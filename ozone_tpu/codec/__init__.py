@@ -1,0 +1,23 @@
+"""Erasure-codec layer: GF(2^8) math, RS/XOR coders, device CRC32C, SPI.
+
+Mirrors the capability surface of the reference's hadoop-hdds/erasurecode
+module (RawErasureEncoder/Decoder SPI, CodecRegistry, RS + XOR + Dummy
+coders) with TPU-first backends: encode/decode are batched GF(2) bit-matrix
+products on the MXU instead of byte-wise table lookups.
+"""
+
+from ozone_tpu.codec.api import (
+    CoderOptions,
+    RawErasureDecoder,
+    RawErasureEncoder,
+)
+from ozone_tpu.codec.registry import CodecRegistry, create_decoder, create_encoder
+
+__all__ = [
+    "CoderOptions",
+    "RawErasureEncoder",
+    "RawErasureDecoder",
+    "CodecRegistry",
+    "create_encoder",
+    "create_decoder",
+]

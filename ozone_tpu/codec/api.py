@@ -1,0 +1,186 @@
+"""Raw erasure coder SPI.
+
+Capability mirror of the reference's RawErasureEncoder/RawErasureDecoder
+abstract classes (reference erasurecode rawcoder/RawErasureEncoder.java:42,
+RawErasureDecoder.java) with an array-first contract instead of the
+ByteBuffer position dance:
+
+- encode(data) takes uint8 arrays shaped [k, C] or batched [B, k, C] and
+  returns parity shaped [p, C] / [B, p, C].
+- decode(inputs, erased) takes a length-(k+p) sequence with None holes
+  (at least k present — same contract as the reference's decode inputs,
+  RawErasureDecoder.java "erasedIndexes indicate erased units") and returns
+  the reconstructed units in `erased` order.
+
+Batching over B stripes is the fundamental TPU-side design difference: the
+reference encodes one stripe per call per thread; here one call dispatches
+thousands of stripes to the device.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class CoderOptions:
+    """Schema for one coder instance.
+
+    Analog of the reference's ECReplicationConfig (hdds/client/
+    ECReplicationConfig.java:35-136): data units, parity units, codec name,
+    and the EC cell ("chunk") size with the same 1 MiB default (:74).
+    String form parses/prints as e.g. "rs-6-3-1024k" (:105).
+    """
+
+    data_units: int
+    parity_units: int
+    codec: str = "rs"
+    cell_size: int = 1024 * 1024
+
+    def __post_init__(self):
+        if self.data_units < 1 or self.parity_units < 1:
+            raise ValueError(f"bad EC schema {self}")
+        if self.data_units + self.parity_units >= 256:
+            raise ValueError("k+p must be < 256 for GF(2^8) RS")
+
+    @property
+    def all_units(self) -> int:
+        return self.data_units + self.parity_units
+
+    @classmethod
+    def parse(cls, s: str) -> "CoderOptions":
+        """Parse "rs-6-3-1024k" / "xor-2-1-4096" forms."""
+        parts = s.strip().lower().split("-")
+        if len(parts) not in (3, 4):
+            raise ValueError(f"cannot parse EC config {s!r}")
+        codec, k, p = parts[0], int(parts[1]), int(parts[2])
+        cell = 1024 * 1024
+        if len(parts) == 4:
+            t = parts[3]
+            if t.endswith("k"):
+                cell = int(t[:-1]) * 1024
+            elif t.endswith("m"):
+                cell = int(t[:-1]) * 1024 * 1024
+            else:
+                cell = int(t)
+        return cls(k, p, codec, cell)
+
+    def __str__(self) -> str:
+        if self.cell_size % (1024 * 1024) == 0:
+            t = f"{self.cell_size // (1024 * 1024)}m"
+        elif self.cell_size % 1024 == 0:
+            t = f"{self.cell_size // 1024}k"
+        else:
+            t = str(self.cell_size)
+        return f"{self.codec}-{self.data_units}-{self.parity_units}-{t}"
+
+
+def _as_batched(arr: np.ndarray, units: int) -> tuple[np.ndarray, bool]:
+    """Normalize [units, C] -> [1, units, C]; return (arr, was_unbatched)."""
+    arr = np.asarray(arr)
+    if arr.dtype != np.uint8:
+        raise TypeError(f"expected uint8 buffers, got {arr.dtype}")
+    if arr.ndim == 2:
+        if arr.shape[0] != units:
+            raise ValueError(f"expected {units} units, got {arr.shape[0]}")
+        return arr[None], True
+    if arr.ndim == 3:
+        if arr.shape[1] != units:
+            raise ValueError(f"expected {units} units, got {arr.shape[1]}")
+        return arr, False
+    raise ValueError(f"expected [units,C] or [B,units,C], got shape {arr.shape}")
+
+
+class RawErasureEncoder:
+    """Base encoder. Subclasses implement do_encode on [B, k, C]."""
+
+    def __init__(self, options: CoderOptions):
+        self.options = options
+
+    @property
+    def k(self) -> int:
+        return self.options.data_units
+
+    @property
+    def p(self) -> int:
+        return self.options.parity_units
+
+    def encode(self, data: np.ndarray | Sequence[np.ndarray]) -> np.ndarray:
+        """data: [k, C] or [B, k, C] (or sequence of k equal-length buffers)
+        -> parity [p, C] or [B, p, C]."""
+        if not isinstance(data, np.ndarray):
+            data = np.stack([np.asarray(d, dtype=np.uint8) for d in data])
+        batched, squeeze = _as_batched(data, self.k)
+        out = self.do_encode(batched)
+        return out[0] if squeeze else out
+
+    def do_encode(self, data: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def release(self) -> None:
+        """Free coder resources (reference RawErasureEncoder.release())."""
+
+
+class RawErasureDecoder:
+    """Base decoder. Subclasses implement do_decode on dense valid inputs."""
+
+    def __init__(self, options: CoderOptions):
+        self.options = options
+
+    @property
+    def k(self) -> int:
+        return self.options.data_units
+
+    @property
+    def p(self) -> int:
+        return self.options.parity_units
+
+    def decode(
+        self,
+        inputs: Sequence[Optional[np.ndarray]],
+        erased_indexes: Sequence[int],
+    ) -> np.ndarray:
+        """Reconstruct `erased_indexes` units.
+
+        inputs: length k+p, None for unavailable units, each present unit
+        [C] or [B, C]. Returns [len(erased), C] / [B, len(erased), C].
+        Contract mirrors reference RawErasureDecoder.decode (inputs with
+        null holes, >= k non-null, erasedIndexes list).
+        """
+        n = self.options.all_units
+        if len(inputs) != n:
+            raise ValueError(f"inputs must have length {n}, got {len(inputs)}")
+        erased = [int(e) for e in erased_indexes]
+        if not erased:
+            raise ValueError("erased_indexes must not be empty")
+        for e in erased:
+            if not 0 <= e < n:
+                raise ValueError(f"erased index {e} out of range")
+            if inputs[e] is not None:
+                raise ValueError(f"erased index {e} has a non-null input")
+        avail = [i for i, b in enumerate(inputs) if b is not None]
+        if len(avail) < self.k:
+            raise ValueError(
+                f"need at least {self.k} available units, have {len(avail)}"
+            )
+        valid = avail[: self.k]
+        dense = np.stack([np.asarray(inputs[i], dtype=np.uint8) for i in valid])
+        # dense is [k, C] or [k, B, C] -> normalize to [B, k, C]
+        if dense.ndim == 2:
+            out = self.do_decode(dense[None], valid, erased)
+            return out[0]
+        elif dense.ndim == 3:
+            return self.do_decode(np.swapaxes(dense, 0, 1), valid, erased)
+        raise ValueError(f"bad input rank {dense.ndim}")
+
+    def do_decode(
+        self, valid_data: np.ndarray, valid: list[int], erased: list[int]
+    ) -> np.ndarray:
+        """valid_data: [B, k, C] in valid-index order -> [B, len(erased), C]."""
+        raise NotImplementedError
+
+    def release(self) -> None:
+        """Free coder resources."""

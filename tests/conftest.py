@@ -1,0 +1,15 @@
+"""Test harness config: force JAX onto 8 virtual CPU devices.
+
+Multi-chip sharding is validated on a virtual CPU mesh (the driver
+separately dry-runs the multichip path); real-TPU runs happen in bench.py.
+Must run before the first jax import anywhere in the test process.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,69 @@
+"""GF(2^8) field math tests."""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.codec import gf256
+
+
+def test_field_axioms_on_samples():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, 1000, dtype=np.uint8)
+    b = rng.integers(0, 256, 1000, dtype=np.uint8)
+    c = rng.integers(0, 256, 1000, dtype=np.uint8)
+    # commutativity, associativity, distributivity over XOR (field addition)
+    assert np.array_equal(gf256.gf_mul(a, b), gf256.gf_mul(b, a))
+    assert np.array_equal(
+        gf256.gf_mul(gf256.gf_mul(a, b), c), gf256.gf_mul(a, gf256.gf_mul(b, c))
+    )
+    assert np.array_equal(
+        gf256.gf_mul(a, b ^ c), gf256.gf_mul(a, b) ^ gf256.gf_mul(a, c)
+    )
+
+
+def test_identity_and_zero():
+    a = np.arange(256, dtype=np.uint8)
+    assert np.array_equal(gf256.gf_mul(a, np.uint8(1)), a)
+    assert np.array_equal(gf256.gf_mul(a, np.uint8(0)), np.zeros(256, np.uint8))
+
+
+def test_inverse():
+    a = np.arange(1, 256, dtype=np.uint8)
+    inv = gf256.gf_inv(a)
+    assert np.array_equal(gf256.gf_mul(a, inv), np.ones(255, np.uint8))
+    assert gf256.gf_inv(np.uint8(0)) == 0
+
+
+def test_known_values_match_reference_tables():
+    # Spot values from the reference's generated antilog table
+    # (GF256.java:31-84): EXP[8] = 0x1d (poly reduction), EXP[254] = 0x8e.
+    assert gf256.EXP[0] == 1
+    assert gf256.EXP[1] == 2
+    assert gf256.EXP[8] == 0x1D
+    assert gf256.EXP[254] == 0x8E
+    assert gf256.EXP[255] == 1
+    # mul via poly: 0x80 * 2 = 0x100 -> ^0x11d = 0x1d
+    assert gf256.gf_mul(np.uint8(0x80), np.uint8(2)) == 0x1D
+
+
+def test_matrix_inverse_roundtrip():
+    rng = np.random.default_rng(1)
+    for n in (1, 2, 5, 10):
+        # random invertible matrix: retry until non-singular
+        for _ in range(20):
+            m = rng.integers(0, 256, (n, n), dtype=np.uint8)
+            try:
+                inv = gf256.gf_invert_matrix(m)
+            except ValueError:
+                continue
+            prod = gf256.gf_matmul(m, inv)
+            assert np.array_equal(prod, np.eye(n, dtype=np.uint8))
+            break
+        else:
+            pytest.fail("could not find invertible matrix")
+
+
+def test_singular_matrix_raises():
+    m = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(ValueError):
+        gf256.gf_invert_matrix(m)

@@ -1,0 +1,50 @@
+"""RS coding-matrix tests: Cauchy structure + decode-matrix correctness."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ozone_tpu.codec import gf256, rs_math
+
+
+def test_encode_matrix_structure():
+    k, p = 6, 3
+    m = rs_math.encode_matrix(k, p)
+    assert m.shape == (k + p, k)
+    assert np.array_equal(m[:k], np.eye(k, dtype=np.uint8))
+    # parity rows: inv(i ^ j) per reference RSUtil.genCauchyMatrix
+    for i in range(k, k + p):
+        for j in range(k):
+            assert m[i, j] == gf256.gf_inv(np.uint8(i ^ j))
+
+
+@pytest.mark.parametrize("k,p", [(3, 2), (6, 3), (10, 4), (2, 1)])
+def test_any_k_rows_invertible(k, p):
+    m = rs_math.encode_matrix(k, p)
+    # MDS property: every k-subset of rows is invertible
+    count = 0
+    for rows in itertools.combinations(range(k + p), k):
+        gf256.gf_invert_matrix(m[list(rows)])
+        count += 1
+        if count > 200:  # cap for the big schemas
+            break
+
+
+@pytest.mark.parametrize("k,p", [(3, 2), (6, 3), (10, 4)])
+def test_decode_matrix_recovers(k, p):
+    rng = np.random.default_rng(42)
+    data = rng.integers(0, 256, (k, 64), dtype=np.uint8)
+    enc = rs_math.encode_matrix(k, p)
+    units = gf256.gf_matmul(enc, data)  # [k+p, 64]; top k rows == data
+
+    for n_erase in range(1, p + 1):
+        for _ in range(10):
+            erased = sorted(
+                rng.choice(k + p, size=n_erase, replace=False).tolist()
+            )
+            avail = [i for i in range(k + p) if i not in erased]
+            valid = rs_math.valid_indexes(avail, k, p)
+            dm = rs_math.decode_matrix(k, p, erased, valid)
+            rec = gf256.gf_matmul(dm, units[valid])
+            assert np.array_equal(rec, units[erased]), (erased, valid)
