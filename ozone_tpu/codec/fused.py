@@ -1,0 +1,110 @@
+"""Fused EC encode + CRC pass: stripes never round-trip to host.
+
+One jitted program takes a stripe batch [B, k, C], produces parity
+[B, p, C] and per-slice CRCs for all k+p units [B, k+p, C/bpc] — the
+north-star fusion (BASELINE.json: "ChunkUtils CRC32C checksumming is fused
+into the same device pass so stripes never round-trip to host between
+encode and verify"). The reference computes these in two separate host
+passes (RSUtil.encodeData then Checksum.computeChecksum per chunk).
+
+Also provides the fused decode+verify used by degraded read and offline
+reconstruction: recover erased units and checksum them in one dispatch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ozone_tpu.codec import crc_device, rs_math
+from ozone_tpu.codec.api import CoderOptions
+from ozone_tpu.codec.bitlin import expand_coding_matrix
+from ozone_tpu.codec.jax_coder import gf_apply
+from ozone_tpu.utils import checksum as hostsum
+from ozone_tpu.utils.checksum import ChecksumType
+
+_POLY = {
+    ChecksumType.CRC32: hostsum.CRC32_POLY,
+    ChecksumType.CRC32C: hostsum.CRC32C_POLY,
+}
+
+
+@dataclass(frozen=True)
+class FusedSpec:
+    options: CoderOptions
+    checksum: ChecksumType = ChecksumType.CRC32C
+    bytes_per_checksum: int = 16 * 1024
+
+
+@lru_cache(maxsize=16)
+def _fused_encode_cached(options: CoderOptions, checksum: ChecksumType, bpc: int):
+    a_np = expand_coding_matrix(
+        rs_math.parity_matrix(options.data_units, options.parity_units)
+    )
+    a = jnp.asarray(a_np, dtype=jnp.int8)
+    if checksum in _POLY:
+        k_np, zeros_crc = crc_device.crc_constants(bpc, _POLY[checksum])
+        k_dev = jnp.asarray(k_np)
+    else:
+        k_dev, zeros_crc = None, 0
+
+    @jax.jit
+    def fn(data: jax.Array):
+        parity = gf_apply(data, a)
+        units = jnp.concatenate([data, parity], axis=1)  # [B, k+p, C]
+        if k_dev is None:
+            return parity, jnp.zeros(units.shape[:2] + (0,), jnp.uint32)
+        crcs = crc_device.crc_slices(units, k_dev, zeros_crc)
+        return parity, crcs
+
+    return fn
+
+
+def make_fused_encoder(spec: FusedSpec):
+    """jitted fn(data uint8 [B, k, C]) -> (parity [B, p, C],
+    crcs uint32 [B, k+p, C // bpc]). C must divide by bytes_per_checksum."""
+    return _fused_encode_cached(spec.options, spec.checksum,
+                                spec.bytes_per_checksum)
+
+
+@lru_cache(maxsize=64)
+def _fused_decode_cached(
+    options: CoderOptions,
+    checksum: ChecksumType,
+    bpc: int,
+    valid: tuple,
+    erased: tuple,
+):
+    dm = rs_math.decode_matrix(
+        options.data_units, options.parity_units, list(erased), list(valid)
+    )
+    a = jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
+    if checksum in _POLY:
+        k_np, zeros_crc = crc_device.crc_constants(bpc, _POLY[checksum])
+        k_dev = jnp.asarray(k_np)
+    else:
+        k_dev, zeros_crc = None, 0
+
+    @jax.jit
+    def fn(valid_units: jax.Array):
+        rec = gf_apply(valid_units, a)  # [B, e, C]
+        if k_dev is None:
+            return rec, jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
+        crcs = crc_device.crc_slices(rec, k_dev, zeros_crc)
+        return rec, crcs
+
+    return fn
+
+
+def make_fused_decoder(spec: FusedSpec, valid: list[int], erased: list[int]):
+    """jitted fn(valid_units uint8 [B, k, C]) -> (recovered [B, e, C],
+    crcs uint32 [B, e, C // bpc]). valid lists the unit indexes of the rows
+    supplied, erased the unit indexes to reconstruct."""
+    return _fused_decode_cached(
+        spec.options, spec.checksum, spec.bytes_per_checksum,
+        tuple(valid), tuple(erased),
+    )

@@ -1,0 +1,139 @@
+"""Checksum tests: host reference vs zlib/test-vectors, device vs host."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from ozone_tpu.utils import checksum as cs
+from ozone_tpu.utils.checksum import (
+    Checksum,
+    ChecksumData,
+    ChecksumError,
+    ChecksumType,
+)
+
+
+def test_crc32c_test_vector():
+    # RFC 3720 / known Castagnoli vector
+    v = np.frombuffer(b"123456789", dtype=np.uint8)
+    assert cs.crc32c(v) == 0xE3069283
+
+
+def test_crc32_matches_zlib():
+    rng = np.random.default_rng(0)
+    for n in (0, 1, 9, 255, 256, 1024, 16384, 100_000):
+        d = rng.integers(0, 256, n, dtype=np.uint8)
+        assert cs.crc32(d) == zlib.crc32(d.tobytes()), n
+
+
+def test_linear_equals_table():
+    rng = np.random.default_rng(1)
+    for poly in (cs.CRC32_POLY, cs.CRC32C_POLY):
+        for n in (1, 7, 64, 1000, 16384):
+            d = rng.integers(0, 256, n, dtype=np.uint8)
+            assert cs.crc_linear(d, poly) == cs.crc_table_driven(d, poly)
+
+
+def test_checksum_compute_verify():
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, 70_000, dtype=np.uint8)
+    for t in ChecksumType:
+        c = Checksum(t, 16 * 1024)
+        cd = c.compute(data)
+        c.verify(data, cd)
+        if t is ChecksumType.NONE:
+            continue
+        assert len(cd.checksums) == 5  # ceil(70000 / 16384)
+        corrupted = data.copy()
+        corrupted[40_000] ^= 0xFF
+        with pytest.raises(ChecksumError):
+            c.verify(corrupted, cd)
+
+
+def test_checksum_data_serde():
+    cd = Checksum(ChecksumType.CRC32C, 1024).compute(
+        (np.arange(2048) % 256).astype(np.uint8)
+    )
+    rt = ChecksumData.from_lists(cd.to_lists())
+    assert rt == cd
+
+
+def test_device_crc_matches_host():
+    from ozone_tpu.codec.crc_device import make_crc_fn
+
+    rng = np.random.default_rng(3)
+    bpc = 512
+    cells = rng.integers(0, 256, (4, 3, 4 * bpc), dtype=np.uint8)
+    fn = make_crc_fn(bpc, cs.CRC32C_POLY)
+    got = np.asarray(fn(cells))
+    assert got.shape == (4, 3, 4)
+    for b in range(4):
+        for u in range(3):
+            for s in range(4):
+                expect = cs.crc32c(cells[b, u, s * bpc : (s + 1) * bpc])
+                assert int(got[b, u, s]) == expect, (b, u, s)
+
+
+def test_device_crc_crc32_poly():
+    from ozone_tpu.codec.crc_device import make_crc_fn
+
+    rng = np.random.default_rng(4)
+    cells = rng.integers(0, 256, (2, 2048), dtype=np.uint8)
+    fn = make_crc_fn(1024, cs.CRC32_POLY)
+    got = np.asarray(fn(cells))
+    for b in range(2):
+        for s in range(2):
+            assert int(got[b, s]) == zlib.crc32(
+                cells[b, s * 1024 : (s + 1) * 1024].tobytes()
+            )
+
+
+def test_fused_encode_crc():
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.codec.fused import FusedSpec, make_fused_encoder
+    from ozone_tpu.codec.numpy_coder import NumpyRSEncoder
+
+    rng = np.random.default_rng(5)
+    opts = CoderOptions(6, 3, "rs", cell_size=2048)
+    spec = FusedSpec(opts, ChecksumType.CRC32C, bytes_per_checksum=512)
+    fn = make_fused_encoder(spec)
+    data = rng.integers(0, 256, (3, 6, 2048), dtype=np.uint8)
+    parity, crcs = (np.asarray(x) for x in fn(data))
+    assert parity.shape == (3, 3, 2048)
+    assert crcs.shape == (3, 9, 4)
+    # parity matches the numpy reference coder
+    expect_parity = NumpyRSEncoder(opts).encode(data)
+    assert np.array_equal(parity, expect_parity)
+    # crcs match host checksums of data+parity
+    units = np.concatenate([data, parity], axis=1)
+    for b in range(3):
+        for u in range(9):
+            for s in range(4):
+                assert int(crcs[b, u, s]) == cs.crc32c(
+                    units[b, u, s * 512 : (s + 1) * 512]
+                )
+
+
+def test_fused_decode_crc():
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.codec.fused import FusedSpec, make_fused_decoder
+    from ozone_tpu.codec.numpy_coder import NumpyRSEncoder
+
+    rng = np.random.default_rng(6)
+    opts = CoderOptions(6, 3, "rs", cell_size=1024)
+    spec = FusedSpec(opts, ChecksumType.CRC32C, bytes_per_checksum=256)
+    data = rng.integers(0, 256, (2, 6, 1024), dtype=np.uint8)
+    parity = NumpyRSEncoder(opts).encode(data)
+    units = np.concatenate([data, parity], axis=1)
+    erased = [1, 7]
+    valid = [i for i in range(9) if i not in erased][:6]
+    fn = make_fused_decoder(spec, valid, erased)
+    rec, crcs = (np.asarray(x) for x in fn(units[:, valid]))
+    assert np.array_equal(rec, units[:, erased])
+    for b in range(2):
+        for e in range(2):
+            for s in range(4):
+                assert int(crcs[b, e, s]) == cs.crc32c(
+                    rec[b, e, s * 256 : (s + 1) * 256]
+                )
