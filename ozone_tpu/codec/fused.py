@@ -47,7 +47,7 @@ def _fused_encode_cached(options: CoderOptions, checksum: ChecksumType, bpc: int
     )
     a = jnp.asarray(a_np, dtype=jnp.int8)
     if checksum in _POLY:
-        k_np, zeros_crc = crc_device.crc_constants(bpc, _POLY[checksum])
+        k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
         k_dev = jnp.asarray(k_np)
     else:
         k_dev, zeros_crc = None, 0
@@ -55,10 +55,19 @@ def _fused_encode_cached(options: CoderOptions, checksum: ChecksumType, bpc: int
     @jax.jit
     def fn(data: jax.Array):
         parity = gf_apply(data, a)
-        units = jnp.concatenate([data, parity], axis=1)  # [B, k+p, C]
         if k_dev is None:
-            return parity, jnp.zeros(units.shape[:2] + (0,), jnp.uint32)
-        crcs = crc_device.crc_slices(units, k_dev, zeros_crc)
+            return parity, jnp.zeros(
+                (data.shape[0], data.shape[1] + parity.shape[1], 0), jnp.uint32
+            )
+        # CRC data and parity units separately (concatenating the byte
+        # buffers first would copy 1.5x the batch through HBM)
+        crcs = jnp.concatenate(
+            [
+                crc_device.crc_slices(data, k_dev, zeros_crc),
+                crc_device.crc_slices(parity, k_dev, zeros_crc),
+            ],
+            axis=1,
+        )
         return parity, crcs
 
     return fn
@@ -84,7 +93,7 @@ def _fused_decode_cached(
     )
     a = jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
     if checksum in _POLY:
-        k_np, zeros_crc = crc_device.crc_constants(bpc, _POLY[checksum])
+        k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
         k_dev = jnp.asarray(k_np)
     else:
         k_dev, zeros_crc = None, 0
