@@ -1,0 +1,242 @@
+"""Containers and volumes: the datanode storage engine.
+
+Mirrors the reference's KeyValueContainer model (container-service
+keyvalue/: a container is a directory with a descriptor + chunk files,
+block metadata in a per-volume DB — schema V3 "one RocksDB per volume",
+reference doc dn-merge-rocksdb). Here: one sqlite DB per volume holding
+block metadata for all containers on that volume, a JSON descriptor per
+container (ContainerDataYaml analog), and FilePerBlockStore chunk files.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from pathlib import Path
+from typing import Iterator, Optional
+
+from ozone_tpu.storage.chunk_store import FilePerBlockStore
+from ozone_tpu.storage.ids import (
+    CONTAINER_EXISTS,
+    CONTAINER_NOT_FOUND,
+    INVALID_CONTAINER_STATE,
+    NO_SUCH_BLOCK,
+    BlockData,
+    BlockID,
+    ContainerState,
+    StorageError,
+)
+
+
+class VolumeDB:
+    """Per-volume block-metadata store (schema V3 analog)."""
+
+    def __init__(self, path: Path):
+        self._path = path
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(str(path), check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS blocks ("
+            " container_id INTEGER, local_id INTEGER, data TEXT,"
+            " PRIMARY KEY (container_id, local_id))"
+        )
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.commit()
+
+    def put_block(self, block: BlockData) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO blocks VALUES (?, ?, ?)",
+                (
+                    block.block_id.container_id,
+                    block.block_id.local_id,
+                    json.dumps(block.to_json()),
+                ),
+            )
+            self._conn.commit()
+
+    def get_block(self, block_id: BlockID) -> Optional[BlockData]:
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT data FROM blocks WHERE container_id=? AND local_id=?",
+                (block_id.container_id, block_id.local_id),
+            ).fetchone()
+        return BlockData.from_json(json.loads(row[0])) if row else None
+
+    def list_blocks(self, container_id: int) -> list[BlockData]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT data FROM blocks WHERE container_id=? ORDER BY local_id",
+                (container_id,),
+            ).fetchall()
+        return [BlockData.from_json(json.loads(r[0])) for r in rows]
+
+    def delete_block(self, block_id: BlockID) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM blocks WHERE container_id=? AND local_id=?",
+                (block_id.container_id, block_id.local_id),
+            )
+            self._conn.commit()
+
+    def delete_container(self, container_id: int) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM blocks WHERE container_id=?", (container_id,)
+            )
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+class Container:
+    """One container replica on one volume."""
+
+    def __init__(
+        self,
+        container_id: int,
+        root: Path,
+        db: VolumeDB,
+        state: ContainerState = ContainerState.OPEN,
+        replica_index: int = 0,
+    ):
+        self.id = container_id
+        self.root = Path(root)
+        self.db = db
+        self.state = state
+        self.replica_index = replica_index
+        self.created_at = time.time()
+        self.chunks = FilePerBlockStore(self.root / "chunks")
+        self._lock = threading.RLock()
+
+    # -- descriptor (ContainerDataYaml analog) --
+    def _descriptor_path(self) -> Path:
+        return self.root / "container.json"
+
+    def save_descriptor(self) -> None:
+        self._descriptor_path().write_text(
+            json.dumps(
+                {
+                    "id": self.id,
+                    "state": self.state.value,
+                    "replica_index": self.replica_index,
+                    "created_at": self.created_at,
+                }
+            )
+        )
+
+    @classmethod
+    def load(cls, root: Path, db: VolumeDB) -> "Container":
+        d = json.loads((Path(root) / "container.json").read_text())
+        c = cls(
+            int(d["id"]),
+            root,
+            db,
+            ContainerState(d["state"]),
+            int(d.get("replica_index", 0)),
+        )
+        c.created_at = d.get("created_at", c.created_at)
+        return c
+
+    # -- state machine --
+    def require_writable(self) -> None:
+        if self.state not in (ContainerState.OPEN, ContainerState.RECOVERING):
+            raise StorageError(
+                INVALID_CONTAINER_STATE,
+                f"container {self.id} is {self.state.value}, not writable",
+            )
+
+    def close(self) -> None:
+        with self._lock:
+            if self.state in (ContainerState.CLOSED, ContainerState.QUASI_CLOSED):
+                return
+            if self.state not in (
+                ContainerState.OPEN,
+                ContainerState.CLOSING,
+                ContainerState.RECOVERING,
+            ):
+                raise StorageError(
+                    INVALID_CONTAINER_STATE,
+                    f"cannot close container {self.id} in {self.state.value}",
+                )
+            self.state = ContainerState.CLOSED
+            self.save_descriptor()
+
+    def mark_unhealthy(self) -> None:
+        with self._lock:
+            self.state = ContainerState.UNHEALTHY
+            self.save_descriptor()
+
+    # -- block ops --
+    def put_block(self, block: BlockData) -> None:
+        self.db.put_block(block)
+
+    def get_block(self, block_id: BlockID) -> BlockData:
+        b = self.db.get_block(block_id)
+        if b is None:
+            raise StorageError(NO_SUCH_BLOCK, str(block_id))
+        return b
+
+    def list_blocks(self) -> list[BlockData]:
+        return self.db.list_blocks(self.id)
+
+    def used_bytes(self) -> int:
+        return sum(b.length for b in self.list_blocks())
+
+
+class HddsVolume:
+    """One storage volume (disk) holding container directories + a VolumeDB."""
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        (self.root / "containers").mkdir(parents=True, exist_ok=True)
+        self.db = VolumeDB(self.root / "metadata.db")
+
+    def container_dir(self, container_id: int) -> Path:
+        return self.root / "containers" / str(container_id)
+
+    def load_containers(self) -> Iterator[Container]:
+        for d in sorted((self.root / "containers").iterdir()):
+            if (d / "container.json").exists():
+                yield Container.load(d, self.db)
+
+    def close(self) -> None:
+        self.db.close()
+
+
+class ContainerSet:
+    """All container replicas on one datanode (reference common/impl/
+    ContainerSet.java)."""
+
+    def __init__(self):
+        self._containers: dict[int, Container] = {}
+        self._lock = threading.Lock()
+
+    def add(self, c: Container, overwrite: bool = False) -> None:
+        with self._lock:
+            if not overwrite and c.id in self._containers:
+                raise StorageError(CONTAINER_EXISTS, str(c.id))
+            self._containers[c.id] = c
+
+    def get(self, container_id: int) -> Container:
+        c = self._containers.get(container_id)
+        if c is None:
+            raise StorageError(CONTAINER_NOT_FOUND, str(container_id))
+        return c
+
+    def get_or_none(self, container_id: int) -> Optional[Container]:
+        return self._containers.get(container_id)
+
+    def remove(self, container_id: int) -> None:
+        with self._lock:
+            self._containers.pop(container_id, None)
+
+    def __iter__(self) -> Iterator[Container]:
+        return iter(list(self._containers.values()))
+
+    def __len__(self) -> int:
+        return len(self._containers)

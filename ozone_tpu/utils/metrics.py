@@ -1,0 +1,137 @@
+"""Metrics: counters/gauges/timers with Prometheus text export.
+
+Capability mirror of the reference's metrics2 registries +
+PrometheusMetricsSink (hadoop-hdds/framework hdds/server/http/
+PrometheusMetricsSink.java — on-by-default /prom endpoint,
+docs Observability.md:32). Every subsystem creates a MetricsRegistry and
+the HTTP layer exposes `prometheus_text()` of the global registry set.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+_all_registries: dict[str, "MetricsRegistry"] = {}
+_all_lock = threading.Lock()
+
+
+class Counter:
+    def __init__(self):
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        return self._v
+
+
+class Gauge:
+    def __init__(self):
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        self._v = v
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Timer:
+    """Latency accumulator: count, total, min/max (freon-style reports)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def update(self, seconds: float) -> None:
+        with self._lock:
+            self.count += 1
+            self.total += seconds
+            self.min = min(self.min, seconds)
+            self.max = max(self.max, seconds)
+
+    def time(self):
+        timer = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *a):
+                timer.update(time.perf_counter() - self.t0)
+
+        return _Ctx()
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    def __init__(self, name: str):
+        self.name = name
+        self._counters: dict[str, Counter] = defaultdict(Counter)
+        self._gauges: dict[str, Gauge] = defaultdict(Gauge)
+        self._timers: dict[str, Timer] = defaultdict(Timer)
+        with _all_lock:
+            _all_registries[name] = self
+
+    def counter(self, name: str) -> Counter:
+        return self._counters[name]
+
+    def gauge(self, name: str) -> Gauge:
+        return self._gauges[name]
+
+    def timer(self, name: str) -> Timer:
+        return self._timers[name]
+
+    def snapshot(self) -> dict:
+        return {
+            **{k: c.value for k, c in self._counters.items()},
+            **{k: g.value for k, g in self._gauges.items()},
+            **{
+                f"{k}_mean_s": t.mean for k, t in self._timers.items() if t.count
+            },
+        }
+
+
+def _sanitize(s: str) -> str:
+    return s.replace(".", "_").replace("-", "_")
+
+
+def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
+    """Prometheus exposition text for one or all registries."""
+    regs = [registry] if registry else list(_all_registries.values())
+    lines: list[str] = []
+    for r in regs:
+        base = _sanitize(r.name)
+        for k, c in r._counters.items():
+            m = f"{base}_{_sanitize(k)}"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {c.value}")
+        for k, g in r._gauges.items():
+            m = f"{base}_{_sanitize(k)}"
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {g.value}")
+        for k, t in r._timers.items():
+            m = f"{base}_{_sanitize(k)}"
+            lines.append(f"# TYPE {m}_seconds summary")
+            lines.append(f"{m}_seconds_count {t.count}")
+            lines.append(f"{m}_seconds_sum {t.total}")
+    return "\n".join(lines) + "\n"
+
+
+def get_registry(name: str) -> Optional[MetricsRegistry]:
+    return _all_registries.get(name)

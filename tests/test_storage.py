@@ -1,0 +1,149 @@
+"""Datanode storage engine tests: containers, chunks, blocks, scanner."""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.storage.datanode import Datanode
+from ozone_tpu.storage.ids import (
+    BlockData,
+    BlockID,
+    ChunkInfo,
+    ContainerState,
+    StorageError,
+)
+from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+
+@pytest.fixture
+def dn(tmp_path):
+    d = Datanode(tmp_path / "dn", num_volumes=2)
+    yield d
+    d.close()
+
+
+def _chunk(data: np.ndarray, offset: int = 0, name: str = "c0") -> ChunkInfo:
+    cs = Checksum(ChecksumType.CRC32C, 4096).compute(data)
+    return ChunkInfo(name, offset, data.size, cs)
+
+
+def test_container_lifecycle(dn):
+    c = dn.create_container(1)
+    assert c.state is ContainerState.OPEN
+    dn.close_container(1)
+    assert dn.get_container(1).state is ContainerState.CLOSED
+    with pytest.raises(StorageError):
+        dn.create_container(1)  # duplicate
+    dn.delete_container(1)
+    with pytest.raises(StorageError):
+        dn.get_container(1)
+
+
+def test_write_read_chunk_roundtrip(dn):
+    dn.create_container(1)
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, 10_000, dtype=np.uint8)
+    bid = BlockID(1, 1)
+    info = _chunk(data)
+    dn.write_chunk(bid, info, data)
+    got = dn.read_chunk(bid, info, verify=True)
+    assert np.array_equal(got, data)
+
+
+def test_multi_chunk_block_offsets(dn):
+    dn.create_container(1)
+    rng = np.random.default_rng(1)
+    bid = BlockID(1, 7)
+    chunks, datas = [], []
+    for i in range(3):
+        d = rng.integers(0, 256, 4096, dtype=np.uint8)
+        info = _chunk(d, offset=i * 4096, name=f"c{i}")
+        dn.write_chunk(bid, info, d)
+        chunks.append(info)
+        datas.append(d)
+    dn.put_block(BlockData(bid, chunks))
+    blk = dn.get_block(bid)
+    assert blk.length == 3 * 4096
+    assert dn.get_committed_block_length(bid) == 3 * 4096
+    for info, d in zip(blk.chunks, datas):
+        assert np.array_equal(dn.read_chunk(bid, info, verify=True), d)
+
+
+def test_closed_container_rejects_writes(dn):
+    dn.create_container(1)
+    dn.close_container(1)
+    data = np.zeros(16, np.uint8)
+    with pytest.raises(StorageError) as ei:
+        dn.write_chunk(BlockID(1, 1), _chunk(data), data)
+    assert "INVALID_CONTAINER_STATE" in str(ei.value)
+
+
+def test_corruption_detection_and_unhealthy(dn):
+    dn.create_container(1)
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, 8192, dtype=np.uint8)
+    bid = BlockID(1, 1)
+    info = _chunk(data)
+    dn.write_chunk(bid, info, data)
+    dn.put_block(BlockData(bid, [info]))
+    # corrupt on disk
+    path = dn.get_container(1).chunks.block_path(bid)
+    raw = bytearray(path.read_bytes())
+    raw[100] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    with pytest.raises(StorageError) as ei:
+        dn.read_chunk(bid, info, verify=True)
+    assert "CHECKSUM_MISMATCH" in str(ei.value)
+    assert dn.get_container(1).state is ContainerState.UNHEALTHY
+
+
+def test_scanner_detects_corruption(dn):
+    dn.create_container(1)
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 8192, dtype=np.uint8)
+    bid = BlockID(1, 1)
+    info = _chunk(data)
+    dn.write_chunk(bid, info, data)
+    dn.put_block(BlockData(bid, [info]))
+    assert dn.scan_container(1) == []
+    path = dn.get_container(1).chunks.block_path(bid)
+    raw = bytearray(path.read_bytes())
+    raw[5000] ^= 1
+    path.write_bytes(bytes(raw))
+    errors = dn.scan_container(1)
+    assert len(errors) == 1
+    assert dn.get_container(1).state is ContainerState.UNHEALTHY
+
+
+def test_persistence_across_restart(tmp_path):
+    root = tmp_path / "dn"
+    dn1 = Datanode(root)
+    dn1.create_container(5)
+    data = np.arange(100, dtype=np.uint8)
+    bid = BlockID(5, 1)
+    info = _chunk(data)
+    dn1.write_chunk(bid, info, data, sync=True)
+    dn1.put_block(BlockData(bid, [info]), sync=True)
+    dn1.close_container(5)
+    dn1.close()
+
+    dn2 = Datanode(root)
+    assert dn2.get_container(5).state is ContainerState.CLOSED
+    blk = dn2.get_block(bid)
+    assert np.array_equal(dn2.read_chunk(bid, blk.chunks[0], verify=True), data)
+    dn2.close()
+
+
+def test_recovering_container_writable(dn):
+    c = dn.create_container(9, replica_index=3, state=ContainerState.RECOVERING)
+    assert c.replica_index == 3
+    data = np.ones(32, np.uint8)
+    dn.write_chunk(BlockID(9, 1), _chunk(data), data)  # no raise
+    dn.close_container(9)
+    assert dn.get_container(9).state is ContainerState.CLOSED
+
+
+def test_container_report(dn):
+    dn.create_container(1)
+    dn.create_container(2, replica_index=1)
+    rep = dn.container_report()
+    assert {r["container_id"] for r in rep} == {1, 2}
