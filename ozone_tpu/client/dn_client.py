@@ -1,0 +1,91 @@
+"""Datanode client abstraction.
+
+Role analog of the reference's XceiverClient family (hadoop-hdds/client
+XceiverClientGrpc / ECXceiverClientGrpc.java:49 — one connection per
+replica-index datanode for EC). The transport is pluggable: in-process
+(tests, single-node), and gRPC (multi-process clusters). All clients expose
+the DatanodeClientProtocol verb surface of storage/datanode.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+import numpy as np
+
+from ozone_tpu.storage.datanode import Datanode
+from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo, ContainerState
+
+
+class DatanodeClient(Protocol):
+    dn_id: str
+
+    def create_container(self, container_id: int, replica_index: int = 0,
+                         state: ContainerState = ContainerState.OPEN) -> None: ...
+    def close_container(self, container_id: int) -> None: ...
+    def delete_container(self, container_id: int, force: bool = False) -> None: ...
+    def write_chunk(self, block_id: BlockID, info: ChunkInfo, data,
+                    sync: bool = False) -> None: ...
+    def read_chunk(self, block_id: BlockID, info: ChunkInfo,
+                   verify: bool = False) -> np.ndarray: ...
+    def put_block(self, block: BlockData, sync: bool = False) -> None: ...
+    def get_block(self, block_id: BlockID) -> BlockData: ...
+    def list_blocks(self, container_id: int) -> list[BlockData]: ...
+    def get_committed_block_length(self, block_id: BlockID) -> int: ...
+
+
+class LocalDatanodeClient:
+    """In-process client wrapping a Datanode instance directly."""
+
+    def __init__(self, dn: Datanode):
+        self.dn = dn
+        self.dn_id = dn.id
+
+    def create_container(self, container_id, replica_index=0,
+                         state=ContainerState.OPEN):
+        self.dn.create_container(container_id, replica_index, state)
+
+    def close_container(self, container_id):
+        self.dn.close_container(container_id)
+
+    def delete_container(self, container_id, force=False):
+        self.dn.delete_container(container_id, force)
+
+    def write_chunk(self, block_id, info, data, sync=False):
+        self.dn.write_chunk(block_id, info, data, sync)
+
+    def read_chunk(self, block_id, info, verify=False):
+        return self.dn.read_chunk(block_id, info, verify)
+
+    def put_block(self, block, sync=False):
+        self.dn.put_block(block, sync)
+
+    def get_block(self, block_id):
+        return self.dn.get_block(block_id)
+
+    def list_blocks(self, container_id):
+        return self.dn.list_blocks(container_id)
+
+    def get_committed_block_length(self, block_id):
+        return self.dn.get_committed_block_length(block_id)
+
+
+class DatanodeClientFactory:
+    """dn_id -> client resolver (XceiverClientManager pool analog)."""
+
+    def __init__(self):
+        self._local: dict[str, LocalDatanodeClient] = {}
+
+    def register_local(self, dn: Datanode) -> LocalDatanodeClient:
+        c = LocalDatanodeClient(dn)
+        self._local[dn.id] = c
+        return c
+
+    def get(self, dn_id: str) -> DatanodeClient:
+        c = self._local.get(dn_id)
+        if c is None:
+            raise KeyError(f"no client for datanode {dn_id}")
+        return c
+
+    def maybe_get(self, dn_id: str) -> Optional[DatanodeClient]:
+        return self._local.get(dn_id)

@@ -1,0 +1,107 @@
+"""Pipeline and replication-config model.
+
+Mirrors the reference's hdds/client ReplicationConfig hierarchy
+(RatisReplicationConfig / ECReplicationConfig, hdds/client/
+ECReplicationConfig.java) and the SCM pipeline object (hdds Pipeline:
+a set of datanodes carrying one replication scheme; for EC, each node is
+bound to a replica index 1..d+p — ECPipelineProvider.java:45).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Optional
+
+from ozone_tpu.codec.api import CoderOptions
+
+
+class ReplicationType(Enum):
+    STANDALONE = "STANDALONE"
+    RATIS = "RATIS"
+    EC = "EC"
+
+
+@dataclass(frozen=True)
+class ReplicationConfig:
+    """Replication scheme of a bucket/key/container."""
+
+    type: ReplicationType
+    factor: int = 1  # RATIS/STANDALONE replica count
+    ec: Optional[CoderOptions] = None
+
+    @classmethod
+    def ratis(cls, factor: int = 3) -> "ReplicationConfig":
+        return cls(ReplicationType.RATIS, factor=factor)
+
+    @classmethod
+    def standalone(cls) -> "ReplicationConfig":
+        return cls(ReplicationType.STANDALONE, factor=1)
+
+    @classmethod
+    def from_ec(cls, ec: CoderOptions) -> "ReplicationConfig":
+        return cls(ReplicationType.EC, factor=ec.all_units, ec=ec)
+
+    @classmethod
+    def parse(cls, s: str) -> "ReplicationConfig":
+        """Parse "RATIS/THREE", "RATIS/1", "rs-6-3-1024k" style strings."""
+        s = s.strip()
+        up = s.upper()
+        if up.startswith("RATIS") or up.startswith("STANDALONE"):
+            t = ReplicationType.RATIS if up.startswith("RATIS") else \
+                ReplicationType.STANDALONE
+            factor = 3
+            if "/" in s:
+                f = s.split("/")[1].upper()
+                factor = {"ONE": 1, "THREE": 3}.get(f) or int(f)
+            return cls(t, factor=factor)
+        return cls.from_ec(CoderOptions.parse(s))
+
+    @property
+    def required_nodes(self) -> int:
+        return self.ec.all_units if self.ec else self.factor
+
+    def __str__(self) -> str:
+        if self.type is ReplicationType.EC:
+            return str(self.ec)
+        return f"{self.type.value}/{self.factor}"
+
+
+class PipelineState(Enum):
+    ALLOCATED = "ALLOCATED"
+    OPEN = "OPEN"
+    DORMANT = "DORMANT"
+    CLOSED = "CLOSED"
+
+
+_pipeline_ids = itertools.count(1)
+
+
+@dataclass
+class Pipeline:
+    """An ordered set of datanodes carrying one replication scheme.
+
+    For EC pipelines, node i (0-based) holds replica index i+1 — data units
+    first, then parity, matching ECBlockOutputStreamEntry's fan-out
+    (replicationIndex 1..d+p)."""
+
+    replication: ReplicationConfig
+    nodes: list[str]  # datanode ids, ordered
+    id: int = field(default_factory=lambda: next(_pipeline_ids))
+    state: PipelineState = PipelineState.OPEN
+
+    def __post_init__(self):
+        if len(self.nodes) != self.replication.required_nodes:
+            raise ValueError(
+                f"pipeline needs {self.replication.required_nodes} nodes, "
+                f"got {len(self.nodes)}"
+            )
+
+    def replica_index(self, dn_id: str) -> int:
+        """1-based replica index of a node (EC), mirroring
+        Pipeline.getReplicaIndex in the reference."""
+        return self.nodes.index(dn_id) + 1
+
+    def node_for_index(self, replica_index: int) -> str:
+        return self.nodes[replica_index - 1]
