@@ -164,6 +164,14 @@ class ECBlockGroupReader:
         """Reconstruct full cells of `targets` units for the given stripes
         (default: all). Returns uint8 [num_stripes, len(targets), cell].
         The recoverChunks analog driving offline reconstruction."""
+        return self.recover_cells_with_crcs(targets, stripes)[0]
+
+    def recover_cells_with_crcs(
+        self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """recover_cells plus the per-slice device CRCs of the recovered
+        cells [num_stripes, len(targets), cell // bpc] — reconstruction
+        writes reuse them so recovered data is never re-checksummed on host."""
         for _ in range(self.p + 1):
             try:
                 return self._recover_cells_once(targets, stripes)
@@ -188,8 +196,8 @@ class ECBlockGroupReader:
             for vi, u in enumerate(valid):
                 batch[bi, vi] = self._read_cell_checked(u, s)
         fn = make_fused_decoder(self.spec, valid, list(targets))
-        rec, _crcs = fn(batch)
-        return np.asarray(rec)
+        rec, crcs = fn(batch)
+        return np.asarray(rec), np.asarray(crcs)
 
     def _read_reconstructed(self) -> np.ndarray:
         avail = set(self.available_units())

@@ -1,0 +1,107 @@
+"""Offline reconstruction coordinator tests (TestECContainerRecovery
+strategy analog: lose replicas, reconstruct to fresh nodes, verify
+byte-exactness and metadata)."""
+
+import numpy as np
+import pytest
+
+from tests.test_ec_pipeline import CELL, OPTS, MiniEC, _write_key
+from ozone_tpu.storage.ids import ContainerState, StorageError
+from ozone_tpu.storage.reconstruction import (
+    ECReconstructionCoordinator,
+    ReconstructionCommand,
+)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniEC(tmp_path, n_dn=8)
+    yield c
+    c.close()
+
+
+def _reconstruct(cluster, group, lost_units, target_dns):
+    """lost_units: 0-based; targets assigned in order."""
+    sources = {
+        u + 1: group.pipeline.nodes[u]
+        for u in range(OPTS.all_units)
+        if u not in lost_units
+    }
+    targets = {u + 1: dn for u, dn in zip(lost_units, target_dns)}
+    cmd = ReconstructionCommand(group.container_id, OPTS, sources, targets)
+    coord = ECReconstructionCoordinator(cluster.clients, bytes_per_checksum=1024)
+    coord.reconstruct_container_group(cmd)
+    return cmd
+
+
+def test_reconstruct_data_unit(cluster):
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, 7 * CELL + 123, dtype=np.uint8)
+    groups = _write_key(cluster, data)
+    g = groups[0]
+    lost = [1]
+    # wipe unit 1's replica entirely
+    dn_lost = next(d for d in cluster.dns if d.id == g.pipeline.nodes[1])
+    dn_lost.delete_container(g.container_id, force=True)
+
+    _reconstruct(cluster, g, lost, ["dn6"])
+    dn6 = next(d for d in cluster.dns if d.id == "dn6")
+    c = dn6.get_container(g.container_id)
+    assert c.state is ContainerState.CLOSED
+    assert c.replica_index == 2
+    # reconstructed block must byte-match the original unit content
+    blk = dn6.get_block(g.block_id)
+    assert blk.block_group_length == g.length
+    # verify chunk checksums were persisted and data verifies
+    for info in blk.chunks:
+        dn6.read_chunk(g.block_id, info, verify=True)
+    # full key still readable using reconstructed replica only:
+    # point the group's unit to dn6 and kill enough others to force its use
+    g.pipeline.nodes[1] = "dn6"
+    got = cluster.reader(g).read_all()
+    start = 0
+    for gg in groups:
+        if gg is g:
+            break
+        start += gg.length
+    assert np.array_equal(got, data[start : start + g.length])
+
+
+def test_reconstruct_multiple_units_mixed(cluster):
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 6 * CELL, dtype=np.uint8)
+    groups = _write_key(cluster, data)
+    g = groups[0]
+    lost = [0, 4]  # one data unit, one parity unit
+    for u in lost:
+        dn = next(d for d in cluster.dns if d.id == g.pipeline.nodes[u])
+        dn.delete_container(g.container_id, force=True)
+    _reconstruct(cluster, g, lost, ["dn6", "dn7"])
+
+    # swap in the reconstructed replicas and verify full read
+    g.pipeline.nodes[0] = "dn6"
+    g.pipeline.nodes[4] = "dn7"
+    got = cluster.reader(g).read_all()
+    assert np.array_equal(got, data[: g.length])
+    # parity replica on dn7 must carry full cells per stripe
+    dn7 = next(d for d in cluster.dns if d.id == "dn7")
+    blk = dn7.get_block(g.block_id)
+    assert blk.length == cluster.reader(g).num_stripes * CELL
+
+
+def test_reconstruction_failure_cleans_up(cluster):
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, 3 * CELL, dtype=np.uint8)
+    groups = _write_key(cluster, data)
+    g = groups[0]
+    # lose more than p units -> reconstruction must fail and clean targets
+    for u in [0, 1, 2]:
+        dn = next(d for d in cluster.dns if d.id == g.pipeline.nodes[u])
+        dn.delete_container(g.container_id, force=True)
+    with pytest.raises(Exception):
+        _reconstruct(cluster, g, [0, 1, 2], ["dn6", "dn7", "dn5"])
+    # no RECOVERING containers left behind
+    for dn_id in ("dn6", "dn7"):
+        dn = next(d for d in cluster.dns if d.id == dn_id)
+        with pytest.raises(StorageError):
+            dn.get_container(g.container_id)
