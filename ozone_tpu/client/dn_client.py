@@ -32,6 +32,7 @@ class DatanodeClient(Protocol):
     def get_block(self, block_id: BlockID) -> BlockData: ...
     def list_blocks(self, container_id: int) -> list[BlockData]: ...
     def get_committed_block_length(self, block_id: BlockID) -> int: ...
+    def delete_block(self, block_id: BlockID) -> None: ...
 
 
 class LocalDatanodeClient:
@@ -68,6 +69,9 @@ class LocalDatanodeClient:
 
     def get_committed_block_length(self, block_id):
         return self.dn.get_committed_block_length(block_id)
+
+    def delete_block(self, block_id):
+        self.dn.delete_block(block_id)
 
 
 class DatanodeClientFactory:

@@ -28,7 +28,7 @@ import numpy as np
 
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.codec.api import CoderOptions
-from ozone_tpu.codec.fused import FusedSpec, make_fused_encoder
+from ozone_tpu.codec.fused import FusedSpec, effective_bpc, make_fused_encoder
 from ozone_tpu.scm.pipeline import Pipeline
 from ozone_tpu.storage.ids import (
     BlockData,
@@ -132,13 +132,11 @@ class ECKeyWriter:
         self.allocate_group = allocate_group
         self.clients = clients
         self.checksum_type = checksum
-        self.bpc = bytes_per_checksum
+        self.bpc = effective_bpc(self.cell, bytes_per_checksum)
         self.stripe_batch = stripe_batch
         self.max_retries = max_retries
-        self._fused = make_fused_encoder(
-            FusedSpec(options, checksum, bytes_per_checksum)
-        )
-        self._host_checksum = Checksum(checksum, bytes_per_checksum)
+        self._fused = make_fused_encoder(FusedSpec(options, checksum, self.bpc))
+        self._host_checksum = Checksum(checksum, self.bpc)
 
         self._groups: list[BlockGroup] = []
         self._group: Optional[BlockGroup] = None

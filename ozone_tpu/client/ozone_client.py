@@ -1,0 +1,155 @@
+"""OzoneClient: the user-facing object-store API.
+
+Mirror of the reference's client object model (hadoop-ozone/client
+OzoneClient -> ObjectStore -> OzoneVolume -> OzoneBucket -> key ops;
+RpcClient.java:192 createKey:1377 / getKey:1570): volume/bucket CRUD and
+key write/read streams that dispatch to the EC or replicated datapath by
+the key's replication config.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.ec_reader import ECBlockGroupReader
+from ozone_tpu.client.ec_writer import BlockGroup, ECKeyWriter
+from ozone_tpu.client.replicated import ReplicatedKeyReader, ReplicatedKeyWriter
+from ozone_tpu.om.om import OpenKeySession, OzoneManager
+from ozone_tpu.scm.pipeline import ReplicationType
+from ozone_tpu.utils.checksum import ChecksumType
+
+
+class KeyWriteHandle:
+    """Streaming write handle; commits the key on close."""
+
+    def __init__(self, session: OpenKeySession, om: OzoneManager, writer):
+        self._session = session
+        self._om = om
+        self._writer = writer
+        self._committed = False
+
+    def write(self, data) -> None:
+        self._writer.write(data)
+
+    def close(self) -> None:
+        if self._committed:
+            return
+        groups = self._writer.close()
+        self._om.commit_key(
+            self._session, groups, self._writer.bytes_written
+        )
+        self._committed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, *a):
+        if exc_type is None:
+            self.close()
+
+
+class OzoneBucket:
+    def __init__(self, client: "OzoneClient", volume: str, name: str):
+        self.client = client
+        self.volume = volume
+        self.name = name
+
+    def open_key(
+        self, key: str, replication: Optional[str] = None
+    ) -> KeyWriteHandle:
+        om = self.client.om
+        session = om.open_key(self.volume, self.name, key, replication)
+        allocate = lambda excluded: om.allocate_block(session, excluded)
+        if session.replication.type is ReplicationType.EC:
+            writer = ECKeyWriter(
+                session.replication.ec,
+                allocate,
+                self.client.clients,
+                block_size=om.block_size,
+                checksum=ChecksumType(session.checksum_type),
+                bytes_per_checksum=session.bytes_per_checksum,
+            )
+        else:
+            writer = ReplicatedKeyWriter(
+                allocate,
+                self.client.clients,
+                block_size=om.block_size,
+                checksum=ChecksumType(session.checksum_type),
+                bytes_per_checksum=session.bytes_per_checksum,
+            )
+        return KeyWriteHandle(session, om, writer)
+
+    def write_key(self, key: str, data, replication: Optional[str] = None) -> None:
+        with self.open_key(key, replication) as h:
+            h.write(data)
+
+    def read_key(self, key: str) -> np.ndarray:
+        om = self.client.om
+        info = om.lookup_key(self.volume, self.name, key)
+        groups = om.key_block_groups(info)
+        parts: list[np.ndarray] = []
+        for g in groups:
+            if g.pipeline.replication.type is ReplicationType.EC:
+                reader = ECBlockGroupReader(
+                    g,
+                    g.pipeline.replication.ec,
+                    self.client.clients,
+                    checksum=ChecksumType(info.get("checksum_type", "CRC32C")),
+                    bytes_per_checksum=info.get("bytes_per_checksum", 16 * 1024),
+                )
+                parts.append(reader.read_all())
+            else:
+                parts.append(
+                    ReplicatedKeyReader(g, self.client.clients).read_all()
+                )
+        out = np.concatenate(parts) if parts else np.zeros(0, np.uint8)
+        assert out.size == info["size"], (out.size, info["size"])
+        return out
+
+    def delete_key(self, key: str) -> None:
+        self.client.om.delete_key(self.volume, self.name, key)
+
+    def rename_key(self, key: str, new_key: str) -> None:
+        self.client.om.rename_key(self.volume, self.name, key, new_key)
+
+    def list_keys(self, prefix: str = "") -> list[dict]:
+        return self.client.om.list_keys(self.volume, self.name, prefix)
+
+
+class OzoneVolume:
+    def __init__(self, client: "OzoneClient", name: str):
+        self.client = client
+        self.name = name
+
+    def create_bucket(self, bucket: str, replication: str = "rs-6-3-1024k") -> OzoneBucket:
+        self.client.om.create_bucket(self.name, bucket, replication)
+        return OzoneBucket(self.client, self.name, bucket)
+
+    def get_bucket(self, bucket: str) -> OzoneBucket:
+        self.client.om.bucket_info(self.name, bucket)
+        return OzoneBucket(self.client, self.name, bucket)
+
+    def list_buckets(self) -> list[dict]:
+        return self.client.om.list_buckets(self.name)
+
+
+class OzoneClient:
+    """Entry point (ObjectStore analog)."""
+
+    def __init__(self, om: OzoneManager, clients: DatanodeClientFactory):
+        self.om = om
+        self.clients = clients
+
+    def create_volume(self, volume: str) -> OzoneVolume:
+        self.om.create_volume(volume)
+        return OzoneVolume(self, volume)
+
+    def get_volume(self, volume: str) -> OzoneVolume:
+        self.om.volume_info(volume)
+        return OzoneVolume(self, volume)
+
+    def list_volumes(self) -> list[dict]:
+        return self.om.list_volumes()

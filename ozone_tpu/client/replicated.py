@@ -1,0 +1,175 @@
+"""Replicated (non-EC) key write/read path.
+
+Capability analog of the reference's Ratis write path (KeyOutputStream ->
+BlockOutputStream -> XceiverClientRatis): every chunk goes to all replicas
+of the pipeline and a block commit follows the data
+(BlockOutputStream.writeChunkToContainer:604 / executePutBlock:515). The
+consensus property itself (leader ordering, watchForCommit quorum) is the
+job of the replication service; this client writes all replicas directly —
+the single-writer-per-block model makes that equivalent for object-store
+semantics — and reads fall over between replicas like XceiverClientGrpc's
+nearest-replica reads.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+import numpy as np
+
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.ec_writer import BlockGroup
+from ozone_tpu.storage.ids import BlockData, ChunkInfo, StorageError
+from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+log = logging.getLogger(__name__)
+
+
+class ReplicatedKeyWriter:
+    """Writes a key as replicated blocks: chunks fanned to every pipeline
+    node, putBlock commit per block."""
+
+    def __init__(
+        self,
+        allocate_group: Callable[[list[str]], BlockGroup],
+        clients: DatanodeClientFactory,
+        block_size: int = 16 * 1024 * 1024,
+        chunk_size: int = 4 * 1024 * 1024,
+        checksum: ChecksumType = ChecksumType.CRC32C,
+        bytes_per_checksum: int = 16 * 1024,
+        max_retries: int = 3,
+    ):
+        self.allocate_group = allocate_group
+        self.clients = clients
+        self.block_size = block_size
+        self.chunk_size = chunk_size
+        self.checksum = Checksum(checksum, bytes_per_checksum)
+        self.max_retries = max_retries
+        self._groups: list[BlockGroup] = []
+        self._group: Optional[BlockGroup] = None
+        self._chunks: list[ChunkInfo] = []
+        self._buf = np.zeros(chunk_size, dtype=np.uint8)
+        self._buf_fill = 0
+        self._excluded: list[str] = []
+        self._closed = False
+
+    def write(self, data) -> None:
+        if self._closed:
+            raise ValueError("writer is closed")
+        arr = np.asarray(
+            np.frombuffer(data, dtype=np.uint8)
+            if isinstance(data, (bytes, bytearray))
+            else data,
+            dtype=np.uint8,
+        ).reshape(-1)
+        pos = 0
+        while pos < arr.size:
+            take = min(self.chunk_size - self._buf_fill, arr.size - pos)
+            self._buf[self._buf_fill : self._buf_fill + take] = arr[
+                pos : pos + take
+            ]
+            self._buf_fill += take
+            pos += take
+            if self._buf_fill == self.chunk_size:
+                self._flush_chunk()
+
+    def _ensure_group(self) -> BlockGroup:
+        if self._group is None:
+            self._group = self.allocate_group(list(self._excluded))
+            self._chunks = []
+            for dn_id in self._group.pipeline.nodes:
+                try:
+                    self.clients.get(dn_id).create_container(
+                        self._group.container_id
+                    )
+                except StorageError as e:
+                    if e.code != "CONTAINER_EXISTS":
+                        raise
+        return self._group
+
+    def _flush_chunk(self) -> None:
+        if self._buf_fill == 0:
+            return
+        data = self._buf[: self._buf_fill].copy()
+        self._buf_fill = 0
+        for attempt in range(self.max_retries + 1):
+            group = self._ensure_group()
+            if group.length + data.size > self.block_size * 1:
+                self._finalize_group()
+                group = self._ensure_group()
+            info = ChunkInfo(
+                name=f"{group.block_id}_chunk_{len(self._chunks)}",
+                offset=group.length,
+                length=int(data.size),
+                checksum=self.checksum.compute(data),
+            )
+            failed: list[str] = []
+            err: Optional[Exception] = None
+            for dn_id in group.pipeline.nodes:
+                try:
+                    self.clients.get(dn_id).write_chunk(group.block_id, info, data)
+                except (StorageError, KeyError, OSError) as e:
+                    failed.append(dn_id)
+                    err = e
+            if not failed:
+                self._chunks.append(info)
+                group.length += data.size
+                bd = BlockData(group.block_id, list(self._chunks))
+                for dn_id in group.pipeline.nodes:
+                    self.clients.get(dn_id).put_block(bd)
+                return
+            log.warning("chunk write failed on %s: %s", failed, err)
+            self._excluded.extend(failed)
+            self._finalize_group()
+            if attempt == self.max_retries:
+                raise StorageError("IO_EXCEPTION", f"write failed: {err}")
+
+    def _finalize_group(self) -> None:
+        if self._group is not None and self._group.length > 0:
+            self._groups.append(self._group)
+        self._group = None
+        self._chunks = []
+
+    def close(self) -> list[BlockGroup]:
+        if self._closed:
+            return self._groups
+        self._flush_chunk()
+        self._finalize_group()
+        self._closed = True
+        return self._groups
+
+    @property
+    def bytes_written(self) -> int:
+        done = sum(g.length for g in self._groups)
+        cur = self._group.length if self._group else 0
+        return done + cur + self._buf_fill
+
+
+class ReplicatedKeyReader:
+    """Reads replicated blocks with replica failover."""
+
+    def __init__(self, group: BlockGroup, clients: DatanodeClientFactory,
+                 verify: bool = True):
+        self.group = group
+        self.clients = clients
+        self.verify = verify
+
+    def read_all(self) -> np.ndarray:
+        last: Optional[Exception] = None
+        for dn_id in self.group.pipeline.nodes:
+            try:
+                client = self.clients.get(dn_id)
+                bd = client.get_block(self.group.block_id)
+                parts = [
+                    client.read_chunk(self.group.block_id, info, self.verify)
+                    for info in bd.chunks
+                ]
+                out = (
+                    np.concatenate(parts) if parts else np.zeros(0, np.uint8)
+                )
+                return out[: self.group.length]
+            except (StorageError, KeyError, OSError) as e:
+                log.warning("replica %s failed: %s; trying next", dn_id, e)
+                last = e
+        raise StorageError("NO_SUCH_BLOCK", f"all replicas failed: {last}")

@@ -33,11 +33,29 @@ _POLY = {
 }
 
 
+def effective_bpc(cell_size: int, bytes_per_checksum: int) -> int:
+    """Clamp bytes-per-checksum so cells divide into whole slices: the
+    device CRC kernel computes fixed-size slices, so a bpc larger than the
+    cell (or not dividing it) degrades to one checksum per cell."""
+    if bytes_per_checksum <= 0:
+        return cell_size
+    if bytes_per_checksum <= cell_size and cell_size % bytes_per_checksum == 0:
+        return bytes_per_checksum
+    return cell_size
+
+
 @dataclass(frozen=True)
 class FusedSpec:
     options: CoderOptions
     checksum: ChecksumType = ChecksumType.CRC32C
     bytes_per_checksum: int = 16 * 1024
+
+    def __post_init__(self):
+        object.__setattr__(
+            self,
+            "bytes_per_checksum",
+            effective_bpc(self.options.cell_size, self.bytes_per_checksum),
+        )
 
 
 @lru_cache(maxsize=16)

@@ -1,0 +1,228 @@
+"""OzoneManager: namespace service (volumes/buckets/keys).
+
+Facade mirroring the reference's OzoneManager + KeyManagerImpl surface:
+volume/bucket CRUD, open-key sessions with SCM block allocation
+(OMKeyCreateRequest.preExecute allocates blocks from SCM), commit, lookup,
+list, delete-to-purge-queue, rename. Writes flow through the
+request/apply split (om/requests.py) so consensus can be slotted in; reads
+bypass it like the reference's submitRequestDirectlyToOM read path
+(OzoneManagerProtocolServerSideTranslatorPB.java:198).
+
+The KeyDeletingService analog purges deleted keys: collects their block
+groups and issues datanode block deletions via the client factory.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import uuid
+from pathlib import Path
+from typing import Any, Optional
+
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.ec_writer import BlockGroup
+from ozone_tpu.om import requests as rq
+from ozone_tpu.om.metadata import (
+    OMMetadataStore,
+    bucket_key,
+    key_key,
+    volume_key,
+)
+from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+from ozone_tpu.scm.scm import StorageContainerManager
+from ozone_tpu.storage.ids import StorageError
+from ozone_tpu.utils.audit import AuditLogger
+from ozone_tpu.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+
+class OpenKeySession:
+    def __init__(self, om: "OzoneManager", info: dict, client_id: str):
+        self.om = om
+        self.volume = info["volume"]
+        self.bucket = info["bucket"]
+        self.key = info["name"]
+        self.client_id = client_id
+        self.replication = ReplicationConfig.parse(info["replication"])
+        self.checksum_type = info["checksum_type"]
+        self.bytes_per_checksum = info["bytes_per_checksum"]
+
+
+class OzoneManager:
+    def __init__(
+        self,
+        db_path: Path,
+        scm: StorageContainerManager,
+        clients: Optional[DatanodeClientFactory] = None,
+        block_size: int = 16 * 1024 * 1024,
+    ):
+        self.store = OMMetadataStore(Path(db_path))
+        self.scm = scm
+        self.clients = clients
+        self.block_size = block_size
+        self.metrics = MetricsRegistry("om")
+        self.audit = AuditLogger("om")
+        self._lock = threading.RLock()
+
+    # ----------------------------------------------------------- write path
+    def submit(self, request: rq.OMRequest) -> Any:
+        """preExecute on the leader, then apply (the future Raft boundary
+        sits between the two)."""
+        with self.metrics.timer(request.audit_action).time():
+            request.pre_execute(self)
+            with self._lock:
+                try:
+                    result = request.apply(self.store)
+                except rq.OMError as e:
+                    self.audit.log(request.audit_action, vars(request),
+                                   ok=False, error=e.code)
+                    raise
+            self.audit.log(request.audit_action, vars(request), ok=True)
+            self.metrics.counter("write_ops").inc()
+            return result
+
+    # ----------------------------------------------------------- volumes
+    def create_volume(self, volume: str, owner: str = "root") -> None:
+        self.submit(rq.CreateVolume(volume, owner))
+
+    def delete_volume(self, volume: str) -> None:
+        self.submit(rq.DeleteVolume(volume))
+
+    def volume_info(self, volume: str) -> dict:
+        v = self.store.get("volumes", volume_key(volume))
+        if v is None:
+            raise rq.OMError(rq.VOLUME_NOT_FOUND, volume)
+        return v
+
+    def list_volumes(self) -> list[dict]:
+        return [v for _, v in self.store.iterate("volumes")]
+
+    # ----------------------------------------------------------- buckets
+    def create_bucket(
+        self, volume: str, bucket: str, replication: str = "rs-6-3-1024k",
+        layout: str = "OBJECT_STORE",
+    ) -> None:
+        self.submit(rq.CreateBucket(volume, bucket, replication, layout))
+
+    def delete_bucket(self, volume: str, bucket: str) -> None:
+        self.submit(rq.DeleteBucket(volume, bucket))
+
+    def bucket_info(self, volume: str, bucket: str) -> dict:
+        b = self.store.get("buckets", bucket_key(volume, bucket))
+        if b is None:
+            raise rq.OMError(rq.BUCKET_NOT_FOUND, f"{volume}/{bucket}")
+        return b
+
+    def list_buckets(self, volume: str) -> list[dict]:
+        return [
+            b for _, b in self.store.iterate("buckets", volume_key(volume) + "/")
+        ]
+
+    # ----------------------------------------------------------- keys
+    def open_key(
+        self,
+        volume: str,
+        bucket: str,
+        key: str,
+        replication: Optional[str] = None,
+    ) -> OpenKeySession:
+        binfo = self.bucket_info(volume, bucket)
+        repl = replication or binfo["replication"]
+        client_id = uuid.uuid4().hex[:16]
+        req = rq.OpenKey(volume, bucket, key, client_id, repl)
+        self.submit(req)
+        info = self.store.get(
+            "open_keys", f"{key_key(volume, bucket, key)}/{client_id}"
+        )
+        self.metrics.counter("keys_opened").inc()
+        return OpenKeySession(self, info, client_id)
+
+    def allocate_block(
+        self, session: OpenKeySession, excluded: Optional[list[str]] = None
+    ) -> BlockGroup:
+        """SCM block allocation for an open key (ScmBlockLocationProtocol
+        .allocateBlock analog)."""
+        return self.scm.allocate_block(
+            session.replication, self.block_size, excluded
+        )
+
+    def commit_key(
+        self, session: OpenKeySession, groups: list[BlockGroup], size: int
+    ) -> None:
+        self.submit(
+            rq.CommitKey(
+                session.volume,
+                session.bucket,
+                session.key,
+                session.client_id,
+                size,
+                [g.to_json() for g in groups],
+                replication=str(session.replication),
+            )
+        )
+        self.metrics.counter("keys_committed").inc()
+
+    def lookup_key(self, volume: str, bucket: str, key: str) -> dict:
+        info = self.store.get("keys", key_key(volume, bucket, key))
+        if info is None:
+            raise rq.OMError(rq.KEY_NOT_FOUND, f"{volume}/{bucket}/{key}")
+        self.metrics.counter("key_lookups").inc()
+        return info
+
+    def key_block_groups(self, info: dict) -> list[BlockGroup]:
+        """Materialize BlockGroup objects (with pipelines) from key info."""
+        out = []
+        for g in info["block_groups"]:
+            repl = ReplicationConfig.parse(g["replication"])
+            out.append(
+                BlockGroup(
+                    container_id=g["container_id"],
+                    local_id=g["local_id"],
+                    pipeline=Pipeline(repl, list(g["nodes"])),
+                    length=g["length"],
+                )
+            )
+        return out
+
+    def list_keys(self, volume: str, bucket: str, prefix: str = "") -> list[dict]:
+        base = bucket_key(volume, bucket) + "/"
+        return [k for _, k in self.store.iterate("keys", base + prefix)]
+
+    def delete_key(self, volume: str, bucket: str, key: str) -> None:
+        self.submit(rq.DeleteKey(volume, bucket, key))
+        self.metrics.counter("keys_deleted").inc()
+
+    def rename_key(self, volume: str, bucket: str, key: str, new_key: str) -> None:
+        self.submit(rq.RenameKey(volume, bucket, key, new_key))
+
+    # ----------------------------------------------------------- services
+    def run_key_deleting_service_once(self, limit: int = 100) -> int:
+        """Purge deleted keys: delete their blocks on datanodes, then drop
+        the entries (KeyDeletingService analog). Returns keys purged."""
+        entries = list(self.store.iterate("deleted_keys"))[:limit]
+        if not entries:
+            return 0
+        from ozone_tpu.storage.ids import BlockID
+
+        purged: list[str] = []
+        for dk, info in entries:
+            for g in info.get("block_groups", []):
+                bid = BlockID(g["container_id"], g["local_id"])
+                for dn_id in g["nodes"]:
+                    client = (
+                        self.clients.maybe_get(dn_id) if self.clients else None
+                    )
+                    if client is None:
+                        continue
+                    try:
+                        client.delete_block(bid)
+                    except (StorageError, OSError) as e:
+                        log.debug("block delete failed on %s: %s", dn_id, e)
+            purged.append(dk)
+        self.submit(rq.PurgeDeletedKeys(purged))
+        return len(purged)
+
+    def close(self) -> None:
+        self.store.close()

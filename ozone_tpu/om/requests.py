@@ -1,0 +1,271 @@
+"""OM write requests: the preExecute / apply split.
+
+Mirrors the reference's OMClientRequest command pattern (ozone-manager
+request/OMClientRequest.java:114 preExecute — leader-side normalization and
+resource allocation — and :143 validateAndUpdateCache — the deterministic
+state mutation applied on every OM replica). Keeping the split means a
+consensus layer (Raft) can be inserted later by shipping the post-
+preExecute request through a log without rewriting any request logic
+(SURVEY.md section 7 step 5).
+
+Each request implements:
+  pre_execute(om)  -> may talk to SCM, assign ids/timestamps; returns None
+  apply(store)     -> pure function of (request, store); idempotent-safe
+  audit fields     -> for the audit log
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ozone_tpu.om.metadata import (
+    OMMetadataStore,
+    bucket_key,
+    key_key,
+    volume_key,
+)
+
+
+class OMError(Exception):
+    def __init__(self, code: str, msg: str = ""):
+        super().__init__(f"{code}: {msg}" if msg else code)
+        self.code = code
+
+
+VOLUME_NOT_FOUND = "VOLUME_NOT_FOUND"
+VOLUME_ALREADY_EXISTS = "VOLUME_ALREADY_EXISTS"
+VOLUME_NOT_EMPTY = "VOLUME_NOT_EMPTY"
+BUCKET_NOT_FOUND = "BUCKET_NOT_FOUND"
+BUCKET_ALREADY_EXISTS = "BUCKET_ALREADY_EXISTS"
+BUCKET_NOT_EMPTY = "BUCKET_NOT_EMPTY"
+KEY_NOT_FOUND = "KEY_NOT_FOUND"
+
+
+@dataclass
+class OMRequest:
+    def pre_execute(self, om: Any) -> None:  # noqa: D401
+        """Leader-side phase; default no-op."""
+
+    def apply(self, store: OMMetadataStore) -> Any:
+        raise NotImplementedError
+
+    @property
+    def audit_action(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class CreateVolume(OMRequest):
+    volume: str
+    owner: str = "root"
+    quota_bytes: int = -1
+    created: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.created = time.time()
+
+    def apply(self, store):
+        k = volume_key(self.volume)
+        if store.exists("volumes", k):
+            raise OMError(VOLUME_ALREADY_EXISTS, self.volume)
+        store.put(
+            "volumes",
+            k,
+            {
+                "name": self.volume,
+                "owner": self.owner,
+                "quota_bytes": self.quota_bytes,
+                "created": self.created,
+            },
+        )
+
+
+@dataclass
+class DeleteVolume(OMRequest):
+    volume: str
+
+    def apply(self, store):
+        k = volume_key(self.volume)
+        if not store.exists("volumes", k):
+            raise OMError(VOLUME_NOT_FOUND, self.volume)
+        if next(store.iterate("buckets", k + "/"), None) is not None:
+            raise OMError(VOLUME_NOT_EMPTY, self.volume)
+        store.delete("volumes", k)
+
+
+@dataclass
+class CreateBucket(OMRequest):
+    volume: str
+    bucket: str
+    replication: str = "rs-6-3-1024k"
+    layout: str = "OBJECT_STORE"
+    versioning: bool = False
+    created: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.created = time.time()
+
+    def apply(self, store):
+        if not store.exists("volumes", volume_key(self.volume)):
+            raise OMError(VOLUME_NOT_FOUND, self.volume)
+        k = bucket_key(self.volume, self.bucket)
+        if store.exists("buckets", k):
+            raise OMError(BUCKET_ALREADY_EXISTS, k)
+        store.put(
+            "buckets",
+            k,
+            {
+                "volume": self.volume,
+                "name": self.bucket,
+                "replication": self.replication,
+                "layout": self.layout,
+                "versioning": self.versioning,
+                "created": self.created,
+            },
+        )
+
+
+@dataclass
+class DeleteBucket(OMRequest):
+    volume: str
+    bucket: str
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        if not store.exists("buckets", k):
+            raise OMError(BUCKET_NOT_FOUND, k)
+        if next(store.iterate("keys", k + "/"), None) is not None:
+            raise OMError(BUCKET_NOT_EMPTY, k)
+        store.delete("buckets", k)
+
+
+@dataclass
+class CommitKey(OMRequest):
+    """Finalize a key: move open-key session state into the key table
+    (OMKeyCommitRequest analog)."""
+
+    volume: str
+    bucket: str
+    key: str
+    client_id: str
+    size: int
+    block_groups: list[dict] = field(default_factory=list)
+    replication: str = ""
+    checksum_type: str = "CRC32C"
+    bytes_per_checksum: int = 16 * 1024
+    modified: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.modified = time.time()
+
+    def apply(self, store):
+        kk = key_key(self.volume, self.bucket, self.key)
+        open_k = f"{kk}/{self.client_id}"
+        if not store.exists("open_keys", open_k):
+            raise OMError(KEY_NOT_FOUND, f"no open session {open_k}")
+        info = store.get("open_keys", open_k)
+        info.update(
+            {
+                "size": self.size,
+                "block_groups": self.block_groups,
+                "modified": self.modified,
+            }
+        )
+        store.delete("open_keys", open_k)
+        store.put("keys", kk, info)
+        return info
+
+
+@dataclass
+class OpenKey(OMRequest):
+    """Record an open-key session (OMKeyCreateRequest analog — block
+    allocation happens in pre_execute via SCM, like the reference's
+    preExecute asking SCM for blocks)."""
+
+    volume: str
+    bucket: str
+    key: str
+    client_id: str
+    replication: str
+    checksum_type: str = "CRC32C"
+    bytes_per_checksum: int = 16 * 1024
+    created: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.created = time.time()
+
+    def apply(self, store):
+        if not store.exists("buckets", bucket_key(self.volume, self.bucket)):
+            raise OMError(BUCKET_NOT_FOUND, f"{self.volume}/{self.bucket}")
+        kk = key_key(self.volume, self.bucket, self.key)
+        store.put(
+            "open_keys",
+            f"{kk}/{self.client_id}",
+            {
+                "volume": self.volume,
+                "bucket": self.bucket,
+                "name": self.key,
+                "replication": self.replication,
+                "checksum_type": self.checksum_type,
+                "bytes_per_checksum": self.bytes_per_checksum,
+                "size": 0,
+                "block_groups": [],
+                "created": self.created,
+                "modified": self.created,
+            },
+        )
+
+
+@dataclass
+class DeleteKey(OMRequest):
+    """Move a key to the deleted table for async purge (OMKeyDeleteRequest +
+    KeyDeletingService pattern)."""
+
+    volume: str
+    bucket: str
+    key: str
+    ts: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.ts = time.time()
+
+    def apply(self, store):
+        kk = key_key(self.volume, self.bucket, self.key)
+        info = store.get("keys", kk)
+        if info is None:
+            raise OMError(KEY_NOT_FOUND, kk)
+        store.delete("keys", kk)
+        store.put("deleted_keys", f"{kk}:{self.ts}", info)
+        return info
+
+
+@dataclass
+class RenameKey(OMRequest):
+    volume: str
+    bucket: str
+    key: str
+    new_key: str
+
+    def apply(self, store):
+        src = key_key(self.volume, self.bucket, self.key)
+        info = store.get("keys", src)
+        if info is None:
+            raise OMError(KEY_NOT_FOUND, src)
+        dst = key_key(self.volume, self.bucket, self.new_key)
+        info["name"] = self.new_key
+        store.delete("keys", src)
+        store.put("keys", dst, info)
+
+
+@dataclass
+class PurgeDeletedKeys(OMRequest):
+    """Remove processed entries from the deleted table (background
+    KeyDeletingService completion)."""
+
+    entries: list[str] = field(default_factory=list)
+
+    def apply(self, store):
+        for k in self.entries:
+            store.delete("deleted_keys", k)

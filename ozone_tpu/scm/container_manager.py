@@ -1,0 +1,189 @@
+"""SCM container manager: lifecycle + replica tracking + EC/Ratis writable
+container pools + block allocation.
+
+Mirrors server-scm's ContainerManagerImpl/ContainerStateManagerImpl
+(lifecycle OPEN->CLOSING->CLOSED->DELETED), replica maps fed by container
+reports, BlockManagerImpl.allocateBlock:146 and the writable-container
+providers (WritableECContainerProvider.java:53,95-174 — a pool of open EC
+containers, one per placement set, new container when none fits;
+WritableRatisContainerProvider for replicated pipelines).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ozone_tpu.client.ec_writer import BlockGroup
+from ozone_tpu.scm.node_manager import NodeManager
+from ozone_tpu.scm.placement import PlacementPolicy
+from ozone_tpu.scm.pipeline import (
+    Pipeline,
+    PipelineState,
+    ReplicationConfig,
+    ReplicationType,
+)
+from ozone_tpu.storage.ids import ContainerState
+
+
+@dataclass
+class ContainerReplica:
+    dn_id: str
+    state: str = "OPEN"
+    replica_index: int = 0  # 1-based for EC, 0 for Ratis
+    block_count: int = 0
+    used_bytes: int = 0
+
+
+@dataclass
+class ContainerInfo:
+    id: int
+    replication: ReplicationConfig
+    pipeline: Optional[Pipeline]
+    state: ContainerState = ContainerState.OPEN
+    used_bytes: int = 0
+    replicas: dict[str, ContainerReplica] = field(default_factory=dict)
+
+    def replica_indexes_present(self) -> set[int]:
+        return {
+            r.replica_index
+            for r in self.replicas.values()
+            if r.state not in ("UNHEALTHY", "DELETED")
+        }
+
+
+class ContainerManager:
+    def __init__(
+        self,
+        nodes: NodeManager,
+        placement: PlacementPolicy,
+        container_size: int = 5 * 1024 * 1024 * 1024,
+    ):
+        self.nodes = nodes
+        self.placement = placement
+        self.container_size = container_size
+        self._containers: dict[int, ContainerInfo] = {}
+        self._pipelines: dict[int, Pipeline] = {}
+        self._cid = itertools.count(1)
+        self._lid = itertools.count(1)
+        # open writable containers by replication-scheme string
+        self._writable: dict[str, list[int]] = {}
+        self._lock = threading.RLock()
+
+    # --------------------------------------------------------------- queries
+    def get(self, container_id: int) -> ContainerInfo:
+        return self._containers[container_id]
+
+    def get_or_none(self, container_id: int) -> Optional[ContainerInfo]:
+        return self._containers.get(container_id)
+
+    def containers(self) -> list[ContainerInfo]:
+        return list(self._containers.values())
+
+    def pipelines(self) -> list[Pipeline]:
+        return list(self._pipelines.values())
+
+    # --------------------------------------------------------------- alloc
+    def _create_pipeline(
+        self, replication: ReplicationConfig, excluded: list[str]
+    ) -> Pipeline:
+        chosen = self.placement.choose(replication.required_nodes, excluded)
+        p = Pipeline(replication, [n.dn_id for n in chosen])
+        self._pipelines[p.id] = p
+        return p
+
+    def _allocate_container(
+        self, replication: ReplicationConfig, excluded: list[str]
+    ) -> ContainerInfo:
+        pipe = self._create_pipeline(replication, excluded)
+        c = ContainerInfo(next(self._cid), replication, pipe)
+        self._containers[c.id] = c
+        return c
+
+    def allocate_block(
+        self,
+        replication: ReplicationConfig,
+        block_size: int,
+        excluded: Optional[list[str]] = None,
+    ) -> BlockGroup:
+        """Find-or-create an open container on a healthy pipeline and issue
+        a new block id in it (allocateBlock -> WritableContainerFactory)."""
+        excluded = excluded or []
+        with self._lock:
+            key = str(replication)
+            pool = self._writable.setdefault(key, [])
+            for cid in list(pool):
+                c = self._containers.get(cid)
+                if c is None or c.state is not ContainerState.OPEN:
+                    pool.remove(cid)
+                    continue
+                if any(n in excluded for n in c.pipeline.nodes):
+                    continue
+                if c.used_bytes + block_size > self.container_size:
+                    # full: close it (reference closes via close-threshold)
+                    self.finalize_container(cid)
+                    pool.remove(cid)
+                    continue
+                c.used_bytes += block_size
+                return BlockGroup(
+                    container_id=cid,
+                    local_id=next(self._lid),
+                    pipeline=c.pipeline,
+                )
+            c = self._allocate_container(replication, excluded)
+            pool.append(c.id)
+            c.used_bytes += block_size
+            return BlockGroup(
+                container_id=c.id,
+                local_id=next(self._lid),
+                pipeline=c.pipeline,
+            )
+
+    # --------------------------------------------------------------- lifecycle
+    def finalize_container(self, container_id: int) -> None:
+        c = self._containers[container_id]
+        if c.state is ContainerState.OPEN:
+            c.state = ContainerState.CLOSING
+
+    def mark_closed(self, container_id: int) -> None:
+        self._containers[container_id].state = ContainerState.CLOSED
+
+    def delete_container(self, container_id: int) -> None:
+        self._containers[container_id].state = ContainerState.DELETED
+
+    # --------------------------------------------------------------- reports
+    def process_container_report(self, dn_id: str, report: list[dict]) -> None:
+        """Ingest a full container report (FCR) from a datanode heartbeat."""
+        seen = set()
+        for r in report:
+            cid = int(r["container_id"])
+            seen.add(cid)
+            c = self._containers.get(cid)
+            if c is None:
+                # unknown container: track it with unknown replication
+                continue
+            c.replicas[dn_id] = ContainerReplica(
+                dn_id=dn_id,
+                state=r["state"],
+                replica_index=int(r.get("replica_index", 0)),
+                block_count=int(r.get("block_count", 0)),
+                used_bytes=int(r.get("used_bytes", 0)),
+            )
+        # drop replicas this DN no longer reports
+        for c in self._containers.values():
+            if dn_id in c.replicas and c.id not in seen:
+                del c.replicas[dn_id]
+
+    def remove_replicas_of_node(self, dn_id: str) -> list[int]:
+        """Node death: forget its replicas; return affected container ids."""
+        affected = []
+        for c in self._containers.values():
+            if dn_id in c.replicas:
+                del c.replicas[dn_id]
+                affected.append(c.id)
+        for p in self._pipelines.values():
+            if dn_id in p.nodes and p.state is PipelineState.OPEN:
+                p.state = PipelineState.CLOSED
+        return affected

@@ -1,0 +1,74 @@
+"""SCM safemode: block allocation gated on cluster readiness.
+
+Mirrors server-scm safemode/SCMSafeModeManager.java:84 + exit rules:
+DataNodeSafeModeRule (min registered DN count), ContainerSafeModeRule
+(fraction of containers with at least one reported replica), and a
+healthy-pipeline rule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ozone_tpu.scm.container_manager import ContainerManager
+from ozone_tpu.scm.node_manager import NodeManager
+from ozone_tpu.storage.ids import ContainerState
+
+
+class SafeModeError(Exception):
+    pass
+
+
+@dataclass
+class SafeModeConfig:
+    min_datanodes: int = 1
+    container_replica_fraction: float = 0.99
+
+
+class SafeModeManager:
+    def __init__(
+        self,
+        nodes: NodeManager,
+        containers: ContainerManager,
+        config: SafeModeConfig = SafeModeConfig(),
+    ):
+        self.nodes = nodes
+        self.containers = containers
+        self.config = config
+        self._forced: bool | None = None  # admin override
+
+    def force(self, in_safemode: bool | None) -> None:
+        """Admin override ('ozone admin safemode enter/exit' analog)."""
+        self._forced = in_safemode
+
+    def status(self) -> dict:
+        relevant = [
+            c
+            for c in self.containers.containers()
+            if c.state in (ContainerState.CLOSED, ContainerState.QUASI_CLOSED)
+        ]
+        with_replica = sum(1 for c in relevant if c.replicas)
+        return {
+            "datanodes": self.nodes.node_count(),
+            "datanodes_required": self.config.min_datanodes,
+            "containers_with_replica": with_replica,
+            "containers_total": len(relevant),
+        }
+
+    def in_safemode(self) -> bool:
+        if self._forced is not None:
+            return self._forced
+        s = self.status()
+        if s["datanodes"] < s["datanodes_required"]:
+            return True
+        if s["containers_total"]:
+            frac = s["containers_with_replica"] / s["containers_total"]
+            if frac < self.config.container_replica_fraction:
+                return True
+        return False
+
+    def check_allocation_allowed(self) -> None:
+        """Raises while in safemode (BlockManagerImpl safemode precheck
+        :154)."""
+        if self.in_safemode():
+            raise SafeModeError(f"SCM is in safemode: {self.status()}")

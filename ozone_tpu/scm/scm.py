@@ -1,0 +1,139 @@
+"""StorageContainerManager facade: wires node/pipeline/container/block
+management, safemode, and the replication control loop.
+
+Mirror of server-scm StorageContainerManager.java:228
+(initializeSystemManagers:648 wiring) at framework scale: one object the
+OM, datanodes, and admin tools talk to. Heartbeat handling mirrors
+SCMNodeManager.processHeartbeat (commands ride the response); dead-node
+events trigger replica cleanup + replication scans (DeadNodeHandler).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from ozone_tpu.client.ec_writer import BlockGroup
+from ozone_tpu.scm import node_manager as nm
+from ozone_tpu.scm.container_manager import ContainerManager
+from ozone_tpu.scm.node_manager import NodeManager, NodeOperationalState
+from ozone_tpu.scm.placement import RackScatterPlacement
+from ozone_tpu.scm.replication_manager import ReplicationManager
+from ozone_tpu.scm.safemode import SafeModeConfig, SafeModeManager
+from ozone_tpu.scm.pipeline import ReplicationConfig
+from ozone_tpu.utils.events import EventQueue
+from ozone_tpu.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+
+class StorageContainerManager:
+    def __init__(
+        self,
+        min_datanodes: int = 1,
+        container_size: int = 5 * 1024 * 1024 * 1024,
+        placement_seed: Optional[int] = None,
+        stale_after_s: float = 9.0,
+        dead_after_s: float = 30.0,
+    ):
+        self.events = EventQueue()
+        self.nodes = NodeManager(
+            self.events, stale_after_s=stale_after_s, dead_after_s=dead_after_s
+        )
+        self.placement = RackScatterPlacement(self.nodes, seed=placement_seed)
+        self.containers = ContainerManager(
+            self.nodes, self.placement, container_size=container_size
+        )
+        self.safemode = SafeModeManager(
+            self.nodes, self.containers, SafeModeConfig(min_datanodes)
+        )
+        self.replication = ReplicationManager(
+            self.containers, self.nodes, self.placement
+        )
+        self.metrics = MetricsRegistry("scm")
+        self.events.subscribe(nm.DEAD_NODE, self._on_dead_node)
+        self._bg: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------- datanodes
+    def register_datanode(
+        self, dn_id: str, rack: str = "/default-rack", capacity_bytes: int = 0
+    ) -> None:
+        self.nodes.register(dn_id, rack, capacity_bytes)
+        self.metrics.counter("registrations").inc()
+
+    def heartbeat(
+        self,
+        dn_id: str,
+        container_report: Optional[list[dict]] = None,
+        used_bytes: int = 0,
+    ) -> list:
+        """Process a heartbeat (+optional full container report); return the
+        commands queued for this datanode."""
+        if container_report is not None:
+            self.containers.process_container_report(dn_id, container_report)
+            # CLOSING -> CLOSED once replicas report closed
+            for r in container_report:
+                c = self.containers.get_or_none(int(r["container_id"]))
+                if (
+                    c is not None
+                    and r["state"] in ("CLOSED", "QUASI_CLOSED")
+                    and c.state.value in ("OPEN", "CLOSING")
+                ):
+                    self.containers.mark_closed(c.id)
+        self.metrics.counter("heartbeats").inc()
+        return self.nodes.process_heartbeat(dn_id, used_bytes)
+
+    def _on_dead_node(self, dn_id: str) -> None:
+        affected = self.containers.remove_replicas_of_node(dn_id)
+        log.info("node %s dead; %d containers affected", dn_id, len(affected))
+        self.metrics.counter("dead_nodes").inc()
+
+    # ------------------------------------------------------------- allocation
+    def allocate_block(
+        self,
+        replication: ReplicationConfig,
+        block_size: int,
+        excluded: Optional[list[str]] = None,
+    ) -> BlockGroup:
+        self.safemode.check_allocation_allowed()
+        g = self.containers.allocate_block(replication, block_size, excluded)
+        self.metrics.counter("blocks_allocated").inc()
+        return g
+
+    # ------------------------------------------------------------- admin ops
+    def decommission(self, dn_id: str) -> None:
+        """Start draining a node (NodeDecommissionManager.java:60): take it
+        out of placement and let the replication manager re-protect its
+        containers."""
+        self.nodes.set_op_state(dn_id, NodeOperationalState.DECOMMISSIONING)
+        # treat its replicas as gone for redundancy purposes on next scan
+
+    def finish_decommission(self, dn_id: str) -> None:
+        self.nodes.set_op_state(dn_id, NodeOperationalState.DECOMMISSIONED)
+        self.containers.remove_replicas_of_node(dn_id)
+
+    # ------------------------------------------------------------- background
+    def run_background_once(self) -> None:
+        """One tick of the SCM control loops (liveness + replication)."""
+        self.nodes.check_liveness()
+        if not self.safemode.in_safemode():
+            self.replication.run_once()
+
+    def start_background(self, interval_s: float = 1.0) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.run_background_once()
+                except Exception:
+                    log.exception("scm background tick failed")
+
+        self._bg = threading.Thread(target=loop, name="scm-bg", daemon=True)
+        self._bg.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._bg:
+            self._bg.join(timeout=5)

@@ -28,6 +28,7 @@ from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_reader import ECBlockGroupReader, unit_true_lengths
 from ozone_tpu.client.ec_writer import BlockGroup
 from ozone_tpu.codec.api import CoderOptions
+from ozone_tpu.codec.fused import effective_bpc
 from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
 from ozone_tpu.storage.ids import (
     BlockData,
@@ -145,18 +146,19 @@ class ECReconstructionCoordinator:
     ) -> None:
         opts = cmd.replication
         cell = opts.cell_size
+        bpc = effective_bpc(cell, self.bpc)
         group = self._group_for(cmd, bd)
         reader = ECBlockGroupReader(
             group,
             opts,
             self.clients,
             checksum=self.checksum,
-            bytes_per_checksum=self.bpc,
+            bytes_per_checksum=bpc,
         )
         target_units = [idx - 1 for idx in targets]  # 0-based unit indexes
         cells, crcs = reader.recover_cells_with_crcs(target_units)
         lengths = unit_true_lengths(group, opts)
-        host_checksum = Checksum(self.checksum, self.bpc)
+        host_checksum = Checksum(self.checksum, bpc)
 
         for ti, idx in enumerate(targets):
             u = idx - 1
@@ -168,10 +170,10 @@ class ECReconstructionCoordinator:
                 if chunk_len == 0:
                     continue
                 data = cells[s, ti, :chunk_len]
-                if chunk_len == cell and cell % self.bpc == 0 and crcs.size:
+                if chunk_len == cell and cell % bpc == 0 and crcs.size:
                     cs = ChecksumData(
                         self.checksum,
-                        self.bpc,
+                        bpc,
                         tuple(
                             int(v).to_bytes(4, "big")
                             for v in crcs[s, ti].tolist()

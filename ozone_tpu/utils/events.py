@@ -1,0 +1,63 @@
+"""Typed pub/sub event queue.
+
+Mirror of the reference's EventQueue (hadoop-hdds/framework
+hdds/server/events/EventQueue.java): handlers subscribe to topics; publish
+dispatches synchronously by default (deterministic for tests) or to an
+executor when async is requested, like FixedThreadPoolWithAffinityExecutor.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from collections import defaultdict
+from typing import Any, Callable
+
+log = logging.getLogger(__name__)
+
+Handler = Callable[[Any], None]
+
+
+class EventQueue:
+    def __init__(self, async_dispatch: bool = False):
+        self._handlers: dict[str, list[Handler]] = defaultdict(list)
+        self._lock = threading.Lock()
+        self._async = async_dispatch
+        self._q: "queue.Queue[tuple[str, Any]]" = queue.Queue()
+        self._worker: threading.Thread | None = None
+        if async_dispatch:
+            self._worker = threading.Thread(
+                target=self._drain, name="event-queue", daemon=True
+            )
+            self._worker.start()
+
+    def subscribe(self, topic: str, handler: Handler) -> None:
+        with self._lock:
+            self._handlers[topic].append(handler)
+
+    def publish(self, topic: str, payload: Any = None) -> None:
+        if self._async:
+            self._q.put((topic, payload))
+        else:
+            self._dispatch(topic, payload)
+
+    def _dispatch(self, topic: str, payload: Any) -> None:
+        for h in list(self._handlers.get(topic, ())):
+            try:
+                h(payload)
+            except Exception:  # handler errors must not break the publisher
+                log.exception("event handler for %s failed", topic)
+
+    def _drain(self) -> None:
+        while True:
+            topic, payload = self._q.get()
+            try:
+                self._dispatch(topic, payload)
+            finally:
+                self._q.task_done()
+
+    def flush(self) -> None:
+        """Wait for queued async events to drain (tests)."""
+        if self._async:
+            self._q.join()

@@ -2,14 +2,19 @@
 
 Multi-chip sharding is validated on a virtual CPU mesh (the driver
 separately dry-runs the multichip path); real-TPU runs happen in bench.py.
-Must run before the first jax import anywhere in the test process.
+The axon environment pins JAX_PLATFORMS=axon via sitecustomize, so env
+vars alone don't stick — jax.config.update after import does.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
