@@ -1,0 +1,209 @@
+"""Multi-chip sharded EC codec: jit + shard_map over a device mesh.
+
+The distribution story of the TPU build (SURVEY.md section 2 "distribution
+strategies" and BASELINE config #5 — multi-datanode reconstruction with
+parity work sharded over v5e-8 ICI):
+
+- **Stripe parallelism (DP)**: the stripe batch axis is sharded over the
+  mesh; encode/decode+CRC run with zero cross-chip traffic. This is the
+  production path for bulk encode and multi-block reconstruction — the
+  structural analog of the reference running one reconstruction task per
+  datanode (ECReconstructionCoordinator) but with the batch spread over
+  chips instead of threads.
+
+- **Unit parallelism (TP)**: the k data units are sharded over the mesh;
+  each chip computes a partial GF(2) sum against its slice of the coding
+  matrix and an int32 psum over ICI accumulates before the mod-2. XOR-
+  accumulate distributes over psum because parity bits are sums mod 2 and
+  integer addition commutes with the final &1. Used when single stripes
+  are huge (cell >> HBM/chip) — the analog of splitting one stripe's
+  coding work across nodes.
+
+All collectives are XLA collectives over the mesh (psum); no host-side
+communication is involved.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ozone_tpu.codec import crc_device, rs_math
+from ozone_tpu.codec.api import CoderOptions
+from ozone_tpu.codec.bitlin import expand_coding_matrix
+from ozone_tpu.codec.fused import FusedSpec, _POLY
+from ozone_tpu.codec.jax_coder import bits_to_bytes, bytes_to_bits, gf_apply
+from ozone_tpu.utils.checksum import ChecksumType
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, axis: str = "dn"
+) -> Mesh:
+    """1-D mesh over the first n devices (default: all)."""
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def pad_batch(batch: np.ndarray, n: int) -> tuple[np.ndarray, int]:
+    """Pad the leading axis to a multiple of n; returns (padded, original)."""
+    b = batch.shape[0]
+    rem = (-b) % n
+    if rem:
+        pad = np.zeros((rem,) + batch.shape[1:], dtype=batch.dtype)
+        batch = np.concatenate([batch, pad], axis=0)
+    return batch, b
+
+
+# --------------------------------------------------------------------- DP
+@lru_cache(maxsize=16)
+def _sharded_fused_encoder_cached(
+    options: CoderOptions,
+    checksum: ChecksumType,
+    bpc: int,
+    mesh: Mesh,
+    axis: str,
+):
+    a = jnp.asarray(
+        expand_coding_matrix(
+            rs_math.parity_matrix(options.data_units, options.parity_units)
+        ),
+        dtype=jnp.int8,
+    )
+    if checksum in _POLY:
+        k_np, zeros_crc = crc_device.crc_constants_planemajor(
+            bpc, _POLY[checksum]
+        )
+        k_dev = jnp.asarray(k_np)
+    else:
+        k_dev, zeros_crc = None, 0
+
+    batch_sharding = NamedSharding(mesh, P(axis))
+
+    def fn(data):
+        parity = gf_apply(data, a)
+        if k_dev is None:
+            crcs = jnp.zeros(
+                (data.shape[0], data.shape[1] + parity.shape[1], 0), jnp.uint32
+            )
+        else:
+            crcs = jnp.concatenate(
+                [
+                    crc_device.crc_slices(data, k_dev, zeros_crc),
+                    crc_device.crc_slices(parity, k_dev, zeros_crc),
+                ],
+                axis=1,
+            )
+        return parity, crcs
+
+    return jax.jit(
+        fn,
+        in_shardings=batch_sharding,
+        out_shardings=(batch_sharding, batch_sharding),
+    )
+
+
+def make_sharded_fused_encoder(spec: FusedSpec, mesh: Mesh, axis: str = "dn"):
+    """Stripe-parallel fused encode+CRC: fn(data [B, k, C]) with B sharded
+    over the mesh; B must divide by mesh size (see pad_batch)."""
+    return _sharded_fused_encoder_cached(
+        spec.options, spec.checksum, spec.bytes_per_checksum, mesh, axis
+    )
+
+
+@lru_cache(maxsize=64)
+def _sharded_decoder_cached(
+    options: CoderOptions,
+    checksum: ChecksumType,
+    bpc: int,
+    valid: tuple,
+    erased: tuple,
+    mesh: Mesh,
+    axis: str,
+):
+    dm = rs_math.decode_matrix(
+        options.data_units, options.parity_units, list(erased), list(valid)
+    )
+    a = jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
+    if checksum in _POLY:
+        k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
+        k_dev = jnp.asarray(k_np)
+    else:
+        k_dev, zeros_crc = None, 0
+    batch_sharding = NamedSharding(mesh, P(axis))
+
+    def fn(valid_units):
+        rec = gf_apply(valid_units, a)
+        if k_dev is None:
+            crcs = jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
+        else:
+            crcs = crc_device.crc_slices(rec, k_dev, zeros_crc)
+        return rec, crcs
+
+    return jax.jit(
+        fn,
+        in_shardings=batch_sharding,
+        out_shardings=(batch_sharding, batch_sharding),
+    )
+
+
+def make_sharded_decoder(
+    spec: FusedSpec, valid: list[int], erased: list[int], mesh: Mesh,
+    axis: str = "dn",
+):
+    """Stripe-parallel fused decode+CRC (multi-chip reconstruction path)."""
+    return _sharded_decoder_cached(
+        spec.options,
+        spec.checksum,
+        spec.bytes_per_checksum,
+        tuple(valid),
+        tuple(erased),
+        mesh,
+        axis,
+    )
+
+
+# --------------------------------------------------------------------- TP
+@lru_cache(maxsize=16)
+def _tp_encoder_cached(options: CoderOptions, mesh: Mesh, axis: str):
+    k, p = options.data_units, options.parity_units
+    n = mesh.devices.size
+    if k % n:
+        raise ValueError(f"TP encode requires k % mesh == 0, got {k} % {n}")
+    a_np = expand_coding_matrix(rs_math.parity_matrix(k, p))  # [k*8, p*8]
+    a = jnp.asarray(a_np, dtype=jnp.int8)
+
+    from jax import shard_map
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis, None), P(axis, None)),
+        out_specs=P(None, None, None),
+    )
+    def tp_encode(data_local, a_local):
+        # data_local [B, k/n, C]; a_local [k*8/n, p*8]
+        bits = bytes_to_bits(data_local)  # [B, (k/n)*8, C]
+        partial_acc = jax.lax.dot_general(
+            a_local.T,
+            bits,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )  # [p*8, B, C] partial integer sums
+        total = jax.lax.psum(partial_acc, axis)  # ICI collective
+        pbits = jnp.moveaxis(jnp.bitwise_and(total, 1), 0, -2).astype(jnp.int8)
+        return bits_to_bytes(pbits)  # [B, p, C] replicated
+
+    return jax.jit(lambda d: tp_encode(d, a))
+
+
+def make_tp_encoder(options: CoderOptions, mesh: Mesh, axis: str = "dn"):
+    """Unit-parallel encode: data units sharded over the mesh, parity
+    accumulated with psum over ICI. fn(data [B, k, C]) -> parity [B, p, C]."""
+    return _tp_encoder_cached(options, mesh, axis)
