@@ -28,8 +28,8 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def bench_fused_encode(batch: int = 16, cell: int = 1024 * 1024,
-                       iters: int = 30, rounds: int = 3) -> float:
+def bench_fused_encode(batch: int = 8, cell: int = 1024 * 1024,
+                       iters: int = 40, rounds: int = 5) -> float:
     import jax
 
     from ozone_tpu.codec.api import CoderOptions
