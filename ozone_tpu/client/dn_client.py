@@ -75,21 +75,43 @@ class LocalDatanodeClient:
 
 
 class DatanodeClientFactory:
-    """dn_id -> client resolver (XceiverClientManager pool analog)."""
+    """dn_id -> client resolver (XceiverClientManager pool analog).
+
+    Resolves in-process datanodes first, then remote addresses registered
+    via register_remote (gRPC, lazily connected)."""
 
     def __init__(self):
-        self._local: dict[str, LocalDatanodeClient] = {}
+        self._local: dict[str, DatanodeClient] = {}
+        self._addresses: dict[str, str] = {}
+        self._remote: dict[str, DatanodeClient] = {}
 
     def register_local(self, dn: Datanode) -> LocalDatanodeClient:
         c = LocalDatanodeClient(dn)
         self._local[dn.id] = c
         return c
 
+    def register_remote(self, dn_id: str, address: str) -> None:
+        self._addresses[dn_id] = address
+        self._remote.pop(dn_id, None)  # reconnect on next use
+
     def get(self, dn_id: str) -> DatanodeClient:
-        c = self._local.get(dn_id)
+        c = self.maybe_get(dn_id)
         if c is None:
             raise KeyError(f"no client for datanode {dn_id}")
         return c
 
     def maybe_get(self, dn_id: str) -> Optional[DatanodeClient]:
-        return self._local.get(dn_id)
+        c = self._local.get(dn_id)
+        if c is not None:
+            return c
+        c = self._remote.get(dn_id)
+        if c is not None:
+            return c
+        addr = self._addresses.get(dn_id)
+        if addr is not None:
+            from ozone_tpu.net.dn_service import GrpcDatanodeClient
+
+            c = GrpcDatanodeClient(dn_id, addr)
+            self._remote[dn_id] = c
+            return c
+        return None

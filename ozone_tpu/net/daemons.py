@@ -1,0 +1,184 @@
+"""Service daemons: datanode and SCM+OM server processes.
+
+Mirrors the reference's service mains (HddsDatanodeService.java:99 start
+:207 with the DatanodeStateMachine register->heartbeat loop and command
+handlers; StorageContainerManagerStarter; OzoneManagerStarter). The SCM
+and OM run co-located in one server process here (separate processes are a
+deployment choice, not an architecture change — both are already
+independent objects behind independent gRPC services).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Optional
+
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.net.dn_service import DatanodeGrpcService
+from ozone_tpu.net.om_service import OmGrpcService
+from ozone_tpu.net.rpc import RpcServer
+from ozone_tpu.net.scm_service import GrpcScmClient, ScmGrpcService
+from ozone_tpu.om.om import OzoneManager
+from ozone_tpu.scm.replication_manager import (
+    DeleteReplicaCommand,
+    ReplicateCommand,
+)
+from ozone_tpu.scm.scm import StorageContainerManager
+from ozone_tpu.storage.datanode import Datanode
+from ozone_tpu.storage.ids import BlockData, StorageError
+from ozone_tpu.storage.reconstruction import (
+    ECReconstructionCoordinator,
+    ReconstructionCommand,
+)
+
+log = logging.getLogger(__name__)
+
+
+class DatanodeDaemon:
+    """Datanode process: gRPC service + SCM heartbeat/command loop."""
+
+    def __init__(
+        self,
+        root: Path,
+        dn_id: str,
+        scm_address: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        rack: str = "/default-rack",
+        heartbeat_interval_s: float = 1.0,
+    ):
+        self.dn = Datanode(Path(root), dn_id=dn_id)
+        self.server = RpcServer(host, port)
+        self.service = DatanodeGrpcService(self.dn, self.server)
+        self.scm = GrpcScmClient(scm_address)
+        self.rack = rack
+        self.heartbeat_interval = heartbeat_interval_s
+        # peer clients for reconstruction/replication work
+        self.clients = DatanodeClientFactory()
+        self.clients.register_local(self.dn)
+        self.reconstruction = ECReconstructionCoordinator(self.clients)
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self) -> None:
+        self.server.start()
+        self.scm.register(self.dn.id, self.address, rack=self.rack)
+        self._hb = threading.Thread(
+            target=self._heartbeat_loop, name=f"hb-{self.dn.id}", daemon=True
+        )
+        self._hb.start()
+
+    def heartbeat_once(self) -> None:
+        report = self.dn.container_report()
+        used = sum(r["used_bytes"] for r in report)
+        commands = self.scm.heartbeat(
+            self.dn.id, container_report=report, used_bytes=used
+        )
+        for cmd in commands:
+            self._execute(cmd)
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                self.heartbeat_once()
+            except Exception:
+                log.exception("%s heartbeat failed", self.dn.id)
+
+    def _learn_addresses(self, addresses: dict[str, str]) -> None:
+        for dn_id, addr in addresses.items():
+            if dn_id != self.dn.id and self.clients.maybe_get(dn_id) is None:
+                self.clients.register_remote(dn_id, addr)
+
+    def _execute(self, cmd) -> None:
+        try:
+            if isinstance(cmd, ReconstructionCommand):
+                self._learn_addresses(self.scm.node_addresses())
+                self.reconstruction.reconstruct_container_group(cmd)
+            elif isinstance(cmd, DeleteReplicaCommand):
+                self.dn.delete_container(cmd.container_id, force=True)
+            elif isinstance(cmd, ReplicateCommand):
+                self._learn_addresses(self.scm.node_addresses())
+                self._replicate(cmd)
+            elif isinstance(cmd, dict) and cmd.get("type") == "register":
+                self.scm.register(self.dn.id, self.address, rack=self.rack)
+            else:
+                log.debug("%s ignoring command %r", self.dn.id, cmd)
+        except Exception:
+            log.exception("%s command %r failed", self.dn.id, cmd)
+
+    def _replicate(self, cmd: ReplicateCommand) -> None:
+        src = self.clients.get(cmd.source)
+        blocks = src.list_blocks(cmd.container_id)
+        try:
+            self.dn.create_container(cmd.container_id, cmd.replica_index)
+        except StorageError as e:
+            if e.code != "CONTAINER_EXISTS":
+                raise
+        for bd in blocks:
+            for info in bd.chunks:
+                self.dn.write_chunk(
+                    bd.block_id, info, src.read_chunk(bd.block_id, info)
+                )
+            self.dn.put_block(
+                BlockData(bd.block_id, bd.chunks, bd.block_group_length)
+            )
+        self.dn.close_container(cmd.container_id)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._hb:
+            self._hb.join(timeout=5)
+        self.server.stop()
+        self.scm.close()
+        self.dn.close()
+
+
+class ScmOmDaemon:
+    """Metadata server process: SCM + OM behind one gRPC endpoint."""
+
+    def __init__(
+        self,
+        om_db: Path,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        min_datanodes: int = 1,
+        block_size: int = 16 * 1024 * 1024,
+        container_size: int = 256 * 1024 * 1024,
+        stale_after_s: float = 9.0,
+        dead_after_s: float = 30.0,
+        background_interval_s: float = 1.0,
+    ):
+        self.scm = StorageContainerManager(
+            min_datanodes=min_datanodes,
+            container_size=container_size,
+            stale_after_s=stale_after_s,
+            dead_after_s=dead_after_s,
+        )
+        self.server = RpcServer(host, port)
+        self.scm_service = ScmGrpcService(self.scm, self.server)
+        self.om = OzoneManager(Path(om_db), self.scm, block_size=block_size)
+        self.om_service = OmGrpcService(
+            self.om, self.server,
+            addresses_provider=lambda: dict(self.scm_service.addresses),
+        )
+        self._bg_interval = background_interval_s
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    def start(self) -> None:
+        self.server.start()
+        self.scm.start_background(self._bg_interval)
+
+    def stop(self) -> None:
+        self.scm.stop()
+        self.server.stop()
+        self.om.close()

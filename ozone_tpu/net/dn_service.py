@@ -1,0 +1,189 @@
+"""Datanode gRPC service + remote client.
+
+The verb surface mirrors DatanodeClientProtocol.proto's Type enum (:82-110)
+served the way XceiverServerGrpc -> HddsDispatcher does; the client is a
+drop-in DatanodeClient (client/dn_client.py protocol), so the EC writer/
+reader and reconstruction coordinator work unchanged across processes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ozone_tpu.net import wire
+from ozone_tpu.net.rpc import RpcChannel, RpcServer
+from ozone_tpu.storage.datanode import Datanode
+from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo, ContainerState
+
+SERVICE = "ozone.tpu.DatanodeService"
+
+
+class DatanodeGrpcService:
+    def __init__(self, dn: Datanode, server: RpcServer):
+        self.dn = dn
+        server.add_service(
+            SERVICE,
+            {
+                "CreateContainer": self._create_container,
+                "CloseContainer": self._close_container,
+                "DeleteContainer": self._delete_container,
+                "WriteChunk": self._write_chunk,
+                "ReadChunk": self._read_chunk,
+                "PutBlock": self._put_block,
+                "GetBlock": self._get_block,
+                "ListBlock": self._list_block,
+                "GetCommittedBlockLength": self._committed_len,
+                "DeleteBlock": self._delete_block,
+                "Echo": lambda req: req,
+            },
+        )
+
+    def _create_container(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        self.dn.create_container(
+            m["container_id"],
+            m.get("replica_index", 0),
+            ContainerState(m.get("state", "OPEN")),
+        )
+        return wire.pack({})
+
+    def _close_container(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        self.dn.close_container(m["container_id"])
+        return wire.pack({})
+
+    def _delete_container(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        self.dn.delete_container(m["container_id"], m.get("force", False))
+        return wire.pack({})
+
+    def _write_chunk(self, req: bytes) -> bytes:
+        m, payload = wire.unpack(req)
+        self.dn.write_chunk(
+            BlockID.from_json(m["block_id"]),
+            ChunkInfo.from_json(m["chunk"]),
+            wire.payload_array(payload),
+            sync=m.get("sync", False),
+        )
+        return wire.pack({})
+
+    def _read_chunk(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        data = self.dn.read_chunk(
+            BlockID.from_json(m["block_id"]),
+            ChunkInfo.from_json(m["chunk"]),
+            verify=m.get("verify", False),
+        )
+        return wire.pack({}, data)
+
+    def _put_block(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        self.dn.put_block(BlockData.from_json(m["block"]), sync=m.get("sync", False))
+        return wire.pack({})
+
+    def _get_block(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        bd = self.dn.get_block(BlockID.from_json(m["block_id"]))
+        return wire.pack({"block": bd.to_json()})
+
+    def _list_block(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        blocks = self.dn.list_blocks(m["container_id"])
+        return wire.pack({"blocks": [b.to_json() for b in blocks]})
+
+    def _committed_len(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        n = self.dn.get_committed_block_length(BlockID.from_json(m["block_id"]))
+        return wire.pack({"length": n})
+
+    def _delete_block(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        self.dn.delete_block(BlockID.from_json(m["block_id"]))
+        return wire.pack({})
+
+
+class GrpcDatanodeClient:
+    """Remote DatanodeClient over gRPC (ECXceiverClientGrpc analog)."""
+
+    def __init__(self, dn_id: str, address: str):
+        self.dn_id = dn_id
+        self._ch = RpcChannel(address)
+
+    def _call(self, method: str, meta: dict,
+              payload: Optional[np.ndarray] = None) -> tuple[dict, memoryview]:
+        resp = self._ch.call(SERVICE, method, wire.pack(meta, payload))
+        return wire.unpack(resp)
+
+    def create_container(self, container_id, replica_index=0,
+                         state=ContainerState.OPEN):
+        self._call(
+            "CreateContainer",
+            {
+                "container_id": container_id,
+                "replica_index": replica_index,
+                "state": state.value,
+            },
+        )
+
+    def close_container(self, container_id):
+        self._call("CloseContainer", {"container_id": container_id})
+
+    def delete_container(self, container_id, force=False):
+        self._call("DeleteContainer", {"container_id": container_id,
+                                       "force": force})
+
+    def write_chunk(self, block_id, info, data, sync=False):
+        arr = np.asarray(
+            np.frombuffer(data, dtype=np.uint8)
+            if isinstance(data, (bytes, bytearray))
+            else data,
+            dtype=np.uint8,
+        )
+        self._call(
+            "WriteChunk",
+            {
+                "block_id": block_id.to_json(),
+                "chunk": info.to_json(),
+                "sync": sync,
+            },
+            arr,
+        )
+
+    def read_chunk(self, block_id, info, verify=False):
+        _, payload = self._call(
+            "ReadChunk",
+            {
+                "block_id": block_id.to_json(),
+                "chunk": info.to_json(),
+                "verify": verify,
+            },
+        )
+        return wire.payload_array(payload).copy()
+
+    def put_block(self, block, sync=False):
+        self._call("PutBlock", {"block": block.to_json(), "sync": sync})
+
+    def get_block(self, block_id):
+        m, _ = self._call("GetBlock", {"block_id": block_id.to_json()})
+        return BlockData.from_json(m["block"])
+
+    def list_blocks(self, container_id):
+        m, _ = self._call("ListBlock", {"container_id": container_id})
+        return [BlockData.from_json(b) for b in m["blocks"]]
+
+    def get_committed_block_length(self, block_id):
+        m, _ = self._call(
+            "GetCommittedBlockLength", {"block_id": block_id.to_json()}
+        )
+        return m["length"]
+
+    def delete_block(self, block_id):
+        self._call("DeleteBlock", {"block_id": block_id.to_json()})
+
+    def echo(self, data: bytes = b"ping") -> bytes:
+        return self._ch.call(SERVICE, "Echo", data)
+
+    def close(self):
+        self._ch.close()

@@ -1,0 +1,273 @@
+"""OM gRPC service + remote client.
+
+Mirrors the reference's OM client protocol surface (OmClientProtocol.proto
+served by OzoneManagerProtocolServerSideTranslatorPB) at the verb level.
+GrpcOmClient implements the same attribute surface OzoneClient needs from
+OzoneManager, so the user-facing API works identically against a remote
+OM (the RpcClient/GrpcOmTransport analog).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ozone_tpu.client.ec_writer import BlockGroup
+from ozone_tpu.net import wire
+from ozone_tpu.net.rpc import RpcChannel, RpcServer
+from ozone_tpu.om.om import OzoneManager
+from ozone_tpu.om.requests import OMError
+from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+from ozone_tpu.storage.ids import StorageError
+
+SERVICE = "ozone.tpu.OmService"
+
+
+class OmGrpcService:
+    def __init__(self, om: OzoneManager, server: RpcServer,
+                 addresses_provider=None):
+        self.om = om
+        # callable returning the dn_id -> address book (from the co-located
+        # SCM service or a remote SCM client)
+        self.addresses_provider = addresses_provider or (lambda: {})
+        server.add_service(
+            SERVICE,
+            {
+                "CreateVolume": self._wrap(lambda m: self.om.create_volume(m["volume"])),
+                "DeleteVolume": self._wrap(lambda m: self.om.delete_volume(m["volume"])),
+                "VolumeInfo": self._wrap(lambda m: self.om.volume_info(m["volume"])),
+                "ListVolumes": self._wrap(lambda m: self.om.list_volumes()),
+                "CreateBucket": self._wrap(
+                    lambda m: self.om.create_bucket(
+                        m["volume"], m["bucket"],
+                        m.get("replication", "rs-6-3-1024k"),
+                        m.get("layout", "OBJECT_STORE"),
+                    )
+                ),
+                "DeleteBucket": self._wrap(
+                    lambda m: self.om.delete_bucket(m["volume"], m["bucket"])
+                ),
+                "BucketInfo": self._wrap(
+                    lambda m: self.om.bucket_info(m["volume"], m["bucket"])
+                ),
+                "ListBuckets": self._wrap(
+                    lambda m: self.om.list_buckets(m["volume"])
+                ),
+                "OpenKey": self._open_key,
+                "AllocateBlock": self._allocate_block,
+                "CommitKey": self._commit_key,
+                "LookupKey": self._wrap(
+                    lambda m: self.om.lookup_key(m["volume"], m["bucket"], m["key"])
+                ),
+                "ListKeys": self._wrap(
+                    lambda m: self.om.list_keys(
+                        m["volume"], m["bucket"], m.get("prefix", "")
+                    )
+                ),
+                "DeleteKey": self._wrap(
+                    lambda m: self.om.delete_key(m["volume"], m["bucket"], m["key"])
+                ),
+                "RenameKey": self._wrap(
+                    lambda m: self.om.rename_key(
+                        m["volume"], m["bucket"], m["key"], m["new_key"]
+                    )
+                ),
+            },
+        )
+
+    @staticmethod
+    def _wrap(fn):
+        def method(req: bytes) -> bytes:
+            m, _ = wire.unpack(req)
+            try:
+                out = fn(m)
+            except OMError as e:
+                raise StorageError(e.code, str(e))
+            return wire.pack({"result": out})
+
+        return method
+
+    def _open_key(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        try:
+            s = self.om.open_key(
+                m["volume"], m["bucket"], m["key"], m.get("replication")
+            )
+        except OMError as e:
+            raise StorageError(e.code, str(e))
+        return wire.pack(
+            {
+                "client_id": s.client_id,
+                "replication": str(s.replication),
+                "checksum_type": s.checksum_type,
+                "bytes_per_checksum": s.bytes_per_checksum,
+                "block_size": self.om.block_size,
+            }
+        )
+
+    def _allocate_block(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        g = self.om.scm.allocate_block(
+            ReplicationConfig.parse(m["replication"]),
+            self.om.block_size,
+            m.get("excluded"),
+        )
+        return wire.pack(
+            {"group": g.to_json(), "addresses": self.addresses_provider()}
+        )
+
+    def _commit_key(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+
+        class _S:  # minimal session view for commit
+            volume = m["volume"]
+            bucket = m["bucket"]
+            key = m["key"]
+            client_id = m["client_id"]
+            replication = ReplicationConfig.parse(m["replication"])
+
+        try:
+            self.om.commit_key(_S(), self._groups_from(m["groups"]), m["size"])
+        except OMError as e:
+            raise StorageError(e.code, str(e))
+        return wire.pack({})
+
+    @staticmethod
+    def _groups_from(groups: list[dict]) -> list[BlockGroup]:
+        out = []
+        for g in groups:
+            out.append(
+                BlockGroup(
+                    container_id=g["container_id"],
+                    local_id=g["local_id"],
+                    pipeline=Pipeline(
+                        ReplicationConfig.parse(g["replication"]),
+                        list(g["nodes"]),
+                    ),
+                    length=g["length"],
+                )
+            )
+        return out
+
+
+class RemoteOpenKeySession:
+    def __init__(self, volume, bucket, key, meta):
+        self.volume = volume
+        self.bucket = bucket
+        self.key = key
+        self.client_id = meta["client_id"]
+        self.replication = ReplicationConfig.parse(meta["replication"])
+        self.checksum_type = meta["checksum_type"]
+        self.bytes_per_checksum = meta["bytes_per_checksum"]
+
+
+class GrpcOmClient:
+    """Remote OzoneManager with the attribute surface OzoneClient expects."""
+
+    def __init__(self, address: str, clients=None):
+        self._ch = RpcChannel(address)
+        self.block_size = 16 * 1024 * 1024
+        self.clients = clients  # DatanodeClientFactory for address learning
+
+    def _call(self, method: str, **meta) -> dict:
+        m, _ = wire.unpack(self._ch.call(SERVICE, method, wire.pack(meta)))
+        return m
+
+    # namespace
+    def create_volume(self, volume, owner="root"):
+        self._call("CreateVolume", volume=volume)
+
+    def delete_volume(self, volume):
+        self._call("DeleteVolume", volume=volume)
+
+    def volume_info(self, volume):
+        return self._call("VolumeInfo", volume=volume)["result"]
+
+    def list_volumes(self):
+        return self._call("ListVolumes")["result"]
+
+    def create_bucket(self, volume, bucket, replication="rs-6-3-1024k",
+                      layout="OBJECT_STORE"):
+        self._call("CreateBucket", volume=volume, bucket=bucket,
+                   replication=replication, layout=layout)
+
+    def delete_bucket(self, volume, bucket):
+        self._call("DeleteBucket", volume=volume, bucket=bucket)
+
+    def bucket_info(self, volume, bucket):
+        return self._call("BucketInfo", volume=volume, bucket=bucket)["result"]
+
+    def list_buckets(self, volume):
+        return self._call("ListBuckets", volume=volume)["result"]
+
+    # keys
+    def open_key(self, volume, bucket, key, replication=None):
+        meta = self._call("OpenKey", volume=volume, bucket=bucket, key=key,
+                          replication=replication)
+        self.block_size = meta.get("block_size", self.block_size)
+        return RemoteOpenKeySession(volume, bucket, key, meta)
+
+    def allocate_block(self, session, excluded: Optional[list[str]] = None):
+        m = self._call(
+            "AllocateBlock",
+            replication=str(session.replication),
+            excluded=excluded or [],
+        )
+        g = m["group"]
+        if self.clients is not None:
+            for dn_id, addr in m.get("addresses", {}).items():
+                if self.clients.maybe_get(dn_id) is None:
+                    self.clients.register_remote(dn_id, addr)
+        return BlockGroup(
+            container_id=g["container_id"],
+            local_id=g["local_id"],
+            pipeline=Pipeline(
+                ReplicationConfig.parse(g["replication"]), list(g["nodes"])
+            ),
+        )
+
+    def commit_key(self, session, groups, size):
+        self._call(
+            "CommitKey",
+            volume=session.volume,
+            bucket=session.bucket,
+            key=session.key,
+            client_id=session.client_id,
+            replication=str(session.replication),
+            groups=[g.to_json() for g in groups],
+            size=size,
+        )
+
+    def lookup_key(self, volume, bucket, key):
+        return self._call("LookupKey", volume=volume, bucket=bucket, key=key)[
+            "result"
+        ]
+
+    def key_block_groups(self, info):
+        out = []
+        for g in info["block_groups"]:
+            out.append(
+                BlockGroup(
+                    container_id=g["container_id"],
+                    local_id=g["local_id"],
+                    pipeline=Pipeline(
+                        ReplicationConfig.parse(g["replication"]),
+                        list(g["nodes"]),
+                    ),
+                    length=g["length"],
+                )
+            )
+        return out
+
+    def list_keys(self, volume, bucket, prefix=""):
+        return self._call("ListKeys", volume=volume, bucket=bucket,
+                          prefix=prefix)["result"]
+
+    def delete_key(self, volume, bucket, key):
+        self._call("DeleteKey", volume=volume, bucket=bucket, key=key)
+
+    def rename_key(self, volume, bucket, key, new_key):
+        self._call("RenameKey", volume=volume, bucket=bucket, key=key,
+                   new_key=new_key)
+
+    def close(self):
+        self._ch.close()

@@ -1,0 +1,121 @@
+"""Generic gRPC plumbing: byte-level services without codegen.
+
+Role analog of the reference's gRPC/Netty datapath transport
+(XceiverServerGrpc.java:76 / GrpcXceiverService.java:42 on the server,
+XceiverClientGrpc on the client). Services register python callables per
+method name; requests/responses are raw bytes in the net/wire.py format.
+Errors cross the wire as grpc ABORTED with a JSON {code, message} detail
+and are re-raised as StorageError on the client.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from concurrent import futures
+from typing import Callable, Optional
+
+import grpc
+
+from ozone_tpu.storage.ids import StorageError
+
+log = logging.getLogger(__name__)
+
+Method = Callable[[bytes], bytes]
+
+
+class _GenericHandler(grpc.GenericRpcHandler):
+    def __init__(self, methods: dict[str, Method]):
+        self._methods = methods
+
+    def service(self, handler_call_details):
+        fn = self._methods.get(handler_call_details.method)
+        if fn is None:
+            return None
+
+        def wrapped(request: bytes, context: grpc.ServicerContext) -> bytes:
+            try:
+                return fn(request)
+            except StorageError as e:
+                context.abort(
+                    grpc.StatusCode.ABORTED,
+                    json.dumps({"code": e.code, "message": str(e)}),
+                )
+            except Exception as e:  # noqa: BLE001 - surface as INTERNAL
+                log.exception("rpc %s failed", handler_call_details.method)
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    json.dumps({"code": "IO_EXCEPTION", "message": str(e)}),
+                )
+
+        return grpc.unary_unary_rpc_method_handler(wrapped)
+
+
+class RpcServer:
+    """One grpc.Server hosting any number of named services."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 max_workers: int = 16):
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[
+                ("grpc.max_send_message_length", 128 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+            ],
+        )
+        self.port = self._server.add_insecure_port(f"{host}:{port}")
+        self.host = host
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def add_service(self, service_name: str, methods: dict[str, Method]) -> None:
+        full = {
+            f"/{service_name}/{name}": fn for name, fn in methods.items()
+        }
+        self._server.add_generic_rpc_handlers((_GenericHandler(full),))
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self, grace: Optional[float] = 0.5) -> None:
+        self._server.stop(grace)
+
+
+class RpcChannel:
+    """Client side: method callables with raw-bytes serialization."""
+
+    def __init__(self, address: str):
+        self.address = address
+        self._channel = grpc.insecure_channel(
+            address,
+            options=[
+                ("grpc.max_send_message_length", 128 * 1024 * 1024),
+                ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+            ],
+        )
+        self._calls: dict[str, Callable] = {}
+
+    def call(self, service: str, method: str, request: bytes,
+             timeout: Optional[float] = 30.0) -> bytes:
+        key = f"/{service}/{method}"
+        fn = self._calls.get(key)
+        if fn is None:
+            fn = self._channel.unary_unary(key)
+            self._calls[key] = fn
+        try:
+            return fn(request, timeout=timeout)
+        except grpc.RpcError as e:
+            detail = e.details() or ""
+            try:
+                d = json.loads(detail)
+                raise StorageError(d.get("code", "IO_EXCEPTION"),
+                                   d.get("message", detail)) from e
+            except (ValueError, KeyError):
+                raise StorageError("IO_EXCEPTION",
+                                   f"rpc {key} to {self.address}: "
+                                   f"{e.code()}: {detail}") from e
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,184 @@
+"""SCM gRPC service + remote client: registration, heartbeats, allocation.
+
+Mirrors the reference's SCM protocol surface (ScmServerDatanodeHeartbeat
+Protocol.proto for DN registration/heartbeat with piggybacked commands;
+ScmServerProtocol block allocation used by the OM). Commands are
+serialized with a type tag and the node address book, so remote datanodes
+can execute reconstruction against peers they have never met.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Optional
+
+from ozone_tpu.codec.api import CoderOptions
+from ozone_tpu.net import wire
+from ozone_tpu.net.rpc import RpcChannel, RpcServer
+from ozone_tpu.scm.pipeline import ReplicationConfig
+from ozone_tpu.scm.replication_manager import (
+    DeleteReplicaCommand,
+    ReplicateCommand,
+)
+from ozone_tpu.scm.scm import StorageContainerManager
+from ozone_tpu.storage.reconstruction import ReconstructionCommand
+
+SERVICE = "ozone.tpu.ScmService"
+
+
+def serialize_command(cmd, addresses: dict[str, str]) -> dict:
+    if isinstance(cmd, ReconstructionCommand):
+        return {
+            "type": "reconstruct",
+            "container_id": cmd.container_id,
+            "replication": str(
+                CoderOptions(
+                    cmd.replication.data_units,
+                    cmd.replication.parity_units,
+                    cmd.replication.codec,
+                    cmd.replication.cell_size,
+                )
+            ),
+            "sources": {str(k): v for k, v in cmd.sources.items()},
+            "targets": {str(k): v for k, v in cmd.targets.items()},
+            "addresses": addresses,
+        }
+    if isinstance(cmd, DeleteReplicaCommand):
+        return {"type": "delete_replica", **asdict(cmd)}
+    if isinstance(cmd, ReplicateCommand):
+        return {"type": "replicate", **asdict(cmd), "addresses": addresses}
+    if isinstance(cmd, dict):
+        return cmd
+    return {"type": "unknown", "repr": repr(cmd)}
+
+
+def deserialize_command(d: dict):
+    t = d.get("type")
+    if t == "reconstruct":
+        return ReconstructionCommand(
+            container_id=d["container_id"],
+            replication=CoderOptions.parse(d["replication"]),
+            sources={int(k): v for k, v in d["sources"].items()},
+            targets={int(k): v for k, v in d["targets"].items()},
+        )
+    if t == "delete_replica":
+        return DeleteReplicaCommand(d["container_id"], d.get("replica_index", 0))
+    if t == "replicate":
+        return ReplicateCommand(
+            d["container_id"], d["source"], d["target"],
+            d.get("replica_index", 0),
+        )
+    return d
+
+
+class ScmGrpcService:
+    def __init__(self, scm: StorageContainerManager, server: RpcServer):
+        self.scm = scm
+        self.addresses: dict[str, str] = {}
+        server.add_service(
+            SERVICE,
+            {
+                "Register": self._register,
+                "Heartbeat": self._heartbeat,
+                "AllocateBlock": self._allocate_block,
+                "NodeAddresses": self._node_addresses,
+                "Status": self._status,
+            },
+        )
+
+    def _register(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        self.addresses[m["dn_id"]] = m["address"]
+        self.scm.register_datanode(
+            m["dn_id"], m.get("rack", "/default-rack"),
+            m.get("capacity_bytes", 0),
+        )
+        return wire.pack({})
+
+    def _heartbeat(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        cmds = self.scm.heartbeat(
+            m["dn_id"],
+            container_report=m.get("container_report"),
+            used_bytes=m.get("used_bytes", 0),
+        )
+        return wire.pack(
+            {
+                "commands": [
+                    serialize_command(c, dict(self.addresses)) for c in cmds
+                ]
+            }
+        )
+
+    def _allocate_block(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        g = self.scm.allocate_block(
+            ReplicationConfig.parse(m["replication"]),
+            m["block_size"],
+            m.get("excluded"),
+        )
+        return wire.pack({"group": g.to_json(), "addresses": dict(self.addresses)})
+
+    def _node_addresses(self, req: bytes) -> bytes:
+        return wire.pack({"addresses": dict(self.addresses)})
+
+    def _status(self, req: bytes) -> bytes:
+        return wire.pack(
+            {
+                "safemode": self.scm.safemode.in_safemode(),
+                "safemode_status": self.scm.safemode.status(),
+                "nodes": [
+                    {
+                        "dn_id": n.dn_id,
+                        "rack": n.rack,
+                        "state": n.state.value,
+                        "op_state": n.op_state.value,
+                    }
+                    for n in self.scm.nodes.nodes()
+                ],
+                "containers": len(self.scm.containers.containers()),
+            }
+        )
+
+
+class GrpcScmClient:
+    def __init__(self, address: str):
+        self._ch = RpcChannel(address)
+
+    def _call(self, method: str, meta: dict) -> dict:
+        m, _ = wire.unpack(self._ch.call(SERVICE, method, wire.pack(meta)))
+        return m
+
+    def register(self, dn_id: str, address: str, rack: str = "/default-rack",
+                 capacity_bytes: int = 0) -> None:
+        self._call("Register", {
+            "dn_id": dn_id, "address": address, "rack": rack,
+            "capacity_bytes": capacity_bytes,
+        })
+
+    def heartbeat(self, dn_id: str, container_report=None,
+                  used_bytes: int = 0) -> list:
+        m = self._call("Heartbeat", {
+            "dn_id": dn_id,
+            "container_report": container_report,
+            "used_bytes": used_bytes,
+        })
+        return [deserialize_command(c) for c in m["commands"]]
+
+    def allocate_block(self, replication: str, block_size: int,
+                       excluded: Optional[list[str]] = None):
+        m = self._call("AllocateBlock", {
+            "replication": replication,
+            "block_size": block_size,
+            "excluded": excluded or [],
+        })
+        return m["group"], m["addresses"]
+
+    def node_addresses(self) -> dict[str, str]:
+        return self._call("NodeAddresses", {})["addresses"]
+
+    def status(self) -> dict:
+        return self._call("Status", {})
+
+    def close(self) -> None:
+        self._ch.close()

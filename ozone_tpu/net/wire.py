@@ -1,0 +1,37 @@
+"""Wire format for the gRPC datapath: JSON header + raw payload bytes.
+
+The reference's datapath messages are protobuf (DatanodeClientProtocol
+.proto) with chunk payloads as embedded bytes. Here each RPC carries a
+compact length-prefixed JSON header (verbs' metadata is small) followed by
+the raw chunk payload, so bulk data is never re-encoded — the property
+that matters at GiB/s rates. grpc-python passes requests/responses as raw
+bytes when serializers are None, so no codegen plugin is needed.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Optional
+
+import numpy as np
+
+_LEN = struct.Struct("!I")
+
+
+def pack(meta: dict[str, Any], payload: Optional[bytes | np.ndarray] = None) -> bytes:
+    h = json.dumps(meta, separators=(",", ":")).encode()
+    body = b"" if payload is None else (
+        payload.tobytes() if isinstance(payload, np.ndarray) else bytes(payload)
+    )
+    return _LEN.pack(len(h)) + h + body
+
+
+def unpack(buf: bytes) -> tuple[dict[str, Any], memoryview]:
+    (hlen,) = _LEN.unpack_from(buf, 0)
+    meta = json.loads(bytes(buf[4 : 4 + hlen]).decode())
+    return meta, memoryview(buf)[4 + hlen :]
+
+
+def payload_array(view: memoryview) -> np.ndarray:
+    return np.frombuffer(view, dtype=np.uint8)
