@@ -77,6 +77,21 @@ class CodecRegistry:
         self.register(
             "dummy", "numpy", 10, numpy_coder.DummyEncoder, numpy_coder.DummyDecoder
         )
+        # C++ backend (ISA-L-class nibble-shuffle kernels): preferred over
+        # numpy, below the TPU backend — mirrors the reference's
+        # native-first ordering (CodecRegistry.java:92-97)
+        try:
+            from ozone_tpu import native as _native
+
+            if _native.load() is not None:
+                from ozone_tpu.codec import cpp_coder
+
+                self.register(
+                    "rs", "cpp", 50, cpp_coder.CppRSEncoder,
+                    cpp_coder.CppRSDecoder,
+                )
+        except Exception as e:  # pragma: no cover - toolchain present in CI
+            log.warning("cpp codec backend unavailable: %s", e)
         # TPU backend registers lazily: importing jax is deliberately deferred
         # so host-only tools never pay for it.
         try:

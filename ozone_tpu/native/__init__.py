@@ -1,0 +1,71 @@
+"""Native (C++) kernels: build-on-demand + ctypes bindings.
+
+Build model mirrors the reference's native-loader pattern
+(ErasureCodeNative.java:42-63 — probe for the native library, fall back
+gracefully): the .so is compiled from gf_coder.cpp with g++ on first use
+and cached next to the source; import never fails hard when a toolchain
+is missing — the registry then simply skips the "cpp" backend.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+log = logging.getLogger(__name__)
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "gf_coder.cpp"
+_SO = _HERE / "libgf_coder.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> None:
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        "-o", str(_SO), str(_SRC),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        try:
+            if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+                _build()
+            lib = ctypes.CDLL(str(_SO))
+            lib.gf_matrix_apply.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ]
+            lib.gf_matrix_apply_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64,
+            ]
+            lib.crc32c_hw.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,
+            ]
+            lib.crc32c_hw.restype = ctypes.c_uint32
+            lib.crc32c_slices.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p,
+            ]
+            lib.native_probe.restype = ctypes.c_int
+            _lib = lib
+            log.info("native coder loaded (simd level %d)", lib.native_probe())
+        except (OSError, subprocess.SubprocessError) as e:
+            log.warning("native coder unavailable: %s", e)
+            _lib = None
+        return _lib

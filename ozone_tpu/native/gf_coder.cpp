@@ -1,0 +1,150 @@
+// Native CPU erasure coder + checksum kernels.
+//
+// Role analog of the reference's ISA-L JNI coder (erasurecode
+// rawcoder/NativeRSRawEncoder.java delegating to libhadoop/ISA-L): the
+// fast CPU backend next to the TPU backend, and the honest single-host
+// baseline for the ">= 5x ISA-L" target in BASELINE.md.
+//
+// The GF(2^8) multiply kernel uses the same split-nibble table-shuffle
+// trick as ISA-L's gf_vect_mul (PSHUFB on low/high nibbles against
+// 16-entry product tables — the tables are exactly the 32-byte/coefficient
+// layout of GF256.gfVectMulInit in the reference, rawcoder/util/
+// GF256.java:259-330), vectorized with AVX2 when available. CRC32C uses
+// the SSE4.2 hardware instruction.
+//
+// Exposed via a C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+
+#if defined(__x86_64__)
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------- GF tables
+// product tables: for coefficient c, lo[x] = mul(c, x) for x in 0..15,
+// hi[x] = mul(c, x << 4). Built host-side (python) and passed in as
+// tables[coef_index * 32].
+
+static inline void gf_mul_region_scalar(const uint8_t* tab32,
+                                        const uint8_t* src, uint8_t* dst,
+                                        int64_t n) {
+  const uint8_t* lo = tab32;
+  const uint8_t* hi = tab32 + 16;
+  for (int64_t i = 0; i < n; ++i) {
+    uint8_t b = src[i];
+    dst[i] ^= (uint8_t)(lo[b & 0x0f] ^ hi[b >> 4]);
+  }
+}
+
+#if defined(__AVX2__)
+static inline void gf_mul_region_avx2(const uint8_t* tab32,
+                                      const uint8_t* src, uint8_t* dst,
+                                      int64_t n) {
+  const __m128i lo128 = _mm_loadu_si128((const __m128i*)tab32);
+  const __m128i hi128 = _mm_loadu_si128((const __m128i*)(tab32 + 16));
+  const __m256i lo = _mm256_broadcastsi128_si256(lo128);
+  const __m256i hi = _mm256_broadcastsi128_si256(hi128);
+  const __m256i mask = _mm256_set1_epi8(0x0f);
+  int64_t i = 0;
+  for (; i + 32 <= n; i += 32) {
+    __m256i v = _mm256_loadu_si256((const __m256i*)(src + i));
+    __m256i vlo = _mm256_and_si256(v, mask);
+    __m256i vhi = _mm256_and_si256(_mm256_srli_epi16(v, 4), mask);
+    __m256i prod = _mm256_xor_si256(_mm256_shuffle_epi8(lo, vlo),
+                                    _mm256_shuffle_epi8(hi, vhi));
+    __m256i d = _mm256_loadu_si256((const __m256i*)(dst + i));
+    _mm256_storeu_si256((__m256i*)(dst + i), _mm256_xor_si256(d, prod));
+  }
+  if (i < n) gf_mul_region_scalar(tab32, src + i, dst + i, n - i);
+}
+#endif
+
+static inline void gf_mul_region(const uint8_t* tab32, const uint8_t* src,
+                                 uint8_t* dst, int64_t n) {
+#if defined(__AVX2__)
+  gf_mul_region_avx2(tab32, src, dst, n);
+#else
+  gf_mul_region_scalar(tab32, src, dst, n);
+#endif
+}
+
+// Apply a coding matrix: out[r] = XOR_j mul(matrix[r*k+j], data[j]).
+// tables: rows*k*32 bytes of per-coefficient nibble tables.
+// data: k contiguous units of n bytes; out: rows units of n bytes (zeroed
+// here).
+void gf_matrix_apply(const uint8_t* tables, int rows, int k,
+                     const uint8_t* data, uint8_t* out, int64_t n) {
+  memset(out, 0, (size_t)rows * (size_t)n);
+  for (int r = 0; r < rows; ++r) {
+    uint8_t* o = out + (int64_t)r * n;
+    for (int j = 0; j < k; ++j) {
+      const uint8_t* tab = tables + ((int64_t)r * k + j) * 32;
+      // tab[1] holds the coefficient's product with 1 == the coefficient;
+      // a zero coefficient contributes nothing.
+      bool zero = true;
+      for (int t = 0; t < 32; ++t)
+        if (tab[t]) { zero = false; break; }
+      if (zero) continue;
+      gf_mul_region(tab, data + (int64_t)j * n, o, n);
+    }
+  }
+}
+
+// Batched variant: data [batch, k, n], out [batch, rows, n].
+void gf_matrix_apply_batch(const uint8_t* tables, int rows, int k,
+                           const uint8_t* data, uint8_t* out, int64_t n,
+                           int64_t batch) {
+  for (int64_t b = 0; b < batch; ++b) {
+    gf_matrix_apply(tables, rows, k, data + b * k * n, out + b * rows * n, n);
+  }
+}
+
+// ------------------------------------------------------------------ CRC32C
+// Hardware CRC32C (Castagnoli) with the standard init/xorout convention.
+uint32_t crc32c_hw(const uint8_t* data, int64_t n, uint32_t prev) {
+  uint32_t state = prev ^ 0xFFFFFFFFu;
+#if defined(__SSE4_2__)
+  int64_t i = 0;
+  uint64_t s64 = state;
+  for (; i + 8 <= n; i += 8) {
+    uint64_t chunk;
+    memcpy(&chunk, data + i, 8);
+    s64 = _mm_crc32_u64(s64, chunk);
+  }
+  state = (uint32_t)s64;
+  for (; i < n; ++i) state = _mm_crc32_u8(state, data[i]);
+#else
+  // bitwise fallback (poly 0x82F63B78 reflected)
+  for (int64_t i = 0; i < n; ++i) {
+    state ^= data[i];
+    for (int bit = 0; bit < 8; ++bit)
+      state = (state >> 1) ^ (0x82F63B78u & (0u - (state & 1u)));
+  }
+#endif
+  return state ^ 0xFFFFFFFFu;
+}
+
+// Slice-wise CRC32C over a buffer: one crc per bpc bytes.
+void crc32c_slices(const uint8_t* data, int64_t n, int64_t bpc,
+                   uint32_t* out) {
+  int64_t idx = 0;
+  for (int64_t off = 0; off < n; off += bpc) {
+    int64_t len = (off + bpc <= n) ? bpc : (n - off);
+    out[idx++] = crc32c_hw(data + off, len, 0);
+  }
+}
+
+int native_probe() {
+#if defined(__AVX2__)
+  return 2;
+#elif defined(__SSE4_2__)
+  return 1;
+#else
+  return 0;
+#endif
+}
+
+}  // extern "C"
