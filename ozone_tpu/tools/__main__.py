@@ -1,0 +1,4 @@
+from ozone_tpu.tools.cli import main
+import sys
+
+sys.exit(main())

@@ -1,0 +1,246 @@
+"""ozone-tpu CLI: shell, admin, freon, daemons, debug.
+
+Mirror of the reference's CLI surface (hadoop-ozone/tools shell/
+OzoneShell.java `ozone sh` volume/bucket/key verbs; `ozone admin`
+safemode/datanode/container commands; `ozone freon` generators;
+`ozone debug`; service starters). Talks to a running cluster over gRPC.
+
+Usage examples:
+  ozone-tpu scm-om --db /data/om.db --port 9860
+  ozone-tpu datanode --root /data/dn1 --scm 127.0.0.1:9860
+  ozone-tpu sh volume create /vol1 --om 127.0.0.1:9860
+  ozone-tpu sh key put /vol1/bucket1/key1 ./file --om ...
+  ozone-tpu admin safemode status --om ...
+  ozone-tpu freon ockg -n 1000 -s 1048576 --om ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def _client(args):
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    clients = DatanodeClientFactory()
+    om = GrpcOmClient(args.om, clients=clients)
+    # learn datanode addresses up front
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    try:
+        for dn_id, addr in GrpcScmClient(args.om).node_addresses().items():
+            clients.register_remote(dn_id, addr)
+    except Exception:
+        pass
+    return OzoneClient(om, clients)
+
+
+def _parse_path(path: str) -> list[str]:
+    return [p for p in path.strip("/").split("/") if p]
+
+
+def _emit(obj) -> None:
+    print(json.dumps(obj, indent=2, default=str))
+
+
+# ---------------------------------------------------------------------- sh
+def cmd_sh(args) -> int:
+    oz = _client(args)
+    parts = _parse_path(args.path)
+    kind, verb = args.object, args.verb
+    if kind == "volume":
+        (vol,) = parts
+        if verb == "create":
+            oz.create_volume(vol)
+        elif verb == "delete":
+            oz.om.delete_volume(vol)
+        elif verb == "info":
+            _emit(oz.om.volume_info(vol))
+        elif verb == "list":
+            _emit(oz.list_volumes())
+    elif kind == "bucket":
+        if verb == "list":
+            (vol,) = parts
+            _emit(oz.om.list_buckets(vol))
+        else:
+            vol, bucket = parts
+            if verb == "create":
+                oz.om.create_bucket(vol, bucket, args.replication)
+            elif verb == "delete":
+                oz.om.delete_bucket(vol, bucket)
+            elif verb == "info":
+                _emit(oz.om.bucket_info(vol, bucket))
+    elif kind == "key":
+        if verb == "list":
+            vol, bucket = parts
+            _emit(oz.om.list_keys(vol, bucket))
+            return 0
+        vol, bucket, *rest = parts
+        key = "/".join(rest)
+        b = oz.get_volume(vol).get_bucket(bucket)
+        if verb == "put":
+            data = Path(args.file).read_bytes()
+            b.write_key(key, np.frombuffer(data, np.uint8),
+                        args.replication if args.replication else None)
+            print(f"wrote {len(data)} bytes to {args.path}")
+        elif verb == "get":
+            data = b.read_key(key)
+            out = Path(args.file) if args.file else None
+            if out:
+                out.write_bytes(data.tobytes())
+                print(f"read {data.size} bytes to {out}")
+            else:
+                sys.stdout.buffer.write(data.tobytes())
+        elif verb == "delete":
+            b.delete_key(key)
+        elif verb == "info":
+            _emit(oz.om.lookup_key(vol, bucket, key))
+        elif verb == "rename":
+            b.rename_key(key, args.to)
+    return 0
+
+
+# -------------------------------------------------------------------- admin
+def cmd_admin(args) -> int:
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    scm = GrpcScmClient(args.om)
+    if args.subject == "safemode":
+        st = scm.status()
+        _emit({"safemode": st["safemode"], **st["safemode_status"]})
+    elif args.subject == "datanode":
+        _emit(scm.status()["nodes"])
+    elif args.subject == "status":
+        _emit(scm.status())
+    return 0
+
+
+# -------------------------------------------------------------------- freon
+def cmd_freon(args) -> int:
+    from ozone_tpu.tools import freon
+
+    if args.generator == "ockg":
+        oz = _client(args)
+        rep = freon.ockg(
+            oz, n_keys=args.num, size=args.size, threads=args.threads,
+            replication=args.replication or None, validate=args.validate,
+        )
+        _emit(rep.summary())
+    elif args.generator == "ockr":
+        oz = _client(args)
+        _emit(freon.ockr(oz, args.num, threads=args.threads).summary())
+    elif args.generator == "rawcoder":
+        _emit(
+            freon.rawcoder_bench(
+                schema=args.schema, cell=args.cell, batch=args.batch
+            )
+        )
+    return 0
+
+
+# ------------------------------------------------------------------ daemons
+def cmd_datanode(args) -> int:
+    import logging
+
+    from ozone_tpu.net.daemons import DatanodeDaemon
+
+    logging.basicConfig(level=logging.INFO)
+    dn_id = args.id or Path(args.root).name
+    d = DatanodeDaemon(
+        Path(args.root), dn_id, args.scm, port=args.port, rack=args.rack
+    )
+    d.start()
+    print(f"datanode {dn_id} serving on {d.address}, scm={args.scm}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        d.stop()
+    return 0
+
+
+def cmd_scm_om(args) -> int:
+    import logging
+
+    from ozone_tpu.net.daemons import ScmOmDaemon
+
+    logging.basicConfig(level=logging.INFO)
+    d = ScmOmDaemon(Path(args.db), port=args.port,
+                    min_datanodes=args.min_datanodes)
+    d.start()
+    print(f"scm+om serving on {d.address}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        d.stop()
+    return 0
+
+
+# -------------------------------------------------------------------- main
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(prog="ozone-tpu")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    sh = sub.add_parser("sh", help="object store shell (ozone sh analog)")
+    sh.add_argument("object", choices=["volume", "bucket", "key"])
+    sh.add_argument("verb",
+                    choices=["create", "delete", "info", "list", "put",
+                             "get", "rename"])
+    sh.add_argument("path", help="/volume[/bucket[/key]]")
+    sh.add_argument("file", nargs="?", help="local file for key put/get")
+    sh.add_argument("--om", default="127.0.0.1:9860")
+    sh.add_argument("--replication", default="")
+    sh.add_argument("--to", default="", help="rename target")
+    sh.set_defaults(fn=cmd_sh)
+
+    ad = sub.add_parser("admin", help="cluster admin (ozone admin analog)")
+    ad.add_argument("subject", choices=["safemode", "datanode", "status"])
+    ad.add_argument("--om", default="127.0.0.1:9860")
+    ad.set_defaults(fn=cmd_admin)
+
+    fr = sub.add_parser("freon", help="load generators")
+    fr.add_argument("generator", choices=["ockg", "ockr", "rawcoder"])
+    fr.add_argument("-n", "--num", type=int, default=100)
+    fr.add_argument("-s", "--size", type=int, default=10240)
+    fr.add_argument("-t", "--threads", type=int, default=4)
+    fr.add_argument("--om", default="127.0.0.1:9860")
+    fr.add_argument("--replication", default="")
+    fr.add_argument("--validate", action="store_true")
+    fr.add_argument("--schema", default="rs-6-3")
+    fr.add_argument("--cell", type=int, default=1024 * 1024)
+    fr.add_argument("--batch", type=int, default=8)
+    fr.set_defaults(fn=cmd_freon)
+
+    dn = sub.add_parser("datanode", help="run a datanode daemon")
+    dn.add_argument("--root", required=True)
+    dn.add_argument("--scm", required=True)
+    dn.add_argument("--id", default="")
+    dn.add_argument("--port", type=int, default=0)
+    dn.add_argument("--rack", default="/default-rack")
+    dn.set_defaults(fn=cmd_datanode)
+
+    so = sub.add_parser("scm-om", help="run the SCM+OM metadata server")
+    so.add_argument("--db", required=True)
+    so.add_argument("--port", type=int, default=9860)
+    so.add_argument("--min-datanodes", type=int, default=1)
+    so.set_defaults(fn=cmd_scm_om)
+
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

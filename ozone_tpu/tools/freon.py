@@ -1,0 +1,233 @@
+"""Freon: load generators and benchmarks.
+
+Mirror of the reference's freon suite (hadoop-ozone/tools freon/
+Freon.java:40-79 subcommand registry): BaseFreonGenerator-style harness
+(thread pool task loop, progress, latency report — BaseFreonGenerator
+.java:77,152,182,321) and the key generators:
+
+- ockg: OzoneClientKeyGenerator.java:42 — write n keys of a given size
+  through the full client stack, per-op timer, replication selectable.
+- ocokr: key read/validate generator (OzoneClientKeyReadWriteOps analog).
+- dcg: DatanodeChunkGenerator — raw WriteChunk straight to datanodes,
+  bypassing OM/SCM (datapath-only throughput).
+- rawcoder: RawErasureCoderBenchmark.java:42-49 — coder encode/decode
+  MB/s per backend (numpy / cpp / jax-TPU), batch x cell matrix.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import numpy as np
+
+from ozone_tpu.utils.metrics import Timer
+
+
+@dataclass
+class FreonReport:
+    name: str
+    ops: int
+    failures: int
+    elapsed_s: float
+    latencies_s: list[float] = field(default_factory=list)
+    bytes_processed: int = 0
+
+    def summary(self) -> dict:
+        lat = sorted(self.latencies_s)
+        pct = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))] if lat else 0.0
+        return {
+            "generator": self.name,
+            "ops": self.ops,
+            "failures": self.failures,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "ops_per_s": round(self.ops / self.elapsed_s, 2)
+            if self.elapsed_s
+            else 0,
+            "throughput_mib_s": round(
+                self.bytes_processed / 2**20 / self.elapsed_s, 2
+            )
+            if self.elapsed_s
+            else 0,
+            "mean_ms": round(1e3 * sum(lat) / len(lat), 3) if lat else 0,
+            "p50_ms": round(1e3 * pct(0.5), 3),
+            "p90_ms": round(1e3 * pct(0.9), 3),
+            "p99_ms": round(1e3 * pct(0.99), 3),
+            "max_ms": round(1e3 * (lat[-1] if lat else 0), 3),
+        }
+
+
+class BaseFreonGenerator:
+    """Thread-pooled op loop with latency capture."""
+
+    def __init__(self, name: str, n_ops: int, threads: int = 4):
+        self.name = name
+        self.n_ops = n_ops
+        self.threads = threads
+        self._lat: list[float] = []
+        self._failures = 0
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def run(self, op: Callable[[int], int]) -> FreonReport:
+        """op(i) -> bytes processed; runs n_ops times across the pool."""
+        t0 = time.time()
+
+        def task(i: int) -> None:
+            s = time.perf_counter()
+            try:
+                nbytes = op(i) or 0
+                dt = time.perf_counter() - s
+                with self._lock:
+                    self._lat.append(dt)
+                    self._bytes += nbytes
+            except Exception:
+                with self._lock:
+                    self._failures += 1
+
+        with ThreadPoolExecutor(max_workers=self.threads) as pool:
+            list(pool.map(task, range(self.n_ops)))
+        return FreonReport(
+            self.name,
+            ops=self.n_ops - self._failures,
+            failures=self._failures,
+            elapsed_s=time.time() - t0,
+            latencies_s=self._lat,
+            bytes_processed=self._bytes,
+        )
+
+
+def ockg(
+    client,
+    n_keys: int = 100,
+    size: int = 10 * 1024,
+    threads: int = 4,
+    volume: str = "freon-vol",
+    bucket: str = "freon-bucket",
+    replication: Optional[str] = None,
+    prefix: str = "key",
+    validate: bool = False,
+) -> FreonReport:
+    """Ozone Client Key Generator (freon ockg)."""
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket,
+                                replication or "rs-6-3-1024k")
+    except Exception:
+        pass
+    b = client.get_volume(volume).get_bucket(bucket)
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, size, dtype=np.uint8)
+
+    def op(i: int) -> int:
+        b.write_key(f"{prefix}-{i}", payload, replication)
+        if validate:
+            got = b.read_key(f"{prefix}-{i}")
+            assert np.array_equal(got, payload)
+        return size
+
+    return BaseFreonGenerator("ockg", n_keys, threads).run(op)
+
+
+def ockr(client, n_keys: int, threads: int = 4, volume: str = "freon-vol",
+         bucket: str = "freon-bucket", prefix: str = "key") -> FreonReport:
+    """Key read generator (validation pass over ockg output)."""
+    b = client.get_volume(volume).get_bucket(bucket)
+
+    def op(i: int) -> int:
+        data = b.read_key(f"{prefix}-{i}")
+        return int(data.size)
+
+    return BaseFreonGenerator("ockr", n_keys, threads).run(op)
+
+
+def dcg(
+    clients,
+    dn_ids: list[str],
+    n_chunks: int = 100,
+    size: int = 1024 * 1024,
+    threads: int = 4,
+    container_id: int = 10_000_000,
+) -> FreonReport:
+    """Datanode chunk generator: raw WriteChunk, bypasses OM/SCM
+    (DatanodeChunkGenerator analog)."""
+    from ozone_tpu.storage.ids import BlockID, ChunkInfo, StorageError
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, size, dtype=np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 16 * 1024).compute(payload)
+    for dn in dn_ids:
+        try:
+            clients.get(dn).create_container(container_id)
+        except StorageError as e:
+            if e.code != "CONTAINER_EXISTS":
+                raise
+
+    def op(i: int) -> int:
+        dn = dn_ids[i % len(dn_ids)]
+        bid = BlockID(container_id, i + 1)
+        info = ChunkInfo(f"chunk_{i}", 0, size, cs)
+        clients.get(dn).write_chunk(bid, info, payload)
+        return size
+
+    return BaseFreonGenerator("dcg", n_chunks, threads).run(op)
+
+
+def rawcoder_bench(
+    backends: Optional[list[str]] = None,
+    schema: str = "rs-6-3",
+    cell: int = 1024 * 1024,
+    batch: int = 8,
+    iters: int = 5,
+) -> list[dict]:
+    """Raw coder throughput matrix (RawErasureCoderBenchmark analog)."""
+    from ozone_tpu.codec import CoderOptions, create_decoder, create_encoder
+    from ozone_tpu.codec.registry import CodecRegistry
+
+    parts = schema.split("-")
+    opts = CoderOptions(int(parts[1]), int(parts[2]), parts[0], cell)
+    backends = backends or CodecRegistry.instance().backends(opts.codec)
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, (batch, opts.data_units, cell), dtype=np.uint8)
+    out = []
+    for be in backends:
+        try:
+            enc = create_encoder(opts, be)
+            enc.encode(data)  # warm
+            t0 = time.time()
+            for _ in range(iters):
+                parity = enc.encode(data)
+            enc_dt = (time.time() - t0) / iters
+
+            dec = create_decoder(opts, be)
+            units = np.concatenate([data, parity], axis=1)
+            erased = list(range(min(2, opts.parity_units)))
+            inputs = [
+                None if i in erased else units[:, i]
+                for i in range(opts.all_units)
+            ]
+            dec.decode(inputs, erased)  # warm
+            t0 = time.time()
+            for _ in range(iters):
+                dec.decode(inputs, erased)
+            dec_dt = (time.time() - t0) / iters
+            gib = data.nbytes / 2**30
+            out.append(
+                {
+                    "backend": be,
+                    "schema": schema,
+                    "encode_gib_s": round(gib / enc_dt, 3),
+                    "decode_gib_s": round(gib / dec_dt, 3),
+                }
+            )
+        except Exception as e:
+            out.append({"backend": be, "schema": schema, "error": str(e)})
+    return out

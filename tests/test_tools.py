@@ -1,0 +1,85 @@
+"""Freon generators + CLI tests against a loopback gRPC cluster."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+from ozone_tpu.tools import freon
+from ozone_tpu.tools.cli import main as cli_main
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cli")
+    meta = ScmOmDaemon(tmp / "om.db", block_size=8 * 4096,
+                       container_size=4 * 1024 * 1024,
+                       stale_after_s=1000.0, dead_after_s=2000.0)
+    meta.start()
+    dns = [
+        DatanodeDaemon(tmp / f"dn{i}", f"dn{i}", meta.address,
+                       heartbeat_interval_s=0.5)
+        for i in range(5)
+    ]
+    for d in dns:
+        d.start()
+    yield meta, dns
+    for d in dns:
+        d.stop()
+    meta.stop()
+
+
+def test_freon_ockg_and_read(cluster):
+    meta, dns = cluster
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    clients = DatanodeClientFactory()
+    oz = OzoneClient(GrpcOmClient(meta.address, clients=clients), clients)
+    rep = freon.ockg(oz, n_keys=12, size=5000, threads=3,
+                     replication="rs-3-2-4096", validate=False)
+    s = rep.summary()
+    assert s["ops"] == 12 and s["failures"] == 0
+    assert s["ops_per_s"] > 0
+    rep2 = freon.ockr(oz, 12, threads=3)
+    assert rep2.summary()["failures"] == 0
+
+
+def test_freon_rawcoder_matrix():
+    out = freon.rawcoder_bench(backends=["numpy"], schema="rs-3-2",
+                               cell=4096, batch=2, iters=1)
+    assert out[0]["backend"] == "numpy"
+    assert out[0]["encode_gib_s"] > 0
+
+
+def test_cli_sh_roundtrip(cluster, tmp_path, capsys):
+    meta, dns = cluster
+    om = meta.address
+    assert cli_main(["sh", "volume", "create", "/cliv", "--om", om]) == 0
+    assert cli_main([
+        "sh", "bucket", "create", "/cliv/b1", "--om", om,
+        "--replication", "rs-3-2-4096",
+    ]) == 0
+    src = tmp_path / "in.bin"
+    payload = bytes(np.random.default_rng(0).integers(0, 256, 20000, dtype=np.uint8))
+    src.write_bytes(payload)
+    assert cli_main(["sh", "key", "put", "/cliv/b1/k1", str(src), "--om", om]) == 0
+    dst = tmp_path / "out.bin"
+    assert cli_main(["sh", "key", "get", "/cliv/b1/k1", str(dst), "--om", om]) == 0
+    assert dst.read_bytes() == payload
+    capsys.readouterr()
+    assert cli_main(["sh", "key", "list", "/cliv/b1", "--om", om]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert [k["name"] for k in out] == ["k1"]
+
+
+def test_cli_admin_status(cluster, capsys):
+    meta, dns = cluster
+    assert cli_main(["admin", "datanode", "--om", meta.address]) == 0
+    nodes = json.loads(capsys.readouterr().out)
+    assert len(nodes) == 5
+    assert cli_main(["admin", "safemode", "--om", meta.address]) == 0
+    sm = json.loads(capsys.readouterr().out)
+    assert sm["safemode"] is False
