@@ -1,0 +1,136 @@
+"""Container balancer: move replicas from over- to under-utilized nodes.
+
+Mirror of the reference's ContainerBalancer (server-scm container/balancer/
+ContainerBalancer.java:42 + ContainerBalancerTask with FindSourceStrategy/
+FindTargetStrategy): nodes outside a utilization band around the cluster
+average become sources/targets; each iteration moves up to a configured
+amount of data by scheduling replicate+delete command pairs through the
+node command queues. Iteration state is queryable (StatefulService analog).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+
+from ozone_tpu.scm.container_manager import ContainerManager
+from ozone_tpu.scm.node_manager import NodeManager
+from ozone_tpu.scm.replication_manager import (
+    DeleteReplicaCommand,
+    ReplicateCommand,
+)
+from ozone_tpu.storage.ids import ContainerState
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class BalancerConfig:
+    threshold: float = 0.10  # +-10% band around average utilization
+    max_moves_per_iteration: int = 5
+    max_size_per_iteration: int = 10 * 1024**3
+
+
+@dataclass
+class Move:
+    container_id: int
+    replica_index: int
+    source: str
+    target: str
+    size: int
+
+
+@dataclass
+class BalancerStatus:
+    running: bool = False
+    iterations: int = 0
+    moves_scheduled: int = 0
+    bytes_scheduled: int = 0
+    last_iteration_moves: list[Move] = field(default_factory=list)
+
+
+class ContainerBalancer:
+    def __init__(
+        self,
+        containers: ContainerManager,
+        nodes: NodeManager,
+        config: BalancerConfig = BalancerConfig(),
+    ):
+        self.containers = containers
+        self.nodes = nodes
+        self.config = config
+        self.status = BalancerStatus()
+
+    def _utilization(self) -> dict[str, float]:
+        out = {}
+        for n in self.nodes.healthy_in_service():
+            out[n.dn_id] = (
+                n.used_bytes / n.capacity_bytes if n.capacity_bytes else 0.0
+            )
+        return out
+
+    def run_iteration(self) -> list[Move]:
+        """One balancing iteration: schedule up to max_moves moves."""
+        util = self._utilization()
+        if not util:
+            return []
+        avg = sum(util.values()) / len(util)
+        over = sorted(
+            (d for d, u in util.items() if u > avg + self.config.threshold),
+            key=lambda d: -util[d],
+        )
+        under = sorted(
+            (d for d, u in util.items() if u < avg - self.config.threshold),
+            key=lambda d: util[d],
+        )
+        moves: list[Move] = []
+        budget = self.config.max_size_per_iteration
+        for src in over:
+            if len(moves) >= self.config.max_moves_per_iteration or not under:
+                break
+            # candidate replicas on the source, largest containers first
+            cands = [
+                (c, c.replicas[src])
+                for c in self.containers.containers()
+                if src in c.replicas
+                and c.state in (ContainerState.CLOSED,
+                                ContainerState.QUASI_CLOSED)
+            ]
+            cands.sort(key=lambda t: -t[0].used_bytes)
+            for c, replica in cands:
+                if len(moves) >= self.config.max_moves_per_iteration:
+                    break
+                if c.used_bytes > budget:
+                    continue
+                target = next(
+                    (t for t in under if t not in c.replicas), None
+                )
+                if target is None:
+                    continue
+                moves.append(
+                    Move(c.id, replica.replica_index, src, target,
+                         c.used_bytes)
+                )
+                budget -= c.used_bytes
+                break  # one move per source per iteration, like the ref
+
+        for m in moves:
+            # move = copy to target, then delete from source once copied;
+            # delete is queued on the source after the target reports the
+            # replica (simplified: queue both, target executes copy first
+            # because commands deliver in heartbeat order)
+            self.nodes.queue_command(
+                m.target,
+                ReplicateCommand(m.container_id, source=m.source,
+                                 target=m.target,
+                                 replica_index=m.replica_index),
+            )
+            self.nodes.queue_command(
+                m.source, DeleteReplicaCommand(m.container_id,
+                                               m.replica_index)
+            )
+        self.status.iterations += 1
+        self.status.moves_scheduled += len(moves)
+        self.status.bytes_scheduled += sum(m.size for m in moves)
+        self.status.last_iteration_moves = moves
+        return moves

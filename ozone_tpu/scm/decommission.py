@@ -1,0 +1,85 @@
+"""Decommission / maintenance drain monitor.
+
+Mirror of the reference's NodeDecommissionManager.java:60 +
+DatanodeAdminMonitorImpl: a node entering DECOMMISSIONING stops receiving
+new allocations (placement only picks IN_SERVICE nodes), the replication
+manager re-protects its replicas (copying from the draining node where the
+replica is still live), and the monitor flips the node to DECOMMISSIONED
+once every container it held is fully replicated elsewhere.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ozone_tpu.scm.container_manager import ContainerManager
+from ozone_tpu.scm.node_manager import (
+    NodeManager,
+    NodeOperationalState,
+)
+from ozone_tpu.scm.pipeline import ReplicationType
+from ozone_tpu.scm.replication_manager import ECReplicaCount, ReplicationManager
+
+log = logging.getLogger(__name__)
+
+
+class DecommissionMonitor:
+    def __init__(
+        self,
+        nodes: NodeManager,
+        containers: ContainerManager,
+        replication: ReplicationManager,
+    ):
+        self.nodes = nodes
+        self.containers = containers
+        self.replication = replication
+
+    def start_decommission(self, dn_id: str) -> None:
+        n = self.nodes.get(dn_id)
+        if n is None:
+            raise KeyError(dn_id)
+        self.nodes.set_op_state(dn_id, NodeOperationalState.DECOMMISSIONING)
+        log.info("decommission started for %s", dn_id)
+
+    def start_maintenance(self, dn_id: str) -> None:
+        self.nodes.set_op_state(dn_id, NodeOperationalState.IN_MAINTENANCE)
+
+    def recommission(self, dn_id: str) -> None:
+        self.nodes.set_op_state(dn_id, NodeOperationalState.IN_SERVICE)
+
+    def _node_drained(self, dn_id: str) -> bool:
+        """All containers with a replica on dn_id are fully redundant
+        without it (the admin monitor's sufficientlyReplicated check)."""
+        for c in self.containers.containers():
+            if dn_id not in c.replicas:
+                continue
+            if c.replication.type is ReplicationType.EC:
+                count = ECReplicaCount(c, self.nodes)
+                if count.missing_indexes:
+                    return False
+            else:
+                live = [
+                    d
+                    for d in c.replicas
+                    if d != dn_id
+                    and (n := self.nodes.get(d)) is not None
+                    and n.op_state is NodeOperationalState.IN_SERVICE
+                ]
+                if len(live) < c.replication.factor:
+                    return False
+        return True
+
+    def run_once(self) -> list[str]:
+        """Check draining nodes; finalize the drained ones. Returns nodes
+        finalized this tick."""
+        done = []
+        for n in self.nodes.nodes():
+            if n.op_state is not NodeOperationalState.DECOMMISSIONING:
+                continue
+            if self._node_drained(n.dn_id):
+                self.nodes.set_op_state(
+                    n.dn_id, NodeOperationalState.DECOMMISSIONED
+                )
+                log.info("decommission of %s complete", n.dn_id)
+                done.append(n.dn_id)
+        return done

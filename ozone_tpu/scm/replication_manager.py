@@ -54,18 +54,27 @@ class HealthReport:
 
 class ECReplicaCount:
     """Per-replica-index accounting for one EC container
-    (ECContainerReplicaCount analog)."""
+    (ECContainerReplicaCount analog). Replicas on decommissioning/
+    maintenance nodes don't count toward redundancy but are remembered as
+    copy sources (the reference's decommission path replicates instead of
+    reconstructing, ECUnderReplicationHandler decommission branch)."""
 
     def __init__(self, container: ContainerInfo, nodes: NodeManager):
+        from ozone_tpu.scm.node_manager import NodeOperationalState
+
         self.container = container
         k = container.replication.ec.all_units
         self.expected = set(range(1, k + 1))
         self.present: dict[int, list[str]] = {}
+        self.draining: dict[int, str] = {}  # index -> decommissioning holder
         for dn_id, r in container.replicas.items():
             n = nodes.get(dn_id)
             if n is None or n.state is NodeState.DEAD:
                 continue
             if r.state in ("UNHEALTHY", "DELETED", "INVALID"):
+                continue
+            if n.op_state is not NodeOperationalState.IN_SERVICE:
+                self.draining.setdefault(r.replica_index, dn_id)
                 continue
             self.present.setdefault(r.replica_index, []).append(dn_id)
 
@@ -82,7 +91,7 @@ class ECReplicaCount:
     @property
     def recoverable(self) -> bool:
         k = self.container.replication.ec.data_units
-        return len(self.present) >= k
+        return len(set(self.present) | set(self.draining)) >= k
 
 
 class ReplicationManager:
@@ -132,7 +141,27 @@ class ReplicationManager:
             return
         if missing:
             report.under_replicated.append(c.id)
-            self._emit_reconstruction(c, count, missing)
+            # indexes still held by draining nodes: plain copy, not decode
+            copyable = [i for i in missing if i in count.draining]
+            rebuild = [i for i in missing if i not in count.draining]
+            for i in copyable:
+                src = count.draining[i]
+                exclude = [
+                    dn for dns in count.present.values() for dn in dns
+                ] + [src]
+                try:
+                    target = self.placement.choose(1, exclude)[0]
+                except PlacementError as e:
+                    log.warning("no copy target for %s idx %s: %s", c.id, i, e)
+                    continue
+                self.nodes.queue_command(
+                    target.dn_id,
+                    ReplicateCommand(c.id, source=src, target=target.dn_id,
+                                     replica_index=i),
+                )
+                self._pending.add((c.id, i))
+            if rebuild:
+                self._emit_reconstruction(c, count, rebuild)
         for idx, extra_dns in count.excess_indexes.items():
             report.over_replicated.append(c.id)
             for dn in extra_dns:

@@ -52,6 +52,14 @@ class StorageContainerManager:
         self.replication = ReplicationManager(
             self.containers, self.nodes, self.placement
         )
+        from ozone_tpu.scm.balancer import ContainerBalancer
+        from ozone_tpu.scm.decommission import DecommissionMonitor
+
+        self.balancer = ContainerBalancer(self.containers, self.nodes)
+        self.balancer_enabled = False
+        self.decommission_monitor = DecommissionMonitor(
+            self.nodes, self.containers, self.replication
+        )
         self.metrics = MetricsRegistry("scm")
         self.events.subscribe(nm.DEAD_NODE, self._on_dead_node)
         self._bg: Optional[threading.Thread] = None
@@ -105,22 +113,21 @@ class StorageContainerManager:
 
     # ------------------------------------------------------------- admin ops
     def decommission(self, dn_id: str) -> None:
-        """Start draining a node (NodeDecommissionManager.java:60): take it
-        out of placement and let the replication manager re-protect its
-        containers."""
-        self.nodes.set_op_state(dn_id, NodeOperationalState.DECOMMISSIONING)
-        # treat its replicas as gone for redundancy purposes on next scan
-
-    def finish_decommission(self, dn_id: str) -> None:
-        self.nodes.set_op_state(dn_id, NodeOperationalState.DECOMMISSIONED)
-        self.containers.remove_replicas_of_node(dn_id)
+        """Start draining a node (NodeDecommissionManager.java:60): out of
+        placement; the replication manager re-protects its containers and
+        the monitor finalizes once drained."""
+        self.decommission_monitor.start_decommission(dn_id)
 
     # ------------------------------------------------------------- background
     def run_background_once(self) -> None:
-        """One tick of the SCM control loops (liveness + replication)."""
+        """One tick of the SCM control loops (liveness + replication +
+        decommission + balancer)."""
         self.nodes.check_liveness()
         if not self.safemode.in_safemode():
             self.replication.run_once()
+            self.decommission_monitor.run_once()
+            if self.balancer_enabled:
+                self.balancer.run_iteration()
 
     def start_background(self, interval_s: float = 1.0) -> None:
         def loop():

@@ -1,0 +1,101 @@
+"""Per-service HTTP endpoint: prometheus metrics, status, config, insight.
+
+Capability mirror of the reference's BaseHttpServer + PrometheusMetricsSink
+(hadoop-hdds/framework hdds/server/http/ — on-by-default /prom endpoint,
+docs Observability.md:32), with the `ozone insight`-style introspection
+endpoints (/metrics JSON snapshot, /conf, /logs level control;
+hadoop-ozone/insight exposes the same triple per component).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ozone_tpu.utils import metrics as metrics_mod
+
+log = logging.getLogger(__name__)
+
+
+class ServiceHttpServer:
+    def __init__(self, service_name: str, host: str = "127.0.0.1",
+                 port: int = 0,
+                 status_provider: Optional[Callable[[], dict]] = None,
+                 config_provider: Optional[Callable[[], dict]] = None):
+        self.service_name = service_name
+        self.status_provider = status_provider or (lambda: {})
+        self.config_provider = config_provider or (lambda: {})
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet
+                log.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, body: str,
+                      ctype: str = "application/json") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                if u.path == "/prom":
+                    self._send(200, metrics_mod.prometheus_text(),
+                               "text/plain; version=0.0.4")
+                elif u.path == "/metrics":
+                    snap = {
+                        name: reg.snapshot()
+                        for name, reg in metrics_mod._all_registries.items()
+                    }
+                    self._send(200, json.dumps(snap, indent=2))
+                elif u.path == "/status":
+                    self._send(200, json.dumps(outer.status_provider(),
+                                               indent=2, default=str))
+                elif u.path == "/conf":
+                    self._send(200, json.dumps(outer.config_provider(),
+                                               indent=2, default=str))
+                elif u.path == "/logLevel":
+                    q = parse_qs(u.query)
+                    name = q.get("log", [""])[0]
+                    level = q.get("level", [""])[0]
+                    if name and level:
+                        logging.getLogger(name).setLevel(level.upper())
+                        self._send(200, json.dumps({"log": name,
+                                                    "level": level}))
+                    else:
+                        self._send(400, json.dumps(
+                            {"error": "need ?log=<name>&level=<level>"}))
+                else:
+                    self._send(404, json.dumps({"error": "not found",
+                                                "endpoints": [
+                                                    "/prom", "/metrics",
+                                                    "/status", "/conf",
+                                                    "/logLevel"]}))
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"http-{self.service_name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,173 @@
+"""SCM service-layer tests: config system, http endpoints, balancer,
+decommission drain, replication-manager accounting."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ozone_tpu.scm.balancer import BalancerConfig, ContainerBalancer
+from ozone_tpu.scm.container_manager import ContainerManager
+from ozone_tpu.scm.decommission import DecommissionMonitor
+from ozone_tpu.scm.node_manager import NodeManager, NodeOperationalState
+from ozone_tpu.scm.placement import RackScatterPlacement
+from ozone_tpu.scm.pipeline import ReplicationConfig
+from ozone_tpu.scm.replication_manager import (
+    ECReplicaCount,
+    ReplicateCommand,
+    ReplicationManager,
+)
+from ozone_tpu.codec.api import CoderOptions
+from ozone_tpu.storage.ids import ContainerState
+from ozone_tpu.utils.config import (
+    ALL_GROUPS,
+    ClientConfig,
+    OzoneConfiguration,
+    ScmConfig,
+    generate_defaults,
+    parse_duration,
+    parse_size,
+)
+
+
+# ------------------------------------------------------------------ config
+def test_parse_size_and_duration():
+    assert parse_size("64MB") == 64 * 1024**2
+    assert parse_size("16kb") == 16 * 1024
+    assert parse_size("1GiB") == 1024**3
+    assert parse_size(4096) == 4096
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("5m") == 300.0
+    assert parse_duration("100ms") == 0.1
+
+
+def test_config_layering(tmp_path, monkeypatch):
+    f = tmp_path / "conf.json"
+    f.write_text(json.dumps({"client.bytes.per.checksum": "8kb",
+                             "scm.container.size": "1GB"}))
+    conf = OzoneConfiguration(f)
+    cc = conf.get_object(ClientConfig)
+    assert cc.bytes_per_checksum == 8 * 1024
+    assert cc.checksum_type == "CRC32C"  # default
+    monkeypatch.setenv("OZONE_TPU_CLIENT_BYTES_PER_CHECKSUM", "4096")
+    cc2 = conf.get_object(ClientConfig)
+    assert cc2.bytes_per_checksum == 4096  # env wins over file
+    conf.set("client.bytes.per.checksum", "2048")
+    assert conf.get_object(ClientConfig).bytes_per_checksum == 2048
+    sc = conf.get_object(ScmConfig)
+    assert sc.container_size == 1024**3
+
+
+def test_generate_defaults_documented():
+    text = generate_defaults(ALL_GROUPS)
+    assert "client.bytes.per.checksum" in text
+    assert "scm.container.size" in text
+    # tail is valid json
+    body = text[text.index("{"):]
+    assert json.loads(body)["om.block.size"] == 16 * 1024 * 1024
+
+
+# ------------------------------------------------------------------ http
+def test_http_endpoints():
+    from ozone_tpu.utils.http_server import ServiceHttpServer
+    from ozone_tpu.utils.metrics import MetricsRegistry
+
+    reg = MetricsRegistry("test.http")
+    reg.counter("hits").inc(3)
+    srv = ServiceHttpServer("test", status_provider=lambda: {"ok": True},
+                            config_provider=lambda: {"a": 1})
+    srv.start()
+    try:
+        base = f"http://{srv.address}"
+        prom = urllib.request.urlopen(base + "/prom").read().decode()
+        assert "test_http_hits 3" in prom
+        status = json.loads(urllib.request.urlopen(base + "/status").read())
+        assert status == {"ok": True}
+        conf = json.loads(urllib.request.urlopen(base + "/conf").read())
+        assert conf == {"a": 1}
+        lvl = json.loads(
+            urllib.request.urlopen(
+                base + "/logLevel?log=test.logger&level=DEBUG"
+            ).read()
+        )
+        assert lvl["level"] == "DEBUG"
+        import logging
+
+        assert logging.getLogger("test.logger").level == logging.DEBUG
+    finally:
+        srv.stop()
+
+
+# ----------------------------------------------------------- scm machinery
+def _mini_scm(n=6, racks=1):
+    nodes = NodeManager()
+    for i in range(n):
+        nodes.register(f"dn{i}", rack=f"/r{i % racks}",
+                       capacity_bytes=1000)
+    placement = RackScatterPlacement(nodes, seed=7)
+    containers = ContainerManager(nodes, placement, container_size=10_000)
+    return nodes, placement, containers
+
+
+def test_balancer_moves_from_hot_to_cold():
+    nodes, placement, containers = _mini_scm(4)
+    repl = ReplicationConfig.ratis(1)
+    # one closed container on dn0; dn0 hot, dn3 cold
+    g = containers.allocate_block(repl, 100, excluded=["dn1", "dn2", "dn3"])
+    c = containers.get(g.container_id)
+    c.used_bytes = 500
+    c.state = ContainerState.CLOSED
+    c.replicas["dn0"] = __import__(
+        "ozone_tpu.scm.container_manager", fromlist=["ContainerReplica"]
+    ).ContainerReplica("dn0", "CLOSED", 0)
+    nodes.get("dn0").used_bytes = 900
+    for d in ("dn1", "dn2"):
+        nodes.get(d).used_bytes = 500
+    nodes.get("dn3").used_bytes = 50
+
+    bal = ContainerBalancer(containers, nodes,
+                            BalancerConfig(threshold=0.1))
+    moves = bal.run_iteration()
+    assert len(moves) == 1
+    assert moves[0].source == "dn0" and moves[0].target == "dn3"
+    assert nodes.pending_commands("dn3") == 1  # replicate
+    assert nodes.pending_commands("dn0") == 1  # delete
+
+
+def test_decommission_drain_flow():
+    nodes, placement, containers = _mini_scm(6)
+    rm = ReplicationManager(containers, nodes, placement)
+    mon = DecommissionMonitor(nodes, containers, rm)
+    ec = CoderOptions(3, 2, "rs", 4096)
+    repl = ReplicationConfig.from_ec(ec)
+    g = containers.allocate_block(repl, 100)
+    c = containers.get(g.container_id)
+    c.state = ContainerState.CLOSED
+    from ozone_tpu.scm.container_manager import ContainerReplica
+
+    for i, dn in enumerate(g.pipeline.nodes):
+        c.replicas[dn] = ContainerReplica(dn, "CLOSED", i + 1)
+
+    victim = g.pipeline.nodes[0]
+    mon.start_decommission(victim)
+    assert nodes.get(victim).op_state is NodeOperationalState.DECOMMISSIONING
+    # replica still on the draining node -> copy command, not reconstruction
+    rep = rm.run_once()
+    assert c.id in rep.under_replicated
+    count = ECReplicaCount(c, nodes)
+    assert 1 in count.draining and 1 in count.missing_indexes
+    # some spare node got a ReplicateCommand with the draining source
+    cmds = [
+        cmd
+        for dn in [n.dn_id for n in nodes.nodes()]
+        for cmd in nodes._commands.get(dn, [])
+    ]
+    reps = [c2 for c2 in cmds if isinstance(c2, ReplicateCommand)]
+    assert len(reps) == 1 and reps[0].source == victim
+    # not drained yet
+    assert mon.run_once() == []
+    # simulate the copy landing on the target
+    c.replicas[reps[0].target] = ContainerReplica(reps[0].target, "CLOSED", 1)
+    assert mon.run_once() == [victim]
+    assert nodes.get(victim).op_state is NodeOperationalState.DECOMMISSIONED
