@@ -1,0 +1,185 @@
+"""Hadoop-compatible filesystem adapter (o3fs analog).
+
+Mirror of the reference's ozonefs adapters (hadoop-ozone/ozonefs-common
+BasicOzoneFileSystem.java:99 — one bucket exposed as a filesystem rooted
+at o3fs://bucket.volume/): path semantics over the flat key namespace with
+directory markers (zero-byte keys ending in "/"), streaming open/create
+handles, rename, recursive delete and listing — the operations Hadoop/
+Spark-style consumers require (create, open, getFileStatus, listStatus,
+mkdirs, rename, delete).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ozone_tpu.client.ozone_client import OzoneBucket
+from ozone_tpu.om.requests import OMError
+
+
+@dataclass
+class FileStatus:
+    path: str
+    is_dir: bool
+    length: int
+    modification_time: float
+
+
+class OzoneFile:
+    """Read handle with pread/seek (BasicOzoneClientAdapterImpl read side)."""
+
+    def __init__(self, data: np.ndarray):
+        self._data = data
+        self._pos = 0
+
+    def read(self, n: int = -1) -> bytes:
+        if n < 0:
+            n = self._data.size - self._pos
+        out = self._data[self._pos : self._pos + n].tobytes()
+        self._pos += len(out)
+        return out
+
+    def seek(self, pos: int) -> None:
+        if not 0 <= pos <= self._data.size:
+            raise ValueError("seek out of range")
+        self._pos = pos
+
+    def tell(self) -> int:
+        return self._pos
+
+    def close(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        pass
+
+
+class OzoneFileSystem:
+    """One bucket as a filesystem."""
+
+    def __init__(self, bucket: OzoneBucket):
+        self.bucket = bucket
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _norm(path: str) -> str:
+        p = "/".join(s for s in path.split("/") if s)
+        return p
+
+    def _dir_marker(self, path: str) -> str:
+        return self._norm(path) + "/"
+
+    # ------------------------------------------------------------- ops
+    def create(self, path: str, data, overwrite: bool = True) -> None:
+        key = self._norm(path)
+        if not overwrite and self.exists(path):
+            raise FileExistsError(path)
+        # implicit parent dirs (FSO would materialize a tree; OBS flat
+        # layout uses markers)
+        parts = key.split("/")[:-1]
+        for i in range(1, len(parts) + 1):
+            self.mkdirs("/".join(parts[:i]))
+        self.bucket.write_key(key, np.asarray(
+            np.frombuffer(data, np.uint8)
+            if isinstance(data, (bytes, bytearray)) else data, dtype=np.uint8))
+
+    def open(self, path: str) -> OzoneFile:
+        return OzoneFile(self.bucket.read_key(self._norm(path)))
+
+    def mkdirs(self, path: str) -> None:
+        marker = self._dir_marker(path)
+        try:
+            self.bucket.client.om.lookup_key(
+                self.bucket.volume, self.bucket.name, marker
+            )
+        except OMError:
+            self.bucket.write_key(marker, np.zeros(0, np.uint8))
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.get_file_status(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def get_file_status(self, path: str) -> FileStatus:
+        key = self._norm(path)
+        om = self.bucket.client.om
+        if key == "":
+            return FileStatus("/", True, 0, 0.0)
+        try:
+            info = om.lookup_key(self.bucket.volume, self.bucket.name, key)
+            return FileStatus(key, False, info["size"],
+                              info.get("modified", 0.0))
+        except OMError:
+            pass
+        try:
+            info = om.lookup_key(
+                self.bucket.volume, self.bucket.name, key + "/"
+            )
+            return FileStatus(key, True, 0, info.get("modified", 0.0))
+        except OMError:
+            # implicit directory: any key under the prefix
+            if om.list_keys(self.bucket.volume, self.bucket.name, key + "/"):
+                return FileStatus(key, True, 0, 0.0)
+        raise FileNotFoundError(path)
+
+    def list_status(self, path: str) -> list[FileStatus]:
+        base = self._norm(path)
+        prefix = base + "/" if base else ""
+        st = self.get_file_status(path)
+        if not st.is_dir:
+            return [st]
+        om = self.bucket.client.om
+        keys = om.list_keys(self.bucket.volume, self.bucket.name, prefix)
+        out: dict[str, FileStatus] = {}
+        for k in keys:
+            rest = k["name"][len(prefix):]
+            if not rest:
+                continue  # the marker itself
+            head = rest.split("/")[0]
+            child = prefix + head
+            if "/" in rest.rstrip("/") or rest.endswith("/"):
+                out.setdefault(child, FileStatus(child, True, 0, 0.0))
+            else:
+                out[child] = FileStatus(
+                    child, False, k["size"], k.get("modified", 0.0)
+                )
+        return sorted(out.values(), key=lambda s: s.path)
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        st = self.get_file_status(path)
+        om = self.bucket.client.om
+        if st.is_dir:
+            children = self.list_status(path)
+            if children and not recursive:
+                raise OSError(f"directory {path} not empty")
+            prefix = self._norm(path) + "/"
+            for k in om.list_keys(self.bucket.volume, self.bucket.name, prefix):
+                self.bucket.delete_key(k["name"])
+            try:
+                self.bucket.delete_key(prefix)
+            except OMError:
+                pass
+        else:
+            self.bucket.delete_key(self._norm(path))
+        return True
+
+    def rename(self, src: str, dst: str) -> None:
+        st = self.get_file_status(src)
+        s, d = self._norm(src), self._norm(dst)
+        om = self.bucket.client.om
+        if st.is_dir:
+            prefix = s + "/"
+            for k in om.list_keys(self.bucket.volume, self.bucket.name, prefix):
+                new = d + "/" + k["name"][len(prefix):]
+                self.bucket.rename_key(k["name"], new)
+        else:
+            self.bucket.rename_key(s, d)

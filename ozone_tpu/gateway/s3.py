@@ -1,0 +1,306 @@
+"""S3-compatible REST gateway.
+
+Mirror of the reference's s3gateway (hadoop-ozone/s3gateway: stateless
+JAX-RS endpoints — ObjectEndpoint.java:147 put:217/get:395 with range
+reads and multipart upload, BucketEndpoint list/multi-delete, Gateway.java
+main): a stateless HTTP translator in front of the object store client.
+Buckets live in the designated "s3v" volume like the reference's S3
+volume mapping. Multipart uploads store parts as hidden keys and stitch
+them on complete (the reference tracks parts in OM's multipartInfo table).
+
+Auth: requests are accepted without signature validation (the reference
+forwards AWS V4 signatures to the OM for validation — hook point kept in
+_authenticate), suitable for the in-framework gateway; the wire protocol
+(paths, query verbs, XML bodies, ETags) follows S3.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import uuid
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, unquote, urlparse
+
+import numpy as np
+
+from ozone_tpu.client.ozone_client import OzoneClient
+from ozone_tpu.om.requests import OMError
+
+log = logging.getLogger(__name__)
+
+S3_VOLUME = "s3v"
+_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _xml(root: ET.Element) -> bytes:
+    return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
+
+
+def _err(code: str, message: str, status: int) -> tuple[int, bytes]:
+    e = ET.Element("Error")
+    ET.SubElement(e, "Code").text = code
+    ET.SubElement(e, "Message").text = message
+    return status, _xml(e)
+
+
+class S3Gateway:
+    def __init__(self, client: OzoneClient, host: str = "127.0.0.1",
+                 port: int = 0, replication: str = "rs-6-3-1024k"):
+        self.client = client
+        self.replication = replication
+        try:
+            client.om.create_volume(S3_VOLUME)
+        except OMError:
+            pass
+        # in-flight multipart uploads: uploadId -> {bucket, key, parts{n: (etag, hidden_key)}}
+        self._mpu: dict[str, dict] = {}
+        self._mpu_lock = threading.Lock()
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("s3: " + fmt, *args)
+
+            def _reply(self, status: int, body: bytes = b"",
+                       headers: Optional[dict] = None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            def do_GET(self):
+                gateway._route(self, "GET")
+
+            def do_PUT(self):
+                gateway._route(self, "PUT")
+
+            def do_POST(self):
+                gateway._route(self, "POST")
+
+            def do_DELETE(self):
+                gateway._route(self, "DELETE")
+
+            def do_HEAD(self):
+                gateway._route(self, "HEAD")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- lifecycle
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="s3-gateway", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ------------------------------------------------------------- routing
+    def _authenticate(self, handler) -> bool:
+        """Signature validation hook (reference: S3 V4 auth forwarded to OM
+        via the S3Auth header, s3gateway AuthorizationFilter)."""
+        return True
+
+    def _route(self, h, method: str) -> None:
+        if not self._authenticate(h):
+            h._reply(*_err("AccessDenied", "access denied", 403))
+            return
+        u = urlparse(h.path)
+        q = parse_qs(u.query, keep_blank_values=True)
+        parts = [unquote(p) for p in u.path.strip("/").split("/") if p]
+        try:
+            if not parts:
+                self._list_buckets(h)
+                return
+            bucket, key = parts[0], "/".join(parts[1:])
+            if not key:
+                self._bucket_op(h, method, bucket, q)
+            else:
+                self._object_op(h, method, bucket, key, q)
+        except OMError as e:
+            code = {
+                "KEY_NOT_FOUND": ("NoSuchKey", 404),
+                "BUCKET_NOT_FOUND": ("NoSuchBucket", 404),
+                "BUCKET_ALREADY_EXISTS": ("BucketAlreadyExists", 409),
+                "BUCKET_NOT_EMPTY": ("BucketNotEmpty", 409),
+            }.get(e.code, ("InternalError", 500))
+            h._reply(*_err(code[0], str(e), code[1]))
+        except Exception as e:  # noqa: BLE001
+            log.exception("s3 %s %s failed", method, h.path)
+            h._reply(*_err("InternalError", str(e), 500))
+
+    # ------------------------------------------------------------- buckets
+    def _list_buckets(self, h) -> None:
+        root = ET.Element("ListAllMyBucketsResult", xmlns=_NS)
+        buckets = ET.SubElement(root, "Buckets")
+        for b in self.client.om.list_buckets(S3_VOLUME):
+            be = ET.SubElement(buckets, "Bucket")
+            ET.SubElement(be, "Name").text = b["name"]
+            ET.SubElement(be, "CreationDate").text = str(b.get("created", ""))
+        h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _bucket_op(self, h, method: str, bucket: str, q) -> None:
+        om = self.client.om
+        if method == "PUT":
+            try:
+                om.create_bucket(S3_VOLUME, bucket, self.replication)
+            except OMError as e:
+                # S3 returns success when the same owner re-creates a bucket
+                if e.code != "BUCKET_ALREADY_EXISTS":
+                    raise
+            h._reply(200, headers={"Location": f"/{bucket}"})
+        elif method == "DELETE":
+            om.delete_bucket(S3_VOLUME, bucket)
+            h._reply(204)
+        elif method in ("GET",):
+            prefix = q.get("prefix", [""])[0]
+            keys = om.list_keys(S3_VOLUME, bucket, prefix)
+            root = ET.Element("ListBucketResult", xmlns=_NS)
+            ET.SubElement(root, "Name").text = bucket
+            ET.SubElement(root, "Prefix").text = prefix
+            ET.SubElement(root, "KeyCount").text = str(len(keys))
+            ET.SubElement(root, "IsTruncated").text = "false"
+            for k in keys:
+                c = ET.SubElement(root, "Contents")
+                ET.SubElement(c, "Key").text = k["name"]
+                ET.SubElement(c, "Size").text = str(k["size"])
+                ET.SubElement(c, "LastModified").text = str(k.get("modified", ""))
+            h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+        elif method == "HEAD":
+            om.bucket_info(S3_VOLUME, bucket)
+            h._reply(200)
+        else:
+            h._reply(*_err("MethodNotAllowed", method, 405))
+
+    # ------------------------------------------------------------- objects
+    def _bucket_handle(self, bucket: str):
+        return self.client.get_volume(S3_VOLUME).get_bucket(bucket)
+
+    def _object_op(self, h, method: str, bucket: str, key: str, q) -> None:
+        if method == "POST" and "uploads" in q:
+            self._mpu_initiate(h, bucket, key)
+        elif method == "PUT" and "uploadId" in q:
+            self._mpu_part(h, bucket, key, q)
+        elif method == "POST" and "uploadId" in q:
+            self._mpu_complete(h, bucket, key, q)
+        elif method == "PUT":
+            self._put_object(h, bucket, key)
+        elif method == "GET":
+            self._get_object(h, bucket, key)
+        elif method == "HEAD":
+            self._head_object(h, bucket, key)
+        elif method == "DELETE":
+            self._bucket_handle(bucket).delete_key(key)
+            h._reply(204)
+        else:
+            h._reply(*_err("MethodNotAllowed", method, 405))
+
+    def _put_object(self, h, bucket: str, key: str) -> None:
+        body = h._body()
+        self._bucket_handle(bucket).write_key(
+            key, np.frombuffer(body, np.uint8)
+        )
+        etag = hashlib.md5(body).hexdigest()
+        h._reply(200, headers={"ETag": f'"{etag}"'})
+
+    def _get_object(self, h, bucket: str, key: str) -> None:
+        data = self._bucket_handle(bucket).read_key(key).tobytes()
+        rng = h.headers.get("Range")
+        if rng and rng.startswith("bytes="):
+            lo_s, _, hi_s = rng[6:].partition("-")
+            lo = int(lo_s) if lo_s else 0
+            hi = int(hi_s) if hi_s else len(data) - 1
+            part = data[lo : hi + 1]
+            h._reply(
+                206,
+                part,
+                {
+                    "Content-Type": "application/octet-stream",
+                    "Content-Range": f"bytes {lo}-{hi}/{len(data)}",
+                },
+            )
+        else:
+            h._reply(200, data,
+                     {"Content-Type": "application/octet-stream"})
+
+    def _head_object(self, h, bucket: str, key: str) -> None:
+        info = self.client.om.lookup_key(S3_VOLUME, bucket, key)
+        h._reply(200, headers={"Content-Length-Info": str(info["size"]),
+                               "Content-Type": "application/octet-stream"})
+
+    # ------------------------------------------------------------- multipart
+    def _mpu_initiate(self, h, bucket: str, key: str) -> None:
+        upload_id = uuid.uuid4().hex
+        with self._mpu_lock:
+            self._mpu[upload_id] = {"bucket": bucket, "key": key, "parts": {}}
+        root = ET.Element("InitiateMultipartUploadResult", xmlns=_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = upload_id
+        h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _mpu_part(self, h, bucket: str, key: str, q) -> None:
+        upload_id = q["uploadId"][0]
+        part_no = int(q.get("partNumber", ["1"])[0])
+        with self._mpu_lock:
+            mpu = self._mpu.get(upload_id)
+        if mpu is None:
+            h._reply(*_err("NoSuchUpload", upload_id, 404))
+            return
+        body = h._body()
+        hidden = f".mpu/{upload_id}/{part_no:05d}"
+        self._bucket_handle(bucket).write_key(
+            hidden, np.frombuffer(body, np.uint8)
+        )
+        etag = hashlib.md5(body).hexdigest()
+        with self._mpu_lock:
+            mpu["parts"][part_no] = (etag, hidden)
+        h._reply(200, headers={"ETag": f'"{etag}"'})
+
+    def _mpu_complete(self, h, bucket: str, key: str, q) -> None:
+        upload_id = q["uploadId"][0]
+        with self._mpu_lock:
+            mpu = self._mpu.pop(upload_id, None)
+        if mpu is None:
+            h._reply(*_err("NoSuchUpload", upload_id, 404))
+            return
+        b = self._bucket_handle(bucket)
+        etags = []
+        with b.open_key(key) as out:
+            for n in sorted(mpu["parts"]):
+                etag, hidden = mpu["parts"][n]
+                etags.append(etag)
+                out.write(b.read_key(hidden))
+        for n in sorted(mpu["parts"]):
+            b.delete_key(mpu["parts"][n][1])
+        final_etag = (
+            hashlib.md5("".join(etags).encode()).hexdigest()
+            + f"-{len(etags)}"
+        )
+        root = ET.Element("CompleteMultipartUploadResult", xmlns=_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "ETag").text = f'"{final_etag}"'
+        h._reply(200, _xml(root), {"Content-Type": "application/xml"})

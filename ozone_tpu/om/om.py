@@ -187,6 +187,7 @@ class OzoneManager:
         return out
 
     def list_keys(self, volume: str, bucket: str, prefix: str = "") -> list[dict]:
+        self.bucket_info(volume, bucket)  # raises BUCKET_NOT_FOUND
         base = bucket_key(volume, bucket) + "/"
         return [k for _, k in self.store.iterate("keys", base + prefix)]
 

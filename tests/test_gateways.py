@@ -1,0 +1,130 @@
+"""S3 gateway + filesystem adapter tests over a MiniOzoneCluster."""
+
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+
+import numpy as np
+import pytest
+
+from ozone_tpu.gateway.fs import OzoneFileSystem
+from ozone_tpu.gateway.s3 import S3Gateway
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = MiniOzoneCluster(
+        tmp_path_factory.mktemp("gw"),
+        num_datanodes=5,
+        block_size=8 * 4096,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+@pytest.fixture(scope="module")
+def s3(cluster):
+    gw = S3Gateway(cluster.client(), replication=EC)
+    gw.start()
+    yield gw
+    gw.stop()
+
+
+def _req(gw, method, path, data=None, headers=None):
+    req = urllib.request.Request(
+        f"http://{gw.address}{path}", data=data, method=method,
+        headers=headers or {},
+    )
+    return urllib.request.urlopen(req)
+
+
+def test_s3_bucket_lifecycle(s3):
+    r = _req(s3, "PUT", "/b1")
+    assert r.status == 200
+    r = _req(s3, "GET", "/")
+    tree = ET.fromstring(r.read())
+    names = [e.text for e in tree.iter() if e.tag.endswith("Name")]
+    assert "b1" in names
+
+
+def test_s3_object_put_get_range_delete(s3):
+    payload = bytes(np.random.default_rng(0).integers(0, 256, 30000,
+                                                      dtype=np.uint8))
+    _req(s3, "PUT", "/b1")
+    r = _req(s3, "PUT", "/b1/dir/obj1", data=payload)
+    assert r.status == 200 and r.headers["ETag"]
+    r = _req(s3, "GET", "/b1/dir/obj1")
+    assert r.read() == payload
+    r = _req(s3, "GET", "/b1/dir/obj1", headers={"Range": "bytes=100-199"})
+    assert r.status == 206
+    assert r.read() == payload[100:200]
+    # list
+    r = _req(s3, "GET", "/b1?list-type=2&prefix=dir/")
+    tree = ET.fromstring(r.read())
+    keys = [e.text for e in tree.iter() if e.tag.endswith("Key")]
+    assert "dir/obj1" in keys
+    r = _req(s3, "DELETE", "/b1/dir/obj1")
+    assert r.status == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "GET", "/b1/dir/obj1")
+    assert ei.value.code == 404
+
+
+def test_s3_multipart_upload(s3):
+    _req(s3, "PUT", "/b1")
+    r = _req(s3, "POST", "/b1/big?uploads")
+    tree = ET.fromstring(r.read()); upload_id = next(e.text for e in tree.iter() if e.tag.endswith("UploadId"))
+    rng = np.random.default_rng(1)
+    parts = [bytes(rng.integers(0, 256, 9000, dtype=np.uint8))
+             for _ in range(3)]
+    for i, p in enumerate(parts, start=1):
+        r = _req(s3, "PUT",
+                 f"/b1/big?partNumber={i}&uploadId={upload_id}", data=p)
+        assert r.status == 200
+    r = _req(s3, "POST", f"/b1/big?uploadId={upload_id}", data=b"")
+    assert r.status == 200
+    got = _req(s3, "GET", "/b1/big").read()
+    assert got == b"".join(parts)
+    # hidden part keys cleaned up
+    r = _req(s3, "GET", "/b1?prefix=.mpu/")
+    assert b"<Key>" not in r.read()
+
+
+def test_s3_errors(s3):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "GET", "/nosuchbucket?list-type=2")
+    assert ei.value.code == 404
+
+
+def test_fs_adapter(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("fsvol").create_bucket("fsb", replication=EC)
+    fs = OzoneFileSystem(b)
+    rng = np.random.default_rng(2)
+    data = bytes(rng.integers(0, 256, 20000, dtype=np.uint8))
+    fs.create("/a/b/file1", data)
+    assert fs.exists("/a/b/file1")
+    assert fs.get_file_status("/a").is_dir
+    with fs.open("/a/b/file1") as f:
+        assert f.read(100) == data[:100]
+        f.seek(19000)
+        assert f.read() == data[19000:]
+    ls = fs.list_status("/a")
+    assert [s.path for s in ls] == ["a/b"]
+    ls = fs.list_status("/a/b")
+    assert [(s.path, s.is_dir) for s in ls] == [("a/b/file1", False)]
+    fs.rename("/a/b/file1", "/a/b/file2")
+    assert not fs.exists("/a/b/file1")
+    assert fs.open("/a/b/file2").read() == data
+    fs.mkdirs("/empty/dir")
+    assert fs.get_file_status("/empty/dir").is_dir
+    with pytest.raises(OSError):
+        fs.delete("/a", recursive=False)
+    fs.delete("/a", recursive=True)
+    assert not fs.exists("/a/b/file2")
