@@ -34,8 +34,15 @@ class _GenericHandler(grpc.GenericRpcHandler):
             return None
 
         def wrapped(request: bytes, context: grpc.ServicerContext) -> bytes:
+            from ozone_tpu.utils.tracing import Tracer
+
+            remote_ctx = dict(context.invocation_metadata()).get("x-trace-id")
             try:
-                return fn(request)
+                with Tracer.instance().span(
+                    f"server:{handler_call_details.method}",
+                    child_of=remote_ctx or None,
+                ):
+                    return fn(request)
             except StorageError as e:
                 context.abort(
                     grpc.StatusCode.ABORTED,
@@ -99,13 +106,19 @@ class RpcChannel:
 
     def call(self, service: str, method: str, request: bytes,
              timeout: Optional[float] = 30.0) -> bytes:
+        from ozone_tpu.utils.tracing import Tracer
+
         key = f"/{service}/{method}"
         fn = self._calls.get(key)
         if fn is None:
             fn = self._channel.unary_unary(key)
             self._calls[key] = fn
+        tracer = Tracer.instance()
         try:
-            return fn(request, timeout=timeout)
+            with tracer.span(f"client:{key}", address=self.address):
+                ctx = tracer.inject()
+                metadata = (("x-trace-id", ctx),) if ctx else None
+                return fn(request, timeout=timeout, metadata=metadata)
         except grpc.RpcError as e:
             detail = e.details() or ""
             try:

@@ -1,0 +1,193 @@
+"""Recon: cluster observability warehouse + REST API.
+
+Mirror of the reference's Recon service (hadoop-ozone/recon ReconServer:
+an OM-metadata follower feeding aggregation tasks — ContainerKeyMapperTask,
+FileSizeCountTask, NSSummaryTask — plus a passive SCM view detecting
+missing/under-replicated containers, exposed over REST for operators and
+the UI). Here: tasks run over a snapshot/tail of the OM store and the SCM
+object's live state, materializing
+
+  - namespace summary (volumes/buckets/keys, bytes)
+  - file-size histogram (FileSizeCountTask analog)
+  - container -> key mapping (ContainerKeyMapperTask analog)
+  - container health: missing / under- / over-replicated (fsck view)
+  - node utilization table
+
+served as JSON endpoints on the service HTTP server (/api/...).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Optional
+
+from ozone_tpu.om.om import OzoneManager
+from ozone_tpu.scm.pipeline import ReplicationType
+from ozone_tpu.scm.replication_manager import ECReplicaCount
+from ozone_tpu.scm.scm import StorageContainerManager
+from ozone_tpu.storage.ids import ContainerState
+
+
+class ReconTasks:
+    """Aggregation tasks over OM metadata (ReconOmTask pipeline analog)."""
+
+    def __init__(self, om: OzoneManager):
+        self.om = om
+
+    def namespace_summary(self) -> dict:
+        vols = self.om.list_volumes()
+        out = {"volumes": len(vols), "buckets": 0, "keys": 0, "bytes": 0,
+               "per_volume": {}}
+        for v in vols:
+            name = v["name"]
+            buckets = self.om.list_buckets(name)
+            vsum = {"buckets": len(buckets), "keys": 0, "bytes": 0}
+            for b in buckets:
+                keys = self.om.list_keys(name, b["name"])
+                vsum["keys"] += len(keys)
+                vsum["bytes"] += sum(k["size"] for k in keys)
+            out["buckets"] += vsum["buckets"]
+            out["keys"] += vsum["keys"]
+            out["bytes"] += vsum["bytes"]
+            out["per_volume"][name] = vsum
+        return out
+
+    def file_size_histogram(self) -> dict:
+        """Power-of-two size buckets (FileSizeCountTask analog)."""
+        buckets: dict[str, int] = {}
+        for v in self.om.list_volumes():
+            for b in self.om.list_buckets(v["name"]):
+                for k in self.om.list_keys(v["name"], b["name"]):
+                    size = max(1, k["size"])
+                    exp = int(math.ceil(math.log2(size)))
+                    label = f"<=2^{exp}"
+                    buckets[label] = buckets.get(label, 0) + 1
+        return dict(sorted(buckets.items(),
+                           key=lambda kv: int(kv[0].split("^")[1])))
+
+    def container_key_map(self) -> dict[int, list[str]]:
+        """container id -> keys with data in it (ContainerKeyMapperTask)."""
+        out: dict[int, list[str]] = {}
+        for v in self.om.list_volumes():
+            for b in self.om.list_buckets(v["name"]):
+                for k in self.om.list_keys(v["name"], b["name"]):
+                    path = f"/{v['name']}/{b['name']}/{k['name']}"
+                    for g in k.get("block_groups", []):
+                        out.setdefault(g["container_id"], []).append(path)
+        return out
+
+
+class ReconScmView:
+    """Passive SCM health view (ReconStorageContainerManagerFacade +
+    fsck/ container health task analog)."""
+
+    def __init__(self, scm: StorageContainerManager):
+        self.scm = scm
+
+    def container_health(self) -> dict:
+        missing, under, over, healthy = [], [], [], []
+        for c in self.scm.containers.containers():
+            if c.state in (ContainerState.DELETED, ContainerState.OPEN):
+                continue
+            if c.replication.type is ReplicationType.EC:
+                count = ECReplicaCount(c, self.scm.nodes)
+                if not count.recoverable:
+                    missing.append(c.id)
+                elif count.missing_indexes:
+                    under.append(c.id)
+                elif count.excess_indexes:
+                    over.append(c.id)
+                else:
+                    healthy.append(c.id)
+            else:
+                live = len(c.replicas)
+                if live == 0:
+                    missing.append(c.id)
+                elif live < c.replication.factor:
+                    under.append(c.id)
+                elif live > c.replication.factor:
+                    over.append(c.id)
+                else:
+                    healthy.append(c.id)
+        return {
+            "healthy": healthy,
+            "under_replicated": under,
+            "over_replicated": over,
+            "missing": missing,
+        }
+
+    def node_table(self) -> list[dict]:
+        return [
+            {
+                "dn_id": n.dn_id,
+                "rack": n.rack,
+                "state": n.state.value,
+                "op_state": n.op_state.value,
+                "capacity_bytes": n.capacity_bytes,
+                "used_bytes": n.used_bytes,
+                "utilization": (
+                    n.used_bytes / n.capacity_bytes if n.capacity_bytes else 0
+                ),
+            }
+            for n in self.scm.nodes.nodes()
+        ]
+
+
+class ReconServer:
+    """Recon REST API over the service HTTP server."""
+
+    def __init__(self, om: OzoneManager, scm: StorageContainerManager,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.tasks = ReconTasks(om)
+        self.scm_view = ReconScmView(scm)
+        from ozone_tpu.utils.http_server import ServiceHttpServer
+
+        self._base = ServiceHttpServer(
+            "recon", host, port, status_provider=self.api_summary
+        )
+        # extend the handler routing with /api endpoints
+        orig_handler = self._base._httpd.RequestHandlerClass
+        recon = self
+
+        class Handler(orig_handler):
+            def do_GET(self):
+                routes = {
+                    "/api/namespace": recon.tasks.namespace_summary,
+                    "/api/filesizes": recon.tasks.file_size_histogram,
+                    "/api/containers/keys": lambda: {
+                        str(k): v
+                        for k, v in recon.tasks.container_key_map().items()
+                    },
+                    "/api/containers/health": recon.scm_view.container_health,
+                    "/api/nodes": recon.scm_view.node_table,
+                    "/api/summary": recon.api_summary,
+                }
+                fn = routes.get(self.path.split("?")[0])
+                if fn is not None:
+                    self._send(200, json.dumps(fn(), indent=2, default=str))
+                else:
+                    super().do_GET()
+
+        self._base._httpd.RequestHandlerClass = Handler
+
+    def api_summary(self) -> dict:
+        health = self.scm_view.container_health()
+        return {
+            "ts": time.time(),
+            "namespace": self.tasks.namespace_summary(),
+            "containers": {k: len(v) for k, v in health.items()},
+            "nodes": self.scm_view.node_table(),
+        }
+
+    @property
+    def address(self) -> str:
+        return self._base.address
+
+    def start(self) -> None:
+        self._base.start()
+
+    def stop(self) -> None:
+        self._base.stop()

@@ -1,0 +1,109 @@
+"""Distributed tracing: spans with cross-RPC propagation.
+
+Capability mirror of the reference's tracing layer (hadoop-hdds/common
+hdds/tracing/TracingUtil.java — Jaeger spans with the trace context
+carried as a string `traceID` field on every proto request,
+DatanodeClientProtocol.proto:184; GrpcClientInterceptor/
+GrpcServerInterceptor propagate it). Here spans are collected in-process
+(ring buffer, queryable/exportable) and the context string rides the
+net/wire.py JSON header under "traceId"; the RPC layer injects/extracts
+automatically.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Optional
+
+_local = threading.local()
+
+
+@dataclass
+class Span:
+    trace_id: str
+    span_id: str
+    parent_id: str
+    name: str
+    start: float
+    duration: float = 0.0
+    tags: dict = field(default_factory=dict)
+
+
+class Tracer:
+    """Process-wide tracer with a bounded span buffer."""
+
+    _instance: Optional["Tracer"] = None
+
+    def __init__(self, max_spans: int = 10_000, sample_rate: float = 1.0):
+        self.spans: deque[Span] = deque(maxlen=max_spans)
+        self.sample_rate = sample_rate
+        self._lock = threading.Lock()
+
+    @classmethod
+    def instance(cls) -> "Tracer":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    @staticmethod
+    def _new_id() -> str:
+        return f"{random.getrandbits(64):016x}"
+
+    def current(self) -> Optional[Span]:
+        return getattr(_local, "span", None)
+
+    @contextmanager
+    def span(self, name: str, child_of: Optional[str] = None, **tags):
+        """Start a span; child_of is an imported context string
+        ("traceid:spanid") from a remote caller."""
+        parent = self.current()
+        if child_of:
+            trace_id, parent_id = (child_of.split(":") + [""])[:2]
+        elif parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            trace_id, parent_id = self._new_id(), ""
+        s = Span(trace_id, self._new_id(), parent_id, name, time.time(),
+                 tags=dict(tags))
+        prev = self.current()
+        _local.span = s
+        try:
+            yield s
+        finally:
+            s.duration = time.time() - s.start
+            _local.span = prev
+            if random.random() < self.sample_rate:
+                with self._lock:
+                    self.spans.append(s)
+
+    def inject(self) -> str:
+        """Export the current context for the wire ("traceID" field analog);
+        empty string when not tracing."""
+        s = self.current()
+        return f"{s.trace_id}:{s.span_id}" if s else ""
+
+    def traces(self, trace_id: Optional[str] = None) -> list[Span]:
+        with self._lock:
+            out = list(self.spans)
+        if trace_id:
+            out = [s for s in out if s.trace_id == trace_id]
+        return out
+
+    def export_json(self) -> list[dict]:
+        return [
+            {
+                "traceId": s.trace_id,
+                "spanId": s.span_id,
+                "parentId": s.parent_id,
+                "name": s.name,
+                "start": s.start,
+                "durationMs": round(s.duration * 1e3, 3),
+                "tags": s.tags,
+            }
+            for s in self.traces()
+        ]

