@@ -1,0 +1,193 @@
+"""OM high availability: replicated request log + failover client.
+
+Capability mirror of the reference's OM HA stack (ozone-manager om/ratis/:
+OzoneManagerRatisServer.submitRequest:108 ships post-preExecute requests
+through Raft; OzoneManagerStateMachine.applyTransaction:335 applies them
+deterministically on every replica against the metadata store; clients
+fail over between OMs via the OMFailoverProxyProvider).
+
+This implementation keeps the exact same request lifecycle — preExecute on
+the leader, serialized request through a durable ordered log, apply
+everywhere — with a single-leader synchronous-replication log instead of
+Raft elections (the reference's pluggable-consensus shape; SURVEY.md
+section 7 explicitly stages consensus this way). Followers are therefore
+warm, byte-identical replicas ready for promotion; failover is an explicit
+promote() (operator or orchestrator driven) rather than an election.
+
+The log is a durable JSONL WAL per replica with fsync-on-append and
+replay-on-restart from the last flushed transaction (the
+OzoneManagerDoubleBuffer + TransactionInfo recovery pattern).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from pathlib import Path
+from typing import Any, Optional
+
+from ozone_tpu.om import requests as rq
+from ozone_tpu.om.om import OzoneManager
+
+log = logging.getLogger(__name__)
+
+
+class RequestLog:
+    """Durable ordered request log (Raft-log stand-in)."""
+
+    def __init__(self, path: Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = open(self.path, "a+")
+        self._lock = threading.Lock()
+        self._index = sum(1 for _ in open(self.path))
+
+    @property
+    def index(self) -> int:
+        return self._index
+
+    def append(self, entry: dict) -> int:
+        with self._lock:
+            self._f.write(json.dumps(entry, separators=(",", ":")) + "\n")
+            self._f.flush()
+            import os
+
+            os.fsync(self._f.fileno())
+            self._index += 1
+            return self._index
+
+    def read_from(self, start: int = 0) -> list[dict]:
+        with self._lock:
+            self._f.flush()
+        out = []
+        with open(self.path) as f:
+            for i, line in enumerate(f):
+                if i >= start and line.strip():
+                    out.append(json.loads(line))
+        return out
+
+    def close(self) -> None:
+        self._f.close()
+
+
+class ReplicatedOzoneManager:
+    """One OM replica: leader accepts writes, followers apply the log."""
+
+    def __init__(self, om: OzoneManager, log_path: Path, om_id: str,
+                 is_leader: bool = False):
+        self.om = om
+        self.om_id = om_id
+        self.is_leader = is_leader
+        self.wal = RequestLog(log_path)
+        self.applied_index = 0
+        self.peers: list["ReplicatedOzoneManager"] = []
+        self._lock = threading.RLock()
+        self._recover()
+
+    # ------------------------------------------------------------- recovery
+    def _recover(self) -> None:
+        """Replay the local log onto the store (idempilot: requests that
+        already applied raise OMErrors which are ignored during replay —
+        the cache/DB state converges because applies are deterministic)."""
+        entries = self.wal.read_from(0)
+        for e in entries:
+            try:
+                rq.OMRequest.from_json(e["request"]).apply(self.om.store)
+            except rq.OMError:
+                pass
+            self.applied_index = e["index"]
+
+    # ------------------------------------------------------------- serving
+    def submit(self, request: rq.OMRequest) -> Any:
+        """Leader write path: preExecute -> log -> replicate -> apply."""
+        with self._lock:
+            if not self.is_leader:
+                raise NotLeaderError(self.om_id)
+            request.pre_execute(self.om)
+            entry = {
+                "index": self.wal.index + 1,
+                "request": request.to_json(),
+            }
+            self.wal.append(entry)
+            for peer in self.peers:
+                try:
+                    peer.replicate(entry)
+                except Exception:
+                    log.exception("replication to %s failed", peer.om_id)
+            result = request.apply(self.om.store)
+            self.applied_index = entry["index"]
+            return result
+
+    def replicate(self, entry: dict) -> None:
+        """Follower apply path (applyTransaction analog)."""
+        with self._lock:
+            if entry["index"] <= self.applied_index:
+                return  # duplicate
+            if entry["index"] != self.applied_index + 1:
+                self.catch_up()
+                if entry["index"] != self.applied_index + 1:
+                    raise ValueError(
+                        f"log gap: at {self.applied_index}, got "
+                        f"{entry['index']}"
+                    )
+            self.wal.append(entry)
+            try:
+                rq.OMRequest.from_json(entry["request"]).apply(self.om.store)
+            except rq.OMError as e:
+                # deterministic failures also happen on the leader; keep
+                # the index advancing
+                log.debug("follower apply error: %s", e)
+            self.applied_index = entry["index"]
+
+    def catch_up(self) -> None:
+        """Pull missing entries from the leader (follower bootstrap /
+        InterSCMGrpcProtocolService-style checkpoint+delta catch-up)."""
+        leader = next((p for p in self.peers if p.is_leader), None)
+        if leader is None:
+            return
+        for e in leader.wal.read_from(self.applied_index):
+            if e["index"] > self.applied_index:
+                self.wal.append(e)
+                try:
+                    rq.OMRequest.from_json(e["request"]).apply(self.om.store)
+                except rq.OMError:
+                    pass
+                self.applied_index = e["index"]
+
+    # ------------------------------------------------------------- failover
+    def promote(self) -> None:
+        """Make this replica the leader (after catching up)."""
+        self.catch_up()
+        for p in self.peers:
+            p.is_leader = False
+        self.is_leader = True
+        log.info("om %s promoted to leader at index %d", self.om_id,
+                 self.applied_index)
+
+
+class NotLeaderError(Exception):
+    pass
+
+
+class OMFailoverProxy:
+    """Client-side failover across OM replicas (OMFailoverProxyProvider
+    analog): tries the known leader first, rotates on NotLeaderError or
+    connection failure."""
+
+    def __init__(self, replicas: list[ReplicatedOzoneManager]):
+        self.replicas = replicas
+        self._leader_idx = 0
+
+    def submit(self, request: rq.OMRequest) -> Any:
+        last: Optional[Exception] = None
+        n = len(self.replicas)
+        for attempt in range(n):
+            idx = (self._leader_idx + attempt) % n
+            try:
+                result = self.replicas[idx].submit(request)
+                self._leader_idx = idx
+                return result
+            except (NotLeaderError, ConnectionError, OSError) as e:
+                last = e
+        raise RuntimeError(f"no OM leader reachable: {last}")

@@ -43,8 +43,15 @@ BUCKET_NOT_EMPTY = "BUCKET_NOT_EMPTY"
 KEY_NOT_FOUND = "KEY_NOT_FOUND"
 
 
+_REQUEST_TYPES: dict[str, type] = {}
+
+
 @dataclass
 class OMRequest:
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        _REQUEST_TYPES[cls.__name__] = cls
+
     def pre_execute(self, om: Any) -> None:  # noqa: D401
         """Leader-side phase; default no-op."""
 
@@ -54,6 +61,20 @@ class OMRequest:
     @property
     def audit_action(self) -> str:
         return type(self).__name__
+
+    def to_json(self) -> dict:
+        """Wire form for the replicated log (post-preExecute state, so
+        followers apply deterministically without re-running preExecute —
+        the OMClientRequest contract)."""
+        import dataclasses
+
+        return {"type": type(self).__name__, **dataclasses.asdict(self)}
+
+    @staticmethod
+    def from_json(d: dict) -> "OMRequest":
+        d = dict(d)
+        cls = _REQUEST_TYPES[d.pop("type")]
+        return cls(**d)
 
 
 @dataclass

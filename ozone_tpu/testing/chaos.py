@@ -1,0 +1,144 @@
+"""Chaos harness: random failure injection under load.
+
+Mirror of the reference's mini-chaos-tests (fault-injection-test
+OzoneChaosCluster + FailureManager: randomly restart/kill datanodes while
+load generators run invariant checks). The FailureManager here stops and
+restarts MiniOzoneCluster datanodes on a schedule while a load thread
+writes keys; the invariant is that every key whose commit succeeded is
+byte-exactly readable afterwards (EC tolerates p concurrent failures).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class ChaosResult:
+    keys_written: list[str] = field(default_factory=list)
+    write_failures: int = 0
+    kills: int = 0
+    restarts: int = 0
+    read_mismatches: list[str] = field(default_factory=list)
+    read_errors: list[str] = field(default_factory=list)
+
+
+class FailureManager:
+    """Randomly stops/restarts datanodes, keeping at most `max_down` down
+    (p for an EC cluster)."""
+
+    def __init__(self, cluster: MiniOzoneCluster, max_down: int = 1,
+                 seed: int = 0, interval_s: float = 0.3):
+        self.cluster = cluster
+        self.max_down = max_down
+        self.rng = random.Random(seed)
+        self.interval = interval_s
+        self.down: list[str] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.kills = 0
+        self.restarts = 0
+
+    def _tick(self) -> None:
+        if self.down and (len(self.down) >= self.max_down
+                          or self.rng.random() < 0.5):
+            dn = self.down.pop(self.rng.randrange(len(self.down)))
+            self.cluster.restart_datanode(dn)
+            self.restarts += 1
+        else:
+            alive = [
+                d.id
+                for d in self.cluster.datanodes
+                if d.id not in self.down
+            ]
+            if len(alive) > 1:
+                dn = self.rng.choice(alive)
+                self.cluster.stop_datanode(dn)
+                self.down.append(dn)
+                self.kills += 1
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self._tick()
+                except Exception:
+                    log.exception("failure manager tick failed")
+
+        self._thread = threading.Thread(target=loop, name="failure-manager",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        for dn in list(self.down):
+            self.cluster.restart_datanode(dn)
+        self.down.clear()
+
+
+def run_chaos(
+    cluster: MiniOzoneCluster,
+    duration_s: float = 5.0,
+    replication: str = "rs-3-2-4096",
+    key_size: int = 20_000,
+    max_down: int = 1,
+    seed: int = 0,
+) -> ChaosResult:
+    """Write keys under random failures, then verify every committed key."""
+    result = ChaosResult()
+    oz = cluster.client()
+    vol = oz.create_volume(f"chaos{seed}")
+    bucket = vol.create_bucket("b", replication=replication)
+    rng = np.random.default_rng(seed)
+    fm = FailureManager(cluster, max_down=max_down, seed=seed)
+    fm.start()
+
+    deadline = time.time() + duration_s
+    i = 0
+    try:
+        while time.time() < deadline:
+            name = f"key-{i}"
+            data = rng.integers(0, 256, key_size, dtype=np.uint8)
+            # deterministic payload per key for later verification
+            data[:8] = np.frombuffer(
+                i.to_bytes(8, "big"), dtype=np.uint8
+            )
+            try:
+                bucket.write_key(name, data)
+                result.keys_written.append(name)
+            except Exception as e:
+                log.info("write %s failed under chaos: %s", name, e)
+                result.write_failures += 1
+            i += 1
+    finally:
+        fm.stop()
+        result.kills = fm.kills
+        result.restarts = fm.restarts
+
+    # verification phase: cluster whole again, every committed key readable
+    rng_v = np.random.default_rng(seed)
+    for j in range(i):
+        expect = rng_v.integers(0, 256, key_size, dtype=np.uint8)
+        expect[:8] = np.frombuffer(j.to_bytes(8, "big"), dtype=np.uint8)
+        name = f"key-{j}"
+        if name not in result.keys_written:
+            continue
+        try:
+            got = bucket.read_key(name)
+            if not np.array_equal(got, expect):
+                result.read_mismatches.append(name)
+        except Exception as e:
+            result.read_errors.append(f"{name}: {e}")
+    return result

@@ -1,0 +1,30 @@
+"""Chaos tests: committed keys survive random datanode kills
+(mini-chaos-tests strategy analog)."""
+
+import pytest
+
+from ozone_tpu.testing.chaos import run_chaos
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_chaos_writes_survive_node_kills(tmp_path, seed):
+    cluster = MiniOzoneCluster(
+        tmp_path,
+        num_datanodes=7,
+        block_size=8 * 4096,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    try:
+        result = run_chaos(
+            cluster, duration_s=4.0, max_down=1, seed=seed,
+            replication="rs-3-2-4096",
+        )
+        assert result.kills >= 1, "chaos must actually kill nodes"
+        assert len(result.keys_written) >= 3
+        assert result.read_mismatches == []
+        assert result.read_errors == []
+    finally:
+        cluster.close()
