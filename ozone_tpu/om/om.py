@@ -209,6 +209,15 @@ class OzoneManager:
 
         purged: list[str] = []
         for dk, info in entries:
+            # defer-delete for snapshotted buckets: block data may still be
+            # referenced by a snapshot (reference: snapshot deferred
+            # deletion via SnapshotDeletingService/SstFilteringService)
+            vol, bkt = info.get("volume"), info.get("bucket")
+            if vol and bkt and next(
+                self.store.iterate("open_keys", f"/.snapmeta/{vol}/{bkt}/"),
+                None,
+            ):
+                continue
             for g in info.get("block_groups", []):
                 bid = BlockID(g["container_id"], g["local_id"])
                 for dn_id in g["nodes"]:

@@ -1,0 +1,133 @@
+"""Layout-version / upgrade-finalization framework.
+
+Mirror of the reference's non-rolling upgrade machinery (hadoop-hdds/common
+ozone/upgrade/: LayoutFeature catalogs HDDSLayoutFeature.java:29 /
+OMLayoutFeature.java, BasicUpgradeFinalizer.java:55, request gating by
+layout version): each service persists a metadata layout version; new
+features declare the version they need; requests/feature paths are gated
+until an explicit finalize step runs the feature upgrade actions and bumps
+the persisted version.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from enum import Enum
+from pathlib import Path
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class LayoutFeature:
+    name: str
+    version: int
+    description: str = ""
+
+
+#: Feature catalog (grows monotonically; versions never reused).
+INITIAL_VERSION = 0
+FEATURES = [
+    LayoutFeature("INITIAL", 0, "base layout"),
+    LayoutFeature(
+        "EC_DEVICE_CODEC", 1,
+        "TPU fused encode+CRC chunk checksums on EC writes",
+    ),
+    LayoutFeature(
+        "OM_REPLICATED_LOG", 2, "OM HA request-log replication"
+    ),
+]
+LATEST_VERSION = max(f.version for f in FEATURES)
+
+
+class FinalizationState(Enum):
+    ALREADY_FINALIZED = "ALREADY_FINALIZED"
+    FINALIZATION_REQUIRED = "FINALIZATION_REQUIRED"
+    FINALIZATION_DONE = "FINALIZATION_DONE"
+
+
+class LayoutVersionManager:
+    """Per-service persisted layout version + feature gating."""
+
+    def __init__(self, version_file: Path,
+                 software_version: int = LATEST_VERSION):
+        self.path = Path(version_file)
+        self.software_version = software_version
+        if self.path.exists():
+            self.metadata_version = json.loads(self.path.read_text())[
+                "layout_version"
+            ]
+        else:
+            # fresh install starts at the software version (reference
+            # behavior: new clusters don't need finalization)
+            self.metadata_version = software_version
+            self._persist()
+        if self.metadata_version > software_version:
+            raise RuntimeError(
+                f"metadata layout {self.metadata_version} is newer than "
+                f"software {software_version}; downgrade not supported"
+            )
+
+    def _persist(self) -> None:
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.path.write_text(
+            json.dumps({"layout_version": self.metadata_version})
+        )
+
+    def is_allowed(self, feature: LayoutFeature) -> bool:
+        return feature.version <= self.metadata_version
+
+    def check_allowed(self, feature: LayoutFeature) -> None:
+        """Request gating (reference request/validation layer)."""
+        if not self.is_allowed(feature):
+            raise RuntimeError(
+                f"feature {feature.name} needs layout {feature.version}, "
+                f"cluster is at {self.metadata_version}; run finalize"
+            )
+
+    def needs_finalization(self) -> bool:
+        return self.metadata_version < self.software_version
+
+
+class UpgradeFinalizer:
+    """Runs per-feature upgrade actions in version order and bumps the
+    persisted version (BasicUpgradeFinalizer.java:55)."""
+
+    def __init__(self, manager: LayoutVersionManager):
+        self.manager = manager
+        self._actions: dict[int, list[Callable[[], None]]] = {}
+
+    def register_action(self, feature: LayoutFeature,
+                        action: Callable[[], None]) -> None:
+        self._actions.setdefault(feature.version, []).append(action)
+
+    def finalize(self) -> FinalizationState:
+        m = self.manager
+        if not m.needs_finalization():
+            return FinalizationState.ALREADY_FINALIZED
+        for f in sorted(FEATURES, key=lambda f: f.version):
+            if m.metadata_version < f.version <= m.software_version:
+                for action in self._actions.get(f.version, ()):
+                    log.info("running upgrade action for %s", f.name)
+                    action()
+                m.metadata_version = f.version
+                m._persist()
+        return FinalizationState.FINALIZATION_DONE
+
+    def status(self) -> dict:
+        return {
+            "metadata_version": self.manager.metadata_version,
+            "software_version": self.manager.software_version,
+            "needs_finalization": self.manager.needs_finalization(),
+            "features": [
+                {
+                    "name": f.name,
+                    "version": f.version,
+                    "allowed": self.manager.is_allowed(f),
+                }
+                for f in FEATURES
+            ],
+        }

@@ -1,0 +1,116 @@
+"""Upgrade framework + OM snapshot/snapdiff tests."""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.om.requests import OMError
+from ozone_tpu.om.snapshots import SnapshotManager
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+from ozone_tpu.utils.upgrade import (
+    FEATURES,
+    FinalizationState,
+    LayoutVersionManager,
+    UpgradeFinalizer,
+)
+
+EC = "rs-3-2-4096"
+
+
+# ------------------------------------------------------------------ upgrade
+def test_fresh_install_is_finalized(tmp_path):
+    m = LayoutVersionManager(tmp_path / "VERSION")
+    assert not m.needs_finalization()
+    fin = UpgradeFinalizer(m)
+    assert fin.finalize() is FinalizationState.ALREADY_FINALIZED
+
+
+def test_upgrade_gating_and_finalize(tmp_path):
+    # simulate an old cluster at layout 0
+    old = LayoutVersionManager(tmp_path / "VERSION", software_version=0)
+    assert old.metadata_version == 0
+    # new software starts against old metadata
+    m = LayoutVersionManager(tmp_path / "VERSION")
+    assert m.metadata_version == 0
+    assert m.needs_finalization()
+    ec_feature = next(f for f in FEATURES if f.name == "EC_DEVICE_CODEC")
+    with pytest.raises(RuntimeError):
+        m.check_allowed(ec_feature)
+    ran = []
+    fin = UpgradeFinalizer(m)
+    fin.register_action(ec_feature, lambda: ran.append("ec"))
+    assert fin.finalize() is FinalizationState.FINALIZATION_DONE
+    assert ran == ["ec"]
+    assert not m.needs_finalization()
+    m.check_allowed(ec_feature)  # no raise
+    # persisted
+    m2 = LayoutVersionManager(tmp_path / "VERSION")
+    assert not m2.needs_finalization()
+
+
+def test_downgrade_rejected(tmp_path):
+    LayoutVersionManager(tmp_path / "VERSION")  # latest
+    with pytest.raises(RuntimeError):
+        LayoutVersionManager(tmp_path / "VERSION", software_version=0)
+
+
+# ---------------------------------------------------------------- snapshots
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniOzoneCluster(
+        tmp_path, num_datanodes=5, block_size=8 * 4096,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0, dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+def test_snapshot_create_read_diff(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    rng = np.random.default_rng(0)
+    d1 = rng.integers(0, 256, 9000, dtype=np.uint8)
+    d2 = rng.integers(0, 256, 5000, dtype=np.uint8)
+    b.write_key("k1", d1)
+    b.write_key("k2", d2)
+
+    sm = SnapshotManager(cluster.om)
+    s1 = sm.create_snapshot("v", "b", "snap1")
+    assert [s.name for s in sm.list_snapshots("v", "b")] == ["snap1"]
+
+    # mutate after the snapshot: delete k1, add k3, rewrite k2
+    b.delete_key("k1")
+    b.write_key("k3", rng.integers(0, 256, 100, dtype=np.uint8))
+    b.write_key("k2", rng.integers(0, 256, 7777, dtype=np.uint8))
+
+    # snapshot still sees the old namespace
+    snap_keys = {k["name"] for k in sm.list_keys("v", "b", "snap1")}
+    assert snap_keys == {"k1", "k2"}
+    info = sm.lookup_key("v", "b", "snap1", "k1")
+    assert info["size"] == 9000
+    # snapshot-referenced data still readable through its block groups
+    groups = cluster.om.key_block_groups(info)
+    from ozone_tpu.client.ec_reader import ECBlockGroupReader
+    from ozone_tpu.codec.api import CoderOptions
+
+    parts = [
+        ECBlockGroupReader(g, CoderOptions.parse(EC), cluster.clients).read_all()
+        for g in groups
+    ]
+    assert np.array_equal(np.concatenate(parts), d1)
+
+    diff = sm.snapshot_diff("v", "b", "snap1")
+    assert diff["added"] == ["k3"]
+    assert diff["deleted"] == ["k1"]
+    assert diff["modified"] == ["k2"]
+
+    s2 = sm.create_snapshot("v", "b", "snap2")
+    assert s2.previous == s1.snap_id
+    diff2 = sm.snapshot_diff("v", "b", "snap1", "snap2")
+    assert diff2["added"] == ["k3"] and diff2["deleted"] == ["k1"]
+
+    sm.delete_snapshot("v", "b", "snap1")
+    with pytest.raises(OMError):
+        sm.get_snapshot("v", "b", "snap1")
+    # live namespace unaffected
+    assert {k["name"] for k in b.list_keys()} == {"k2", "k3"}
