@@ -1,0 +1,78 @@
+"""Block token tests: issue/verify, rotation, tamper/expiry rejection."""
+
+import time
+
+import pytest
+
+from ozone_tpu.storage.ids import BlockID
+from ozone_tpu.utils.security import (
+    AccessMode,
+    BlockTokenIssuer,
+    BlockTokenVerifier,
+    SecretKeyManager,
+    TokenError,
+)
+
+
+@pytest.fixture
+def setup():
+    mgr = SecretKeyManager()
+    return mgr, BlockTokenIssuer(mgr), BlockTokenVerifier(mgr)
+
+
+def test_issue_and_verify(setup):
+    mgr, issuer, verifier = setup
+    bid = BlockID(7, 42)
+    tok = issuer.issue(bid, [AccessMode.READ, AccessMode.WRITE])
+    verifier.verify(tok, bid, AccessMode.READ)
+    verifier.verify(tok, bid, AccessMode.WRITE)
+
+
+def test_mode_and_block_scoping(setup):
+    mgr, issuer, verifier = setup
+    bid = BlockID(7, 42)
+    tok = issuer.issue(bid, [AccessMode.READ])
+    with pytest.raises(TokenError):
+        verifier.verify(tok, bid, AccessMode.WRITE)
+    with pytest.raises(TokenError):
+        verifier.verify(tok, BlockID(7, 43), AccessMode.READ)
+
+
+def test_tamper_rejected(setup):
+    mgr, issuer, verifier = setup
+    bid = BlockID(1, 1)
+    tok = issuer.issue(bid, [AccessMode.READ])
+    bad = dict(tok)
+    bad["modes"] = ["READ", "WRITE"]
+    with pytest.raises(TokenError):
+        verifier.verify(bad, bid, AccessMode.WRITE)
+    bad2 = dict(tok)
+    bad2["sig"] = "0" * 64
+    with pytest.raises(TokenError):
+        verifier.verify(bad2, bid, AccessMode.READ)
+
+
+def test_expiry(setup):
+    mgr, _, verifier = setup
+    issuer = BlockTokenIssuer(mgr, token_lifetime_s=-1.0)
+    bid = BlockID(1, 1)
+    tok = issuer.issue(bid, [AccessMode.READ])
+    with pytest.raises(TokenError):
+        verifier.verify(tok, bid, AccessMode.READ)
+
+
+def test_rotation_keeps_old_tokens_valid(setup):
+    mgr, issuer, verifier = setup
+    bid = BlockID(2, 2)
+    tok = issuer.issue(bid, [AccessMode.READ])
+    mgr.rotate()
+    verifier.verify(tok, bid, AccessMode.READ)  # old key still importable
+    tok2 = issuer.issue(bid, [AccessMode.READ])
+    assert tok2["key_id"] != tok["key_id"]
+    verifier.verify(tok2, bid, AccessMode.READ)
+
+
+def test_disabled_verifier_accepts_anything(setup):
+    mgr, _, _ = setup
+    v = BlockTokenVerifier(mgr, enabled=False)
+    v.verify(None, BlockID(1, 1), AccessMode.WRITE)
