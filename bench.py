@@ -28,7 +28,7 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def bench_fused_encode(batch: int = 8, cell: int = 1024 * 1024,
+def bench_fused_encode(batch: int = 12, cell: int = 1024 * 1024,
                        iters: int = 40, rounds: int = 5) -> float:
     import jax
 

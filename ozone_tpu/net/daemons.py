@@ -60,6 +60,7 @@ class DatanodeDaemon:
         self.clients = DatanodeClientFactory()
         self.clients.register_local(self.dn)
         self.reconstruction = ECReconstructionCoordinator(self.clients)
+        self._pending_acks: list[int] = []
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None
 
@@ -78,8 +79,10 @@ class DatanodeDaemon:
     def heartbeat_once(self) -> None:
         report = self.dn.container_report()
         used = sum(r["used_bytes"] for r in report)
+        acks, self._pending_acks = self._pending_acks, []
         commands = self.scm.heartbeat(
-            self.dn.id, container_report=report, used_bytes=used
+            self.dn.id, container_report=report, used_bytes=used,
+            deleted_block_acks=acks,
         )
         for cmd in commands:
             self._execute(cmd)
@@ -97,8 +100,17 @@ class DatanodeDaemon:
                 self.clients.register_remote(dn_id, addr)
 
     def _execute(self, cmd) -> None:
+        from ozone_tpu.scm.block_deletion import DeleteBlocksCommand
+
         try:
-            if isinstance(cmd, ReconstructionCommand):
+            if isinstance(cmd, DeleteBlocksCommand):
+                for bid in cmd.blocks:
+                    try:
+                        self.dn.delete_block(bid)
+                    except StorageError:
+                        pass
+                self._pending_acks.extend(cmd.tx_ids)
+            elif isinstance(cmd, ReconstructionCommand):
                 self._learn_addresses(self.scm.node_addresses())
                 self.reconstruction.reconstruct_container_group(cmd)
             elif isinstance(cmd, DeleteReplicaCommand):

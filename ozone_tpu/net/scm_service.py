@@ -43,6 +43,14 @@ def serialize_command(cmd, addresses: dict[str, str]) -> dict:
             "targets": {str(k): v for k, v in cmd.targets.items()},
             "addresses": addresses,
         }
+    from ozone_tpu.scm.block_deletion import DeleteBlocksCommand
+
+    if isinstance(cmd, DeleteBlocksCommand):
+        return {
+            "type": "delete_blocks",
+            "tx_ids": cmd.tx_ids,
+            "blocks": [b.to_json() for b in cmd.blocks],
+        }
     if isinstance(cmd, DeleteReplicaCommand):
         return {"type": "delete_replica", **asdict(cmd)}
     if isinstance(cmd, ReplicateCommand):
@@ -60,6 +68,14 @@ def deserialize_command(d: dict):
             replication=CoderOptions.parse(d["replication"]),
             sources={int(k): v for k, v in d["sources"].items()},
             targets={int(k): v for k, v in d["targets"].items()},
+        )
+    if t == "delete_blocks":
+        from ozone_tpu.scm.block_deletion import DeleteBlocksCommand
+        from ozone_tpu.storage.ids import BlockID
+
+        return DeleteBlocksCommand(
+            list(d["tx_ids"]),
+            [BlockID.from_json(b) for b in d["blocks"]],
         )
     if t == "delete_replica":
         return DeleteReplicaCommand(d["container_id"], d.get("replica_index", 0))
@@ -101,6 +117,7 @@ class ScmGrpcService:
             m["dn_id"],
             container_report=m.get("container_report"),
             used_bytes=m.get("used_bytes", 0),
+            deleted_block_acks=m.get("deleted_block_acks"),
         )
         return wire.pack(
             {
@@ -157,11 +174,13 @@ class GrpcScmClient:
         })
 
     def heartbeat(self, dn_id: str, container_report=None,
-                  used_bytes: int = 0) -> list:
+                  used_bytes: int = 0,
+                  deleted_block_acks: Optional[list[int]] = None) -> list:
         m = self._call("Heartbeat", {
             "dn_id": dn_id,
             "container_report": container_report,
             "used_bytes": used_bytes,
+            "deleted_block_acks": deleted_block_acks or [],
         })
         return [deserialize_command(c) for c in m["commands"]]
 

@@ -200,14 +200,17 @@ class OzoneManager:
 
     # ----------------------------------------------------------- services
     def run_key_deleting_service_once(self, limit: int = 100) -> int:
-        """Purge deleted keys: delete their blocks on datanodes, then drop
-        the entries (KeyDeletingService analog). Returns keys purged."""
+        """Purge deleted keys: hand their blocks to the SCM deletion log
+        (which drives datanode deletes over heartbeats — the reference's
+        KeyDeletingService -> SCM DeletedBlockLog chain), then drop the
+        entries. Returns keys purged."""
         entries = list(self.store.iterate("deleted_keys"))[:limit]
         if not entries:
             return 0
         from ozone_tpu.storage.ids import BlockID
 
         purged: list[str] = []
+        txs: list[tuple] = []
         for dk, info in entries:
             # defer-delete for snapshotted buckets: block data may still be
             # referenced by a snapshot (reference: snapshot deferred
@@ -219,18 +222,13 @@ class OzoneManager:
             ):
                 continue
             for g in info.get("block_groups", []):
-                bid = BlockID(g["container_id"], g["local_id"])
-                for dn_id in g["nodes"]:
-                    client = (
-                        self.clients.maybe_get(dn_id) if self.clients else None
-                    )
-                    if client is None:
-                        continue
-                    try:
-                        client.delete_block(bid)
-                    except (StorageError, OSError) as e:
-                        log.debug("block delete failed on %s: %s", dn_id, e)
+                txs.append(
+                    (BlockID(g["container_id"], g["local_id"]),
+                     list(g["nodes"]))
+                )
             purged.append(dk)
+        if txs:
+            self.scm.delete_blocks(txs)
         self.submit(rq.PurgeDeletedKeys(purged))
         return len(purged)
 

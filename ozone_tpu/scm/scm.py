@@ -53,12 +53,20 @@ class StorageContainerManager:
             self.containers, self.nodes, self.placement
         )
         from ozone_tpu.scm.balancer import ContainerBalancer
+        from ozone_tpu.scm.block_deletion import (
+            BlockDeletingService,
+            DeletedBlockLog,
+        )
         from ozone_tpu.scm.decommission import DecommissionMonitor
 
         self.balancer = ContainerBalancer(self.containers, self.nodes)
         self.balancer_enabled = False
         self.decommission_monitor = DecommissionMonitor(
             self.nodes, self.containers, self.replication
+        )
+        self.deleted_blocks = DeletedBlockLog()
+        self.block_deleting = BlockDeletingService(
+            self.deleted_blocks, self.nodes
         )
         self.metrics = MetricsRegistry("scm")
         self.events.subscribe(nm.DEAD_NODE, self._on_dead_node)
@@ -77,9 +85,12 @@ class StorageContainerManager:
         dn_id: str,
         container_report: Optional[list[dict]] = None,
         used_bytes: int = 0,
+        deleted_block_acks: Optional[list[int]] = None,
     ) -> list:
-        """Process a heartbeat (+optional full container report); return the
-        commands queued for this datanode."""
+        """Process a heartbeat (+optional full container report and block-
+        deletion acks); return the commands queued for this datanode."""
+        if deleted_block_acks:
+            self.deleted_blocks.ack(dn_id, deleted_block_acks)
         if container_report is not None:
             self.containers.process_container_report(dn_id, container_report)
             # CLOSING -> CLOSED once replicas report closed
@@ -111,6 +122,15 @@ class StorageContainerManager:
         self.metrics.counter("blocks_allocated").inc()
         return g
 
+    def delete_blocks(self, entries: list[tuple]) -> list[int]:
+        """OM -> SCM deletion handoff (ScmBlockLocationProtocol
+        .deleteKeyBlocks analog): entries of (BlockID, datanode ids)."""
+        tx_ids = [
+            self.deleted_blocks.add(bid, nodes) for bid, nodes in entries
+        ]
+        self.metrics.counter("block_delete_txs").inc(len(tx_ids))
+        return tx_ids
+
     # ------------------------------------------------------------- admin ops
     def decommission(self, dn_id: str) -> None:
         """Start draining a node (NodeDecommissionManager.java:60): out of
@@ -126,6 +146,7 @@ class StorageContainerManager:
         if not self.safemode.in_safemode():
             self.replication.run_once()
             self.decommission_monitor.run_once()
+            self.block_deleting.run_once()
             if self.balancer_enabled:
                 self.balancer.run_iteration()
 

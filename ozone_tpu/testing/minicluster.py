@@ -106,8 +106,17 @@ class MiniOzoneCluster:
                 self._execute_command(dn, cmd)
 
     def _execute_command(self, dn: Datanode, cmd) -> None:
+        from ozone_tpu.scm.block_deletion import DeleteBlocksCommand
+
         try:
-            if isinstance(cmd, ReconstructionCommand):
+            if isinstance(cmd, DeleteBlocksCommand):
+                for bid in cmd.blocks:
+                    try:
+                        dn.delete_block(bid)
+                    except StorageError:
+                        pass
+                self.scm.deleted_blocks.ack(dn.id, cmd.tx_ids)
+            elif isinstance(cmd, ReconstructionCommand):
                 self.reconstruction.reconstruct_container_group(cmd)
                 for idx in cmd.targets:
                     self.scm.replication.op_completed(cmd.container_id, idx)

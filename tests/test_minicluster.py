@@ -74,6 +74,8 @@ def test_key_rename_delete_purge(cluster):
     b = oz.create_volume("v").create_bucket("b", replication=EC)
     data = np.arange(10_000, dtype=np.int64).astype(np.uint8)
     b.write_key("old", data)
+    info = oz.om.lookup_key("v", "b", "old")
+    groups = cluster.om.key_block_groups(info)
     b.rename_key("old", "new")
     assert np.array_equal(b.read_key("new"), data)
     with pytest.raises(OMError):
@@ -83,9 +85,17 @@ def test_key_rename_delete_purge(cluster):
         b.read_key("new")
     purged = cluster.om.run_key_deleting_service_once()
     assert purged == 1
-    # blocks gone from datanodes
-    g = cluster.om.key_block_groups({"block_groups": []})
-    assert g == []
+    # deletion rides SCM heartbeat commands: tick drives the chain
+    assert cluster.scm.deleted_blocks.pending_count() > 0
+    cluster.tick(rounds=2)
+    assert cluster.scm.deleted_blocks.pending_count() == 0
+    # blocks physically gone from the datanodes
+    from ozone_tpu.storage.ids import StorageError
+
+    for g in groups:
+        for dn_id in g.pipeline.nodes:
+            with pytest.raises(StorageError):
+                cluster.datanode(dn_id).get_block(g.block_id)
 
 
 def test_node_death_triggers_reconstruction(cluster):
