@@ -1,0 +1,71 @@
+"""Replication-to-EC re-encode: convert replicated keys to erasure coding.
+
+Mirror of the reference's container-service conversion capability
+(BASELINE config #4 "XOR(1) replication-to-EC re-encode path"): bulk data
+written with replication (fast ingest, 2-3x storage) is re-encoded to an
+EC layout (1.5x storage for rs-6-3) in the background. The read side
+streams from any live replica; the write side is the standard EC stripe
+pipeline, so the re-encode inherits the batched fused device encode+CRC;
+the key's block list is swapped atomically at commit and the old blocks
+go through the SCM deletion chain.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.ec_writer import ECKeyWriter
+from ozone_tpu.client.replicated import ReplicatedKeyReader
+from ozone_tpu.om.om import OzoneManager
+from ozone_tpu.om import requests as rq
+from ozone_tpu.scm.pipeline import ReplicationConfig, ReplicationType
+from ozone_tpu.storage.ids import BlockID
+from ozone_tpu.utils.checksum import ChecksumType
+
+log = logging.getLogger(__name__)
+
+
+def re_encode_key_to_ec(
+    om: OzoneManager,
+    clients: DatanodeClientFactory,
+    volume: str,
+    bucket: str,
+    key: str,
+    ec: str = "rs-6-3-1024k",
+) -> dict:
+    """Convert one replicated key to EC. Returns the new key info."""
+    info = om.lookup_key(volume, bucket, key)
+    old_groups = om.key_block_groups(info)
+    repl = ReplicationConfig.parse(info["replication"])
+    if repl.type is ReplicationType.EC:
+        raise ValueError(f"{key} is already erasure coded ({repl})")
+
+    ec_conf = ReplicationConfig.parse(ec)
+    session = om.open_key(volume, bucket, key, replication=ec)
+    writer = ECKeyWriter(
+        ec_conf.ec,
+        lambda excluded: om.allocate_block(session, excluded),
+        clients,
+        block_size=om.block_size,
+        checksum=ChecksumType(info.get("checksum_type", "CRC32C")),
+        bytes_per_checksum=info.get("bytes_per_checksum", 16 * 1024),
+    )
+    for g in old_groups:
+        writer.write(ReplicatedKeyReader(g, clients).read_all())
+    groups = writer.close()
+    # commit replaces the key's block list; the old key version moves to
+    # the deleted table so its blocks retire through the SCM chain
+    om.submit(
+        rq.DeleteKey(volume, bucket, key)
+    )
+    om.commit_key(session, groups, writer.bytes_written)
+
+    log.info(
+        "re-encoded %s/%s/%s: %d bytes, %d replicated groups -> %d EC groups",
+        volume, bucket, key, writer.bytes_written, len(old_groups),
+        len(groups),
+    )
+    return om.lookup_key(volume, bucket, key)

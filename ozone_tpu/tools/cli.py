@@ -234,7 +234,86 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("--min-datanodes", type=int, default=1)
     so.set_defaults(fn=cmd_scm_om)
 
+    dbg = sub.add_parser("debug", help="debug tools (ozone debug analog)")
+    dbg.add_argument("tool", choices=["ldb", "chunk-info", "verify-replicas"])
+    dbg.add_argument("target", help="db path (ldb) or /vol/bucket/key")
+    dbg.add_argument("--table", default="keys")
+    dbg.add_argument("--prefix", default="")
+    dbg.add_argument("--om", default="127.0.0.1:9860")
+    dbg.set_defaults(fn=cmd_debug)
+
     return ap
+
+
+# -------------------------------------------------------------------- debug
+def cmd_debug(args) -> int:
+    if args.tool == "ldb":
+        # OM/volume metadata explorer (ozone debug ldb analog)
+        from ozone_tpu.om.metadata import OMMetadataStore
+
+        store = OMMetadataStore(args.target)
+        try:
+            for k, v in store.iterate(args.table, args.prefix):
+                print(json.dumps({"key": k, "value": v}, default=str))
+        finally:
+            store.close()
+        return 0
+
+    oz = _client(args)
+    vol, bucket, *rest = _parse_path(args.target)
+    key = "/".join(rest)
+    info = oz.om.lookup_key(vol, bucket, key)
+    groups = oz.om.key_block_groups(info)
+    if args.tool == "chunk-info":
+        out = []
+        for g in groups:
+            unit_chunks = {}
+            for i, dn_id in enumerate(g.pipeline.nodes):
+                client = oz.clients.maybe_get(dn_id)
+                if client is None:
+                    unit_chunks[dn_id] = "unreachable"
+                    continue
+                try:
+                    bd = client.get_block(g.block_id)
+                    unit_chunks[dn_id] = {
+                        "replica_index": i + 1,
+                        "chunks": [c.to_json() for c in bd.chunks],
+                    }
+                except Exception as e:
+                    unit_chunks[dn_id] = f"error: {e}"
+            out.append({
+                "container_id": g.container_id,
+                "local_id": g.local_id,
+                "length": g.length,
+                "replicas": unit_chunks,
+            })
+        _emit(out)
+    elif args.tool == "verify-replicas":
+        # read every unit with checksum verification (replicas verify analog)
+        report = []
+        for g in groups:
+            for i, dn_id in enumerate(g.pipeline.nodes):
+                client = oz.clients.maybe_get(dn_id)
+                status = "ok"
+                if client is None:
+                    status = "unreachable"
+                else:
+                    try:
+                        bd = client.get_block(g.block_id)
+                        for c in bd.chunks:
+                            client.read_chunk(g.block_id, c, verify=True)
+                    except Exception as e:
+                        status = f"corrupt/unavailable: {e}"
+                report.append({
+                    "container_id": g.container_id,
+                    "datanode": dn_id,
+                    "replica_index": i + 1,
+                    "status": status,
+                })
+        _emit(report)
+        bad = [r for r in report if r["status"] != "ok"]
+        return 1 if bad else 0
+    return 0
 
 
 def main(argv=None) -> int:

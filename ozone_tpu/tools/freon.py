@@ -181,6 +181,49 @@ def dcg(
     return BaseFreonGenerator("dcg", n_chunks, threads).run(op)
 
 
+def omkg(client, n_keys: int = 1000, threads: int = 8,
+         volume: str = "freon-vol", bucket: str = "freon-meta") -> FreonReport:
+    """Pure OM metadata op generator: open+commit empty keys without any
+    datanode IO (OmKeyGenerator analog — measures namespace throughput)."""
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket)
+    except Exception:
+        pass
+
+    def op(i: int) -> int:
+        s = client.om.open_key(volume, bucket, f"meta-{i}")
+        client.om.commit_key(s, [], 0)
+        return 0
+
+    return BaseFreonGenerator("omkg", n_keys, threads).run(op)
+
+
+def dcv(clients, dn_ids: list[str], n_chunks: int, size: int = 1024 * 1024,
+        threads: int = 4, container_id: int = 10_000_000) -> FreonReport:
+    """Datanode chunk validator: read back + checksum-verify chunks written
+    by dcg (DatanodeChunkValidator analog)."""
+    from ozone_tpu.storage.ids import BlockID, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    rng = np.random.default_rng(1)
+    payload = rng.integers(0, 256, size, dtype=np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 16 * 1024).compute(payload)
+
+    def op(i: int) -> int:
+        dn = dn_ids[i % len(dn_ids)]
+        bid = BlockID(container_id, i + 1)
+        info = ChunkInfo(f"chunk_{i}", 0, size, cs)
+        data = clients.get(dn).read_chunk(bid, info, verify=True)
+        assert data.size == size
+        return size
+
+    return BaseFreonGenerator("dcv", n_chunks, threads).run(op)
+
+
 def rawcoder_bench(
     backends: Optional[list[str]] = None,
     schema: str = "rs-6-3",

@@ -1,0 +1,78 @@
+"""Replication-to-EC re-encode + extra freon generators + debug CLI."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ozone_tpu.client.re_encode import re_encode_key_to_ec
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+from ozone_tpu.tools import freon
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniOzoneCluster(
+        tmp_path, num_datanodes=6, block_size=8 * 4096,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0, dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+def test_re_encode_replicated_key_to_ec(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication="RATIS/THREE")
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, 100_000, dtype=np.uint8)
+    b.write_key("k", data)
+    info = oz.om.lookup_key("v", "b", "k")
+    assert info["replication"].startswith("RATIS")
+
+    new_info = re_encode_key_to_ec(
+        cluster.om, cluster.clients, "v", "b", "k", ec="rs-3-2-4096"
+    )
+    assert new_info["replication"] == "rs-3-2-4096"
+    assert new_info["size"] == data.size
+    got = b.read_key("k")
+    assert np.array_equal(got, data)
+    # old replicated blocks retire through the SCM deletion chain
+    purged = cluster.om.run_key_deleting_service_once()
+    assert purged == 1
+    assert cluster.scm.deleted_blocks.pending_count() > 0
+    cluster.tick(rounds=2)
+    assert cluster.scm.deleted_blocks.pending_count() == 0
+    # double-conversion is rejected
+    with pytest.raises(ValueError):
+        re_encode_key_to_ec(cluster.om, cluster.clients, "v", "b", "k")
+
+
+def test_freon_omkg_and_dcv(cluster):
+    oz = cluster.client()
+    rep = freon.omkg(oz, n_keys=20, threads=4)
+    assert rep.summary()["failures"] == 0
+    assert rep.summary()["ops"] == 20
+
+    dn_ids = [d.id for d in cluster.datanodes[:3]]
+    w = freon.dcg(cluster.clients, dn_ids, n_chunks=6, size=8192, threads=3)
+    assert w.summary()["failures"] == 0
+    r = freon.dcv(cluster.clients, dn_ids, n_chunks=6, size=8192, threads=3)
+    assert r.summary()["failures"] == 0
+
+
+def test_debug_cli_ldb_and_replicas(cluster, capsys):
+    from ozone_tpu.tools.cli import main as cli_main
+
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication="rs-3-2-4096")
+    data = np.random.default_rng(1).integers(0, 256, 30_000, dtype=np.uint8)
+    b.write_key("k", data)
+    cluster.om.store.flush()
+
+    # ldb table dump straight from the OM db file
+    db_path = str(cluster.root / "om" / "om.db")
+    assert cli_main(["debug", "ldb", db_path, "--table", "keys"]) == 0
+    lines = [json.loads(line) for line in
+             capsys.readouterr().out.strip().splitlines()]
+    assert any(e["key"] == "/v/b/k" for e in lines)
