@@ -172,6 +172,7 @@ class ScmOmDaemon:
             container_size=container_size,
             stale_after_s=stale_after_s,
             dead_after_s=dead_after_s,
+            db_path=Path(om_db).parent / "scm.db",
         )
         self.server = RpcServer(host, port)
         self.scm_service = ScmGrpcService(self.scm, self.server)

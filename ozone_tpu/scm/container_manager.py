@@ -60,17 +60,56 @@ class ContainerManager:
         nodes: NodeManager,
         placement: PlacementPolicy,
         container_size: int = 5 * 1024 * 1024 * 1024,
+        db_path=None,
     ):
         self.nodes = nodes
         self.placement = placement
         self.container_size = container_size
         self._containers: dict[int, ContainerInfo] = {}
         self._pipelines: dict[int, Pipeline] = {}
-        self._cid = itertools.count(1)
-        self._lid = itertools.count(1)
+        self._next_cid = 1
+        self._next_lid = 1
         # open writable containers by replication-scheme string
         self._writable: dict[str, list[int]] = {}
         self._lock = threading.RLock()
+        # optional persistence (reference: SCM metadata in RocksDB with
+        # HA-safe SequenceIdGenerator; replicas rebuild from reports)
+        self._db = None
+        if db_path is not None:
+            from ozone_tpu.scm.scm_store import ScmStore
+
+            self._db = ScmStore(db_path)
+            self._recover()
+
+    def _recover(self) -> None:
+        state = self._db.load()
+        for c in state["containers"]:
+            repl = ReplicationConfig.parse(c["replication"])
+            pipe = Pipeline(repl, list(c["nodes"]))
+            self._pipelines[pipe.id] = pipe
+            info = ContainerInfo(
+                c["id"], repl, pipe,
+                state=ContainerState(c["state"]),
+                used_bytes=int(c["used_bytes"]),
+            )
+            self._containers[info.id] = info
+            if info.state is ContainerState.OPEN:
+                self._writable.setdefault(str(repl), []).append(info.id)
+        self._next_cid = state["next_container_id"]
+        self._next_lid = state["next_local_id"]
+
+    def _persist(self, c: ContainerInfo) -> None:
+        if self._db is not None:
+            self._db.save_container(
+                {
+                    "id": c.id,
+                    "replication": str(c.replication),
+                    "nodes": c.pipeline.nodes if c.pipeline else [],
+                    "state": c.state.value,
+                    "used_bytes": c.used_bytes,
+                },
+                counters=(self._next_cid, self._next_lid),
+            )
 
     # --------------------------------------------------------------- queries
     def get(self, container_id: int) -> ContainerInfo:
@@ -98,8 +137,10 @@ class ContainerManager:
         self, replication: ReplicationConfig, excluded: list[str]
     ) -> ContainerInfo:
         pipe = self._create_pipeline(replication, excluded)
-        c = ContainerInfo(next(self._cid), replication, pipe)
+        c = ContainerInfo(self._next_cid, replication, pipe)
+        self._next_cid += 1
         self._containers[c.id] = c
+        self._persist(c)
         return c
 
     def allocate_block(
@@ -127,17 +168,23 @@ class ContainerManager:
                     pool.remove(cid)
                     continue
                 c.used_bytes += block_size
+                lid = self._next_lid
+                self._next_lid += 1
+                self._persist(c)
                 return BlockGroup(
                     container_id=cid,
-                    local_id=next(self._lid),
+                    local_id=lid,
                     pipeline=c.pipeline,
                 )
             c = self._allocate_container(replication, excluded)
             pool.append(c.id)
             c.used_bytes += block_size
+            lid = self._next_lid
+            self._next_lid += 1
+            self._persist(c)
             return BlockGroup(
                 container_id=c.id,
-                local_id=next(self._lid),
+                local_id=lid,
                 pipeline=c.pipeline,
             )
 
@@ -146,12 +193,17 @@ class ContainerManager:
         c = self._containers[container_id]
         if c.state is ContainerState.OPEN:
             c.state = ContainerState.CLOSING
+            self._persist(c)
 
     def mark_closed(self, container_id: int) -> None:
-        self._containers[container_id].state = ContainerState.CLOSED
+        c = self._containers[container_id]
+        c.state = ContainerState.CLOSED
+        self._persist(c)
 
     def delete_container(self, container_id: int) -> None:
-        self._containers[container_id].state = ContainerState.DELETED
+        c = self._containers[container_id]
+        c.state = ContainerState.DELETED
+        self._persist(c)
 
     # --------------------------------------------------------------- reports
     def process_container_report(self, dn_id: str, report: list[dict]) -> None:

@@ -37,6 +37,7 @@ class StorageContainerManager:
         placement_seed: Optional[int] = None,
         stale_after_s: float = 9.0,
         dead_after_s: float = 30.0,
+        db_path=None,
     ):
         self.events = EventQueue()
         self.nodes = NodeManager(
@@ -44,7 +45,8 @@ class StorageContainerManager:
         )
         self.placement = RackScatterPlacement(self.nodes, seed=placement_seed)
         self.containers = ContainerManager(
-            self.nodes, self.placement, container_size=container_size
+            self.nodes, self.placement, container_size=container_size,
+            db_path=db_path,
         )
         self.safemode = SafeModeManager(
             self.nodes, self.containers, SafeModeConfig(min_datanodes)

@@ -1,0 +1,63 @@
+"""SCM metadata persistence.
+
+Role analog of the reference's SCM RocksDB metadata store (server-scm
+persists containers/pipelines/sequence ids; replicas are soft state
+rebuilt from datanode full container reports). Sqlite-backed: container
+rows + monotonic id counters (SequenceIdGenerator analog — persisted
+before use so restarts never reissue an id).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from pathlib import Path
+
+
+class ScmStore:
+    def __init__(self, path):
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        self._conn = sqlite3.connect(str(p), check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS containers "
+            "(id INTEGER PRIMARY KEY, data TEXT)"
+        )
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v TEXT)"
+        )
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def save_container(self, row: dict, counters: tuple[int, int]) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO containers VALUES (?, ?)",
+                (row["id"], json.dumps(row)),
+            )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta VALUES ('counters', ?)",
+                (json.dumps(list(counters)),),
+            )
+            self._conn.commit()
+
+    def load(self) -> dict:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT data FROM containers ORDER BY id"
+            ).fetchall()
+            meta = self._conn.execute(
+                "SELECT v FROM meta WHERE k='counters'"
+            ).fetchone()
+        counters = json.loads(meta[0]) if meta else [1, 1]
+        return {
+            "containers": [json.loads(r[0]) for r in rows],
+            "next_container_id": counters[0],
+            "next_local_id": counters[1],
+        }
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
