@@ -190,8 +190,26 @@ class ScmOmDaemon:
     def start(self) -> None:
         self.server.start()
         self.scm.start_background(self._bg_interval)
+        # OM background services (reference service/: KeyDeletingService,
+        # DirectoryDeletingService) — purge detached subtrees and hand
+        # deleted blocks to the SCM deletion chain
+        self._om_bg_stop = threading.Event()
+
+        def _om_services():
+            while not self._om_bg_stop.wait(self._bg_interval):
+                try:
+                    self.om.run_dir_deleting_service_once()
+                    self.om.run_key_deleting_service_once()
+                except Exception:  # noqa: BLE001 - service must survive
+                    log.exception("om background service pass failed")
+
+        self._om_bg = threading.Thread(target=_om_services, daemon=True,
+                                       name="om-background")
+        self._om_bg.start()
 
     def stop(self) -> None:
+        if hasattr(self, "_om_bg_stop"):
+            self._om_bg_stop.set()
         self.scm.stop()
         self.server.stop()
         self.om.close()

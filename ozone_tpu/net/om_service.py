@@ -71,6 +71,30 @@ class OmGrpcService:
                         m["volume"], m["bucket"], m["key"], m["new_key"]
                     )
                 ),
+                # FSO file-system verbs (reference OmClientProtocol
+                # CreateDirectory/GetFileStatus/ListStatus/DeleteKey with
+                # recursive flag)
+                "CreateDirectory": self._wrap(
+                    lambda m: self.om.create_directory(
+                        m["volume"], m["bucket"], m["path"]
+                    )
+                ),
+                "DeleteDirectory": self._wrap(
+                    lambda m: self.om.delete_directory(
+                        m["volume"], m["bucket"], m["path"],
+                        m.get("recursive", False),
+                    )
+                ),
+                "GetFileStatus": self._wrap(
+                    lambda m: self.om.get_file_status(
+                        m["volume"], m["bucket"], m["path"]
+                    )
+                ),
+                "ListStatus": self._wrap(
+                    lambda m: self.om.list_status(
+                        m["volume"], m["bucket"], m["path"]
+                    )
+                ),
             },
         )
 
@@ -81,7 +105,7 @@ class OmGrpcService:
             try:
                 out = fn(m)
             except OMError as e:
-                raise StorageError(e.code, str(e))
+                raise StorageError(e.code, e.msg)
             return wire.pack({"result": out})
 
         return method
@@ -93,7 +117,7 @@ class OmGrpcService:
                 m["volume"], m["bucket"], m["key"], m.get("replication")
             )
         except OMError as e:
-            raise StorageError(e.code, str(e))
+            raise StorageError(e.code, e.msg)
         return wire.pack(
             {
                 "client_id": s.client_id,
@@ -101,6 +125,9 @@ class OmGrpcService:
                 "checksum_type": s.checksum_type,
                 "bytes_per_checksum": s.bytes_per_checksum,
                 "block_size": self.om.block_size,
+                # FSO sessions carry their tree position across the wire
+                "parent_id": s.parent_id,
+                "file_name": s.file_name,
             }
         )
 
@@ -124,11 +151,13 @@ class OmGrpcService:
             key = m["key"]
             client_id = m["client_id"]
             replication = ReplicationConfig.parse(m["replication"])
+            parent_id = m.get("parent_id")
+            file_name = m.get("file_name")
 
         try:
             self.om.commit_key(_S(), self._groups_from(m["groups"]), m["size"])
         except OMError as e:
-            raise StorageError(e.code, str(e))
+            raise StorageError(e.code, e.msg)
         return wire.pack({})
 
     @staticmethod
@@ -158,6 +187,8 @@ class RemoteOpenKeySession:
         self.replication = ReplicationConfig.parse(meta["replication"])
         self.checksum_type = meta["checksum_type"]
         self.bytes_per_checksum = meta["bytes_per_checksum"]
+        self.parent_id = meta.get("parent_id")
+        self.file_name = meta.get("file_name")
 
 
 class GrpcOmClient:
@@ -235,6 +266,8 @@ class GrpcOmClient:
             replication=str(session.replication),
             groups=[g.to_json() for g in groups],
             size=size,
+            parent_id=getattr(session, "parent_id", None),
+            file_name=getattr(session, "file_name", None),
         )
 
     def lookup_key(self, volume, bucket, key):
@@ -268,6 +301,22 @@ class GrpcOmClient:
     def rename_key(self, volume, bucket, key, new_key):
         self._call("RenameKey", volume=volume, bucket=bucket, key=key,
                    new_key=new_key)
+
+    # FSO file-system verbs
+    def create_directory(self, volume, bucket, path):
+        self._call("CreateDirectory", volume=volume, bucket=bucket, path=path)
+
+    def delete_directory(self, volume, bucket, path, recursive=False):
+        self._call("DeleteDirectory", volume=volume, bucket=bucket,
+                   path=path, recursive=recursive)
+
+    def get_file_status(self, volume, bucket, path):
+        return self._call("GetFileStatus", volume=volume, bucket=bucket,
+                          path=path)["result"]
+
+    def list_status(self, volume, bucket, path):
+        return self._call("ListStatus", volume=volume, bucket=bucket,
+                          path=path)["result"]
 
     def close(self):
         self._ch.close()

@@ -46,7 +46,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
             except StorageError as e:
                 context.abort(
                     grpc.StatusCode.ABORTED,
-                    json.dumps({"code": e.code, "message": str(e)}),
+                    json.dumps({"code": e.code, "message": e.msg}),
                 )
             except Exception as e:  # noqa: BLE001 - surface as INTERNAL
                 log.exception("rpc %s failed", handler_call_details.method)

@@ -1,0 +1,569 @@
+"""FILE_SYSTEM_OPTIMIZED (FSO) bucket layout: a true directory tree.
+
+Capability mirror of the reference's FSO layout (ozone-manager
+BucketLayoutAwareOMKeyRequestFactory.java routes key requests to
+OMFileCreateRequest / OMDirectoryCreateRequest / OMKeyRenameRequestWithFSO
+variants; interface-storage OMMetadataManager.java:375-642 defines the
+directoryTable/fileTable keyed by parent object id). Entries are stored as
+
+    dirs :  /{volume}/{bucket}/{parentId}/{name} -> {object_id, ...}
+    files:  /{volume}/{bucket}/{parentId}/{name} -> key info
+
+so a directory rename is O(1) — only the directory's own entry moves;
+children key off its immutable object id. Recursive delete moves the dir
+entry to the deleted_dirs table and a background DirectoryDeletingService
+(reference: service/DirectoryDeletingService.java) walks the subtree,
+feeding files into the deleted-key purge chain.
+
+Object ids are allocated in pre_execute on the leader and carried inside
+the request so follower applies are deterministic (the OMClientRequest
+preExecute/validateAndUpdateCache contract, OMClientRequest.java:114,143).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Iterator, Optional
+
+from ozone_tpu.om.metadata import OMMetadataStore, bucket_key
+from ozone_tpu.om.requests import (
+    BUCKET_NOT_FOUND,
+    KEY_NOT_FOUND,
+    OMError,
+    OMRequest,
+)
+
+DIRECTORY_NOT_FOUND = "DIRECTORY_NOT_FOUND"
+DIRECTORY_NOT_EMPTY = "DIRECTORY_NOT_EMPTY"
+NOT_A_FILE = "NOT_A_FILE"
+NOT_A_DIRECTORY = "NOT_A_DIRECTORY"
+FILE_ALREADY_EXISTS = "FILE_ALREADY_EXISTS"
+
+ROOT_ID = "0"  # every bucket's root directory object id
+
+
+def split_path(path: str) -> list[str]:
+    parts = [p for p in path.strip("/").split("/") if p]
+    for p in parts:
+        if p in (".", ".."):
+            raise OMError(NOT_A_FILE, f"illegal path component {p!r}")
+    return parts
+
+
+def dir_key(volume: str, bucket: str, parent_id: str, name: str) -> str:
+    return f"/{volume}/{bucket}/{parent_id}/{name}"
+
+
+def id_key(volume: str, bucket: str, object_id: str) -> str:
+    return f"/{volume}/{bucket}/{object_id}"
+
+
+def dir_alive(
+    store: OMMetadataStore, volume: str, bucket: str, object_id: str
+) -> bool:
+    return object_id == ROOT_ID or store.exists(
+        "dir_ids", id_key(volume, bucket, object_id)
+    )
+
+
+def resolve(
+    store: OMMetadataStore, volume: str, bucket: str, path: str
+) -> tuple[str, list[str]]:
+    """Walk the directory tree; return (deepest existing dir's object id,
+    unresolved trailing components)."""
+    parts = split_path(path)
+    parent = ROOT_ID
+    for i, name in enumerate(parts):
+        d = store.get("dirs", dir_key(volume, bucket, parent, name))
+        if d is None:
+            return parent, parts[i:]
+        parent = d["object_id"]
+    return parent, []
+
+
+def resolve_parent(
+    store: OMMetadataStore, volume: str, bucket: str, path: str
+) -> tuple[str, str]:
+    """Resolve the parent directory of `path`; return (parent_id, name).
+    Raises DIRECTORY_NOT_FOUND if an intermediate component is missing."""
+    parts = split_path(path)
+    if not parts:
+        raise OMError(NOT_A_FILE, "empty path")
+    parent, missing = resolve(store, volume, bucket, "/".join(parts[:-1]))
+    if missing:
+        raise OMError(DIRECTORY_NOT_FOUND, "/".join(parts[:-1]))
+    return parent, parts[-1]
+
+
+def _require_bucket(store: OMMetadataStore, volume: str, bucket: str) -> dict:
+    b = store.get("buckets", bucket_key(volume, bucket))
+    if b is None:
+        raise OMError(BUCKET_NOT_FOUND, f"{volume}/{bucket}")
+    return b
+
+
+def _ensure_parents(
+    store: OMMetadataStore,
+    volume: str,
+    bucket: str,
+    parts: list[str],
+    new_ids: list[str],
+    created: float,
+    conflict_code: str,
+) -> str:
+    """Create any missing directory components along `parts`, using the
+    leader-assigned `new_ids` for determinism; return the final dir's
+    object id. A file occupying a component raises `conflict_code`."""
+    parent = ROOT_ID
+    for i, name in enumerate(parts):
+        dk = dir_key(volume, bucket, parent, name)
+        d = store.get("dirs", dk)
+        if d is None:
+            if store.exists("files", dk):
+                raise OMError(conflict_code, dk)
+            d = {
+                "object_id": new_ids[i],
+                "name": name,
+                "parent_id": parent,
+                "created": created,
+            }
+            store.put("dirs", dk, d)
+            store.put("dir_ids", id_key(volume, bucket, d["object_id"]),
+                      {"parent_id": parent, "name": name})
+        parent = d["object_id"]
+    return parent
+
+
+@dataclass
+class CreateDirectory(OMRequest):
+    """mkdir -p: creates all missing components (OMDirectoryCreateRequest
+    with MissingParentInfos, reference request/file/)."""
+
+    volume: str
+    bucket: str
+    path: str
+    # ids pre-allocated on the leader, one per possibly-missing component
+    new_ids: list[str] = field(default_factory=list)
+    created: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.created = time.time()
+        self.new_ids = [
+            uuid.uuid4().hex[:16] for _ in split_path(self.path)
+        ]
+
+    def apply(self, store):
+        _require_bucket(store, self.volume, self.bucket)
+        return _ensure_parents(
+            store, self.volume, self.bucket, split_path(self.path),
+            self.new_ids, self.created, FILE_ALREADY_EXISTS,
+        )
+
+
+@dataclass
+class OpenFile(OMRequest):
+    """Open a file for write, creating missing parent dirs
+    (OMFileCreateRequest semantics)."""
+
+    volume: str
+    bucket: str
+    path: str
+    client_id: str
+    replication: str
+    checksum_type: str = "CRC32C"
+    bytes_per_checksum: int = 16 * 1024
+    overwrite: bool = True
+    new_dir_ids: list[str] = field(default_factory=list)
+    created: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.created = time.time()
+        self.new_dir_ids = [
+            uuid.uuid4().hex[:16] for _ in split_path(self.path)
+        ]
+
+    def apply(self, store):
+        _require_bucket(store, self.volume, self.bucket)
+        parts = split_path(self.path)
+        if not parts:
+            raise OMError(NOT_A_FILE, "empty path")
+        parent = _ensure_parents(
+            store, self.volume, self.bucket, parts[:-1],
+            self.new_dir_ids, self.created, NOT_A_DIRECTORY,
+        )
+        name = parts[-1]
+        fk = dir_key(self.volume, self.bucket, parent, name)
+        if store.exists("dirs", fk):
+            raise OMError(NOT_A_FILE, f"{fk} is a directory")
+        if not self.overwrite and store.exists("files", fk):
+            raise OMError(FILE_ALREADY_EXISTS, fk)
+        store.put(
+            "open_keys",
+            f"{fk}/{self.client_id}",
+            {
+                "volume": self.volume,
+                "bucket": self.bucket,
+                "name": self.path.strip("/"),
+                "file_name": name,
+                "parent_id": parent,
+                "replication": self.replication,
+                "checksum_type": self.checksum_type,
+                "bytes_per_checksum": self.bytes_per_checksum,
+                "size": 0,
+                "block_groups": [],
+                "created": self.created,
+                "modified": self.created,
+            },
+        )
+        return parent
+
+
+@dataclass
+class CommitFile(OMRequest):
+    """Move an open-file session into the file table (OMFileCreateRequest's
+    commit counterpart, keyed by parent object id)."""
+
+    volume: str
+    bucket: str
+    parent_id: str
+    file_name: str
+    client_id: str
+    size: int
+    block_groups: list[dict] = field(default_factory=list)
+    modified: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.modified = time.time()
+
+    def apply(self, store):
+        fk = dir_key(self.volume, self.bucket, self.parent_id, self.file_name)
+        open_k = f"{fk}/{self.client_id}"
+        info = store.get("open_keys", open_k)
+        if info is None:
+            raise OMError(KEY_NOT_FOUND, f"no open session {open_k}")
+        if not dir_alive(store, self.volume, self.bucket, self.parent_id):
+            # parent was recursively deleted while the key was open; refuse
+            # the commit so the file row can't become unreachable, and hand
+            # the already-written blocks to the deleted-key purge chain
+            store.delete("open_keys", open_k)
+            info.update(size=self.size, block_groups=self.block_groups)
+            store.put("deleted_keys", f"{fk}:{self.modified}", info)
+            raise OMError(DIRECTORY_NOT_FOUND,
+                          f"parent of {fk} deleted during write")
+        info.update(
+            {
+                "size": self.size,
+                "block_groups": self.block_groups,
+                "modified": self.modified,
+            }
+        )
+        store.delete("open_keys", open_k)
+        # overwrite: the previous version's blocks must reach the purge
+        # chain or they leak on the datanodes
+        old = store.get("files", fk)
+        if old is not None and old.get("block_groups"):
+            store.put("deleted_keys", f"{fk}:{self.modified}", old)
+        store.put("files", fk, info)
+        return info
+
+
+@dataclass
+class DeleteFile(OMRequest):
+    volume: str
+    bucket: str
+    path: str
+    ts: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.ts = time.time()
+
+    def apply(self, store):
+        parent, name = resolve_parent(store, self.volume, self.bucket, self.path)
+        fk = dir_key(self.volume, self.bucket, parent, name)
+        info = store.get("files", fk)
+        if info is None:
+            if store.exists("dirs", fk):
+                raise OMError(NOT_A_FILE, f"{fk} is a directory")
+            raise OMError(KEY_NOT_FOUND, fk)
+        store.delete("files", fk)
+        store.put("deleted_keys", f"{fk}:{self.ts}", info)
+        return info
+
+
+@dataclass
+class DeleteDirectory(OMRequest):
+    """Detach a directory (recursive) or remove an empty one. The subtree
+    is purged asynchronously by DirectoryDeletingService — matching the
+    reference where OMKeyDeleteRequestWithFSO moves the dir into the
+    deletedDirectoryTable."""
+
+    volume: str
+    bucket: str
+    path: str
+    recursive: bool = False
+    ts: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.ts = time.time()
+
+    def apply(self, store):
+        parent, name = resolve_parent(store, self.volume, self.bucket, self.path)
+        dk = dir_key(self.volume, self.bucket, parent, name)
+        d = store.get("dirs", dk)
+        if d is None:
+            raise OMError(DIRECTORY_NOT_FOUND, dk)
+        prefix = f"/{self.volume}/{self.bucket}/{d['object_id']}/"
+        has_children = (
+            next(store.iterate("dirs", prefix), None) is not None
+            or next(store.iterate("files", prefix), None) is not None
+        )
+        if has_children and not self.recursive:
+            raise OMError(DIRECTORY_NOT_EMPTY, dk)
+        store.delete("dirs", dk)
+        store.delete("dir_ids", id_key(self.volume, self.bucket,
+                                       d["object_id"]))
+        store.put(
+            "deleted_dirs",
+            f"/{self.volume}/{self.bucket}/{d['object_id']}:{self.ts}",
+            {"volume": self.volume, "bucket": self.bucket, **d},
+        )
+
+
+@dataclass
+class RenameEntry(OMRequest):
+    """Rename a file or directory. Directory rename moves ONE row — the
+    whole subtree follows because children are keyed by the directory's
+    object id (OMKeyRenameRequestWithFSO)."""
+
+    volume: str
+    bucket: str
+    src: str
+    dst: str
+    ts: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.ts = time.time()
+
+    def apply(self, store):
+        src_parent, src_name = resolve_parent(
+            store, self.volume, self.bucket, self.src
+        )
+        dst_parent, dst_name = resolve_parent(
+            store, self.volume, self.bucket, self.dst
+        )
+        sk = dir_key(self.volume, self.bucket, src_parent, src_name)
+        dk = dir_key(self.volume, self.bucket, dst_parent, dst_name)
+        if store.exists("dirs", dk) or store.exists("files", dk):
+            raise OMError(FILE_ALREADY_EXISTS, dk)
+        d = store.get("dirs", sk)
+        if d is not None:
+            # moving a dir under its own subtree would orphan it
+            p = dst_parent
+            while p != ROOT_ID:
+                if p == d["object_id"]:
+                    raise OMError(NOT_A_DIRECTORY,
+                                  f"cannot move {sk} into its own subtree")
+                p = _parent_of(store, self.volume, self.bucket, p)
+            d.update(name=dst_name, parent_id=dst_parent, modified=self.ts)
+            store.delete("dirs", sk)
+            store.put("dirs", dk, d)
+            store.put("dir_ids",
+                      id_key(self.volume, self.bucket, d["object_id"]),
+                      {"parent_id": dst_parent, "name": dst_name})
+            return d
+        f = store.get("files", sk)
+        if f is None:
+            raise OMError(KEY_NOT_FOUND, sk)
+        f.update(file_name=dst_name, parent_id=dst_parent, modified=self.ts)
+        store.delete("files", sk)
+        store.put("files", dk, f)
+        return f
+
+
+def _parent_of(
+    store: OMMetadataStore, volume: str, bucket: str, object_id: str
+) -> str:
+    """O(1) parent lookup via the dir_ids index (rename-cycle check)."""
+    e = store.get("dir_ids", id_key(volume, bucket, object_id))
+    return e["parent_id"] if e else ROOT_ID
+
+
+@dataclass
+class PurgeDirectories(OMRequest):
+    """Apply one batch of DirectoryDeletingService work: move files under
+    deleted dirs into deleted_keys, re-queue child dirs, drop finished
+    entries (reference service/DirectoryDeletingService.java purge path)."""
+
+    # [(deleted_dirs key to drop, [(file key, info)...], [(child dir key, info)...])]
+    drops: list[str] = field(default_factory=list)
+    file_moves: list[list] = field(default_factory=list)  # [files key, info, ts]
+    dir_moves: list[list] = field(default_factory=list)  # [deleted_dirs key, info]
+
+    def apply(self, store):
+        for fk, info, ts in self.file_moves:
+            store.delete("files", fk)
+            store.put("deleted_keys", f"{fk}:{ts}", info)
+        for dk, info in self.dir_moves:
+            store.delete("dirs", dk)
+            store.delete("dir_ids",
+                         id_key(info["volume"], info["bucket"],
+                                info["object_id"]))
+            store.put("deleted_dirs", dk_suffix(dk, info), info)
+        for k in self.drops:
+            # re-check emptiness at apply time: a file committed between the
+            # service's scan and this apply must not be orphaned
+            info = store.get("deleted_dirs", k)
+            if info is not None:
+                prefix = (f"/{info['volume']}/{info['bucket']}/"
+                          f"{info['object_id']}/")
+                if (next(store.iterate("files", prefix), None) is not None
+                        or next(store.iterate("dirs", prefix), None)
+                        is not None):
+                    continue  # keep queued; next pass collects the stragglers
+            store.delete("deleted_dirs", k)
+
+
+def dk_suffix(dk: str, info: dict) -> str:
+    return f"/{info['volume']}/{info['bucket']}/{info['object_id']}:{info.get('ts', 0)}"
+
+
+class DirectoryDeletingService:
+    """Background subtree reaper. Each run() pass collects up to `limit`
+    children of detached directories and submits one PurgeDirectories
+    request (so HA replicas stay in sync)."""
+
+    def __init__(self, om):
+        self.om = om
+
+    def run_once(self, limit: int = 256) -> int:
+        store = self.om.store
+        drops: list[str] = []
+        file_moves: list[list] = []
+        dir_moves: list[list] = []
+        n = 0
+        ts = time.time()
+        for ddk, d in list(store.iterate("deleted_dirs")):
+            if n >= limit:
+                break
+            vol, bkt = d["volume"], d["bucket"]
+            prefix = f"/{vol}/{bkt}/{d['object_id']}/"
+            exhausted = True
+            for fk, info in store.iterate("files", prefix):
+                file_moves.append([fk, info, ts])
+                n += 1
+                if n >= limit:
+                    exhausted = False
+                    break
+            if exhausted:
+                for dk, child in store.iterate("dirs", prefix):
+                    dir_moves.append(
+                        [dk, {"volume": vol, "bucket": bkt, "ts": ts, **child}]
+                    )
+                    n += 1
+                    if n >= limit:
+                        exhausted = False
+                        break
+            if exhausted:
+                drops.append(ddk)
+                n += 1
+        if not (drops or file_moves or dir_moves):
+            return 0
+        self.om.submit(
+            PurgeDirectories(
+                drops=drops, file_moves=file_moves, dir_moves=dir_moves
+            )
+        )
+        return n
+
+    def run_to_completion(self, max_rounds: int = 1000) -> int:
+        total = 0
+        for _ in range(max_rounds):
+            got = self.run_once()
+            if got == 0:
+                return total
+            total += got
+        return total
+
+
+# --------------------------------------------------------------- read paths
+def get_status(
+    store: OMMetadataStore, volume: str, bucket: str, path: str
+) -> dict:
+    """getFileStatus: file or directory info (reference
+    KeyManagerImpl.getFileStatus)."""
+    parts = split_path(path)
+    if not parts:
+        return {"type": "DIRECTORY", "name": "", "object_id": ROOT_ID}
+    parent, missing = resolve(store, volume, bucket, "/".join(parts[:-1]))
+    if missing:
+        raise OMError(KEY_NOT_FOUND, path)
+    ek = dir_key(volume, bucket, parent, parts[-1])
+    d = store.get("dirs", ek)
+    if d is not None:
+        return {"type": "DIRECTORY", **d, "name": "/".join(parts)}
+    f = store.get("files", ek)
+    if f is not None:
+        # 'name' is derived from the traversal, never from the stored row —
+        # ancestors may have been renamed since the file was written
+        return {"type": "FILE", **f, "name": "/".join(parts)}
+    raise OMError(KEY_NOT_FOUND, path)
+
+
+def _list_children(
+    store: OMMetadataStore, volume: str, bucket: str, object_id: str,
+    base: str,
+) -> list[dict]:
+    """Immediate children of a directory known by object id — no path
+    re-resolution. Dirs first then files, each sorted by name."""
+    prefix = f"/{volume}/{bucket}/{object_id}/"
+    out = []
+    for _, d in store.iterate("dirs", prefix):
+        full = f"{base}/{d['name']}" if base else d["name"]
+        out.append({"type": "DIRECTORY", **d, "path": full, "name": full})
+    for _, f in store.iterate("files", prefix):
+        full = f"{base}/{f['file_name']}" if base else f["file_name"]
+        out.append({"type": "FILE", **f, "path": full, "name": full})
+    return out
+
+
+def list_status(
+    store: OMMetadataStore, volume: str, bucket: str, path: str
+) -> list[dict]:
+    """listStatus: immediate children of a directory (or the file itself)."""
+    st = get_status(store, volume, bucket, path)
+    if st["type"] != "DIRECTORY":
+        return [st]
+    return _list_children(store, volume, bucket, st["object_id"],
+                          "/".join(split_path(path)))
+
+
+def walk_files(
+    store: OMMetadataStore, volume: str, bucket: str, path: str = ""
+) -> Iterator[dict]:
+    """Recursive file iterator in path order (for listKeys on FSO
+    buckets). One store scan per directory — ancestors are resolved once
+    at the root, then object ids thread through the recursion."""
+    st = get_status(store, volume, bucket, path)
+    if st["type"] == "FILE":
+        yield st
+        return
+
+    def _walk(object_id: str, base: str) -> Iterator[dict]:
+        for entry in _list_children(store, volume, bucket, object_id, base):
+            if entry["type"] == "FILE":
+                yield entry
+            else:
+                yield from _walk(entry["object_id"], entry["path"])
+
+    yield from _walk(st["object_id"], "/".join(split_path(path)))
+
+
+def lookup_file(
+    store: OMMetadataStore, volume: str, bucket: str, path: str
+) -> dict:
+    st = get_status(store, volume, bucket, path)
+    if st["type"] != "FILE":
+        raise OMError(NOT_A_FILE, path)
+    return st

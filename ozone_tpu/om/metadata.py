@@ -17,7 +17,20 @@ import threading
 from pathlib import Path
 from typing import Any, Iterator, Optional
 
-_TABLES = ("volumes", "buckets", "keys", "open_keys", "deleted_keys")
+_TABLES = (
+    "volumes",
+    "buckets",
+    "keys",
+    "open_keys",
+    "deleted_keys",
+    # FSO layout tables (dir/file entries keyed by parent object id,
+    # reference interface-storage OMMetadataManager.java:375-642)
+    "dirs",
+    "dir_ids",  # object_id -> {parent_id, name}: O(1) liveness/ancestry
+    "files",
+    "deleted_dirs",
+    "multipart",
+)
 
 
 class OMMetadataStore:

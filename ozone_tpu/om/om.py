@@ -48,6 +48,9 @@ class OpenKeySession:
         self.replication = ReplicationConfig.parse(info["replication"])
         self.checksum_type = info["checksum_type"]
         self.bytes_per_checksum = info["bytes_per_checksum"]
+        # FSO sessions carry their resolved tree position
+        self.parent_id: Optional[str] = info.get("parent_id")
+        self.file_name: Optional[str] = info.get("file_name")
 
 
 class OzoneManager:
@@ -121,6 +124,9 @@ class OzoneManager:
         ]
 
     # ----------------------------------------------------------- keys
+    def _is_fso(self, binfo: dict) -> bool:
+        return binfo.get("layout") == "FILE_SYSTEM_OPTIMIZED"
+
     def open_key(
         self,
         volume: str,
@@ -128,14 +134,21 @@ class OzoneManager:
         key: str,
         replication: Optional[str] = None,
     ) -> OpenKeySession:
+        from ozone_tpu.om import fso
+
         binfo = self.bucket_info(volume, bucket)
         repl = replication or binfo["replication"]
         client_id = uuid.uuid4().hex[:16]
-        req = rq.OpenKey(volume, bucket, key, client_id, repl)
-        self.submit(req)
-        info = self.store.get(
-            "open_keys", f"{key_key(volume, bucket, key)}/{client_id}"
-        )
+        if self._is_fso(binfo):
+            req = fso.OpenFile(volume, bucket, key, client_id, repl)
+            parent = self.submit(req)
+            name = fso.split_path(key)[-1]
+            open_k = f"{fso.dir_key(volume, bucket, parent, name)}/{client_id}"
+        else:
+            req = rq.OpenKey(volume, bucket, key, client_id, repl)
+            self.submit(req)
+            open_k = f"{key_key(volume, bucket, key)}/{client_id}"
+        info = self.store.get("open_keys", open_k)
         self.metrics.counter("keys_opened").inc()
         return OpenKeySession(self, info, client_id)
 
@@ -151,21 +164,41 @@ class OzoneManager:
     def commit_key(
         self, session: OpenKeySession, groups: list[BlockGroup], size: int
     ) -> None:
-        self.submit(
-            rq.CommitKey(
-                session.volume,
-                session.bucket,
-                session.key,
-                session.client_id,
-                size,
-                [g.to_json() for g in groups],
-                replication=str(session.replication),
+        from ozone_tpu.om import fso
+
+        if session.parent_id is not None:
+            self.submit(
+                fso.CommitFile(
+                    session.volume,
+                    session.bucket,
+                    session.parent_id,
+                    session.file_name,
+                    session.client_id,
+                    size,
+                    [g.to_json() for g in groups],
+                )
             )
-        )
+        else:
+            self.submit(
+                rq.CommitKey(
+                    session.volume,
+                    session.bucket,
+                    session.key,
+                    session.client_id,
+                    size,
+                    [g.to_json() for g in groups],
+                    replication=str(session.replication),
+                )
+            )
         self.metrics.counter("keys_committed").inc()
 
     def lookup_key(self, volume: str, bucket: str, key: str) -> dict:
-        info = self.store.get("keys", key_key(volume, bucket, key))
+        from ozone_tpu.om import fso
+
+        if self._is_fso(self.bucket_info(volume, bucket)):
+            info = fso.lookup_file(self.store, volume, bucket, key)
+        else:
+            info = self.store.get("keys", key_key(volume, bucket, key))
         if info is None:
             raise rq.OMError(rq.KEY_NOT_FOUND, f"{volume}/{bucket}/{key}")
         self.metrics.counter("key_lookups").inc()
@@ -187,16 +220,72 @@ class OzoneManager:
         return out
 
     def list_keys(self, volume: str, bucket: str, prefix: str = "") -> list[dict]:
-        self.bucket_info(volume, bucket)  # raises BUCKET_NOT_FOUND
+        from ozone_tpu.om import fso
+
+        binfo = self.bucket_info(volume, bucket)  # raises BUCKET_NOT_FOUND
+        if self._is_fso(binfo):
+            return [
+                f for f in fso.walk_files(self.store, volume, bucket)
+                if f.get("name", "").startswith(prefix)
+            ]
         base = bucket_key(volume, bucket) + "/"
         return [k for _, k in self.store.iterate("keys", base + prefix)]
 
     def delete_key(self, volume: str, bucket: str, key: str) -> None:
-        self.submit(rq.DeleteKey(volume, bucket, key))
+        from ozone_tpu.om import fso
+
+        if self._is_fso(self.bucket_info(volume, bucket)):
+            self.submit(fso.DeleteFile(volume, bucket, key))
+        else:
+            self.submit(rq.DeleteKey(volume, bucket, key))
         self.metrics.counter("keys_deleted").inc()
 
     def rename_key(self, volume: str, bucket: str, key: str, new_key: str) -> None:
-        self.submit(rq.RenameKey(volume, bucket, key, new_key))
+        from ozone_tpu.om import fso
+
+        if self._is_fso(self.bucket_info(volume, bucket)):
+            self.submit(fso.RenameEntry(volume, bucket, key, new_key))
+        else:
+            self.submit(rq.RenameKey(volume, bucket, key, new_key))
+
+    # ----------------------------------------------------- FSO file system
+    def create_directory(self, volume: str, bucket: str, path: str) -> None:
+        from ozone_tpu.om import fso
+
+        self._require_fso(volume, bucket)
+        self.submit(fso.CreateDirectory(volume, bucket, path))
+
+    def _require_fso(self, volume: str, bucket: str) -> None:
+        from ozone_tpu.om import fso
+
+        if not self._is_fso(self.bucket_info(volume, bucket)):
+            raise rq.OMError(fso.NOT_A_DIRECTORY,
+                             f"{volume}/{bucket} is not an FSO bucket")
+
+    def delete_directory(
+        self, volume: str, bucket: str, path: str, recursive: bool = False
+    ) -> None:
+        from ozone_tpu.om import fso
+
+        self._require_fso(volume, bucket)
+        self.submit(fso.DeleteDirectory(volume, bucket, path, recursive))
+
+    def get_file_status(self, volume: str, bucket: str, path: str) -> dict:
+        from ozone_tpu.om import fso
+
+        self._require_fso(volume, bucket)
+        return fso.get_status(self.store, volume, bucket, path)
+
+    def list_status(self, volume: str, bucket: str, path: str) -> list[dict]:
+        from ozone_tpu.om import fso
+
+        self._require_fso(volume, bucket)
+        return fso.list_status(self.store, volume, bucket, path)
+
+    def run_dir_deleting_service_once(self, limit: int = 256) -> int:
+        from ozone_tpu.om import fso
+
+        return fso.DirectoryDeletingService(self).run_once(limit)
 
     # ----------------------------------------------------------- services
     def run_key_deleting_service_once(self, limit: int = 100) -> int:

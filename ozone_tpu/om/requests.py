@@ -32,6 +32,7 @@ class OMError(Exception):
     def __init__(self, code: str, msg: str = ""):
         super().__init__(f"{code}: {msg}" if msg else code)
         self.code = code
+        self.msg = msg  # bare message for re-wrapping without code stacking
 
 
 VOLUME_NOT_FOUND = "VOLUME_NOT_FOUND"
@@ -157,8 +158,11 @@ class DeleteBucket(OMRequest):
         k = bucket_key(self.volume, self.bucket)
         if not store.exists("buckets", k):
             raise OMError(BUCKET_NOT_FOUND, k)
-        if next(store.iterate("keys", k + "/"), None) is not None:
-            raise OMError(BUCKET_NOT_EMPTY, k)
+        # FSO buckets keep their namespace in dirs/files, not keys; a
+        # detached-but-unpurged subtree still counts as non-empty
+        for table in ("keys", "files", "dirs", "deleted_dirs"):
+            if next(store.iterate(table, k + "/"), None) is not None:
+                raise OMError(BUCKET_NOT_EMPTY, k)
         store.delete("buckets", k)
 
 
@@ -195,6 +199,11 @@ class CommitKey(OMRequest):
             }
         )
         store.delete("open_keys", open_k)
+        # overwrite: the previous version's blocks must reach the purge
+        # chain or they leak on the datanodes
+        old = store.get("keys", kk)
+        if old is not None and old.get("block_groups"):
+            store.put("deleted_keys", f"{kk}:{self.modified}", old)
         store.put("keys", kk, info)
         return info
 

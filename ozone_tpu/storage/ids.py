@@ -118,6 +118,7 @@ class StorageError(Exception):
     def __init__(self, code: str, msg: str = ""):
         super().__init__(f"{code}: {msg}" if msg else code)
         self.code = code
+        self.msg = msg  # bare message for re-wrapping without code stacking
 
 
 # Result codes (subset of DatanodeClientProtocol.proto Result)

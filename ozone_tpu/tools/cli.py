@@ -24,6 +24,8 @@ from pathlib import Path
 
 import numpy as np
 
+from ozone_tpu.storage.ids import StorageError
+
 
 def _client(args):
     from ozone_tpu.client.dn_client import DatanodeClientFactory
@@ -73,7 +75,8 @@ def cmd_sh(args) -> int:
         else:
             vol, bucket = parts
             if verb == "create":
-                oz.om.create_bucket(vol, bucket, args.replication)
+                oz.om.create_bucket(vol, bucket, args.replication,
+                                    layout=args.layout)
             elif verb == "delete":
                 oz.om.delete_bucket(vol, bucket)
             elif verb == "info":
@@ -105,6 +108,31 @@ def cmd_sh(args) -> int:
             _emit(oz.om.lookup_key(vol, bucket, key))
         elif verb == "rename":
             b.rename_key(key, args.to)
+    return 0
+
+
+# ---------------------------------------------------------------------- fs
+def cmd_fs(args) -> int:
+    """Filesystem verbs against FSO buckets (reference: ozone fs via the
+    Hadoop shell — mkdir/ls/stat/rm on o3fs paths)."""
+    oz = _client(args)
+    vol, bucket, *rest = _parse_path(args.path)
+    path = "/".join(rest)
+    om = oz.om
+    if args.verb == "mkdir":
+        om.create_directory(vol, bucket, path)
+        print(f"created directory /{vol}/{bucket}/{path}")
+    elif args.verb == "ls":
+        _emit(om.list_status(vol, bucket, path))
+    elif args.verb == "stat":
+        _emit(om.get_file_status(vol, bucket, path))
+    elif args.verb == "rm":
+        st = om.get_file_status(vol, bucket, path)
+        if st["type"] == "DIRECTORY":
+            om.delete_directory(vol, bucket, path, recursive=args.recursive)
+        else:
+            om.delete_key(vol, bucket, path)
+        print(f"deleted /{vol}/{bucket}/{path}")
     return 0
 
 
@@ -200,7 +228,19 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("--om", default="127.0.0.1:9860")
     sh.add_argument("--replication", default="")
     sh.add_argument("--to", default="", help="rename target")
+    sh.add_argument("--layout", default="OBJECT_STORE",
+                    choices=["OBJECT_STORE", "FILE_SYSTEM_OPTIMIZED"],
+                    help="bucket layout (reference: ozone sh bucket create "
+                         "--layout)")
     sh.set_defaults(fn=cmd_sh)
+
+    fs = sub.add_parser("fs", help="file-system verbs on FSO buckets "
+                                   "(ozone fs analog)")
+    fs.add_argument("verb", choices=["mkdir", "ls", "stat", "rm"])
+    fs.add_argument("path", help="/volume/bucket[/dir/path]")
+    fs.add_argument("-r", "--recursive", action="store_true")
+    fs.add_argument("--om", default="127.0.0.1:9860")
+    fs.set_defaults(fn=cmd_fs)
 
     ad = sub.add_parser("admin", help="cluster admin (ozone admin analog)")
     ad.add_argument("subject", choices=["safemode", "datanode", "status"])
@@ -318,7 +358,13 @@ def cmd_debug(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except StorageError as e:
+        # one clean line, not a traceback (ozone sh prints the OMException
+        # result code the same way)
+        print(f"error {e.code}: {e.msg}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,232 @@
+"""FSO bucket layout: directory tree semantics.
+
+Mirrors the reference's FSO coverage (ozone-manager request/file tests,
+TestObjectStoreWithFSO): nested file create with implicit parents, dir
+rename moving subtrees in O(1), recursive delete via the directory
+deleting service feeding the deleted-key purge chain.
+"""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.om import fso
+from ozone_tpu.om.requests import OMError, OMRequest
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = MiniOzoneCluster(tmp_path, num_datanodes=5)
+    c.client().create_volume("vol")
+    c.om.create_bucket("vol", "fsb", replication="rs-3-2-64k",
+                       layout="FILE_SYSTEM_OPTIMIZED")
+    yield c
+    c.close()
+
+
+def _bucket(cluster):
+    return cluster.client().get_volume("vol").get_bucket("fsb")
+
+
+def test_nested_write_read(cluster):
+    b = _bucket(cluster)
+    data = np.frombuffer(np.random.default_rng(0).bytes(300_000), np.uint8)
+    b.write_key("a/b/c/file.bin", data)
+    out = b.read_key("a/b/c/file.bin")
+    assert np.array_equal(out, data)
+    # implicit parents exist as real directory entries
+    st = cluster.om.get_file_status("vol", "fsb", "a/b")
+    assert st["type"] == "DIRECTORY"
+    st = cluster.om.get_file_status("vol", "fsb", "a/b/c/file.bin")
+    assert st["type"] == "FILE" and st["size"] == data.size
+
+
+def test_mkdir_and_list_status(cluster):
+    om = cluster.om
+    om.create_directory("vol", "fsb", "x/y/z")
+    _bucket(cluster).write_key("x/y/f1", b"11111")
+    _bucket(cluster).write_key("x/f2", b"22222")
+    names = [(e["type"], e["path"]) for e in om.list_status("vol", "fsb", "x")]
+    assert ("DIRECTORY", "x/y") in names
+    assert ("FILE", "x/f2") in names
+    assert ("DIRECTORY", "x/y/z") in [
+        (e["type"], e["path"]) for e in om.list_status("vol", "fsb", "x/y")
+    ]
+    # root listing
+    assert [e["path"] for e in om.list_status("vol", "fsb", "")] == ["x"]
+
+
+def test_list_keys_recursive(cluster):
+    b = _bucket(cluster)
+    for p in ("d1/k1", "d1/d2/k2", "k0"):
+        b.write_key(p, b"data")
+    names = sorted(k["name"] for k in b.list_keys())
+    assert names == ["d1/d2/k2", "d1/k1", "k0"]
+    assert [k["name"] for k in b.list_keys(prefix="d1/")] == [
+        "d1/d2/k2", "d1/k1"]
+
+
+def test_dir_rename_moves_subtree(cluster):
+    b = _bucket(cluster)
+    b.write_key("src/deep/file", b"payload")
+    cluster.om.rename_key("vol", "fsb", "src", "dst")
+    assert bytes(b.read_key("dst/deep/file")) == b"payload"
+    with pytest.raises(OMError):
+        cluster.om.get_file_status("vol", "fsb", "src/deep/file")
+
+
+def test_file_rename(cluster):
+    b = _bucket(cluster)
+    b.write_key("a/old", b"v")
+    b.rename_key("a/old", "a/new")
+    assert bytes(b.read_key("a/new")) == b"v"
+
+
+def test_rename_into_own_subtree_rejected(cluster):
+    om = cluster.om
+    om.create_directory("vol", "fsb", "p/q")
+    with pytest.raises(OMError):
+        om.rename_key("vol", "fsb", "p", "p/q/p2")
+
+
+def test_delete_nonrecursive_requires_empty(cluster):
+    b = _bucket(cluster)
+    b.write_key("d/f", b"x")
+    with pytest.raises(OMError) as ei:
+        cluster.om.delete_directory("vol", "fsb", "d")
+    assert ei.value.code == fso.DIRECTORY_NOT_EMPTY
+    b.delete_key("d/f")
+    cluster.om.delete_directory("vol", "fsb", "d")
+    with pytest.raises(OMError):
+        cluster.om.get_file_status("vol", "fsb", "d")
+
+
+def test_recursive_delete_purges_subtree(cluster):
+    b = _bucket(cluster)
+    for p in ("big/a/f1", "big/a/f2", "big/b/c/f3", "big/f4"):
+        b.write_key(p, b"some bytes here")
+    cluster.om.delete_directory("vol", "fsb", "big", recursive=True)
+    # detached immediately: no longer visible
+    with pytest.raises(OMError):
+        cluster.om.get_file_status("vol", "fsb", "big/a/f1")
+    # the background service drains the subtree into deleted_keys
+    svc = fso.DirectoryDeletingService(cluster.om)
+    svc.run_to_completion()
+    assert list(cluster.om.store.iterate("deleted_dirs")) == []
+    assert list(cluster.om.store.iterate("files", "/vol/fsb/")) == []
+    deleted = list(cluster.om.store.iterate("deleted_keys"))
+    assert len(deleted) == 4
+    # and the key-deleting service hands their blocks to SCM for purge
+    purged = cluster.om.run_key_deleting_service_once()
+    assert purged == 4
+
+
+def test_overwrite_and_type_conflicts(cluster):
+    b = _bucket(cluster)
+    b.write_key("c/f", b"one")
+    b.write_key("c/f", b"two")  # overwrite allowed
+    assert bytes(b.read_key("c/f")) == b"two"
+    # a directory can't be opened as a file
+    with pytest.raises(OMError):
+        b.write_key("c", b"clobber")
+    # a file can't be a parent directory
+    with pytest.raises(OMError):
+        b.write_key("c/f/under", b"x")
+
+
+def test_fso_requests_roundtrip_wire_form(cluster):
+    """FSO requests replicate through the HA log like any other request."""
+    reqs = [
+        fso.CreateDirectory("v", "b", "a/b", new_ids=["1", "2"], created=1.0),
+        fso.OpenFile("v", "b", "a/f", "cid", "rs-3-2-64k",
+                     new_dir_ids=["3"], created=2.0),
+        fso.CommitFile("v", "b", "3", "f", "cid", 10, [], modified=3.0),
+        fso.DeleteFile("v", "b", "a/f", ts=4.0),
+        fso.DeleteDirectory("v", "b", "a", recursive=True, ts=5.0),
+        fso.RenameEntry("v", "b", "a", "z", ts=6.0),
+        fso.PurgeDirectories(drops=["k"], file_moves=[], dir_moves=[]),
+    ]
+    for r in reqs:
+        wire = r.to_json()
+        back = OMRequest.from_json(wire)
+        assert back == r
+
+
+def test_list_names_follow_ancestor_rename(cluster):
+    """Listings derive names from the tree walk, not stored rows — an
+    ancestor rename must be reflected everywhere."""
+    b = _bucket(cluster)
+    b.write_key("top/mid/leaf", b"v")
+    cluster.om.rename_key("vol", "fsb", "top", "newtop")
+    assert [k["name"] for k in b.list_keys()] == ["newtop/mid/leaf"]
+    assert b.list_keys(prefix="newtop/") and not b.list_keys(prefix="top/")
+    st = cluster.om.get_file_status("vol", "fsb", "newtop/mid/leaf")
+    assert st["name"] == "newtop/mid/leaf"
+
+
+def test_commit_into_deleted_dir_rejected(cluster):
+    """A commit racing a recursive delete must not leak an unreachable
+    file: the commit fails and the written blocks go to the purge chain."""
+    om = cluster.om
+    b = _bucket(cluster)
+    h = b.open_key("gone/part")
+    h.write(b"block data written before the delete")
+    om.create_directory("vol", "fsb", "gone/sub")  # make it non-empty
+    om.delete_directory("vol", "fsb", "gone", recursive=True)
+    fso.DirectoryDeletingService(om).run_to_completion()
+    with pytest.raises(OMError) as ei:
+        h.close()
+    assert ei.value.code == fso.DIRECTORY_NOT_FOUND
+    # no unreachable row; blocks queued for reclaim
+    assert list(om.store.iterate("files", "/vol/fsb/")) == []
+    assert len(list(om.store.iterate("deleted_keys"))) == 1
+
+
+def test_fs_ops_validate_bucket(cluster):
+    om = cluster.om
+    with pytest.raises(OMError):
+        om.list_status("vol", "nope", "")
+    with pytest.raises(OMError):
+        om.get_file_status("vol", "nope", "")
+    om.create_bucket("vol", "flat", replication="rs-3-2-64k")
+    with pytest.raises(OMError):
+        om.list_status("vol", "flat", "")
+
+
+def test_overwrite_queues_old_blocks(cluster):
+    """Rewriting a key must send the old version's blocks to the purge
+    chain (both layouts)."""
+    b = _bucket(cluster)
+    b.write_key("ow/f", b"version one")
+    b.write_key("ow/f", b"version two")
+    dels = list(cluster.om.store.iterate("deleted_keys"))
+    assert len(dels) == 1 and dels[0][1]["block_groups"]
+    cluster.om.create_bucket("vol", "obs2", replication="rs-3-2-64k")
+    ob = cluster.client().get_volume("vol").get_bucket("obs2")
+    ob.write_key("k", b"one")
+    ob.write_key("k", b"two")
+    assert len(list(cluster.om.store.iterate("deleted_keys"))) == 2
+
+
+def test_delete_bucket_requires_fso_empty(cluster):
+    b = _bucket(cluster)
+    b.write_key("d/f", b"x")
+    with pytest.raises(OMError) as ei:
+        cluster.om.delete_bucket("vol", "fsb")
+    assert ei.value.code == "BUCKET_NOT_EMPTY"
+    cluster.om.delete_directory("vol", "fsb", "d", recursive=True)
+    # still not empty: detached subtree awaits the deleting service
+    with pytest.raises(OMError):
+        cluster.om.delete_bucket("vol", "fsb")
+    fso.DirectoryDeletingService(cluster.om).run_to_completion()
+    cluster.om.delete_bucket("vol", "fsb")
+
+
+def test_obs_bucket_unaffected(cluster):
+    """OBS flat layout continues to treat '/' as opaque key bytes."""
+    cluster.om.create_bucket("vol", "obs", replication="rs-3-2-64k")
+    ob = cluster.client().get_volume("vol").get_bucket("obs")
+    ob.write_key("a/b/c", b"flat")
+    assert bytes(ob.read_key("a/b/c")) == b"flat"
+    with pytest.raises(OMError):
+        cluster.om.create_directory("vol", "obs", "a")
