@@ -72,6 +72,14 @@ class ContainerManager:
         # open writable containers by replication-scheme string
         self._writable: dict[str, list[int]] = {}
         self._lock = threading.RLock()
+        # SCM-HA hook: called with (row, counters) after every durable
+        # state mutation; the leader's ReplicatedSCM ships these records
+        # through the replicated log (the reference replicates leader
+        # decisions the same way via @Replicate proxies: the marshalled
+        # SCMRatisRequest carries the resulting container info, not the
+        # nondeterministic placement computation — server-scm ha/
+        # SCMHAInvocationHandler + SCMRatisRequest).
+        self.mutation_listener = None
         # optional persistence (reference: SCM metadata in RocksDB with
         # HA-safe SequenceIdGenerator; replicas rebuild from reports)
         self._db = None
@@ -98,18 +106,67 @@ class ContainerManager:
         self._next_cid = state["next_container_id"]
         self._next_lid = state["next_local_id"]
 
+    def _row(self, c: ContainerInfo) -> dict:
+        return {
+            "id": c.id,
+            "replication": str(c.replication),
+            "nodes": c.pipeline.nodes if c.pipeline else [],
+            "state": c.state.value,
+            "used_bytes": c.used_bytes,
+        }
+
     def _persist(self, c: ContainerInfo) -> None:
+        row = self._row(c)
+        counters = (self._next_cid, self._next_lid)
         if self._db is not None:
-            self._db.save_container(
-                {
-                    "id": c.id,
-                    "replication": str(c.replication),
-                    "nodes": c.pipeline.nodes if c.pipeline else [],
-                    "state": c.state.value,
-                    "used_bytes": c.used_bytes,
-                },
-                counters=(self._next_cid, self._next_lid),
-            )
+            self._db.save_container(row, counters=counters)
+        if self.mutation_listener is not None:
+            self.mutation_listener(row, counters)
+
+    def apply_mutation(self, row: dict, counters: tuple[int, int]) -> None:
+        """Follower-side deterministic apply of a leader mutation record
+        (SCMStateMachine.applyTransaction analog): upsert the container row
+        and advance the HA-safe id counters."""
+        with self._lock:
+            c = self._containers.get(int(row["id"]))
+            if c is None:
+                repl = ReplicationConfig.parse(row["replication"])
+                pipe = Pipeline(repl, list(row["nodes"]))
+                self._pipelines[pipe.id] = pipe
+                c = ContainerInfo(int(row["id"]), repl, pipe)
+                self._containers[c.id] = c
+            c.state = ContainerState(row["state"])
+            c.used_bytes = int(row["used_bytes"])
+            pool = self._writable.setdefault(str(c.replication), [])
+            if c.state is ContainerState.OPEN:
+                if c.id not in pool:
+                    pool.append(c.id)
+            elif c.id in pool:
+                pool.remove(c.id)
+            self._next_cid = max(self._next_cid, int(counters[0]))
+            self._next_lid = max(self._next_lid, int(counters[1]))
+            if self._db is not None:
+                self._db.save_container(
+                    row, counters=(self._next_cid, self._next_lid)
+                )
+
+    def snapshot_state(self) -> dict:
+        """Full durable-state dump for follower bootstrap
+        (SCMSnapshotProvider checkpoint-tarball analog)."""
+        with self._lock:
+            return {
+                "containers": [
+                    self._row(c) for c in self._containers.values()
+                ],
+                "counters": [self._next_cid, self._next_lid],
+            }
+
+    def install_snapshot(self, snap: dict) -> None:
+        for row in snap["containers"]:
+            self.apply_mutation(row, tuple(snap["counters"]))
+        with self._lock:
+            self._next_cid = max(self._next_cid, int(snap["counters"][0]))
+            self._next_lid = max(self._next_lid, int(snap["counters"][1]))
 
     # --------------------------------------------------------------- queries
     def get(self, container_id: int) -> ContainerInfo:
@@ -140,7 +197,8 @@ class ContainerManager:
         c = ContainerInfo(self._next_cid, replication, pipe)
         self._next_cid += 1
         self._containers[c.id] = c
-        self._persist(c)
+        # no _persist here: allocate_block always persists the final row
+        # (used_bytes + issued local id) right after
         return c
 
     def allocate_block(

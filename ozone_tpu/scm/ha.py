@@ -1,0 +1,213 @@
+"""SCM high availability: replicated mutation log + snapshot bootstrap.
+
+Capability mirror of the reference's SCM-HA stack (server-scm ha/:
+SCMHAManagerImpl wires a Ratis server whose SCMStateMachine applies
+marshalled @Replicate invocations on every peer; SCMHADBTransactionBuffer
+batches the resulting RocksDB writes; SCMSnapshotProvider +
+InterSCMGrpcProtocolService bootstrap new followers from a checkpoint
+tarball and then tail the log).
+
+Design notes, TPU-build shape:
+- The reference replicates *leader decisions*, not computations: the
+  SCMRatisRequest carries the resulting container/pipeline info so apply
+  is deterministic even though placement is randomized. We do the same —
+  the replication unit is the durable mutation record ContainerManager
+  already emits on every state change (container row + HA-safe id
+  counters), shipped through the same durable JSONL WAL used by OM HA
+  (om/ha.py:RequestLog).
+- Soft state (node liveness, container replicas) is NOT replicated —
+  exactly like the reference, where every SCM receives datanode
+  heartbeats and rebuilds replica maps from full container reports.
+- Failover is promote()-based single-leader replication rather than Raft
+  elections (SURVEY.md §7: stage consensus behind the request/apply
+  split); followers are warm byte-identical replicas.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Optional
+
+from ozone_tpu.om.ha import NotLeaderError, RequestLog
+from ozone_tpu.scm.scm import StorageContainerManager
+
+log = logging.getLogger(__name__)
+
+
+class ReplicatedSCM:
+    """One SCM replica: the leader accepts mutating calls and ships each
+    resulting durable mutation to followers; followers apply them onto
+    their own managers (SCMStateMachine.applyTransaction analog)."""
+
+    def __init__(
+        self,
+        scm: StorageContainerManager,
+        log_path: Path,
+        scm_id: str,
+        is_leader: bool = False,
+    ):
+        self.scm = scm
+        self.scm_id = scm_id
+        self.is_leader = is_leader
+        self.wal = RequestLog(log_path)
+        self.applied_index = 0
+        self.peers: list["ReplicatedSCM"] = []
+        self._replaying = False
+        scm.containers.mutation_listener = self._on_mutation
+        self._recover()
+
+    # ------------------------------------------------------------- recovery
+    def _recover(self) -> None:
+        self._replaying = True
+        try:
+            for e in self.wal.read_from(0):
+                if "snapshot" in e:
+                    # bootstrap checkpoint recorded in the WAL so restarts
+                    # of a bootstrapped follower recover the full state
+                    self.scm.containers.install_snapshot(e["snapshot"])
+                else:
+                    self.scm.containers.apply_mutation(
+                        e["row"], tuple(e["counters"])
+                    )
+                self.applied_index = e["index"]
+        finally:
+            self._replaying = False
+
+    # ------------------------------------------------------------- leader
+    def _on_mutation(self, row: dict, counters: tuple[int, int]) -> None:
+        """ContainerManager hook: on the leader, every durable mutation is
+        appended to the WAL and pushed to followers synchronously (the
+        reference's Ratis write happens *before* apply; we hook after —
+        equivalent durability because the record is also in the local
+        sqlite store, and replay converges via upsert)."""
+        if self._replaying or not self.is_leader:
+            return
+        entry = {
+            # applied_index, not WAL line count: a bootstrapped follower's
+            # WAL holds one snapshot entry standing in for many indexes
+            "index": self.applied_index + 1,
+            "row": row,
+            "counters": list(counters),
+        }
+        self.wal.append(entry)
+        self.applied_index = entry["index"]
+        for peer in self.peers:
+            try:
+                peer.replicate(entry)
+            except Exception:
+                log.exception("scm replication to %s failed", peer.scm_id)
+
+    def check_leader(self) -> None:
+        if not self.is_leader:
+            raise NotLeaderError(self.scm_id)
+
+    def submit(self, method: str, *args: Any, **kw: Any) -> Any:
+        """Leader-gated mutating entry point (SCMHAInvocationHandler
+        analog): clients/om route allocate_block, delete_blocks,
+        decommission, ... through here so followers reject writes."""
+        self.check_leader()
+        return getattr(self.scm, method)(*args, **kw)
+
+    # ------------------------------------------------------------- follower
+    def replicate(self, entry: dict) -> None:
+        if entry["index"] <= self.applied_index:
+            return
+        if entry["index"] != self.applied_index + 1:
+            self.catch_up()
+            if entry["index"] <= self.applied_index:
+                return
+            if entry["index"] != self.applied_index + 1:
+                # gap we could not close (leader unreachable): stay behind
+                # rather than skip entries; the next catch_up re-fetches
+                log.warning(
+                    "scm %s dropping out-of-order entry %d (applied %d)",
+                    self.scm_id, entry["index"], self.applied_index,
+                )
+                return
+        self._replaying = True
+        try:
+            self.wal.append(entry)
+            self.scm.containers.apply_mutation(
+                entry["row"], tuple(entry["counters"])
+            )
+            self.applied_index = entry["index"]
+        finally:
+            self._replaying = False
+
+    def catch_up(self) -> None:
+        leader = next((p for p in self.peers if p.is_leader), None)
+        if leader is None:
+            return
+        self._replaying = True
+        try:
+            # scan from 0 and filter by index: WAL line offsets are not
+            # indexes once a snapshot entry (standing in for many indexes)
+            # is present in the leader's log
+            for e in leader.wal.read_from(0):
+                if e["index"] <= self.applied_index:
+                    continue
+                self.wal.append(e)
+                if "snapshot" in e:
+                    self.scm.containers.install_snapshot(e["snapshot"])
+                else:
+                    self.scm.containers.apply_mutation(
+                        e["row"], tuple(e["counters"])
+                    )
+                self.applied_index = e["index"]
+        finally:
+            self._replaying = False
+
+    # ------------------------------------------------------------- bootstrap
+    def bootstrap_from(self, leader: "ReplicatedSCM") -> None:
+        """New-follower bootstrap: install the leader's checkpoint, then
+        tail its log (SCMSnapshotProvider + InterSCMGrpcProtocolService)."""
+        snap = leader.scm.containers.snapshot_state()
+        self._replaying = True
+        try:
+            self.scm.containers.install_snapshot(snap)
+        finally:
+            self._replaying = False
+        self.applied_index = leader.applied_index
+        # record the checkpoint durably so restart recovery and post-
+        # promotion index assignment both see the bootstrapped state
+        self.wal.append({"index": self.applied_index, "snapshot": snap})
+        if self not in leader.peers:
+            leader.peers.append(self)
+        if leader not in self.peers:
+            self.peers.append(leader)
+
+    # ------------------------------------------------------------- failover
+    def promote(self) -> None:
+        self.catch_up()
+        for p in self.peers:
+            p.is_leader = False
+        self.is_leader = True
+        log.info(
+            "scm %s promoted to leader at index %d",
+            self.scm_id,
+            self.applied_index,
+        )
+
+
+class SCMFailoverProxy:
+    """Client/OM-side failover across SCM replicas (the reference's
+    SCMBlockLocationFailoverProxyProvider): tries the known leader,
+    rotates on NotLeaderError or connection failure."""
+
+    def __init__(self, replicas: list[ReplicatedSCM]):
+        self.replicas = replicas
+        self._leader_idx = 0
+
+    def submit(self, method: str, *args: Any, **kw: Any) -> Any:
+        last: Optional[Exception] = None
+        n = len(self.replicas)
+        for attempt in range(n):
+            idx = (self._leader_idx + attempt) % n
+            try:
+                result = self.replicas[idx].submit(method, *args, **kw)
+                self._leader_idx = idx
+                return result
+            except (NotLeaderError, ConnectionError, OSError) as e:
+                last = e
+        raise RuntimeError(f"no SCM leader reachable: {last}")
