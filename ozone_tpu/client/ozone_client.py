@@ -51,20 +51,67 @@ class KeyWriteHandle:
             self.close()
 
 
+class MultipartUpload:
+    """Client handle for one multipart upload (createMultipartKey flow,
+    RpcClient.java:2009): each part streams through the same EC/replicated
+    datapath as a whole key, then completion stitches parts at the OM."""
+
+    def __init__(self, bucket: "OzoneBucket", key: str, upload_id: str):
+        self.bucket = bucket
+        self.key = key
+        self.upload_id = upload_id
+        self._etags: dict[int, str] = {}
+
+    def write_part(self, part_number: int, data) -> str:
+        import hashlib
+
+        om = self.bucket.client.om
+        session = om.open_multipart_part(
+            self.bucket.volume, self.bucket.name, self.key, self.upload_id
+        )
+        writer = self.bucket._make_writer(session)
+        writer.write(data)
+        groups = writer.close()
+        etag = hashlib.md5(np.asarray(data, np.uint8).tobytes()).hexdigest()
+        om.commit_multipart_part(
+            session, part_number, groups, writer.bytes_written, etag
+        )
+        self._etags[part_number] = etag
+        return etag
+
+    def complete(self, parts: Optional[list[dict]] = None) -> dict:
+        if parts is None:
+            parts = [
+                {"part_number": n, "etag": self._etags[n]}
+                for n in sorted(self._etags)
+            ]
+        return self.bucket.client.om.complete_multipart_upload(
+            self.bucket.volume, self.bucket.name, self.key, self.upload_id,
+            parts,
+        )
+
+    def abort(self) -> None:
+        self.bucket.client.om.abort_multipart_upload(
+            self.bucket.volume, self.bucket.name, self.key, self.upload_id
+        )
+
+    def list_parts(self) -> list[dict]:
+        return self.bucket.client.om.list_parts(
+            self.bucket.volume, self.bucket.name, self.key, self.upload_id
+        )
+
+
 class OzoneBucket:
     def __init__(self, client: "OzoneClient", volume: str, name: str):
         self.client = client
         self.volume = volume
         self.name = name
 
-    def open_key(
-        self, key: str, replication: Optional[str] = None
-    ) -> KeyWriteHandle:
+    def _make_writer(self, session: OpenKeySession):
         om = self.client.om
-        session = om.open_key(self.volume, self.name, key, replication)
         allocate = lambda excluded: om.allocate_block(session, excluded)
         if session.replication.type is ReplicationType.EC:
-            writer = ECKeyWriter(
+            return ECKeyWriter(
                 session.replication.ec,
                 allocate,
                 self.client.clients,
@@ -72,15 +119,28 @@ class OzoneBucket:
                 checksum=ChecksumType(session.checksum_type),
                 bytes_per_checksum=session.bytes_per_checksum,
             )
-        else:
-            writer = ReplicatedKeyWriter(
-                allocate,
-                self.client.clients,
-                block_size=om.block_size,
-                checksum=ChecksumType(session.checksum_type),
-                bytes_per_checksum=session.bytes_per_checksum,
-            )
-        return KeyWriteHandle(session, om, writer)
+        return ReplicatedKeyWriter(
+            allocate,
+            self.client.clients,
+            block_size=om.block_size,
+            checksum=ChecksumType(session.checksum_type),
+            bytes_per_checksum=session.bytes_per_checksum,
+        )
+
+    def initiate_multipart_upload(
+        self, key: str, replication: Optional[str] = None
+    ) -> MultipartUpload:
+        upload_id = self.client.om.initiate_multipart_upload(
+            self.volume, self.name, key, replication
+        )
+        return MultipartUpload(self, key, upload_id)
+
+    def open_key(
+        self, key: str, replication: Optional[str] = None
+    ) -> KeyWriteHandle:
+        om = self.client.om
+        session = om.open_key(self.volume, self.name, key, replication)
+        return KeyWriteHandle(session, om, self._make_writer(session))
 
     def write_key(self, key: str, data, replication: Optional[str] = None) -> None:
         with self.open_key(key, replication) as h:

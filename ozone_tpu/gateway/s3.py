@@ -20,7 +20,6 @@ import hashlib
 import json
 import logging
 import threading
-import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -30,6 +29,11 @@ import numpy as np
 
 from ozone_tpu.client.ozone_client import OzoneClient
 from ozone_tpu.om.requests import OMError
+from ozone_tpu.storage.ids import StorageError
+
+# a local OzoneManager raises OMError; a remote OM (GrpcOmClient) re-raises
+# the same codes as StorageError — the gateway maps both identically
+_OM_ERRORS = (OMError, StorageError)
 
 log = logging.getLogger(__name__)
 
@@ -55,11 +59,8 @@ class S3Gateway:
         self.replication = replication
         try:
             client.om.create_volume(S3_VOLUME)
-        except OMError:
+        except _OM_ERRORS:
             pass
-        # in-flight multipart uploads: uploadId -> {bucket, key, parts{n: (etag, hidden_key)}}
-        self._mpu: dict[str, dict] = {}
-        self._mpu_lock = threading.Lock()
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -139,12 +140,14 @@ class S3Gateway:
                 self._bucket_op(h, method, bucket, q)
             else:
                 self._object_op(h, method, bucket, key, q)
-        except OMError as e:
+        except _OM_ERRORS as e:
             code = {
                 "KEY_NOT_FOUND": ("NoSuchKey", 404),
                 "BUCKET_NOT_FOUND": ("NoSuchBucket", 404),
                 "BUCKET_ALREADY_EXISTS": ("BucketAlreadyExists", 409),
                 "BUCKET_NOT_EMPTY": ("BucketNotEmpty", 409),
+                "NO_SUCH_MULTIPART_UPLOAD": ("NoSuchUpload", 404),
+                "INVALID_PART": ("InvalidPart", 400),
             }.get(e.code, ("InternalError", 500))
             h._reply(*_err(code[0], str(e), code[1]))
         except Exception as e:  # noqa: BLE001
@@ -205,6 +208,10 @@ class S3Gateway:
             self._mpu_part(h, bucket, key, q)
         elif method == "POST" and "uploadId" in q:
             self._mpu_complete(h, bucket, key, q)
+        elif method == "DELETE" and "uploadId" in q:
+            self._mpu_abort(h, bucket, key, q)
+        elif method == "GET" and "uploadId" in q:
+            self._mpu_list_parts(h, bucket, key, q)
         elif method == "PUT":
             self._put_object(h, bucket, key)
         elif method == "GET":
@@ -251,56 +258,83 @@ class S3Gateway:
                                "Content-Type": "application/octet-stream"})
 
     # ------------------------------------------------------------- multipart
+    # Backed by the OM multipart table (om/multipart.py), the reference's
+    # design: the gateway is stateless, upload state survives restarts,
+    # and parts stream through the normal EC/replicated datapath.
     def _mpu_initiate(self, h, bucket: str, key: str) -> None:
-        upload_id = uuid.uuid4().hex
-        with self._mpu_lock:
-            self._mpu[upload_id] = {"bucket": bucket, "key": key, "parts": {}}
+        mpu = self._bucket_handle(bucket).initiate_multipart_upload(key)
         root = ET.Element("InitiateMultipartUploadResult", xmlns=_NS)
         ET.SubElement(root, "Bucket").text = bucket
         ET.SubElement(root, "Key").text = key
-        ET.SubElement(root, "UploadId").text = upload_id
+        ET.SubElement(root, "UploadId").text = mpu.upload_id
         h._reply(200, _xml(root), {"Content-Type": "application/xml"})
 
-    def _mpu_part(self, h, bucket: str, key: str, q) -> None:
+    def _mpu_handle(self, h, bucket: str, key: str, q):
+        # no existence pre-check: the underlying OM call raises
+        # NO_SUCH_MULTIPART_UPLOAD itself (mapped to 404 in _route),
+        # avoiding an extra MultipartInfo round-trip per part
+        from ozone_tpu.client.ozone_client import MultipartUpload
+
         upload_id = q["uploadId"][0]
-        part_no = int(q.get("partNumber", ["1"])[0])
-        with self._mpu_lock:
-            mpu = self._mpu.get(upload_id)
+        return MultipartUpload(self._bucket_handle(bucket), key, upload_id)
+
+    def _mpu_part(self, h, bucket: str, key: str, q) -> None:
+        mpu = self._mpu_handle(h, bucket, key, q)
         if mpu is None:
-            h._reply(*_err("NoSuchUpload", upload_id, 404))
             return
+        part_no = int(q.get("partNumber", ["1"])[0])
         body = h._body()
-        hidden = f".mpu/{upload_id}/{part_no:05d}"
-        self._bucket_handle(bucket).write_key(
-            hidden, np.frombuffer(body, np.uint8)
-        )
-        etag = hashlib.md5(body).hexdigest()
-        with self._mpu_lock:
-            mpu["parts"][part_no] = (etag, hidden)
+        etag = mpu.write_part(part_no, np.frombuffer(body, np.uint8))
         h._reply(200, headers={"ETag": f'"{etag}"'})
 
     def _mpu_complete(self, h, bucket: str, key: str, q) -> None:
-        upload_id = q["uploadId"][0]
-        with self._mpu_lock:
-            mpu = self._mpu.pop(upload_id, None)
+        mpu = self._mpu_handle(h, bucket, key, q)
         if mpu is None:
-            h._reply(*_err("NoSuchUpload", upload_id, 404))
             return
-        b = self._bucket_handle(bucket)
-        etags = []
-        with b.open_key(key) as out:
-            for n in sorted(mpu["parts"]):
-                etag, hidden = mpu["parts"][n]
-                etags.append(etag)
-                out.write(b.read_key(hidden))
-        for n in sorted(mpu["parts"]):
-            b.delete_key(mpu["parts"][n][1])
-        final_etag = (
-            hashlib.md5("".join(etags).encode()).hexdigest()
-            + f"-{len(etags)}"
-        )
+        # parts may be listed in the XML body; default to all uploaded
+        parts = None
+        body = h._body()
+        if body:
+            listed = []
+            for pe in ET.fromstring(body):
+                if pe.tag.rpartition("}")[2] != "Part":
+                    continue
+                fields = {c.tag.rpartition("}")[2]: (c.text or "") for c in pe}
+                listed.append({
+                    "part_number": int(fields["PartNumber"]),
+                    "etag": fields.get("ETag", "").strip('"'),
+                })
+            parts = listed or None
+        if parts is None:
+            parts = [
+                {"part_number": p["part_number"], "etag": p["etag"]}
+                for p in mpu.list_parts()
+            ]
+        info = mpu.complete(parts)
         root = ET.Element("CompleteMultipartUploadResult", xmlns=_NS)
         ET.SubElement(root, "Bucket").text = bucket
         ET.SubElement(root, "Key").text = key
-        ET.SubElement(root, "ETag").text = f'"{final_etag}"'
+        ET.SubElement(root, "ETag").text = f'"{info["etag"]}"'
+        h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _mpu_abort(self, h, bucket: str, key: str, q) -> None:
+        mpu = self._mpu_handle(h, bucket, key, q)
+        if mpu is None:
+            return
+        mpu.abort()
+        h._reply(204)
+
+    def _mpu_list_parts(self, h, bucket: str, key: str, q) -> None:
+        mpu = self._mpu_handle(h, bucket, key, q)
+        if mpu is None:
+            return
+        root = ET.Element("ListPartsResult", xmlns=_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "Key").text = key
+        ET.SubElement(root, "UploadId").text = mpu.upload_id
+        for p in mpu.list_parts():
+            pe = ET.SubElement(root, "Part")
+            ET.SubElement(pe, "PartNumber").text = str(p["part_number"])
+            ET.SubElement(pe, "ETag").text = f'"{p["etag"]}"'
+            ET.SubElement(pe, "Size").text = str(p["size"])
         h._reply(200, _xml(root), {"Content-Type": "application/xml"})

@@ -71,6 +71,42 @@ class OmGrpcService:
                         m["volume"], m["bucket"], m["key"], m["new_key"]
                     )
                 ),
+                # Multipart upload verbs (reference OmClientProtocol
+                # InitiateMultiPartUpload/CommitMultiPartUpload/
+                # CompleteMultiPartUpload/AbortMultiPartUpload/ListParts)
+                "InitiateMultipartUpload": self._wrap(
+                    lambda m: self.om.initiate_multipart_upload(
+                        m["volume"], m["bucket"], m["key"],
+                        m.get("replication"),
+                    )
+                ),
+                "MultipartInfo": self._wrap(
+                    lambda m: self.om.multipart_info(
+                        m["volume"], m["bucket"], m["key"], m["upload_id"]
+                    )
+                ),
+                "CommitMultipartPart": self._commit_multipart_part,
+                "CompleteMultipartUpload": self._wrap(
+                    lambda m: self.om.complete_multipart_upload(
+                        m["volume"], m["bucket"], m["key"], m["upload_id"],
+                        m["parts"],
+                    )
+                ),
+                "AbortMultipartUpload": self._wrap(
+                    lambda m: self.om.abort_multipart_upload(
+                        m["volume"], m["bucket"], m["key"], m["upload_id"]
+                    )
+                ),
+                "ListParts": self._wrap(
+                    lambda m: self.om.list_parts(
+                        m["volume"], m["bucket"], m["key"], m["upload_id"]
+                    )
+                ),
+                "ListMultipartUploads": self._wrap(
+                    lambda m: self.om.list_multipart_uploads(
+                        m["volume"], m["bucket"], m.get("prefix", "")
+                    )
+                ),
                 # FSO file-system verbs (reference OmClientProtocol
                 # CreateDirectory/GetFileStatus/ListStatus/DeleteKey with
                 # recursive flag)
@@ -141,6 +177,24 @@ class OmGrpcService:
         return wire.pack(
             {"group": g.to_json(), "addresses": self.addresses_provider()}
         )
+
+    def _commit_multipart_part(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+
+        class _S:
+            volume = m["volume"]
+            bucket = m["bucket"]
+            key = m["key"]
+            client_id = m["upload_id"]
+
+        try:
+            etag = self.om.commit_multipart_part(
+                _S(), m["part_number"], self._groups_from(m["groups"]),
+                m["size"], m["etag"],
+            )
+        except OMError as e:
+            raise StorageError(e.code, e.msg)
+        return wire.pack({"result": etag})
 
     def _commit_key(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
@@ -301,6 +355,65 @@ class GrpcOmClient:
     def rename_key(self, volume, bucket, key, new_key):
         self._call("RenameKey", volume=volume, bucket=bucket, key=key,
                    new_key=new_key)
+
+    # multipart upload
+    def initiate_multipart_upload(self, volume, bucket, key,
+                                  replication=None):
+        return self._call(
+            "InitiateMultipartUpload", volume=volume, bucket=bucket,
+            key=key, replication=replication,
+        )["result"]
+
+    def multipart_info(self, volume, bucket, key, upload_id):
+        return self._call(
+            "MultipartInfo", volume=volume, bucket=bucket, key=key,
+            upload_id=upload_id,
+        )["result"]
+
+    def open_multipart_part(self, volume, bucket, key, upload_id):
+        info = self.multipart_info(volume, bucket, key, upload_id)
+        return RemoteOpenKeySession(
+            volume, bucket, key,
+            {
+                "client_id": upload_id,
+                "replication": info["replication"],
+                "checksum_type": info["checksum_type"],
+                "bytes_per_checksum": info["bytes_per_checksum"],
+            },
+        )
+
+    def commit_multipart_part(self, session, part_number, groups, size,
+                              etag):
+        return self._call(
+            "CommitMultipartPart",
+            volume=session.volume,
+            bucket=session.bucket,
+            key=session.key,
+            upload_id=session.client_id,
+            part_number=part_number,
+            groups=[g.to_json() for g in groups],
+            size=size,
+            etag=etag,
+        )["result"]
+
+    def complete_multipart_upload(self, volume, bucket, key, upload_id,
+                                  parts):
+        return self._call(
+            "CompleteMultipartUpload", volume=volume, bucket=bucket,
+            key=key, upload_id=upload_id, parts=parts,
+        )["result"]
+
+    def abort_multipart_upload(self, volume, bucket, key, upload_id):
+        self._call("AbortMultipartUpload", volume=volume, bucket=bucket,
+                   key=key, upload_id=upload_id)
+
+    def list_parts(self, volume, bucket, key, upload_id):
+        return self._call("ListParts", volume=volume, bucket=bucket,
+                          key=key, upload_id=upload_id)["result"]
+
+    def list_multipart_uploads(self, volume, bucket, prefix=""):
+        return self._call("ListMultipartUploads", volume=volume,
+                          bucket=bucket, prefix=prefix)["result"]
 
     # FSO file-system verbs
     def create_directory(self, volume, bucket, path):

@@ -248,6 +248,114 @@ class OzoneManager:
         else:
             self.submit(rq.RenameKey(volume, bucket, key, new_key))
 
+    # ----------------------------------------------------- multipart upload
+    def initiate_multipart_upload(
+        self, volume: str, bucket: str, key: str,
+        replication: Optional[str] = None,
+    ) -> str:
+        from ozone_tpu.om import multipart as mpu
+
+        return self.submit(
+            mpu.InitiateMultipartUpload(
+                volume, bucket, key, replication=replication or ""
+            )
+        )
+
+    def multipart_info(
+        self, volume: str, bucket: str, key: str, upload_id: str
+    ) -> dict:
+        from ozone_tpu.om import multipart as mpu
+
+        info = self.store.get(
+            "multipart", mpu.mpu_key(volume, bucket, key, upload_id)
+        )
+        if info is None:
+            raise rq.OMError(
+                mpu.NO_SUCH_UPLOAD, f"{volume}/{bucket}/{key}/{upload_id}"
+            )
+        return info
+
+    def open_multipart_part(
+        self, volume: str, bucket: str, key: str, upload_id: str
+    ) -> OpenKeySession:
+        """Session for writing one part's blocks (createMultipartKey,
+        RpcClient.java:2009): same datapath as a normal key write; the
+        part is recorded by commit_multipart_part."""
+        info = self.multipart_info(volume, bucket, key, upload_id)
+        return OpenKeySession(self, info, client_id=upload_id)
+
+    def commit_multipart_part(
+        self,
+        session: OpenKeySession,
+        part_number: int,
+        groups: list[BlockGroup],
+        size: int,
+        etag: str,
+    ) -> str:
+        from ozone_tpu.om import multipart as mpu
+
+        return self.submit(
+            mpu.CommitMultipartPart(
+                session.volume,
+                session.bucket,
+                session.key,
+                session.client_id,
+                part_number,
+                size,
+                etag,
+                [g.to_json() for g in groups],
+            )
+        )
+
+    def complete_multipart_upload(
+        self, volume: str, bucket: str, key: str, upload_id: str,
+        parts: list[dict],
+    ) -> dict:
+        from ozone_tpu.om import multipart as mpu
+
+        return self.submit(
+            mpu.CompleteMultipartUpload(volume, bucket, key, upload_id, parts)
+        )
+
+    def abort_multipart_upload(
+        self, volume: str, bucket: str, key: str, upload_id: str
+    ) -> None:
+        from ozone_tpu.om import multipart as mpu
+
+        self.submit(mpu.AbortMultipartUpload(volume, bucket, key, upload_id))
+
+    def list_parts(
+        self, volume: str, bucket: str, key: str, upload_id: str
+    ) -> list[dict]:
+        info = self.multipart_info(volume, bucket, key, upload_id)
+        return sorted(
+            info["parts"].values(), key=lambda p: p["part_number"]
+        )
+
+    def list_multipart_uploads(
+        self, volume: str, bucket: str, prefix: str = ""
+    ) -> list[dict]:
+        base = bucket_key(volume, bucket) + "/"
+        return [
+            m for _, m in self.store.iterate("multipart", base + prefix)
+        ]
+
+    def run_open_key_cleanup_once(
+        self, max_age_s: float = 7 * 24 * 3600.0, limit: int = 256
+    ) -> int:
+        from ozone_tpu.om import multipart as mpu
+
+        return mpu.OpenKeyCleanupService(self, max_age_s).run_once(limit)
+
+    def run_mpu_cleanup_once(
+        self, max_age_s: float = 7 * 24 * 3600.0, limit: int = 256
+    ) -> int:
+        from ozone_tpu.om import multipart as mpu
+
+        return mpu.MultipartUploadCleanupService(self, max_age_s).run_once(
+            limit
+        )
+
     # ----------------------------------------------------- FSO file system
     def create_directory(self, volume: str, bucket: str, path: str) -> None:
         from ozone_tpu.om import fso

@@ -213,6 +213,26 @@ def cmd_scm_om(args) -> int:
     return 0
 
 
+def cmd_s3g(args) -> int:
+    """Run the S3 gateway daemon against a remote OM (reference:
+    `ozone s3g`, s3gateway Gateway.java main)."""
+    import logging
+
+    from ozone_tpu.gateway.s3 import S3Gateway
+
+    logging.basicConfig(level=logging.INFO)
+    gw = S3Gateway(_client(args), port=args.port,
+                   replication=args.replication)
+    gw.start()
+    print(f"s3 gateway serving on {gw.address}, om={args.om}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        gw.stop()
+    return 0
+
+
 # -------------------------------------------------------------------- main
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="ozone-tpu")
@@ -267,6 +287,12 @@ def build_parser() -> argparse.ArgumentParser:
     dn.add_argument("--port", type=int, default=0)
     dn.add_argument("--rack", default="/default-rack")
     dn.set_defaults(fn=cmd_datanode)
+
+    s3g = sub.add_parser("s3g", help="run the S3 gateway daemon")
+    s3g.add_argument("--om", default="127.0.0.1:9860")
+    s3g.add_argument("--port", type=int, default=9878)
+    s3g.add_argument("--replication", default="rs-6-3-1024k")
+    s3g.set_defaults(fn=cmd_s3g)
 
     so = sub.add_parser("scm-om", help="run the SCM+OM metadata server")
     so.add_argument("--db", required=True)

@@ -87,13 +87,33 @@ def test_s3_multipart_upload(s3):
         r = _req(s3, "PUT",
                  f"/b1/big?partNumber={i}&uploadId={upload_id}", data=p)
         assert r.status == 200
+    # list parts while in flight
+    r = _req(s3, "GET", f"/b1/big?uploadId={upload_id}")
+    listing = r.read()
+    assert listing.count(b"<PartNumber>") == 3
     r = _req(s3, "POST", f"/b1/big?uploadId={upload_id}", data=b"")
     assert r.status == 200
     got = _req(s3, "GET", "/b1/big").read()
     assert got == b"".join(parts)
-    # hidden part keys cleaned up
-    r = _req(s3, "GET", "/b1?prefix=.mpu/")
-    assert b"<Key>" not in r.read()
+    # upload state cleaned up at the OM
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "GET", f"/b1/big?uploadId={upload_id}")
+    assert ei.value.code == 404
+
+
+def test_s3_multipart_abort(s3):
+    _req(s3, "PUT", "/b1")
+    r = _req(s3, "POST", "/b1/aborted?uploads")
+    tree = ET.fromstring(r.read())
+    upload_id = next(e.text for e in tree.iter()
+                     if e.tag.endswith("UploadId"))
+    _req(s3, "PUT", f"/b1/aborted?partNumber=1&uploadId={upload_id}",
+         data=b"x" * 5000)
+    r = _req(s3, "DELETE", f"/b1/aborted?uploadId={upload_id}")
+    assert r.status == 204
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "GET", "/b1/aborted")
+    assert ei.value.code == 404
 
 
 def test_s3_errors(s3):
