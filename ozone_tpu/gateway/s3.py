@@ -28,6 +28,11 @@ from urllib.parse import parse_qs, unquote, urlparse
 import numpy as np
 
 from ozone_tpu.client.ozone_client import OzoneClient
+from ozone_tpu.gateway.s3_auth import (
+    AuthError,
+    parse_authorization,
+    verify_request,
+)
 from ozone_tpu.om.requests import OMError
 from ozone_tpu.storage.ids import StorageError
 
@@ -54,9 +59,14 @@ def _err(code: str, message: str, status: int) -> tuple[int, bytes]:
 
 class S3Gateway:
     def __init__(self, client: OzoneClient, host: str = "127.0.0.1",
-                 port: int = 0, replication: str = "rs-6-3-1024k"):
+                 port: int = 0, replication: str = "rs-6-3-1024k",
+                 require_auth: bool = False):
         self.client = client
         self.replication = replication
+        # require_auth=True enforces SigV4 on every request (anonymous
+        # reads still allowed on public-read buckets); False accepts
+        # unsigned requests but validates signatures when presented
+        self.require_auth = require_auth
         try:
             client.om.create_volume(S3_VOLUME)
         except _OM_ERRORS:
@@ -80,8 +90,12 @@ class S3Gateway:
                     self.wfile.write(body)
 
             def _body(self) -> bytes:
-                n = int(self.headers.get("Content-Length", 0))
-                return self.rfile.read(n) if n else b""
+                # memoized: read once so both signature verification and
+                # the operation handler can consume it
+                if not hasattr(self, "_cached_body"):
+                    n = int(self.headers.get("Content-Length", 0))
+                    self._cached_body = self.rfile.read(n) if n else b""
+                return self._cached_body
 
             def do_GET(self):
                 gateway._route(self, "GET")
@@ -119,19 +133,51 @@ class S3Gateway:
         self._httpd.server_close()
 
     # ------------------------------------------------------------- routing
-    def _authenticate(self, handler) -> bool:
-        """Signature validation hook (reference: S3 V4 auth forwarded to OM
-        via the S3Auth header, s3gateway AuthorizationFilter)."""
-        return True
+    def _authenticate(self, h, method: str) -> Optional[str]:
+        """SigV4 validation (reference: s3gateway AuthorizationFilter +
+        AWSSignatureProcessor, secret from OM's s3SecretTable). Returns
+        the authenticated access id, or None for anonymous requests."""
+        header = h.headers.get("Authorization")
+        if not header:
+            return None
+        auth = parse_authorization(header)
+        secret = self.client.om.get_s3_secret(auth.access_id, create=False)
+        if secret is None:
+            raise AuthError("InvalidAccessKeyId", auth.access_id)
+        u = urlparse(h.path)
+        verify_request(
+            secret, method, u.path, u.query, dict(h.headers), h._body(),
+            auth,
+        )
+        return auth.access_id
+
+    def _is_public_read(self, bucket: str) -> bool:
+        try:
+            acl = self.client.om.get_bucket_acl(S3_VOLUME, bucket)
+        except _OM_ERRORS:
+            return False
+        return any(
+            g.get("grantee") == "*" and g.get("permission") in
+            ("READ", "FULL_CONTROL")
+            for g in acl
+        )
 
     def _route(self, h, method: str) -> None:
-        if not self._authenticate(h):
-            h._reply(*_err("AccessDenied", "access denied", 403))
-            return
         u = urlparse(h.path)
         q = parse_qs(u.query, keep_blank_values=True)
         parts = [unquote(p) for p in u.path.strip("/").split("/") if p]
         try:
+            principal = self._authenticate(h, method)
+            if principal is None and self.require_auth:
+                # anonymous: only reads of public-read buckets pass
+                public = (
+                    method in ("GET", "HEAD")
+                    and parts
+                    and self._is_public_read(parts[0])
+                )
+                if not public:
+                    h._reply(*_err("AccessDenied", "anonymous access", 403))
+                    return
             if not parts:
                 self._list_buckets(h)
                 return
@@ -140,6 +186,9 @@ class S3Gateway:
                 self._bucket_op(h, method, bucket, q)
             else:
                 self._object_op(h, method, bucket, key, q)
+        except AuthError as e:
+            status = 400 if "Malformed" in e.code else 403
+            h._reply(*_err(e.code, str(e), status))
         except _OM_ERRORS as e:
             code = {
                 "KEY_NOT_FOUND": ("NoSuchKey", 404),
@@ -164,8 +213,78 @@ class S3Gateway:
             ET.SubElement(be, "CreationDate").text = str(b.get("created", ""))
         h._reply(200, _xml(root), {"Content-Type": "application/xml"})
 
+    _CANNED_ACLS = {
+        "private": [],
+        "public-read": [{"grantee": "*", "permission": "READ"}],
+        "public-read-write": [
+            {"grantee": "*", "permission": "READ"},
+            {"grantee": "*", "permission": "WRITE"},
+        ],
+    }
+
+    def _bucket_acl_op(self, h, method: str, bucket: str) -> None:
+        """?acl subresource (reference BucketEndpoint get/put ACL: S3
+        grants map onto bucket ACLs)."""
+        om = self.client.om
+        if method == "GET":
+            acl = om.get_bucket_acl(S3_VOLUME, bucket)
+            root = ET.Element("AccessControlPolicy", xmlns=_NS)
+            owner = ET.SubElement(root, "Owner")
+            ET.SubElement(owner, "ID").text = "owner"
+            grants = ET.SubElement(root, "AccessControlList")
+            for g in acl or [{"grantee": "owner",
+                              "permission": "FULL_CONTROL"}]:
+                ge = ET.SubElement(grants, "Grant")
+                gr = ET.SubElement(ge, "Grantee")
+                ET.SubElement(gr, "ID").text = g["grantee"]
+                ET.SubElement(ge, "Permission").text = g["permission"]
+            h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+        elif method == "PUT":
+            canned = h.headers.get("x-amz-acl")
+            if canned is not None:
+                if canned not in self._CANNED_ACLS:
+                    h._reply(*_err("InvalidArgument", canned, 400))
+                    return
+                acl = self._CANNED_ACLS[canned]
+            else:
+                try:
+                    acl = self._parse_acl_body(h._body())
+                except (ET.ParseError, KeyError) as e:
+                    h._reply(*_err("MalformedACLError", str(e), 400))
+                    return
+            om.set_bucket_acl(S3_VOLUME, bucket, acl)
+            h._reply(200)
+        else:
+            h._reply(*_err("MethodNotAllowed", method, 405))
+
+    @staticmethod
+    def _parse_acl_body(body: bytes) -> list[dict]:
+        acl = []
+        if not body:
+            return acl
+        for ge in ET.fromstring(body).iter():
+            if ge.tag.rpartition("}")[2] != "Grant":
+                continue
+            fields = {c.tag.rpartition("}")[2]: c for c in ge}
+            grantee = fields.get("Grantee")
+            gid = ""
+            if grantee is not None:
+                for c in grantee:
+                    if c.tag.rpartition("}")[2] in ("ID", "URI"):
+                        gid = (c.text or "").rpartition("/")[2]
+            if gid in ("AllUsers",):
+                gid = "*"
+            acl.append({
+                "grantee": gid,
+                "permission": (fields["Permission"].text or "").strip(),
+            })
+        return acl
+
     def _bucket_op(self, h, method: str, bucket: str, q) -> None:
         om = self.client.om
+        if "acl" in q:
+            self._bucket_acl_op(h, method, bucket)
+            return
         if method == "PUT":
             try:
                 om.create_bucket(S3_VOLUME, bucket, self.replication)

@@ -71,6 +71,24 @@ class OmGrpcService:
                         m["volume"], m["bucket"], m["key"], m["new_key"]
                     )
                 ),
+                # S3 secret + ACL verbs (reference OmClientProtocol
+                # GetS3Secret/RevokeS3Secret/SetAcl/GetAcl)
+                "GetS3Secret": self._wrap(
+                    lambda m: self.om.get_s3_secret(
+                        m["access_id"], m.get("create", True)
+                    )
+                ),
+                "RevokeS3Secret": self._wrap(
+                    lambda m: self.om.revoke_s3_secret(m["access_id"])
+                ),
+                "SetBucketAcl": self._wrap(
+                    lambda m: self.om.set_bucket_acl(
+                        m["volume"], m["bucket"], m["acl"]
+                    )
+                ),
+                "GetBucketAcl": self._wrap(
+                    lambda m: self.om.get_bucket_acl(m["volume"], m["bucket"])
+                ),
                 # Multipart upload verbs (reference OmClientProtocol
                 # InitiateMultiPartUpload/CommitMultiPartUpload/
                 # CompleteMultiPartUpload/AbortMultiPartUpload/ListParts)
@@ -355,6 +373,22 @@ class GrpcOmClient:
     def rename_key(self, volume, bucket, key, new_key):
         self._call("RenameKey", volume=volume, bucket=bucket, key=key,
                    new_key=new_key)
+
+    # s3 secrets / acl
+    def get_s3_secret(self, access_id, create=True):
+        return self._call("GetS3Secret", access_id=access_id,
+                          create=create)["result"]
+
+    def revoke_s3_secret(self, access_id):
+        self._call("RevokeS3Secret", access_id=access_id)
+
+    def set_bucket_acl(self, volume, bucket, acl):
+        self._call("SetBucketAcl", volume=volume, bucket=bucket, acl=acl)
+
+    def get_bucket_acl(self, volume, bucket):
+        return self._call("GetBucketAcl", volume=volume, bucket=bucket)[
+            "result"
+        ]
 
     # multipart upload
     def initiate_multipart_upload(self, volume, bucket, key,

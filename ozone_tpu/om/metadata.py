@@ -30,6 +30,9 @@ _TABLES = (
     "files",
     "deleted_dirs",
     "multipart",
+    # accessId -> secret for S3 SigV4 auth (reference: OM s3SecretTable
+    # backing the s3-secret-store module)
+    "s3_secrets",
 )
 
 

@@ -248,6 +248,31 @@ class OzoneManager:
         else:
             self.submit(rq.RenameKey(volume, bucket, key, new_key))
 
+    # ----------------------------------------------------- s3 secrets / acl
+    def get_s3_secret(self, access_id: str, create: bool = True) -> Optional[str]:
+        """Fetch (creating on first use, like the reference's
+        S3GetSecretRequest) the SigV4 secret for an access id."""
+        row = self.store.get("s3_secrets", access_id)
+        if row is not None:
+            return row["secret"]
+        if not create:
+            return None
+        import secrets as _secrets
+
+        return self.submit(
+            rq.SetS3Secret(access_id, _secrets.token_hex(32), if_absent=True)
+        )
+
+    def revoke_s3_secret(self, access_id: str) -> None:
+        self.submit(rq.RevokeS3Secret(access_id))
+
+    def set_bucket_acl(self, volume: str, bucket: str,
+                       acl: list[dict]) -> None:
+        self.submit(rq.SetBucketAcl(volume, bucket, acl))
+
+    def get_bucket_acl(self, volume: str, bucket: str) -> list[dict]:
+        return self.bucket_info(volume, bucket).get("acl", [])
+
     # ----------------------------------------------------- multipart upload
     def initiate_multipart_upload(
         self, volume: str, bucket: str, key: str,

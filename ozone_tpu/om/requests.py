@@ -290,6 +290,55 @@ class RenameKey(OMRequest):
 
 
 @dataclass
+class SetS3Secret(OMRequest):
+    """Store an access-id's S3 secret (reference: S3GetSecretRequest
+    creates on first fetch; OMSetSecretRequest overwrites). With
+    if_absent, the get-or-create is atomic inside apply so concurrent
+    first fetches converge on one secret."""
+
+    access_id: str
+    secret: str
+    if_absent: bool = False
+
+    def apply(self, store):
+        if self.if_absent:
+            row = store.get("s3_secrets", self.access_id)
+            if row is not None:
+                return row["secret"]
+        store.put(
+            "s3_secrets", self.access_id,
+            {"access_id": self.access_id, "secret": self.secret},
+        )
+        return self.secret
+
+
+@dataclass
+class RevokeS3Secret(OMRequest):
+    access_id: str
+
+    def apply(self, store):
+        store.delete("s3_secrets", self.access_id)
+
+
+@dataclass
+class SetBucketAcl(OMRequest):
+    """Replace a bucket's ACL grant list (reference: OMBucketSetAclRequest;
+    S3 grants map onto the bucket record)."""
+
+    volume: str
+    bucket: str
+    acl: list[dict] = field(default_factory=list)
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", k)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, k)
+        b["acl"] = self.acl
+        store.put("buckets", k, b)
+
+
+@dataclass
 class PurgeDeletedKeys(OMRequest):
     """Remove processed entries from the deleted table (background
     KeyDeletingService completion)."""

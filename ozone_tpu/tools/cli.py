@@ -222,7 +222,8 @@ def cmd_s3g(args) -> int:
 
     logging.basicConfig(level=logging.INFO)
     gw = S3Gateway(_client(args), port=args.port,
-                   replication=args.replication)
+                   replication=args.replication,
+                   require_auth=args.require_auth)
     gw.start()
     print(f"s3 gateway serving on {gw.address}, om={args.om}")
     try:
@@ -230,6 +231,19 @@ def cmd_s3g(args) -> int:
             time.sleep(3600)
     except KeyboardInterrupt:
         gw.stop()
+    return 0
+
+
+def cmd_s3(args) -> int:
+    """S3 secret management (reference: `ozone s3 getsecret` /
+    `revokesecret`)."""
+    om = _client(args).om
+    if args.verb == "getsecret":
+        secret = om.get_s3_secret(args.access_id)
+        _emit({"access_id": args.access_id, "secret": secret})
+    elif args.verb == "revokesecret":
+        om.revoke_s3_secret(args.access_id)
+        _emit({"access_id": args.access_id, "revoked": True})
     return 0
 
 
@@ -292,7 +306,15 @@ def build_parser() -> argparse.ArgumentParser:
     s3g.add_argument("--om", default="127.0.0.1:9860")
     s3g.add_argument("--port", type=int, default=9878)
     s3g.add_argument("--replication", default="rs-6-3-1024k")
+    s3g.add_argument("--require-auth", action="store_true",
+                     help="enforce SigV4 signatures")
     s3g.set_defaults(fn=cmd_s3g)
+
+    s3 = sub.add_parser("s3", help="s3 secret management")
+    s3.add_argument("verb", choices=["getsecret", "revokesecret"])
+    s3.add_argument("access_id")
+    s3.add_argument("--om", default="127.0.0.1:9860")
+    s3.set_defaults(fn=cmd_s3)
 
     so = sub.add_parser("scm-om", help="run the SCM+OM metadata server")
     so.add_argument("--db", required=True)
