@@ -1,12 +1,15 @@
-"""Hadoop-compatible filesystem adapter (o3fs analog).
+"""Hadoop-compatible filesystem adapters (o3fs + rooted ofs analogs).
 
-Mirror of the reference's ozonefs adapters (hadoop-ozone/ozonefs-common
-BasicOzoneFileSystem.java:99 — one bucket exposed as a filesystem rooted
-at o3fs://bucket.volume/): path semantics over the flat key namespace with
-directory markers (zero-byte keys ending in "/"), streaming open/create
-handles, rename, recursive delete and listing — the operations Hadoop/
-Spark-style consumers require (create, open, getFileStatus, listStatus,
-mkdirs, rename, delete).
+Mirror of the reference's ozonefs adapters (hadoop-ozone/ozonefs-common):
+- OzoneFileSystem — BasicOzoneFileSystem.java:99, one bucket exposed as a
+  filesystem rooted at o3fs://bucket.volume/: path semantics over the
+  flat key namespace with directory markers (zero-byte keys ending in
+  "/"), streaming open/create handles, rename, recursive delete/listing.
+- RootedOzoneFileSystem — RootedOzoneFileSystem (ofs:// cluster-rooted):
+  paths are /volume/bucket/rest; the first two path components address
+  the namespace (volumes and buckets appear as directories, mkdirs at
+  depth 1/2 creates them), deeper paths delegate to the bucket adapter.
+  Renames cannot cross bucket boundaries, like the reference.
 """
 
 from __future__ import annotations
@@ -19,6 +22,11 @@ import numpy as np
 
 from ozone_tpu.client.ozone_client import OzoneBucket
 from ozone_tpu.om.requests import OMError
+from ozone_tpu.storage.ids import StorageError
+
+# a local OzoneManager raises OMError; a remote OM (GrpcOmClient)
+# re-raises the same codes as StorageError
+_OM_ERRORS = (OMError, StorageError)
 
 
 @dataclass
@@ -99,7 +107,7 @@ class OzoneFileSystem:
             self.bucket.client.om.lookup_key(
                 self.bucket.volume, self.bucket.name, marker
             )
-        except OMError:
+        except _OM_ERRORS:
             self.bucket.write_key(marker, np.zeros(0, np.uint8))
 
     def exists(self, path: str) -> bool:
@@ -118,16 +126,23 @@ class OzoneFileSystem:
             info = om.lookup_key(self.bucket.volume, self.bucket.name, key)
             return FileStatus(key, False, info["size"],
                               info.get("modified", 0.0))
-        except OMError:
+        except _OM_ERRORS:
             pass
         try:
             info = om.lookup_key(
                 self.bucket.volume, self.bucket.name, key + "/"
             )
             return FileStatus(key, True, 0, info.get("modified", 0.0))
-        except OMError:
-            # implicit directory: any key under the prefix
-            if om.list_keys(self.bucket.volume, self.bucket.name, key + "/"):
+        except _OM_ERRORS:
+            # implicit directory: any key under the prefix (a missing
+            # bucket raises here too and must surface as not-found)
+            try:
+                children = om.list_keys(
+                    self.bucket.volume, self.bucket.name, key + "/"
+                )
+            except _OM_ERRORS:
+                children = []
+            if children:
                 return FileStatus(key, True, 0, 0.0)
         raise FileNotFoundError(path)
 
@@ -166,7 +181,7 @@ class OzoneFileSystem:
                 self.bucket.delete_key(k["name"])
             try:
                 self.bucket.delete_key(prefix)
-            except OMError:
+            except _OM_ERRORS:
                 pass
         else:
             self.bucket.delete_key(self._norm(path))
@@ -183,3 +198,142 @@ class OzoneFileSystem:
                 self.bucket.rename_key(k["name"], new)
         else:
             self.bucket.rename_key(s, d)
+
+
+class RootedOzoneFileSystem:
+    """The whole cluster as one filesystem: /volume/bucket/path
+    (reference RootedOzoneFileSystem, ofs:// scheme)."""
+
+    def __init__(self, client, replication: Optional[str] = None):
+        self.client = client
+        # replication for buckets implicitly created by mkdirs
+        self.replication = replication
+
+    # ------------------------------------------------------------- helpers
+    @staticmethod
+    def _split(path: str) -> list[str]:
+        return [s for s in path.split("/") if s]
+
+    def _bucket_fs(self, volume: str, bucket: str) -> OzoneFileSystem:
+        return OzoneFileSystem(OzoneBucket(self.client, volume, bucket))
+
+    def _resolve(self, path: str):
+        """-> (volume, bucket, rest) with None for absent components."""
+        parts = self._split(path)
+        vol = parts[0] if len(parts) >= 1 else None
+        bkt = parts[1] if len(parts) >= 2 else None
+        rest = "/".join(parts[2:])
+        return vol, bkt, rest
+
+    # ------------------------------------------------------------- ops
+    def create(self, path: str, data, overwrite: bool = True) -> None:
+        vol, bkt, rest = self._resolve(path)
+        if not (vol and bkt and rest):
+            raise IsADirectoryError(path)
+        self._bucket_fs(vol, bkt).create(rest, data, overwrite)
+
+    def open(self, path: str) -> OzoneFile:
+        vol, bkt, rest = self._resolve(path)
+        if not (vol and bkt and rest):
+            raise IsADirectoryError(path)
+        return self._bucket_fs(vol, bkt).open(rest)
+
+    def mkdirs(self, path: str) -> None:
+        vol, bkt, rest = self._resolve(path)
+        om = self.client.om
+        if vol:
+            try:
+                om.volume_info(vol)
+            except _OM_ERRORS:
+                om.create_volume(vol)
+        if vol and bkt:
+            try:
+                om.bucket_info(vol, bkt)
+            except _OM_ERRORS:
+                if self.replication:
+                    om.create_bucket(vol, bkt, self.replication)
+                else:
+                    om.create_bucket(vol, bkt)
+        if rest:
+            self._bucket_fs(vol, bkt).mkdirs(rest)
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.get_file_status(path)
+            return True
+        except FileNotFoundError:
+            return False
+
+    def get_file_status(self, path: str) -> FileStatus:
+        vol, bkt, rest = self._resolve(path)
+        om = self.client.om
+        try:
+            if vol is None:
+                return FileStatus("/", True, 0, 0.0)
+            if bkt is None:
+                v = om.volume_info(vol)
+                return FileStatus(vol, True, 0, v.get("created", 0.0))
+            if not rest:
+                b = om.bucket_info(vol, bkt)
+                return FileStatus(f"{vol}/{bkt}", True, 0,
+                                  b.get("created", 0.0))
+        except _OM_ERRORS:
+            raise FileNotFoundError(path)
+        st = self._bucket_fs(vol, bkt).get_file_status(rest)
+        return FileStatus(f"{vol}/{bkt}/{st.path}", st.is_dir, st.length,
+                          st.modification_time)
+
+    def list_status(self, path: str) -> list[FileStatus]:
+        vol, bkt, rest = self._resolve(path)
+        om = self.client.om
+        if vol is None:
+            return [
+                FileStatus(v["name"], True, 0, v.get("created", 0.0))
+                for v in om.list_volumes()
+            ]
+        if bkt is None:
+            try:
+                om.volume_info(vol)
+            except _OM_ERRORS:
+                raise FileNotFoundError(path)
+            return [
+                FileStatus(f"{vol}/{b['name']}", True, 0,
+                           b.get("created", 0.0))
+                for b in om.list_buckets(vol)
+            ]
+        out = self._bucket_fs(vol, bkt).list_status(rest)
+        return [
+            FileStatus(f"{vol}/{bkt}/{s.path}", s.is_dir, s.length,
+                       s.modification_time)
+            for s in out
+        ]
+
+    def delete(self, path: str, recursive: bool = False) -> bool:
+        vol, bkt, rest = self._resolve(path)
+        om = self.client.om
+        if vol is None:
+            raise OSError("cannot delete the root")
+        if bkt is None:
+            if recursive:
+                for b in om.list_buckets(vol):
+                    self.delete(f"/{vol}/{b['name']}", recursive=True)
+            om.delete_volume(vol)
+            return True
+        if not rest:
+            if recursive:
+                fs = self._bucket_fs(vol, bkt)
+                for st in fs.list_status(""):
+                    fs.delete(st.path, recursive=True)
+            om.delete_bucket(vol, bkt)
+            return True
+        return self._bucket_fs(vol, bkt).delete(rest, recursive)
+
+    def rename(self, src: str, dst: str) -> None:
+        sv, sb, srest = self._resolve(src)
+        dv, db, drest = self._resolve(dst)
+        if not (sv and sb and srest and drest):
+            raise OSError("rename requires paths inside a bucket")
+        if (sv, sb) != (dv, db):
+            # same constraint as the reference: no cross-bucket rename
+            raise OSError("rename cannot cross bucket boundaries")
+        self._bucket_fs(sv, sb).rename(srest, drest)

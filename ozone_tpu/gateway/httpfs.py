@@ -1,0 +1,229 @@
+"""WebHDFS-compatible REST gateway (HttpFS analog).
+
+Mirror of the reference's httpfsgateway (hadoop-ozone/httpfsgateway,
+HttpFSServerWebServer: a WebHDFS REST facade over the Ozone filesystem
+adapter). Serves the standard `/webhdfs/v1/<path>?op=...` verbs over the
+cluster-rooted filesystem (gateway/fs.py:RootedOzoneFileSystem):
+
+  GET    OPEN (offset/length), GETFILESTATUS, LISTSTATUS,
+         GETCONTENTSUMMARY
+  PUT    CREATE (two-step 307 redirect per the WebHDFS spec, or direct
+         with ?data=true), MKDIRS, RENAME (destination=)
+  POST   APPEND -> not implemented (matches immutable-key semantics)
+  DELETE DELETE (recursive=)
+
+Responses follow the WebHDFS JSON schema (FileStatus.type FILE/DIRECTORY,
+modificationTime in ms, RemoteException envelope on errors).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, quote, unquote, urlparse
+
+from ozone_tpu.gateway.fs import FileStatus, RootedOzoneFileSystem
+from ozone_tpu.om.requests import OMError
+from ozone_tpu.storage.ids import StorageError
+
+log = logging.getLogger(__name__)
+
+PREFIX = "/webhdfs/v1"
+
+
+def _status_json(st: FileStatus, suffix_only: bool = False) -> dict:
+    name = st.path.rstrip("/").rpartition("/")[2] if suffix_only else ""
+    return {
+        "pathSuffix": name,
+        "type": "DIRECTORY" if st.is_dir else "FILE",
+        "length": st.length,
+        "modificationTime": int(st.modification_time * 1000),
+        "accessTime": int(st.modification_time * 1000),
+        "blockSize": 16 * 1024 * 1024,
+        "replication": 1,
+        "permission": "755" if st.is_dir else "644",
+        "owner": "ozone",
+        "group": "ozone",
+    }
+
+
+class HttpFSGateway:
+    def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
+                 replication: Optional[str] = None):
+        self.fs = RootedOzoneFileSystem(client, replication=replication)
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                log.debug("httpfs: " + fmt, *args)
+
+            def _reply(self, status: int, body: bytes = b"",
+                       headers: Optional[dict] = None,
+                       content_type: str = "application/json"):
+                self.send_response(status)
+                self.send_header("Content-Type", content_type)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _json(self, status: int, obj: dict):
+                self._reply(status, json.dumps(obj).encode())
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            def do_GET(self):
+                gateway._route(self, "GET")
+
+            def do_PUT(self):
+                gateway._route(self, "PUT")
+
+            def do_POST(self):
+                gateway._route(self, "POST")
+
+            def do_DELETE(self):
+                gateway._route(self, "DELETE")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._httpd.server_port
+        self.host = host
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="httpfs", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # ----------------------------------------------------------------- route
+    @staticmethod
+    def _exception(status: int, exc: str, msg: str) -> tuple[int, dict]:
+        return status, {
+            "RemoteException": {
+                "exception": exc,
+                "javaClassName": f"java.io.{exc}",
+                "message": msg,
+            }
+        }
+
+    def _route(self, h, method: str) -> None:
+        u = urlparse(h.path)
+        if not u.path.startswith(PREFIX):
+            h._json(*self._exception(404, "FileNotFoundException", u.path))
+            return
+        path = unquote(u.path[len(PREFIX):]) or "/"
+        q = parse_qs(u.query, keep_blank_values=True)
+        op = q.get("op", [""])[0].upper()
+        try:
+            handler = getattr(self, f"_op_{method.lower()}_{op.lower()}",
+                              None)
+            if handler is None:
+                h._json(*self._exception(
+                    400, "UnsupportedOperationException",
+                    f"{method} op={op}"))
+                return
+            handler(h, path, q)
+        except FileNotFoundError as e:
+            h._json(*self._exception(404, "FileNotFoundException", str(e)))
+        except (IsADirectoryError, OSError) as e:
+            h._json(*self._exception(403, "IOException", str(e)))
+        except (OMError, StorageError) as e:
+            h._json(*self._exception(403, "IOException", str(e)))
+        except Exception as e:  # noqa: BLE001
+            log.exception("httpfs %s %s failed", method, h.path)
+            h._json(*self._exception(500, "RuntimeException", str(e)))
+
+    # ----------------------------------------------------------------- GET
+    def _op_get_open(self, h, path: str, q) -> None:
+        f = self.fs.open(path)
+        offset = int(q.get("offset", ["0"])[0])
+        f.seek(offset)
+        length = q.get("length", [None])[0]
+        data = f.read(int(length)) if length is not None else f.read()
+        h._reply(200, data, content_type="application/octet-stream")
+
+    def _op_get_getfilestatus(self, h, path: str, q) -> None:
+        st = self.fs.get_file_status(path)
+        h._json(200, {"FileStatus": _status_json(st)})
+
+    def _op_get_liststatus(self, h, path: str, q) -> None:
+        sts = self.fs.list_status(path)
+        h._json(200, {
+            "FileStatuses": {
+                "FileStatus": [_status_json(s, suffix_only=True)
+                               for s in sts]
+            }
+        })
+
+    def _op_get_getcontentsummary(self, h, path: str, q) -> None:
+        files = dirs = length = 0
+        stack = [path]
+        while stack:
+            p = stack.pop()
+            st = self.fs.get_file_status(p)
+            if st.is_dir:
+                dirs += 1
+                stack.extend(
+                    "/" + c.path for c in self.fs.list_status(p)
+                )
+            else:
+                files += 1
+                length += st.length
+        h._json(200, {
+            "ContentSummary": {
+                "directoryCount": dirs,
+                "fileCount": files,
+                "length": length,
+                "quota": -1,
+                "spaceConsumed": length,
+                "spaceQuota": -1,
+            }
+        })
+
+    # ----------------------------------------------------------------- PUT
+    def _op_put_create(self, h, path: str, q) -> None:
+        if q.get("data", ["false"])[0] != "true":
+            # WebHDFS two-step: redirect the client to the data endpoint
+            # (path was unquoted in _route; re-encode it for the header)
+            loc = (f"http://{self.address}{PREFIX}{quote(path)}?op=CREATE&"
+                   f"data=true&overwrite="
+                   f"{q.get('overwrite', ['true'])[0]}")
+            h._reply(307, headers={"Location": loc})
+            return
+        overwrite = q.get("overwrite", ["true"])[0] == "true"
+        self.fs.create(path, h._body(), overwrite=overwrite)
+        h._reply(201)
+
+    def _op_put_mkdirs(self, h, path: str, q) -> None:
+        self.fs.mkdirs(path)
+        h._json(200, {"boolean": True})
+
+    def _op_put_rename(self, h, path: str, q) -> None:
+        dst = q.get("destination", [""])[0]
+        if not dst:
+            raise OSError("destination required")
+        self.fs.rename(path, dst)
+        h._json(200, {"boolean": True})
+
+    # ----------------------------------------------------------------- DELETE
+    def _op_delete_delete(self, h, path: str, q) -> None:
+        recursive = q.get("recursive", ["false"])[0] == "true"
+        ok = self.fs.delete(path, recursive=recursive)
+        h._json(200, {"boolean": bool(ok)})

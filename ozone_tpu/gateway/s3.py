@@ -60,13 +60,16 @@ def _err(code: str, message: str, status: int) -> tuple[int, bytes]:
 class S3Gateway:
     def __init__(self, client: OzoneClient, host: str = "127.0.0.1",
                  port: int = 0, replication: str = "rs-6-3-1024k",
-                 require_auth: bool = False):
+                 require_auth: bool = False,
+                 max_clock_skew_s: float = 900.0):
         self.client = client
         self.replication = replication
         # require_auth=True enforces SigV4 on every request (anonymous
-        # reads still allowed on public-read buckets); False accepts
-        # unsigned requests but validates signatures when presented
+        # access still allowed per public bucket ACL grants); False
+        # accepts unsigned requests but validates presented signatures
         self.require_auth = require_auth
+        # signed-request freshness window (AWS: 15 min); 0 disables
+        self.max_clock_skew_s = max_clock_skew_s
         try:
             client.om.create_volume(S3_VOLUME)
         except _OM_ERRORS:
@@ -97,20 +100,27 @@ class S3Gateway:
                     self._cached_body = self.rfile.read(n) if n else b""
                 return self._cached_body
 
+            def _dispatch(self, method: str):
+                # the handler instance persists across requests on a
+                # keep-alive connection — drop the previous request's
+                # memoized body or it would be served again
+                self.__dict__.pop("_cached_body", None)
+                gateway._route(self, method)
+
             def do_GET(self):
-                gateway._route(self, "GET")
+                self._dispatch("GET")
 
             def do_PUT(self):
-                gateway._route(self, "PUT")
+                self._dispatch("PUT")
 
             def do_POST(self):
-                gateway._route(self, "POST")
+                self._dispatch("POST")
 
             def do_DELETE(self):
-                gateway._route(self, "DELETE")
+                self._dispatch("DELETE")
 
             def do_HEAD(self):
-                gateway._route(self, "HEAD")
+                self._dispatch("HEAD")
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port
@@ -147,20 +157,28 @@ class S3Gateway:
         u = urlparse(h.path)
         verify_request(
             secret, method, u.path, u.query, dict(h.headers), h._body(),
-            auth,
+            auth, max_skew_s=self.max_clock_skew_s or None,
         )
         return auth.access_id
 
-    def _is_public_read(self, bucket: str) -> bool:
+    def _public_grants(self, bucket: str) -> set:
         try:
             acl = self.client.om.get_bucket_acl(S3_VOLUME, bucket)
         except _OM_ERRORS:
-            return False
-        return any(
-            g.get("grantee") == "*" and g.get("permission") in
-            ("READ", "FULL_CONTROL")
+            return set()
+        return {
+            g.get("permission")
             for g in acl
-        )
+            if g.get("grantee") == "*"
+        }
+
+    def _anonymous_allowed(self, method: str, bucket: str) -> bool:
+        grants = self._public_grants(bucket)
+        if "FULL_CONTROL" in grants:
+            return True
+        if method in ("GET", "HEAD"):
+            return "READ" in grants
+        return "WRITE" in grants
 
     def _route(self, h, method: str) -> None:
         u = urlparse(h.path)
@@ -169,13 +187,9 @@ class S3Gateway:
         try:
             principal = self._authenticate(h, method)
             if principal is None and self.require_auth:
-                # anonymous: only reads of public-read buckets pass
-                public = (
-                    method in ("GET", "HEAD")
-                    and parts
-                    and self._is_public_read(parts[0])
-                )
-                if not public:
+                # anonymous: gated by the bucket's public ACL grants
+                # (READ for reads, WRITE for mutations)
+                if not (parts and self._anonymous_allowed(method, parts[0])):
                     h._reply(*_err("AccessDenied", "anonymous access", 403))
                     return
             if not parts:

@@ -165,9 +165,26 @@ def verify_request(
     headers: dict,
     body: bytes,
     auth: ParsedAuth,
+    max_skew_s: Optional[float] = None,
 ) -> None:
-    """Raise AuthError unless the request signature matches."""
+    """Raise AuthError unless the request signature matches. With
+    max_skew_s set, the signed x-amz-date must be within that window of
+    the server clock (AWS enforces 15 minutes), so captured requests
+    cannot be replayed verbatim later."""
     lower = {k.lower(): v for k, v in headers.items()}
+    if max_skew_s is not None:
+        import calendar
+        import time as _time
+
+        amz_date = str(lower.get("x-amz-date") or lower.get("date") or "")
+        try:
+            t = calendar.timegm(
+                _time.strptime(amz_date, "%Y%m%dT%H%M%SZ")
+            )
+        except ValueError:
+            raise AuthError("AccessDenied", f"bad x-amz-date {amz_date!r}")
+        if abs(_time.time() - t) > max_skew_s:
+            raise AuthError("RequestTimeTooSkewed", amz_date)
     claimed = str(lower.get("x-amz-content-sha256", ""))
     if claimed == UNSIGNED:
         payload_hash = UNSIGNED

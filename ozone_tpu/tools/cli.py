@@ -234,6 +234,26 @@ def cmd_s3g(args) -> int:
     return 0
 
 
+def cmd_httpfs(args) -> int:
+    """Run the WebHDFS-compatible HttpFS gateway daemon (reference:
+    `ozone httpfs`, httpfsgateway HttpFSServerWebServer)."""
+    import logging
+
+    from ozone_tpu.gateway.httpfs import HttpFSGateway
+
+    logging.basicConfig(level=logging.INFO)
+    gw = HttpFSGateway(_client(args), port=args.port,
+                       replication=args.replication)
+    gw.start()
+    print(f"httpfs gateway serving on {gw.address}, om={args.om}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        gw.stop()
+    return 0
+
+
 def cmd_s3(args) -> int:
     """S3 secret management (reference: `ozone s3 getsecret` /
     `revokesecret`)."""
@@ -309,6 +329,13 @@ def build_parser() -> argparse.ArgumentParser:
     s3g.add_argument("--require-auth", action="store_true",
                      help="enforce SigV4 signatures")
     s3g.set_defaults(fn=cmd_s3g)
+
+    hf = sub.add_parser("httpfs", help="run the WebHDFS-compatible gateway")
+    hf.add_argument("--om", default="127.0.0.1:9860")
+    hf.add_argument("--port", type=int, default=14000)
+    hf.add_argument("--replication", default=None,
+                    help="replication for implicitly created buckets")
+    hf.set_defaults(fn=cmd_httpfs)
 
     s3 = sub.add_parser("s3", help="s3 secret management")
     s3.add_argument("verb", choices=["getsecret", "revokesecret"])

@@ -6,6 +6,7 @@ us-east-1): signing-key bytes and final signature are the published
 values. The gateway tests then exercise the verifier over real HTTP.
 """
 
+import time
 import urllib.error
 import urllib.request
 
@@ -23,6 +24,10 @@ from ozone_tpu.gateway.s3_auth import (
 from ozone_tpu.testing.minicluster import MiniOzoneCluster
 
 EC = "rs-3-2-4096"
+
+
+def _now() -> str:
+    return time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
 
 AWS_SECRET = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
 AWS_ACCESS = "AKIDEXAMPLE"
@@ -112,7 +117,7 @@ def _signed(gw, creds, method, path, body=b""):
     url = f"http://{gw.address}{path}"
     headers = {
         "host": gw.address,
-        "x-amz-date": "20260729T000000Z",
+        "x-amz-date": _now(),
     }
     headers = sign_request(access, secret, method, url, headers, body)
     req = urllib.request.Request(url, data=body or None, method=method,
@@ -140,7 +145,7 @@ def test_bad_signature_rejected(gw, creds):
     url = f"http://{gw.address}/secure/obj"
     headers = sign_request(access, "wrong-secret", "GET", url,
                            {"host": gw.address,
-                            "x-amz-date": "20260729T000000Z"})
+                            "x-amz-date": _now()})
     req = urllib.request.Request(url, headers=headers)
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req)
@@ -152,7 +157,7 @@ def test_unknown_access_id_rejected(gw, creds):
     url = f"http://{gw.address}/secure/obj"
     headers = sign_request("nobody", "whatever", "GET", url,
                            {"host": gw.address,
-                            "x-amz-date": "20260729T000000Z"})
+                            "x-amz-date": _now()})
     req = urllib.request.Request(url, headers=headers)
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(req)
@@ -165,7 +170,7 @@ def test_tampered_payload_rejected(gw, creds):
     url = f"http://{gw.address}/secure/tamper"
     headers = sign_request(access, secret, "PUT", url,
                            {"host": gw.address,
-                            "x-amz-date": "20260729T000000Z"},
+                            "x-amz-date": _now()},
                            b"original")
     req = urllib.request.Request(url, data=b"tampered!", method="PUT",
                                  headers=headers)
@@ -181,7 +186,7 @@ def test_stripped_body_replay_rejected(gw, creds):
     url = f"http://{gw.address}/secure/replay"
     headers = sign_request(access, secret, "PUT", url,
                            {"host": gw.address,
-                            "x-amz-date": "20260729T000000Z"},
+                            "x-amz-date": _now()},
                            b"real content")
     ok = urllib.request.urlopen(urllib.request.Request(
         url, data=b"real content", method="PUT", headers=headers))
@@ -200,7 +205,7 @@ def test_malformed_acl_body_400(gw, creds):
     body = b"<AccessControlPolicy><AccessControlList><Grant><Grantee><ID>x</ID></Grantee></Grant></AccessControlList></AccessControlPolicy>"
     headers = sign_request(access, secret, "PUT", url,
                            {"host": gw.address,
-                            "x-amz-date": "20260729T000000Z"}, body)
+                            "x-amz-date": _now()}, body)
     req = urllib.request.Request(url, data=body, method="PUT",
                                  headers=headers)
     with pytest.raises(urllib.error.HTTPError) as ei:
@@ -220,7 +225,7 @@ def test_public_read_acl_allows_anonymous_get(gw, creds):
         f"http://{gw.address}/pub?acl", method="PUT",
         headers=sign_request(
             creds[0], creds[1], "PUT", f"http://{gw.address}/pub?acl",
-            {"host": gw.address, "x-amz-date": "20260729T000000Z",
+            {"host": gw.address, "x-amz-date": _now(),
              "x-amz-acl": "public-read"},
         ),
     )
@@ -235,6 +240,61 @@ def test_public_read_acl_allows_anonymous_get(gw, creds):
     assert ei.value.code == 403
 
 
+def test_stale_date_rejected(gw, creds):
+    """Regression: a verbatim replay of an old signed request must fail
+    the clock-skew window (RequestTimeTooSkewed)."""
+    access, secret = creds
+    url = f"http://{gw.address}/secure/obj"
+    old = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(time.time() - 3600))
+    headers = sign_request(access, secret, "GET", url,
+                           {"host": gw.address, "x-amz-date": old})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(urllib.request.Request(url, headers=headers))
+    assert ei.value.code == 403
+    assert b"RequestTimeTooSkewed" in ei.value.read()
+
+
+def test_public_read_write_allows_anonymous_put(gw, creds):
+    _signed(gw, creds, "PUT", "/pubrw")
+    req = urllib.request.Request(
+        f"http://{gw.address}/pubrw?acl", method="PUT",
+        headers=sign_request(
+            creds[0], creds[1], "PUT", f"http://{gw.address}/pubrw?acl",
+            {"host": gw.address, "x-amz-date": _now(),
+             "x-amz-acl": "public-read-write"},
+        ),
+    )
+    assert urllib.request.urlopen(req).status == 200
+    w = urllib.request.Request(f"http://{gw.address}/pubrw/anonobj",
+                               data=b"anon write", method="PUT")
+    assert urllib.request.urlopen(w).status == 200
+    got = urllib.request.urlopen(f"http://{gw.address}/pubrw/anonobj").read()
+    assert got == b"anon write"
+
+
+def test_keepalive_connection_body_isolation(gw, creds):
+    """Regression: two PUTs on one keep-alive connection must not reuse
+    the first request's memoized body."""
+    import http.client
+
+    access, secret = creds
+    conn = http.client.HTTPConnection(gw.host, gw.port)
+    try:
+        for name, body in (("ka1", b"first-body"), ("ka2", b"second!!")):
+            url = f"http://{gw.address}/secure/{name}"
+            headers = sign_request(access, secret, "PUT", url,
+                                   {"host": gw.address,
+                                    "x-amz-date": _now()}, body)
+            conn.request("PUT", f"/secure/{name}", body=body,
+                         headers=headers)
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200, name
+    finally:
+        conn.close()
+    assert _signed(gw, creds, "GET", "/secure/ka2").read() == b"second!!"
+
+
 def test_get_acl_xml(gw, creds):
     _signed(gw, creds, "PUT", "/aclb")
     r = _signed(gw, creds, "GET", "/aclb?acl")
@@ -247,7 +307,7 @@ def test_revoked_secret_rejected(gw, creds, cluster):
     url = f"http://{gw.address}/secure/obj"
     headers = sign_request("shortlived", secret, "GET", url,
                            {"host": gw.address,
-                            "x-amz-date": "20260729T000000Z"})
+                            "x-amz-date": _now()})
     assert urllib.request.urlopen(
         urllib.request.Request(url, headers=headers)).status == 200
     om.revoke_s3_secret("shortlived")
