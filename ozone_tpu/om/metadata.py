@@ -55,6 +55,11 @@ class OMMetadataStore:
         self._dirty: list[tuple[str, str, Optional[dict]]] = []
         self.flush_every = flush_every
         self._txid = 0
+        # bounded update journal for WAL-delta shipping (the reference's
+        # DBUpdatesWrapper: Recon tails OM RocksDB WAL deltas instead of
+        # rescanning). Entries: (txid, table, key, value-or-None).
+        self._updates: list[tuple[int, str, str, Optional[dict]]] = []
+        self.max_journal = 100_000
 
     # ------------------------------------------------------------------ CRUD
     def put(self, table: str, key: str, value: dict) -> None:
@@ -62,6 +67,7 @@ class OMMetadataStore:
             self._cache[table][key] = value
             self._dirty.append((table, key, value))
             self._txid += 1
+            self._journal(table, key, value)
             if len(self._dirty) >= self.flush_every:
                 self._flush_locked()
 
@@ -70,8 +76,33 @@ class OMMetadataStore:
             self._cache[table][key] = None
             self._dirty.append((table, key, None))
             self._txid += 1
+            self._journal(table, key, None)
             if len(self._dirty) >= self.flush_every:
                 self._flush_locked()
+
+    def _journal(self, table: str, key: str, value: Optional[dict]) -> None:
+        self._updates.append((self._txid, table, key, value))
+        if len(self._updates) > self.max_journal:
+            del self._updates[: len(self._updates) // 2]
+
+    def get_updates_since(
+        self, txid: int
+    ) -> tuple[list[tuple[int, str, str, Optional[dict]]], int, bool]:
+        """WAL-delta shipping (DBUpdatesWrapper analog): updates after
+        `txid`, the current txid, and whether the journal still reaches
+        back that far (False -> consumer must full-rescan, the same
+        contract as RocksDB WAL retention)."""
+        import bisect
+
+        with self._lock:
+            complete = (
+                txid >= (self._updates[0][0] - 1) if self._updates
+                else txid >= self._txid
+            )
+            # txids are strictly increasing: binary-search the offset
+            # instead of scanning the whole journal under the store lock
+            i = bisect.bisect_right(self._updates, txid, key=lambda u: u[0])
+            return self._updates[i:], self._txid, complete
 
     def get(self, table: str, key: str) -> Optional[dict]:
         with self._lock:

@@ -68,16 +68,150 @@ class ReconTasks:
         return dict(sorted(buckets.items(),
                            key=lambda kv: int(kv[0].split("^")[1])))
 
+class ContainerKeyIndex:
+    """Incrementally-maintained container -> keys index fed by OM WAL
+    deltas (the reference's OMDBUpdatesHandler + ContainerKeyMapperTask:
+    Recon tails OM RocksDB update batches and applies them to its own
+    rocksdb copy instead of rescanning the namespace)."""
+
+    def __init__(self, om: OzoneManager):
+        self.om = om
+        # cid -> {store_key: table}; FSO store keys are resolved to real
+        # namespace paths at query time (they embed parent object ids)
+        self._index: dict[int, dict[str, str]] = {}
+        self._key_containers: dict[str, list[int]] = {}
+        self._txid = 0
+        self.full_rebuilds = 0
+        self._lock = threading.RLock()
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        with self._lock:
+            self._index.clear()
+            self._key_containers.clear()
+            self._txid = self.om.store.txid
+            self.full_rebuilds += 1
+            for table in ("keys", "files"):
+                for k, info in self.om.store.iterate(table):
+                    self._apply(table, k, info)
+
+    def _apply(self, table: str, key: str, info) -> None:
+        # drop the previous mapping for this key path, then re-add
+        for cid in self._key_containers.pop(key, []):
+            m = self._index.get(cid)
+            if m is not None:
+                m.pop(key, None)
+                if not m:
+                    del self._index[cid]
+        if info is None:
+            return
+        cids = []
+        for g in info.get("block_groups", []):
+            cid = int(g["container_id"])
+            self._index.setdefault(cid, {})[key] = table
+            cids.append(cid)
+        if cids:
+            self._key_containers[key] = cids
+
+    def refresh(self) -> None:
+        with self._lock:
+            updates, txid, complete = self.om.store.get_updates_since(
+                self._txid
+            )
+            if not complete:
+                self._rebuild()
+                return
+            for utx, table, key, value in updates:
+                if table in ("keys", "files"):
+                    self._apply(table, key, value)
+            self._txid = txid
+
+    def _display_path(self, store_key: str, table: str) -> str:
+        """Real namespace path for a store key: keys-table keys ARE paths;
+        files-table keys are /vol/bucket/<parentId>/<name> and resolve by
+        walking the dir_ids index upward (fso.py id_key layout)."""
+        if table != "files":
+            return store_key
+        from ozone_tpu.om.fso import ROOT_ID
+
+        parts = store_key.split("/")
+        if len(parts) < 5:
+            return store_key
+        vol, bkt, pid = parts[1], parts[2], parts[3]
+        segs = ["/".join(parts[4:])]
+        store = self.om.store
+        while pid != ROOT_ID:
+            row = store.get("dir_ids", f"/{vol}/{bkt}/{pid}")
+            if row is None:
+                break  # detached subtree pending purge
+            segs.append(row["name"])
+            pid = row["parent_id"]
+        return f"/{vol}/{bkt}/" + "/".join(reversed(segs))
+
     def container_key_map(self) -> dict[int, list[str]]:
-        """container id -> keys with data in it (ContainerKeyMapperTask)."""
-        out: dict[int, list[str]] = {}
-        for v in self.om.list_volumes():
-            for b in self.om.list_buckets(v["name"]):
-                for k in self.om.list_keys(v["name"], b["name"]):
-                    path = f"/{v['name']}/{b['name']}/{k['name']}"
-                    for g in k.get("block_groups", []):
-                        out.setdefault(g["container_id"], []).append(path)
-        return out
+        self.refresh()
+        with self._lock:
+            snapshot = {
+                cid: dict(m) for cid, m in self._index.items()
+            }
+        return {
+            cid: sorted(
+                self._display_path(k, table) for k, table in m.items()
+            )
+            for cid, m in snapshot.items()
+        }
+
+
+class ReconWarehouse:
+    """Persistent stats warehouse (the reference's jOOQ/Derby SQL
+    warehouse: GlobalStats / FileCountBySize / cluster-growth tables,
+    schema generated in recon-codegen). Sqlite: one `stats` table of
+    timestamped JSON task outputs queryable by kind."""
+
+    def __init__(self, path):
+        import sqlite3
+        from pathlib import Path
+
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        self._conn = sqlite3.connect(str(p), check_same_thread=False)
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS stats "
+            "(id INTEGER PRIMARY KEY AUTOINCREMENT, ts REAL, kind TEXT, "
+            "data TEXT)"
+        )
+        self._conn.execute(
+            "CREATE INDEX IF NOT EXISTS stats_kind ON stats (kind, ts)"
+        )
+        self._conn.commit()
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, data: dict) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO stats (ts, kind, data) VALUES (?, ?, ?)",
+                (time.time(), kind, json.dumps(data, default=str)),
+            )
+            self._conn.commit()
+
+    def history(self, kind: str, limit: int = 100) -> list[dict]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT ts, data FROM stats WHERE kind=? "
+                "ORDER BY ts DESC LIMIT ?",
+                (kind, limit),
+            ).fetchall()
+        return [
+            {"ts": ts, **json.loads(data)} for ts, data in rows
+        ]
+
+    def latest(self, kind: str):
+        h = self.history(kind, limit=1)
+        return h[0] if h else None
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
 
 
 class ReconScmView:
@@ -140,9 +274,13 @@ class ReconServer:
     """Recon REST API over the service HTTP server."""
 
     def __init__(self, om: OzoneManager, scm: StorageContainerManager,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0, db_path=None):
         self.tasks = ReconTasks(om)
         self.scm_view = ReconScmView(scm)
+        self.key_index = ContainerKeyIndex(om)
+        self.warehouse = (
+            ReconWarehouse(db_path) if db_path is not None else None
+        )
         from ozone_tpu.utils.http_server import ServiceHttpServer
 
         self._base = ServiceHttpServer(
@@ -154,20 +292,32 @@ class ReconServer:
 
         class Handler(orig_handler):
             def do_GET(self):
+                path = self.path.split("?")[0]
                 routes = {
                     "/api/namespace": recon.tasks.namespace_summary,
                     "/api/filesizes": recon.tasks.file_size_histogram,
                     "/api/containers/keys": lambda: {
                         str(k): v
-                        for k, v in recon.tasks.container_key_map().items()
+                        for k, v in recon.key_index.container_key_map()
+                        .items()
                     },
                     "/api/containers/health": recon.scm_view.container_health,
                     "/api/nodes": recon.scm_view.node_table,
                     "/api/summary": recon.api_summary,
                 }
-                fn = routes.get(self.path.split("?")[0])
+                fn = routes.get(path)
                 if fn is not None:
                     self._send(200, json.dumps(fn(), indent=2, default=str))
+                elif path.startswith("/api/history/"):
+                    if recon.warehouse is None:
+                        self._send(404, '{"error": "no warehouse"}')
+                        return
+                    kind = path.rpartition("/")[2]
+                    self._send(
+                        200,
+                        json.dumps(recon.warehouse.history(kind),
+                                   indent=2, default=str),
+                    )
                 else:
                     super().do_GET()
 
@@ -182,6 +332,23 @@ class ReconServer:
             "nodes": self.scm_view.node_table(),
         }
 
+    def run_tasks_once(self) -> None:
+        """One warehouse tick (ReconTaskController analog): refresh the
+        delta-fed index and persist every task's output with a
+        timestamp so operators get history, not just now."""
+        self.key_index.refresh()
+        if self.warehouse is None:
+            return
+        self.warehouse.record("namespace", self.tasks.namespace_summary())
+        self.warehouse.record(
+            "filesizes", {"buckets": self.tasks.file_size_histogram()}
+        )
+        health = self.scm_view.container_health()
+        self.warehouse.record(
+            "container_health", {k: len(v) for k, v in health.items()}
+        )
+        self.warehouse.record("nodes", {"nodes": self.scm_view.node_table()})
+
     @property
     def address(self) -> str:
         return self._base.address
@@ -191,3 +358,5 @@ class ReconServer:
 
     def stop(self) -> None:
         self._base.stop()
+        if self.warehouse is not None:
+            self.warehouse.close()

@@ -254,6 +254,26 @@ def cmd_httpfs(args) -> int:
     return 0
 
 
+def cmd_csi(args) -> int:
+    """Run the CSI driver daemon (reference: `ozone csi`, csi
+    CsiServer)."""
+    import logging
+
+    from ozone_tpu.gateway.csi import CsiServer
+
+    logging.basicConfig(level=logging.INFO)
+    srv = CsiServer(_client(args), s3_endpoint=args.s3_endpoint,
+                    port=args.port, replication=args.replication)
+    srv.start()
+    print(f"csi driver serving on {srv.address}, om={args.om}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
 def cmd_s3(args) -> int:
     """S3 secret management (reference: `ozone s3 getsecret` /
     `revokesecret`)."""
@@ -336,6 +356,13 @@ def build_parser() -> argparse.ArgumentParser:
     hf.add_argument("--replication", default=None,
                     help="replication for implicitly created buckets")
     hf.set_defaults(fn=cmd_httpfs)
+
+    csi = sub.add_parser("csi", help="run the CSI driver daemon")
+    csi.add_argument("--om", default="127.0.0.1:9860")
+    csi.add_argument("--port", type=int, default=9899)
+    csi.add_argument("--s3-endpoint", default="")
+    csi.add_argument("--replication", default=None)
+    csi.set_defaults(fn=cmd_csi)
 
     s3 = sub.add_parser("s3", help="s3 secret management")
     s3.add_argument("verb", choices=["getsecret", "revokesecret"])
