@@ -100,6 +100,9 @@ class DatanodeClientFactory:
             raise KeyError(f"no client for datanode {dn_id}")
         return c
 
+    def known_ids(self) -> list[str]:
+        return sorted(set(self._local) | set(self._addresses))
+
     def maybe_get(self, dn_id: str) -> Optional[DatanodeClient]:
         c = self._local.get(dn_id)
         if c is not None:

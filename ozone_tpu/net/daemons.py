@@ -53,6 +53,9 @@ class DatanodeDaemon:
         self.dn = Datanode(Path(root), dn_id=dn_id)
         self.server = RpcServer(host, port)
         self.service = DatanodeGrpcService(self.dn, self.server)
+        from ozone_tpu.utils.insight import InsightService
+
+        self.insight = InsightService(self.server, f"datanode:{dn_id}")
         self.scm = GrpcScmClient(scm_address)
         self.rack = rack
         self.heartbeat_interval = heartbeat_interval_s
@@ -181,6 +184,9 @@ class ScmOmDaemon:
             self.om, self.server,
             addresses_provider=lambda: dict(self.scm_service.addresses),
         )
+        from ozone_tpu.utils.insight import InsightService
+
+        self.insight = InsightService(self.server, "scm-om")
         self._bg_interval = background_interval_s
 
     @property

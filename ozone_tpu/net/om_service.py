@@ -267,6 +267,7 @@ class GrpcOmClient:
     """Remote OzoneManager with the attribute surface OzoneClient expects."""
 
     def __init__(self, address: str, clients=None):
+        self.address = address
         self._ch = RpcChannel(address)
         self.block_size = 16 * 1024 * 1024
         self.clients = clients  # DatanodeClientFactory for address learning

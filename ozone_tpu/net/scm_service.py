@@ -99,6 +99,7 @@ class ScmGrpcService:
                 "AllocateBlock": self._allocate_block,
                 "NodeAddresses": self._node_addresses,
                 "Status": self._status,
+                "ListContainers": self._list_containers,
             },
         )
 
@@ -138,6 +139,28 @@ class ScmGrpcService:
 
     def _node_addresses(self, req: bytes) -> bytes:
         return wire.pack({"addresses": dict(self.addresses)})
+
+    def _list_containers(self, req: bytes) -> bytes:
+        """Container listing for admin/repair tools (`ozone admin
+        container list` analog)."""
+        return wire.pack({
+            "containers": [
+                {
+                    "id": c.id,
+                    "state": c.state.value,
+                    "replication": str(c.replication),
+                    "nodes": c.pipeline.nodes if c.pipeline else [],
+                    "used_bytes": c.used_bytes,
+                    # snapshot: heartbeat threads mutate replicas live
+                    "replicas": [
+                        {"dn_id": r.dn_id, "state": r.state,
+                         "replica_index": r.replica_index}
+                        for r in list(c.replicas.values())
+                    ],
+                }
+                for c in self.scm.containers.containers()
+            ],
+        })
 
     def _status(self, req: bytes) -> bytes:
         return wire.pack(
@@ -192,6 +215,9 @@ class GrpcScmClient:
             "excluded": excluded or [],
         })
         return m["group"], m["addresses"]
+
+    def list_containers(self) -> list[dict]:
+        return self._call("ListContainers", {})["containers"]
 
     def node_addresses(self) -> dict[str, str]:
         return self._call("NodeAddresses", {})["addresses"]

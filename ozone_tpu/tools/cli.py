@@ -171,6 +171,33 @@ def cmd_freon(args) -> int:
                 schema=args.schema, cell=args.cell, batch=args.batch
             )
         )
+    elif args.generator == "omkg":
+        _emit(freon.omkg(_client(args), n_keys=args.num,
+                         threads=args.threads).summary())
+    elif args.generator == "ommg":
+        _emit(freon.ommg(_client(args), n_ops=args.num,
+                         threads=args.threads, mix=args.mix).summary())
+    elif args.generator == "scmtb":
+        _emit(freon.scmtb(
+            _client(args), n_blocks=args.num, threads=args.threads,
+            replication=args.replication or "rs-3-2-4096",
+        ).summary())
+    elif args.generator == "cmdw":
+        _emit(freon.cmdw(args.root or "/tmp/ozone-cmdw", n_chunks=args.num,
+                         size=args.size, threads=args.threads).summary())
+    elif args.generator == "dbgen":
+        _emit(freon.dbgen(args.root or "/tmp/ozone-dbgen.db",
+                          n_keys=args.num).summary())
+    elif args.generator in ("dcg", "dcv"):
+        oz = _client(args)
+        dn_ids = list(oz.clients.known_ids())
+        if not dn_ids:
+            print(f"error: no datanodes known (is the SCM at {args.om} "
+                  "reachable?)", file=sys.stderr)
+            return 1
+        gen = freon.dcg if args.generator == "dcg" else freon.dcv
+        _emit(gen(oz.clients, dn_ids, args.num, size=args.size,
+                  threads=args.threads).summary())
     return 0
 
 
@@ -287,6 +314,107 @@ def cmd_s3(args) -> int:
     return 0
 
 
+def cmd_insight(args) -> int:
+    """Per-subsystem introspection (ozone insight analog): list points,
+    read metrics, tail logs, bump log levels on a running daemon."""
+    from ozone_tpu.utils.insight import InsightClient
+
+    cli = InsightClient(args.address or args.om)
+    try:
+        if args.verb == "list":
+            _emit(cli.list_points())
+        elif args.verb == "metrics":
+            _emit(cli.metrics())
+        elif args.verb == "logs":
+            for r in cli.logs(n=args.num, logger=args.logger,
+                              level=args.level):
+                print(f"{r['ts']:.3f} {r['level']:<8} {r['logger']}: "
+                      f"{r['message']}")
+        elif args.verb == "log-level":
+            _emit(cli.set_log_level(args.logger, args.level or "DEBUG"))
+    finally:
+        cli.close()
+    return 0
+
+
+def _scan_referenced_blocks(oz) -> set:
+    """All (container, local) pairs referenced by committed keys."""
+    referenced: set[tuple[int, int]] = set()
+    for v in oz.om.list_volumes():
+        for b in oz.om.list_buckets(v["name"]):
+            for k in oz.om.list_keys(v["name"], b["name"]):
+                for g in k.get("block_groups", []):
+                    referenced.add(
+                        (int(g["container_id"]), int(g["local_id"]))
+                    )
+    return referenced
+
+
+def cmd_repair(args) -> int:
+    """Repair tools (ozone repair analog). `orphans`: blocks present on
+    datanodes but referenced by no key — left behind by failed writes or
+    interrupted deletes; reports them, --delete reclaims.
+
+    Deletion safety: blocks are enumerated BEFORE the namespace scan (a
+    key committed mid-scan is still seen as referenced), OPEN containers
+    are report-only (in-flight writes target OPEN containers exclusively,
+    so closed containers cannot gain new blocks), and the namespace is
+    re-checked immediately before each delete."""
+    from ozone_tpu.net.scm_service import GrpcScmClient
+    from ozone_tpu.storage.ids import BlockID
+
+    oz = _client(args)
+    scm = GrpcScmClient(args.om)
+    if args.tool != "orphans":
+        print(f"unknown repair tool {args.tool}", file=sys.stderr)
+        return 1
+    # 1. candidates first: (pair, dn, container_state)
+    candidates: list[tuple[tuple[int, int], str, str]] = []
+    for c in scm.list_containers():
+        if c["state"] == "DELETED":
+            continue
+        for rep in c["replicas"]:
+            client = oz.clients.maybe_get(rep["dn_id"])
+            if client is None:
+                continue
+            try:
+                blocks = client.list_blocks(int(c["id"]))
+            except Exception:
+                continue
+            for blk in blocks:
+                candidates.append((
+                    (blk.block_id.container_id, blk.block_id.local_id),
+                    rep["dn_id"], c["state"],
+                ))
+    # 2. namespace after the block listing
+    referenced = _scan_referenced_blocks(oz)
+    orphans = [c for c in candidates if c[0] not in referenced]
+    # 3. optional reclaim, with a final re-check right before deleting
+    if args.delete and orphans:
+        recheck = _scan_referenced_blocks(oz)
+    report = []
+    for pair, dn_id, state in orphans:
+        entry = {
+            "container_id": pair[0],
+            "local_id": pair[1],
+            "datanode": dn_id,
+            "container_state": state,
+            "action": "none",
+        }
+        if args.delete:
+            if state == "OPEN":
+                # an in-flight write may still commit this block
+                entry["action"] = "skipped-open-container"
+            elif pair in recheck:
+                entry["action"] = "skipped-now-referenced"
+            else:
+                oz.clients.get(dn_id).delete_block(BlockID(*pair))
+                entry["action"] = "deleted"
+        report.append(entry)
+    _emit({"orphans": report, "count": len(report)})
+    return 0
+
+
 # -------------------------------------------------------------------- main
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="ozone-tpu")
@@ -322,7 +450,9 @@ def build_parser() -> argparse.ArgumentParser:
     ad.set_defaults(fn=cmd_admin)
 
     fr = sub.add_parser("freon", help="load generators")
-    fr.add_argument("generator", choices=["ockg", "ockr", "rawcoder"])
+    fr.add_argument("generator",
+                    choices=["ockg", "ockr", "rawcoder", "omkg", "ommg",
+                             "scmtb", "cmdw", "dbgen", "dcg", "dcv"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("-t", "--threads", type=int, default=4)
@@ -332,6 +462,10 @@ def build_parser() -> argparse.ArgumentParser:
     fr.add_argument("--schema", default="rs-6-3")
     fr.add_argument("--cell", type=int, default=1024 * 1024)
     fr.add_argument("--batch", type=int, default=8)
+    fr.add_argument("--mix", default="crudl",
+                    help="ommg op mix (c/r/u/d/l per char)")
+    fr.add_argument("--root", default="",
+                    help="local path for cmdw/dbgen")
     fr.set_defaults(fn=cmd_freon)
 
     dn = sub.add_parser("datanode", help="run a datanode daemon")
@@ -375,6 +509,25 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("--port", type=int, default=9860)
     so.add_argument("--min-datanodes", type=int, default=1)
     so.set_defaults(fn=cmd_scm_om)
+
+    ins = sub.add_parser("insight",
+                         help="subsystem introspection (ozone insight)")
+    ins.add_argument("verb", choices=["list", "metrics", "logs",
+                                      "log-level"])
+    ins.add_argument("--om", default="127.0.0.1:9860")
+    ins.add_argument("--address", default="",
+                     help="daemon address (defaults to --om)")
+    ins.add_argument("--logger", default="")
+    ins.add_argument("--level", default="")
+    ins.add_argument("-n", "--num", type=int, default=100)
+    ins.set_defaults(fn=cmd_insight)
+
+    rp = sub.add_parser("repair", help="repair tools (ozone repair analog)")
+    rp.add_argument("tool", choices=["orphans"])
+    rp.add_argument("--om", default="127.0.0.1:9860")
+    rp.add_argument("--delete", action="store_true",
+                    help="reclaim orphaned blocks")
+    rp.set_defaults(fn=cmd_repair)
 
     dbg = sub.add_parser("debug", help="debug tools (ozone debug analog)")
     dbg.add_argument("tool", choices=["ldb", "chunk-info", "verify-replicas"])

@@ -224,6 +224,134 @@ def dcv(clients, dn_ids: list[str], n_chunks: int, size: int = 1024 * 1024,
     return BaseFreonGenerator("dcv", n_chunks, threads).run(op)
 
 
+def cmdw(root, n_chunks: int = 200, size: int = 4 * 1024 * 1024,
+         threads: int = 4) -> FreonReport:
+    """Chunk-manager disk write: pure local chunk IO, no network, no
+    OM/SCM (ChunkManagerDiskWrite analog — isolates the disk path)."""
+    from pathlib import Path
+
+    from ozone_tpu.storage.chunk_store import FilePerBlockStore
+    from ozone_tpu.storage.ids import BlockID, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    store = FilePerBlockStore(Path(root))
+    rng = np.random.default_rng(3)
+    payload = rng.integers(0, 256, size, dtype=np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 16 * 1024).compute(payload)
+
+    def op(i: int) -> int:
+        bid = BlockID(1 + i // 64, i + 1)
+        store.write_chunk(bid, ChunkInfo(f"c{i}", 0, size, cs), payload)
+        return size
+
+    return BaseFreonGenerator("cmdw", n_chunks, threads).run(op)
+
+
+def scmtb(client, n_blocks: int = 1000, threads: int = 8,
+          replication: str = "rs-3-2-4096",
+          block_size: int = 16 * 1024 * 1024) -> FreonReport:
+    """SCM block-allocation throughput (SCMThroughputBenchmark analog):
+    hammers allocateBlock without writing any data."""
+    from ozone_tpu.scm.pipeline import ReplicationConfig
+
+    cfg = ReplicationConfig.parse(replication)
+    if hasattr(client.om, "scm") and not isinstance(client.om.scm, str):
+        # in-process OM: call the SCM manager directly
+        op_alloc = lambda: client.om.scm.allocate_block(cfg, block_size)
+    else:
+        # remote OM: the co-located SCM service honors block_size
+        from ozone_tpu.net.scm_service import GrpcScmClient
+
+        scm = GrpcScmClient(client.om.address)
+        op_alloc = lambda: scm.allocate_block(replication, block_size)
+
+    def op(i: int) -> int:
+        op_alloc()
+        return 0
+
+    return BaseFreonGenerator("scmtb", n_blocks, threads).run(op)
+
+
+def dbgen(db_path, n_keys: int = 10_000, volume: str = "genvol",
+          bucket: str = "genbucket", threads: int = 1) -> FreonReport:
+    """Offline OM metadata fabrication (freon GeneratorOm analog): writes
+    a populated OM database directly — no cluster, no datanodes — for
+    testing metadata-scale behavior (billion-key DBs in the reference)."""
+    from pathlib import Path
+
+    from ozone_tpu.om.metadata import OMMetadataStore, bucket_key, key_key, \
+        volume_key
+
+    store = OMMetadataStore(Path(db_path), flush_every=4096)
+    store.put("volumes", volume_key(volume),
+              {"name": volume, "owner": "freon", "quota_bytes": -1,
+               "created": time.time()})
+    store.put("buckets", bucket_key(volume, bucket),
+              {"volume": volume, "name": bucket,
+               "replication": "rs-6-3-1024k", "layout": "OBJECT_STORE",
+               "versioning": False, "created": time.time()})
+
+    def op(i: int) -> int:
+        kk = key_key(volume, bucket, f"gen/{i // 1000}/key-{i}")
+        store.put("keys", kk, {
+            "volume": volume, "bucket": bucket,
+            "name": f"gen/{i // 1000}/key-{i}",
+            "replication": "rs-6-3-1024k",
+            "checksum_type": "CRC32C", "bytes_per_checksum": 16384,
+            "size": 1024, "block_groups": [], "created": time.time(),
+            "modified": time.time(),
+        })
+        return 1024
+
+    # single-threaded by design: sqlite writer; flush batching does the work
+    report = BaseFreonGenerator("dbgen", n_keys, threads).run(op)
+    store.close()
+    return report
+
+
+def ommg(client, n_ops: int = 1000, threads: int = 8,
+         volume: str = "freon-vol", bucket: str = "freon-meta",
+         mix: str = "crudl") -> FreonReport:
+    """Mixed OM metadata ops (OmMetadataGenerator analog): cycles
+    create/read(lookup)/update(rename)/delete/list per the mix string."""
+    bad = set(mix) - set("crudl")
+    if not mix or bad:
+        raise ValueError(f"mix must be chars from 'crudl', got {mix!r}")
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket)
+    except Exception:
+        pass
+    # seed keys the read/delete ops can hit
+    for i in range(min(64, n_ops)):
+        s = client.om.open_key(volume, bucket, f"mix-{i}")
+        client.om.commit_key(s, [], 0)
+
+    def op(i: int) -> int:
+        kind = mix[i % len(mix)]
+        name = f"mix-{i % 64}"
+        if kind == "c":
+            s = client.om.open_key(volume, bucket, f"mix-new-{i}")
+            client.om.commit_key(s, [], 0)
+        elif kind == "r":
+            client.om.lookup_key(volume, bucket, name)
+        elif kind == "u":
+            client.om.rename_key(volume, bucket, name, name + ".r")
+            client.om.rename_key(volume, bucket, name + ".r", name)
+        elif kind == "d":
+            s = client.om.open_key(volume, bucket, f"mix-del-{i}")
+            client.om.commit_key(s, [], 0)
+            client.om.delete_key(volume, bucket, f"mix-del-{i}")
+        elif kind == "l":
+            client.om.list_keys(volume, bucket, "mix-")
+        return 0
+
+    return BaseFreonGenerator("ommg", n_ops, threads).run(op)
+
+
 def rawcoder_bench(
     backends: Optional[list[str]] = None,
     schema: str = "rs-6-3",

@@ -1,0 +1,153 @@
+"""Insight service, repair tool, and new freon generators.
+
+Mirrors the reference's insight CLI tests (per-subsystem points, log
+streaming via level bump) and freon generator coverage."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+from ozone_tpu.tools import freon
+from ozone_tpu.utils.insight import (
+    INSIGHT_POINTS,
+    InsightClient,
+    InsightService,
+    RingLogHandler,
+)
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = MiniOzoneCluster(
+        tmp_path_factory.mktemp("insight"),
+        num_datanodes=5,
+        block_size=8 * 4096,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+# ------------------------------------------------------------------ insight
+@pytest.fixture(scope="module")
+def insight(cluster):
+    from ozone_tpu.net.rpc import RpcServer
+
+    server = RpcServer()
+    InsightService(server, "test-daemon")
+    server.start()
+    cli = InsightClient(server.address)
+    yield cli
+    cli.close()
+    server.stop()
+
+
+def test_insight_points_catalog(insight):
+    points = insight.list_points()["points"]
+    assert "scm.replication-manager" in points
+    assert "om.key-manager" in points
+    for p in points.values():
+        assert p["loggers"] and p["metrics"]
+    assert set(points) == set(INSIGHT_POINTS)
+
+
+def test_insight_metrics(cluster, insight):
+    cluster.client().create_volume("insvol")
+    regs = insight.metrics()["registries"]
+    assert "om" in regs and "scm" in regs
+    assert regs["scm"].get("heartbeats", 0) >= 0
+
+
+def test_insight_logs_and_level(insight):
+    log = logging.getLogger("ozone_tpu.scm.replication_manager")
+    insight.set_log_level("ozone_tpu.scm.replication_manager", "DEBUG")
+    log.debug("insight-test-debug-message %d", 42)
+    records = insight.logs(n=50, logger="ozone_tpu.scm")
+    assert any("insight-test-debug-message 42" in r["message"]
+               for r in records)
+    # level filter excludes DEBUG
+    records = insight.logs(n=50, logger="ozone_tpu.scm", level="ERROR")
+    assert not any("insight-test-debug-message" in r["message"]
+                   for r in records)
+
+
+def test_ring_handler_bounded():
+    h = RingLogHandler(capacity=10)
+    for i in range(100):
+        h.emit(logging.LogRecord("x", logging.INFO, "", 0,
+                                 f"m{i}", (), None))
+    assert len(h.records) == 10
+    assert h.tail(5)[-1]["message"] == "m99"
+
+
+# ------------------------------------------------------------------- repair
+def test_orphan_block_detection(cluster):
+    from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo
+
+    oz = cluster.client()
+    b = oz.create_volume("repvol").create_bucket("rb", replication=EC)
+    b.write_key("legit", np.arange(9000, dtype=np.uint8) % 251)
+    info = oz.om.lookup_key("repvol", "rb", "legit")
+    g = info["block_groups"][0]
+    cid = int(g["container_id"])
+    dn_id = g["nodes"][0]
+    # fabricate an orphan block in the same container on one datanode
+    orphan = BlockID(cid, 999_999)
+    client = oz.clients.get(dn_id)
+    client.put_block(BlockData(orphan, chunks=[]))
+    referenced = {
+        (int(gg["container_id"]), int(gg["local_id"]))
+        for v in oz.om.list_volumes()
+        for bk in oz.om.list_buckets(v["name"])
+        for k in oz.om.list_keys(v["name"], bk["name"])
+        for gg in k.get("block_groups", [])
+    }
+    blocks = client.list_blocks(cid)
+    orphans = [
+        blk for blk in blocks
+        if (blk.block_id.container_id, blk.block_id.local_id)
+        not in referenced
+    ]
+    assert [o.block_id.local_id for o in orphans] == [999_999]
+    client.delete_block(orphan)
+    assert all(
+        blk.block_id.local_id != 999_999
+        for blk in client.list_blocks(cid)
+    )
+
+
+# ------------------------------------------------------------- freon gens
+def test_freon_cmdw(tmp_path):
+    rep = freon.cmdw(tmp_path / "chunks", n_chunks=20, size=64 * 1024,
+                     threads=2)
+    assert rep.failures == 0
+    assert rep.summary()["throughput_mib_s"] > 0
+
+
+def test_freon_scmtb(cluster):
+    rep = freon.scmtb(cluster.client(), n_blocks=50, threads=4,
+                      replication=EC)
+    assert rep.failures == 0
+    assert rep.summary()["ops_per_s"] > 0
+
+
+def test_freon_dbgen(tmp_path):
+    rep = freon.dbgen(tmp_path / "gen.db", n_keys=500)
+    assert rep.failures == 0
+    from ozone_tpu.om.metadata import OMMetadataStore
+
+    store = OMMetadataStore(tmp_path / "gen.db")
+    keys = list(store.iterate("keys"))
+    store.close()
+    assert len(keys) == 500
+
+
+def test_freon_ommg(cluster):
+    rep = freon.ommg(cluster.client(), n_ops=50, threads=4)
+    assert rep.failures == 0
