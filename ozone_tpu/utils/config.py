@@ -137,6 +137,46 @@ def generate_defaults(groups: list[type]) -> str:
     return "\n".join(lines) + "\n" + json.dumps(out, indent=2, sort_keys=True)
 
 
+class ReconfigurationHandler:
+    """Live reconfiguration (reference: ReconfigureProtocol.proto +
+    ReconfigurableConfig, doc feature/Reconfigurability.md): services
+    register reconfigurable keys with an apply callback (and optional
+    validator); `reconfigure` validates, updates the layered config's
+    override tier, and applies — no restart. Non-registered keys are
+    rejected, like the reference's getReconfigurableProperties contract.
+    """
+
+    def __init__(self, conf_obj: "OzoneConfiguration"):
+        self.conf = conf_obj
+        self._props: dict[str, dict] = {}
+
+    def register(self, key: str, apply, validator=None,
+                 description: str = "") -> None:
+        self._props[key] = {
+            "apply": apply,
+            "validator": validator,
+            "description": description,
+        }
+
+    def properties(self) -> list[dict]:
+        return [
+            {"key": k, "description": p["description"],
+             "current": self.conf.raw(k)}
+            for k, p in sorted(self._props.items())
+        ]
+
+    def reconfigure(self, key: str, value: Any) -> dict:
+        p = self._props.get(key)
+        if p is None:
+            raise KeyError(f"{key} is not reconfigurable")
+        if p["validator"] is not None:
+            value = p["validator"](value)
+        old = self.conf.raw(key)
+        self.conf.set(key, value)
+        p["apply"](value)
+        return {"key": key, "old": old, "new": value}
+
+
 # ------------------------------------------------------------- config groups
 @dataclass
 class ClientConfig:
