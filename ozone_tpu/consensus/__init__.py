@@ -1,0 +1,17 @@
+"""Quorum consensus (Raft) for the metadata planes.
+
+The reference replicates OM and SCM state through Apache Ratis (Raft over
+gRPC): `OzoneManagerRatisServer` / `OzoneManagerStateMachine` for OM HA and
+`SCMRatisServerImpl` / `SCMStateMachine` for SCM HA. This package is the
+TPU build's equivalent: a compact, correct Raft core (`raft.py`) with
+leader election, log replication with quorum commit, conflict repair, and
+snapshot-based follower bootstrap, plus pluggable transports (in-process
+for tests and the gRPC wire for real daemons).
+"""
+
+from ozone_tpu.consensus.raft import (  # noqa: F401
+    InProcessTransport,
+    NotRaftLeaderError,
+    RaftConfig,
+    RaftNode,
+)

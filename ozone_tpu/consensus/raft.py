@@ -1,0 +1,702 @@
+"""A compact, correct Raft core: elections, quorum commit, log repair.
+
+Fills the role Apache Ratis plays in the reference (OM HA via
+`OzoneManagerRatisServer.submitRequest`, ozone-manager om/ratis/
+OzoneManagerRatisServer.java:108; SCM HA via `SCMRatisServerImpl` +
+`SCMStateMachine`, server-scm ha/). The state machine contract matches the
+reference's: an opaque `apply(data) -> result` callback invoked exactly
+once per committed entry, in log order, on every replica
+(`OzoneManagerStateMachine.applyTransaction:335` analog).
+
+Scope notes (what is and is not here):
+- Leader election with randomized timeouts, term/vote durability, the
+  log-up-to-date vote check, and step-down on higher terms — Raft §5.1-5.2.
+- AppendEntries consistency check + conflict truncation + next_index
+  backtracking — §5.3.
+- Commit only entries of the current term by counting replicas — §5.4.2.
+- Snapshot install for follower bootstrap (the SCMSnapshotProvider /
+  OMDBCheckpointServlet analog): a new or lagging peer receives the
+  application snapshot + last included index/term instead of the whole log.
+- No membership-change joint consensus: the cluster set is fixed at
+  construction (the reference similarly bootstraps OM/SCM rings from
+  static config; decommissioned metadata nodes are replaced, not removed
+  online).
+
+Transports are pluggable: `InProcessTransport` wires nodes directly for
+tests and the MiniCluster (the reference tests consensus the same way —
+MiniOzoneHAClusterImpl runs many Ratis servers in one JVM); a gRPC
+transport (net/daemons) carries the same dicts over the wire for real
+daemons. All RPC handlers are thread-safe; timers are optional so tests
+can drive elections deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+log = logging.getLogger(__name__)
+
+FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+
+class NotRaftLeaderError(Exception):
+    """Raised on writes addressed to a non-leader; carries the leader hint
+    (the reference's OMNotLeaderException / SCMRatisResponse NotLeader)."""
+
+    def __init__(self, node_id: str, leader_hint: Optional[str] = None):
+        super().__init__(f"{node_id} is not the raft leader "
+                         f"(leader hint: {leader_hint})")
+        self.node_id = node_id
+        self.leader_hint = leader_hint
+
+
+@dataclass(frozen=True)
+class RaftConfig:
+    election_timeout_s: tuple[float, float] = (0.15, 0.3)
+    heartbeat_interval_s: float = 0.05
+    #: entries retained behind the snapshot when compacting
+    snapshot_trailing: int = 64
+
+
+class RaftStorage:
+    """Durable term/vote + log (JSONL, fsync'd) with truncation.
+
+    Equivalent of Ratis' RaftStorage/RaftLog segments; one directory per
+    node holding `meta.json` (currentTerm, votedFor, snapshot marker) and
+    `log.jsonl` (entries {term, data} from snapshot_index+1 up).
+    """
+
+    def __init__(self, root: Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self.meta_path = self.root / "meta.json"
+        self.log_path = self.root / "log.jsonl"
+        self.snap_path = self.root / "snapshot.json"
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        # log[i] corresponds to raft index snapshot_index + 1 + i
+        self.entries: list[dict] = []
+        self.snapshot_index = 0
+        self.snapshot_term = 0
+        self.snapshot_data: Any = None
+        self._load()
+
+    def _load(self) -> None:
+        if self.meta_path.exists():
+            m = json.loads(self.meta_path.read_text())
+            self.term = m.get("term", 0)
+            self.voted_for = m.get("voted_for")
+            self.snapshot_index = m.get("snapshot_index", 0)
+            self.snapshot_term = m.get("snapshot_term", 0)
+        if self.snap_path.exists():
+            self.snapshot_data = json.loads(self.snap_path.read_text())
+        if self.log_path.exists():
+            with open(self.log_path) as f:
+                self.entries = [json.loads(ln) for ln in f if ln.strip()]
+
+    @staticmethod
+    def _write_durable(path: Path, payload: str) -> None:
+        tmp = path.with_suffix(".tmp")
+        fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+        try:
+            os.write(fd, payload.encode())
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+        os.replace(tmp, path)
+
+    def persist_meta(self) -> None:
+        """Durably record term/vote (+ snapshot marker). fsync'd: a
+        forgotten vote after a crash would allow double-voting and two
+        leaders in one term (Raft §5.2 election safety). The snapshot
+        payload itself lives in its own file written only at snapshot
+        time — votes/term bumps must not rewrite the whole app state."""
+        self._write_durable(self.meta_path, json.dumps({
+            "term": self.term,
+            "voted_for": self.voted_for,
+            "snapshot_index": self.snapshot_index,
+            "snapshot_term": self.snapshot_term,
+        }))
+
+    def persist_snapshot(self) -> None:
+        self._write_durable(
+            self.snap_path, json.dumps(self.snapshot_data))
+
+    # ------------------------------------------------------------- log ops
+    @property
+    def last_index(self) -> int:
+        return self.snapshot_index + len(self.entries)
+
+    def term_at(self, index: int) -> Optional[int]:
+        if index == 0:
+            return 0
+        if index == self.snapshot_index:
+            return self.snapshot_term
+        i = index - self.snapshot_index - 1
+        if 0 <= i < len(self.entries):
+            return self.entries[i]["term"]
+        return None
+
+    def entry_at(self, index: int) -> Optional[dict]:
+        i = index - self.snapshot_index - 1
+        if 0 <= i < len(self.entries):
+            return self.entries[i]
+        return None
+
+    def append(self, entries: list[dict]) -> None:
+        with open(self.log_path, "a") as f:
+            for e in entries:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        self.entries.extend(entries)
+
+    def truncate_from(self, index: int) -> None:
+        """Drop entries at raft index >= index (conflict repair)."""
+        keep = max(0, index - self.snapshot_index - 1)
+        if keep >= len(self.entries):
+            return
+        self.entries = self.entries[:keep]
+        tmp = self.log_path.with_suffix(".tmp")
+        with open(tmp, "w") as f:
+            for e in self.entries:
+                f.write(json.dumps(e, separators=(",", ":")) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.log_path)
+
+    def install_snapshot(self, index: int, term: int, data: Any) -> None:
+        self.snapshot_index = index
+        self.snapshot_term = term
+        self.snapshot_data = data
+        self.entries = []
+        if self.log_path.exists():
+            self.log_path.unlink()
+        self.persist_snapshot()
+        self.persist_meta()
+
+    def compact(self, upto_index: int, term: int, data: Any,
+                trailing: int) -> None:
+        """Retain `trailing` entries behind the snapshot point."""
+        cut = max(0, upto_index - trailing)
+        if cut <= self.snapshot_index:
+            return
+        new_snap_term = term if cut == upto_index else (
+            self.term_at(cut) or term)
+        drop = cut - self.snapshot_index
+        self.entries = self.entries[drop:]
+        self.snapshot_index = cut
+        self.snapshot_term = new_snap_term
+        self.snapshot_data = data
+        self._write_durable(
+            self.log_path,
+            "".join(json.dumps(e, separators=(",", ":")) + "\n"
+                    for e in self.entries),
+        )
+        self.persist_snapshot()
+        self.persist_meta()
+
+
+class RaftNode:
+    """One consensus peer.
+
+    apply_fn(data) is invoked once per committed entry in order; its return
+    value resolves the originating propose() when this node is the leader.
+    snapshot_fn()/restore_fn(data) (optional) capture and install the full
+    application state for follower bootstrap and log compaction.
+    """
+
+    def __init__(
+        self,
+        node_id: str,
+        peer_ids: list[str],
+        storage_dir: Path,
+        apply_fn: Callable[[Any], Any],
+        snapshot_fn: Optional[Callable[[], Any]] = None,
+        restore_fn: Optional[Callable[[Any], None]] = None,
+        config: RaftConfig = RaftConfig(),
+        transport: Optional["Transport"] = None,
+        on_step_down: Optional[Callable[[], None]] = None,
+    ):
+        self.node_id = node_id
+        self.peer_ids = [p for p in peer_ids if p != node_id]
+        self.storage = RaftStorage(Path(storage_dir))
+        self.apply_fn = apply_fn
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+        self.config = config
+        self.transport = transport or InProcessTransport()
+        self.transport.register(self)
+
+        self.role = FOLLOWER
+        self.leader_hint: Optional[str] = None
+        self.commit_index = self.storage.snapshot_index
+        self.last_applied = self.storage.snapshot_index
+        self.next_index: dict[str, int] = {}
+        self.match_index: dict[str, int] = {}
+        # results are retained only for indexes with a registered waiter
+        # (a blocked propose()) — otherwise apply results would accumulate
+        # unboundedly over a long leadership
+        self._waiters: set[int] = set()
+        self._results: dict[int, Any] = {}
+        self.on_step_down = on_step_down
+
+        self._lock = threading.RLock()
+        self._commit_cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._last_heartbeat = time.monotonic()
+        self._timer_thread: Optional[threading.Thread] = None
+
+        # restore application state from the durable snapshot, then replay
+        # the committed suffix on the next leader contact / election
+        if self.storage.snapshot_data is not None and self.restore_fn:
+            self.restore_fn(self.storage.snapshot_data)
+
+    # ----------------------------------------------------------- lifecycle
+    def start_timers(self) -> None:
+        """Enable background election/heartbeat timers (daemon mode).
+
+        Tests usually drive `tick()`/`start_election()` directly instead,
+        the way the reference unit-tests Ratis state machines without
+        real clocks.
+        """
+        if self._timer_thread:
+            return
+        self._timer_thread = threading.Thread(
+            target=self._timer_loop, daemon=True,
+            name=f"raft-{self.node_id}")
+        self._election_deadline = self._new_deadline()
+        self._timer_thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._timer_thread:
+            self._timer_thread.join(timeout=1.0)
+            self._timer_thread = None
+
+    def _new_deadline(self) -> float:
+        lo, hi = self.config.election_timeout_s
+        return time.monotonic() + random.uniform(lo, hi)
+
+    def _timer_loop(self) -> None:
+        while not self._stop.is_set():
+            time.sleep(self.config.heartbeat_interval_s / 2)
+            with self._lock:
+                role = self.role
+            if role == LEADER:
+                self._broadcast_heartbeat()
+            elif time.monotonic() >= self._election_deadline:
+                self._election_deadline = self._new_deadline()
+                self.start_election()
+
+    # ----------------------------------------------------------- elections
+    def start_election(self) -> bool:
+        """Run one candidate round; returns True if this node won."""
+        with self._lock:
+            self.role = CANDIDATE
+            self.storage.term += 1
+            self.storage.voted_for = self.node_id
+            self.storage.persist_meta()
+            term = self.storage.term
+            last_index = self.storage.last_index
+            last_term = self.storage.term_at(last_index) or 0
+        votes = 1
+        for pid in self.peer_ids:
+            try:
+                resp = self.transport.send(pid, "request_vote", {
+                    "term": term,
+                    "candidate_id": self.node_id,
+                    "last_log_index": last_index,
+                    "last_log_term": last_term,
+                })
+            except Exception:
+                continue
+            with self._lock:
+                if resp["term"] > self.storage.term:
+                    self._step_down(resp["term"])
+                    return False
+            if resp.get("granted"):
+                votes += 1
+        quorum = (len(self.peer_ids) + 1) // 2 + 1
+        with self._lock:
+            if self.role != CANDIDATE or self.storage.term != term:
+                return False
+            if votes >= quorum:
+                self._become_leader()
+                return True
+            self.role = FOLLOWER
+            return False
+
+    def _become_leader(self) -> None:
+        self.role = LEADER
+        self.leader_hint = self.node_id
+        ni = self.storage.last_index + 1
+        self.next_index = {p: ni for p in self.peer_ids}
+        self.match_index = {p: 0 for p in self.peer_ids}
+        log.info("raft %s: leader of term %d at index %d",
+                 self.node_id, self.storage.term, self.storage.last_index)
+        # replicate a no-op so the new leader can commit prior-term entries
+        # (Raft §5.4.2 / Ratis leader-ready marker)
+        self._propose_locked({"_noop": True})
+
+    def _step_down(self, term: int) -> None:
+        was_leader = self.role == LEADER
+        if term > self.storage.term:
+            self.storage.term = term
+            self.storage.voted_for = None
+            self.storage.persist_meta()
+        self.role = FOLLOWER
+        if was_leader and self.on_step_down is not None:
+            # called with the node lock held: the callback must only set
+            # flags / enqueue work, never call back into this node
+            try:
+                self.on_step_down()
+            except Exception:
+                log.exception("on_step_down callback failed")
+
+    # ----------------------------------------------------------- serving
+    def propose(self, data: Any, timeout: float = 10.0) -> Any:
+        """Leader write path: append -> replicate to quorum -> apply.
+
+        The OzoneManagerRatisServer.submitRequest analog: blocks until the
+        entry commits and the local state machine applied it, returning
+        apply_fn's result, or raises NotRaftLeaderError.
+        """
+        with self._lock:
+            if self.role != LEADER:
+                raise NotRaftLeaderError(self.node_id, self.leader_hint)
+            index = self._propose_locked(data, register_waiter=True)
+        deadline = time.monotonic() + timeout
+        try:
+            with self._commit_cv:
+                while self.last_applied < index:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or self._stop.is_set():
+                        raise TimeoutError(
+                            f"entry {index} not committed within {timeout}s")
+                    if self.role != LEADER:
+                        raise NotRaftLeaderError(self.node_id,
+                                                 self.leader_hint)
+                    self._commit_cv.wait(timeout=min(left, 0.05))
+                    # single-threaded test mode: no timer thread to push
+                    # replication, so drive it from here
+                    if self.last_applied < index and self._timer_thread is None:
+                        self._commit_cv.release()
+                        try:
+                            self._broadcast_heartbeat()
+                        finally:
+                            self._commit_cv.acquire()
+                result = self._results.pop(index, None)
+            return result
+        finally:
+            with self._lock:
+                self._waiters.discard(index)
+                self._results.pop(index, None)
+
+    def _propose_locked(self, data: Any, register_waiter: bool = False) -> int:
+        entry = {"term": self.storage.term, "data": data}
+        self.storage.append([entry])
+        index = self.storage.last_index
+        if register_waiter:
+            self._waiters.add(index)
+        self.match_index[self.node_id] = index
+        # fast path: push to peers immediately (heartbeat retries failures)
+        self._lock.release()
+        try:
+            self._broadcast_heartbeat()
+        finally:
+            self._lock.acquire()
+        return index
+
+    # ----------------------------------------------------------- replication
+    def _broadcast_heartbeat(self) -> None:
+        for pid in list(self.peer_ids):
+            try:
+                self._replicate_to(pid)
+            except Exception as e:  # peer down: retried next heartbeat
+                log.debug("raft %s -> %s replication failed: %s",
+                          self.node_id, pid, e)
+        self._advance_commit()
+
+    def _replicate_to(self, pid: str) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            term = self.storage.term
+            ni = self.next_index.get(pid, self.storage.last_index + 1)
+            if ni <= self.storage.snapshot_index:
+                # peer is behind the compaction horizon: ship the snapshot
+                snap = {
+                    "term": term,
+                    "leader_id": self.node_id,
+                    "last_included_index": self.storage.snapshot_index,
+                    "last_included_term": self.storage.snapshot_term,
+                    "data": self.storage.snapshot_data,
+                }
+                resp = None
+                self._lock.release()
+                try:
+                    resp = self.transport.send(pid, "install_snapshot", snap)
+                finally:
+                    self._lock.acquire()
+                if resp and resp["term"] > self.storage.term:
+                    self._step_down(resp["term"])
+                    return
+                self.next_index[pid] = self.storage.snapshot_index + 1
+                self.match_index[pid] = self.storage.snapshot_index
+                ni = self.next_index[pid]
+            prev = ni - 1
+            prev_term = self.storage.term_at(prev)
+            if prev_term is None:
+                prev_term = 0
+            entries = [
+                self.storage.entry_at(i)
+                for i in range(ni, self.storage.last_index + 1)
+            ]
+            req = {
+                "term": term,
+                "leader_id": self.node_id,
+                "prev_log_index": prev,
+                "prev_log_term": prev_term,
+                "entries": entries,
+                "leader_commit": self.commit_index,
+            }
+        resp = self.transport.send(pid, "append_entries", req)
+        with self._lock:
+            if resp["term"] > self.storage.term:
+                self._step_down(resp["term"])
+                return
+            if self.role != LEADER or self.storage.term != term:
+                return
+            if resp.get("success"):
+                self.match_index[pid] = prev + len(entries)
+                self.next_index[pid] = self.match_index[pid] + 1
+            else:
+                # conflict: back up (use the follower's hint when present)
+                hint = resp.get("conflict_index")
+                self.next_index[pid] = max(
+                    1, hint if hint else self.next_index[pid] - 1)
+
+    def _advance_commit(self) -> None:
+        with self._lock:
+            if self.role != LEADER:
+                return
+            quorum = (len(self.peer_ids) + 1) // 2 + 1
+            for n in range(self.storage.last_index, self.commit_index, -1):
+                if self.storage.term_at(n) != self.storage.term:
+                    break  # only commit current-term entries by counting
+                votes = 1 + sum(
+                    1 for p in self.peer_ids
+                    if self.match_index.get(p, 0) >= n)
+                if votes >= quorum:
+                    self.commit_index = n
+                    break
+            self._apply_committed()
+
+    def _apply_committed(self) -> None:
+        while self.last_applied < self.commit_index:
+            idx = self.last_applied + 1
+            entry = self.storage.entry_at(idx)
+            if entry is None:  # inside snapshot: state already restored
+                self.last_applied = idx
+                continue
+            data = entry["data"]
+            result = None
+            if not (isinstance(data, dict) and data.get("_noop")):
+                try:
+                    result = self.apply_fn(data)
+                except Exception as e:  # deterministic app error
+                    result = e
+            self.last_applied = idx
+            if idx in self._waiters:
+                self._results[idx] = result
+        self._commit_cv.notify_all()
+
+    # ----------------------------------------------------------- RPC handlers
+    def handle_request_vote(self, req: dict) -> dict:
+        with self._lock:
+            if req["term"] > self.storage.term:
+                self._step_down(req["term"])
+            granted = False
+            if req["term"] == self.storage.term and self.storage.voted_for \
+                    in (None, req["candidate_id"]):
+                last_index = self.storage.last_index
+                last_term = self.storage.term_at(last_index) or 0
+                up_to_date = (req["last_log_term"], req["last_log_index"]) \
+                    >= (last_term, last_index)
+                if up_to_date:
+                    granted = True
+                    self.storage.voted_for = req["candidate_id"]
+                    self.storage.persist_meta()
+                    self._last_heartbeat = time.monotonic()
+                    if self._timer_thread:
+                        self._election_deadline = self._new_deadline()
+            return {"term": self.storage.term, "granted": granted}
+
+    def handle_append_entries(self, req: dict) -> dict:
+        with self._lock:
+            if req["term"] > self.storage.term:
+                self._step_down(req["term"])
+            if req["term"] < self.storage.term:
+                return {"term": self.storage.term, "success": False}
+            self.role = FOLLOWER
+            self.leader_hint = req["leader_id"]
+            self._last_heartbeat = time.monotonic()
+            if self._timer_thread:
+                self._election_deadline = self._new_deadline()
+
+            prev, prev_term = req["prev_log_index"], req["prev_log_term"]
+            have = self.storage.term_at(prev)
+            if have is None or have != prev_term:
+                # conflict hint: first index of our conflicting term, or
+                # one past our log end
+                ci = min(prev, self.storage.last_index + 1)
+                while ci > self.storage.snapshot_index + 1 and \
+                        self.storage.term_at(ci - 1) == have and have is not None:
+                    ci -= 1
+                return {"term": self.storage.term, "success": False,
+                        "conflict_index": max(1, ci)}
+
+            idx = prev
+            new = []
+            for e in req["entries"]:
+                idx += 1
+                mine = self.storage.term_at(idx)
+                if mine is None:
+                    new.append(e)
+                elif mine != e["term"]:
+                    self.storage.truncate_from(idx)
+                    new.append(e)
+                elif new:
+                    new.append(e)  # already truncated past here
+            if new:
+                self.storage.append(new)
+            if req["leader_commit"] > self.commit_index:
+                self.commit_index = min(req["leader_commit"],
+                                        self.storage.last_index)
+                self._apply_committed()
+            return {"term": self.storage.term, "success": True}
+
+    def handle_install_snapshot(self, req: dict) -> dict:
+        with self._lock:
+            if req["term"] > self.storage.term:
+                self._step_down(req["term"])
+            if req["term"] < self.storage.term:
+                return {"term": self.storage.term}
+            self.role = FOLLOWER
+            self.leader_hint = req["leader_id"]
+            self._last_heartbeat = time.monotonic()
+            idx = req["last_included_index"]
+            if idx > self.storage.snapshot_index:
+                self.storage.install_snapshot(
+                    idx, req["last_included_term"], req["data"])
+                if self.restore_fn and req["data"] is not None:
+                    self.restore_fn(req["data"])
+                self.commit_index = max(self.commit_index, idx)
+                self.last_applied = max(self.last_applied, idx)
+            return {"term": self.storage.term}
+
+    def handle_fetch_state(self, req: dict) -> dict:
+        """Serve the current application state to a resyncing peer (the
+        deposed-leader reconciliation path; role analog of the reference's
+        follower bootstrap from a leader checkpoint). Leader-only so the
+        state handed out is the committed line."""
+        with self._lock:
+            if self.role != LEADER or self.snapshot_fn is None:
+                return {"ok": False, "term": self.storage.term}
+            return {
+                "ok": True,
+                "term": self.storage.term,
+                "applied": self.last_applied,
+                "data": self.snapshot_fn(),
+            }
+
+    def fetch_state_from(self, peer_id: str) -> bool:
+        """Pull the leader's full state and install it locally, discarding
+        any divergent local application state (used after losing
+        leadership with unreplicated local effects)."""
+        resp = self.transport.send(peer_id, "fetch_state",
+                                   {"requester": self.node_id})
+        if not resp.get("ok"):
+            return False
+        with self._lock:
+            if self.restore_fn is not None:
+                self.restore_fn(resp["data"])
+            self.last_applied = max(self.last_applied, resp["applied"])
+            self.commit_index = max(self.commit_index, resp["applied"])
+        return True
+
+    # ----------------------------------------------------------- maintenance
+    def tick(self) -> None:
+        """One deterministic heartbeat round (test mode)."""
+        if self.role == LEADER:
+            self._broadcast_heartbeat()
+
+    def take_snapshot(self) -> None:
+        """Compact the log behind a fresh application snapshot
+        (ContainerStateMachine.takeSnapshot / Ratis snapshot analog)."""
+        if self.snapshot_fn is None:
+            return
+        with self._lock:
+            upto = self.last_applied
+            term = self.storage.term_at(upto) or self.storage.term
+            data = self.snapshot_fn()
+            self.storage.compact(upto, term, data,
+                                 self.config.snapshot_trailing)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.role == LEADER
+
+
+class Transport:
+    """Abstract peer messaging: send(method in {request_vote,
+    append_entries, install_snapshot})."""
+
+    def register(self, node: RaftNode) -> None:
+        raise NotImplementedError
+
+    def send(self, peer_id: str, method: str, req: dict) -> dict:
+        raise NotImplementedError
+
+
+class InProcessTransport(Transport):
+    """Direct in-process dispatch; one instance shared by a test cluster.
+
+    A `partition` set of (a, b) pairs simulates network partitions for
+    chaos tests (the blockade-test analog)."""
+
+    def __init__(self):
+        self.nodes: dict[str, RaftNode] = {}
+        self.partitions: set[frozenset] = set()
+        self.down: set[str] = set()
+
+    def register(self, node: RaftNode) -> None:
+        self.nodes[node.node_id] = node
+
+    def partition(self, a: str, b: str) -> None:
+        self.partitions.add(frozenset((a, b)))
+
+    def heal(self) -> None:
+        self.partitions.clear()
+        self.down.clear()
+
+    def send(self, peer_id: str, method: str, req: dict) -> dict:
+        src = req.get("candidate_id") or req.get("leader_id") \
+            or req.get("requester")
+        if peer_id in self.down or src in self.down or (
+                src and frozenset((src, peer_id)) in self.partitions):
+            raise ConnectionError(f"{src} -/-> {peer_id}")
+        node = self.nodes.get(peer_id)
+        if node is None:
+            raise ConnectionError(f"unknown peer {peer_id}")
+        handler = getattr(node, f"handle_{method}")
+        return handler(req)

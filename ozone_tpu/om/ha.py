@@ -6,15 +6,19 @@ through Raft; OzoneManagerStateMachine.applyTransaction:335 applies them
 deterministically on every replica against the metadata store; clients
 fail over between OMs via the OMFailoverProxyProvider).
 
-This implementation keeps the exact same request lifecycle — preExecute on
-the leader, serialized request through a durable ordered log, apply
-everywhere — with a single-leader synchronous-replication log instead of
-Raft elections (the reference's pluggable-consensus shape; SURVEY.md
-section 7 explicitly stages consensus this way). Followers are therefore
-warm, byte-identical replicas ready for promotion; failover is an explicit
-promote() (operator or orchestrator driven) rather than an election.
+Two consensus modes share the same request lifecycle — preExecute on the
+leader, serialized request through a durable ordered log, deterministic
+apply everywhere (the reference's pluggable-consensus shape; SURVEY.md
+section 7 explicitly stages consensus behind the request/apply split):
 
-The log is a durable JSONL WAL per replica with fsync-on-append and
+- `RaftOzoneManager`: full quorum consensus (consensus/raft.py) — leader
+  elections with terms, quorum-committed log, conflict repair, snapshot
+  bootstrap. This is the complete Ratis-equivalent mode.
+- `ReplicatedOzoneManager`: single-leader synchronous replication with
+  operator-driven promote() failover — the degenerate consensus useful
+  for two-replica or orchestrator-managed deployments.
+
+Both keep a durable JSONL WAL per replica with fsync-on-append and
 replay-on-restart from the last flushed transaction (the
 OzoneManagerDoubleBuffer + TransactionInfo recovery pattern).
 """
@@ -170,16 +174,79 @@ class NotLeaderError(Exception):
     pass
 
 
+class RaftOzoneManager:
+    """OM replica on quorum consensus — the full OzoneManagerRatisServer
+    analog (ozone-manager om/ratis/OzoneManagerRatisServer.java:108):
+    leader elections with terms and randomized timeouts, replicated log
+    with quorum commit, deterministic applyTransaction on every replica,
+    and snapshot-based follower bootstrap (consensus/raft.py).
+
+    Request lifecycle matches the reference exactly: `submit` runs
+    preExecute on the leader (block allocation, normalization), proposes
+    the serialized request through Raft, and returns the local apply
+    result once the entry commits. Deterministic OMErrors replicate like
+    any result so every replica's table state stays byte-identical.
+    """
+
+    def __init__(
+        self,
+        om: OzoneManager,
+        raft_dir: Path,
+        om_id: str,
+        peer_ids: list[str],
+        transport=None,
+        config=None,
+    ):
+        from ozone_tpu.consensus.raft import RaftConfig, RaftNode
+
+        self.om = om
+        self.om_id = om_id
+        self.node = RaftNode(
+            om_id,
+            peer_ids,
+            Path(raft_dir),
+            apply_fn=self._apply,
+            snapshot_fn=om.store.export_state,
+            restore_fn=om.store.import_state,
+            config=config or RaftConfig(),
+            transport=transport,
+        )
+
+    def _apply(self, data: dict) -> Any:
+        return rq.OMRequest.from_json(data).apply(self.om.store)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node.is_leader
+
+    def submit(self, request: rq.OMRequest) -> Any:
+        if not self.node.is_leader:
+            raise NotLeaderError(self.om_id)
+        request.pre_execute(self.om)
+        result = self.node.propose(request.to_json())
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def start(self) -> None:
+        self.node.start_timers()
+
+    def stop(self) -> None:
+        self.node.stop()
+
+
 class OMFailoverProxy:
     """Client-side failover across OM replicas (OMFailoverProxyProvider
     analog): tries the known leader first, rotates on NotLeaderError or
     connection failure."""
 
-    def __init__(self, replicas: list[ReplicatedOzoneManager]):
+    def __init__(self, replicas: list):
         self.replicas = replicas
         self._leader_idx = 0
 
     def submit(self, request: rq.OMRequest) -> Any:
+        from ozone_tpu.consensus.raft import NotRaftLeaderError
+
         last: Optional[Exception] = None
         n = len(self.replicas)
         for attempt in range(n):
@@ -188,6 +255,7 @@ class OMFailoverProxy:
                 result = self.replicas[idx].submit(request)
                 self._leader_idx = idx
                 return result
-            except (NotLeaderError, ConnectionError, OSError) as e:
+            except (NotLeaderError, NotRaftLeaderError, ConnectionError,
+                    OSError) as e:
                 last = e
         raise RuntimeError(f"no OM leader reachable: {last}")

@@ -159,6 +159,36 @@ class OMMetadataStore:
         for t, k in flushed:
             self._cache[t].pop(k, None)
 
+    # --------------------------------------------------------------- snapshot
+    def export_state(self) -> dict:
+        """Full-table dump for HA snapshot shipping (the OM follower
+        bootstrap checkpoint — OMDBCheckpointServlet analog)."""
+        with self._lock:
+            self._flush_locked()
+            return {
+                "txid": self._txid,
+                "tables": {
+                    t: {k: v for k, v in self.iterate(t)} for t in _TABLES
+                },
+            }
+
+    def import_state(self, state: dict) -> None:
+        """Replace all tables with a shipped checkpoint."""
+        with self._lock:
+            self._dirty.clear()
+            self._updates.clear()
+            cur = self._conn.cursor()
+            for t in _TABLES:
+                self._cache[t].clear()
+                cur.execute(f"DELETE FROM {t}")
+                for k, v in state["tables"].get(t, {}).items():
+                    cur.execute(
+                        f"INSERT OR REPLACE INTO {t} VALUES (?, ?)",
+                        (k, json.dumps(v)),
+                    )
+            self._conn.commit()
+            self._txid = max(self._txid, int(state.get("txid", 0)))
+
     @property
     def txid(self) -> int:
         return self._txid

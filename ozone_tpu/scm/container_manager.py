@@ -162,6 +162,19 @@ class ContainerManager:
             }
 
     def install_snapshot(self, snap: dict) -> None:
+        """Replace-all install of a shipped checkpoint: containers absent
+        from the snapshot are dropped (a deposed leader resyncing may hold
+        phantom rows the quorum never accepted), then every row is
+        upserted. Replica soft state for surviving containers is kept —
+        it is rebuilt from heartbeats either way."""
+        with self._lock:
+            keep = {int(r["id"]) for r in snap["containers"]}
+            for cid in [c for c in self._containers if c not in keep]:
+                c = self._containers.pop(cid)
+                if c.pipeline is not None:
+                    self._pipelines.pop(c.pipeline.id, None)
+            for pool in self._writable.values():
+                pool[:] = [cid for cid in pool if cid in keep]
         for row in snap["containers"]:
             self.apply_mutation(row, tuple(snap["counters"]))
         with self._lock:

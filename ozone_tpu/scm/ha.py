@@ -26,6 +26,8 @@ Design notes, TPU-build shape:
 from __future__ import annotations
 
 import logging
+import threading
+import time
 from pathlib import Path
 from typing import Any, Optional
 
@@ -190,6 +192,217 @@ class ReplicatedSCM:
         )
 
 
+class RaftSCM:
+    """SCM replica on quorum consensus — the full SCMRatisServerImpl +
+    SCMStateMachine analog (server-scm ha/): elections, quorum-committed
+    mutation log, snapshot bootstrap for lagging followers.
+
+    Replication unit matches the reference's design (and ReplicatedSCM
+    above): the leader replicates *decision records* — durable container
+    mutations + HA-safe id counters — not the computations that produced
+    them, so apply is deterministic despite randomized placement. Soft
+    state (node liveness, replica maps) is rebuilt from heartbeats on
+    every SCM, exactly like the reference.
+
+    Concurrency contract (lock order is raft-node -> container-manager,
+    never the reverse):
+    - The ContainerManager mutation hook runs under the container lock;
+      it only *enqueues* the decision record. A single dispatcher thread
+      proposes records through raft in mutation order, so client threads
+      never touch raft state while holding the container lock.
+    - Records the leader enqueued are already applied to its own state
+      (the mutation produced them), so the local commit apply skips them
+      by record id; followers (and log replay after a restart, when the
+      in-flight set is empty) apply every record.
+    - submit() acks the client only after the records its call produced
+      are quorum-committed — the same client-visible durability as the
+      reference, where the Ratis write precedes the response.
+    - If leadership is lost with enqueued-but-uncommitted records, this
+      replica's state has effects the quorum never accepted; it resyncs
+      by fetching the new leader's full committed state (fetch_state)
+      before serving again.
+    """
+
+    def __init__(
+        self,
+        scm: StorageContainerManager,
+        raft_dir: Path,
+        scm_id: str,
+        peer_ids: list[str],
+        transport=None,
+        config=None,
+        ack_timeout_s: float = 30.0,
+    ):
+        import queue as _queue
+
+        from ozone_tpu.consensus.raft import RaftConfig, RaftNode
+
+        self.scm = scm
+        self.scm_id = scm_id
+        self.ack_timeout_s = ack_timeout_s
+        self._queue: "_queue.Queue" = _queue.Queue()
+        self._inflight: set[str] = set()
+        self._seq = 0
+        self._committed_seq = 0
+        self._ack_cv = threading.Condition()
+        self._needs_resync = False
+        self._stop = threading.Event()
+        self.node = RaftNode(
+            scm_id,
+            peer_ids,
+            Path(raft_dir),
+            apply_fn=self._apply,
+            snapshot_fn=scm.containers.snapshot_state,
+            restore_fn=self._restore,
+            config=config or RaftConfig(),
+            transport=transport,
+            on_step_down=self._on_step_down,
+        )
+        scm.containers.mutation_listener = self._on_mutation
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name=f"scm-ha-dispatch-{scm_id}")
+        self._dispatcher.start()
+
+    # ------------------------------------------------------------- leader
+    def _on_mutation(self, row: dict, counters: tuple[int, int]) -> None:
+        """ContainerManager hook (runs under the container lock): enqueue
+        the decision record. Enqueue order == mutation order because the
+        hook fires inside the mutating critical section."""
+        if not self.node.is_leader:
+            return
+        with self._ack_cv:
+            self._seq += 1
+            rec_id = f"{self.scm_id}:{self._seq}"
+            self._inflight.add(rec_id)
+        self._queue.put(
+            {"id": rec_id, "seq": self._seq, "row": row,
+             "counters": list(counters)}
+        )
+
+    def _dispatch_loop(self) -> None:
+        import queue as _queue
+
+        from ozone_tpu.consensus.raft import NotRaftLeaderError
+
+        while not self._stop.is_set():
+            try:
+                rec = self._queue.get(timeout=0.1)
+            except _queue.Empty:
+                self._maybe_resync()
+                continue
+            while not self._stop.is_set():
+                try:
+                    self.node.propose(
+                        {k: rec[k] for k in ("id", "row", "counters")},
+                        timeout=5.0,
+                    )
+                    with self._ack_cv:
+                        self._committed_seq = rec["seq"]
+                        self._ack_cv.notify_all()
+                    break
+                except NotRaftLeaderError:
+                    # effects of this record exist locally but were never
+                    # accepted by the quorum: flag for state resync and
+                    # fail any waiting submits
+                    with self._ack_cv:
+                        self._needs_resync = True
+                        self._committed_seq = rec["seq"]
+                        self._ack_cv.notify_all()
+                    break
+                except TimeoutError:
+                    continue  # keep retrying while still leader
+
+    def _maybe_resync(self) -> None:
+        import queue as _queue
+
+        if not self._needs_resync or self.node.is_leader:
+            return
+        hint = self.node.leader_hint
+        if not hint or hint == self.scm_id:
+            return
+        # drop queued records that will never replicate (their effects are
+        # about to be overwritten by the leader's committed state)
+        try:
+            while True:
+                rec = self._queue.get_nowait()
+                with self._ack_cv:
+                    self._committed_seq = max(self._committed_seq,
+                                              rec["seq"])
+                    self._ack_cv.notify_all()
+        except _queue.Empty:
+            pass
+        try:
+            if self.node.fetch_state_from(hint):
+                with self._ack_cv:
+                    self._needs_resync = False
+                    self._inflight.clear()
+                log.info("scm %s resynced from leader %s", self.scm_id, hint)
+        except Exception as e:
+            log.debug("scm %s resync attempt failed: %s", self.scm_id, e)
+
+    def _on_step_down(self) -> None:
+        """Raft callback (node lock held — flags only): unreplicated local
+        effects mean divergence; resync from the new leader."""
+        with self._ack_cv:
+            if self._inflight or not self._queue.empty():
+                self._needs_resync = True
+            self._ack_cv.notify_all()
+
+    # ------------------------------------------------------------- apply
+    def _apply(self, data: dict) -> None:
+        rec_id = data.get("id")
+        if rec_id is not None:
+            with self._ack_cv:
+                if rec_id in self._inflight:
+                    # our own record: the mutation that produced it
+                    # already updated local state
+                    self._inflight.discard(rec_id)
+                    return
+        self.scm.containers.apply_mutation(
+            data["row"], tuple(data["counters"])
+        )
+
+    def _restore(self, snap: dict) -> None:
+        self.scm.containers.install_snapshot(snap)
+
+    @property
+    def is_leader(self) -> bool:
+        return self.node.is_leader
+
+    # ------------------------------------------------------------- serving
+    def submit(self, method: str, *args: Any, **kw: Any) -> Any:
+        """Leader-gated mutating call; returns after every decision record
+        the call produced is quorum-committed."""
+        from ozone_tpu.consensus.raft import NotRaftLeaderError
+
+        if not self.node.is_leader:
+            raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        result = getattr(self.scm, method)(*args, **kw)
+        deadline = time.monotonic() + self.ack_timeout_s
+        with self._ack_cv:
+            target = self._seq
+            while self._committed_seq < target:
+                if self._needs_resync or not self.node.is_leader:
+                    raise NotRaftLeaderError(self.scm_id,
+                                             self.node.leader_hint)
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        "scm mutation not committed within "
+                        f"{self.ack_timeout_s}s")
+                self._ack_cv.wait(timeout=min(left, 0.05))
+        return result
+
+    def start(self) -> None:
+        self.node.start_timers()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.node.stop()
+        self._dispatcher.join(timeout=1.0)
+
+
 class SCMFailoverProxy:
     """Client/OM-side failover across SCM replicas (the reference's
     SCMBlockLocationFailoverProxyProvider): tries the known leader,
@@ -200,6 +413,8 @@ class SCMFailoverProxy:
         self._leader_idx = 0
 
     def submit(self, method: str, *args: Any, **kw: Any) -> Any:
+        from ozone_tpu.consensus.raft import NotRaftLeaderError
+
         last: Optional[Exception] = None
         n = len(self.replicas)
         for attempt in range(n):
@@ -208,6 +423,7 @@ class SCMFailoverProxy:
                 result = self.replicas[idx].submit(method, *args, **kw)
                 self._leader_idx = idx
                 return result
-            except (NotLeaderError, ConnectionError, OSError) as e:
+            except (NotLeaderError, NotRaftLeaderError, TimeoutError,
+                    ConnectionError, OSError) as e:
                 last = e
         raise RuntimeError(f"no SCM leader reachable: {last}")
