@@ -28,8 +28,12 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def bench_fused_encode(batch: int = 12, cell: int = 1024 * 1024,
-                       iters: int = 40, rounds: int = 5) -> float:
+def bench_fused_encode(batch: int = 96, cell: int = 1024 * 1024,
+                       iters: int = 8, rounds: int = 5) -> float:
+    """Batch 96 (576 MiB of data per dispatch) measured best on v5e:
+    throughput rises monotonically with stripes/dispatch (7.6 GiB/s at 12
+    -> ~14 GiB/s at 96) as fixed dispatch + layout-move costs amortize;
+    8 iters keeps ~2.3 GiB of queued outputs, well inside HBM."""
     import jax
 
     from ozone_tpu.codec.api import CoderOptions
@@ -61,8 +65,8 @@ def bench_fused_encode(batch: int = 12, cell: int = 1024 * 1024,
     return gib / best
 
 
-def bench_fused_decode(batch: int = 12, cell: int = 1024 * 1024,
-                       iters: int = 20) -> float:
+def bench_fused_decode(batch: int = 48, cell: int = 1024 * 1024,
+                       iters: int = 8) -> float:
     import jax
 
     from ozone_tpu.codec.api import CoderOptions

@@ -15,7 +15,13 @@ import numpy as np
 from ozone_tpu.net import wire
 from ozone_tpu.net.rpc import RpcChannel, RpcServer
 from ozone_tpu.storage.datanode import Datanode
-from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo, ContainerState
+from ozone_tpu.storage.ids import (
+    BlockData,
+    BlockID,
+    ChunkInfo,
+    ContainerState,
+    StorageError,
+)
 
 SERVICE = "ozone.tpu.DatanodeService"
 
@@ -38,7 +44,61 @@ class DatanodeGrpcService:
                 "DeleteBlock": self._delete_block,
                 "Echo": lambda req: req,
             },
+            stream_methods={"StreamWriteBlock": self._stream_write_block},
         )
+
+    def _stream_write_block(self, frames) -> bytes:
+        """Streaming block write (the Ratis DataStream / StreamInit path:
+        KeyValueHandler.java:273, client BlockDataStreamOutput): frame 0 is
+        the wire-packed header {block_id, chunk_size, sync, checksum_type,
+        bytes_per_checksum}; every following frame is a raw payload slab.
+        Chunks are cut server-side at chunk_size, written as they arrive
+        (no per-chunk round trip), and one PutBlock commits the lot —
+        the response is the committed BlockData."""
+        from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+        it = iter(frames)
+        header, _ = wire.unpack(next(it))
+        block_id = BlockID.from_json(header["block_id"])
+        chunk_size = int(header.get("chunk_size", 4 * 1024 * 1024))
+        if chunk_size <= 0:
+            raise StorageError("INVALID_ARGUMENT",
+                               f"chunk_size must be positive: {chunk_size}")
+        sync = bool(header.get("sync", False))
+        cksum = Checksum(
+            ChecksumType(header.get("checksum_type", "CRC32C")),
+            int(header.get("bytes_per_checksum", 16 * 1024)),
+        )
+
+        chunks: list[ChunkInfo] = []
+        offset = 0
+        buf = bytearray()
+
+        def flush(final: bool) -> None:
+            nonlocal offset
+            while len(buf) >= chunk_size or (final and buf):
+                part = bytes(buf[:chunk_size])
+                del buf[:chunk_size]
+                info = ChunkInfo(
+                    name=f"{block_id}_chunk_{len(chunks)}",
+                    offset=offset,
+                    length=len(part),
+                    checksum=cksum.compute(
+                        np.frombuffer(part, dtype=np.uint8)),
+                )
+                self.dn.write_chunk(
+                    block_id, info,
+                    np.frombuffer(part, dtype=np.uint8), sync=sync)
+                chunks.append(info)
+                offset += len(part)
+
+        for frame in it:
+            buf.extend(frame)
+            flush(final=False)
+        flush(final=True)
+        bd = BlockData(block_id, chunks)
+        self.dn.put_block(bd, sync=sync)
+        return wire.pack({"block": bd.to_json()})
 
     def _create_container(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
@@ -181,6 +241,28 @@ class GrpcDatanodeClient:
 
     def delete_block(self, block_id):
         self._call("DeleteBlock", {"block_id": block_id.to_json()})
+
+    def stream_write_block(self, block_id, data_frames, chunk_size=4 * 1024 * 1024,
+                           sync=False, checksum_type="CRC32C",
+                           bytes_per_checksum=16 * 1024):
+        """Streaming write of a whole block: `data_frames` yields bytes
+        slabs of any size; returns the committed BlockData. The
+        BlockDataStreamOutput analog — one ack for the entire block."""
+
+        def frames():
+            yield wire.pack({
+                "block_id": block_id.to_json(),
+                "chunk_size": chunk_size,
+                "sync": sync,
+                "checksum_type": checksum_type,
+                "bytes_per_checksum": bytes_per_checksum,
+            })
+            for f in data_frames:
+                yield bytes(f)
+
+        resp = self._ch.call_streaming(SERVICE, "StreamWriteBlock", frames())
+        m, _ = wire.unpack(resp)
+        return BlockData.from_json(m["block"])
 
     def echo(self, data: bytes = b"ping") -> bytes:
         return self._ch.call(SERVICE, "Echo", data)

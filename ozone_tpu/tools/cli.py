@@ -188,14 +188,15 @@ def cmd_freon(args) -> int:
     elif args.generator == "dbgen":
         _emit(freon.dbgen(args.root or "/tmp/ozone-dbgen.db",
                           n_keys=args.num).summary())
-    elif args.generator in ("dcg", "dcv"):
+    elif args.generator in ("dcg", "dcv", "dsg"):
         oz = _client(args)
         dn_ids = list(oz.clients.known_ids())
         if not dn_ids:
             print(f"error: no datanodes known (is the SCM at {args.om} "
                   "reachable?)", file=sys.stderr)
             return 1
-        gen = freon.dcg if args.generator == "dcg" else freon.dcv
+        gen = {"dcg": freon.dcg, "dcv": freon.dcv, "dsg": freon.dsg}[
+            args.generator]
         _emit(gen(oz.clients, dn_ids, args.num, size=args.size,
                   threads=args.threads).summary())
     return 0
@@ -452,7 +453,8 @@ def build_parser() -> argparse.ArgumentParser:
     fr = sub.add_parser("freon", help="load generators")
     fr.add_argument("generator",
                     choices=["ockg", "ockr", "rawcoder", "omkg", "ommg",
-                             "scmtb", "cmdw", "dbgen", "dcg", "dcv"])
+                             "scmtb", "cmdw", "dbgen", "dcg", "dcv",
+                             "dsg"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("-t", "--threads", type=int, default=4)

@@ -148,6 +148,18 @@ def ockr(client, n_keys: int, threads: int = 4, volume: str = "freon-vol",
     return BaseFreonGenerator("ockr", n_keys, threads).run(op)
 
 
+def _ensure_container(clients, dn_ids: list[str], container_id: int) -> None:
+    """Idempotently create the bench container on every target datanode."""
+    from ozone_tpu.storage.ids import StorageError
+
+    for dn in dn_ids:
+        try:
+            clients.get(dn).create_container(container_id)
+        except StorageError as e:
+            if e.code != "CONTAINER_EXISTS":
+                raise
+
+
 def dcg(
     clients,
     dn_ids: list[str],
@@ -164,12 +176,7 @@ def dcg(
     rng = np.random.default_rng(1)
     payload = rng.integers(0, 256, size, dtype=np.uint8)
     cs = Checksum(ChecksumType.CRC32C, 16 * 1024).compute(payload)
-    for dn in dn_ids:
-        try:
-            clients.get(dn).create_container(container_id)
-        except StorageError as e:
-            if e.code != "CONTAINER_EXISTS":
-                raise
+    _ensure_container(clients, dn_ids, container_id)
 
     def op(i: int) -> int:
         dn = dn_ids[i % len(dn_ids)]
@@ -179,6 +186,36 @@ def dcg(
         return size
 
     return BaseFreonGenerator("dcg", n_chunks, threads).run(op)
+
+
+def dsg(
+    clients,
+    dn_ids: list[str],
+    n_blocks: int = 20,
+    size: int = 8 * 1024 * 1024,
+    frame_size: int = 1024 * 1024,
+    chunk_size: int = 4 * 1024 * 1024,
+    threads: int = 4,
+    container_id: int = 20_000_000,
+) -> FreonReport:
+    """Datanode streaming-write generator (StreamingGenerator analog):
+    whole blocks over the client-streaming RPC, one commit ack each."""
+    from ozone_tpu.storage.ids import BlockID, StorageError
+
+    rng = np.random.default_rng(2)
+    payload = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+    _ensure_container(clients, dn_ids, container_id)
+
+    def op(i: int) -> int:
+        dn = dn_ids[i % len(dn_ids)]
+        frames = (payload[o:o + frame_size]
+                  for o in range(0, len(payload), frame_size))
+        bd = clients.get(dn).stream_write_block(
+            BlockID(container_id, i + 1), frames, chunk_size=chunk_size)
+        assert bd.length == size
+        return size
+
+    return BaseFreonGenerator("dsg", n_blocks, threads).run(op)
 
 
 def omkg(client, n_keys: int = 1000, threads: int = 8,

@@ -1,0 +1,239 @@
+"""x509 certificate authority + per-role cert clients + gRPC TLS material.
+
+Role analog of the reference's security infrastructure
+(hadoop-hdds/framework hdds/security/x509/: SCM hosts a root CA;
+every service role runs a certificate client that generates a keypair,
+submits a CSR to the SCM CA, and stores the signed chain; gRPC datapath
+and replication servers then run TLS with mutual authentication).
+
+Here the CA is a library the SCM daemon owns: `CertificateAuthority`
+self-signs a root, `CertificateClient.enroll()` produces a CSR and stores
+the signed cert + chain under the role's metadata dir, and
+`TlsMaterial.server()/client()` yields the grpc credential objects the
+net/rpc layer plugs in. Kerberos/UGI has no equivalent here by design —
+caller identity rides on block/container tokens (utils/security.py) and
+mTLS peer names, the way the reference's token-only deployments work.
+"""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _write_private(path: Path, data: bytes) -> None:
+    """Owner-only private-key files (the reference stores keys 0600 via
+    its KeyStorage permissions checks)."""
+    import os
+
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    try:
+        os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
+def _name(common_name: str, org: str = "ozone-tpu") -> x509.Name:
+    return x509.Name([
+        x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+        x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+    ])
+
+
+def _new_key():
+    # P-256: small certs, fast handshakes; the reference defaults to RSA
+    # but its SecurityConfig lets deployments pick — ECDSA is the modern
+    # choice and half the handshake cost on the datapath
+    return ec.generate_private_key(ec.SECP256R1())
+
+
+def _pem_key(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+class CertificateAuthority:
+    """Self-signed root CA (the SCM's DefaultCAServer analog).
+
+    Persists root key + cert under `root_dir`; `sign_csr` issues leaf
+    certificates with clientAuth+serverAuth EKUs so one cert serves a
+    role's server and client sides (as the reference's service certs do).
+    """
+
+    def __init__(self, root_dir: Path, cluster_id: str = "ozone-tpu",
+                 valid_days: int = 3650):
+        self.root_dir = Path(root_dir)
+        self.root_dir.mkdir(parents=True, exist_ok=True)
+        self.valid_days = valid_days
+        key_path = self.root_dir / "ca.key.pem"
+        cert_path = self.root_dir / "ca.cert.pem"
+        if key_path.exists() and cert_path.exists():
+            self.key = serialization.load_pem_private_key(
+                key_path.read_bytes(), password=None)
+            self.cert = x509.load_pem_x509_certificate(cert_path.read_bytes())
+        else:
+            self.key = _new_key()
+            now = datetime.datetime.now(datetime.timezone.utc)
+            name = _name(f"{cluster_id}-root-ca")
+            self.cert = (
+                x509.CertificateBuilder()
+                .subject_name(name)
+                .issuer_name(name)
+                .public_key(self.key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - _ONE_DAY)
+                .not_valid_after(now + datetime.timedelta(days=valid_days))
+                .add_extension(x509.BasicConstraints(ca=True, path_length=1),
+                               critical=True)
+                .add_extension(
+                    x509.KeyUsage(
+                        digital_signature=True, key_cert_sign=True,
+                        crl_sign=True, content_commitment=False,
+                        key_encipherment=False, data_encipherment=False,
+                        key_agreement=False, encipher_only=False,
+                        decipher_only=False),
+                    critical=True)
+                .sign(self.key, hashes.SHA256())
+            )
+            _write_private(key_path, _pem_key(self.key))
+            cert_path.write_bytes(self.cert.public_bytes(
+                serialization.Encoding.PEM))
+
+    @property
+    def root_pem(self) -> bytes:
+        return self.cert.public_bytes(serialization.Encoding.PEM)
+
+    def sign_csr(self, csr_pem: bytes, valid_days: int = 398) -> bytes:
+        """Issue a leaf cert for a CSR (DefaultApprover analog: SANs are
+        taken from the CSR; subject is preserved)."""
+        csr = x509.load_pem_x509_csr(csr_pem)
+        if not csr.is_signature_valid:
+            raise ValueError("CSR signature invalid")
+        now = datetime.datetime.now(datetime.timezone.utc)
+        builder = (
+            x509.CertificateBuilder()
+            .subject_name(csr.subject)
+            .issuer_name(self.cert.subject)
+            .public_key(csr.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - _ONE_DAY)
+            .not_valid_after(now + datetime.timedelta(days=valid_days))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None),
+                           critical=True)
+            .add_extension(
+                x509.ExtendedKeyUsage([
+                    ExtendedKeyUsageOID.SERVER_AUTH,
+                    ExtendedKeyUsageOID.CLIENT_AUTH,
+                ]),
+                critical=False)
+        )
+        try:
+            san = csr.extensions.get_extension_for_class(
+                x509.SubjectAlternativeName)
+            builder = builder.add_extension(san.value, critical=False)
+        except x509.ExtensionNotFound:
+            pass
+        cert = builder.sign(self.key, hashes.SHA256())
+        return cert.public_bytes(serialization.Encoding.PEM)
+
+
+class CertificateClient:
+    """Per-role cert client (DNCertificateClient / OMCertificateClient
+    analog): keypair + CSR generation, enrollment against a CA, PEM
+    storage under the role dir."""
+
+    def __init__(self, role_dir: Path, role: str,
+                 hostnames: Optional[list[str]] = None):
+        self.role_dir = Path(role_dir)
+        self.role_dir.mkdir(parents=True, exist_ok=True)
+        self.role = role
+        self.hostnames = hostnames or ["localhost", "127.0.0.1"]
+        self.key_path = self.role_dir / f"{role}.key.pem"
+        self.cert_path = self.role_dir / f"{role}.cert.pem"
+        self.ca_path = self.role_dir / "ca.cert.pem"
+        if self.key_path.exists():
+            self.key = serialization.load_pem_private_key(
+                self.key_path.read_bytes(), password=None)
+        else:
+            self.key = _new_key()
+            _write_private(self.key_path, _pem_key(self.key))
+
+    def make_csr(self) -> bytes:
+        sans: list[x509.GeneralName] = []
+        for h in self.hostnames:
+            try:
+                sans.append(x509.IPAddress(ipaddress.ip_address(h)))
+            except ValueError:
+                sans.append(x509.DNSName(h))
+        csr = (
+            x509.CertificateSigningRequestBuilder()
+            .subject_name(_name(self.role))
+            .add_extension(x509.SubjectAlternativeName(sans), critical=False)
+            .sign(self.key, hashes.SHA256())
+        )
+        return csr.public_bytes(serialization.Encoding.PEM)
+
+    def install(self, cert_pem: bytes, ca_pem: bytes) -> None:
+        self.cert_path.write_bytes(cert_pem)
+        self.ca_path.write_bytes(ca_pem)
+
+    def enroll(self, ca: CertificateAuthority) -> None:
+        """In-process enrollment (daemons co-located with the SCM CA or
+        test clusters); remote enrollment ships make_csr() over the SCM
+        RPC and installs the response the same way."""
+        self.install(ca.sign_csr(self.make_csr()), ca.root_pem)
+
+    @property
+    def enrolled(self) -> bool:
+        return self.cert_path.exists() and self.ca_path.exists()
+
+    def tls(self) -> "TlsMaterial":
+        if not self.enrolled:
+            raise RuntimeError(f"{self.role}: not enrolled")
+        return TlsMaterial(
+            key_pem=self.key_path.read_bytes(),
+            cert_pem=self.cert_path.read_bytes(),
+            ca_pem=self.ca_path.read_bytes(),
+        )
+
+
+@dataclass(frozen=True)
+class TlsMaterial:
+    """PEM bundle -> grpc credentials (the SecurityConfig/GrpcTlsConfig
+    analog). mutual=True enforces client certs (the reference's
+    datanode<->datanode replication and Ratis TLS mode)."""
+
+    key_pem: bytes
+    cert_pem: bytes
+    ca_pem: bytes
+
+    def server_credentials(self, mutual: bool = True):
+        import grpc
+
+        return grpc.ssl_server_credentials(
+            [(self.key_pem, self.cert_pem)],
+            root_certificates=self.ca_pem if mutual else None,
+            require_client_auth=mutual,
+        )
+
+    def channel_credentials(self):
+        import grpc
+
+        return grpc.ssl_channel_credentials(
+            root_certificates=self.ca_pem,
+            private_key=self.key_pem,
+            certificate_chain=self.cert_pem,
+        )
