@@ -74,6 +74,17 @@ class S3Gateway:
             client.om.create_volume(S3_VOLUME)
         except _OM_ERRORS:
             pass
+        # per-request bucket namespace: the default s3v volume, or the
+        # authenticated principal's tenant volume (reference
+        # OMMultiTenantManager: accessId -> tenant -> tenant volume).
+        # ThreadingHTTPServer handles each request on its own thread, so a
+        # thread-local carries it without plumbing through every handler.
+        self._request_ctx = threading.local()
+        # accessId -> (volume, expiry): tenant assignment is admin-rare,
+        # so a short TTL cache keeps the hot path at one OM round trip
+        # (the secret fetch) instead of two
+        self._tenant_cache: dict = {}
+        self._tenant_cache_ttl_s = 60.0
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -163,7 +174,7 @@ class S3Gateway:
 
     def _public_grants(self, bucket: str) -> set:
         try:
-            acl = self.client.om.get_bucket_acl(S3_VOLUME, bucket)
+            acl = self.client.om.get_bucket_acl(self._vol, bucket)
         except _OM_ERRORS:
             return set()
         return {
@@ -180,12 +191,32 @@ class S3Gateway:
             return "READ" in grants
         return "WRITE" in grants
 
+    @property
+    def _vol(self) -> str:
+        return getattr(self._request_ctx, "volume", S3_VOLUME)
+
+    def _volume_for(self, access_id: str) -> str:
+        import time as _time
+
+        now = _time.monotonic()
+        hit = self._tenant_cache.get(access_id)
+        if hit is not None and hit[1] > now:
+            return hit[0]
+        tenant = self.client.om.tenant_for_access_id(access_id)
+        vol = tenant["volume"] if tenant is not None else S3_VOLUME
+        self._tenant_cache[access_id] = (vol, now + self._tenant_cache_ttl_s)
+        return vol
+
     def _route(self, h, method: str) -> None:
         u = urlparse(h.path)
         q = parse_qs(u.query, keep_blank_values=True)
         parts = [unquote(p) for p in u.path.strip("/").split("/") if p]
         try:
             principal = self._authenticate(h, method)
+            self._request_ctx.volume = (
+                self._volume_for(principal) if principal is not None
+                else S3_VOLUME
+            )
             if principal is None and self.require_auth:
                 # anonymous: gated by the bucket's public ACL grants
                 # (READ for reads, WRITE for mutations)
@@ -221,7 +252,7 @@ class S3Gateway:
     def _list_buckets(self, h) -> None:
         root = ET.Element("ListAllMyBucketsResult", xmlns=_NS)
         buckets = ET.SubElement(root, "Buckets")
-        for b in self.client.om.list_buckets(S3_VOLUME):
+        for b in self.client.om.list_buckets(self._vol):
             be = ET.SubElement(buckets, "Bucket")
             ET.SubElement(be, "Name").text = b["name"]
             ET.SubElement(be, "CreationDate").text = str(b.get("created", ""))
@@ -241,7 +272,7 @@ class S3Gateway:
         grants map onto bucket ACLs)."""
         om = self.client.om
         if method == "GET":
-            acl = om.get_bucket_acl(S3_VOLUME, bucket)
+            acl = om.get_bucket_acl(self._vol, bucket)
             root = ET.Element("AccessControlPolicy", xmlns=_NS)
             owner = ET.SubElement(root, "Owner")
             ET.SubElement(owner, "ID").text = "owner"
@@ -266,7 +297,7 @@ class S3Gateway:
                 except (ET.ParseError, KeyError) as e:
                     h._reply(*_err("MalformedACLError", str(e), 400))
                     return
-            om.set_bucket_acl(S3_VOLUME, bucket, acl)
+            om.set_bucket_acl(self._vol, bucket, acl)
             h._reply(200)
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
@@ -301,18 +332,18 @@ class S3Gateway:
             return
         if method == "PUT":
             try:
-                om.create_bucket(S3_VOLUME, bucket, self.replication)
+                om.create_bucket(self._vol, bucket, self.replication)
             except OMError as e:
                 # S3 returns success when the same owner re-creates a bucket
                 if e.code != "BUCKET_ALREADY_EXISTS":
                     raise
             h._reply(200, headers={"Location": f"/{bucket}"})
         elif method == "DELETE":
-            om.delete_bucket(S3_VOLUME, bucket)
+            om.delete_bucket(self._vol, bucket)
             h._reply(204)
         elif method in ("GET",):
             prefix = q.get("prefix", [""])[0]
-            keys = om.list_keys(S3_VOLUME, bucket, prefix)
+            keys = om.list_keys(self._vol, bucket, prefix)
             root = ET.Element("ListBucketResult", xmlns=_NS)
             ET.SubElement(root, "Name").text = bucket
             ET.SubElement(root, "Prefix").text = prefix
@@ -325,14 +356,14 @@ class S3Gateway:
                 ET.SubElement(c, "LastModified").text = str(k.get("modified", ""))
             h._reply(200, _xml(root), {"Content-Type": "application/xml"})
         elif method == "HEAD":
-            om.bucket_info(S3_VOLUME, bucket)
+            om.bucket_info(self._vol, bucket)
             h._reply(200)
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
 
     # ------------------------------------------------------------- objects
     def _bucket_handle(self, bucket: str):
-        return self.client.get_volume(S3_VOLUME).get_bucket(bucket)
+        return self.client.get_volume(self._vol).get_bucket(bucket)
 
     def _object_op(self, h, method: str, bucket: str, key: str, q) -> None:
         if method == "POST" and "uploads" in q:
@@ -386,9 +417,14 @@ class S3Gateway:
                      {"Content-Type": "application/octet-stream"})
 
     def _head_object(self, h, bucket: str, key: str) -> None:
-        info = self.client.om.lookup_key(S3_VOLUME, bucket, key)
-        h._reply(200, headers={"Content-Length-Info": str(info["size"]),
-                               "Content-Type": "application/octet-stream"})
+        """HEAD must report the real object size in Content-Length with no
+        body (S3 semantics; SDKs size objects this way before ranged
+        GETs), so the reply is hand-rolled instead of using _reply."""
+        info = self.client.om.lookup_key(self._vol, bucket, key)
+        h.send_response(200)
+        h.send_header("Content-Type", "application/octet-stream")
+        h.send_header("Content-Length", str(info["size"]))
+        h.end_headers()
 
     # ------------------------------------------------------------- multipart
     # Backed by the OM multipart table (om/multipart.py), the reference's

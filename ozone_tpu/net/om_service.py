@@ -9,6 +9,7 @@ OM (the RpcClient/GrpcOmTransport analog).
 
 from __future__ import annotations
 
+import threading
 from typing import Optional
 
 from ozone_tpu.client.ec_writer import BlockGroup
@@ -125,6 +126,45 @@ class OmGrpcService:
                         m["volume"], m["bucket"], m.get("prefix", "")
                     )
                 ),
+                # Native ACL + tenant verbs (reference OmClientProtocol
+                # AddAcl/RemoveAcl/SetAcl/GetAcl + tenant admin RPCs)
+                "ModifyAcl": self._wrap(
+                    lambda m: self.om.modify_acl(
+                        m["obj_type"], m["volume"], m.get("bucket", ""),
+                        m.get("path", ""), m.get("op", "add"),
+                        m.get("acls", []),
+                    )
+                ),
+                "GetAcls": self._wrap(
+                    lambda m: self.om.get_acls(
+                        m["obj_type"], m["volume"], m.get("bucket", ""),
+                        m.get("path", ""),
+                    )
+                ),
+                "CreateTenant": self._wrap(
+                    lambda m: self.om.create_tenant(
+                        m["tenant"], m.get("volume", ""),
+                        m.get("owner", "root"),
+                    )
+                ),
+                "DeleteTenant": self._wrap(
+                    lambda m: self.om.delete_tenant(m["tenant"])
+                ),
+                "ListTenants": self._wrap(lambda m: self.om.list_tenants()),
+                "TenantAssignUser": self._wrap(
+                    lambda m: self.om.tenant_assign_user(
+                        m["tenant"], m["user"], m.get("access_id", "")
+                    )
+                ),
+                "TenantRevokeAccess": self._wrap(
+                    lambda m: self.om.tenant_revoke_access(m["access_id"])
+                ),
+                "ListTenantUsers": self._wrap(
+                    lambda m: self.om.list_tenant_users(m["tenant"])
+                ),
+                "TenantForAccessId": self._wrap(
+                    lambda m: self.om.tenant_for_access_id(m["access_id"])
+                ),
                 # FSO file-system verbs (reference OmClientProtocol
                 # CreateDirectory/GetFileStatus/ListStatus/DeleteKey with
                 # recursive flag)
@@ -152,12 +192,16 @@ class OmGrpcService:
             },
         )
 
-    @staticmethod
-    def _wrap(fn):
+    def _wrap(self, fn):
         def method(req: bytes) -> bytes:
             m, _ = wire.unpack(req)
+            user = m.pop("_user", None)
+            groups = m.pop("_groups", ())
             try:
-                out = fn(m)
+                # bind the remote caller identity for ACL checks (the
+                # reference carries UGI identity on every OM RPC)
+                with self.om.user_context(user, groups):
+                    out = fn(m)
             except OMError as e:
                 raise StorageError(e.code, e.msg)
             return wire.pack({"result": out})
@@ -167,9 +211,11 @@ class OmGrpcService:
     def _open_key(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
         try:
-            s = self.om.open_key(
-                m["volume"], m["bucket"], m["key"], m.get("replication")
-            )
+            with self.om.user_context(m.pop("_user", None),
+                                      m.pop("_groups", ())):
+                s = self.om.open_key(
+                    m["volume"], m["bucket"], m["key"], m.get("replication")
+                )
         except OMError as e:
             raise StorageError(e.code, e.msg)
         return wire.pack(
@@ -271,8 +317,30 @@ class GrpcOmClient:
         self._ch = RpcChannel(address)
         self.block_size = 16 * 1024 * 1024
         self.clients = clients  # DatanodeClientFactory for address learning
+        self._caller = threading.local()
+
+    def user_context(self, user, groups=()):
+        """Bind a caller identity to every RPC issued from this thread
+        (mirrors OzoneManager.user_context; the identity rides the wire as
+        _user/_groups fields)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            prev = getattr(self._caller, "identity", None)
+            self._caller.identity = (user, tuple(groups))
+            try:
+                yield
+            finally:
+                self._caller.identity = prev
+
+        return _ctx()
 
     def _call(self, method: str, **meta) -> dict:
+        ident = getattr(self._caller, "identity", None)
+        if ident is not None and ident[0] is not None:
+            meta.setdefault("_user", ident[0])
+            meta.setdefault("_groups", list(ident[1]))
         m, _ = wire.unpack(self._ch.call(SERVICE, method, wire.pack(meta)))
         return m
 
@@ -390,6 +458,41 @@ class GrpcOmClient:
         return self._call("GetBucketAcl", volume=volume, bucket=bucket)[
             "result"
         ]
+
+    # native acls / tenants
+    def modify_acl(self, obj_type, volume, bucket="", path="", op="add",
+                   acls=None):
+        from ozone_tpu.om.acl import normalize_acls
+
+        return self._call("ModifyAcl", obj_type=obj_type, volume=volume,
+                          bucket=bucket, path=path, op=op,
+                          acls=normalize_acls(acls))["result"]
+
+    def get_acls(self, obj_type, volume, bucket="", path=""):
+        return self._call("GetAcls", obj_type=obj_type, volume=volume,
+                          bucket=bucket, path=path)["result"]
+
+    def create_tenant(self, tenant, volume="", owner="root"):
+        self._call("CreateTenant", tenant=tenant, volume=volume, owner=owner)
+
+    def delete_tenant(self, tenant):
+        self._call("DeleteTenant", tenant=tenant)
+
+    def list_tenants(self):
+        return self._call("ListTenants")["result"]
+
+    def tenant_assign_user(self, tenant, user, access_id=""):
+        return self._call("TenantAssignUser", tenant=tenant, user=user,
+                          access_id=access_id)["result"]
+
+    def tenant_revoke_access(self, access_id):
+        self._call("TenantRevokeAccess", access_id=access_id)
+
+    def list_tenant_users(self, tenant):
+        return self._call("ListTenantUsers", tenant=tenant)["result"]
+
+    def tenant_for_access_id(self, access_id):
+        return self._call("TenantForAccessId", access_id=access_id)["result"]
 
     # multipart upload
     def initiate_multipart_upload(self, volume, bucket, key,

@@ -33,6 +33,11 @@ _TABLES = (
     # accessId -> secret for S3 SigV4 auth (reference: OM s3SecretTable
     # backing the s3-secret-store module)
     "s3_secrets",
+    # path-prefix ACL grants (reference: prefixTable / PrefixManagerImpl)
+    "prefixes",
+    # multi-tenancy (reference: tenantStateTable, tenantAccessIdTable)
+    "tenants",
+    "tenant_access",
 )
 
 

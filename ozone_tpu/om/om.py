@@ -68,6 +68,134 @@ class OzoneManager:
         self.metrics = MetricsRegistry("om")
         self.audit = AuditLogger("om")
         self._lock = threading.RLock()
+        # native authorizer (reference ozone.acl.enabled, default off)
+        self.acl_enabled = False
+        self._authorizer = None
+        self._superusers = {"root"}
+        self._caller = threading.local()
+
+    # ----------------------------------------------------------- acl/tenant
+    def enable_acls(self, superusers=("root",)) -> None:
+        from ozone_tpu.om.acl import NativeAuthorizer
+
+        self._superusers = set(superusers)
+        self._authorizer = NativeAuthorizer(self.store, superusers)
+        self.acl_enabled = True
+
+    def user_context(self, user: Optional[str], groups=()):
+        """Context manager binding the caller identity for ACL checks on
+        this thread (gateways and the OM RPC service wrap each request;
+        unbound calls run as the local superuser, like the reference's
+        in-process trusted callers)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            prev = getattr(self._caller, "identity", None)
+            self._caller.identity = (user, tuple(groups))
+            try:
+                yield
+            finally:
+                self._caller.identity = prev
+
+        return _ctx()
+
+    def current_user(self) -> tuple[Optional[str], tuple]:
+        ident = getattr(self._caller, "identity", None)
+        return ident if ident else (None, ())
+
+    def check_access(self, volume: str, bucket: Optional[str],
+                     key: Optional[str], right,
+                     user: Optional[str] = None, groups=()) -> None:
+        """Raise ACLDeniedError (an OMError) unless the caller holds
+        `right` (an acl.ACLRight or its name) on the object; no-op with
+        ACLs off or with no bound identity."""
+        if not self.acl_enabled:
+            return
+        if user is None:
+            user, groups = self.current_user()
+        if user is None:
+            return
+        from ozone_tpu.om.acl import ACLDeniedError, ACLRight
+
+        if isinstance(right, str):
+            right = ACLRight[right]
+        if not self._authorizer.check(volume, bucket, key, user, groups,
+                                      right):
+            path = "/".join(x for x in (volume, bucket, key) if x)
+            self.metrics.counter("acl_denied").inc()
+            raise ACLDeniedError(user, right, path)
+
+    def _check_superuser(self) -> None:
+        if not self.acl_enabled:
+            return
+        user, _ = self.current_user()
+        if user is not None and user not in self._superusers:
+            from ozone_tpu.om.acl import ACLDeniedError, ACLRight
+
+            raise ACLDeniedError(user, ACLRight.CREATE, "<admin>")
+
+    def modify_acl(self, obj_type: str, volume: str, bucket: str = "",
+                   path: str = "", op: str = "add",
+                   acls: Optional[list] = None) -> bool:
+        """Add/remove/set grants; `acls` items are OzoneAcl, dicts, or
+        CLI strings like `user:alice:rwl[DEFAULT]`."""
+        from ozone_tpu.om.acl import normalize_acls
+
+        self.check_access(volume, bucket or None,
+                          path if (obj_type == "key" and path) else None,
+                          "WRITE_ACL")
+        return self.submit(rq.ModifyAcl(obj_type, volume, bucket, path,
+                                        op, normalize_acls(acls)))
+
+    def get_acls(self, obj_type: str, volume: str, bucket: str = "",
+                 path: str = "") -> list[dict]:
+        self.check_access(volume, bucket or None,
+                          path if (obj_type == "key" and path) else None,
+                          "READ_ACL")
+        table, k = rq._acl_target(self.store, obj_type, volume, bucket, path)
+        row = self.store.get(table, k)
+        if row is None:
+            if table == "prefixes":
+                return []
+            raise rq.OMError(rq.KEY_NOT_FOUND if table == "keys" else
+                             rq.VOLUME_NOT_FOUND if table == "volumes" else
+                             rq.BUCKET_NOT_FOUND, k)
+        return row.get("acls", [])
+
+    def create_tenant(self, tenant: str, volume: str = "",
+                      owner: str = "root") -> None:
+        self._check_superuser()
+        self.submit(rq.CreateTenant(tenant, volume, owner))
+
+    def delete_tenant(self, tenant: str) -> None:
+        self._check_superuser()
+        self.submit(rq.DeleteTenant(tenant))
+
+    def list_tenants(self) -> list[dict]:
+        return [t for _, t in self.store.iterate("tenants")]
+
+    def tenant_assign_user(self, tenant: str, user: str,
+                           access_id: str = "") -> dict:
+        self._check_superuser()
+        return self.submit(rq.AssignUserToTenant(tenant, user, access_id))
+
+    def tenant_revoke_access(self, access_id: str) -> None:
+        self._check_superuser()
+        self.submit(rq.RevokeUserAccessId(access_id))
+
+    def tenant_for_access_id(self, access_id: str) -> Optional[dict]:
+        """S3 gateway hook: map an authenticated access id to its tenant
+        record (tenant volume = the S3 bucket namespace for the request,
+        the reference's OMMultiTenantManager.getTenantVolumeName)."""
+        row = self.store.get("tenant_access", access_id)
+        if row is None:
+            return None
+        return self.store.get("tenants", row["tenant"])
+
+    def list_tenant_users(self, tenant: str) -> list[dict]:
+        return [r for _, r in self.store.iterate("tenant_access")
+                if r["tenant"] == tenant]
 
     # ----------------------------------------------------------- write path
     def submit(self, request: rq.OMRequest) -> Any:
@@ -88,9 +216,11 @@ class OzoneManager:
 
     # ----------------------------------------------------------- volumes
     def create_volume(self, volume: str, owner: str = "root") -> None:
+        self._check_superuser()
         self.submit(rq.CreateVolume(volume, owner))
 
     def delete_volume(self, volume: str) -> None:
+        self._check_superuser()
         self.submit(rq.DeleteVolume(volume))
 
     def volume_info(self, volume: str) -> dict:
@@ -107,9 +237,11 @@ class OzoneManager:
         self, volume: str, bucket: str, replication: str = "rs-6-3-1024k",
         layout: str = "OBJECT_STORE",
     ) -> None:
+        self.check_access(volume, None, None, "CREATE")
         self.submit(rq.CreateBucket(volume, bucket, replication, layout))
 
     def delete_bucket(self, volume: str, bucket: str) -> None:
+        self.check_access(volume, bucket, None, "DELETE")
         self.submit(rq.DeleteBucket(volume, bucket))
 
     def bucket_info(self, volume: str, bucket: str) -> dict:
@@ -136,6 +268,7 @@ class OzoneManager:
     ) -> OpenKeySession:
         from ozone_tpu.om import fso
 
+        self.check_access(volume, bucket, None, "CREATE")
         binfo = self.bucket_info(volume, bucket)
         repl = replication or binfo["replication"]
         client_id = uuid.uuid4().hex[:16]
@@ -195,6 +328,8 @@ class OzoneManager:
     def lookup_key(self, volume: str, bucket: str, key: str) -> dict:
         from ozone_tpu.om import fso
 
+        self.check_access(volume, bucket, key, "READ")
+
         if self._is_fso(self.bucket_info(volume, bucket)):
             info = fso.lookup_file(self.store, volume, bucket, key)
         else:
@@ -222,6 +357,8 @@ class OzoneManager:
     def list_keys(self, volume: str, bucket: str, prefix: str = "") -> list[dict]:
         from ozone_tpu.om import fso
 
+        self.check_access(volume, bucket, None, "LIST")
+
         binfo = self.bucket_info(volume, bucket)  # raises BUCKET_NOT_FOUND
         if self._is_fso(binfo):
             return [
@@ -234,6 +371,8 @@ class OzoneManager:
     def delete_key(self, volume: str, bucket: str, key: str) -> None:
         from ozone_tpu.om import fso
 
+        self.check_access(volume, bucket, key, "DELETE")
+
         if self._is_fso(self.bucket_info(volume, bucket)):
             self.submit(fso.DeleteFile(volume, bucket, key))
         else:
@@ -242,6 +381,8 @@ class OzoneManager:
 
     def rename_key(self, volume: str, bucket: str, key: str, new_key: str) -> None:
         from ozone_tpu.om import fso
+
+        self.check_access(volume, bucket, key, "WRITE")
 
         if self._is_fso(self.bucket_info(volume, bucket)):
             self.submit(fso.RenameEntry(volume, bucket, key, new_key))

@@ -130,7 +130,10 @@ class CreateBucket(OMRequest):
         self.created = time.time()
 
     def apply(self, store):
-        if not store.exists("volumes", volume_key(self.volume)):
+        from ozone_tpu.om.acl import inherit_defaults
+
+        vrow = store.get("volumes", volume_key(self.volume))
+        if vrow is None:
             raise OMError(VOLUME_NOT_FOUND, self.volume)
         k = bucket_key(self.volume, self.bucket)
         if store.exists("buckets", k):
@@ -145,6 +148,9 @@ class CreateBucket(OMRequest):
                 "layout": self.layout,
                 "versioning": self.versioning,
                 "created": self.created,
+                # DEFAULT grants on the volume flow down as ACCESS grants
+                # (OzoneAclUtil.inheritDefaultAcls)
+                "acls": inherit_defaults(vrow.get("acls", [])),
             },
         )
 
@@ -204,6 +210,12 @@ class CommitKey(OMRequest):
         old = store.get("keys", kk)
         if old is not None and old.get("block_groups"):
             store.put("deleted_keys", f"{kk}:{self.modified}", old)
+        if "acls" not in info:
+            from ozone_tpu.om.acl import inherit_defaults
+
+            b = store.get("buckets", bucket_key(self.volume, self.bucket))
+            if b is not None:
+                info["acls"] = inherit_defaults(b.get("acls", []))
         store.put("keys", kk, info)
         return info
 
@@ -336,6 +348,201 @@ class SetBucketAcl(OMRequest):
             raise OMError(BUCKET_NOT_FOUND, k)
         b["acl"] = self.acl
         store.put("buckets", k, b)
+
+
+PREFIX_NOT_FOUND = "PREFIX_NOT_FOUND"
+TENANT_ALREADY_EXISTS = "TENANT_ALREADY_EXISTS"
+TENANT_NOT_FOUND = "TENANT_NOT_FOUND"
+TENANT_NOT_EMPTY = "TENANT_NOT_EMPTY"
+ACCESS_ID_NOT_FOUND = "ACCESS_ID_NOT_FOUND"
+ACCESS_ID_ALREADY_EXISTS = "ACCESS_ID_ALREADY_EXISTS"
+INVALID_REQUEST = "INVALID_REQUEST"
+PERMISSION_DENIED = "PERMISSION_DENIED"
+
+_OBJ_TABLES = {"volume": "volumes", "bucket": "buckets", "key": "keys"}
+
+
+def _acl_target(store, obj_type: str, volume: str, bucket: str, path: str):
+    """(table, row_key) for an ACL object; prefix rows are created on
+    demand (the reference's prefixTable upserts). Keys resolve through
+    the flat table for OBS buckets and the parent-id-keyed file table for
+    FSO buckets (reference: BucketLayoutAwareOMKeyRequestFactory)."""
+    from ozone_tpu.om import acl as aclmod
+
+    if obj_type == "volume":
+        return "volumes", volume_key(volume)
+    if obj_type == "bucket":
+        return "buckets", bucket_key(volume, bucket)
+    if obj_type == "key":
+        flat = f"/{volume}/{bucket}/{path}"
+        if store.exists("keys", flat):
+            return "keys", flat
+        b = store.get("buckets", bucket_key(volume, bucket))
+        if b is not None and b.get("layout") == "FILE_SYSTEM_OPTIMIZED":
+            from ozone_tpu.om import fso
+
+            try:
+                parent_id, name = fso.resolve_parent(store, volume, bucket,
+                                                     path)
+            except OMError:
+                return "keys", flat  # unreachable path -> KEY_NOT_FOUND
+            fk = fso.dir_key(volume, bucket, parent_id, name)
+            if store.exists("files", fk):
+                return "files", fk
+        return "keys", flat
+    if obj_type == "prefix":
+        return "prefixes", aclmod.prefix_key(volume, bucket, path)
+    raise OMError(INVALID_REQUEST, f"unknown acl object type {obj_type}")
+
+
+@dataclass
+class ModifyAcl(OMRequest):
+    """Add/remove/replace native ACL grants on volume/bucket/key/prefix
+    (reference: OM*AddAclRequest / *RemoveAclRequest / *SetAclRequest
+    families + OMPrefixAclRequest)."""
+
+    obj_type: str  # volume | bucket | key | prefix
+    volume: str
+    bucket: str = ""
+    path: str = ""
+    op: str = "add"  # add | remove | set
+    acls: list[dict] = field(default_factory=list)
+
+    def apply(self, store):
+        from ozone_tpu.om import acl as aclmod
+
+        if self.op not in ("add", "remove", "set"):
+            raise OMError(INVALID_REQUEST, f"unknown acl op {self.op!r}")
+        table, k = _acl_target(store, self.obj_type, self.volume,
+                               self.bucket, self.path)
+        row = store.get(table, k)
+        if row is None:
+            if table == "prefixes":
+                row = {"acls": []}
+            else:
+                raise OMError(
+                    {"volumes": VOLUME_NOT_FOUND,
+                     "buckets": BUCKET_NOT_FOUND,
+                     "keys": KEY_NOT_FOUND,
+                     "files": KEY_NOT_FOUND}[table], k)
+        existing = row.get("acls", [])
+        changed = False
+        if self.op == "set":
+            row["acls"] = list(self.acls)
+            changed = True
+        else:
+            fn = aclmod.add_acl if self.op == "add" else aclmod.remove_acl
+            for d in self.acls:
+                existing, ch = fn(existing, aclmod.OzoneAcl.from_json(d))
+                changed = changed or ch
+            row["acls"] = existing
+        if changed:
+            store.put(table, k, row)
+        return changed
+
+
+@dataclass
+class CreateTenant(OMRequest):
+    """Create a tenant backed by its own volume (reference:
+    OMTenantCreateRequest — tenant name == volume unless overridden)."""
+
+    tenant: str
+    volume: str = ""
+    owner: str = "root"
+    created: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.created = time.time()
+        if not self.volume:
+            self.volume = self.tenant
+
+    def apply(self, store):
+        if store.exists("tenants", self.tenant):
+            raise OMError(TENANT_ALREADY_EXISTS, self.tenant)
+        vk = volume_key(self.volume)
+        # the tenant volume must be fresh: adopting an existing volume
+        # (s3v, another owner's namespace) would hand the tenant's users
+        # its entire contents (reference OMTenantCreateRequest fails the
+        # same way)
+        if store.exists("volumes", vk):
+            raise OMError(VOLUME_ALREADY_EXISTS,
+                          f"tenant volume {self.volume} already exists")
+        store.put("volumes", vk, {
+            "name": self.volume,
+            "owner": self.owner,
+            "quota_bytes": -1,
+            "created": self.created,
+        })
+        store.put("tenants", self.tenant, {
+            "tenant": self.tenant,
+            "volume": self.volume,
+            "created": self.created,
+        })
+
+
+@dataclass
+class DeleteTenant(OMRequest):
+    tenant: str
+
+    def apply(self, store):
+        if not store.exists("tenants", self.tenant):
+            raise OMError(TENANT_NOT_FOUND, self.tenant)
+        for _, row in store.iterate("tenant_access"):
+            if row["tenant"] == self.tenant:
+                raise OMError(TENANT_NOT_EMPTY,
+                              f"{self.tenant} still has access ids")
+        store.delete("tenants", self.tenant)
+
+
+@dataclass
+class AssignUserToTenant(OMRequest):
+    """Grant a user an S3 access id under a tenant (reference:
+    OMTenantAssignUserAccessIdRequest: accessId = tenant$user, S3 secret
+    minted and stored)."""
+
+    tenant: str
+    user: str
+    access_id: str = ""
+    secret: str = ""
+
+    def pre_execute(self, om) -> None:
+        import secrets as _secrets
+
+        if not self.access_id:
+            self.access_id = f"{self.tenant}${self.user}"
+        if not self.secret:
+            self.secret = _secrets.token_hex(20)
+
+    def apply(self, store):
+        if not store.exists("tenants", self.tenant):
+            raise OMError(TENANT_NOT_FOUND, self.tenant)
+        # never adopt or rotate an existing identity: that would silently
+        # invalidate issued credentials or re-point another tenant's
+        # access id here (reference: TENANT_ACCESS_ID_ALREADY_EXISTS)
+        if store.exists("tenant_access", self.access_id) or \
+                store.exists("s3_secrets", self.access_id):
+            raise OMError(ACCESS_ID_ALREADY_EXISTS, self.access_id)
+        store.put("tenant_access", self.access_id, {
+            "access_id": self.access_id,
+            "tenant": self.tenant,
+            "user": self.user,
+        })
+        store.put("s3_secrets", self.access_id, {
+            "access_id": self.access_id,
+            "secret": self.secret,
+        })
+        return {"access_id": self.access_id, "secret": self.secret}
+
+
+@dataclass
+class RevokeUserAccessId(OMRequest):
+    access_id: str
+
+    def apply(self, store):
+        if not store.exists("tenant_access", self.access_id):
+            raise OMError(ACCESS_ID_NOT_FOUND, self.access_id)
+        store.delete("tenant_access", self.access_id)
+        store.delete("s3_secrets", self.access_id)
 
 
 @dataclass

@@ -111,6 +111,48 @@ def cmd_sh(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------- acl/tenant
+def cmd_acl(args) -> int:
+    """Native ACL verbs (reference: ozone sh volume|bucket|key|prefix
+    addacl/removeacl/setacl/getacl)."""
+    oz = _client(args)
+    parts = _parse_path(args.path)
+    vol = parts[0]
+    bucket = parts[1] if len(parts) > 1 else ""
+    path = "/".join(parts[2:]) if len(parts) > 2 else ""
+    if args.verb == "get":
+        _emit(oz.om.get_acls(args.object, vol, bucket, path))
+    else:
+        op = {"add": "add", "remove": "remove", "set": "set"}[args.verb]
+        changed = oz.om.modify_acl(args.object, vol, bucket, path, op,
+                                   args.acl)
+        print("changed" if changed else "unchanged")
+    return 0
+
+
+def cmd_tenant(args) -> int:
+    """Tenant admin verbs (reference: ozone tenant create/delete/list,
+    ozone tenant user assign/revoke/list)."""
+    oz = _client(args)
+    om = oz.om
+    if args.verb == "create":
+        om.create_tenant(args.tenant)
+        print(f"tenant {args.tenant} created")
+    elif args.verb == "delete":
+        om.delete_tenant(args.tenant)
+        print(f"tenant {args.tenant} deleted")
+    elif args.verb == "list":
+        _emit(om.list_tenants())
+    elif args.verb == "assign":
+        _emit(om.tenant_assign_user(args.tenant, args.user))
+    elif args.verb == "revoke":
+        om.tenant_revoke_access(args.access_id)
+        print(f"revoked {args.access_id}")
+    elif args.verb == "users":
+        _emit(om.list_tenant_users(args.tenant))
+    return 0
+
+
 # ---------------------------------------------------------------------- fs
 def cmd_fs(args) -> int:
     """Filesystem verbs against FSO buckets (reference: ozone fs via the
@@ -444,6 +486,27 @@ def build_parser() -> argparse.ArgumentParser:
     fs.add_argument("-r", "--recursive", action="store_true")
     fs.add_argument("--om", default="127.0.0.1:9860")
     fs.set_defaults(fn=cmd_fs)
+
+    acl = sub.add_parser("acl", help="native ACL grants (ozone sh "
+                                     "addacl/removeacl/setacl/getacl analog)")
+    acl.add_argument("object",
+                     choices=["volume", "bucket", "key", "prefix"])
+    acl.add_argument("verb", choices=["add", "remove", "set", "get"])
+    acl.add_argument("path", help="/volume[/bucket[/key-or-prefix]]")
+    acl.add_argument("-a", "--acl", action="append", default=[],
+                     help="grant like user:alice:rwl[DEFAULT] (repeatable)")
+    acl.add_argument("--om", default="127.0.0.1:9860")
+    acl.set_defaults(fn=cmd_acl)
+
+    tn = sub.add_parser("tenant", help="multi-tenant admin (ozone tenant "
+                                       "analog)")
+    tn.add_argument("verb", choices=["create", "delete", "list", "assign",
+                                     "revoke", "users"])
+    tn.add_argument("tenant", nargs="?", default="")
+    tn.add_argument("--user", default="")
+    tn.add_argument("--access-id", default="")
+    tn.add_argument("--om", default="127.0.0.1:9860")
+    tn.set_defaults(fn=cmd_tenant)
 
     ad = sub.add_parser("admin", help="cluster admin (ozone admin analog)")
     ad.add_argument("subject", choices=["safemode", "datanode", "status"])

@@ -1,0 +1,265 @@
+"""Native ACLs (volume/bucket/key/prefix) + multi-tenancy.
+
+Mirrors the reference's ACL/tenant test surface (TestOzoneNativeAuthorizer,
+TestOmAcls, PrefixManager tests, TestOMTenantCreateRequest et al.):
+grant parse/merge semantics, authorizer resolution order with
+longest-prefix override, DEFAULT-scope inheritance, deny auditing, and
+tenant lifecycle with access-id S3 secrets.
+"""
+
+import pytest
+
+from ozone_tpu.om import requests as rq
+from ozone_tpu.om.acl import (
+    ACLDeniedError,
+    ACLIdentityType,
+    ACLRight,
+    ACLScope,
+    OzoneAcl,
+    add_acl,
+    remove_acl,
+)
+from ozone_tpu.om.om import OzoneManager
+from ozone_tpu.scm.scm import StorageContainerManager
+
+
+@pytest.fixture
+def om(tmp_path):
+    scm = StorageContainerManager(stale_after_s=1e6, dead_after_s=2e6)
+    for i in range(5):
+        scm.register_datanode(f"dn{i}")
+    om = OzoneManager(tmp_path / "om.db", scm)
+    om.create_volume("v1", owner="owner1")
+    om.create_bucket("v1", "b1", "rs-3-2-4096")
+    yield om
+    om.close()
+
+
+def test_acl_parse_and_string_roundtrip():
+    a = OzoneAcl.parse("user:alice:rwl[DEFAULT]")
+    assert a.id_type is ACLIdentityType.USER
+    assert a.rights == {ACLRight.READ, ACLRight.WRITE, ACLRight.LIST}
+    assert a.scope is ACLScope.DEFAULT
+    assert OzoneAcl.parse(str(a)) == a
+    world = OzoneAcl.parse("world::a")
+    assert world.rights == ACLRight.all()
+    assert world.scope is ACLScope.ACCESS
+
+
+def test_add_remove_merge_semantics():
+    acls, ch = add_acl([], OzoneAcl.parse("user:u:r"))
+    assert ch
+    acls, ch = add_acl(acls, OzoneAcl.parse("user:u:w"))
+    assert ch and len(acls) == 1
+    assert set(acls[0]["rights"]) == {"r", "w"}
+    acls, ch = add_acl(acls, OzoneAcl.parse("user:u:r"))
+    assert not ch  # idempotent
+    acls, ch = remove_acl(acls, OzoneAcl.parse("user:u:w"))
+    assert ch and set(acls[0]["rights"]) == {"r"}
+    acls, ch = remove_acl(acls, OzoneAcl.parse("user:u:r"))
+    assert ch and acls == []
+
+
+def test_authorizer_volume_bucket_key_chain(om):
+    om.enable_acls()
+    om.modify_acl("bucket", "v1", "b1", op="add",
+                  acls=["user:alice:rl"])
+    # alice can READ at bucket scope, bob cannot
+    om.check_access("v1", "b1", None, "READ", user="alice")
+    with pytest.raises(ACLDeniedError):
+        om.check_access("v1", "b1", None, "READ", user="bob")
+    # owner and superuser always pass
+    om.check_access("v1", "b1", None, "WRITE", user="owner1")
+    om.check_access("v1", "b1", None, "WRITE", user="root")
+    # key ACLs require the key row to exist (reference KEY_NOT_FOUND)
+    with pytest.raises(rq.OMError):
+        om.modify_acl("key", "v1", "b1", "k-missing", op="add",
+                      acls=["user:bob:r"])
+    # group grants
+    om.modify_acl("bucket", "v1", "b1", op="add", acls=["group:devs:w"])
+    om.check_access("v1", "b1", None, "WRITE", user="carol", groups=["devs"])
+    with pytest.raises(ACLDeniedError):
+        om.check_access("v1", "b1", None, "DELETE", user="carol", groups=["devs"])
+
+
+def test_prefix_acls_longest_match(om):
+    om.enable_acls()
+    om.modify_acl("prefix", "v1", "b1", "logs/", op="add",
+                  acls=["user:reader:rl"])
+    om.modify_acl("prefix", "v1", "b1", "logs/secret/", op="add",
+                  acls=["user:reader:l"])  # narrower prefix: no READ
+    om.check_access("v1", "b1", "logs/app.log", "READ", user="reader")
+    with pytest.raises(ACLDeniedError):
+        om.check_access("v1", "b1", "logs/secret/x", "READ", user="reader")
+    om.check_access("v1", "b1", "logs/secret/x", "LIST", user="reader")
+    assert om.get_acls("prefix", "v1", "b1", "logs/")
+
+
+def test_default_scope_inheritance(om):
+    om.modify_acl("volume", "v1", op="add",
+                  acls=["user:team:rwcl[DEFAULT]"])
+    om.create_bucket("v1", "b2", "rs-3-2-4096")
+    grants = om.get_acls("bucket", "v1", "b2")
+    assert any(g["name"] == "team" and g["scope"] == "ACCESS"
+               for g in grants)
+    # pre-existing bucket b1 is unaffected
+    assert not any(g.get("name") == "team"
+                   for g in om.get_acls("bucket", "v1", "b1"))
+
+
+def test_modify_acl_missing_object(om):
+    with pytest.raises(rq.OMError):
+        om.modify_acl("bucket", "v1", "nope", op="add", acls=["user:u:r"])
+    with pytest.raises(rq.OMError):
+        om.modify_acl("badtype", "v1", op="add", acls=["user:u:r"])
+
+
+def test_tenant_lifecycle(om):
+    om.create_tenant("acme")
+    assert om.volume_info("acme")["name"] == "acme"
+    assert [t["tenant"] for t in om.list_tenants()] == ["acme"]
+    with pytest.raises(rq.OMError):
+        om.create_tenant("acme")
+
+    grant = om.tenant_assign_user("acme", "alice")
+    assert grant["access_id"] == "acme$alice"
+    assert len(grant["secret"]) == 40
+    # S3 auth path: secret resolvable, tenant mapped
+    assert om.get_s3_secret("acme$alice", create=False) == grant["secret"]
+    assert om.tenant_for_access_id("acme$alice")["volume"] == "acme"
+    assert om.list_tenant_users("acme")[0]["user"] == "alice"
+
+    # non-empty tenant refuses deletion
+    with pytest.raises(rq.OMError):
+        om.delete_tenant("acme")
+    om.tenant_revoke_access("acme$alice")
+    assert om.get_s3_secret("acme$alice", create=False) is None
+    assert om.tenant_for_access_id("acme$alice") is None
+    om.delete_tenant("acme")
+    assert om.list_tenants() == []
+    with pytest.raises(rq.OMError):
+        om.tenant_revoke_access("acme$alice")
+
+
+def test_enforcement_in_om_verbs(om):
+    """enable_acls + a bound user identity actually gates the verbs
+    (reference OzoneNativeAuthorizer wired through OzoneManager)."""
+    import numpy as np
+
+    om.enable_acls()
+    om.modify_acl("bucket", "v1", "b1", op="add", acls=["user:alice:rcl"])
+    with om.user_context("alice"):
+        om.list_keys("v1", "b1")            # LIST granted
+        om.open_key("v1", "b1", "k1")       # CREATE granted
+        with pytest.raises(ACLDeniedError):
+            om.delete_key("v1", "b1", "k1")  # DELETE not granted
+        with pytest.raises(ACLDeniedError):
+            om.create_volume("valice")       # admin-only
+        with pytest.raises(ACLDeniedError):
+            om.create_tenant("talice")       # admin-only
+        with pytest.raises(ACLDeniedError):
+            om.modify_acl("bucket", "v1", "b1", op="add",
+                          acls=["user:alice:a"])  # WRITE_ACL not granted
+    with om.user_context("mallory"):
+        with pytest.raises(ACLDeniedError):
+            om.list_keys("v1", "b1")
+        with pytest.raises(ACLDeniedError):
+            om.open_key("v1", "b1", "k2")
+    # unbound (in-process trusted) callers are unaffected
+    om.list_keys("v1", "b1")
+
+
+def test_tenant_cannot_hijack_existing_volume(om):
+    with pytest.raises(rq.OMError) as ei:
+        om.create_tenant("sneaky", volume="v1")
+    assert ei.value.code == rq.VOLUME_ALREADY_EXISTS
+    # assign twice -> refuses to rotate the issued secret
+    om.create_tenant("tx")
+    om.tenant_assign_user("tx", "u")
+    with pytest.raises(rq.OMError) as ei:
+        om.tenant_assign_user("tx", "u")
+    assert ei.value.code == rq.ACCESS_ID_ALREADY_EXISTS
+    # unknown acl op is rejected, not treated as remove
+    with pytest.raises(rq.OMError):
+        om.modify_acl("bucket", "v1", "b1", op="REPLACE",
+                      acls=["user:u:r"])
+
+
+def test_fso_key_acls(om):
+    om.create_bucket("v1", "fso", "rs-3-2-4096",
+                     layout="FILE_SYSTEM_OPTIMIZED")
+    # write a small file through the normal FSO path
+    s = om.open_key("v1", "fso", "dir/sub/file.txt")
+    om.commit_key(s, [], 0)
+    assert om.modify_acl("key", "v1", "fso", "dir/sub/file.txt", op="add",
+                         acls=["user:fred:r"]) is True
+    grants = om.get_acls("key", "v1", "fso", "dir/sub/file.txt")
+    assert grants and grants[0]["name"] == "fred"
+    om.enable_acls()
+    om.check_access("v1", "fso", "dir/sub/file.txt", "READ", user="fred")
+    with pytest.raises(ACLDeniedError):
+        om.check_access("v1", "fso", "dir/sub/file.txt", "WRITE",
+                        user="fred")
+
+
+def test_remote_identity_enforcement(tmp_path):
+    """The _user identity rides the OM RPC and is enforced server-side."""
+    from ozone_tpu.net.daemons import ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.storage.ids import StorageError
+
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1e6,
+                       dead_after_s=2e6)
+    meta.start()
+    try:
+        om = GrpcOmClient(meta.address)
+        om.create_volume("v")
+        om.create_bucket("v", "b", "rs-3-2-4096")
+        meta.om.enable_acls()
+        om.modify_acl("bucket", "v", "b", op="add", acls=["user:alice:l"])
+        with om.user_context("alice"):
+            om.list_keys("v", "b")
+            with pytest.raises(StorageError) as ei:
+                om.delete_bucket("v", "b")
+            assert ei.value.code == "PERMISSION_DENIED"
+        om.list_keys("v", "b")  # unbound: trusted
+    finally:
+        meta.stop()
+
+
+def test_acl_tenant_over_grpc(tmp_path):
+    """Remote OM path: ModifyAcl/GetAcls + tenant verbs over the wire."""
+    from ozone_tpu.net.daemons import ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1e6,
+                       dead_after_s=2e6)
+    meta.start()
+    try:
+        om = GrpcOmClient(meta.address)
+        om.create_volume("v")
+        om.create_bucket("v", "b", "rs-3-2-4096")
+        assert om.modify_acl("bucket", "v", "b", op="add",
+                             acls=["user:alice:rl"]) is True
+        grants = om.get_acls("bucket", "v", "b")
+        assert grants and grants[0]["name"] == "alice"
+
+        om.create_tenant("corp")
+        tok = om.tenant_assign_user("corp", "bob")
+        assert tok["access_id"] == "corp$bob"
+        assert om.list_tenant_users("corp")[0]["user"] == "bob"
+        assert [t["tenant"] for t in om.list_tenants()] == ["corp"]
+        om.tenant_revoke_access("corp$bob")
+        om.delete_tenant("corp")
+    finally:
+        meta.stop()
+
+
+def test_tenant_requests_replicate_deterministically(tmp_path):
+    """Tenant + ACL requests flow through the replicated request log like
+    every other OM write (serde roundtrip + follower apply)."""
+    r = rq.AssignUserToTenant("t", "u", access_id="t$u", secret="s" * 40)
+    assert rq.OMRequest.from_json(r.to_json()) == r
+    a = rq.ModifyAcl("bucket", "v", "b", op="add",
+                     acls=[OzoneAcl.parse("user:x:r").to_json()])
+    assert rq.OMRequest.from_json(a.to_json()) == a

@@ -134,6 +134,30 @@ def test_signed_bucket_and_object_ops(gw, creds):
     assert got == payload
 
 
+def test_tenant_accessid_addresses_tenant_volume(gw, cluster):
+    """A tenant user's buckets live in the tenant volume, isolated from
+    the default s3v namespace (reference OMMultiTenantManager routing)."""
+    om = cluster.client().om
+    om.create_tenant("tcorp")
+    grant = om.tenant_assign_user("tcorp", "tuser")
+    tcreds = (grant["access_id"], grant["secret"])
+
+    assert _signed(gw, tcreds, "PUT", "/tbucket").status == 200
+    payload = b"tenant-data"
+    assert _signed(gw, tcreds, "PUT", "/tbucket/obj", payload).status == 200
+    assert _signed(gw, tcreds, "GET", "/tbucket/obj").read() == payload
+    # bucket exists in the tenant volume, not in s3v
+    assert any(b["name"] == "tbucket"
+               for b in om.list_buckets("tcorp"))
+    import ozone_tpu.om.requests as rq
+    with pytest.raises(rq.OMError):
+        om.bucket_info("s3v", "tbucket")
+    # a non-tenant principal doesn't see the tenant's buckets
+    other = ("plainuser", om.get_s3_secret("plainuser"))
+    names = _signed(gw, other, "GET", "/").read()
+    assert b"tbucket" not in names
+
+
 def test_anonymous_rejected(gw, creds):
     with pytest.raises(urllib.error.HTTPError) as ei:
         urllib.request.urlopen(f"http://{gw.address}/secure/obj")
