@@ -1,0 +1,84 @@
+"""Controller for the native filesystem failure injector.
+
+The reference ships a standalone C++ gRPC service that interposes a
+filesystem and corrupts/fails/delays operations under a datanode
+(tools/fault-injection-service). This build's equivalent is an
+LD_PRELOAD interposer (native/failure_injector.cpp) plus this
+controller: rules are written to a file the shim re-reads on mtime
+change, so faults can be planted, retargeted, and cleared on a *live*
+process with no native RPC stack. Inject into any subprocess by merging
+`env()` into its environment.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from pathlib import Path
+from typing import Optional
+
+from ozone_tpu.native import build_shared
+
+_HERE = Path(__file__).parent
+_SRC = _HERE.parent / "native" / "failure_injector.cpp"
+_SO = _HERE.parent / "native" / "libfailure_injector.so"
+
+
+def build_injector() -> Optional[Path]:
+    """Compile (once) and return the interposer .so, None if no
+    toolchain — callers (tests) skip instead of failing."""
+    return build_shared(_SRC, _SO, extra=("-ldl",))
+
+
+class FaultInjector:
+    """Plant filesystem faults for child processes.
+
+    >>> fi = FaultInjector(tmp_path)
+    >>> fi.fail("write", dn_root / "chunks", "EIO")
+    >>> subprocess.run([...], env={**os.environ, **fi.env()})
+    """
+
+    def __init__(self, workdir: Path):
+        self.rules_path = Path(workdir) / "fi_rules.txt"
+        self.rules_path.write_text("")
+        self._rules: list[str] = []
+        self._last_mtime = int(self.rules_path.stat().st_mtime)
+
+    # ------------------------------------------------------------- rules
+    def _flush(self) -> None:
+        self.rules_path.write_text("".join(self._rules))
+        # the shim compares whole-second mtimes: every flush must land on
+        # a strictly new time_t value or a same-second update would be
+        # missed forever; bump monotonically past the last one
+        st = self.rules_path.stat()
+        self._last_mtime = max(int(st.st_mtime), self._last_mtime + 1)
+        os.utime(self.rules_path, (st.st_atime, self._last_mtime))
+        time.sleep(0)
+
+    def fail(self, op: str, path_prefix, err: str = "EIO") -> None:
+        self._rules.append(f"{op} {path_prefix} fail {err}\n")
+        self._flush()
+
+    def delay(self, op: str, path_prefix, millis: int) -> None:
+        self._rules.append(f"{op} {path_prefix} delay {millis}\n")
+        self._flush()
+
+    def corrupt_writes(self, path_prefix) -> None:
+        """Bit-flip the first byte of every matched write (the
+        scanner/checksum-verification test hook)."""
+        self._rules.append(f"write {path_prefix} corrupt\n")
+        self._flush()
+
+    def clear(self) -> None:
+        self._rules = []
+        self._flush()
+
+    # ------------------------------------------------------------- env
+    def env(self) -> dict[str, str]:
+        so = build_injector()
+        if so is None:
+            raise RuntimeError("native toolchain unavailable")
+        return {
+            "LD_PRELOAD": str(so),
+            "OZONE_FI_CONFIG": str(self.rules_path),
+        }

@@ -1,0 +1,176 @@
+"""Native filesystem failure injector (LD_PRELOAD interposer).
+
+Mirrors the reference's fault-injection-service test intent
+(tools/fault-injection-service): operations under a target path can be
+failed with a chosen errno, delayed, or corrupted, while untargeted
+paths are untouched — and a datanode whose chunk writes are corrupted
+detects it via checksum verification on read.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ozone_tpu.testing.fault_injection import FaultInjector, build_injector
+
+pytestmark = pytest.mark.skipif(build_injector() is None,
+                                reason="no native toolchain")
+
+
+def _run_py(code: str, env: dict) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-c", code],
+        env={**os.environ, **env, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_write_fail_with_errno(tmp_path):
+    fi = FaultInjector(tmp_path)
+    target = tmp_path / "data"
+    target.mkdir()
+    fi.fail("write", target, "ENOSPC")
+    r = _run_py(
+        "import sys\n"
+        f"f = open({str(target / 'x')!r}, 'wb')\n"
+        "try:\n"
+        "    f.write(b'hello'); f.flush(); print('WROTE')\n"
+        "except OSError as e:\n"
+        "    print('ERR', e.errno)\n",
+        fi.env(),
+    )
+    assert "ERR 28" in r.stdout  # ENOSPC
+
+
+def test_open_fail_and_untargeted_path_unaffected(tmp_path):
+    fi = FaultInjector(tmp_path)
+    target = tmp_path / "blocked"
+    other = tmp_path / "free"
+    target.mkdir()
+    other.mkdir()
+    fi.fail("open", target, "EACCES")
+    r = _run_py(
+        "try:\n"
+        f"    open({str(target / 'x')!r}, 'wb'); print('OPENED')\n"
+        "except OSError as e:\n"
+        "    print('ERR', e.errno)\n"
+        f"open({str(other / 'y')!r}, 'wb').write(b'ok')\n"
+        "print('OTHER_OK')\n",
+        fi.env(),
+    )
+    assert "ERR 13" in r.stdout  # EACCES
+    assert "OTHER_OK" in r.stdout
+
+
+def test_write_corruption_detected_by_checksum(tmp_path):
+    """End-to-end scanner story: a corrupted chunk write is caught by
+    read-side checksum verification (the on-demand scanner trigger)."""
+    fi = FaultInjector(tmp_path)
+    dn_root = tmp_path / "dn"
+    fi.corrupt_writes(dn_root)
+    code = f"""
+import numpy as np
+from pathlib import Path
+from ozone_tpu.storage.datanode import Datanode
+from ozone_tpu.storage.ids import BlockID, ChunkInfo, ContainerState
+from ozone_tpu.utils.checksum import Checksum, ChecksumType, ChecksumError
+from ozone_tpu.storage.ids import StorageError
+
+dn = Datanode(Path({str(dn_root)!r}), "dn0")
+dn.create_container(1, replica_index=1)
+data = np.arange(4096, dtype=np.uint8) % 251
+cs = Checksum(ChecksumType.CRC32C, 1024).compute(data)
+info = ChunkInfo("c0", 0, data.size, cs)
+dn.write_chunk(BlockID(1, 1), info, data)
+try:
+    dn.read_chunk(BlockID(1, 1), info, verify=True)
+    print("UNDETECTED")
+except ChecksumError:
+    print("CORRUPTION_DETECTED")
+except StorageError as e:
+    print("CORRUPTION_DETECTED" if e.code == "CHECKSUM_MISMATCH"
+          else f"OTHER {{e.code}}")
+"""
+    r = _run_py(code, {**fi.env(), "PYTHONPATH": os.getcwd()})
+    assert "CORRUPTION_DETECTED" in r.stdout, r.stdout + r.stderr
+
+
+def test_delay(tmp_path):
+    fi = FaultInjector(tmp_path)
+    target = tmp_path / "slow"
+    target.mkdir()
+    fi.delay("write", target, 300)
+    # measure around the write inside the child: wall-clocking the whole
+    # subprocess would pass vacuously from interpreter startup alone
+    r = _run_py(
+        "import time\n"
+        f"f = open({str(target / 'x')!r}, 'wb')\n"
+        "t0 = time.time(); f.write(b'z'); f.flush()\n"
+        "print('ELAPSED', time.time() - t0)\n",
+        fi.env(),
+    )
+    elapsed = float(r.stdout.split("ELAPSED")[1])
+    assert elapsed >= 0.3, r.stdout
+
+
+def test_fd_reuse_does_not_leak_rules(tmp_path):
+    """After closing a targeted file, a recycled fd pointing at an
+    untargeted file must not inherit its fault rules."""
+    fi = FaultInjector(tmp_path)
+    target = tmp_path / "t"
+    other = tmp_path / "o"
+    target.mkdir()
+    other.mkdir()
+    fi.fail("write", target, "EIO")
+    r = _run_py(
+        "import os\n"
+        f"fd1 = os.open({str(target / 'x')!r}, os.O_WRONLY | os.O_CREAT)\n"
+        "try:\n"
+        "    os.write(fd1, b'x'); print('T_WROTE')\n"
+        "except OSError as e:\n"
+        "    print('T_ERR', e.errno)\n"
+        "os.close(fd1)\n"
+        # the very next open typically recycles the same fd number
+        f"fd2 = os.open({str(other / 'y')!r}, os.O_WRONLY | os.O_CREAT)\n"
+        "print('SAME_FD', fd1 == fd2)\n"
+        "os.write(fd2, b'y'); print('O_WROTE')\n"
+        "os.close(fd2)\n",
+        fi.env(),
+    )
+    assert "T_ERR 5" in r.stdout
+    assert "SAME_FD True" in r.stdout, r.stdout  # fd actually recycled
+    assert "O_WROTE" in r.stdout, r.stdout
+
+
+def test_live_retarget(tmp_path):
+    """Rules can change while the victim process is running (the gRPC
+    retargeting capability of the reference, minus the RPC)."""
+    fi = FaultInjector(tmp_path)
+    target = tmp_path / "d"
+    target.mkdir()
+    code = f"""
+import sys
+p = {str(target / 'x')!r}
+open(p, 'wb').write(b'first')          # no rules yet -> fine
+print('PHASE1_OK', flush=True)
+sys.stdin.readline()                   # controller plants a rule now
+try:
+    f = open(p, 'wb'); f.write(b'second'); print('PHASE2_WROTE')
+except OSError as e:
+    print('PHASE2_ERR', e.errno)
+"""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        env={**os.environ, **fi.env(), "JAX_PLATFORMS": "cpu"},
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "PHASE1_OK" in line
+    fi.fail("open", target, "EIO")
+    time.sleep(1.2)  # the shim's reload check is 1s-granular
+    out, _ = proc.communicate(input="go\n", timeout=30)
+    assert "PHASE2_ERR 5" in out  # EIO planted mid-flight
