@@ -169,6 +169,9 @@ class ScmOmDaemon:
         stale_after_s: float = 9.0,
         dead_after_s: float = 30.0,
         background_interval_s: float = 1.0,
+        http_port: int | None = None,
+        recon_port: int | None = None,
+        recon_interval_s: float = 30.0,
     ):
         self.scm = StorageContainerManager(
             min_datanodes=min_datanodes,
@@ -188,6 +191,70 @@ class ScmOmDaemon:
 
         self.insight = InsightService(self.server, "scm-om")
         self._bg_interval = background_interval_s
+        # optional HTTP endpoint: /prom, /prof, /stacks, and live
+        # reconfiguration of the service knobs (ReconfigureProtocol
+        # analog, reference feature/Reconfigurability.md)
+        self.http = None
+        if http_port is not None:
+            from ozone_tpu.utils.config import (
+                OzoneConfiguration,
+                ReconfigurationHandler,
+            )
+            from ozone_tpu.utils.http_server import ServiceHttpServer
+
+            conf = OzoneConfiguration()
+            reconfig = ReconfigurationHandler(conf)
+
+            def _set_float(attr):
+                def apply(v):
+                    setattr(self.scm.nodes, attr, float(v))
+
+                return apply
+
+            # seed the config with the effective values so
+            # /reconfig/properties reports reality, not null
+            conf.set("ozone.scm.stale.node.interval", stale_after_s)
+            reconfig.register(
+                "ozone.scm.stale.node.interval",
+                _set_float("stale_after"), validator=float,
+                description="seconds of heartbeat silence before STALE")
+            conf.set("ozone.scm.dead.node.interval", dead_after_s)
+            reconfig.register(
+                "ozone.scm.dead.node.interval",
+                _set_float("dead_after"), validator=float,
+                description="seconds of heartbeat silence before DEAD")
+
+            def _set_block_size(v):
+                self.om.block_size = int(v)
+
+            conf.set("ozone.om.block.size", block_size)
+            reconfig.register(
+                "ozone.om.block.size", _set_block_size, validator=int,
+                description="allocation unit for new keys (bytes)")
+            self.http = ServiceHttpServer(
+                "scm-om", host, http_port,
+                status_provider=lambda: {
+                    "address": self.address,
+                    "safemode": self.scm.safemode.in_safemode(),
+                },
+                reconfig=reconfig,
+            )
+        # optional embedded Recon (observability warehouse + UI); the
+        # reference runs Recon as its own role fed by OM WAL deltas —
+        # here it rides the metadata process and tails the same store
+        self.recon = None
+        if recon_port is not None:
+            from ozone_tpu.recon.recon import ReconServer
+
+            self.recon = ReconServer(
+                self.om, self.scm, host=host, port=recon_port,
+                db_path=Path(om_db).parent / "recon.db",
+            )
+        # recon tasks do full-namespace scans + warehouse inserts: they
+        # run on their own minute-scale cadence (reference
+        # ReconTaskController schedules), never per background tick
+        self._recon_interval = recon_interval_s
+        self._recon_last = 0.0
 
     @property
     def address(self) -> str:
@@ -195,6 +262,10 @@ class ScmOmDaemon:
 
     def start(self) -> None:
         self.server.start()
+        if self.http is not None:
+            self.http.start()
+        if self.recon is not None:
+            self.recon.start()
         self.scm.start_background(self._bg_interval)
         # OM background services (reference service/: KeyDeletingService,
         # DirectoryDeletingService) — purge detached subtrees and hand
@@ -206,6 +277,11 @@ class ScmOmDaemon:
                 try:
                     self.om.run_dir_deleting_service_once()
                     self.om.run_key_deleting_service_once()
+                    now = time.monotonic()
+                    if self.recon is not None and \
+                            now - self._recon_last >= self._recon_interval:
+                        self._recon_last = now
+                        self.recon.run_tasks_once()
                 except Exception:  # noqa: BLE001 - service must survive
                     log.exception("om background service pass failed")
 
@@ -216,6 +292,13 @@ class ScmOmDaemon:
     def stop(self) -> None:
         if hasattr(self, "_om_bg_stop"):
             self._om_bg_stop.set()
+            # the background thread may be mid recon scan / OM purge;
+            # it must finish the pass before the stores close under it
+            self._om_bg.join(timeout=30.0)
+        if self.http is not None:
+            self.http.stop()
+        if self.recon is not None:
+            self.recon.stop()
         self.scm.stop()
         self.server.stop()
         self.om.close()

@@ -274,13 +274,22 @@ class ReconServer:
     """Recon REST API over the service HTTP server."""
 
     def __init__(self, om: OzoneManager, scm: StorageContainerManager,
-                 host: str = "127.0.0.1", port: int = 0, db_path=None):
+                 host: str = "127.0.0.1", port: int = 0, db_path=None,
+                 scan_cache_ttl_s: float = 15.0):
         self.tasks = ReconTasks(om)
         self.scm_view = ReconScmView(scm)
         self.key_index = ContainerKeyIndex(om)
         self.warehouse = (
             ReconWarehouse(db_path) if db_path is not None else None
         )
+        # full-namespace-scan task outputs are served from a short TTL
+        # cache: the UI polls every 10s from any number of tabs, and a
+        # scan must cost at most one pass per TTL window, not one per
+        # request (the reference serves these from the warehouse tables
+        # its ReconTaskController refreshed, never by scanning inline)
+        self._scan_cache_ttl = scan_cache_ttl_s
+        self._scan_cache: dict[str, tuple[float, object]] = {}
+        self._scan_lock = threading.Lock()
         from ozone_tpu.utils.http_server import ServiceHttpServer
 
         self._base = ServiceHttpServer(
@@ -293,9 +302,17 @@ class ReconServer:
         class Handler(orig_handler):
             def do_GET(self):
                 path = self.path.split("?")[0]
+                if path in ("/", "/ui"):
+                    from ozone_tpu.recon.ui import RECON_INDEX_HTML
+
+                    self._send(200, RECON_INDEX_HTML,
+                               "text/html; charset=utf-8")
+                    return
                 routes = {
-                    "/api/namespace": recon.tasks.namespace_summary,
-                    "/api/filesizes": recon.tasks.file_size_histogram,
+                    "/api/namespace": lambda: recon._scan(
+                        "namespace", recon.tasks.namespace_summary),
+                    "/api/filesizes": lambda: recon._scan(
+                        "filesizes", recon.tasks.file_size_histogram),
                     "/api/containers/keys": lambda: {
                         str(k): v
                         for k, v in recon.key_index.container_key_map()
@@ -323,11 +340,25 @@ class ReconServer:
 
         self._base._httpd.RequestHandlerClass = Handler
 
+    def _scan(self, key: str, fn):
+        """Run a namespace-scan task at most once per TTL window; callers
+        in between get the cached output."""
+        now = time.monotonic()
+        with self._scan_lock:
+            hit = self._scan_cache.get(key)
+            if hit is not None and now - hit[0] < self._scan_cache_ttl:
+                return hit[1]
+        val = fn()
+        with self._scan_lock:
+            self._scan_cache[key] = (time.monotonic(), val)
+        return val
+
     def api_summary(self) -> dict:
         health = self.scm_view.container_health()
         return {
             "ts": time.time(),
-            "namespace": self.tasks.namespace_summary(),
+            "namespace": self._scan("namespace",
+                                    self.tasks.namespace_summary),
             "containers": {k: len(v) for k, v in health.items()},
             "nodes": self.scm_view.node_table(),
         }
@@ -335,14 +366,19 @@ class ReconServer:
     def run_tasks_once(self) -> None:
         """One warehouse tick (ReconTaskController analog): refresh the
         delta-fed index and persist every task's output with a
-        timestamp so operators get history, not just now."""
+        timestamp so operators get history, not just now. Runs the scans
+        fresh and primes the serving cache with the results."""
         self.key_index.refresh()
+        ns = self.tasks.namespace_summary()
+        sizes = self.tasks.file_size_histogram()
+        with self._scan_lock:
+            now = time.monotonic()
+            self._scan_cache["namespace"] = (now, ns)
+            self._scan_cache["filesizes"] = (now, sizes)
         if self.warehouse is None:
             return
-        self.warehouse.record("namespace", self.tasks.namespace_summary())
-        self.warehouse.record(
-            "filesizes", {"buckets": self.tasks.file_size_histogram()}
-        )
+        self.warehouse.record("namespace", ns)
+        self.warehouse.record("filesizes", {"buckets": sizes})
         health = self.scm_view.container_health()
         self.warehouse.record(
             "container_health", {k: len(v) for k, v in health.items()}

@@ -1,0 +1,213 @@
+"""Recon web UI: a single static dashboard page over the REST API.
+
+Role analog of the reference's bundled React UI (hadoop-ozone/recon
+`webapps/recon` — overview cards, datanode table, container health); this
+build serves one dependency-free HTML page from the Recon server itself,
+rendering /api/summary + /api/filesizes + /api/history. Visual rules
+follow the dataviz method: headline numbers are stat tiles (not charts),
+node/container state uses the reserved status palette with an icon+label
+(never color alone), the single file-size series is one hue with direct
+labels and no legend, and light/dark are both selected palettes swapped
+via CSS custom properties.
+"""
+
+RECON_INDEX_HTML = """<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>Recon &mdash; ozone-tpu</title>
+<style>
+  .viz-root {
+    color-scheme: light;
+    --surface-1: #fcfcfb;
+    --surface-2: #f1f0ee;
+    --text-primary: #0b0b0b;
+    --text-secondary: #52514e;
+    --series-1: #2a78d6;
+    --status-good: #0ca30c;
+    --status-warning: #fab219;
+    --status-critical: #d03b3b;
+    --border: #d8d7d3;
+  }
+  @media (prefers-color-scheme: dark) {
+    :root:where(:not([data-theme="light"])) .viz-root {
+      color-scheme: dark;
+      --surface-1: #1a1a19;
+      --surface-2: #242422;
+      --text-primary: #ffffff;
+      --text-secondary: #c3c2b7;
+      --series-1: #3987e5;
+      --border: #3a3937;
+    }
+  }
+  :root[data-theme="dark"] .viz-root {
+    color-scheme: dark;
+    --surface-1: #1a1a19;
+    --surface-2: #242422;
+    --text-primary: #ffffff;
+    --text-secondary: #c3c2b7;
+    --series-1: #3987e5;
+    --border: #3a3937;
+  }
+  body { margin: 0; }
+  .viz-root {
+    font: 14px/1.45 system-ui, sans-serif;
+    background: var(--surface-1);
+    color: var(--text-primary);
+    min-height: 100vh;
+    padding: 24px;
+    box-sizing: border-box;
+  }
+  h1 { font-size: 18px; margin: 0 0 4px; }
+  .sub { color: var(--text-secondary); margin-bottom: 20px; }
+  h2 { font-size: 14px; margin: 28px 0 10px; }
+  .tiles { display: flex; flex-wrap: wrap; gap: 12px; }
+  .tile {
+    background: var(--surface-2);
+    border: 1px solid var(--border);
+    border-radius: 8px;
+    padding: 12px 18px;
+    min-width: 120px;
+  }
+  .tile .v { font-size: 26px; font-weight: 600; }
+  .tile .k { color: var(--text-secondary); font-size: 12px; }
+  table { border-collapse: collapse; width: 100%; max-width: 880px; }
+  th, td {
+    text-align: left;
+    padding: 6px 10px;
+    border-bottom: 1px solid var(--border);
+  }
+  th { color: var(--text-secondary); font-weight: 500; font-size: 12px; }
+  .badge {
+    display: inline-flex;
+    align-items: center;
+    gap: 6px;
+    font-size: 12px;
+  }
+  .dot { width: 8px; height: 8px; border-radius: 50%; }
+  .bar-row { display: flex; align-items: center; gap: 8px; margin: 3px 0; }
+  .bar-label {
+    width: 110px;
+    text-align: right;
+    color: var(--text-secondary);
+    font-size: 12px;
+  }
+  .bar {
+    height: 14px;
+    background: var(--series-1);
+    border-radius: 0 4px 4px 0;
+    min-width: 2px;
+  }
+  .bar-val { font-size: 12px; }
+  .err { color: var(--status-critical); }
+</style>
+</head>
+<body>
+<div class="viz-root">
+  <h1>Recon &mdash; ozone-tpu cluster observability</h1>
+  <div class="sub" id="ts">loading&hellip;</div>
+
+  <div class="tiles" id="tiles"></div>
+
+  <h2>Datanodes</h2>
+  <table id="nodes">
+    <thead><tr><th>node</th><th>rack</th><th>state</th><th>op state</th>
+      <th>used / capacity</th></tr></thead>
+    <tbody></tbody>
+  </table>
+
+  <h2>Container health</h2>
+  <table id="health">
+    <thead><tr><th>class</th><th>count</th></tr></thead>
+    <tbody></tbody>
+  </table>
+
+  <h2>File sizes</h2>
+  <div id="sizes"></div>
+  <details><summary>table view</summary>
+    <table id="sizes-table">
+      <thead><tr><th>bucket</th><th>files</th></tr></thead>
+      <tbody></tbody>
+    </table>
+  </details>
+</div>
+<script>
+// state -> reserved status palette; always icon(dot)+label, never color alone
+const STATE = {
+  HEALTHY: ["var(--status-good)", "\\u2713"],
+  STALE: ["var(--status-warning)", "\\u26a0"],
+  DEAD: ["var(--status-critical)", "\\u2715"],
+};
+// every server-derived string goes through esc() before innerHTML —
+// dn ids, racks, bucket labels etc. are external input to this page
+function esc(s) {
+  return String(s).replace(/[&<>"']/g, c => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
+  }[c]));
+}
+function badge(state) {
+  const [color, icon] = STATE[state] || ["var(--text-secondary)", "?"];
+  return `<span class="badge"><span class="dot" style="background:${color}">` +
+         `</span>${icon} ${esc(state)}</span>`;
+}
+function fmtBytes(n) {
+  if (n == null) return "0 B";
+  const units = ["B", "KiB", "MiB", "GiB", "TiB"];
+  let i = 0;
+  while (n >= 1024 && i < units.length - 1) { n /= 1024; i++; }
+  return (i ? n.toFixed(1) : n) + " " + units[i];
+}
+function tile(k, v) {
+  return `<div class="tile"><div class="v">${esc(v)}</div>` +
+         `<div class="k">${esc(k)}</div></div>`;
+}
+async function refresh() {
+  try {
+    const s = await (await fetch("/api/summary")).json();
+    document.getElementById("ts").textContent =
+        "as of " + new Date(s.ts * 1000).toLocaleString();
+    const ns = s.namespace || {};
+    const tiles = [
+      ["volumes", ns.volumes], ["buckets", ns.buckets],
+      ["keys", ns.keys], ["bytes", fmtBytes(ns.bytes)],
+      ["datanodes", (s.nodes || []).length],
+    ];
+    for (const [k, n] of Object.entries(s.containers || {}))
+      tiles.push(["containers: " + k, n]);
+    document.getElementById("tiles").innerHTML =
+        tiles.map(([k, v]) => tile(k, v ?? 0)).join("");
+
+    document.querySelector("#nodes tbody").innerHTML = (s.nodes || [])
+      .map(n => `<tr><td>${esc(n.dn_id)}</td><td>${esc(n.rack ?? "")}</td>` +
+                `<td>${badge(n.state)}</td><td>${esc(n.op_state ?? "")}</td>` +
+                `<td>${fmtBytes(n.used_bytes)} / ` +
+                `${fmtBytes(n.capacity_bytes)}</td></tr>`).join("");
+
+    document.querySelector("#health tbody").innerHTML =
+        Object.entries(s.containers || {})
+          .map(([k, v]) =>
+            `<tr><td>${esc(k)}</td><td>${esc(v)}</td></tr>`).join("");
+
+    const fs = await (await fetch("/api/filesizes")).json();
+    const entries = Object.entries(fs);
+    const max = Math.max(1, ...entries.map(([, v]) => v));
+    document.getElementById("sizes").innerHTML = entries.map(([k, v]) =>
+      `<div class="bar-row"><span class="bar-label">${esc(k)}</span>` +
+      `<span class="bar" style="width:${(260 * v / max) | 0}px"></span>` +
+      `<span class="bar-val">${esc(v)}</span></div>`).join("");
+    document.querySelector("#sizes-table tbody").innerHTML = entries
+      .map(([k, v]) =>
+        `<tr><td>${esc(k)}</td><td>${esc(v)}</td></tr>`).join("");
+  } catch (e) {
+    const ts = document.getElementById("ts");
+    ts.innerHTML = '<span class="err"></span>';
+    ts.firstChild.textContent = "failed to load: " + e;
+  }
+}
+refresh();
+setInterval(refresh, 10000);
+</script>
+</body>
+</html>
+"""

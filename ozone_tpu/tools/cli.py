@@ -272,9 +272,13 @@ def cmd_scm_om(args) -> int:
 
     logging.basicConfig(level=logging.INFO)
     d = ScmOmDaemon(Path(args.db), port=args.port,
-                    min_datanodes=args.min_datanodes)
+                    min_datanodes=args.min_datanodes,
+                    http_port=args.http_port,
+                    recon_port=args.recon_port)
     d.start()
-    print(f"scm+om serving on {d.address}")
+    print(f"scm+om serving on {d.address}"
+          + (f", http on {d.http.address}" if d.http else "")
+          + (f", recon on {d.recon.address}" if d.recon else ""))
     try:
         while True:
             time.sleep(3600)
@@ -573,6 +577,10 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("--db", required=True)
     so.add_argument("--port", type=int, default=9860)
     so.add_argument("--min-datanodes", type=int, default=1)
+    so.add_argument("--http-port", type=int, default=None,
+                    help="serve /prom /prof /stacks /reconfig on this port")
+    so.add_argument("--recon-port", type=int, default=None,
+                    help="serve the Recon API + web UI on this port")
     so.set_defaults(fn=cmd_scm_om)
 
     ins = sub.add_parser("insight",

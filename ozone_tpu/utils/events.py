@@ -61,3 +61,112 @@ class EventQueue:
         """Wait for queued async events to drain (tests)."""
         if self._async:
             self._q.join()
+
+
+class EventWatcher:
+    """Command-ack tracking with lease timeout (reference EventWatcher,
+    hdds/server/events/EventWatcher.java + LeaseManager): a started
+    event is tracked by id until its completion event arrives; if the
+    lease expires first the original payload is re-published on the
+    start topic (retry) and the timeout hook fires. check_leases() is
+    deterministic for tests; start_timer() runs it in the background.
+    """
+
+    def __init__(
+        self,
+        queue: EventQueue,
+        start_topic: str,
+        completion_topic: str,
+        lease_timeout_s: float = 10.0,
+        on_timeout: Handler | None = None,
+        max_retries: int = 3,
+    ):
+        import time
+
+        self._time = time.monotonic
+        self.queue = queue
+        self.start_topic = start_topic
+        self.completion_topic = completion_topic
+        self.lease_timeout_s = lease_timeout_s
+        self.on_timeout = on_timeout
+        self.max_retries = max_retries
+        #: id -> (payload, deadline, retries)
+        self._pending: dict[Any, tuple[Any, float, int]] = {}
+        self._lock = threading.Lock()
+        self._timer: threading.Thread | None = None
+        self._stop = threading.Event()
+        queue.subscribe(completion_topic, self._on_completion)
+
+    # ------------------------------------------------------------- tracking
+    def watch(self, event_id: Any, payload: Any) -> None:
+        """Publish on the start topic and track until completion/ack."""
+        with self._lock:
+            self._pending[event_id] = (
+                payload, self._time() + self.lease_timeout_s, 0)
+        self.queue.publish(self.start_topic, payload)
+
+    def _on_completion(self, event_id: Any) -> None:
+        with self._lock:
+            self._pending.pop(event_id, None)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def check_leases(self) -> list[Any]:
+        """Expire overdue leases: re-publish (up to max_retries), then
+        drop and invoke on_timeout. Returns the ids that timed out."""
+        now = self._time()
+        expired: list[tuple[Any, tuple[Any, float, int]]] = []
+        with self._lock:
+            for eid, entry in list(self._pending.items()):
+                if entry[1] <= now:
+                    expired.append((eid, entry))
+        timed_out = []
+        for eid, entry in expired:
+            payload, _deadline, retries = entry
+            with self._lock:
+                # between collecting the expiry and acting on it the
+                # completion may have landed — and the same id may have
+                # been re-watched with a fresh lease. Only act if the
+                # exact expired lease object is still the tracked one;
+                # a fresh lease must be neither overwritten nor timed out
+                if self._pending.get(eid) is not entry:
+                    continue
+                if retries < self.max_retries:
+                    self._pending[eid] = (
+                        payload, self._time() + self.lease_timeout_s,
+                        retries + 1)
+                    retry = True
+                else:
+                    self._pending.pop(eid, None)
+                    retry = False
+            if retry:
+                self.queue.publish(self.start_topic, payload)
+            else:
+                timed_out.append(eid)
+                if self.on_timeout is not None:
+                    try:
+                        self.on_timeout(payload)
+                    except Exception:
+                        log.exception("event watcher timeout hook failed")
+        return timed_out
+
+    # ------------------------------------------------------------- timer
+    def start_timer(self, interval_s: float = 1.0) -> None:
+        if self._timer is not None:
+            return
+
+        def loop():
+            while not self._stop.wait(interval_s):
+                self.check_leases()
+
+        self._timer = threading.Thread(target=loop, daemon=True,
+                                       name="event-watcher")
+        self._timer.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._timer is not None:
+            self._timer.join(timeout=1.0)
+            self._timer = None

@@ -21,14 +21,65 @@ from ozone_tpu.utils import metrics as metrics_mod
 log = logging.getLogger(__name__)
 
 
+def sample_stacks(duration_s: float = 1.0,
+                  interval_s: float = 0.01) -> str:
+    """Sampling profiler over sys._current_frames (the ProfileServlet /
+    async-profiler analog, hadoop-hdds/framework http/ProfileServlet.java):
+    samples every thread's stack for `duration_s` and emits
+    flamegraph-collapsed lines `frame;frame;frame count` — feed straight
+    into speedscope / flamegraph.pl."""
+    import sys
+    import time
+    import traceback
+    from collections import Counter
+
+    counts: Counter = Counter()
+    deadline = time.monotonic() + duration_s
+    me = threading.get_ident()
+    while time.monotonic() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            stack = traceback.extract_stack(frame)
+            key = ";".join(
+                f"{f.name} ({f.filename.rsplit('/', 1)[-1]}:{f.lineno})"
+                for f in stack
+            )
+            counts[key] += 1
+        time.sleep(interval_s)
+    return "\n".join(f"{k} {v}" for k, v in counts.most_common())
+
+
+def thread_dump() -> str:
+    """jstack-style dump of every live thread (the /stacks servlet)."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    by_id = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for tid, frame in frames.items():
+        t = by_id.get(tid)
+        out.append(f'Thread "{t.name if t else tid}" '
+                   f"daemon={getattr(t, 'daemon', '?')}:")
+        out.extend("    " + ln.strip()
+                   for ln in traceback.format_stack(frame))
+        out.append("")
+    return "\n".join(out)
+
+
 class ServiceHttpServer:
     def __init__(self, service_name: str, host: str = "127.0.0.1",
                  port: int = 0,
                  status_provider: Optional[Callable[[], dict]] = None,
-                 config_provider: Optional[Callable[[], dict]] = None):
+                 config_provider: Optional[Callable[[], dict]] = None,
+                 reconfig=None):
         self.service_name = service_name
         self.status_provider = status_provider or (lambda: {})
         self.config_provider = config_provider or (lambda: {})
+        #: utils/config.ReconfigurationHandler wired by the daemon; the
+        #: /reconfig endpoints 404 without one
+        self.reconfig = reconfig
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -72,12 +123,55 @@ class ServiceHttpServer:
                     else:
                         self._send(400, json.dumps(
                             {"error": "need ?log=<name>&level=<level>"}))
+                elif u.path == "/prof":
+                    # sampling profiler (ProfileServlet analog): collapsed
+                    # flamegraph stacks over ?duration=S&interval=S
+                    q = parse_qs(u.query)
+                    try:
+                        dur = min(float(q.get("duration", ["1"])[0]), 30.0)
+                        iv = max(float(q.get("interval", ["0.01"])[0]),
+                                 0.001)
+                    except ValueError as e:
+                        self._send(400, json.dumps({"error": str(e)}))
+                        return
+                    self._send(200, sample_stacks(dur, iv), "text/plain")
+                elif u.path == "/stacks":
+                    self._send(200, thread_dump(), "text/plain")
+                elif u.path == "/reconfig/properties":
+                    if outer.reconfig is None:
+                        self._send(404, json.dumps(
+                            {"error": "no reconfiguration handler"}))
+                    else:
+                        self._send(200, json.dumps(
+                            outer.reconfig.properties(), indent=2,
+                            default=str))
+                elif u.path == "/reconfig":
+                    q = parse_qs(u.query)
+                    key = q.get("key", [""])[0]
+                    value = q.get("value", [""])[0]
+                    if outer.reconfig is None:
+                        self._send(404, json.dumps(
+                            {"error": "no reconfiguration handler"}))
+                    elif not key:
+                        self._send(400, json.dumps(
+                            {"error": "need ?key=<k>&value=<v>"}))
+                    else:
+                        try:
+                            self._send(200, json.dumps(
+                                outer.reconfig.reconfigure(key, value),
+                                default=str))
+                        except (KeyError, ValueError) as e:
+                            self._send(400, json.dumps({"error": str(e)}))
                 else:
                     self._send(404, json.dumps({"error": "not found",
                                                 "endpoints": [
                                                     "/prom", "/metrics",
                                                     "/status", "/conf",
-                                                    "/logLevel"]}))
+                                                    "/logLevel", "/prof",
+                                                    "/stacks",
+                                                    "/reconfig",
+                                                    "/reconfig/properties",
+                                                ]}))
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.port = self._httpd.server_port

@@ -1,0 +1,169 @@
+"""Profiler endpoint, thread dump, live reconfiguration, event watcher,
+and the Recon UI page.
+
+Mirrors the reference's auxiliary observability surface: ProfileServlet
+(flamegraph sampling), /stacks, ReconfigureProtocol (live key updates
+without restart), EventWatcher lease/retry semantics, and the Recon web
+UI served from the observability service.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ozone_tpu.net.daemons import ScmOmDaemon
+from ozone_tpu.utils.events import EventQueue, EventWatcher
+from ozone_tpu.utils.http_server import sample_stacks, thread_dump
+
+
+# ----------------------------------------------------------- profiler
+def test_sample_stacks_sees_worker_thread():
+    stop = threading.Event()
+
+    def spin_about():
+        while not stop.is_set():
+            time.sleep(0.001)
+
+    t = threading.Thread(target=spin_about, name="prof-victim")
+    t.start()
+    try:
+        out = sample_stacks(duration_s=0.3, interval_s=0.01)
+    finally:
+        stop.set()
+        t.join()
+    # collapsed flamegraph lines: "frame;frame count"
+    assert "spin_about" in out
+    line = next(ln for ln in out.splitlines() if "spin_about" in ln)
+    assert line.rsplit(" ", 1)[1].isdigit()
+    assert ";" in line
+
+
+def test_thread_dump_lists_threads():
+    out = thread_dump()
+    assert "Thread " in out
+    assert "MainThread" in out
+
+
+# ----------------------------------------------------------- event watcher
+def test_event_watcher_completion_and_lease_retry():
+    q = EventQueue()
+    started: list = []
+    timed_out: list = []
+    q.subscribe("cmd", started.append)
+    w = EventWatcher(q, "cmd", "cmd-done", lease_timeout_s=0.05,
+                     on_timeout=timed_out.append, max_retries=2)
+    # completion before the lease expires -> no retries
+    w.watch("a", {"id": "a"})
+    assert started == [{"id": "a"}]
+    q.publish("cmd-done", "a")
+    assert w.pending_count() == 0
+    assert w.check_leases() == []
+    assert started == [{"id": "a"}]
+
+    # no completion: re-published max_retries times, then dropped with hook
+    w.watch("b", {"id": "b"})
+    for i in range(2):
+        time.sleep(0.06)
+        assert w.check_leases() == []
+        assert len(started) == 2 + i + 1  # retry republished
+    time.sleep(0.06)
+    assert w.check_leases() == ["b"]
+    assert timed_out == [{"id": "b"}]
+    assert w.pending_count() == 0
+
+
+def test_event_watcher_rewatch_during_expiry_keeps_fresh_lease():
+    """A completion + fresh watch of the same id landing between lease
+    collection and expiry action must leave the new lease untouched:
+    no spurious timeout, no stale retry-count overwrite."""
+    q = EventQueue()
+    timed_out: list = []
+    w = EventWatcher(q, "cmd", "cmd-done", lease_timeout_s=0.01,
+                     on_timeout=timed_out.append, max_retries=0)
+    w.watch("x", {"gen": 1})
+    time.sleep(0.02)  # let the lease expire
+    # simulate the race: completion + re-watch land before check_leases
+    # acts on its expired-lease snapshot
+    q.publish("cmd-done", "x")
+    w.watch("x", {"gen": 2})
+    assert w.check_leases() == []  # fresh lease: not expired, not touched
+    assert timed_out == []
+    assert w.pending_count() == 1
+    # and the surviving lease is the new one: expiring it reports gen 2
+    time.sleep(0.02)
+    assert w.check_leases() == ["x"]
+    assert timed_out == [{"gen": 2}]
+
+
+# ----------------------------------------------------------- http extras
+@pytest.fixture
+def daemon(tmp_path):
+    d = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1000.0,
+                    dead_after_s=2000.0, http_port=0)
+    d.start()
+    yield d
+    d.stop()
+
+
+def _get(addr, path):
+    return urllib.request.urlopen(f"http://{addr}{path}", timeout=10)
+
+
+def test_live_reconfiguration_over_http(daemon):
+    addr = daemon.http.address
+    props = json.load(_get(addr, "/reconfig/properties"))
+    keys = {p["key"] for p in props}
+    assert "ozone.scm.stale.node.interval" in keys
+    assert "ozone.om.block.size" in keys
+
+    # change a live value, no restart
+    r = json.load(_get(
+        addr, "/reconfig?key=ozone.scm.stale.node.interval&value=123.5"))
+    assert r["new"] == 123.5
+    assert daemon.scm.nodes.stale_after == 123.5
+    json.load(_get(addr, "/reconfig?key=ozone.om.block.size&value=65536"))
+    assert daemon.om.block_size == 65536
+
+    # unknown key rejected
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(addr, "/reconfig?key=not.a.key&value=1")
+    assert ei.value.code == 400
+
+
+def test_prof_and_stacks_endpoints(daemon):
+    addr = daemon.http.address
+    out = _get(addr, "/prof?duration=0.2&interval=0.02").read().decode()
+    assert out == "" or all(
+        ln.rsplit(" ", 1)[1].isdigit() for ln in out.splitlines())
+    dump = _get(addr, "/stacks").read().decode()
+    assert "Thread " in dump
+
+
+def test_recon_ui_served(tmp_path):
+    from ozone_tpu.recon.recon import ReconServer
+    from ozone_tpu.scm.scm import StorageContainerManager
+    from ozone_tpu.om.om import OzoneManager
+
+    scm = StorageContainerManager(stale_after_s=1e6, dead_after_s=2e6)
+    for i in range(3):
+        scm.register_datanode(f"dn{i}")
+        scm.heartbeat(f"dn{i}", container_report=[])
+    om = OzoneManager(tmp_path / "om.db", scm)
+    srv = ReconServer(om, scm)
+    srv.start()
+    try:
+        html = _get(srv.address, "/").read().decode()
+        assert "Recon" in html and "viz-root" in html
+        # status uses icon + label, never color alone
+        assert "badge" in html
+        # the APIs the page fetches exist
+        s = json.load(_get(srv.address, "/api/summary"))
+        assert len(s["nodes"]) == 3
+        json.load(_get(srv.address, "/api/filesizes"))
+    finally:
+        srv.stop()
+        om.close()
