@@ -94,6 +94,14 @@ class DatanodeClientFactory:
         self._addresses[dn_id] = address
         self._remote.pop(dn_id, None)  # reconnect on next use
 
+    def update_remote(self, dn_id: str, address: str) -> None:
+        """Refresh a remote address if it changed (daemon restarts bind
+        new ports; stale channels must be dropped, locals left alone)."""
+        if dn_id in self._local:
+            return
+        if self._addresses.get(dn_id) != address:
+            self.register_remote(dn_id, address)
+
     def get(self, dn_id: str) -> DatanodeClient:
         c = self.maybe_get(dn_id)
         if c is None:
@@ -102,6 +110,11 @@ class DatanodeClientFactory:
 
     def known_ids(self) -> list[str]:
         return sorted(set(self._local) | set(self._addresses))
+
+    def remote_address(self, dn_id: str) -> Optional[str]:
+        """Registered RpcServer address of a remote datanode (the ratis
+        client factory resolves peers off this same address book)."""
+        return self._addresses.get(dn_id)
 
     def maybe_get(self, dn_id: str) -> Optional[DatanodeClient]:
         c = self._local.get(dn_id)

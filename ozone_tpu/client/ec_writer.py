@@ -63,7 +63,29 @@ class BlockGroup:
             "length": self.length,
             "nodes": self.pipeline.nodes,
             "replication": str(self.pipeline.replication),
+            # the pipeline's cluster-wide identity must survive the wire:
+            # the datanode raft group is named by it (storage/ratis.py
+            # group_id), so a client-side re-numbered Pipeline would
+            # address a nonexistent group
+            "pipeline_id": self.pipeline.id,
         }
+
+    @classmethod
+    def from_json(cls, g: dict) -> "BlockGroup":
+        from ozone_tpu.scm.pipeline import ReplicationConfig
+
+        kw = {}
+        if g.get("pipeline_id") is not None:
+            kw["id"] = int(g["pipeline_id"])
+        return cls(
+            container_id=g["container_id"],
+            local_id=g["local_id"],
+            pipeline=Pipeline(
+                ReplicationConfig.parse(g["replication"]),
+                list(g["nodes"]), **kw,
+            ),
+            length=g.get("length", 0),
+        )
 
 
 class StripeWriteError(Exception):

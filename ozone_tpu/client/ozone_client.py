@@ -119,6 +119,21 @@ class OzoneBucket:
                 checksum=ChecksumType(session.checksum_type),
                 bytes_per_checksum=session.bytes_per_checksum,
             )
+        if (
+            session.replication.type is ReplicationType.RATIS
+            and session.replication.factor > 1
+            and self.client.ratis_clients is not None
+        ):
+            from ozone_tpu.client.ratis_client import RatisKeyWriter
+
+            return RatisKeyWriter(
+                allocate,
+                self.client.clients,
+                self.client.ratis_clients,
+                block_size=om.block_size,
+                checksum=ChecksumType(session.checksum_type),
+                bytes_per_checksum=session.bytes_per_checksum,
+            )
         return ReplicatedKeyWriter(
             allocate,
             self.client.clients,
@@ -199,9 +214,14 @@ class OzoneVolume:
 class OzoneClient:
     """Entry point (ObjectStore analog)."""
 
-    def __init__(self, om: OzoneManager, clients: DatanodeClientFactory):
+    def __init__(self, om: OzoneManager, clients: DatanodeClientFactory,
+                 ratis_clients=None):
         self.om = om
         self.clients = clients
+        #: optional net/ratis_service.RatisClientFactory: when present,
+        #: RATIS/3 writes are ordered through the pipeline raft ring
+        #: (XceiverClientRatis path) instead of plain client fan-out
+        self.ratis_clients = ratis_clients
 
     def create_volume(self, volume: str) -> OzoneVolume:
         self.om.create_volume(volume)

@@ -78,15 +78,25 @@ class ReplicatedKeyWriter:
         if self._group is None:
             self._group = self.allocate_group(list(self._excluded))
             self._chunks = []
-            for dn_id in self._group.pipeline.nodes:
-                try:
-                    self.clients.get(dn_id).create_container(
-                        self._group.container_id
-                    )
-                except StorageError as e:
-                    if e.code != "CONTAINER_EXISTS":
-                        raise
+            self._create_containers(self._group)
         return self._group
+
+    def _create_containers(self, group: BlockGroup) -> None:
+        """Open the block's container on every member (overridden by the
+        Raft path to order the create through the pipeline leader)."""
+        for dn_id in group.pipeline.nodes:
+            try:
+                self.clients.get(dn_id).create_container(group.container_id)
+            except StorageError as e:
+                if e.code != "CONTAINER_EXISTS":
+                    raise
+
+    def _commit_chunk(self, group: BlockGroup, info: ChunkInfo) -> None:
+        """Commit point after the chunk bytes reached every member: plain
+        fan-out putBlock here; the Raft path orders this via the leader."""
+        bd = BlockData(group.block_id, [*self._chunks, info])
+        for dn_id in group.pipeline.nodes:
+            self.clients.get(dn_id).put_block(bd)
 
     def _flush_chunk(self) -> None:
         if self._buf_fill == 0:
@@ -112,18 +122,28 @@ class ReplicatedKeyWriter:
                 except (StorageError, KeyError, OSError) as e:
                     failed.append(dn_id)
                     err = e
-            if not failed:
-                self._chunks.append(info)
-                group.length += data.size
-                bd = BlockData(group.block_id, list(self._chunks))
-                for dn_id in group.pipeline.nodes:
-                    self.clients.get(dn_id).put_block(bd)
-                return
-            log.warning("chunk write failed on %s: %s", failed, err)
+            if self._data_phase_ok(group, failed):
+                try:
+                    self._commit_chunk(group, info)
+                    self._chunks.append(info)
+                    group.length += data.size
+                    return
+                except (StorageError, KeyError, OSError) as e:
+                    err = e
+                    failed = []  # commit failure: no node to exclude
+            log.warning("chunk write failed on %s: %s", failed or "commit",
+                        err)
             self._excluded.extend(failed)
             self._finalize_group()
             if attempt == self.max_retries:
                 raise StorageError("IO_EXCEPTION", f"write failed: {err}")
+
+    def _data_phase_ok(self, group: BlockGroup, failed: list[str]) -> bool:
+        """Whether the chunk fan-out suffices to commit. Plain replication
+        needs every member; the Raft path overrides to a quorum (a dead
+        minority member misses the data, fails its apply when it returns,
+        and is repaired by the replication manager)."""
+        return not failed
 
     def _finalize_group(self) -> None:
         if self._group is not None and self._group.length > 0:

@@ -242,6 +242,9 @@ class RaftNode:
         self.last_applied = self.storage.snapshot_index
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
+        #: leader-side view of each follower's apply watermark (reported
+        #: in append_entries responses) — feeds watchForCommit(ALL)
+        self.applied_index: dict[str, int] = {}
         # results are retained only for indexes with a registered waiter
         # (a blocked propose()) — otherwise apply results would accumulate
         # unboundedly over a long leadership
@@ -479,6 +482,9 @@ class RaftNode:
             if resp.get("success"):
                 self.match_index[pid] = prev + len(entries)
                 self.next_index[pid] = self.match_index[pid] + 1
+                self.applied_index[pid] = max(
+                    self.applied_index.get(pid, 0),
+                    resp.get("applied", 0))
             else:
                 # conflict: back up (use the follower's hint when present)
                 hint = resp.get("conflict_index")
@@ -583,7 +589,8 @@ class RaftNode:
                 self.commit_index = min(req["leader_commit"],
                                         self.storage.last_index)
                 self._apply_committed()
-            return {"term": self.storage.term, "success": True}
+            return {"term": self.storage.term, "success": True,
+                    "applied": self.last_applied}
 
     def handle_install_snapshot(self, req: dict) -> dict:
         with self._lock:

@@ -53,6 +53,19 @@ class DatanodeDaemon:
         self.dn = Datanode(Path(root), dn_id=dn_id)
         self.server = RpcServer(host, port)
         self.service = DatanodeGrpcService(self.dn, self.server)
+        # datanode raft pipelines (XceiverServerRatis analog): raft RPCs
+        # and the client Submit/Watch surface ride the same RpcServer
+        from ozone_tpu.net.raft_transport import RaftRpcService
+        from ozone_tpu.net.ratis_service import RatisGrpcService
+        from ozone_tpu.storage.ratis import RatisXceiverServer
+
+        self.raft_rpc = RaftRpcService(self.server)
+        self.xceiver_ratis = RatisXceiverServer(
+            self.dn, Path(root), self.server.address,
+            rpc_service=self.raft_rpc,
+        )
+        self.ratis_service = RatisGrpcService(self.xceiver_ratis, self.server)
+        self._groups_file = Path(root) / "ratis" / "groups.json"
         from ozone_tpu.utils.insight import InsightService
 
         self.insight = InsightService(self.server, f"datanode:{dn_id}")
@@ -73,11 +86,70 @@ class DatanodeDaemon:
 
     def start(self) -> None:
         self.server.start()
+        self._rejoin_pipelines()
         self.scm.register(self.dn.id, self.address, rack=self.rack)
         self._hb = threading.Thread(
             target=self._heartbeat_loop, name=f"hb-{self.dn.id}", daemon=True
         )
         self._hb.start()
+
+    def _rejoin_pipelines(self) -> None:
+        """Re-open raft groups this node served before a restart (the
+        reference reloads its RaftGroups from the ratis storage dirs)."""
+        import json
+
+        if not self._groups_file.exists():
+            return
+        try:
+            groups = json.loads(self._groups_file.read_text())
+        except ValueError:
+            log.exception("%s: corrupt %s", self.dn.id, self._groups_file)
+            return
+        for g in groups.values():
+            try:
+                self.xceiver_ratis.join(int(g["pipeline_id"]), g["peers"])
+            except Exception:
+                log.exception("%s: rejoin pipeline %s failed",
+                              self.dn.id, g.get("pipeline_id"))
+
+    def _join_pipeline(self, cmd: dict) -> None:
+        import json
+
+        pid = int(cmd["pipeline_id"])
+        peers = dict(cmd["peers"])
+        self.xceiver_ratis.join(pid, peers)
+        self._groups_file.parent.mkdir(parents=True, exist_ok=True)
+        groups = {}
+        if self._groups_file.exists():
+            try:
+                groups = json.loads(self._groups_file.read_text())
+            except ValueError:
+                groups = {}
+        groups[str(pid)] = {"pipeline_id": pid, "peers": peers}
+        tmp = self._groups_file.with_suffix(".tmp")
+        tmp.write_text(json.dumps(groups))
+        tmp.replace(self._groups_file)
+
+    def _leave_pipeline(self, pid: int) -> None:
+        """Retire a closed pipeline's raft group: stop the node, drop it
+        from the rejoin record, delete its log (container data stays)."""
+        import json
+        import shutil
+
+        self.xceiver_ratis.leave(pid)
+        if self._groups_file.exists():
+            try:
+                groups = json.loads(self._groups_file.read_text())
+            except ValueError:
+                groups = {}
+            if groups.pop(str(pid), None) is not None:
+                tmp = self._groups_file.with_suffix(".tmp")
+                tmp.write_text(json.dumps(groups))
+                tmp.replace(self._groups_file)
+        shutil.rmtree(
+            self._groups_file.parent / self.xceiver_ratis.group_id(pid),
+            ignore_errors=True,
+        )
 
     def heartbeat_once(self) -> None:
         report = self.dn.container_report()
@@ -123,6 +195,10 @@ class DatanodeDaemon:
                 self._replicate(cmd)
             elif isinstance(cmd, dict) and cmd.get("type") == "register":
                 self.scm.register(self.dn.id, self.address, rack=self.rack)
+            elif isinstance(cmd, dict) and cmd.get("type") == "join-pipeline":
+                self._join_pipeline(cmd)
+            elif isinstance(cmd, dict) and cmd.get("type") == "leave-pipeline":
+                self._leave_pipeline(int(cmd["pipeline_id"]))
             else:
                 log.debug("%s ignoring command %r", self.dn.id, cmd)
         except Exception:
@@ -150,6 +226,7 @@ class DatanodeDaemon:
         self._stop.set()
         if self._hb:
             self._hb.join(timeout=5)
+        self.xceiver_ratis.stop()
         self.server.stop()
         self.scm.close()
         self.dn.close()
@@ -182,6 +259,46 @@ class ScmOmDaemon:
         )
         self.server = RpcServer(host, port)
         self.scm_service = ScmGrpcService(self.scm, self.server)
+        # RatisPipelineProvider analog: a freshly placed RATIS pipeline is
+        # announced to its members so each opens the raft group (command
+        # rides the next heartbeat response; the client's leader-retry
+        # loop covers the one-heartbeat join latency)
+        from ozone_tpu.scm.pipeline import ReplicationType
+
+        def _announce_pipeline(p):
+            if p.replication.type is not ReplicationType.RATIS \
+                    or p.replication.factor < 2:
+                return
+            peers = {
+                dn: self.scm_service.addresses.get(dn, "")
+                for dn in p.nodes
+            }
+            for dn in p.nodes:
+                self.scm.nodes.queue_command(dn, {
+                    "type": "join-pipeline",
+                    "pipeline_id": p.id,
+                    "peers": peers,
+                })
+
+        self.scm.containers.on_pipeline_created = _announce_pipeline
+
+        def _retire_pipeline(p):
+            if p.replication.type is not ReplicationType.RATIS \
+                    or p.replication.factor < 2:
+                return
+            for dn in p.nodes:
+                self.scm.nodes.queue_command(dn, {
+                    "type": "leave-pipeline", "pipeline_id": p.id,
+                })
+
+        self.scm.containers.on_pipeline_closed = _retire_pipeline
+
+        def _reannounce_pipelines_of(dn_id):
+            for p in self.scm.containers.pipelines():
+                if dn_id in p.nodes:
+                    _announce_pipeline(p)
+
+        self.scm_service.on_register = _reannounce_pipelines_of
         self.om = OzoneManager(Path(om_db), self.scm, block_size=block_size)
         self.om_service = OmGrpcService(
             self.om, self.server,

@@ -17,7 +17,7 @@ from ozone_tpu.net import wire
 from ozone_tpu.net.rpc import RpcChannel, RpcServer
 from ozone_tpu.om.om import OzoneManager
 from ozone_tpu.om.requests import OMError
-from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+from ozone_tpu.scm.pipeline import ReplicationConfig
 from ozone_tpu.storage.ids import StorageError
 
 SERVICE = "ozone.tpu.OmService"
@@ -280,20 +280,7 @@ class OmGrpcService:
 
     @staticmethod
     def _groups_from(groups: list[dict]) -> list[BlockGroup]:
-        out = []
-        for g in groups:
-            out.append(
-                BlockGroup(
-                    container_id=g["container_id"],
-                    local_id=g["local_id"],
-                    pipeline=Pipeline(
-                        ReplicationConfig.parse(g["replication"]),
-                        list(g["nodes"]),
-                    ),
-                    length=g["length"],
-                )
-            )
-        return out
+        return [BlockGroup.from_json(g) for g in groups]
 
 
 class RemoteOpenKeySession:
@@ -387,15 +374,8 @@ class GrpcOmClient:
         g = m["group"]
         if self.clients is not None:
             for dn_id, addr in m.get("addresses", {}).items():
-                if self.clients.maybe_get(dn_id) is None:
-                    self.clients.register_remote(dn_id, addr)
-        return BlockGroup(
-            container_id=g["container_id"],
-            local_id=g["local_id"],
-            pipeline=Pipeline(
-                ReplicationConfig.parse(g["replication"]), list(g["nodes"])
-            ),
-        )
+                self.clients.update_remote(dn_id, addr)
+        return BlockGroup.from_json(g)
 
     def commit_key(self, session, groups, size):
         self._call(
@@ -417,19 +397,7 @@ class GrpcOmClient:
         ]
 
     def key_block_groups(self, info):
-        out = []
-        for g in info["block_groups"]:
-            out.append(
-                BlockGroup(
-                    container_id=g["container_id"],
-                    local_id=g["local_id"],
-                    pipeline=Pipeline(
-                        ReplicationConfig.parse(g["replication"]),
-                        list(g["nodes"]),
-                    ),
-                    length=g["length"],
-                )
-            )
+        out = [BlockGroup.from_json(g) for g in info["block_groups"]]
         return out
 
     def list_keys(self, volume, bucket, prefix=""):

@@ -91,6 +91,9 @@ class ScmGrpcService:
     def __init__(self, scm: StorageContainerManager, server: RpcServer):
         self.scm = scm
         self.addresses: dict[str, str] = {}
+        #: optional hook fired when a node (re)registers with a new
+        #: address (daemon wires pipeline re-announcement through it)
+        self.on_register = None
         server.add_service(
             SERVICE,
             {
@@ -105,11 +108,16 @@ class ScmGrpcService:
 
     def _register(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        changed = self.addresses.get(m["dn_id"]) != m["address"]
         self.addresses[m["dn_id"]] = m["address"]
         self.scm.register_datanode(
             m["dn_id"], m.get("rack", "/default-rack"),
             m.get("capacity_bytes", 0),
         )
+        if changed and self.on_register is not None:
+            # a restarted node binds a new port: peers holding the old
+            # address (e.g. its pipelines' raft transports) are refreshed
+            self.on_register(m["dn_id"])
         return wire.pack({})
 
     def _heartbeat(self, req: bytes) -> bytes:

@@ -29,7 +29,7 @@ from ozone_tpu.om.metadata import (
     key_key,
     volume_key,
 )
-from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+from ozone_tpu.scm.pipeline import ReplicationConfig
 from ozone_tpu.scm.scm import StorageContainerManager
 from ozone_tpu.storage.ids import StorageError
 from ozone_tpu.utils.audit import AuditLogger
@@ -206,6 +206,13 @@ class OzoneManager:
             with self._lock:
                 try:
                     result = request.apply(self.store)
+                    # durable before ack: the reference's double buffer
+                    # completes client futures only after the RocksDB
+                    # batch lands (OzoneManagerDoubleBuffer
+                    # .flushTransactions:293) — an acked mutation must
+                    # survive a crash. Requests batch their own puts, so
+                    # this is one WAL commit per write request.
+                    self.store.flush()
                 except rq.OMError as e:
                     self.audit.log(request.audit_action, vars(request),
                                    ok=False, error=e.code)
@@ -343,15 +350,7 @@ class OzoneManager:
         """Materialize BlockGroup objects (with pipelines) from key info."""
         out = []
         for g in info["block_groups"]:
-            repl = ReplicationConfig.parse(g["replication"])
-            out.append(
-                BlockGroup(
-                    container_id=g["container_id"],
-                    local_id=g["local_id"],
-                    pipeline=Pipeline(repl, list(g["nodes"])),
-                    length=g["length"],
-                )
-            )
+            out.append(BlockGroup.from_json(g))
         return out
 
     def list_keys(self, volume: str, bucket: str, prefix: str = "") -> list[dict]:

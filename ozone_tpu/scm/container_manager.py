@@ -11,7 +11,7 @@ WritableRatisContainerProvider for replicated pipelines).
 
 from __future__ import annotations
 
-import itertools
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Optional
@@ -26,6 +26,8 @@ from ozone_tpu.scm.pipeline import (
     ReplicationType,
 )
 from ozone_tpu.storage.ids import ContainerState
+
+log = logging.getLogger(__name__)
 
 
 @dataclass
@@ -80,6 +82,12 @@ class ContainerManager:
         # nondeterministic placement computation — server-scm ha/
         # SCMHAInvocationHandler + SCMRatisRequest).
         self.mutation_listener = None
+        # pipeline lifecycle hooks (RatisPipelineProvider / PipelineManager
+        # close analog): the daemon wires these to issue join-pipeline /
+        # leave-pipeline commands so member datanodes open and prune the
+        # pipeline's raft group
+        self.on_pipeline_created = None
+        self.on_pipeline_closed = None
         # optional persistence (reference: SCM metadata in RocksDB with
         # HA-safe SequenceIdGenerator; replicas rebuild from reports)
         self._db = None
@@ -89,11 +97,26 @@ class ContainerManager:
             self._db = ScmStore(db_path)
             self._recover()
 
+    @staticmethod
+    def _pipeline_from_row(row: dict) -> Pipeline:
+        """Rebuild a persisted pipeline keeping its cluster-assigned id
+        (datanode raft groups are named by it) and keep the allocator
+        ahead of every restored id so new pipelines never collide."""
+        from ozone_tpu.scm.pipeline import _pipeline_ids
+
+        repl = ReplicationConfig.parse(row["replication"])
+        kw = {}
+        if row.get("pipeline_id") is not None:
+            kw["id"] = int(row["pipeline_id"])
+        p = Pipeline(repl, list(row["nodes"]), **kw)
+        _pipeline_ids.advance_past(p.id)
+        return p
+
     def _recover(self) -> None:
         state = self._db.load()
         for c in state["containers"]:
             repl = ReplicationConfig.parse(c["replication"])
-            pipe = Pipeline(repl, list(c["nodes"]))
+            pipe = self._pipeline_from_row(c)
             self._pipelines[pipe.id] = pipe
             info = ContainerInfo(
                 c["id"], repl, pipe,
@@ -111,6 +134,7 @@ class ContainerManager:
             "id": c.id,
             "replication": str(c.replication),
             "nodes": c.pipeline.nodes if c.pipeline else [],
+            "pipeline_id": c.pipeline.id if c.pipeline else None,
             "state": c.state.value,
             "used_bytes": c.used_bytes,
         }
@@ -131,7 +155,7 @@ class ContainerManager:
             c = self._containers.get(int(row["id"]))
             if c is None:
                 repl = ReplicationConfig.parse(row["replication"])
-                pipe = Pipeline(repl, list(row["nodes"]))
+                pipe = self._pipeline_from_row(row)
                 self._pipelines[pipe.id] = pipe
                 c = ContainerInfo(int(row["id"]), repl, pipe)
                 self._containers[c.id] = c
@@ -201,6 +225,11 @@ class ContainerManager:
         chosen = self.placement.choose(replication.required_nodes, excluded)
         p = Pipeline(replication, [n.dn_id for n in chosen])
         self._pipelines[p.id] = p
+        if self.on_pipeline_created is not None:
+            try:
+                self.on_pipeline_created(p)
+            except Exception:  # noqa: BLE001 - allocation must not fail
+                log.exception("pipeline-created hook failed for %s", p.id)
         return p
 
     def _allocate_container(
@@ -260,21 +289,38 @@ class ContainerManager:
             )
 
     # --------------------------------------------------------------- lifecycle
+    def _close_pipeline(self, c: ContainerInfo) -> None:
+        """A container leaving OPEN retires its (1:1) pipeline: writes
+        stop, members may drop the raft group (reads never needed it)."""
+        p = c.pipeline
+        if p is None or p.state is PipelineState.CLOSED:
+            return
+        p.state = PipelineState.CLOSED
+        self._pipelines.pop(p.id, None)
+        if self.on_pipeline_closed is not None:
+            try:
+                self.on_pipeline_closed(p)
+            except Exception:  # noqa: BLE001 - lifecycle must not fail
+                log.exception("pipeline-closed hook failed for %s", p.id)
+
     def finalize_container(self, container_id: int) -> None:
         c = self._containers[container_id]
         if c.state is ContainerState.OPEN:
             c.state = ContainerState.CLOSING
             self._persist(c)
+            self._close_pipeline(c)
 
     def mark_closed(self, container_id: int) -> None:
         c = self._containers[container_id]
         c.state = ContainerState.CLOSED
         self._persist(c)
+        self._close_pipeline(c)
 
     def delete_container(self, container_id: int) -> None:
         c = self._containers[container_id]
         c.state = ContainerState.DELETED
         self._persist(c)
+        self._close_pipeline(c)
 
     # --------------------------------------------------------------- reports
     def process_container_report(self, dn_id: str, report: list[dict]) -> None:

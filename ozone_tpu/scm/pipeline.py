@@ -9,7 +9,7 @@ bound to a replica index 1..d+p — ECPipelineProvider.java:45).
 
 from __future__ import annotations
 
-import itertools
+import threading
 from dataclasses import dataclass, field
 from enum import Enum
 from typing import Optional
@@ -75,7 +75,26 @@ class PipelineState(Enum):
     CLOSED = "CLOSED"
 
 
-_pipeline_ids = itertools.count(1)
+class _PipelineIdAllocator:
+    """Monotonic pipeline-id source that can be advanced past persisted
+    ids on recovery — a regenerated id colliding with one a datanode
+    still serves a raft group under would silently mis-address writes."""
+
+    def __init__(self):
+        self._last = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._last += 1
+            return self._last
+
+    def advance_past(self, pipeline_id: int) -> None:
+        with self._lock:
+            self._last = max(self._last, int(pipeline_id))
+
+
+_pipeline_ids = _PipelineIdAllocator()
 
 
 @dataclass
@@ -88,7 +107,7 @@ class Pipeline:
 
     replication: ReplicationConfig
     nodes: list[str]  # datanode ids, ordered
-    id: int = field(default_factory=lambda: next(_pipeline_ids))
+    id: int = field(default_factory=_pipeline_ids.next)
     state: PipelineState = PipelineState.OPEN
 
     def __post_init__(self):

@@ -19,7 +19,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -42,7 +42,29 @@ def _client(args):
             clients.register_remote(dn_id, addr)
     except Exception:
         pass
-    return OzoneClient(om, clients)
+    from ozone_tpu.net.ratis_service import RatisClientFactory
+
+    ratis = RatisClientFactory(address_source=clients.remote_address)
+    return OzoneClient(om, clients, ratis_clients=ratis)
+
+
+def _serve(stop_fn) -> int:
+    """Run a daemon until SIGTERM/SIGINT, then shut it down cleanly —
+    a TERM'd daemon must flush buffered state (OM double buffer) before
+    the process dies."""
+    import signal
+
+    done = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: done.set())
+    try:
+        while not done.wait(3600):
+            pass
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop_fn()
+    return 0
 
 
 def _parse_path(path: str) -> list[str]:
@@ -257,12 +279,7 @@ def cmd_datanode(args) -> int:
     )
     d.start()
     print(f"datanode {dn_id} serving on {d.address}, scm={args.scm}")
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        d.stop()
-    return 0
+    return _serve(d.stop)
 
 
 def cmd_scm_om(args) -> int:
@@ -279,12 +296,7 @@ def cmd_scm_om(args) -> int:
     print(f"scm+om serving on {d.address}"
           + (f", http on {d.http.address}" if d.http else "")
           + (f", recon on {d.recon.address}" if d.recon else ""))
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        d.stop()
-    return 0
+    return _serve(d.stop)
 
 
 def cmd_s3g(args) -> int:
@@ -300,12 +312,7 @@ def cmd_s3g(args) -> int:
                    require_auth=args.require_auth)
     gw.start()
     print(f"s3 gateway serving on {gw.address}, om={args.om}")
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        gw.stop()
-    return 0
+    return _serve(gw.stop)
 
 
 def cmd_httpfs(args) -> int:
@@ -320,12 +327,7 @@ def cmd_httpfs(args) -> int:
                        replication=args.replication)
     gw.start()
     print(f"httpfs gateway serving on {gw.address}, om={args.om}")
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        gw.stop()
-    return 0
+    return _serve(gw.stop)
 
 
 def cmd_csi(args) -> int:
@@ -340,12 +342,7 @@ def cmd_csi(args) -> int:
                     port=args.port, replication=args.replication)
     srv.start()
     print(f"csi driver serving on {srv.address}, om={args.om}")
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        srv.stop()
-    return 0
+    return _serve(srv.stop)
 
 
 def cmd_s3(args) -> int:
