@@ -78,6 +78,7 @@ class XceiverClientRatis:
                     elif e.code in non_retriable:
                         raise
                     elif e.code not in ("TIMEOUT", "IO_EXCEPTION",
+                                        "UNAVAILABLE",
                                         "NO_SUCH_RAFT_GROUP"):
                         raise  # deterministic application error
                 except (KeyError, OSError, ConnectionError) as e:
@@ -101,7 +102,7 @@ class XceiverClientRatis:
                                       policy="ALL", timeout=timeout),
                     non_retriable=("TIMEOUT",))
             except StorageError as e:
-                if e.code not in ("TIMEOUT", "IO_EXCEPTION"):
+                if e.code not in ("TIMEOUT", "IO_EXCEPTION", "UNAVAILABLE"):
                     raise
                 log.warning(
                     "watch(ALL) for index %d on pipeline %d degraded to "

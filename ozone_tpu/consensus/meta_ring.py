@@ -1,0 +1,127 @@
+"""One raft ring for the whole metadata process (OM + SCM state).
+
+The reference runs OM HA and SCM HA as two independent Ratis rings
+(ozone-manager om/ratis/OzoneManagerRatisServer.java:108; server-scm
+ha/SCMRatisServerImpl) because OM and SCM are separate processes. This
+framework co-locates them in one metadata daemon (net/daemons.ScmOmDaemon),
+so HA uses ONE ring replicating both: OM client requests ride the log as
+`{"om": <request json>}` entries (OzoneManagerStateMachine
+.applyTransaction:335 analog) and SCM container mutations ride as the
+leader's decision records (`@Replicate`/SCMRatisRequest analog, inherited
+from scm/ha.RaftSCM). A single ring means a single leader for both roles —
+no split-brain window where the OM leader's block allocations land on an
+SCM follower whose mutations nobody replicates.
+
+Request lifecycle (submit_om): leader-gated preExecute (block allocation —
+emits SCM decision records), propose the OM request, then ack only after
+BOTH the OM entry and every SCM record the call produced are
+quorum-committed. Followers apply the same entries in log order, so every
+replica's OM tables and SCM container state stay byte-identical.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+from ozone_tpu.consensus.raft import NotRaftLeaderError
+from ozone_tpu.om import requests as rq
+from ozone_tpu.om.om import OzoneManager
+from ozone_tpu.scm.ha import RaftSCM
+from ozone_tpu.scm.scm import StorageContainerManager
+
+log = logging.getLogger(__name__)
+
+
+class MetaHARing(RaftSCM):
+    """RaftSCM (decision-record replication, resync, ack tracking) plus
+    OM request replication on the same RaftNode."""
+
+    def __init__(self, om: OzoneManager, scm: StorageContainerManager,
+                 raft_dir: Path, node_id: str, peer_ids: list[str],
+                 transport=None, config=None, ack_timeout_s: float = 30.0):
+        self.om = om  # before super(): RaftNode restore may fire in init
+        # durable applied floor: restart replays the raft log from the
+        # snapshot point, but the OM sqlite may already hold the effects
+        # of entries flushed before the crash — re-applying those would
+        # duplicate non-idempotent effects (e.g. versioned CommitKeys).
+        # The floor rides the OM store's own batch, so it is exactly as
+        # current as the data it guards.
+        row = om.store.get("system", "raft_applied")
+        self._applied_floor = int(row["index"]) if row else 0
+        super().__init__(scm, raft_dir, node_id, peer_ids,
+                         transport=transport, config=config,
+                         ack_timeout_s=ack_timeout_s)
+        # the ring snapshots/restores the whole metadata process, not
+        # just SCM container state
+        self.node.snapshot_fn = self._snapshot_all
+        self.node.restore_fn = self._restore_all
+
+    # ------------------------------------------------------------- apply
+    def _apply(self, data: dict) -> Any:
+        # exact: _apply_committed holds the node lock and bumps
+        # last_applied right after this callback returns
+        idx = self.node.last_applied + 1
+        if idx <= self._applied_floor:
+            return None  # already durably applied before the restart
+        if "om" in data:
+            try:
+                result = rq.OMRequest.from_json(data["om"]).apply(
+                    self.om.store)
+            except rq.OMError as e:
+                result = e  # deterministic: replicas converge on the error
+        else:
+            result = super()._apply(data)
+        self._applied_floor = idx
+        self.om.store.put("system", "raft_applied", {"index": idx})
+        return result
+
+    def _snapshot_all(self) -> dict:
+        return {
+            "om": self.om.store.export_state(),
+            "scm": self.scm.containers.snapshot_state(),
+        }
+
+    def _restore_all(self, snap: dict) -> None:
+        if "om" in snap:
+            self.om.store.import_state(snap["om"])
+        if "scm" in snap:
+            self.scm.containers.install_snapshot(snap["scm"])
+
+    def _restore(self, snap: dict) -> None:
+        # RaftNode init / install_snapshot path: handle both the combined
+        # form and a bare SCM snapshot (pre-ring state)
+        if "om" in snap or "scm" in snap:
+            self._restore_all(snap)
+        else:
+            super()._restore(snap)
+
+    # ------------------------------------------------------------ serving
+    @property
+    def is_ready(self) -> bool:
+        """Leader with the current term's no-op applied — safe to serve
+        reads and run preExecute against local state."""
+        return self.node.is_ready_leader
+
+    def submit_om(self, request: rq.OMRequest) -> Any:
+        """OzoneManager.submit through the ring (the OzoneManagerRatis
+        Server.submitRequest analog). Audit/metrics stay with the caller
+        (the daemon patches om.submit to this)."""
+        if not self.node.is_ready_leader:
+            # not-yet-ready leaders bounce too: preExecute reads local
+            # state, which may lag the committed line until the no-op
+            # applies (clients retry through the failover proxy)
+            raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        request.pre_execute(self.om)
+        result = self.node.propose({"om": request.to_json()})
+        # block allocation in preExecute produced SCM decision records;
+        # the client ack covers them too
+        self._await_records()
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    @property
+    def leader_hint(self):
+        return self.node.leader_hint

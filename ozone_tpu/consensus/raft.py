@@ -251,11 +251,15 @@ class RaftNode:
         self._waiters: set[int] = set()
         self._results: dict[int, Any] = {}
         self.on_step_down = on_step_down
+        #: index of this term's no-op marker (set on winning an election)
+        self._leader_ready_index = 0
 
         self._lock = threading.RLock()
         self._commit_cv = threading.Condition(self._lock)
         self._stop = threading.Event()
-        self._last_heartbeat = time.monotonic()
+        # -inf: a node that has never heard a leader must not refuse
+        # pre-votes on "live leader contact" grounds
+        self._last_heartbeat = float("-inf")
         self._timer_thread: Optional[threading.Thread] = None
 
         # restore application state from the durable snapshot, then replay
@@ -297,12 +301,50 @@ class RaftNode:
             if role == LEADER:
                 self._broadcast_heartbeat()
             elif time.monotonic() >= self._election_deadline:
-                self._election_deadline = self._new_deadline()
                 self.start_election()
+                # re-randomize AFTER the (possibly slow) round: a vote
+                # RPC hanging on a dead peer would otherwise consume the
+                # whole jitter window and keep rival candidates in
+                # lockstep, splitting votes forever
+                self._election_deadline = self._new_deadline()
 
     # ----------------------------------------------------------- elections
     def start_election(self) -> bool:
-        """Run one candidate round; returns True if this node won."""
+        """Run one candidate round; returns True if this node won.
+
+        A pre-vote phase (Raft §9.6) runs first: the would-be candidate
+        probes electability at term+1 WITHOUT bumping its own term, so a
+        rejoining replica with a stale log (or one behind a live leader)
+        can never depose a healthy leader just by campaigning — the
+        disruptive-server problem the reference delegates to Ratis'
+        leader election with pre-vote."""
+        quorum = (len(self.peer_ids) + 1) // 2 + 1
+        # randomized contact order + early exit: reachable peers decide
+        # the election before any unreachable peer's RPC timeout is paid
+        order = list(self.peer_ids)
+        random.shuffle(order)
+        with self._lock:
+            probe_term = self.storage.term + 1
+            last_index = self.storage.last_index
+            last_term = self.storage.term_at(last_index) or 0
+        pre = 1
+        for pid in order:
+            if pre >= quorum:
+                break
+            try:
+                resp = self.transport.send(pid, "request_vote", {
+                    "term": probe_term,
+                    "candidate_id": self.node_id,
+                    "last_log_index": last_index,
+                    "last_log_term": last_term,
+                    "pre_vote": True,
+                })
+            except Exception:
+                continue
+            if resp.get("granted"):
+                pre += 1
+        if pre < quorum:
+            return False
         with self._lock:
             self.role = CANDIDATE
             self.storage.term += 1
@@ -312,7 +354,9 @@ class RaftNode:
             last_index = self.storage.last_index
             last_term = self.storage.term_at(last_index) or 0
         votes = 1
-        for pid in self.peer_ids:
+        for pid in order:
+            if votes >= quorum:
+                break
             try:
                 resp = self.transport.send(pid, "request_vote", {
                     "term": term,
@@ -328,7 +372,6 @@ class RaftNode:
                     return False
             if resp.get("granted"):
                 votes += 1
-        quorum = (len(self.peer_ids) + 1) // 2 + 1
         with self._lock:
             if self.role != CANDIDATE or self.storage.term != term:
                 return False
@@ -347,8 +390,9 @@ class RaftNode:
         log.info("raft %s: leader of term %d at index %d",
                  self.node_id, self.storage.term, self.storage.last_index)
         # replicate a no-op so the new leader can commit prior-term entries
-        # (Raft §5.4.2 / Ratis leader-ready marker)
-        self._propose_locked({"_noop": True})
+        # (Raft §5.4.2 / Ratis leader-ready marker); until it applies,
+        # this leader may not have applied everything already committed
+        self._leader_ready_index = self._propose_locked({"_noop": True})
 
     def _step_down(self, term: int) -> None:
         was_leader = self.role == LEADER
@@ -357,6 +401,10 @@ class RaftNode:
             self.storage.voted_for = None
             self.storage.persist_meta()
         self.role = FOLLOWER
+        if self.leader_hint == self.node_id:
+            # a deposed leader must not keep advertising itself —
+            # clients would pin to it and never find the real leader
+            self.leader_hint = None
         if was_leader and self.on_step_down is not None:
             # called with the node lock held: the callback must only set
             # flags / enqueue work, never call back into this node
@@ -526,10 +574,34 @@ class RaftNode:
                 self._results[idx] = result
         self._commit_cv.notify_all()
 
+    def _heard_from_leader_recently(self) -> bool:
+        """Sticky-leader check (timer mode only): a node in live contact
+        with a leader refuses to help depose it (Raft §4.2.3)."""
+        return (
+            self._timer_thread is not None
+            and self.role == FOLLOWER
+            and time.monotonic() - self._last_heartbeat
+            < self.config.election_timeout_s[0]
+        )
+
     # ----------------------------------------------------------- RPC handlers
     def handle_request_vote(self, req: dict) -> dict:
         with self._lock:
-            if req["term"] > self.storage.term:
+            if req.get("pre_vote"):
+                # advisory only: no term change, no vote persisted, no
+                # timer reset — just "would I vote for you?"
+                last_index = self.storage.last_index
+                last_term = self.storage.term_at(last_index) or 0
+                granted = (
+                    req["term"] >= self.storage.term
+                    and self.role != LEADER
+                    and not self._heard_from_leader_recently()
+                    and (req["last_log_term"], req["last_log_index"])
+                    >= (last_term, last_index)
+                )
+                return {"term": self.storage.term, "granted": granted}
+            if req["term"] > self.storage.term and \
+                    not self._heard_from_leader_recently():
                 self._step_down(req["term"])
             granted = False
             if req["term"] == self.storage.term and self.storage.voted_for \
@@ -662,6 +734,15 @@ class RaftNode:
     @property
     def is_leader(self) -> bool:
         return self.role == LEADER
+
+    @property
+    def is_ready_leader(self) -> bool:
+        """Leader AND caught up: the current term's no-op has applied, so
+        every entry committed in prior terms is reflected in local state.
+        Serving reads before this point would return stale data across a
+        failover (a freshly elected leader may lag the old commit line)."""
+        return self.role == LEADER and \
+            self.last_applied >= self._leader_ready_index
 
 
 class Transport:

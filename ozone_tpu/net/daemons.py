@@ -249,6 +249,8 @@ class ScmOmDaemon:
         http_port: int | None = None,
         recon_port: int | None = None,
         recon_interval_s: float = 30.0,
+        ha_id: str | None = None,
+        ha_peers: dict[str, str] | None = None,
     ):
         self.scm = StorageContainerManager(
             min_datanodes=min_datanodes,
@@ -304,6 +306,79 @@ class ScmOmDaemon:
             self.om, self.server,
             addresses_provider=lambda: dict(self.scm_service.addresses),
         )
+        # ---- metadata HA: one raft ring for OM + SCM state ----
+        # (the reference's OM-HA + SCM-HA Ratis rings; co-located here,
+        # so one ring and one leader for both roles)
+        self.ha = None
+        self._ha_peers = dict(ha_peers or {})
+        if ha_id is not None:
+            from ozone_tpu.consensus.meta_ring import MetaHARing
+            from ozone_tpu.consensus.raft import NotRaftLeaderError
+            from ozone_tpu.net.raft_transport import (
+                GrpcRaftTransport,
+                RaftRpcService,
+            )
+            from ozone_tpu.om import requests as _rq
+
+            raft_rpc = RaftRpcService(self.server)
+            transport = GrpcRaftTransport("meta-ha", self._ha_peers)
+            self.ha = MetaHARing(
+                self.om, self.scm, Path(om_db).parent / "meta-raft",
+                ha_id, list(self._ha_peers), transport=transport,
+            )
+            raft_rpc.register("meta-ha", self.ha.node)
+
+            om = self.om
+            audit = om.audit
+
+            def _ha_submit(request):
+                with om.metrics.timer(request.audit_action).time():
+                    try:
+                        result = self.ha.submit_om(request)
+                    except NotRaftLeaderError as e:
+                        raise StorageError(
+                            "OM_NOT_LEADER",
+                            self._leader_address(e.leader_hint))
+                    except _rq.OMError as e:
+                        audit.log(request.audit_action, vars(request),
+                                  ok=False, error=e.code)
+                        raise
+                    audit.log(request.audit_action, vars(request), ok=True)
+                    om.metrics.counter("write_ops").inc()
+                    return result
+
+            # route every OM write through the ring (OzoneManager methods
+            # all funnel into submit); reads are leader-gated at the
+            # service edge so clients get read-your-writes
+            self.om.submit = _ha_submit
+            self.om_service.gate = self._leader_gate
+
+            def _scm_barrier():
+                try:
+                    self.ha._await_records()
+                except NotRaftLeaderError as e:
+                    raise StorageError(
+                        "OM_NOT_LEADER",
+                        self._leader_address(e.leader_hint))
+
+            self.om_service.scm_barrier = _scm_barrier
+
+            def _scm_gate():
+                if not self.ha.is_ready:
+                    raise StorageError(
+                        "SCM_NOT_LEADER",
+                        self._leader_address(self.ha.leader_hint))
+
+            def _scm_side_barrier():
+                try:
+                    self.ha._await_records()
+                except NotRaftLeaderError as e:
+                    raise StorageError(
+                        "SCM_NOT_LEADER",
+                        self._leader_address(e.leader_hint))
+
+            self.scm_service.gate = _scm_gate
+            self.scm_service.barrier = _scm_side_barrier
         from ozone_tpu.utils.insight import InsightService
 
         self.insight = InsightService(self.server, "scm-om")
@@ -377,21 +452,56 @@ class ScmOmDaemon:
     def address(self) -> str:
         return self.server.address
 
+    def _leader_address(self, hint: str | None) -> str:
+        return self._ha_peers.get(hint or "", "")
+
+    def _leader_gate(self) -> None:
+        # ready-leader, not just leader: a freshly elected leader must
+        # apply the prior terms' committed entries (its no-op marker)
+        # before serving reads, or a failover client could read stale
+        # state it wrote through the previous leader
+        if self.ha is not None and not self.ha.is_ready:
+            raise StorageError(
+                "OM_NOT_LEADER",
+                self._leader_address(self.ha.leader_hint))
+
     def start(self) -> None:
         self.server.start()
         if self.http is not None:
             self.http.start()
         if self.recon is not None:
             self.recon.start()
-        self.scm.start_background(self._bg_interval)
+        if self.ha is not None:
+            self.ha.start()
+        else:
+            self.scm.start_background(self._bg_interval)
         # OM background services (reference service/: KeyDeletingService,
         # DirectoryDeletingService) — purge detached subtrees and hand
-        # deleted blocks to the SCM deletion chain
+        # deleted blocks to the SCM deletion chain. Under HA only the
+        # leader runs background mutators (the reference starts these
+        # services on the Ratis leader only); the SCM scan rides the same
+        # loop in HA mode so it obeys the same leadership gate.
         self._om_bg_stop = threading.Event()
 
         def _om_services():
             while not self._om_bg_stop.wait(self._bg_interval):
+                if self.ha is not None:
+                    # every replica compacts its own raft log behind a
+                    # full-state snapshot (ContainerStateMachine
+                    # .takeSnapshot cadence); without this the log and
+                    # the OM store's dirty cache grow without bound
+                    try:
+                        node = self.ha.node
+                        if node.last_applied - node.storage.snapshot_index \
+                                > 512:
+                            node.take_snapshot()
+                    except Exception:  # noqa: BLE001
+                        log.exception("raft log compaction failed")
+                if self.ha is not None and not self.ha.is_leader:
+                    continue
                 try:
+                    if self.ha is not None:
+                        self.scm.run_background_once()
                     self.om.run_dir_deleting_service_once()
                     self.om.run_key_deleting_service_once()
                     now = time.monotonic()
@@ -412,6 +522,8 @@ class ScmOmDaemon:
             # the background thread may be mid recon scan / OM purge;
             # it must finish the pass before the stores close under it
             self._om_bg.join(timeout=30.0)
+        if self.ha is not None:
+            self.ha.stop()
         if self.http is not None:
             self.http.stop()
         if self.recon is not None:

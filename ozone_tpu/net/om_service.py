@@ -30,9 +30,18 @@ class OmGrpcService:
         # callable returning the dn_id -> address book (from the co-located
         # SCM service or a remote SCM client)
         self.addresses_provider = addresses_provider or (lambda: {})
-        server.add_service(
-            SERVICE,
-            {
+        #: HA leader gate, set by the daemon: raises
+        #: StorageError("OM_NOT_LEADER", <leader address>) on followers so
+        #: clients fail over. Reads are leader-gated too — followers
+        #: apply committed entries asynchronously, so serving reads there
+        #: would break read-your-writes (the reference routes all OM
+        #: traffic to the Ratis leader the same way).
+        self.gate = None
+        #: HA barrier, set by the daemon: blocks until SCM decision
+        #: records produced by a direct allocation are quorum-committed
+        #: (the OM-request path gets this inside MetaHARing.submit_om)
+        self.scm_barrier = None
+        methods = {
                 "CreateVolume": self._wrap(lambda m: self.om.create_volume(m["volume"])),
                 "DeleteVolume": self._wrap(lambda m: self.om.delete_volume(m["volume"])),
                 "VolumeInfo": self._wrap(lambda m: self.om.volume_info(m["volume"])),
@@ -189,8 +198,17 @@ class OmGrpcService:
                         m["volume"], m["bucket"], m["path"]
                     )
                 ),
-            },
-        )
+        }
+        server.add_service(
+            SERVICE, {n: self._gated(fn) for n, fn in methods.items()})
+
+    def _gated(self, fn):
+        def method(req: bytes) -> bytes:
+            if self.gate is not None:
+                self.gate()
+            return fn(req)
+
+        return method
 
     def _wrap(self, fn):
         def method(req: bytes) -> bytes:
@@ -238,6 +256,10 @@ class OmGrpcService:
             self.om.block_size,
             m.get("excluded"),
         )
+        if self.scm_barrier is not None:
+            # HA: the allocation must survive leader failover before the
+            # client writes data against it
+            self.scm_barrier()
         return wire.pack(
             {"group": g.to_json(), "addresses": self.addresses_provider()}
         )
@@ -297,11 +319,18 @@ class RemoteOpenKeySession:
 
 
 class GrpcOmClient:
-    """Remote OzoneManager with the attribute surface OzoneClient expects."""
+    """Remote OzoneManager with the attribute surface OzoneClient expects.
+
+    `address` may be a comma-separated list of OM-HA replicas
+    (OMFailoverProxyProvider analog): calls stick to the known leader,
+    follow OM_NOT_LEADER hints, and rotate on connection failure."""
 
     def __init__(self, address: str, clients=None):
-        self.address = address
-        self._ch = RpcChannel(address)
+        from ozone_tpu.net.rpc import FailoverChannels
+
+        self._pool = FailoverChannels(address)
+        self.addresses = self._pool.addresses
+        self.address = self.addresses[0]
         self.block_size = 16 * 1024 * 1024
         self.clients = clients  # DatanodeClientFactory for address learning
         self._caller = threading.local()
@@ -324,12 +353,37 @@ class GrpcOmClient:
         return _ctx()
 
     def _call(self, method: str, **meta) -> dict:
+        import time as _time
+
         ident = getattr(self._caller, "identity", None)
         if ident is not None and ident[0] is not None:
             meta.setdefault("_user", ident[0])
             meta.setdefault("_groups", list(ident[1]))
-        m, _ = wire.unpack(self._ch.call(SERVICE, method, wire.pack(meta)))
-        return m
+        payload = wire.pack(meta)
+        last: Exception | None = None
+        attempts = max(4, 3 * len(self.addresses))
+        for attempt in range(attempts):
+            addr, ch = self._pool.channel()
+            try:
+                m, _ = wire.unpack(ch.call(SERVICE, method, payload))
+                self.address = addr
+                return m
+            except StorageError as e:
+                last = e
+                if e.code == "OM_NOT_LEADER":
+                    # msg carries the leader address when known
+                    self._pool.follow_hint(e.msg)
+                elif e.code == "UNAVAILABLE" and len(self.addresses) > 1:
+                    # replica unreachable: rotate. Server-side errors
+                    # (IO_EXCEPTION and application codes) surface —
+                    # blind retry would re-execute non-idempotent writes
+                    # and mask the real failure
+                    self._pool.rotate()
+                else:
+                    raise
+            _time.sleep(min(0.1 * (attempt + 1), 0.5))
+        raise StorageError("IO_EXCEPTION",
+                           f"no OM leader reachable: {last}")
 
     # namespace
     def create_volume(self, volume, owner="root"):
@@ -538,4 +592,4 @@ class GrpcOmClient:
                           path=path)["result"]
 
     def close(self):
-        self._ch.close()
+        self._pool.close()

@@ -73,11 +73,15 @@ class GrpcRaftTransport(Transport):
     """
 
     def __init__(self, group_id: str, peers: dict[str, str],
-                 tls=None, timeout_s: float = 5.0):
+                 tls=None, timeout_s: float = 5.0,
+                 vote_timeout_s: float = 1.0):
         self.group_id = group_id
         self._peers = dict(peers)
         self._tls = tls
         self._timeout = timeout_s
+        #: votes get a short deadline — a hung call to a dead peer inside
+        #: an election round delays the candidate's next campaign
+        self._vote_timeout = vote_timeout_s
         self._channels: dict[str, RpcChannel] = {}
         self._lock = threading.Lock()
 
@@ -107,10 +111,12 @@ class GrpcRaftTransport(Transport):
 
     def send(self, peer_id: str, method: str, req: dict) -> dict:
         ch = self._channel(peer_id)
+        timeout = (self._vote_timeout if method == "request_vote"
+                   else self._timeout)
         try:
             raw = ch.call(SERVICE, method,
                           wire.pack({"group": self.group_id, "req": req}),
-                          timeout=self._timeout)
+                          timeout=timeout)
         except StorageError as e:
             # the raft core treats any raised error as "peer unreachable"
             # and retries on the next heartbeat

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 from concurrent import futures
 from typing import Callable, Optional
 
@@ -87,6 +88,12 @@ class RpcServer:
             options=[
                 ("grpc.max_send_message_length", 128 * 1024 * 1024),
                 ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+                # no SO_REUSEPORT: a restarted daemon re-binding its port
+                # must either own it exclusively or fail — with reuseport
+                # the kernel load-balances new connections onto the old
+                # shutting-down server's socket, which accepts TCP but
+                # never answers the HTTP/2 handshake
+                ("grpc.so_reuseport", 0),
             ],
         )
         if tls is not None:
@@ -118,7 +125,9 @@ class RpcServer:
         self._server.start()
 
     def stop(self, grace: Optional[float] = 0.5) -> None:
-        self._server.stop(grace)
+        # wait for full termination so the port is actually released
+        # before a successor binds it
+        self._server.stop(grace).wait(timeout=(grace or 0) + 5)
 
 
 class RpcChannel:
@@ -152,7 +161,17 @@ class RpcChannel:
             return StorageError(d.get("code", "IO_EXCEPTION"),
                                 d.get("message", detail))
         except (ValueError, KeyError):
-            return StorageError("IO_EXCEPTION",
+            # no JSON detail -> the server never produced an answer.
+            # Transport-level failures get their own code so failover
+            # clients can tell "replica unreachable: rotate" apart from
+            # "server raised: surface it" (retrying a handler bug across
+            # every replica would mask the real error)
+            code = ("UNAVAILABLE"
+                    if e.code() in (grpc.StatusCode.UNAVAILABLE,
+                                    grpc.StatusCode.DEADLINE_EXCEEDED,
+                                    grpc.StatusCode.CANCELLED)
+                    else "IO_EXCEPTION")
+            return StorageError(code,
                                 f"rpc {key} to {self.address}: "
                                 f"{e.code()}: {detail}")
 
@@ -196,3 +215,58 @@ class RpcChannel:
 
     def close(self) -> None:
         self._channel.close()
+
+
+class FailoverChannels:
+    """Address-list channel pool for HA failover clients (the
+    OMFailoverProxyProvider / SCMBlockLocationFailoverProxyProvider
+    plumbing): comma-list parsing, a thread-safe lazily-built channel
+    cache, and a sticky index that follows leader hints or rotates on
+    unreachable replicas. Shared by GrpcOmClient and GrpcScmClient so
+    the failover behavior cannot drift between them."""
+
+    def __init__(self, address: str, tls=None):
+        self.addresses = [a.strip() for a in address.split(",")
+                          if a.strip()]
+        if not self.addresses:
+            raise ValueError("empty address list")
+        self._tls = tls
+        self._chs: dict[str, RpcChannel] = {}
+        self._idx = 0
+        self._lock = threading.Lock()
+
+    @property
+    def current(self) -> str:
+        with self._lock:
+            return self.addresses[self._idx]
+
+    def channel(self, addr: Optional[str] = None) -> tuple[str, RpcChannel]:
+        with self._lock:
+            a = addr if addr is not None else self.addresses[self._idx]
+            ch = self._chs.get(a)
+            if ch is None:
+                ch = self._chs[a] = RpcChannel(a, tls=self._tls)
+            return a, ch
+
+    def rotate(self) -> None:
+        with self._lock:
+            self._idx = (self._idx + 1) % len(self.addresses)
+
+    def follow_hint(self, addr: Optional[str]) -> None:
+        """Pin to a hinted leader address; a hint that is unknown or
+        points back at the current replica rotates instead (a deposed
+        leader advertising itself must not pin clients forever)."""
+        with self._lock:
+            if addr and addr in self.addresses:
+                i = self.addresses.index(addr)
+                if i != self._idx:
+                    self._idx = i
+                    return
+            self._idx = (self._idx + 1) % len(self.addresses)
+
+    def close(self) -> None:
+        with self._lock:
+            chans = list(self._chs.values())
+            self._chs.clear()
+        for ch in chans:
+            ch.close()

@@ -21,6 +21,7 @@ from ozone_tpu.scm.replication_manager import (
     ReplicateCommand,
 )
 from ozone_tpu.scm.scm import StorageContainerManager
+from ozone_tpu.storage.ids import StorageError
 from ozone_tpu.storage.reconstruction import ReconstructionCommand
 
 SERVICE = "ozone.tpu.ScmService"
@@ -94,6 +95,13 @@ class ScmGrpcService:
         #: optional hook fired when a node (re)registers with a new
         #: address (daemon wires pipeline re-announcement through it)
         self.on_register = None
+        #: HA hooks, set by the daemon. `gate` rejects state-mutating
+        #: client calls on followers (registration/heartbeats stay open
+        #: on every replica — the reference's datanodes heartbeat all
+        #: SCMs); `barrier` blocks until the decision records a leader
+        #: allocation produced are quorum-committed.
+        self.gate = None
+        self.barrier = None
         server.add_service(
             SERVICE,
             {
@@ -137,12 +145,16 @@ class ScmGrpcService:
         )
 
     def _allocate_block(self, req: bytes) -> bytes:
+        if self.gate is not None:
+            self.gate()  # follower-local allocation would never replicate
         m, _ = wire.unpack(req)
         g = self.scm.allocate_block(
             ReplicationConfig.parse(m["replication"]),
             m["block_size"],
             m.get("excluded"),
         )
+        if self.barrier is not None:
+            self.barrier()  # allocation must survive leader failover
         return wire.pack({"group": g.to_json(), "addresses": dict(self.addresses)})
 
     def _node_addresses(self, req: bytes) -> bytes:
@@ -190,16 +202,73 @@ class ScmGrpcService:
 
 
 class GrpcScmClient:
-    def __init__(self, address: str):
-        self._ch = RpcChannel(address)
+    """Remote SCM client. `address` may be a comma-separated HA replica
+    list: datanodes register/heartbeat to EVERY replica (the reference's
+    datanodes heartbeat all SCMs so each tracks liveness and a promoted
+    leader starts with fresh node state; commands only come back from the
+    leader), while reads rotate to the first reachable replica."""
 
-    def _call(self, method: str, meta: dict) -> dict:
-        m, _ = wire.unpack(self._ch.call(SERVICE, method, wire.pack(meta)))
-        return m
+    def __init__(self, address: str):
+        from ozone_tpu.net.rpc import FailoverChannels
+
+        self._pool = FailoverChannels(address)
+        self.addresses = self._pool.addresses
+
+    def _call(self, method: str, meta: dict,
+              timeout: Optional[float] = 30.0) -> dict:
+        payload = wire.pack(meta)
+        last: Optional[Exception] = None
+        for attempt in range(2 * len(self.addresses)):
+            addr, ch = self._pool.channel()
+            try:
+                m, _ = wire.unpack(ch.call(
+                    SERVICE, method, payload, timeout=timeout))
+                return m
+            except StorageError as e:
+                last = e
+                if e.code == "SCM_NOT_LEADER":
+                    self._pool.follow_hint(e.msg)
+                elif e.code == "UNAVAILABLE" and len(self.addresses) > 1:
+                    self._pool.rotate()
+                else:
+                    raise
+        raise last
+
+    def _broadcast(self, method: str, meta: dict,
+                   timeout: Optional[float] = 2.0) -> list[dict]:
+        """Send to every replica concurrently; return the successful
+        responses (at least one required). Concurrency matters: a
+        blackholed replica must cost one timeout in parallel, not one
+        per replica per heartbeat."""
+        payload = wire.pack(meta)
+        if len(self.addresses) == 1:
+            addr, ch = self._pool.channel(self.addresses[0])
+            m, _ = wire.unpack(ch.call(SERVICE, method, payload,
+                                       timeout=timeout))
+            return [m]
+        from concurrent.futures import ThreadPoolExecutor
+
+        def one(addr):
+            _, ch = self._pool.channel(addr)
+            m, _ = wire.unpack(ch.call(SERVICE, method, payload,
+                                       timeout=timeout))
+            return m
+
+        out, last = [], None
+        with ThreadPoolExecutor(max_workers=len(self.addresses)) as ex:
+            futs = {ex.submit(one, a): a for a in self.addresses}
+            for f in futs:
+                try:
+                    out.append(f.result())
+                except StorageError as e:
+                    last = e
+        if not out:
+            raise last
+        return out
 
     def register(self, dn_id: str, address: str, rack: str = "/default-rack",
                  capacity_bytes: int = 0) -> None:
-        self._call("Register", {
+        self._broadcast("Register", {
             "dn_id": dn_id, "address": address, "rack": rack,
             "capacity_bytes": capacity_bytes,
         })
@@ -207,13 +276,16 @@ class GrpcScmClient:
     def heartbeat(self, dn_id: str, container_report=None,
                   used_bytes: int = 0,
                   deleted_block_acks: Optional[list[int]] = None) -> list:
-        m = self._call("Heartbeat", {
+        responses = self._broadcast("Heartbeat", {
             "dn_id": dn_id,
             "container_report": container_report,
             "used_bytes": used_bytes,
             "deleted_block_acks": deleted_block_acks or [],
         })
-        return [deserialize_command(c) for c in m["commands"]]
+        cmds = []
+        for m in responses:  # only the leader queues commands
+            cmds.extend(deserialize_command(c) for c in m["commands"])
+        return cmds
 
     def allocate_block(self, replication: str, block_size: int,
                        excluded: Optional[list[str]] = None):
@@ -234,4 +306,4 @@ class GrpcScmClient:
         return self._call("Status", {})
 
     def close(self) -> None:
-        self._ch.close()
+        self._pool.close()

@@ -38,6 +38,9 @@ _TABLES = (
     # multi-tenancy (reference: tenantStateTable, tenantAccessIdTable)
     "tenants",
     "tenant_access",
+    # process-level markers (e.g. the raft applied-index floor) that must
+    # flush atomically with the data they describe
+    "system",
 )
 
 

@@ -379,6 +379,15 @@ class RaftSCM:
         if not self.node.is_leader:
             raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
         result = getattr(self.scm, method)(*args, **kw)
+        self._await_records()
+        return result
+
+    def _await_records(self) -> None:
+        """Block until every decision record enqueued so far is
+        quorum-committed (the ack tail shared with the combined
+        metadata ring's OM submits)."""
+        from ozone_tpu.consensus.raft import NotRaftLeaderError
+
         deadline = time.monotonic() + self.ack_timeout_s
         with self._ack_cv:
             target = self._seq
@@ -392,7 +401,6 @@ class RaftSCM:
                         "scm mutation not committed within "
                         f"{self.ack_timeout_s}s")
                 self._ack_cv.wait(timeout=min(left, 0.05))
-        return result
 
     def start(self) -> None:
         self.node.start_timers()

@@ -288,12 +288,22 @@ def cmd_scm_om(args) -> int:
     from ozone_tpu.net.daemons import ScmOmDaemon
 
     logging.basicConfig(level=logging.INFO)
+    ha_peers = None
+    if args.peer:
+        ha_peers = dict(p.split("=", 1) for p in args.peer)
+        if not args.ha_id or args.ha_id not in ha_peers:
+            print("--ha-id must name one of the --peer entries",
+                  file=sys.stderr)
+            return 1
     d = ScmOmDaemon(Path(args.db), port=args.port,
                     min_datanodes=args.min_datanodes,
                     http_port=args.http_port,
-                    recon_port=args.recon_port)
+                    recon_port=args.recon_port,
+                    ha_id=args.ha_id if ha_peers else None,
+                    ha_peers=ha_peers)
     d.start()
     print(f"scm+om serving on {d.address}"
+          + (f" as HA node {args.ha_id}" if ha_peers else "")
           + (f", http on {d.http.address}" if d.http else "")
           + (f", recon on {d.recon.address}" if d.recon else ""))
     return _serve(d.stop)
@@ -578,6 +588,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="serve /prom /prof /stacks /reconfig on this port")
     so.add_argument("--recon-port", type=int, default=None,
                     help="serve the Recon API + web UI on this port")
+    so.add_argument("--ha-id", default=None,
+                    help="this node's id in the metadata HA ring")
+    so.add_argument("--peer", action="append", default=[],
+                    help="HA ring member as id=host:port (repeat; must "
+                         "include --ha-id itself)")
     so.set_defaults(fn=cmd_scm_om)
 
     ins = sub.add_parser("insight",

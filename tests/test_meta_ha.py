@@ -1,0 +1,241 @@
+"""Metadata HA across real daemon boundaries: one raft ring for OM+SCM.
+
+Role analog of the reference's MiniOzoneHAClusterImpl suites: three
+metadata replicas over real gRPC (raft RPCs on the wire), datanodes
+heartbeating every replica, client failover across addresses, leader
+kill mid-workload, and restart-rejoin of a deposed replica.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.ozone_client import OzoneClient
+from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+from ozone_tpu.net.om_service import GrpcOmClient
+from ozone_tpu.net.ratis_service import RatisClientFactory
+from ozone_tpu.storage.ids import StorageError
+
+N_META = 3
+EC = "rs-3-2-4096"
+
+
+def _free_ports(n):
+    """Reserve n distinct loopback ports (bind then release)."""
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _make_meta(tmp_path, i, peers):
+    return ScmOmDaemon(
+        tmp_path / f"meta{i}" / "om.db",
+        port=int(peers[f"m{i}"].rsplit(":", 1)[1]),
+        block_size=256 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+        background_interval_s=0.2,
+        ha_id=f"m{i}",
+        ha_peers=peers,
+    )
+
+
+@pytest.fixture
+def ha_cluster(tmp_path):
+    ports = _free_ports(N_META)
+    peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(N_META)}
+    metas = {}
+    dns = []
+    try:
+        for i in range(N_META):
+            d = _make_meta(tmp_path, i, peers)
+            d.start()
+            metas[f"m{i}"] = d
+        _await_leader(metas)
+        scm_addrs = ",".join(peers.values())
+        for i in range(5):
+            d = DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", scm_addrs,
+                               heartbeat_interval_s=0.15)
+            d.start()
+            dns.append(d)
+        yield metas, dns, peers, tmp_path
+    finally:
+        for d in dns:
+            d.stop()
+        for d in metas.values():
+            d.stop()
+
+
+def _await_leader(metas, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [mid for mid, d in metas.items()
+                   if d.ha is not None and d.ha.is_leader]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError(f"no single leader among {list(metas)}")
+
+
+def _client(peers):
+    clients = DatanodeClientFactory()
+    om = GrpcOmClient(",".join(peers.values()), clients=clients)
+    ratis = RatisClientFactory(address_source=clients.remote_address)
+    return OzoneClient(om, clients, ratis_clients=ratis)
+
+
+def test_ha_write_read_failover_and_rejoin(ha_cluster):
+    metas, dns, peers, tmp_path = ha_cluster
+    oz = _client(peers)
+    payload = np.random.default_rng(2).integers(
+        0, 256, 150_000, dtype=np.uint8).tobytes()
+
+    oz.create_volume("v")
+    b = oz.get_volume("v").create_bucket("b", replication=EC)
+    b.write_key("k1", payload)
+    assert b.read_key("k1").tobytes() == payload
+
+    # every replica's OM tables converged (leader flushed; followers
+    # applied the same committed entries)
+    leader_id = _await_leader(metas)
+    time.sleep(0.5)
+    for mid, d in metas.items():
+        vols = [v["name"] for v in d.om.list_volumes()]
+        assert vols == ["v"], (mid, vols)
+
+    # ---- kill the leader process-equivalent: clients fail over ----
+    metas.pop(leader_id).stop()
+    new_leader = _await_leader(metas, timeout=15.0)
+    assert new_leader != leader_id
+
+    b.write_key("k2", payload)
+    assert b.read_key("k1").tobytes() == payload
+    assert b.read_key("k2").tobytes() == payload
+
+    # the new leader's SCM knows the pre-failover containers (decision
+    # records were quorum-committed before the client ack)
+    survivor = metas[new_leader]
+    info = survivor.om.lookup_key("v", "b", "k1")
+    for g in survivor.om.key_block_groups(info):
+        assert survivor.scm.containers.get_or_none(g.container_id) \
+            is not None
+
+    # ---- restart the old leader: it rejoins as a follower and catches
+    # up from the raft log / snapshot ----
+    idx = int(leader_id[1:])
+    revived = _make_meta(tmp_path, idx, peers)
+    revived.start()
+    metas[leader_id] = revived
+    deadline = time.monotonic() + 15.0
+    while time.monotonic() < deadline:
+        vols = [v["name"] for v in revived.om.list_volumes()]
+        keys = {k["name"] for k in revived.om.list_keys("v", "b")} \
+            if vols == ["v"] else set()
+        if keys >= {"k1", "k2"}:
+            break
+        time.sleep(0.1)
+    assert {k["name"] for k in revived.om.list_keys("v", "b")} \
+        >= {"k1", "k2"}
+    # still exactly one leader
+    _await_leader(metas, timeout=15.0)
+
+
+def test_ha_follower_rejects_with_leader_hint(ha_cluster):
+    metas, dns, peers, _ = ha_cluster
+    leader_id = _await_leader(metas)
+    follower_id = next(m for m in metas if m != leader_id)
+    om = GrpcOmClient(peers[follower_id])
+    # single-address client pointed at a follower: the error carries the
+    # leader address for operators/proxies
+    with pytest.raises(StorageError) as ei:
+        om.create_volume("nope")
+    assert ei.value.code in ("OM_NOT_LEADER", "IO_EXCEPTION")
+    om.close()
+
+
+def test_ha_scm_allocation_leader_gated(ha_cluster):
+    """Direct block allocation on a follower must be rejected — a
+    follower-local allocation would mutate state no decision record
+    ever replicates."""
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    metas, dns, peers, _ = ha_cluster
+    leader_id = _await_leader(metas)
+    follower_id = next(m for m in metas if m != leader_id)
+    scm = GrpcScmClient(peers[follower_id])  # single follower address
+    with pytest.raises(StorageError) as ei:
+        scm.allocate_block("rs-3-2-4096", 4096)
+    assert ei.value.code == "SCM_NOT_LEADER"
+    scm.close()
+    # with the full list the client follows the hint to the leader
+    scm = GrpcScmClient(",".join(peers.values()))
+    group, addresses = scm.allocate_block("rs-3-2-4096", 4096)
+    assert group["container_id"] >= 1
+    scm.close()
+
+
+def test_ha_restart_does_not_reapply_flushed_entries(tmp_path):
+    """Replay floor: entries flushed to the OM store before a restart are
+    skipped on raft log replay (re-applying would duplicate
+    non-idempotent effects)."""
+    from ozone_tpu.consensus.meta_ring import MetaHARing
+    from ozone_tpu.om import requests as rq
+    from ozone_tpu.om.om import OzoneManager
+    from ozone_tpu.scm.scm import StorageContainerManager
+
+    def build():
+        scm = StorageContainerManager(stale_after_s=1e6, dead_after_s=2e6)
+        om = OzoneManager(tmp_path / "om.db", scm)
+        ring = MetaHARing(om, scm, tmp_path / "raft", "m0", ["m0"])
+        return om, scm, ring
+
+    om, scm, ring = build()
+    assert ring.node.start_election()
+    ring.submit_om(rq.CreateVolume("v", "root"))
+    ring.submit_om(rq.CreateBucket("v", "b", "rs-3-2-4096"))
+    floor = ring._applied_floor
+    assert floor == ring.node.last_applied > 0
+    om.close()  # clean shutdown flushes the store (floor rides along)
+    ring.node.stop()
+
+    om2, scm2, ring2 = build()
+    assert ring2._applied_floor == floor
+    applied = []
+    orig = rq.OMRequest.from_json
+    rq.OMRequest.from_json = staticmethod(
+        lambda d: (applied.append(d), orig(d))[1])
+    try:
+        assert ring2.node.start_election()  # commits + replays the log
+        assert applied == [], "flushed entries were re-applied"
+    finally:
+        rq.OMRequest.from_json = orig
+    assert [v["name"] for v in om2.list_volumes()] == ["v"]
+    # new writes continue past the floor
+    ring2.submit_om(rq.CreateVolume("v2", "root"))
+    assert {v["name"] for v in om2.list_volumes()} == {"v", "v2"}
+    om2.close()
+    ring2.node.stop()
+
+
+def test_ha_ratis_pipeline_write(ha_cluster):
+    """RATIS/THREE through HA metadata: the leader announces the
+    pipeline, datanodes join, writes ride the DN raft ring."""
+    metas, dns, peers, _ = ha_cluster
+    oz = _client(peers)
+    payload = np.random.default_rng(4).integers(
+        0, 256, 120_000, dtype=np.uint8).tobytes()
+    oz.create_volume("rv")
+    b = oz.get_volume("rv").create_bucket("rb", replication="RATIS/THREE")
+    b.write_key("rk", payload)
+    assert b.read_key("rk").tobytes() == payload
