@@ -1,0 +1,105 @@
+"""Chaos over the metadata HA ring: replica restarts under write load.
+
+The reference's mini-chaos-tests (fault-injection-test OzoneChaosCluster
++ FailureManager) randomly restart OMs while load generators assert
+invariants; this is the same contract against the multi-process HA ring:
+every ACKED write must be readable afterwards, no matter which replica
+was down when, and the ring must converge back to one leader.
+"""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ozone_tpu.storage.ids import StorageError
+from tests.test_meta_ha import (
+    _await_leader,
+    _client,
+    _free_ports,
+    _make_meta,
+)
+from ozone_tpu.net.daemons import DatanodeDaemon
+
+N_META = 3
+
+
+@pytest.mark.parametrize("seed", [11])
+def test_meta_ha_chaos_replica_restarts(tmp_path, seed):
+    rng = random.Random(seed)
+    ports = _free_ports(N_META)
+    peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(N_META)}
+    metas = {}
+    dns = []
+    stop = threading.Event()
+    acked: list[str] = []
+    write_errors: list[Exception] = []
+
+    try:
+        for i in range(N_META):
+            d = _make_meta(tmp_path, i, peers)
+            d.start()
+            metas[f"m{i}"] = d
+        _await_leader(metas)
+        scm_addrs = ",".join(peers.values())
+        for i in range(5):
+            d = DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", scm_addrs,
+                               heartbeat_interval_s=0.15)
+            d.start()
+            dns.append(d)
+
+        oz = _client(peers)
+        oz.create_volume("v")
+        bucket = oz.get_volume("v").create_bucket(
+            "b", replication="rs-3-2-4096")
+        payload = np.random.default_rng(seed).integers(
+            0, 256, 60_000, dtype=np.uint8).tobytes()
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                key = f"k{n}"
+                try:
+                    bucket.write_key(key, payload)
+                    acked.append(key)
+                except StorageError:
+                    pass  # un-acked: no durability claim, keep going
+                except Exception as e:  # noqa: BLE001 - fail the test
+                    write_errors.append(e)
+                    return
+                n += 1
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+
+        # chaos: three rounds of stop-a-random-replica / restart it
+        for _ in range(3):
+            time.sleep(1.5)
+            victim = rng.choice(sorted(metas))
+            idx = int(victim[1:])
+            metas.pop(victim).stop()
+            time.sleep(1.5)
+            revived = _make_meta(tmp_path, idx, peers)
+            revived.start()
+            metas[victim] = revived
+
+        time.sleep(1.0)
+        stop.set()
+        wt.join(timeout=30)
+        assert not wt.is_alive(), "writer wedged"
+        assert not write_errors, write_errors
+        assert len(acked) >= 3, f"writer made no progress: {acked}"
+
+        # invariant: every acked key reads back intact
+        _await_leader(metas, timeout=20)
+        for key in acked:
+            out = bucket.read_key(key)
+            assert out.tobytes() == payload, key
+    finally:
+        stop.set()
+        for d in dns:
+            d.stop()
+        for d in metas.values():
+            d.stop()
