@@ -71,6 +71,11 @@ class MetaHARing(RaftSCM):
                     self.om.store)
             except rq.OMError as e:
                 result = e  # deterministic: replicas converge on the error
+        elif "admin" in data:
+            # replicated operator decision (decommission/safemode/
+            # balancer): applied on every replica so it survives failover
+            result = self.scm.apply_admin_op(
+                data["admin"]["op"], data["admin"].get("target"))
         else:
             result = super()._apply(data)
         self._applied_floor = idx
@@ -118,6 +123,16 @@ class MetaHARing(RaftSCM):
         # block allocation in preExecute produced SCM decision records;
         # the client ack covers them too
         self._await_records()
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def submit_admin(self, op: str, target=None) -> dict:
+        """Replicate a mutating admin op (the SCMRatisRequest shape for
+        operator decisions): applied on every replica in log order."""
+        if not self.node.is_ready_leader:
+            raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        result = self.node.propose({"admin": {"op": op, "target": target}})
         if isinstance(result, Exception):
             raise result
         return result

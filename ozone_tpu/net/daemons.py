@@ -379,6 +379,16 @@ class ScmOmDaemon:
 
             self.scm_service.gate = _scm_gate
             self.scm_service.barrier = _scm_side_barrier
+
+            def _admin_submit(op, target):
+                try:
+                    return self.ha.submit_admin(op, target)
+                except NotRaftLeaderError as e:
+                    raise StorageError(
+                        "SCM_NOT_LEADER",
+                        self._leader_address(e.leader_hint))
+
+            self.scm_service.admin_submitter = _admin_submit
         from ozone_tpu.utils.insight import InsightService
 
         self.insight = InsightService(self.server, "scm-om")

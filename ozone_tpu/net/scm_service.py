@@ -102,6 +102,10 @@ class ScmGrpcService:
         #: allocation produced are quorum-committed.
         self.gate = None
         self.barrier = None
+        #: HA hook: replicates a mutating admin op through the metadata
+        #: ring (callable(op, target) -> dict) so the decision survives
+        #: leader failover; None = apply directly to the local SCM
+        self.admin_submitter = None
         server.add_service(
             SERVICE,
             {
@@ -111,6 +115,7 @@ class ScmGrpcService:
                 "NodeAddresses": self._node_addresses,
                 "Status": self._status,
                 "ListContainers": self._list_containers,
+                "AdminOp": self._admin_op,
             },
         )
 
@@ -159,6 +164,45 @@ class ScmGrpcService:
 
     def _node_addresses(self, req: bytes) -> bytes:
         return wire.pack({"addresses": dict(self.addresses)})
+
+    #: admin verbs that change cluster state (leader-only under HA; the
+    #: read-only ones may be answered by any replica)
+    _MUTATING_ADMIN = frozenset({
+        "decommission", "recommission", "maintenance",
+        "balancer-start", "balancer-stop",
+        "safemode-enter", "safemode-exit",
+    })
+
+    def _admin_op(self, req: bytes) -> bytes:
+        """Operator verbs (`ozone admin` analog: NodeDecommissionManager,
+        ContainerBalancerCommands, SafeModeCommands, pipeline list)."""
+        m, _ = wire.unpack(req)
+        op, target = m["op"], m.get("target")
+        scm = self.scm
+        if op in self._MUTATING_ADMIN:
+            if self.gate is not None:
+                self.gate()
+            if self.admin_submitter is not None:
+                out = self.admin_submitter(op, target)  # via the HA ring
+            else:
+                out = scm.apply_admin_op(op, target)
+        elif op == "balancer-status":
+            out = {"running": scm.balancer_enabled}
+        elif op == "pipelines":
+            out = {"pipelines": [
+                {"id": p.id, "nodes": p.nodes,
+                 "replication": str(p.replication),
+                 "state": p.state.value}
+                for p in scm.containers.pipelines()
+            ]}
+        elif op == "replication-status":
+            from ozone_tpu.recon.recon import ReconScmView
+
+            health = ReconScmView(scm).container_health()
+            out = {k: len(v) for k, v in health.items()}
+        else:
+            raise StorageError("UNSUPPORTED_REQUEST", f"admin op {op!r}")
+        return wire.pack(out)
 
     def _list_containers(self, req: bytes) -> bytes:
         """Container listing for admin/repair tools (`ozone admin
@@ -301,6 +345,9 @@ class GrpcScmClient:
 
     def node_addresses(self) -> dict[str, str]:
         return self._call("NodeAddresses", {})["addresses"]
+
+    def admin(self, op: str, target: Optional[str] = None) -> dict:
+        return self._call("AdminOp", {"op": op, "target": target})
 
     def status(self) -> dict:
         return self._call("Status", {})

@@ -140,6 +140,40 @@ class StorageContainerManager:
         the monitor finalizes once drained."""
         self.decommission_monitor.start_decommission(dn_id)
 
+    def apply_admin_op(self, op: str, target=None) -> dict:
+        """Deterministic admin mutation + state read-back. One function
+        serves both the direct (single-node) path and the HA ring's
+        replicated apply, so every replica ends in the same state
+        (`ozone admin` node/balancer/safemode verbs)."""
+        from ozone_tpu.storage.ids import StorageError
+
+        if op in ("decommission", "recommission", "maintenance"):
+            node = self.nodes.get(target) if target else None
+            if node is None:
+                raise StorageError("NODE_NOT_FOUND",
+                                   f"unknown datanode {target!r}")
+            if op == "decommission":
+                self.decommission(target)
+            elif op == "recommission":
+                self.decommission_monitor.recommission(target)
+            else:
+                self.decommission_monitor.start_maintenance(target)
+            return {"node": target, "op_state": node.op_state.value}
+        if op == "balancer-start":
+            self.balancer_enabled = True
+        elif op == "balancer-stop":
+            self.balancer_enabled = False
+        elif op == "safemode-enter":
+            self.safemode.force(True)
+        elif op == "safemode-exit":
+            self.safemode.force(False)
+        else:
+            raise StorageError("UNSUPPORTED_REQUEST", f"admin op {op!r}")
+        if op.startswith("balancer"):
+            return {"running": self.balancer_enabled}
+        return {"safemode": self.safemode.in_safemode(),
+                **self.safemode.status()}
+
     # ------------------------------------------------------------- background
     def run_background_once(self) -> None:
         """One tick of the SCM control loops (liveness + replication +

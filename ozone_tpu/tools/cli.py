@@ -204,13 +204,43 @@ def cmd_fs(args) -> int:
 def cmd_admin(args) -> int:
     from ozone_tpu.net.scm_service import GrpcScmClient
 
+    def usage(msg: str) -> int:
+        print(f"error: {msg}", file=sys.stderr)
+        return 2
+
     scm = GrpcScmClient(args.om)
-    if args.subject == "safemode":
-        st = scm.status()
-        _emit({"safemode": st["safemode"], **st["safemode_status"]})
-    elif args.subject == "datanode":
-        _emit(scm.status()["nodes"])
-    elif args.subject == "status":
+    subject, verb, target = args.subject, args.verb, args.target
+    if subject == "safemode":
+        if verb in ("enter", "exit"):
+            _emit(scm.admin(f"safemode-{verb}"))
+        elif verb in (None, "status"):
+            st = scm.status()
+            _emit({"safemode": st["safemode"], **st["safemode_status"]})
+        else:
+            return usage(f"unknown safemode verb {verb!r} "
+                         "(expected enter|exit|status)")
+    elif subject == "datanode":
+        if verb in ("decommission", "recommission", "maintenance"):
+            if not target:
+                return usage(f"datanode {verb} needs a datanode id")
+            _emit(scm.admin(verb, target))
+        elif verb in (None, "list"):
+            _emit(scm.status()["nodes"])
+        else:
+            return usage(f"unknown datanode verb {verb!r} (expected "
+                         "list|decommission|recommission|maintenance)")
+    elif subject == "pipeline":
+        _emit(scm.admin("pipelines"))
+    elif subject == "container":
+        _emit(scm.list_containers())
+    elif subject == "balancer":
+        if verb not in (None, "status", "start", "stop"):
+            return usage(f"unknown balancer verb {verb!r} "
+                         "(expected start|stop|status)")
+        _emit(scm.admin(f"balancer-{verb or 'status'}"))
+    elif subject == "replicationmanager":
+        _emit(scm.admin("replication-status"))
+    elif subject == "status":
         _emit(scm.status())
     return 0
 
@@ -520,7 +550,17 @@ def build_parser() -> argparse.ArgumentParser:
     tn.set_defaults(fn=cmd_tenant)
 
     ad = sub.add_parser("admin", help="cluster admin (ozone admin analog)")
-    ad.add_argument("subject", choices=["safemode", "datanode", "status"])
+    ad.add_argument("subject", choices=[
+        "safemode", "datanode", "status", "pipeline", "container",
+        "balancer", "replicationmanager",
+    ])
+    ad.add_argument("verb", nargs="?", default=None,
+                    help="safemode: enter|exit; datanode: decommission|"
+                         "recommission|maintenance <id>; balancer: "
+                         "start|stop|status")
+    ad.add_argument("target", nargs="?", default=None,
+                    help="datanode id for decommission/recommission/"
+                         "maintenance")
     ad.add_argument("--om", default="127.0.0.1:9860")
     ad.set_defaults(fn=cmd_admin)
 
@@ -705,6 +745,13 @@ def main(argv=None) -> int:
         # result code the same way)
         print(f"error {e.code}: {e.msg}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe: exit quietly like any
+        # well-behaved unix tool
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

@@ -185,6 +185,25 @@ def test_ha_scm_allocation_leader_gated(ha_cluster):
     scm.close()
 
 
+def test_ha_admin_ops_survive_failover(ha_cluster):
+    """Operator decisions (decommission) replicate through the ring: a
+    new leader must not silently forget a drain in progress."""
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    metas, dns, peers, _ = ha_cluster
+    scm = GrpcScmClient(",".join(peers.values()))
+    out = scm.admin("decommission", "dn3")
+    assert out["op_state"] == "DECOMMISSIONING"
+    leader = _await_leader(metas)
+    time.sleep(0.5)  # followers apply the replicated record
+    metas.pop(leader).stop()
+    new_leader = _await_leader(metas, timeout=15.0)
+    node = metas[new_leader].scm.nodes.get("dn3")
+    assert node.op_state.value in ("DECOMMISSIONING", "DECOMMISSIONED")
+    scm.admin("recommission", "dn3")
+    scm.close()
+
+
 def test_ha_restart_does_not_reapply_flushed_entries(tmp_path):
     """Replay floor: entries flushed to the OM store before a restart are
     skipped on raft log replay (re-applying would duplicate

@@ -60,7 +60,6 @@ def test_recon_endpoints(cluster):
 
 def test_tracing_spans_nest_and_propagate():
     t = Tracer.instance()
-    before = len(t.traces())
     with t.span("outer") as outer:
         with t.span("inner") as inner:
             assert inner.trace_id == outer.trace_id
@@ -71,8 +70,9 @@ def test_tracing_spans_nest_and_propagate():
     with t.span("remote", child_of=ctx) as remote:
         assert remote.trace_id == outer.trace_id
         assert remote.parent_id == inner.span_id
-    assert len(t.traces()) == before + 3
-    assert t.export_json()[-1]["name"] == "outer" or True
+    # count only this trace: the tracer is a process-global singleton and
+    # background daemon threads from other tests may emit spans too
+    assert len(t.traces(trace_id=outer.trace_id)) == 3
 
 
 def test_rpc_carries_trace_context(cluster):

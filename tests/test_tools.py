@@ -83,3 +83,55 @@ def test_cli_admin_status(cluster, capsys):
     assert cli_main(["admin", "safemode", "--om", meta.address]) == 0
     sm = json.loads(capsys.readouterr().out)
     assert sm["safemode"] is False
+
+
+def test_cli_admin_operator_verbs(cluster, capsys):
+    """ozone admin pipeline/balancer/safemode/decommission analogs."""
+    meta, dns = cluster
+    om = meta.address
+
+    assert cli_main(["admin", "safemode", "enter", "--om", om]) == 0
+    assert json.loads(capsys.readouterr().out)["safemode"] is True
+    assert cli_main(["admin", "safemode", "exit", "--om", om]) == 0
+    assert json.loads(capsys.readouterr().out)["safemode"] is False
+
+    assert cli_main(["admin", "balancer", "status", "--om", om]) == 0
+    assert json.loads(capsys.readouterr().out)["running"] is False
+    assert cli_main(["admin", "balancer", "start", "--om", om]) == 0
+    assert json.loads(capsys.readouterr().out)["running"] is True
+    assert meta.scm.balancer_enabled
+    assert cli_main(["admin", "balancer", "stop", "--om", om]) == 0
+    capsys.readouterr()
+
+    assert cli_main(["admin", "pipeline", "--om", om]) == 0
+    pls = json.loads(capsys.readouterr().out)["pipelines"]
+    assert all({"id", "nodes", "replication", "state"} <= set(p)
+               for p in pls)
+
+    assert cli_main(["admin", "replicationmanager", "--om", om]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert {"healthy", "under_replicated", "missing"} <= set(rep)
+
+    assert cli_main(["admin", "datanode", "decommission", "dn4",
+                     "--om", om]) == 0
+    assert json.loads(capsys.readouterr().out)["op_state"] \
+        == "DECOMMISSIONING"
+    assert cli_main(["admin", "datanode", "recommission", "dn4",
+                     "--om", om]) == 0
+    assert json.loads(capsys.readouterr().out)["op_state"] == "IN_SERVICE"
+
+
+def test_cli_admin_rejects_bad_input(cluster, capsys):
+    meta, dns = cluster
+    om = meta.address
+    # typo'd verbs must error, not silently fall back to the status view
+    assert cli_main(["admin", "safemode", "exti", "--om", om]) == 2
+    assert cli_main(["admin", "datanode", "decomission", "dn0",
+                     "--om", om]) == 2
+    assert cli_main(["admin", "balancer", "strat", "--om", om]) == 2
+    # missing / unknown targets produce clean errors
+    assert cli_main(["admin", "datanode", "decommission", "--om", om]) == 2
+    assert cli_main(["admin", "datanode", "maintenance", "dn-typo",
+                     "--om", om]) == 1
+    err = capsys.readouterr().err
+    assert "NODE_NOT_FOUND" in err
