@@ -49,6 +49,7 @@ class DatanodeDaemon:
         port: int = 0,
         rack: str = "/default-rack",
         heartbeat_interval_s: float = 1.0,
+        scan_interval_s: float = 300.0,
     ):
         self.dn = Datanode(Path(root), dn_id=dn_id)
         self.server = RpcServer(host, port)
@@ -79,6 +80,15 @@ class DatanodeDaemon:
         self._pending_acks: list[int] = []
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None
+        # background data scanner (BackgroundContainerDataScanner analog):
+        # one container per tick, round-robin, device-batched CRC verify;
+        # a poisoned replica reaches the SCM via the next container report
+        from ozone_tpu.storage.scrubber import DeviceScrubber
+
+        self.scan_interval = scan_interval_s
+        self._scrubber = DeviceScrubber()
+        self._scan_cursor = 0
+        self._scanner: Optional[threading.Thread] = None
 
     @property
     def address(self) -> str:
@@ -92,6 +102,36 @@ class DatanodeDaemon:
             target=self._heartbeat_loop, name=f"hb-{self.dn.id}", daemon=True
         )
         self._hb.start()
+        if self.scan_interval and self.scan_interval > 0:
+            self._scanner = threading.Thread(
+                target=self._scan_loop, name=f"scan-{self.dn.id}",
+                daemon=True)
+            self._scanner.start()
+
+    def scan_once(self) -> None:
+        """Scrub the next scannable container in round-robin order
+        (throttle unit of the background scanner). Only writer-free
+        states are data-scanned — an OPEN or RECOVERING replica has
+        concurrent writers whose in-flight chunks would read torn."""
+        from ozone_tpu.storage.scrubber import SCANNABLE_STATES
+
+        containers = [c for c in self.dn.list_containers()
+                      if c.state in SCANNABLE_STATES]
+        if not containers:
+            return
+        c = containers[self._scan_cursor % len(containers)]
+        self._scan_cursor += 1
+        errs = self._scrubber.scrub_container(self.dn, c.id)
+        if errs:
+            log.warning("%s: container %d failed scrub: %s",
+                        self.dn.id, c.id, errs[:4])
+
+    def _scan_loop(self) -> None:
+        while not self._stop.wait(self.scan_interval):
+            try:
+                self.scan_once()
+            except Exception:
+                log.exception("%s background scan failed", self.dn.id)
 
     def _rejoin_pipelines(self) -> None:
         """Re-open raft groups this node served before a restart (the
@@ -226,6 +266,8 @@ class DatanodeDaemon:
         self._stop.set()
         if self._hb:
             self._hb.join(timeout=5)
+        if self._scanner:
+            self._scanner.join(timeout=5)
         self.xceiver_ratis.stop()
         self.server.stop()
         self.scm.close()

@@ -1,0 +1,169 @@
+"""Device-batched container scrubbing: checksum verification as batched
+CRC dispatches instead of a per-slice host loop.
+
+Role analog of the reference's BackgroundContainerDataScanner
+(container-service ozoneimpl/ — throttled full-chunk checksum verify that
+marks containers UNHEALTHY and lets the SCM replication manager repair
+them; it data-scans only closed containers, never ones with live
+writers). TPU-first divergence: full bytes-per-checksum slices are
+stacked into uint8 batches and verified by the same GF(2) CRC kernel the
+write path uses (codec/crc_device.py) — a whole container becomes a few
+device dispatches. Tails (short final slices) and non-CRC32C checksum
+types fall back to the host path.
+
+Only checksum MISMATCHES (and metadata inconsistencies) poison a
+replica. A chunk that cannot be read is re-checked against the block
+metadata first: if the block vanished, a concurrent deletion won the
+race and the chunk is skipped — an I/O race must not trigger needless
+re-replication.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from ozone_tpu.storage.ids import ContainerState, StorageError
+from ozone_tpu.utils.checksum import (
+    Checksum,
+    ChecksumError,
+    ChecksumType,
+    crc32c,
+)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ozone_tpu.storage.datanode import Datanode
+
+log = logging.getLogger(__name__)
+
+#: container states whose data is stable enough to scrub (the reference
+#: scanner's shouldScanData contract: no live writers)
+SCANNABLE_STATES = (ContainerState.CLOSED, ContainerState.QUASI_CLOSED)
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << (max(1, n) - 1).bit_length()
+
+
+class DeviceScrubber:
+    """Batched CRC32C verification over container contents."""
+
+    def __init__(self, max_batch_bytes: int = 64 * 1024 * 1024):
+        self.max_batch_bytes = max_batch_bytes
+        self._fns: dict[int, object] = {}
+
+    def _crc_fn(self, bpc: int):
+        fn = self._fns.get(bpc)
+        if fn is None:
+            from ozone_tpu.codec.crc_device import make_crc_fn
+
+            fn = self._fns[bpc] = make_crc_fn(bpc)
+        return fn
+
+    def _dispatch(self, bpc: int, bufs: list, exps: list, labels: list,
+                  errors: list[str]) -> None:
+        """Verify one slice batch on device and drain the buffers.
+
+        Batches are padded to the next power of two (zero slices, results
+        ignored) so the jitted kernel sees a handful of shapes per bpc
+        instead of recompiling for every container's exact slice count.
+        """
+        import jax
+
+        if not bufs:
+            return
+        n = len(bufs)
+        padded = _next_pow2(n)
+        batch = np.zeros((padded, bpc), dtype=np.uint8)
+        batch[:n] = np.stack(bufs)
+        crcs = np.asarray(
+            jax.device_get(self._crc_fn(bpc)(batch))).reshape(-1)[:n]
+        exp = np.asarray(exps, dtype=np.uint32)
+        for i in np.nonzero(crcs != exp)[0][:64]:
+            lbl, sl = labels[int(i)]
+            errors.append(f"{lbl}: crc mismatch at slice {sl}")
+        bufs.clear()
+        exps.clear()
+        labels.clear()
+
+    def scrub_container(self, dn: "Datanode", container_id: int,
+                        mark_unhealthy: bool = True) -> list[str]:
+        """Verify every chunk checksum in a container; returns error
+        strings and (by default) poisons the replica on any."""
+        c = dn.containers.get(container_id)
+        errors: list[str] = []
+        # bpc -> (slice buffers, expected crcs, (label, slice idx));
+        # drained to the device whenever a group reaches the batch cap so
+        # peak host memory is bounded by max_batch_bytes per group, not
+        # by the container size
+        groups: dict[int, tuple[list, list, list]] = {}
+        for block in c.list_blocks():
+            for info in block.chunks:
+                cd = info.checksum
+                if not cd.checksums:
+                    continue
+                label = f"{block.block_id}/{info.name}"
+                try:
+                    data = np.asarray(
+                        c.chunks.read_chunk(block.block_id, info),
+                        dtype=np.uint8,
+                    ).reshape(-1)
+                except StorageError as e:
+                    # corruption evidence only if the block metadata is
+                    # still live; a concurrently deleted block is a race,
+                    # not damage
+                    if c.db.get_block(block.block_id) is not None:
+                        errors.append(f"{label}: {e}")
+                    continue
+                if cd.type is not ChecksumType.CRC32C:
+                    try:
+                        Checksum().verify(data, cd, label)
+                    except ChecksumError as e:
+                        errors.append(f"{label}: {e}")
+                    continue
+                bpc = cd.bytes_per_checksum
+                n_full = data.size // bpc
+                expected_entries = n_full + (1 if data.size % bpc else 0)
+                if len(cd.checksums) != expected_entries:
+                    errors.append(
+                        f"{label}: {len(cd.checksums)} checksum entries "
+                        f"for {data.size} bytes (expected "
+                        f"{expected_entries})")
+                    continue
+                bufs, exps, labels = groups.setdefault(bpc, ([], [], []))
+                cap = max(1, self.max_batch_bytes // bpc)
+                for i in range(n_full):
+                    bufs.append(data[i * bpc:(i + 1) * bpc])
+                    exps.append(int.from_bytes(cd.checksums[i], "big"))
+                    labels.append((label, i))
+                    if len(bufs) >= cap:
+                        self._dispatch(bpc, bufs, exps, labels, errors)
+                tail = data[n_full * bpc:]
+                if tail.size:
+                    if crc32c(tail).to_bytes(4, "big") \
+                            != cd.checksums[n_full]:
+                        errors.append(
+                            f"{label}: crc mismatch at tail slice "
+                            f"{n_full}")
+        for bpc, (bufs, exps, labels) in groups.items():
+            self._dispatch(bpc, bufs, exps, labels, errors)
+        if errors and mark_unhealthy:
+            c.mark_unhealthy()
+        dn.metrics.counter("containers_scrubbed").inc()
+        return errors
+
+    def scrub_all(self, dn: "Datanode") -> dict[int, list[str]]:
+        """One pass over every scannable (writer-free) container."""
+        out: dict[int, list[str]] = {}
+        for c in dn.list_containers():
+            if c.state not in SCANNABLE_STATES:
+                continue
+            try:
+                errs = self.scrub_container(dn, c.id)
+            except StorageError as e:
+                errs = [str(e)]
+            if errs:
+                out[c.id] = errs
+        return out

@@ -305,7 +305,8 @@ def cmd_datanode(args) -> int:
     logging.basicConfig(level=logging.INFO)
     dn_id = args.id or Path(args.root).name
     d = DatanodeDaemon(
-        Path(args.root), dn_id, args.scm, port=args.port, rack=args.rack
+        Path(args.root), dn_id, args.scm, port=args.port, rack=args.rack,
+        scan_interval_s=args.scan_interval,
     )
     d.start()
     print(f"datanode {dn_id} serving on {d.address}, scm={args.scm}")
@@ -590,6 +591,9 @@ def build_parser() -> argparse.ArgumentParser:
     dn.add_argument("--id", default="")
     dn.add_argument("--port", type=int, default=0)
     dn.add_argument("--rack", default="/default-rack")
+    dn.add_argument("--scan-interval", type=float, default=300.0,
+                    help="seconds between background container scrubs "
+                         "(0 disables)")
     dn.set_defaults(fn=cmd_datanode)
 
     s3g = sub.add_parser("s3g", help="run the S3 gateway daemon")
