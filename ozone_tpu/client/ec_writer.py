@@ -261,6 +261,7 @@ class ECKeyWriter:
         stripe.index = self._stripe_in_group
         offset = stripe.index * self.cell
         failed: list[str] = []
+        closed = False
         cause: Optional[Exception] = None
         new_chunks: list[Optional[ChunkInfo]] = [None] * (self.k + self.p)
 
@@ -282,10 +283,19 @@ class ECKeyWriter:
                     group.block_id, info, cell_data[:length]
                 )
                 new_chunks[u] = info
-            except (StorageError, KeyError, OSError) as e:
+            except StorageError as e:
+                cause = e
+                if e.code == "INVALID_CONTAINER_STATE":
+                    # container closed under us (filled concurrently /
+                    # SCM finalize): the node is healthy — reallocate a
+                    # fresh group, never blacklist the whole pipeline
+                    closed = True
+                else:
+                    failed.append(dn_id)
+            except (KeyError, OSError) as e:
                 failed.append(dn_id)
                 cause = e
-        if failed:
+        if failed or closed:
             raise StripeWriteError(failed, cause)
 
         # stripe barrier: putBlock on every participating stream
@@ -304,9 +314,14 @@ class ECKeyWriter:
             )
             try:
                 self.clients.get(dn_id).put_block(bd)
-            except (StorageError, KeyError, OSError) as e:
+            except StorageError as e:
                 # putBlock failure fails the whole stripe: the group rolls
-                # over and chunks past the committed length are orphaned
+                # over and chunks past the committed length are orphaned.
+                # A closed container is a reallocation signal, not a node
+                # failure — exclude nobody.
+                bad = [] if e.code == "INVALID_CONTAINER_STATE" else [dn_id]
+                raise StripeWriteError(bad, e)
+            except (KeyError, OSError) as e:
                 raise StripeWriteError([dn_id], e)
         group.length = group_len_after
         self._stripe_in_group += 1

@@ -115,14 +115,23 @@ class ReplicatedKeyWriter:
                 checksum=self.checksum.compute(data),
             )
             failed: list[str] = []
+            closed = False
             err: Optional[Exception] = None
             for dn_id in group.pipeline.nodes:
                 try:
                     self.clients.get(dn_id).write_chunk(group.block_id, info, data)
-                except (StorageError, KeyError, OSError) as e:
+                except StorageError as e:
+                    err = e
+                    if e.code == "INVALID_CONTAINER_STATE":
+                        # container closed under us: healthy node,
+                        # reallocate without blacklisting anyone
+                        closed = True
+                    else:
+                        failed.append(dn_id)
+                except (KeyError, OSError) as e:
                     failed.append(dn_id)
                     err = e
-            if self._data_phase_ok(group, failed):
+            if not closed and self._data_phase_ok(group, failed):
                 try:
                     self._commit_chunk(group, info)
                     self._chunks.append(info)

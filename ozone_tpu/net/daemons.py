@@ -170,6 +170,26 @@ class DatanodeDaemon:
         tmp.write_text(json.dumps(groups))
         tmp.replace(self._groups_file)
 
+    def _close_container(self, cmd: dict) -> None:
+        cid = int(cmd["container_id"])
+        pid = cmd.get("pipeline_id")
+        if pid is not None and self.xceiver_ratis.get(int(pid)) is not None:
+            # RATIS: ordered through the ring — only the leader submits;
+            # followers apply the committed close from the log
+            try:
+                self.xceiver_ratis.submit(int(pid), {
+                    "verb": "close_container", "container_id": cid,
+                }, timeout=10.0)
+            except StorageError as e:
+                if e.code != "NOT_LEADER":
+                    log.warning("%s: raft close of container %d failed: %s",
+                                self.dn.id, cid, e)
+            return
+        try:
+            self.dn.close_container(cid)
+        except StorageError:
+            pass  # already closed / not replicated here yet
+
     def _leave_pipeline(self, pid: int) -> None:
         """Retire a closed pipeline's raft group: stop the node, drop it
         from the rejoin record, delete its log (container data stays)."""
@@ -239,6 +259,16 @@ class DatanodeDaemon:
                 self._join_pipeline(cmd)
             elif isinstance(cmd, dict) and cmd.get("type") == "leave-pipeline":
                 self._leave_pipeline(int(cmd["pipeline_id"]))
+                # group stopped: no more applies can land, so a replica
+                # that missed the raft close converges by direct close
+                if cmd.get("container_id") is not None:
+                    try:
+                        self.dn.close_container(int(cmd["container_id"]))
+                    except StorageError:
+                        pass
+            elif isinstance(cmd, dict) and \
+                    cmd.get("type") == "close-container":
+                self._close_container(cmd)
             else:
                 log.debug("%s ignoring command %r", self.dn.id, cmd)
         except Exception:
@@ -326,13 +356,37 @@ class ScmOmDaemon:
 
         self.scm.containers.on_pipeline_created = _announce_pipeline
 
+        def _announce_container_close(c):
+            # RATIS containers close THROUGH the pipeline raft ring so the
+            # close is ordered after every in-flight replicated write; the
+            # member that is leader submits, the others ignore. EC /
+            # standalone replicas close directly.
+            via_raft = (
+                c.pipeline is not None
+                and c.pipeline.replication.type is ReplicationType.RATIS
+                and c.pipeline.replication.factor > 1
+            )
+            for dn in (c.pipeline.nodes if c.pipeline else []):
+                self.scm.nodes.queue_command(dn, {
+                    "type": "close-container", "container_id": c.id,
+                    "pipeline_id": c.pipeline.id if via_raft else None,
+                })
+
+        self.scm.containers.on_container_closing = _announce_container_close
+
         def _retire_pipeline(p):
             if p.replication.type is not ReplicationType.RATIS \
                     or p.replication.factor < 2:
                 return
+            # carry the (1:1) container so a member that had not yet
+            # applied the raft close still converges after the group stops
+            cid = next((c.id for c in self.scm.containers.containers()
+                        if c.pipeline is not None and c.pipeline.id == p.id),
+                       None)
             for dn in p.nodes:
                 self.scm.nodes.queue_command(dn, {
                     "type": "leave-pipeline", "pipeline_id": p.id,
+                    "container_id": cid,
                 })
 
         self.scm.containers.on_pipeline_closed = _retire_pipeline

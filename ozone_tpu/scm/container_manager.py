@@ -88,6 +88,11 @@ class ContainerManager:
         # pipeline's raft group
         self.on_pipeline_created = None
         self.on_pipeline_closed = None
+        # fired when a container enters CLOSING: the daemon queues
+        # close-container commands so replicas actually close and report
+        # CLOSED back (the reference's CloseContainerCommand round trip —
+        # without it CLOSING would never converge to CLOSED)
+        self.on_container_closing = None
         # optional persistence (reference: SCM metadata in RocksDB with
         # HA-safe SequenceIdGenerator; replicas rebuild from reports)
         self._db = None
@@ -308,7 +313,28 @@ class ContainerManager:
         if c.state is ContainerState.OPEN:
             c.state = ContainerState.CLOSING
             self._persist(c)
-            self._close_pipeline(c)
+            # the pipeline stays live through CLOSING: a RATIS close is
+            # ordered through the pipeline's raft ring AFTER in-flight
+            # writes; the pipeline retires at mark_closed
+            self._fire_container_closing(c)
+
+    def _fire_container_closing(self, c: ContainerInfo) -> None:
+        if self.on_container_closing is not None:
+            try:
+                self.on_container_closing(c)
+            except Exception:  # noqa: BLE001 - lifecycle must not fail
+                log.exception("container-closing hook failed for %s", c.id)
+
+    def resend_closing(self) -> None:
+        """Re-announce close for every CLOSING container (background
+        sweep): close commands are fire-and-forget over in-memory queues,
+        so an SCM restart or missed heartbeat must not leave a container
+        CLOSING forever."""
+        with self._lock:
+            closing = [c for c in self._containers.values()
+                       if c.state is ContainerState.CLOSING]
+        for c in closing:
+            self._fire_container_closing(c)
 
     def mark_closed(self, container_id: int) -> None:
         c = self._containers[container_id]

@@ -68,18 +68,26 @@ class NodeManager:
     # ---------------------------------------------------------------- members
     def register(self, dn_id: str, rack: str = "/default-rack",
                  capacity_bytes: int = 0) -> None:
+        # events publish OUTSIDE the lock: handlers take other managers'
+        # locks (e.g. ContainerManager), and those managers' hooks call
+        # back into queue_command — publishing under the lock would make
+        # the A->B / B->A deadlock reachable
+        is_new = False
         with self._lock:
             if dn_id not in self._nodes:
                 self._nodes[dn_id] = NodeInfo(dn_id, rack, capacity_bytes,
                                               last_heartbeat=self.clock())
                 self._commands.setdefault(dn_id, [])
-                self.events.publish(NEW_NODE, dn_id)
+                is_new = True
             else:
                 self._nodes[dn_id].last_heartbeat = self.clock()
+        if is_new:
+            self.events.publish(NEW_NODE, dn_id)
 
     def process_heartbeat(self, dn_id: str, used_bytes: int = 0) -> list[Any]:
         """Record a heartbeat; return queued commands for the node
         (SCM commands ride heartbeat responses in the reference)."""
+        recovered = False
         with self._lock:
             n = self._nodes.get(dn_id)
             if n is None:
@@ -89,25 +97,30 @@ class NodeManager:
             n.used_bytes = used_bytes
             if n.state is not NodeState.HEALTHY:
                 n.state = NodeState.HEALTHY
-                self.events.publish(HEALTHY_READBACK, dn_id)
+                recovered = True
             cmds, self._commands[dn_id] = self._commands.get(dn_id, []), []
-            return cmds
+        if recovered:
+            self.events.publish(HEALTHY_READBACK, dn_id)
+        return cmds
 
     def check_liveness(self) -> None:
         """Periodic sweep advancing HEALTHY->STALE->DEAD by heartbeat age."""
         now = self.clock()
+        transitions: list[tuple[str, str]] = []
         with self._lock:
             for n in self._nodes.values():
                 age = now - n.last_heartbeat
                 if age > self.dead_after and n.state is not NodeState.DEAD:
                     n.state = NodeState.DEAD
-                    self.events.publish(DEAD_NODE, n.dn_id)
+                    transitions.append((DEAD_NODE, n.dn_id))
                 elif (
                     self.stale_after < age <= self.dead_after
                     and n.state is NodeState.HEALTHY
                 ):
                     n.state = NodeState.STALE
-                    self.events.publish(STALE_NODE, n.dn_id)
+                    transitions.append((STALE_NODE, n.dn_id))
+        for topic, dn_id in transitions:
+            self.events.publish(topic, dn_id)
 
     # ---------------------------------------------------------------- queries
     def get(self, dn_id: str) -> Optional[NodeInfo]:

@@ -108,6 +108,15 @@ class StorageContainerManager:
         return self.nodes.process_heartbeat(dn_id, used_bytes)
 
     def _on_dead_node(self, dn_id: str) -> None:
+        # events are published outside the NodeManager lock (deadlock
+        # avoidance), so the node may have heartbeated back between the
+        # transition and this dispatch — re-validate before purging a
+        # healthy node's replica records
+        n = self.nodes.get(dn_id)
+        if n is None or n.state is not nm.NodeState.DEAD:
+            log.info("node %s recovered before dead-node handling; skipped",
+                     dn_id)
+            return
         affected = self.containers.remove_replicas_of_node(dn_id)
         log.info("node %s dead; %d containers affected", dn_id, len(affected))
         self.metrics.counter("dead_nodes").inc()
@@ -183,6 +192,7 @@ class StorageContainerManager:
             self.replication.run_once()
             self.decommission_monitor.run_once()
             self.block_deleting.run_once()
+            self.containers.resend_closing()
             if self.balancer_enabled:
                 self.balancer.run_iteration()
 

@@ -160,3 +160,144 @@ def test_reconstruction_over_grpc(cluster):
         for g in groups
     ]
     assert np.array_equal(np.concatenate(parts), data)
+
+
+def test_container_close_converges(tmp_path):
+    """A full container goes CLOSING on the SCM, the close command
+    reaches every replica over heartbeats, replicas close and report
+    back, and the SCM marks it CLOSED (CloseContainerCommand round
+    trip) — making it scannable for the background scrubber."""
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.storage.ids import ContainerState
+
+    meta = ScmOmDaemon(
+        tmp_path / "om.db",
+        block_size=64 * 1024,
+        container_size=128 * 1024,  # two blocks fill a container
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+        background_interval_s=0.2,
+    )
+    meta.start()
+    dns = [
+        DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                       heartbeat_interval_s=0.1)
+        for i in range(5)
+    ]
+    for d in dns:
+        d.start()
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        oz.create_volume("v")
+        b = oz.get_volume("v").create_bucket("b",
+                                             replication="rs-3-2-4096")
+        payload = np.random.default_rng(8).integers(
+            0, 256, 64 * 1024, dtype=np.uint8).tobytes()
+        for i in range(4):  # spans multiple containers
+            b.write_key(f"k{i}", payload)
+        deadline = time.monotonic() + 15
+        closed = []
+        while time.monotonic() < deadline:
+            closed = [c for c in meta.scm.containers.containers()
+                      if c.state is ContainerState.CLOSED]
+            if closed:
+                break
+            time.sleep(0.2)
+        assert closed, [
+            (c.id, c.state.value)
+            for c in meta.scm.containers.containers()
+        ]
+        # the replicas themselves are closed on the datanodes
+        cid = closed[0].id
+        on_dns = [d for d in dns
+                  if d.dn.containers.get_or_none(cid) is not None]
+        assert on_dns
+        for d in on_dns:
+            assert d.dn.containers.get(cid).state in (
+                ContainerState.CLOSED, ContainerState.QUASI_CLOSED)
+        # read-back still works from closed containers
+        for i in range(4):
+            assert b.read_key(f"k{i}").tobytes() == payload
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
+
+
+def test_ratis_container_close_rides_the_raft_ring(tmp_path):
+    """Closing a RATIS container is ordered through the pipeline raft
+    group (never a bare per-replica close racing replicated writes), and
+    a writer that hits the closed container reallocates instead of
+    blacklisting healthy nodes."""
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.net.ratis_service import RatisClientFactory
+    from ozone_tpu.net.scm_service import GrpcScmClient
+    from ozone_tpu.storage.ids import ContainerState
+
+    meta = ScmOmDaemon(
+        tmp_path / "om.db",
+        block_size=64 * 1024,
+        container_size=128 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+        background_interval_s=0.2,
+    )
+    meta.start()
+    dns = [
+        DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                       heartbeat_interval_s=0.1)
+        for i in range(3)
+    ]
+    for d in dns:
+        d.start()
+    try:
+        clients = DatanodeClientFactory()
+        om = GrpcOmClient(meta.address, clients=clients)
+        for dn_id, addr in GrpcScmClient(
+                meta.address).node_addresses().items():
+            clients.register_remote(dn_id, addr)
+        ratis = RatisClientFactory(address_source=clients.remote_address)
+        oz = OzoneClient(om, clients, ratis_clients=ratis)
+        oz.create_volume("v")
+        b = oz.get_volume("v").create_bucket("b",
+                                             replication="RATIS/THREE")
+        payload = np.random.default_rng(9).integers(
+            0, 256, 64 * 1024, dtype=np.uint8).tobytes()
+        # enough keys to fill and roll containers while writing
+        for i in range(5):
+            b.write_key(f"k{i}", payload)
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            closed = [c for c in meta.scm.containers.containers()
+                      if c.state is ContainerState.CLOSED]
+            if closed:
+                break
+            time.sleep(0.2)
+        assert closed
+        # datanode replicas of the closed container converge to CLOSED
+        cid = closed[0].id
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            states = {d.dn.id: d.dn.containers.get_or_none(cid)
+                      for d in dns}
+            vals = [c.state for c in states.values() if c is not None]
+            if vals and all(
+                    s in (ContainerState.CLOSED,
+                          ContainerState.QUASI_CLOSED) for s in vals):
+                break
+            time.sleep(0.2)
+        assert vals and all(
+            s in (ContainerState.CLOSED, ContainerState.QUASI_CLOSED)
+            for s in vals), states
+        for i in range(5):
+            assert b.read_key(f"k{i}").tobytes() == payload
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
