@@ -66,16 +66,37 @@ class MetaHARing(RaftSCM):
         if idx <= self._applied_floor:
             return None  # already durably applied before the restart
         if "om" in data:
-            try:
-                result = rq.OMRequest.from_json(data["om"]).apply(
-                    self.om.store)
-            except rq.OMError as e:
-                result = e  # deterministic: replicas converge on the error
+            if self.om.prepared:
+                # deterministic by log position: every entry after the
+                # om_prepare marker converges to the same rejection on
+                # every replica (a write proposed concurrently with the
+                # marker must not apply behind the operator's back)
+                result = rq.OMError(
+                    "OM_PREPARED",
+                    "OM is prepared for upgrade; writes are rejected "
+                    "until cancelprepare")
+            else:
+                try:
+                    result = rq.OMRequest.from_json(data["om"]).apply(
+                        self.om.store)
+                except rq.OMError as e:
+                    result = e  # deterministic: replicas converge on it
         elif "admin" in data:
             # replicated operator decision (decommission/safemode/
             # balancer): applied on every replica so it survives failover
             result = self.scm.apply_admin_op(
                 data["admin"]["op"], data["admin"].get("target"))
+        elif "om_prepare" in data:
+            # coordinated upgrade quiesce: every replica durably flushes
+            # and rejects writes (the OzoneManagerPrepareState marker).
+            # Called UNBOUND: the daemon patches the instance's prepare
+            # to the ring's leader entry point, and apply must run the
+            # local state change, not re-propose.
+            if data["om_prepare"]:
+                result = OzoneManager.prepare(self.om)
+            else:
+                OzoneManager.cancel_prepare(self.om)
+                result = None
         else:
             result = super()._apply(data)
         self._applied_floor = idx
@@ -91,6 +112,10 @@ class MetaHARing(RaftSCM):
     def _restore_all(self, snap: dict) -> None:
         if "om" in snap:
             self.om.store.import_state(snap["om"])
+            # the durable quiesce marker rides the system table: refresh
+            # the cached flag so a snapshot-installed replica agrees with
+            # its peers on prepared state
+            self.om.reload_prepared()
         if "scm" in snap:
             self.scm.containers.install_snapshot(snap["scm"])
 
@@ -118,6 +143,11 @@ class MetaHARing(RaftSCM):
             # state, which may lag the committed line until the no-op
             # applies (clients retry through the failover proxy)
             raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        if self.om.prepared:
+            raise rq.OMError(
+                "OM_PREPARED",
+                "OM is prepared for upgrade; writes are rejected until "
+                "cancelprepare")
         request.pre_execute(self.om)
         result = self.node.propose({"om": request.to_json()})
         # block allocation in preExecute produced SCM decision records;
@@ -126,6 +156,22 @@ class MetaHARing(RaftSCM):
         if isinstance(result, Exception):
             raise result
         return result
+
+    def prepare_om(self) -> int:
+        """Replicated `om prepare`: every replica flushes + quiesces."""
+        if not self.node.is_ready_leader:
+            raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        result = self.node.propose({"om_prepare": True})
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+    def cancel_prepare_om(self) -> None:
+        if not self.node.is_leader:
+            raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        result = self.node.propose({"om_prepare": False})
+        if isinstance(result, Exception):
+            raise result
 
     def submit_admin(self, op: str, target=None) -> dict:
         """Replicate a mutating admin op (the SCMRatisRequest shape for

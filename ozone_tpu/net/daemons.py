@@ -447,6 +447,25 @@ class ScmOmDaemon:
             # all funnel into submit); reads are leader-gated at the
             # service edge so clients get read-your-writes
             self.om.submit = _ha_submit
+
+            def _ha_prepare():
+                try:
+                    return self.ha.prepare_om()
+                except NotRaftLeaderError as e:
+                    raise StorageError(
+                        "OM_NOT_LEADER",
+                        self._leader_address(e.leader_hint))
+
+            def _ha_cancel_prepare():
+                try:
+                    self.ha.cancel_prepare_om()
+                except NotRaftLeaderError as e:
+                    raise StorageError(
+                        "OM_NOT_LEADER",
+                        self._leader_address(e.leader_hint))
+
+            self.om.prepare = _ha_prepare
+            self.om.cancel_prepare = _ha_cancel_prepare
             self.om_service.gate = self._leader_gate
 
             def _scm_barrier():

@@ -198,6 +198,13 @@ class OmGrpcService:
                         m["volume"], m["bucket"], m["path"]
                     )
                 ),
+                # upgrade quiesce (`ozone admin om prepare` analog)
+                "Prepare": self._wrap(
+                    lambda m: {"txid": self.om.prepare()}),
+                "CancelPrepare": self._wrap(
+                    lambda m: self.om.cancel_prepare()),
+                "PrepareStatus": self._wrap(
+                    lambda m: {"prepared": self.om.prepared}),
         }
         server.add_service(
             SERVICE, {n: self._gated(fn) for n, fn in methods.items()})
@@ -590,6 +597,15 @@ class GrpcOmClient:
     def list_status(self, volume, bucket, path):
         return self._call("ListStatus", volume=volume, bucket=bucket,
                           path=path)["result"]
+
+    def prepare(self):
+        return self._call("Prepare")["result"]
+
+    def cancel_prepare(self):
+        self._call("CancelPrepare")
+
+    def prepare_status(self):
+        return self._call("PrepareStatus")["result"]
 
     def close(self):
         self._pool.close()

@@ -68,6 +68,9 @@ class OzoneManager:
         self.metrics = MetricsRegistry("om")
         self.audit = AuditLogger("om")
         self._lock = threading.RLock()
+        # durable upgrade-quiesce marker (OzoneManagerPrepareState):
+        # rides the metadata store so a restart is deterministic
+        self._prepared = self.store.get("system", "om_prepared") is not None
         # native authorizer (reference ozone.acl.enabled, default off)
         self.acl_enabled = False
         self._authorizer = None
@@ -197,13 +200,55 @@ class OzoneManager:
         return [r for _, r in self.store.iterate("tenant_access")
                 if r["tenant"] == tenant]
 
+    # ----------------------------------------------------------- prepare
+    def prepare(self) -> int:
+        """Quiesce writes for a coordinated upgrade (`ozone om prepare` /
+        OzoneManagerPrepareState analog): flush the double buffer, reject
+        further writes until cancel_prepare, return the prepared txid.
+        The marker is durable (system table) so restarts stay prepared."""
+        with self._lock:
+            self.store.put("system", "om_prepared", {"prepared": True})
+            self.store.flush()
+            self._prepared = True
+            return self.store.txid
+
+    def cancel_prepare(self) -> None:
+        with self._lock:
+            self.store.delete("system", "om_prepared")
+            self.store.flush()
+            self._prepared = False
+
+    def reload_prepared(self) -> None:
+        """Re-read the durable marker (after a snapshot install replaced
+        the underlying tables)."""
+        with self._lock:
+            self._prepared = \
+                self.store.get("system", "om_prepared") is not None
+
+    @property
+    def prepared(self) -> bool:
+        return getattr(self, "_prepared", False)
+
     # ----------------------------------------------------------- write path
     def submit(self, request: rq.OMRequest) -> Any:
         """preExecute on the leader, then apply (the future Raft boundary
         sits between the two)."""
+        if self.prepared:
+            raise rq.OMError(
+                "OM_PREPARED",
+                "OM is prepared for upgrade; writes are rejected until "
+                "cancelprepare")
         with self.metrics.timer(request.audit_action).time():
             request.pre_execute(self)
             with self._lock:
+                if self.prepared:
+                    # re-check under the lock: a write that passed the
+                    # fast-path check must not apply after prepare()'s
+                    # flush point (the quiesce would be a lie)
+                    raise rq.OMError(
+                        "OM_PREPARED",
+                        "OM is prepared for upgrade; writes are rejected "
+                        "until cancelprepare")
                 try:
                     result = request.apply(self.store)
                     # durable before ack: the reference's double buffer

@@ -240,6 +240,20 @@ def cmd_admin(args) -> int:
         _emit(scm.admin(f"balancer-{verb or 'status'}"))
     elif subject == "replicationmanager":
         _emit(scm.admin("replication-status"))
+    elif subject == "om":
+        from ozone_tpu.net.om_service import GrpcOmClient
+
+        om = GrpcOmClient(args.om)
+        if verb == "prepare":
+            _emit(om.prepare())
+        elif verb == "cancelprepare":
+            om.cancel_prepare()
+            _emit({"prepared": False})
+        elif verb in (None, "status"):
+            _emit(om.prepare_status())
+        else:
+            return usage(f"unknown om verb {verb!r} "
+                         "(expected prepare|cancelprepare|status)")
     elif subject == "status":
         _emit(scm.status())
     return 0
@@ -553,7 +567,7 @@ def build_parser() -> argparse.ArgumentParser:
     ad = sub.add_parser("admin", help="cluster admin (ozone admin analog)")
     ad.add_argument("subject", choices=[
         "safemode", "datanode", "status", "pipeline", "container",
-        "balancer", "replicationmanager",
+        "balancer", "replicationmanager", "om",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"

@@ -204,6 +204,29 @@ def test_ha_admin_ops_survive_failover(ha_cluster):
     scm.close()
 
 
+def test_ha_om_prepare_quiesces_every_replica(ha_cluster):
+    """Replicated upgrade quiesce: prepare rejects writes on the whole
+    ring; cancelprepare resumes them."""
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    metas, dns, peers, _ = ha_cluster
+    om = GrpcOmClient(",".join(peers.values()))
+    oz_before = om.prepare()
+    assert oz_before["txid"] >= 0
+    time.sleep(0.5)  # followers apply the marker
+    prepared = [d.om.prepared for d in metas.values()]
+    assert all(prepared), prepared
+    with pytest.raises(StorageError) as ei:
+        om.create_volume("nope")
+    assert ei.value.code == "OM_PREPARED"
+    om.cancel_prepare()
+    om.create_volume("resumed")
+    assert any(v["name"] == "resumed"
+               for d in metas.values() if d.ha.is_leader
+               for v in d.om.list_volumes())
+    om.close()
+
+
 def test_ha_restart_does_not_reapply_flushed_entries(tmp_path):
     """Replay floor: entries flushed to the OM store before a restart are
     skipped on raft log replay (re-applying would duplicate

@@ -201,3 +201,30 @@ def test_om_restart_preserves_metadata(tmp_path):
         c.scm.stop()
         for dn in c.datanodes:
             dn.close()
+
+
+def test_mini_ha_cluster_failover_roundtrip(tmp_path):
+    """MiniOzoneHACluster (MiniOzoneHAClusterImpl analog): boot, write,
+    kill the leader, write again, revive, converge."""
+    import numpy as np
+
+    from ozone_tpu.testing.minicluster import MiniOzoneHACluster
+
+    cluster = MiniOzoneHACluster(tmp_path, num_meta=3, num_datanodes=5)
+    try:
+        oz = cluster.client()
+        payload = np.random.default_rng(1).integers(
+            0, 256, 100_000, dtype=np.uint8).tobytes()
+        oz.create_volume("v")
+        b = oz.get_volume("v").create_bucket("b",
+                                             replication="rs-3-2-4096")
+        b.write_key("k1", payload)
+        leader = cluster.await_leader()
+        cluster.stop_meta(leader)
+        b.write_key("k2", payload)
+        assert b.read_key("k1").tobytes() == payload
+        cluster.revive_meta(leader)
+        cluster.await_leader()
+        assert b.read_key("k2").tobytes() == payload
+    finally:
+        cluster.shutdown()

@@ -121,6 +121,24 @@ def test_cli_admin_operator_verbs(cluster, capsys):
     assert json.loads(capsys.readouterr().out)["op_state"] == "IN_SERVICE"
 
 
+def test_cli_om_prepare_quiesces_writes(cluster, capsys):
+    """`admin om prepare` flushes and rejects writes until
+    cancelprepare (ozone om prepare analog)."""
+    meta, dns = cluster
+    om = meta.address
+    assert cli_main(["admin", "om", "prepare", "--om", om]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["txid"] >= 0
+    assert cli_main(["admin", "om", "status", "--om", om]) == 0
+    assert json.loads(capsys.readouterr().out)["prepared"] is True
+    # writes rejected while prepared
+    assert cli_main(["sh", "volume", "create", "/prepv", "--om", om]) == 1
+    assert "OM_PREPARED" in capsys.readouterr().err
+    assert cli_main(["admin", "om", "cancelprepare", "--om", om]) == 0
+    capsys.readouterr()
+    assert cli_main(["sh", "volume", "create", "/prepv", "--om", om]) == 0
+
+
 def test_cli_admin_rejects_bad_input(cluster, capsys):
     meta, dns = cluster
     om = meta.address
