@@ -225,6 +225,7 @@ class RaftNode:
         config: RaftConfig = RaftConfig(),
         transport: Optional["Transport"] = None,
         on_step_down: Optional[Callable[[], None]] = None,
+        metrics_name: Optional[str] = None,
     ):
         self.node_id = node_id
         self.peer_ids = [p for p in peer_ids if p != node_id]
@@ -240,6 +241,14 @@ class RaftNode:
         self.leader_hint: Optional[str] = None
         self.commit_index = self.storage.snapshot_index
         self.last_applied = self.storage.snapshot_index
+        # per-group metrics (Ratis server metrics analog: role/term/
+        # indices + election and apply counters), exported through the
+        # daemon's /prom. A node serving several raft groups (one per
+        # pipeline) must pass a distinct metrics_name per group or the
+        # groups would clobber each other's gauges.
+        from ozone_tpu.utils.metrics import registry
+
+        self.metrics = registry(metrics_name or f"raft.{node_id}")
         self.next_index: dict[str, int] = {}
         self.match_index: dict[str, int] = {}
         #: leader-side view of each follower's apply watermark (reported
@@ -345,6 +354,7 @@ class RaftNode:
                 pre += 1
         if pre < quorum:
             return False
+        self.metrics.counter("elections_started").inc()
         with self._lock:
             self.role = CANDIDATE
             self.storage.term += 1
@@ -389,6 +399,8 @@ class RaftNode:
         self.match_index = {p: 0 for p in self.peer_ids}
         log.info("raft %s: leader of term %d at index %d",
                  self.node_id, self.storage.term, self.storage.last_index)
+        self.metrics.counter("elections_won").inc()
+        self.metrics.gauge("is_leader").set(1)
         # replicate a no-op so the new leader can commit prior-term entries
         # (Raft §5.4.2 / Ratis leader-ready marker); until it applies,
         # this leader may not have applied everything already committed
@@ -401,6 +413,9 @@ class RaftNode:
             self.storage.voted_for = None
             self.storage.persist_meta()
         self.role = FOLLOWER
+        self.metrics.gauge("is_leader").set(0)
+        if was_leader:
+            self.metrics.counter("step_downs").inc()
         if self.leader_hint == self.node_id:
             # a deposed leader must not keep advertising itself —
             # clients would pin to it and never find the real leader
@@ -570,8 +585,12 @@ class RaftNode:
                 except Exception as e:  # deterministic app error
                     result = e
             self.last_applied = idx
+            self.metrics.counter("entries_applied").inc()
             if idx in self._waiters:
                 self._results[idx] = result
+        self.metrics.gauge("term").set(self.storage.term)
+        self.metrics.gauge("commit_index").set(self.commit_index)
+        self.metrics.gauge("last_applied").set(self.last_applied)
         self._commit_cv.notify_all()
 
     def _heard_from_leader_recently(self) -> bool:

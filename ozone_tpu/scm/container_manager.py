@@ -366,6 +366,17 @@ class ContainerManager:
                 block_count=int(r.get("block_count", 0)),
                 used_bytes=int(r.get("used_bytes", 0)),
             )
+            if r["state"] == "UNHEALTHY" \
+                    and c.state is ContainerState.OPEN:
+                # an unhealthy replica of an OPEN container (reference
+                # ICR -> close flow): stop allocating into it — writers
+                # roll to a fresh container (allocate_block prunes the
+                # non-OPEN entry from its pool) and the replication
+                # manager repairs the poisoned replica once it closes
+                log.warning("container %d has unhealthy replica on %s; "
+                            "closing", cid, dn_id)
+                with self._lock:
+                    self.finalize_container(cid)
         # drop replicas this DN no longer reports
         for c in self._containers.values():
             if dn_id in c.replicas and c.id not in seen:

@@ -183,6 +183,7 @@ class RatisXceiverServer:
                 restore_fn=sm.restore,
                 config=self.config,
                 transport=transport,
+                metrics_name=f"raft.{self.dn.id}.{gid}",
             )
             self._groups[gid] = node
             if self.rpc_service is not None:

@@ -15,7 +15,7 @@ from collections import defaultdict
 from typing import Optional
 
 _all_registries: dict[str, "MetricsRegistry"] = {}
-_all_lock = threading.Lock()
+_all_lock = threading.RLock()  # registry() constructs while holding it
 
 
 class Counter:
@@ -105,6 +105,19 @@ class MetricsRegistry:
                 f"{k}_mean_s": t.mean for k, t in self._timers.items() if t.count
             },
         }
+
+
+def registry(name: str) -> MetricsRegistry:
+    """Get-or-create a named registry (components that may be
+    instantiated repeatedly — e.g. one RaftNode per pipeline group —
+    share one registry instead of orphaning the previous one)."""
+    with _all_lock:
+        r = _all_registries.get(name)
+        if r is None:
+            # construct under the lock: MetricsRegistry.__init__ inserts
+            # itself, and a racing create would orphan the loser
+            r = MetricsRegistry(name)
+        return r
 
 
 def _sanitize(s: str) -> str:

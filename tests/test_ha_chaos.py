@@ -103,3 +103,83 @@ def test_meta_ha_chaos_replica_restarts(tmp_path, seed):
             d.stop()
         for d in metas.values():
             d.stop()
+
+
+@pytest.mark.parametrize("seed", [23])
+def test_dn_raft_chaos_pipeline_member_restarts(tmp_path, seed):
+    """RATIS pipeline chaos: a member datanode is killed and revived
+    while raft-ordered writes flow; every acked key reads back."""
+    from ozone_tpu.net.daemons import ScmOmDaemon
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.net.ratis_service import RatisClientFactory
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    rng = random.Random(seed)
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=256 * 1024,
+                       stale_after_s=1000.0, dead_after_s=2000.0,
+                       background_interval_s=0.2)
+    meta.start()
+    dns = {}
+    for i in range(3):
+        d = DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                           heartbeat_interval_s=0.1)
+        d.start()
+        dns[f"dn{i}"] = d
+    stop = threading.Event()
+    acked: list[str] = []
+    write_errors: list[Exception] = []
+    try:
+        clients = DatanodeClientFactory()
+        om = GrpcOmClient(meta.address, clients=clients)
+        for dn_id, addr in GrpcScmClient(
+                meta.address).node_addresses().items():
+            clients.register_remote(dn_id, addr)
+        ratis = RatisClientFactory(address_source=clients.remote_address)
+        oz = OzoneClient(om, clients, ratis_clients=ratis)
+        oz.create_volume("v")
+        bucket = oz.get_volume("v").create_bucket(
+            "b", replication="RATIS/THREE")
+        payload = np.random.default_rng(seed).integers(
+            0, 256, 50_000, dtype=np.uint8).tobytes()
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                key = f"k{n}"
+                try:
+                    bucket.write_key(key, payload)
+                    acked.append(key)
+                except StorageError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    write_errors.append(e)
+                    return
+                n += 1
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        for _ in range(2):
+            time.sleep(1.5)
+            victim = rng.choice(sorted(dns))
+            dns.pop(victim).stop()
+            time.sleep(1.5)
+            revived = DatanodeDaemon(tmp_path / victim, victim,
+                                     meta.address,
+                                     heartbeat_interval_s=0.1)
+            revived.start()
+            dns[victim] = revived
+        time.sleep(1.0)
+        stop.set()
+        wt.join(timeout=60)
+        assert not wt.is_alive(), "writer wedged"
+        assert not write_errors, write_errors
+        assert len(acked) >= 2, f"no progress: {acked}"
+        for key in acked:
+            assert bucket.read_key(key).tobytes() == payload, key
+    finally:
+        stop.set()
+        for d in dns.values():
+            d.stop()
+        meta.stop()
