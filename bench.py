@@ -28,6 +28,28 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
+def probe_devices(timeout_s: float = 120.0):
+    """Fail fast if the TPU backend is unreachable: the first backend
+    call against a dead axon tunnel blocks forever, which would hang the
+    whole bench run instead of erroring."""
+    import threading
+
+    out: list = []
+
+    def attempt():
+        import jax
+
+        out.append(jax.devices())
+
+    t = threading.Thread(target=attempt, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if not out:
+        log(f"device backend unreachable after {timeout_s}s; aborting")
+        sys.exit(2)
+    log(f"devices: {out[0]}")
+
+
 def bench_fused_encode(batch: int = 96, cell: int = 1024 * 1024,
                        iters: int = 8, rounds: int = 5) -> float:
     """Batch 96 (576 MiB of data per dispatch) measured best on v5e:
@@ -155,6 +177,7 @@ def bench_cpp_fused(cell: int = 1024 * 1024) -> float:
 
 
 def main() -> None:
+    probe_devices()
     value = bench_fused_encode()
     log(f"fused RS(6,3) encode+CRC32C: {value:.2f} GiB/s/chip")
     try:
