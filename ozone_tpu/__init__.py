@@ -22,3 +22,20 @@ Package map (SURVEY.md section 7 build order):
 """
 
 __version__ = "0.1.0"
+
+# Honor JAX_PLATFORMS=cpu reliably: this image's sitecustomize registers
+# a TPU PJRT plugin whose backend discovery can block indefinitely on a
+# dead tunnel even when the environment asks for cpu — only
+# jax.config.update pins the platform for certain. Daemons and the CLI
+# are launched with JAX_PLATFORMS=cpu on hosts without a chip; this makes
+# that contract hold. (jax is on the import path of every client/codec
+# flow already, so the eager import costs nothing extra.)
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    try:
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 - jax-less installs still import
+        pass
