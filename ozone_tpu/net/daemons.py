@@ -89,6 +89,20 @@ class DatanodeDaemon:
         self._scrubber = DeviceScrubber()
         self._scan_cursor = 0
         self._scanner: Optional[threading.Thread] = None
+        # persisted operational state (reference persistedOpState): set
+        # by SCM commands, echoed back at registration so a restarted
+        # SCM relearns an in-progress drain
+        self._op_state_file = Path(root) / "op_state.json"
+        self._op_state: Optional[str] = None
+        if self._op_state_file.exists():
+            import json as _json
+
+            try:
+                loaded = _json.loads(self._op_state_file.read_text())
+                if isinstance(loaded, dict):
+                    self._op_state = loaded.get("op_state")
+            except ValueError:
+                pass  # corrupt marker: start IN_SERVICE, SCM re-drives
 
     @property
     def address(self) -> str:
@@ -97,7 +111,8 @@ class DatanodeDaemon:
     def start(self) -> None:
         self.server.start()
         self._rejoin_pipelines()
-        self.scm.register(self.dn.id, self.address, rack=self.rack)
+        self.scm.register(self.dn.id, self.address, rack=self.rack,
+                          op_state=self._op_state)
         self._hb = threading.Thread(
             target=self._heartbeat_loop, name=f"hb-{self.dn.id}", daemon=True
         )
@@ -169,6 +184,17 @@ class DatanodeDaemon:
         tmp = self._groups_file.with_suffix(".tmp")
         tmp.write_text(json.dumps(groups))
         tmp.replace(self._groups_file)
+
+    def _set_op_state(self, state: Optional[str]) -> None:
+        import json as _json
+
+        self._op_state = state if state != "IN_SERVICE" else None
+        if self._op_state is None:
+            self._op_state_file.unlink(missing_ok=True)
+        else:
+            tmp = self._op_state_file.with_suffix(".tmp")
+            tmp.write_text(_json.dumps({"op_state": self._op_state}))
+            tmp.replace(self._op_state_file)
 
     def _close_container(self, cmd: dict) -> None:
         cid = int(cmd["container_id"])
@@ -254,7 +280,10 @@ class DatanodeDaemon:
                 self._learn_addresses(self.scm.node_addresses())
                 self._replicate(cmd)
             elif isinstance(cmd, dict) and cmd.get("type") == "register":
-                self.scm.register(self.dn.id, self.address, rack=self.rack)
+                self.scm.register(self.dn.id, self.address, rack=self.rack,
+                                  op_state=self._op_state)
+            elif isinstance(cmd, dict) and cmd.get("type") == "set-op-state":
+                self._set_op_state(cmd.get("op_state"))
             elif isinstance(cmd, dict) and cmd.get("type") == "join-pipeline":
                 self._join_pipeline(cmd)
             elif isinstance(cmd, dict) and cmd.get("type") == "leave-pipeline":

@@ -126,6 +126,7 @@ class ScmGrpcService:
         self.scm.register_datanode(
             m["dn_id"], m.get("rack", "/default-rack"),
             m.get("capacity_bytes", 0),
+            op_state=m.get("op_state"),
         )
         if changed and self.on_register is not None:
             # a restarted node binds a new port: peers holding the old
@@ -311,10 +312,11 @@ class GrpcScmClient:
         return out
 
     def register(self, dn_id: str, address: str, rack: str = "/default-rack",
-                 capacity_bytes: int = 0) -> None:
+                 capacity_bytes: int = 0,
+                 op_state: Optional[str] = None) -> None:
         self._broadcast("Register", {
             "dn_id": dn_id, "address": address, "rack": rack,
-            "capacity_bytes": capacity_bytes,
+            "capacity_bytes": capacity_bytes, "op_state": op_state,
         })
 
     def heartbeat(self, dn_id: str, container_report=None,

@@ -96,6 +96,7 @@ class ContainerManager:
         # optional persistence (reference: SCM metadata in RocksDB with
         # HA-safe SequenceIdGenerator; replicas rebuild from reports)
         self._db = None
+        self._node_op_states: dict[str, str] = {}
         if db_path is not None:
             from ozone_tpu.scm.scm_store import ScmStore
 
@@ -133,6 +134,7 @@ class ContainerManager:
                 self._writable.setdefault(str(repl), []).append(info.id)
         self._next_cid = state["next_container_id"]
         self._next_lid = state["next_local_id"]
+        self._node_op_states = dict(state.get("node_op_states", {}))
 
     def _row(self, c: ContainerInfo) -> dict:
         return {
@@ -324,6 +326,18 @@ class ContainerManager:
                 self.on_container_closing(c)
             except Exception:  # noqa: BLE001 - lifecycle must not fail
                 log.exception("container-closing hook failed for %s", c.id)
+
+    def node_op_states(self) -> dict[str, str]:
+        """Durable node operational states loaded at recovery."""
+        return dict(self._node_op_states)
+
+    def persist_node_op_state(self, dn_id: str, state: str) -> None:
+        if state == "IN_SERVICE":
+            self._node_op_states.pop(dn_id, None)
+        else:
+            self._node_op_states[dn_id] = state
+        if self._db is not None:
+            self._db.save_node_op_state(dn_id, state)
 
     def resend_closing(self) -> None:
         """Re-announce close for every CLOSING container (background

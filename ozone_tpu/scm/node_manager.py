@@ -9,6 +9,7 @@ events on transition — StaleNodeHandler/DeadNodeHandler).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from dataclasses import dataclass, field
@@ -16,6 +17,8 @@ from enum import Enum
 from typing import Any, Callable, Optional
 
 from ozone_tpu.utils.events import EventQueue
+
+log = logging.getLogger(__name__)
 
 
 class NodeState(Enum):
@@ -64,10 +67,15 @@ class NodeManager:
         self._nodes: dict[str, NodeInfo] = {}
         self._commands: dict[str, list[Any]] = {}
         self._lock = threading.Lock()
+        # SCM-durable op states (seeded from the SCM store at startup;
+        # authoritative over the DN's own echo) + persistence hook
+        self._seeded_op: dict[str, str] = {}
+        self.on_op_state_change = None
 
     # ---------------------------------------------------------------- members
     def register(self, dn_id: str, rack: str = "/default-rack",
-                 capacity_bytes: int = 0) -> None:
+                 capacity_bytes: int = 0,
+                 op_state: Optional[str] = None) -> None:
         # events publish OUTSIDE the lock: handlers take other managers'
         # locks (e.g. ContainerManager), and those managers' hooks call
         # back into queue_command — publishing under the lock would make
@@ -75,8 +83,21 @@ class NodeManager:
         is_new = False
         with self._lock:
             if dn_id not in self._nodes:
-                self._nodes[dn_id] = NodeInfo(dn_id, rack, capacity_bytes,
-                                              last_heartbeat=self.clock())
+                n = NodeInfo(dn_id, rack, capacity_bytes,
+                             last_heartbeat=self.clock())
+                # adopt an operational state on (re)registration: the
+                # SCM's own durable record wins; the node's persisted
+                # echo covers an SCM that lost its store (the reference
+                # adopts persistedOpState at register the same way)
+                adopted = self._seeded_op.get(dn_id) or op_state
+                if adopted:
+                    try:
+                        n.op_state = NodeOperationalState(adopted)
+                    except ValueError:
+                        log.warning(
+                            "%s reported unknown op state %r; treating "
+                            "as IN_SERVICE", dn_id, adopted)
+                self._nodes[dn_id] = n
                 self._commands.setdefault(dn_id, [])
                 is_new = True
             else:
@@ -150,6 +171,29 @@ class NodeManager:
         return len(self._commands.get(dn_id, []))
 
     # ---------------------------------------------------------------- admin
+    def seed_op_states(self, states: dict[str, str]) -> None:
+        """Install the SCM store's durable op-state records (applied to
+        nodes as they register)."""
+        with self._lock:
+            self._seeded_op.update(states)
+
     def set_op_state(self, dn_id: str, state: NodeOperationalState) -> None:
         n = self._nodes[dn_id]
         n.op_state = state
+        with self._lock:
+            if state is NodeOperationalState.IN_SERVICE:
+                self._seeded_op.pop(dn_id, None)
+            else:
+                self._seeded_op[dn_id] = state.value
+        if self.on_op_state_change is not None:
+            try:
+                self.on_op_state_change(dn_id, state.value)
+            except Exception:  # noqa: BLE001 - persistence must not fail ops
+                log.exception("op-state persistence failed for %s", dn_id)
+        # tell the datanode so it persists the state and reports it back
+        # at (re)registration — covers an SCM that lost its store
+        # (the reference's SetNodeOperationalStateCommand +
+        # persistedOpState round trip)
+        self.queue_command(dn_id, {
+            "type": "set-op-state", "op_state": state.value,
+        })

@@ -48,6 +48,11 @@ class StorageContainerManager:
             self.nodes, self.placement, container_size=container_size,
             db_path=db_path,
         )
+        # durable op-state round trip: the SCM store is authoritative
+        # across restarts; DN echoes cover a store-less SCM
+        self.nodes.seed_op_states(self.containers.node_op_states())
+        self.nodes.on_op_state_change = \
+            self.containers.persist_node_op_state
         self.safemode = SafeModeManager(
             self.nodes, self.containers, SafeModeConfig(min_datanodes)
         )
@@ -77,9 +82,10 @@ class StorageContainerManager:
 
     # ------------------------------------------------------------- datanodes
     def register_datanode(
-        self, dn_id: str, rack: str = "/default-rack", capacity_bytes: int = 0
+        self, dn_id: str, rack: str = "/default-rack",
+        capacity_bytes: int = 0, op_state=None,
     ) -> None:
-        self.nodes.register(dn_id, rack, capacity_bytes)
+        self.nodes.register(dn_id, rack, capacity_bytes, op_state=op_state)
         self.metrics.counter("registrations").inc()
 
     def heartbeat(

@@ -51,12 +51,34 @@ class ScmStore:
             meta = self._conn.execute(
                 "SELECT v FROM meta WHERE k='counters'"
             ).fetchone()
+            ops = self._conn.execute(
+                "SELECT v FROM meta WHERE k='node_op_states'"
+            ).fetchone()
         counters = json.loads(meta[0]) if meta else [1, 1]
         return {
             "containers": [json.loads(r[0]) for r in rows],
             "next_container_id": counters[0],
             "next_local_id": counters[1],
+            "node_op_states": json.loads(ops[0]) if ops else {},
         }
+
+    def save_node_op_state(self, dn_id: str, state: str) -> None:
+        """Durably record a node's operational state (IN_SERVICE clears
+        the entry) — a restarted SCM must not forget an in-flight drain."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM meta WHERE k='node_op_states'"
+            ).fetchone()
+            states = json.loads(row[0]) if row else {}
+            if state == "IN_SERVICE":
+                states.pop(dn_id, None)
+            else:
+                states[dn_id] = state
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta VALUES ('node_op_states', ?)",
+                (json.dumps(states),),
+            )
+            self._conn.commit()
 
     def close(self) -> None:
         with self._lock:

@@ -301,3 +301,63 @@ def test_ratis_container_close_rides_the_raft_ring(tmp_path):
         for d in dns:
             d.stop()
         meta.stop()
+
+
+def test_decommission_survives_scm_restart(tmp_path):
+    """The node persists its operational state (set-op-state command)
+    and echoes it at registration, so a restarted SCM relearns an
+    in-progress drain (persistedOpState round trip)."""
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    # huge background interval: the decommission monitor must not
+    # finalize the (container-less) node to DECOMMISSIONED mid-test
+    metas = [ScmOmDaemon(tmp_path / "om.db", stale_after_s=1000.0,
+                         dead_after_s=2000.0,
+                         background_interval_s=1000.0)]
+    metas[0].start()
+    dns = [
+        DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", metas[0].address,
+                       heartbeat_interval_s=0.1)
+        for i in range(3)
+    ]
+    for d in dns:
+        d.start()
+    try:
+        port = int(metas[0].address.rsplit(":", 1)[1])
+        scm = GrpcScmClient(metas[0].address)
+        scm.admin("decommission", "dn1")
+        # wait for the set-op-state command to reach and persist on dn1
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if dns[1]._op_state == "DECOMMISSIONING":
+                break
+            time.sleep(0.1)
+        assert dns[1]._op_state == "DECOMMISSIONING"
+        scm.close()
+
+        metas.pop().stop()
+        meta2 = ScmOmDaemon(tmp_path / "om.db", port=port,
+                            stale_after_s=1000.0, dead_after_s=2000.0,
+                            background_interval_s=1000.0)
+        metas.append(meta2)
+        meta2.start()
+        # the restarted SCM's durable store already knows the drain —
+        # before any datanode even re-registers
+        assert meta2.scm.nodes._seeded_op.get("dn1") == "DECOMMISSIONING"
+        deadline = time.monotonic() + 10
+        node = None
+        while time.monotonic() < deadline:
+            node = meta2.scm.nodes.get("dn1")
+            if node is not None:
+                break
+            time.sleep(0.1)
+        assert node is not None
+        assert node.op_state.value == "DECOMMISSIONING"
+        # healthy nodes come back IN_SERVICE
+        assert meta2.scm.nodes.get("dn0") is None or \
+            meta2.scm.nodes.get("dn0").op_state.value == "IN_SERVICE"
+    finally:
+        for d in dns:
+            d.stop()
+        for m in metas:
+            m.stop()
