@@ -10,6 +10,7 @@ independent objects behind independent gRPC services).
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 import time
@@ -95,10 +96,8 @@ class DatanodeDaemon:
         self._op_state_file = Path(root) / "op_state.json"
         self._op_state: Optional[str] = None
         if self._op_state_file.exists():
-            import json as _json
-
             try:
-                loaded = _json.loads(self._op_state_file.read_text())
+                loaded = json.loads(self._op_state_file.read_text())
                 if isinstance(loaded, dict):
                     self._op_state = loaded.get("op_state")
             except ValueError:
@@ -151,8 +150,6 @@ class DatanodeDaemon:
     def _rejoin_pipelines(self) -> None:
         """Re-open raft groups this node served before a restart (the
         reference reloads its RaftGroups from the ratis storage dirs)."""
-        import json
-
         if not self._groups_file.exists():
             return
         try:
@@ -168,8 +165,6 @@ class DatanodeDaemon:
                               self.dn.id, g.get("pipeline_id"))
 
     def _join_pipeline(self, cmd: dict) -> None:
-        import json
-
         pid = int(cmd["pipeline_id"])
         peers = dict(cmd["peers"])
         self.xceiver_ratis.join(pid, peers)
@@ -186,14 +181,12 @@ class DatanodeDaemon:
         tmp.replace(self._groups_file)
 
     def _set_op_state(self, state: Optional[str]) -> None:
-        import json as _json
-
         self._op_state = state if state != "IN_SERVICE" else None
         if self._op_state is None:
             self._op_state_file.unlink(missing_ok=True)
         else:
             tmp = self._op_state_file.with_suffix(".tmp")
-            tmp.write_text(_json.dumps({"op_state": self._op_state}))
+            tmp.write_text(json.dumps({"op_state": self._op_state}))
             tmp.replace(self._op_state_file)
 
     def _close_container(self, cmd: dict) -> None:
@@ -437,102 +430,7 @@ class ScmOmDaemon:
         self.ha = None
         self._ha_peers = dict(ha_peers or {})
         if ha_id is not None:
-            from ozone_tpu.consensus.meta_ring import MetaHARing
-            from ozone_tpu.consensus.raft import NotRaftLeaderError
-            from ozone_tpu.net.raft_transport import (
-                GrpcRaftTransport,
-                RaftRpcService,
-            )
-            from ozone_tpu.om import requests as _rq
-
-            raft_rpc = RaftRpcService(self.server)
-            transport = GrpcRaftTransport("meta-ha", self._ha_peers)
-            self.ha = MetaHARing(
-                self.om, self.scm, Path(om_db).parent / "meta-raft",
-                ha_id, list(self._ha_peers), transport=transport,
-            )
-            raft_rpc.register("meta-ha", self.ha.node)
-
-            om = self.om
-            audit = om.audit
-
-            def _ha_submit(request):
-                with om.metrics.timer(request.audit_action).time():
-                    try:
-                        result = self.ha.submit_om(request)
-                    except NotRaftLeaderError as e:
-                        raise StorageError(
-                            "OM_NOT_LEADER",
-                            self._leader_address(e.leader_hint))
-                    except _rq.OMError as e:
-                        audit.log(request.audit_action, vars(request),
-                                  ok=False, error=e.code)
-                        raise
-                    audit.log(request.audit_action, vars(request), ok=True)
-                    om.metrics.counter("write_ops").inc()
-                    return result
-
-            # route every OM write through the ring (OzoneManager methods
-            # all funnel into submit); reads are leader-gated at the
-            # service edge so clients get read-your-writes
-            self.om.submit = _ha_submit
-
-            def _ha_prepare():
-                try:
-                    return self.ha.prepare_om()
-                except NotRaftLeaderError as e:
-                    raise StorageError(
-                        "OM_NOT_LEADER",
-                        self._leader_address(e.leader_hint))
-
-            def _ha_cancel_prepare():
-                try:
-                    self.ha.cancel_prepare_om()
-                except NotRaftLeaderError as e:
-                    raise StorageError(
-                        "OM_NOT_LEADER",
-                        self._leader_address(e.leader_hint))
-
-            self.om.prepare = _ha_prepare
-            self.om.cancel_prepare = _ha_cancel_prepare
-            self.om_service.gate = self._leader_gate
-
-            def _scm_barrier():
-                try:
-                    self.ha._await_records()
-                except NotRaftLeaderError as e:
-                    raise StorageError(
-                        "OM_NOT_LEADER",
-                        self._leader_address(e.leader_hint))
-
-            self.om_service.scm_barrier = _scm_barrier
-
-            def _scm_gate():
-                if not self.ha.is_ready:
-                    raise StorageError(
-                        "SCM_NOT_LEADER",
-                        self._leader_address(self.ha.leader_hint))
-
-            def _scm_side_barrier():
-                try:
-                    self.ha._await_records()
-                except NotRaftLeaderError as e:
-                    raise StorageError(
-                        "SCM_NOT_LEADER",
-                        self._leader_address(e.leader_hint))
-
-            self.scm_service.gate = _scm_gate
-            self.scm_service.barrier = _scm_side_barrier
-
-            def _admin_submit(op, target):
-                try:
-                    return self.ha.submit_admin(op, target)
-                except NotRaftLeaderError as e:
-                    raise StorageError(
-                        "SCM_NOT_LEADER",
-                        self._leader_address(e.leader_hint))
-
-            self.scm_service.admin_submitter = _admin_submit
+            self._init_ha(ha_id, Path(om_db).parent / "meta-raft")
         from ozone_tpu.utils.insight import InsightService
 
         self.insight = InsightService(self.server, "scm-om")
@@ -608,6 +506,73 @@ class ScmOmDaemon:
 
     def _leader_address(self, hint: str | None) -> str:
         return self._ha_peers.get(hint or "", "")
+
+    def _ha_call(self, fn, not_leader_code: str):
+        """Run a ring operation, translating NotRaftLeaderError into the
+        wire error (with the leader's address) clients fail over on."""
+        from ozone_tpu.consensus.raft import NotRaftLeaderError
+
+        try:
+            return fn()
+        except NotRaftLeaderError as e:
+            raise StorageError(not_leader_code,
+                               self._leader_address(e.leader_hint))
+
+    def _init_ha(self, ha_id: str, raft_dir: Path) -> None:
+        from ozone_tpu.consensus.meta_ring import MetaHARing
+        from ozone_tpu.net.raft_transport import (
+            GrpcRaftTransport,
+            RaftRpcService,
+        )
+        from ozone_tpu.om import requests as rq
+
+        raft_rpc = RaftRpcService(self.server)
+        transport = GrpcRaftTransport("meta-ha", self._ha_peers)
+        self.ha = MetaHARing(
+            self.om, self.scm, raft_dir,
+            ha_id, list(self._ha_peers), transport=transport,
+        )
+        raft_rpc.register("meta-ha", self.ha.node)
+
+        om = self.om
+
+        def _ha_submit(request):
+            with om.metrics.timer(request.audit_action).time():
+                try:
+                    result = self._ha_call(
+                        lambda: self.ha.submit_om(request), "OM_NOT_LEADER")
+                except rq.OMError as e:
+                    om.audit.log(request.audit_action, vars(request),
+                                 ok=False, error=e.code)
+                    raise
+                om.audit.log(request.audit_action, vars(request), ok=True)
+                om.metrics.counter("write_ops").inc()
+                return result
+
+        # route every OM write through the ring (OzoneManager methods all
+        # funnel into submit); reads are leader-gated at the service edge
+        # so clients get read-your-writes
+        om.submit = _ha_submit
+        om.prepare = lambda: self._ha_call(
+            self.ha.prepare_om, "OM_NOT_LEADER")
+        om.cancel_prepare = lambda: self._ha_call(
+            self.ha.cancel_prepare_om, "OM_NOT_LEADER")
+        self.om_service.gate = self._leader_gate
+        self.om_service.scm_barrier = lambda: self._ha_call(
+            self.ha._await_records, "OM_NOT_LEADER")
+
+        def _scm_gate():
+            if not self.ha.is_ready:
+                raise StorageError(
+                    "SCM_NOT_LEADER",
+                    self._leader_address(self.ha.leader_hint))
+
+        self.scm_service.gate = _scm_gate
+        self.scm_service.barrier = lambda: self._ha_call(
+            self.ha._await_records, "SCM_NOT_LEADER")
+        self.scm_service.admin_submitter = \
+            lambda op, target: self._ha_call(
+                lambda: self.ha.submit_admin(op, target), "SCM_NOT_LEADER")
 
     def _leader_gate(self) -> None:
         # ready-leader, not just leader: a freshly elected leader must

@@ -185,6 +185,22 @@ class MiniOzoneCluster:
             dn.close()
 
 
+def free_ports(n: int) -> list[int]:
+    """Reserve n distinct loopback ports (bind, record, release)."""
+    import socket
+
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
 class MiniOzoneHACluster:
     """Multi-replica metadata ring + real-gRPC datanodes in one process.
 
@@ -201,24 +217,14 @@ class MiniOzoneHACluster:
                  num_datanodes: int = 5,
                  block_size: int = 256 * 1024,
                  heartbeat_interval_s: float = 0.15):
-        import socket
-
         from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
 
         self.root = Path(root)
         self.block_size = block_size
-        socks = []
-        for _ in range(num_meta):
-            s = socket.socket()
-            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-            s.bind(("127.0.0.1", 0))
-            socks.append(s)
         self.peers = {
-            f"m{i}": f"127.0.0.1:{s.getsockname()[1]}"
-            for i, s in enumerate(socks)
+            f"m{i}": f"127.0.0.1:{p}"
+            for i, p in enumerate(free_ports(num_meta))
         }
-        for s in socks:
-            s.close()
         self.metas: dict[str, ScmOmDaemon] = {}
         for mid in self.peers:
             d = self._make_meta(mid)

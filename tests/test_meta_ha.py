@@ -23,19 +23,9 @@ EC = "rs-3-2-4096"
 
 
 def _free_ports(n):
-    """Reserve n distinct loopback ports (bind then release)."""
-    import socket
+    from ozone_tpu.testing.minicluster import free_ports
 
-    socks = []
-    for _ in range(n):
-        s = socket.socket()
-        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-    ports = [s.getsockname()[1] for s in socks]
-    for s in socks:
-        s.close()
-    return ports
+    return free_ports(n)
 
 
 def _make_meta(tmp_path, i, peers):
