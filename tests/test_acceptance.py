@@ -130,3 +130,115 @@ def test_smoke_freon_ockg(live_cluster):
                timeout=120).stdout
     rep = json.loads(out)
     assert rep["ops"] == 10 and rep["failures"] == 0
+
+
+def test_ha_cluster_subprocesses(tmp_path):
+    """HA acceptance: three scm-om OS processes on one raft ring, five
+    datanode processes, CLI writes through the failover address list,
+    SIGKILL the leader process, writes continue, old data intact."""
+    from ozone_tpu.testing.minicluster import free_ports
+
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    ports = free_ports(3)
+    peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(3)}
+    peer_flags = []
+    for mid, addr in peers.items():
+        peer_flags += ["--peer", f"{mid}={addr}"]
+    procs: dict[str, subprocess.Popen] = {}
+
+    def start_meta(mid: str) -> None:
+        procs[mid] = subprocess.Popen(
+            [sys.executable, "-m", "ozone_tpu.tools", "scm-om",
+             "--db", str(tmp_path / mid / "om.db"),
+             "--port", peers[mid].rsplit(":", 1)[1],
+             "--ha-id", mid, *peer_flags],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=str(REPO), env=env,
+        )
+
+    oms = ",".join(peers.values())
+    dn_procs = []
+    try:
+        for mid in peers:
+            start_meta(mid)
+        deadline = time.time() + 45
+        while time.time() < deadline:
+            try:
+                _cli(["admin", "status", "--om", oms], timeout=10)
+                break
+            except (subprocess.CalledProcessError,
+                    subprocess.TimeoutExpired):
+                time.sleep(0.5)
+        else:
+            pytest.fail("HA ring did not come up")
+        for i in range(5):
+            p = subprocess.Popen(
+                [sys.executable, "-m", "ozone_tpu.tools", "datanode",
+                 "--root", str(tmp_path / f"dn{i}"), "--scm", oms,
+                 "--id", f"dn{i}"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=str(REPO), env=env,
+            )
+            dn_procs.append(p)
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            try:
+                out = _cli(["admin", "status", "--om", oms],
+                           timeout=20).stdout
+            except (subprocess.CalledProcessError,
+                    subprocess.TimeoutExpired):
+                time.sleep(0.5)
+                continue
+            if out.count("HEALTHY") >= 5 and '"safemode": false' in out:
+                break
+            time.sleep(0.5)
+
+        payload = np.random.default_rng(3).integers(
+            0, 256, 120_000, dtype=np.uint8).tobytes()
+        src = tmp_path / "payload.bin"
+        src.write_bytes(payload)
+        _cli(["sh", "volume", "create", "/v", "--om", oms])
+        _cli(["sh", "bucket", "create", "/v/b", "--om", oms,
+              "--replication", "rs-3-2-4096"])
+        _cli(["sh", "key", "put", "/v/b/k1", str(src), "--om", oms])
+
+        # find and SIGKILL the leader process: a follower's error names
+        # the leader address
+        leader_addr = None
+        for mid, addr in peers.items():
+            r = _cli(["admin", "om", "prepare", "--om", addr],
+                     check=False, timeout=15)
+            if r.returncode != 0 and "OM_NOT_LEADER" in r.stderr:
+                hint = r.stderr.rsplit(":", 1)[-1].strip()
+                if hint.isdigit():
+                    leader_addr = f"127.0.0.1:{hint}"
+                    break
+            elif r.returncode == 0:
+                leader_addr = addr  # this one IS the leader
+                _cli(["admin", "om", "cancelprepare", "--om", addr],
+                     timeout=15)
+                break
+        assert leader_addr, "could not locate the leader"
+        leader_id = next(m for m, a in peers.items() if a == leader_addr)
+        procs[leader_id].kill()
+        procs[leader_id].wait(timeout=10)
+
+        # failover: writes and reads continue against the survivors
+        _cli(["sh", "key", "put", "/v/b/k2", str(src), "--om", oms],
+             timeout=90)
+        for key in ("k1", "k2"):
+            dst = tmp_path / f"out_{key}.bin"
+            _cli(["sh", "key", "get", f"/v/b/{key}", str(dst),
+                  "--om", oms], timeout=90)
+            assert dst.read_bytes() == payload, key
+    finally:
+        for p in dn_procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs.values():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in [*dn_procs, *procs.values()]:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
