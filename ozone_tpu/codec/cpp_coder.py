@@ -36,15 +36,35 @@ def _require_lib():
     return lib
 
 
+#: don't spin up threads below this much input (thread startup would
+#: dominate); above it the stripes split across a one-shot pool
+_MT_THRESHOLD_BYTES = 4 * 1024 * 1024
+
+
+def _default_threads() -> int:
+    import os
+
+    return min(8, os.cpu_count() or 1)
+
+
 def _apply(lib, tables: np.ndarray, rows: int, k: int,
-           data: np.ndarray) -> np.ndarray:
+           data: np.ndarray, threads: int = 0) -> np.ndarray:
     batch, _, n = data.shape
     data = np.ascontiguousarray(data)
     out = np.empty((batch, rows, n), dtype=np.uint8)
-    lib.gf_matrix_apply_batch(
-        tables.ctypes.data, rows, k, data.ctypes.data, out.ctypes.data,
-        n, batch,
-    )
+    if threads == 0 and batch > 1 \
+            and data.nbytes >= _MT_THRESHOLD_BYTES:
+        threads = _default_threads()
+    if threads > 1:
+        lib.gf_matrix_apply_batch_mt(
+            tables.ctypes.data, rows, k, data.ctypes.data, out.ctypes.data,
+            n, batch, threads,
+        )
+    else:
+        lib.gf_matrix_apply_batch(
+            tables.ctypes.data, rows, k, data.ctypes.data, out.ctypes.data,
+            n, batch,
+        )
     return out
 
 

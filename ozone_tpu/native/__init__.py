@@ -49,7 +49,8 @@ def build_shared(src: Path, so: Path, compiler: str = "g++",
 def _build() -> None:
     # -O3 -march=native: the coder kernels are perf-measured (bench.py
     # CPU baseline); later flags override build_shared's -O2
-    if build_shared(_SRC, _SO, extra=("-O3", "-march=native")) is None:
+    if build_shared(_SRC, _SO,
+                    extra=("-O3", "-march=native", "-pthread")) is None:
         raise OSError("native coder build failed")
 
 
@@ -72,6 +73,11 @@ def load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_int64,
+            ]
+            lib.gf_matrix_apply_batch_mt.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int,
             ]
             lib.crc32c_hw.argtypes = [
                 ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint32,

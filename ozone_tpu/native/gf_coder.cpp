@@ -14,8 +14,11 @@
 //
 // Exposed via a C ABI for ctypes (no pybind11 in this image).
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <thread>
+#include <vector>
 
 #if defined(__x86_64__)
 #include <immintrin.h>
@@ -100,6 +103,33 @@ void gf_matrix_apply_batch(const uint8_t* tables, int rows, int k,
   for (int64_t b = 0; b < batch; ++b) {
     gf_matrix_apply(tables, rows, k, data + b * k * n, out + b * rows * n, n);
   }
+}
+
+// Multithreaded batch: stripes are independent, so the batch splits
+// across a one-shot thread pool (the reference reaches the same
+// parallelism by running many coder instances on executor threads —
+// RawErasureCoderBenchmark's thread x chunk matrix).
+void gf_matrix_apply_batch_mt(const uint8_t* tables, int rows, int k,
+                              const uint8_t* data, uint8_t* out, int64_t n,
+                              int64_t batch, int threads) {
+  int nt = (int)std::min<int64_t>(threads, batch);
+  if (nt <= 1) {
+    gf_matrix_apply_batch(tables, rows, k, data, out, n, batch);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve((size_t)nt);
+  const int64_t per = (batch + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    const int64_t lo = (int64_t)t * per;
+    const int64_t hi = std::min<int64_t>(batch, lo + per);
+    if (lo >= hi) break;
+    pool.emplace_back([=] {
+      gf_matrix_apply_batch(tables, rows, k, data + lo * k * n,
+                            out + lo * rows * n, n, hi - lo);
+    });
+  }
+  for (auto& th : pool) th.join();
 }
 
 // ------------------------------------------------------------------ CRC32C

@@ -51,3 +51,20 @@ def test_registry_ordering_includes_cpp():
 
     backends = CodecRegistry.instance().backends("rs")
     assert backends.index("jax") < backends.index("cpp") < backends.index("numpy")
+
+
+def test_multithreaded_batch_matches_single_thread():
+    """The threaded batch kernel must be byte-identical to the serial
+    one (stripes are independent; only the split differs)."""
+    import numpy as np
+
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.codec.cpp_coder import CppRSEncoder, _apply
+
+    opts = CoderOptions(4, 2, "rs", cell_size=8192)
+    enc = CppRSEncoder(opts)
+    data = np.random.default_rng(3).integers(
+        0, 256, (13, 4, 8192), dtype=np.uint8)  # odd batch: uneven split
+    single = _apply(enc._lib, enc._tables, 2, 4, data, threads=1)
+    multi = _apply(enc._lib, enc._tables, 2, 4, data, threads=5)
+    assert np.array_equal(single, multi)
