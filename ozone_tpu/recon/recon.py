@@ -253,6 +253,17 @@ class ReconScmView:
             "missing": missing,
         }
 
+    def pipeline_table(self) -> list[dict]:
+        return [
+            {
+                "id": p.id,
+                "replication": str(p.replication),
+                "state": p.state.value,
+                "nodes": list(p.nodes),
+            }
+            for p in self.scm.containers.pipelines()
+        ]
+
     def node_table(self) -> list[dict]:
         return [
             {
@@ -320,6 +331,7 @@ class ReconServer:
                     },
                     "/api/containers/health": recon.scm_view.container_health,
                     "/api/nodes": recon.scm_view.node_table,
+                    "/api/pipelines": recon.scm_view.pipeline_table,
                     "/api/summary": recon.api_summary,
                 }
                 fn = routes.get(path)

@@ -117,6 +117,13 @@ RECON_INDEX_HTML = """<!doctype html>
     <tbody></tbody>
   </table>
 
+  <h2>Pipelines</h2>
+  <table id="pipelines">
+    <thead><tr><th>id</th><th>replication</th><th>state</th>
+      <th>members</th></tr></thead>
+    <tbody></tbody>
+  </table>
+
   <h2>Container health</h2>
   <table id="health">
     <thead><tr><th>class</th><th>count</th></tr></thead>
@@ -183,6 +190,13 @@ async function refresh() {
                 `<td>${badge(n.state)}</td><td>${esc(n.op_state ?? "")}</td>` +
                 `<td>${fmtBytes(n.used_bytes)} / ` +
                 `${fmtBytes(n.capacity_bytes)}</td></tr>`).join("");
+
+    const pls = await (await fetch("/api/pipelines")).json();
+    document.querySelector("#pipelines tbody").innerHTML = pls
+      .map(p => `<tr><td>${esc(p.id)}</td><td>${esc(p.replication)}</td>` +
+                `<td>${esc(p.state)}</td>` +
+                `<td>${esc((p.nodes || []).join(", "))}</td></tr>`)
+      .join("");
 
     document.querySelector("#health tbody").innerHTML =
         Object.entries(s.containers || {})

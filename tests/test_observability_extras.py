@@ -164,6 +164,7 @@ def test_recon_ui_served(tmp_path):
         s = json.load(_get(srv.address, "/api/summary"))
         assert len(s["nodes"]) == 3
         json.load(_get(srv.address, "/api/filesizes"))
+        assert json.load(_get(srv.address, "/api/pipelines")) == []
     finally:
         srv.stop()
         om.close()
