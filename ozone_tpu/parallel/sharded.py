@@ -19,8 +19,19 @@ parity work sharded over v5e-8 ICI):
   are huge (cell >> HBM/chip) — the analog of splitting one stripe's
   coding work across nodes.
 
-All collectives are XLA collectives over the mesh (psum); no host-side
-communication is involved.
+- **Ring reconstruction (SP)**: the k surviving units are sharded one
+  group per chip — the natural layout when each chip fronts one datanode
+  of the reconstruction read fan-in (ECReconstructionCoordinator reads k
+  survivors in parallel; here each survivor's bytes land on a different
+  chip). Each chip computes its packed-byte partial parity and the
+  partials ride an explicit ppermute ring, XOR-combining at every hop
+  (the ring-attention pattern applied to GF(2) coding: XOR is the
+  mod-2 reduction, so packed uint8 partials — not bit-planes, not int32
+  sums — are the ring payload, 32x less ICI traffic than a naive int32
+  psum of bit-planes).
+
+All collectives are XLA collectives over the mesh (psum / ppermute); no
+host-side communication is involved.
 """
 
 from __future__ import annotations
@@ -37,7 +48,13 @@ from ozone_tpu.codec import crc_device, rs_math
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.bitlin import expand_coding_matrix
 from ozone_tpu.codec.fused import FusedSpec, _POLY
-from ozone_tpu.codec.jax_coder import bits_to_bytes, bytes_to_bits, gf_apply
+from ozone_tpu.codec.jax_coder import (
+    _gf_dot,
+    bits_to_bytes,
+    bytes_to_bits,
+    gf_apply,
+    pack_bit_rows,
+)
 from ozone_tpu.utils.checksum import ChecksumType
 
 
@@ -207,3 +224,105 @@ def make_tp_encoder(options: CoderOptions, mesh: Mesh, axis: str = "dn"):
     """Unit-parallel encode: data units sharded over the mesh, parity
     accumulated with psum over ICI. fn(data [B, k, C]) -> parity [B, p, C]."""
     return _tp_encoder_cached(options, mesh, axis)
+
+
+# ------------------------------------------------------------------- ring
+@lru_cache(maxsize=64)
+def _ring_decoder_cached(
+    options: CoderOptions,
+    checksum: ChecksumType,
+    bpc: int,
+    valid: tuple,
+    erased: tuple,
+    mesh: Mesh,
+    axis: str,
+):
+    k = len(valid)
+    e = len(erased)
+    n = mesh.devices.size
+    upc = -(-k // n)  # units per chip, survivors zero-padded to upc * n
+    dm = rs_math.decode_matrix(
+        options.data_units, options.parity_units, list(erased), list(valid)
+    )  # GF(2^8) [e, k]
+    a_np = expand_coding_matrix(dm)  # [k*8, e*8]
+    if upc * n != k:
+        # zero matrix rows for the padded survivor slots: a zero unit
+        # contributes a zero partial, keeping the ring XOR exact
+        a_np = np.concatenate(
+            [a_np, np.zeros(((upc * n - k) * 8, e * 8), dtype=a_np.dtype)]
+        )
+    a = jnp.asarray(a_np, dtype=jnp.int8)
+    if checksum in _POLY:
+        k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
+        k_dev = jnp.asarray(k_np)
+    else:
+        k_dev, zeros_crc = None, 0
+
+    from jax import shard_map
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis, None), P(axis, None)),
+        out_specs=P(None, None, None),
+        # the replicated output comes out of a ppermute ring, which the
+        # static replication checker can't prove; every chip provably
+        # holds the same XOR-of-all-partials after n-1 hops
+        check_vma=False,
+    )
+    def ring_decode(units_local, a_local):
+        # units_local [B, upc, C] uint8; a_local [upc*8, e*8] int8
+        pbits = _gf_dot(bytes_to_bits(units_local), a_local)  # [e*8, B, C]
+        # pack the PARTIAL parity to bytes before touching the ring: XOR
+        # of packed bytes == packed XOR of bits, so the ring payload is
+        # [e, B, C] uint8 — 8x smaller than bit-planes
+        local = pack_bit_rows(pbits)  # [e, B, C]
+        acc_ring = local
+        for _ in range(n - 1):
+            acc_ring = (
+                jax.lax.ppermute(acc_ring, axis, perm) ^ local
+            )
+        return jnp.moveaxis(acc_ring, 0, 1)  # [B, e, C] replicated
+
+    batch_sharding = NamedSharding(mesh, P(axis))
+
+    def fn(valid_units):
+        b, kk, c = valid_units.shape
+        if kk != upc * n:
+            pad = jnp.zeros((b, upc * n - kk, c), dtype=valid_units.dtype)
+            valid_units = jnp.concatenate([valid_units, pad], axis=1)
+        rec = ring_decode(valid_units, a)
+        if k_dev is None:
+            crcs = jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
+        else:
+            # the ring output is replicated; shard the CRC pass over the
+            # stripe batch so the checksum work spreads over the mesh
+            # instead of running n-fold redundantly
+            rec_sh = jax.lax.with_sharding_constraint(rec, batch_sharding)
+            crcs = crc_device.crc_slices(rec_sh, k_dev, zeros_crc)
+        return rec, crcs
+
+    return jax.jit(fn)
+
+
+def make_ring_decoder(
+    spec: FusedSpec, valid: list[int], erased: list[int], mesh: Mesh,
+    axis: str = "dn",
+):
+    """Survivor-sharded ring reconstruction: fn(valid_units [B, k, C]) ->
+    (recovered [B, e, C], crcs). The k survivor units are sharded over the
+    mesh (zero-padded to a multiple of its size); packed-byte partial
+    parities XOR-combine around a ppermute ring. The multi-datanode
+    reconstruction layout of BASELINE config #5: each chip ingests one
+    survivor datanode's bytes, no chip ever holds the whole stripe."""
+    return _ring_decoder_cached(
+        spec.options,
+        spec.checksum,
+        spec.bytes_per_checksum,
+        tuple(valid),
+        tuple(erased),
+        mesh,
+        axis,
+    )
