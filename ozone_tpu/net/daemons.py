@@ -527,7 +527,7 @@ class ScmOmDaemon:
         from ozone_tpu.om import requests as rq
 
         raft_rpc = RaftRpcService(self.server)
-        transport = GrpcRaftTransport("meta-ha", self._ha_peers)
+        transport = GrpcRaftTransport("meta-ha", self._ha_peers, owner=ha_id)
         self.ha = MetaHARing(
             self.om, self.scm, raft_dir,
             ha_id, list(self._ha_peers), transport=transport,

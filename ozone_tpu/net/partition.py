@@ -1,0 +1,65 @@
+"""Client-side network-partition injection — the blockade analog.
+
+The reference tests network partitions with blockade/iptables around
+docker containers (fault-injection-test/network-tests/src/test/blockade/
+test_blockade_*.py: datanode isolation, SCM isolation, flaky net). This
+framework's daemons all speak gRPC through RpcChannel, so a partition is
+injected one layer up: every outbound call consults a process-global deny
+table and fails with the same UNAVAILABLE StorageError a dead TCP peer
+would produce — failover clients rotate, raft peers mark the target
+unreachable and retry on the next heartbeat, exactly as if the wire were
+cut.
+
+Entries are scoped: ("*", dst) drops every call this process makes to
+dst; (owner, dst) drops only calls made through channels tagged with that
+owner — which is how an in-process HA minicluster isolates ONE replica of
+a ring whose members all share the process (each replica's raft transport
+tags its channels with its node id).
+
+Real daemon processes expose Partition/Heal/PartitionList verbs on their
+insight RPC service, so a test (or operator drill) can cut links between
+live daemons remotely: cutting both directions of a link means one
+Partition call to each endpoint's process, mirroring how blockade
+programs netfilter in each container.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_blocked: set[tuple[str, str]] = set()
+
+#: wildcard owner: matches calls from every channel in the process
+ANY = "*"
+
+
+def block(dst: str, owner: str = ANY) -> None:
+    """Drop future calls to dst (from `owner`-tagged channels only, or
+    from the whole process with the default wildcard)."""
+    with _lock:
+        _blocked.add((owner, dst))
+
+
+def heal(dst: str, owner: str = ANY) -> None:
+    with _lock:
+        _blocked.discard((owner, dst))
+
+
+def clear() -> None:
+    with _lock:
+        _blocked.clear()
+
+
+def blocked() -> list[tuple[str, str]]:
+    with _lock:
+        return sorted(_blocked)
+
+
+def is_blocked(dst: str, owner: str | None = None) -> bool:
+    with _lock:
+        if not _blocked:  # fast path: injection is a test/drill feature
+            return False
+        if (ANY, dst) in _blocked:
+            return True
+        return owner is not None and (owner, dst) in _blocked

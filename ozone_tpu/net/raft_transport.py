@@ -74,10 +74,13 @@ class GrpcRaftTransport(Transport):
 
     def __init__(self, group_id: str, peers: dict[str, str],
                  tls=None, timeout_s: float = 5.0,
-                 vote_timeout_s: float = 1.0):
+                 vote_timeout_s: float = 1.0,
+                 owner: Optional[str] = None):
         self.group_id = group_id
         self._peers = dict(peers)
         self._tls = tls
+        #: partition-injection scope tag for this node's outbound channels
+        self._owner = owner
         self._timeout = timeout_s
         #: votes get a short deadline — a hung call to a dead peer inside
         #: an election round delays the candidate's next campaign
@@ -105,7 +108,8 @@ class GrpcRaftTransport(Transport):
                     raise ConnectionError(
                         f"no address for raft peer {peer_id}")
                 ch = RpcChannel(addr, tls=self._tls,
-                                server_name=peer_id if self._tls else None)
+                                server_name=peer_id if self._tls else None,
+                                owner=self._owner)
                 self._channels[peer_id] = ch
             return ch
 

@@ -135,11 +135,14 @@ class RpcChannel:
 
     `tls` (TlsMaterial) switches to a secure channel presenting this
     role's client certificate; `server_name` overrides SNI/authority when
-    dialing by IP (certs carry role names + localhost SANs)."""
+    dialing by IP (certs carry role names + localhost SANs). `owner` tags
+    the channel for scoped partition injection (net/partition.py)."""
 
     def __init__(self, address: str, tls=None,
-                 server_name: Optional[str] = None):
+                 server_name: Optional[str] = None,
+                 owner: Optional[str] = None):
         self.address = address
+        self.owner = owner
         options = [
             ("grpc.max_send_message_length", 128 * 1024 * 1024),
             ("grpc.max_receive_message_length", 128 * 1024 * 1024),
@@ -175,6 +178,15 @@ class RpcChannel:
                                 f"rpc {key} to {self.address}: "
                                 f"{e.code()}: {detail}")
 
+    def _check_partition(self, key: str) -> None:
+        from ozone_tpu.net import partition
+
+        if partition.is_blocked(self.address, self.owner):
+            raise StorageError(
+                "UNAVAILABLE",
+                f"rpc {key} to {self.address}: injected network partition",
+            )
+
     def call_streaming(self, service: str, method: str, frames,
                        timeout: Optional[float] = 120.0) -> bytes:
         """Client-streaming call: send an iterator of byte frames, get one
@@ -182,6 +194,7 @@ class RpcChannel:
         from ozone_tpu.utils.tracing import Tracer
 
         key = f"/{service}/{method}"
+        self._check_partition(key)
         fn = self._calls.get(key)
         if fn is None:
             fn = self._channel.stream_unary(key)
@@ -200,6 +213,7 @@ class RpcChannel:
         from ozone_tpu.utils.tracing import Tracer
 
         key = f"/{service}/{method}"
+        self._check_partition(key)
         fn = self._calls.get(key)
         if fn is None:
             fn = self._channel.unary_unary(key)

@@ -171,7 +171,8 @@ class RatisXceiverServer:
             if transport is None:
                 from ozone_tpu.net.raft_transport import GrpcRaftTransport
 
-                transport = GrpcRaftTransport(gid, dict(peers), tls=self.tls)
+                transport = GrpcRaftTransport(gid, dict(peers), tls=self.tls,
+                                              owner=self.dn.id)
                 self._transports[gid] = transport
             sm = ContainerStateMachine(self.dn)
             node = RaftNode(

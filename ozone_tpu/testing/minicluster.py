@@ -299,3 +299,39 @@ class MiniOzoneHACluster:
             d.stop()
         self.metas.clear()
         self.datanodes = []
+
+
+def make_meta_daemon(tmp_path, i: int, peers: dict, **overrides):
+    """One metadata-ring replica (ScmOmDaemon) with test-friendly
+    defaults; peers maps 'm<i>' -> host:port. Shared by the HA suites."""
+    from ozone_tpu.net.daemons import ScmOmDaemon
+
+    kw = dict(
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+        background_interval_s=0.2,
+        ha_id=f"m{i}",
+        ha_peers=peers,
+    )
+    kw.update(overrides)
+    return ScmOmDaemon(
+        tmp_path / f"meta{i}" / "om.db",
+        port=int(peers[f"m{i}"].rsplit(":", 1)[1]),
+        **kw,
+    )
+
+
+def await_meta_leader(metas: dict, timeout: float = 10.0, among=None):
+    """Wait until exactly one replica (optionally restricted to `among`)
+    reports leadership; returns its id."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [mid for mid, d in metas.items()
+                   if (among is None or mid in among)
+                   and d.ha is not None and d.ha.is_leader]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.05)
+    raise AssertionError(f"no single leader among {among or list(metas)}")

@@ -81,6 +81,9 @@ def cmd_sh(args) -> int:
     parts = _parse_path(args.path)
     kind, verb = args.object, args.verb
     if kind == "volume":
+        if verb == "list":  # accepts "/" (no volume component)
+            _emit(oz.list_volumes())
+            return 0
         (vol,) = parts
         if verb == "create":
             oz.create_volume(vol)
@@ -88,8 +91,6 @@ def cmd_sh(args) -> int:
             oz.om.delete_volume(vol)
         elif verb == "info":
             _emit(oz.om.volume_info(vol))
-        elif verb == "list":
-            _emit(oz.list_volumes())
     elif kind == "bucket":
         if verb == "list":
             (vol,) = parts
@@ -431,6 +432,20 @@ def cmd_insight(args) -> int:
                       f"{r['message']}")
         elif args.verb == "log-level":
             _emit(cli.set_log_level(args.logger, args.level or "DEBUG"))
+        elif args.verb == "partition":
+            if not args.dst:
+                print("error INVALID: partition requires --dst",
+                      file=sys.stderr)
+                return 1
+            _emit(cli.partition(args.dst, owner=args.owner))
+        elif args.verb == "heal":
+            if args.owner and not args.dst:
+                print("error INVALID: heal --owner requires --dst",
+                      file=sys.stderr)
+                return 1
+            _emit(cli.heal(args.dst, owner=args.owner))
+        elif args.verb == "partitions":
+            _emit({"blocked": cli.partition_list()})
     finally:
         cli.close()
     return 0
@@ -656,12 +671,17 @@ def build_parser() -> argparse.ArgumentParser:
     ins = sub.add_parser("insight",
                          help="subsystem introspection (ozone insight)")
     ins.add_argument("verb", choices=["list", "metrics", "logs",
-                                      "log-level"])
+                                      "log-level", "partition", "heal",
+                                      "partitions"])
     ins.add_argument("--om", default="127.0.0.1:9860")
     ins.add_argument("--address", default="",
                      help="daemon address (defaults to --om)")
     ins.add_argument("--logger", default="")
     ins.add_argument("--level", default="")
+    ins.add_argument("--dst", default="",
+                     help="partition/heal: peer address to cut/restore")
+    ins.add_argument("--owner", default="",
+                     help="partition scope tag (default: whole process)")
     ins.add_argument("-n", "--num", type=int, default=100)
     ins.set_defaults(fn=cmd_insight)
 

@@ -130,6 +130,9 @@ class InsightService:
             "Metrics": self._metrics,
             "Logs": self._logs,
             "SetLogLevel": self._set_log_level,
+            "Partition": self._partition,
+            "Heal": self._heal,
+            "PartitionList": self._partition_list,
         })
 
     def _list_points(self, req: bytes) -> bytes:
@@ -165,6 +168,38 @@ class InsightService:
         logger.setLevel(m["level"].upper())
         return wire.pack({"logger": m["logger"], "level": m["level"]})
 
+    # ---- network-partition injection (blockade analog): cut/restore this
+    # process's outbound links remotely during fault drills
+    def _partition(self, req: bytes) -> bytes:
+        from ozone_tpu.net import partition
+        from ozone_tpu.storage.ids import StorageError
+
+        m, _ = wire.unpack(req)
+        if not m.get("dst"):
+            raise StorageError("INVALID", "partition requires a dst address")
+        partition.block(m["dst"], m.get("owner") or partition.ANY)
+        return wire.pack({"blocked": partition.blocked()})
+
+    def _heal(self, req: bytes) -> bytes:
+        from ozone_tpu.net import partition
+        from ozone_tpu.storage.ids import StorageError
+
+        m, _ = wire.unpack(req)
+        if m.get("dst"):
+            partition.heal(m["dst"], m.get("owner") or partition.ANY)
+        elif m.get("owner"):
+            # an owner without a dst is ambiguous — refuse rather than
+            # silently clearing every rule mid-drill
+            raise StorageError("INVALID", "heal: owner given without dst")
+        else:
+            partition.clear()
+        return wire.pack({"blocked": partition.blocked()})
+
+    def _partition_list(self, req: bytes) -> bytes:
+        from ozone_tpu.net import partition
+
+        return wire.pack({"blocked": partition.blocked()})
+
 
 class InsightClient:
     def __init__(self, address: str):
@@ -188,6 +223,17 @@ class InsightClient:
 
     def set_log_level(self, logger: str, level: str) -> dict:
         return self._call("SetLogLevel", logger=logger, level=level)
+
+    def partition(self, dst: str, owner: str = "") -> dict:
+        """Cut the target process's outbound link(s) to dst."""
+        return self._call("Partition", dst=dst, owner=owner)
+
+    def heal(self, dst: str = "", owner: str = "") -> dict:
+        """Restore a cut link, or all links when dst is empty."""
+        return self._call("Heal", dst=dst, owner=owner)
+
+    def partition_list(self) -> list:
+        return self._call("PartitionList")["blocked"]
 
     def close(self) -> None:
         self._ch.close()

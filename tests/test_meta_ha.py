@@ -29,16 +29,9 @@ def _free_ports(n):
 
 
 def _make_meta(tmp_path, i, peers):
-    return ScmOmDaemon(
-        tmp_path / f"meta{i}" / "om.db",
-        port=int(peers[f"m{i}"].rsplit(":", 1)[1]),
-        block_size=256 * 1024,
-        stale_after_s=1000.0,
-        dead_after_s=2000.0,
-        background_interval_s=0.2,
-        ha_id=f"m{i}",
-        ha_peers=peers,
-    )
+    from ozone_tpu.testing.minicluster import make_meta_daemon
+
+    return make_meta_daemon(tmp_path, i, peers, block_size=256 * 1024)
 
 
 @pytest.fixture
@@ -67,15 +60,7 @@ def ha_cluster(tmp_path):
             d.stop()
 
 
-def _await_leader(metas, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        leaders = [mid for mid, d in metas.items()
-                   if d.ha is not None and d.ha.is_leader]
-        if len(leaders) == 1:
-            return leaders[0]
-        time.sleep(0.05)
-    raise AssertionError(f"no single leader among {list(metas)}")
+from ozone_tpu.testing.minicluster import await_meta_leader as _await_leader  # noqa: E402
 
 
 def _client(peers):

@@ -1,0 +1,151 @@
+"""Network-partition fault injection — the blockade-test analog.
+
+The reference drives iptables partitions around docker containers
+(fault-injection-test/network-tests/src/test/blockade/: datanode
+isolation, SCM isolation scenarios). Here the injection lives in the RPC
+layer (net/partition.py): outbound calls to a blocked destination fail
+exactly like a cut wire, scoped per channel owner so one replica of an
+in-process ring can be isolated from its peers.
+"""
+
+import time
+
+import pytest
+
+from ozone_tpu.net import partition
+from ozone_tpu.net.rpc import RpcChannel, RpcServer
+from ozone_tpu.storage.ids import StorageError
+from ozone_tpu.testing.minicluster import (
+    await_meta_leader as _await_leader,
+    make_meta_daemon as _make_meta,
+)
+
+N_META = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_partitions():
+    partition.clear()
+    yield
+    partition.clear()
+
+
+def test_blocked_channel_fails_like_a_cut_wire():
+    server = RpcServer()
+    server.add_service("echo", {"Echo": lambda b: b})
+    server.start()
+    try:
+        ch = RpcChannel(server.address)
+        assert ch.call("echo", "Echo", b"hi") == b"hi"
+        partition.block(server.address)
+        with pytest.raises(StorageError) as ei:
+            ch.call("echo", "Echo", b"hi")
+        assert ei.value.code == "UNAVAILABLE"
+        partition.heal(server.address)
+        assert ch.call("echo", "Echo", b"hi") == b"hi"
+        ch.close()
+    finally:
+        server.stop()
+
+
+def test_owner_scoped_block_only_cuts_tagged_channels():
+    server = RpcServer()
+    server.add_service("echo", {"Echo": lambda b: b})
+    server.start()
+    try:
+        tagged = RpcChannel(server.address, owner="m0")
+        plain = RpcChannel(server.address)
+        partition.block(server.address, owner="m0")
+        with pytest.raises(StorageError):
+            tagged.call("echo", "Echo", b"x")
+        assert plain.call("echo", "Echo", b"x") == b"x"
+        tagged.close()
+        plain.close()
+    finally:
+        server.stop()
+
+
+def test_insight_rpc_controls_partitions():
+    """The remote control plane: Partition/Heal/PartitionList verbs on any
+    daemon's insight service (how multi-process drills cut links)."""
+    from ozone_tpu.utils.insight import InsightClient
+
+    server = RpcServer()
+    from ozone_tpu.utils.insight import InsightService
+
+    InsightService(server, "test")
+    server.start()
+    try:
+        cli = InsightClient(server.address)
+        cli.partition("10.0.0.9:1234")
+        cli.partition("10.0.0.7:1234", owner="m2")
+        got = cli.partition_list()
+        assert [tuple(x) for x in got] == [
+            ("*", "10.0.0.9:1234"), ("m2", "10.0.0.7:1234")]
+        cli.heal("10.0.0.9:1234")
+        assert [tuple(x) for x in cli.partition_list()] == [
+            ("m2", "10.0.0.7:1234")]
+        cli.heal()  # no dst -> clear all
+        assert cli.partition_list() == []
+        cli.close()
+    finally:
+        server.stop()
+
+
+def test_leader_isolation_elects_new_leader_and_heals(tmp_path):
+    """SCM/OM-isolation blockade scenario: sever both directions of the
+    raft links between the leader and its followers. The majority side
+    elects a new leader and keeps serving; the isolated ex-leader cannot
+    commit; healing the partition deposes it and it converges."""
+    from ozone_tpu.testing.minicluster import free_ports
+
+    ports = free_ports(N_META)
+    peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(N_META)}
+    metas = {}
+    try:
+        for i in range(N_META):
+            d = _make_meta(tmp_path, i, peers)
+            d.start()
+            metas[f"m{i}"] = d
+        old = _await_leader(metas)
+        followers = [m for m in metas if m != old]
+
+        # cut leader <-> follower links in both directions (one blockade
+        # rule per endpoint, like netfilter in each container)
+        for f in followers:
+            partition.block(peers[old], owner=f)   # f -> old
+            partition.block(peers[f], owner=old)   # old -> f
+        new = _await_leader(metas, timeout=15.0, among=followers)
+        assert new != old
+
+        # majority side serves writes (client dials the followers only;
+        # the deposed side would hold a write for its full ack timeout)
+        from ozone_tpu.net.om_service import GrpcOmClient
+
+        om = GrpcOmClient(",".join(peers[f] for f in followers))
+        om.create_volume("pv")
+        assert "pv" in [v["name"] for v in om.list_volumes()]
+
+        # the isolated ex-leader never saw the write
+        assert "pv" not in [v["name"]
+                            for v in metas[old].om.list_volumes()]
+
+        # ---- heal: ex-leader hears the higher term, steps down, catches
+        # up from the raft log
+        partition.clear()
+        deadline = time.monotonic() + 15.0
+        while time.monotonic() < deadline:
+            vols = [v["name"] for v in metas[old].om.list_volumes()]
+            if "pv" in vols and not metas[old].ha.is_leader:
+                break
+            time.sleep(0.1)
+        assert "pv" in [v["name"] for v in metas[old].om.list_volumes()]
+        assert not metas[old].ha.is_leader
+        _await_leader(metas)  # exactly one leader cluster-wide
+        om.close()
+    finally:
+        for d in metas.values():
+            try:
+                d.stop()
+            except Exception:
+                pass
