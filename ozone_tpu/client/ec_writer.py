@@ -352,6 +352,13 @@ class ECKeyWriter:
         self._group_chunks = []
         self._stripe_in_group = 0
 
+    def hsync(self) -> list[BlockGroup]:
+        """EC keys do not support hsync, matching the reference
+        (ECKeyOutputStream rejects hflush/hsync: a partial stripe cannot
+        be made durable without writing throwaway parity)."""
+        raise StorageError("NOT_SUPPORTED_OPERATION",
+                           "hsync is not supported for EC keys")
+
     # ------------------------------------------------------------------ close
     def close(self) -> list[BlockGroup]:
         """Flush the final (possibly partial) stripe and return the

@@ -34,6 +34,16 @@ class KeyWriteHandle:
     def write(self, data) -> None:
         self._writer.write(data)
 
+    def hsync(self) -> None:
+        """Make everything written so far durable and readable while the
+        stream stays open (KeyOutputStream.hsync): flush to the datanodes,
+        then commit the key at the synced length with the session kept
+        alive. Not supported for EC keys (reference parity)."""
+        groups = self._writer.hsync()
+        self._om.hsync_key(
+            self._session, groups, self._writer.bytes_written
+        )
+
     def close(self) -> None:
         if self._committed:
             return

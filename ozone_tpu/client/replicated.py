@@ -160,6 +160,19 @@ class ReplicatedKeyWriter:
         self._group = None
         self._chunks = []
 
+    def hsync(self) -> list[BlockGroup]:
+        """Flush buffered bytes to every replica and return the block
+        groups covering all bytes written so far; the current block stays
+        open for further writes (KeyOutputStream.hsync semantics — the
+        durable prefix the OM can commit mid-write)."""
+        if self._closed:
+            raise ValueError("writer is closed")
+        self._flush_chunk()
+        groups = list(self._groups)
+        if self._group is not None and self._group.length > 0:
+            groups.append(self._group)
+        return groups
+
     def close(self) -> list[BlockGroup]:
         if self._closed:
             return self._groups

@@ -101,6 +101,14 @@ class OzoneFileSystem:
     def open(self, path: str) -> OzoneFile:
         return OzoneFile(self.bucket.read_key(self._norm(path)))
 
+    def recover_lease(self, path: str) -> bool:
+        """Seal an abandoned hsynced write and fence the dead writer
+        (BasicOzoneClientAdapterImpl.recoverLease analog)."""
+        out = self.bucket.client.om.recover_lease(
+            self.bucket.volume, self.bucket.name, self._norm(path)
+        )
+        return bool(out.get("recovered"))
+
     def mkdirs(self, path: str) -> None:
         marker = self._dir_marker(path)
         try:
@@ -237,6 +245,12 @@ class RootedOzoneFileSystem:
         if not (vol and bkt and rest):
             raise IsADirectoryError(path)
         return self._bucket_fs(vol, bkt).open(rest)
+
+    def recover_lease(self, path: str) -> bool:
+        vol, bkt, rest = self._resolve(path)
+        if not (vol and bkt and rest):
+            raise IsADirectoryError(path)
+        return self._bucket_fs(vol, bkt).recover_lease(rest)
 
     def mkdirs(self, path: str) -> None:
         vol, bkt, rest = self._resolve(path)

@@ -65,6 +65,7 @@ class OmGrpcService:
                 "OpenKey": self._open_key,
                 "AllocateBlock": self._allocate_block,
                 "CommitKey": self._commit_key,
+                "RecoverLease": self._recover_lease,
                 "LookupKey": self._wrap(
                     lambda m: self.om.lookup_key(m["volume"], m["bucket"], m["key"])
                 ),
@@ -302,10 +303,19 @@ class OmGrpcService:
             file_name = m.get("file_name")
 
         try:
-            self.om.commit_key(_S(), self._groups_from(m["groups"]), m["size"])
+            self.om.commit_key(_S(), self._groups_from(m["groups"]), m["size"],
+                               hsync=bool(m.get("hsync")))
         except OMError as e:
             raise StorageError(e.code, e.msg)
         return wire.pack({})
+
+    def _recover_lease(self, req: bytes) -> bytes:
+        m, _ = wire.unpack(req)
+        try:
+            out = self.om.recover_lease(m["volume"], m["bucket"], m["key"])
+        except OMError as e:
+            raise StorageError(e.code, e.msg)
+        return wire.pack({"result": out})
 
     @staticmethod
     def _groups_from(groups: list[dict]) -> list[BlockGroup]:
@@ -438,7 +448,7 @@ class GrpcOmClient:
                 self.clients.update_remote(dn_id, addr)
         return BlockGroup.from_json(g)
 
-    def commit_key(self, session, groups, size):
+    def commit_key(self, session, groups, size, hsync=False):
         self._call(
             "CommitKey",
             volume=session.volume,
@@ -450,7 +460,15 @@ class GrpcOmClient:
             size=size,
             parent_id=getattr(session, "parent_id", None),
             file_name=getattr(session, "file_name", None),
+            hsync=hsync,
         )
+
+    def hsync_key(self, session, groups, size):
+        self.commit_key(session, groups, size, hsync=True)
+
+    def recover_lease(self, volume, bucket, key):
+        return self._call("RecoverLease", volume=volume, bucket=bucket,
+                          key=key)["result"]
 
     def lookup_key(self, volume, bucket, key):
         return self._call("LookupKey", volume=volume, bucket=bucket, key=key)[

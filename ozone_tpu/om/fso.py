@@ -33,6 +33,7 @@ from ozone_tpu.om.requests import (
     KEY_NOT_FOUND,
     OMError,
     OMRequest,
+    finalize_commit,
 )
 
 DIRECTORY_NOT_FOUND = "DIRECTORY_NOT_FOUND"
@@ -233,6 +234,7 @@ class CommitFile(OMRequest):
     size: int
     block_groups: list[dict] = field(default_factory=list)
     modified: float = 0.0
+    hsync: bool = False
 
     def pre_execute(self, om) -> None:
         self.modified = time.time()
@@ -259,13 +261,9 @@ class CommitFile(OMRequest):
                 "modified": self.modified,
             }
         )
-        store.delete("open_keys", open_k)
-        # overwrite: the previous version's blocks must reach the purge
-        # chain or they leak on the datanodes
         old = store.get("files", fk)
-        if old is not None and old.get("block_groups"):
-            store.put("deleted_keys", f"{fk}:{self.modified}", old)
-        store.put("files", fk, info)
+        finalize_commit(store, "files", fk, info, old, self.client_id,
+                        self.hsync, self.modified)
         return info
 
 
@@ -288,6 +286,10 @@ class DeleteFile(OMRequest):
                 raise OMError(NOT_A_FILE, f"{fk} is a directory")
             raise OMError(KEY_NOT_FOUND, fk)
         store.delete("files", fk)
+        # fence a live hsync stream before purging its blocks
+        stale_writer = info.get("hsync_client_id")
+        if stale_writer:
+            store.delete("open_keys", f"{fk}/{stale_writer}")
         store.put("deleted_keys", f"{fk}:{self.ts}", info)
         return info
 

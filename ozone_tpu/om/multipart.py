@@ -19,6 +19,7 @@ a client abort would.
 
 from __future__ import annotations
 
+import logging
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -26,6 +27,8 @@ from typing import Any, Optional
 
 from ozone_tpu.om.metadata import bucket_key, key_key
 from ozone_tpu.om import requests as rq
+
+log = logging.getLogger(__name__)
 
 NO_SUCH_UPLOAD = "NO_SUCH_MULTIPART_UPLOAD"
 INVALID_PART = "INVALID_PART"
@@ -244,15 +247,34 @@ class OpenKeyCleanupService:
 
     def run_once(self, limit: int = 256) -> int:
         cutoff = time.time() - self.max_age_s
-        expired = [
-            k
-            for k, info in self.om.store.iterate("open_keys")
-            if info.get("created", 0) < cutoff
-            and not k.startswith("/.snapmeta/")
-        ][:limit]
+        expired = []
+        hsynced = []
+        for k, info in self.om.store.iterate("open_keys"):
+            if k.startswith("/.snapmeta/"):
+                continue
+            if info.get("hsync_client_id"):
+                # a live hsync stream refreshes "modified" on every sync:
+                # only a writer that stopped syncing for max_age is dead
+                if max(info.get("created", 0),
+                       info.get("modified", 0)) < cutoff:
+                    hsynced.append(info)
+            elif info.get("created", 0) < cutoff:
+                expired.append(k)
+        expired = expired[:limit]
         if expired:
             self.om.submit(PurgeExpiredOpenKeys(expired))
-        return len(expired)
+        # an expired hsynced session means the writer died mid-stream:
+        # seal the key at its last synced length instead of discarding it
+        # (the reference's cleanup commits hsync'd keys the same way)
+        for info in hsynced[:limit]:
+            try:
+                self.om.recover_lease(
+                    info["volume"], info["bucket"], info["name"]
+                )
+            except rq.OMError:
+                log.warning("lease recovery failed for %s/%s/%s",
+                            info["volume"], info["bucket"], info["name"])
+        return len(expired) + len(hsynced[:limit])
 
 
 class MultipartUploadCleanupService:

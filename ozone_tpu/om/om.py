@@ -347,7 +347,8 @@ class OzoneManager:
         )
 
     def commit_key(
-        self, session: OpenKeySession, groups: list[BlockGroup], size: int
+        self, session: OpenKeySession, groups: list[BlockGroup], size: int,
+        hsync: bool = False,
     ) -> None:
         from ozone_tpu.om import fso
 
@@ -361,6 +362,7 @@ class OzoneManager:
                     session.client_id,
                     size,
                     [g.to_json() for g in groups],
+                    hsync=hsync,
                 )
             )
         else:
@@ -373,9 +375,26 @@ class OzoneManager:
                     size,
                     [g.to_json() for g in groups],
                     replication=str(session.replication),
+                    hsync=hsync,
                 )
             )
-        self.metrics.counter("keys_committed").inc()
+        self.metrics.counter("keys_hsynced" if hsync
+                             else "keys_committed").inc()
+
+    def hsync_key(
+        self, session: OpenKeySession, groups: list[BlockGroup], size: int
+    ) -> None:
+        """Mid-write durability commit: the key becomes readable at the
+        synced length while the write stream stays open (the reference's
+        hsync support in KeyOutputStream / OMKeyCommitRequest isHsync)."""
+        self.commit_key(session, groups, size, hsync=True)
+
+    def recover_lease(self, volume: str, bucket: str, key: str) -> dict:
+        """Seal an abandoned hsynced write and fence its dead writer
+        (recoverLease of the ozonefs adapter / OMRecoverLeaseRequest)."""
+        out = self.submit(rq.RecoverLease(volume, bucket, key))
+        self.metrics.counter("leases_recovered").inc()
+        return out
 
     def lookup_key(self, volume: str, bucket: str, key: str) -> dict:
         from ozone_tpu.om import fso

@@ -172,10 +172,51 @@ class DeleteBucket(OMRequest):
         store.delete("buckets", k)
 
 
+def direct_sessions_of(store, ek: str) -> list[str]:
+    """Open-session storage keys belonging to entry `ek` itself — NOT to
+    longer key names that extend it with a slash (OBS key names legally
+    contain slashes; client ids never do)."""
+    return [
+        k
+        for k, _ in store.iterate("open_keys", f"{ek}/")
+        if "/" not in k[len(ek) + 1:]
+    ]
+
+
+def finalize_commit(store, table: str, ek: str, info: dict, old,
+                    client_id: str, hsync: bool, modified: float) -> None:
+    """Shared hsync-aware commit tail for OBS keys and FSO files: stamp or
+    clear hsync_client_id, keep or drop the open session, and route a
+    superseded previous version to the purge chain — fencing its writer
+    first if that version was a live hsync stream (its blocks are about to
+    be purged, so its eventual commit must fail rather than resurrect
+    them)."""
+    if hsync:
+        info["hsync_client_id"] = client_id
+        store.put("open_keys", f"{ek}/{client_id}", info)  # session lives on
+    else:
+        info.pop("hsync_client_id", None)
+        store.delete("open_keys", f"{ek}/{client_id}")
+    if (
+        old is not None
+        and old.get("block_groups")
+        and old.get("hsync_client_id") != client_id
+    ):
+        stale_writer = old.get("hsync_client_id")
+        if stale_writer:
+            store.delete("open_keys", f"{ek}/{stale_writer}")
+        store.put("deleted_keys", f"{ek}:{modified}", old)
+    store.put(table, ek, info)
+
+
 @dataclass
 class CommitKey(OMRequest):
     """Finalize a key: move open-key session state into the key table
-    (OMKeyCommitRequest analog)."""
+    (OMKeyCommitRequest analog). With hsync=True this is the mid-write
+    durability commit (OMKeyCommitRequest's isHsync path): the key becomes
+    visible at the synced length, but the open session survives so the
+    writer can keep appending; the key carries hsync_client_id until the
+    final commit or a lease recovery clears it."""
 
     volume: str
     bucket: str
@@ -187,6 +228,7 @@ class CommitKey(OMRequest):
     checksum_type: str = "CRC32C"
     bytes_per_checksum: int = 16 * 1024
     modified: float = 0.0
+    hsync: bool = False
 
     def pre_execute(self, om) -> None:
         self.modified = time.time()
@@ -204,20 +246,66 @@ class CommitKey(OMRequest):
                 "modified": self.modified,
             }
         )
-        store.delete("open_keys", open_k)
-        # overwrite: the previous version's blocks must reach the purge
-        # chain or they leak on the datanodes
-        old = store.get("keys", kk)
-        if old is not None and old.get("block_groups"):
-            store.put("deleted_keys", f"{kk}:{self.modified}", old)
         if "acls" not in info:
             from ozone_tpu.om.acl import inherit_defaults
 
             b = store.get("buckets", bucket_key(self.volume, self.bucket))
             if b is not None:
                 info["acls"] = inherit_defaults(b.get("acls", []))
-        store.put("keys", kk, info)
+        old = store.get("keys", kk)
+        finalize_commit(store, "keys", kk, info, old, self.client_id,
+                        self.hsync, self.modified)
         return info
+
+
+@dataclass
+class RecoverLease(OMRequest):
+    """Finalize an abandoned hsynced write (OMRecoverLeaseRequest analog +
+    the ozonefs adapter's recoverLease): the key is sealed at its last
+    hsynced length, every open session for it is dropped, and the dead
+    writer is fenced — its eventual commit fails on the missing session.
+    Works on both OBS keys and FSO files (path resolved against the
+    bucket layout)."""
+
+    volume: str
+    bucket: str
+    key: str
+    modified: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.modified = time.time()
+
+    def apply(self, store):
+        from ozone_tpu.om import fso
+
+        b = store.get("buckets", bucket_key(self.volume, self.bucket))
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, f"{self.volume}/{self.bucket}")
+        if b.get("layout") == "FILE_SYSTEM_OPTIMIZED":
+            parent_id, name = fso.resolve_parent(
+                store, self.volume, self.bucket, self.key
+            )
+            ek = fso.dir_key(self.volume, self.bucket, parent_id, name)
+            table = "files"
+        else:
+            ek = key_key(self.volume, self.bucket, self.key)
+            table = "keys"
+        cur = store.get(table, ek)
+        sessions = direct_sessions_of(store, ek)
+        for s in sessions:
+            store.delete("open_keys", s)
+        if cur is not None:
+            if cur.pop("hsync_client_id", None) is not None:
+                cur["modified"] = self.modified
+                store.put(table, ek, cur)
+            return {"recovered": True, "key": cur}
+        if sessions:
+            # never hsynced: nothing visible to seal; dropping the
+            # sessions abandons the uncommitted chunks (unreferenced on
+            # the datanodes, reclaimed by scrubbing)
+            return {"recovered": False, "key": None}
+        raise OMError(KEY_NOT_FOUND,
+                      f"{self.volume}/{self.bucket}/{self.key}")
 
 
 @dataclass
@@ -279,6 +367,11 @@ class DeleteKey(OMRequest):
         if info is None:
             raise OMError(KEY_NOT_FOUND, kk)
         store.delete("keys", kk)
+        # deleting a live hsync stream: fence its writer before the blocks
+        # hit the purge chain, or its commit would resurrect purged blocks
+        stale_writer = info.get("hsync_client_id")
+        if stale_writer:
+            store.delete("open_keys", f"{kk}/{stale_writer}")
         store.put("deleted_keys", f"{kk}:{self.ts}", info)
         return info
 

@@ -198,6 +198,8 @@ def cmd_fs(args) -> int:
         else:
             om.delete_key(vol, bucket, path)
         print(f"deleted /{vol}/{bucket}/{path}")
+    elif args.verb == "recover-lease":
+        _emit(om.recover_lease(vol, bucket, path))
     return 0
 
 
@@ -274,6 +276,12 @@ def cmd_freon(args) -> int:
     elif args.generator == "ockr":
         oz = _client(args)
         _emit(freon.ockr(oz, args.num, threads=args.threads).summary())
+    elif args.generator == "hsg":
+        oz = _client(args)
+        _emit(freon.hsg(
+            oz, n_keys=args.num, size=args.size, threads=args.threads,
+            replication=args.replication or "RATIS/THREE",
+        ).summary())
     elif args.generator == "rawcoder":
         _emit(
             freon.rawcoder_bench(
@@ -552,7 +560,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     fs = sub.add_parser("fs", help="file-system verbs on FSO buckets "
                                    "(ozone fs analog)")
-    fs.add_argument("verb", choices=["mkdir", "ls", "stat", "rm"])
+    fs.add_argument("verb", choices=["mkdir", "ls", "stat", "rm",
+                                     "recover-lease"])
     fs.add_argument("path", help="/volume/bucket[/dir/path]")
     fs.add_argument("-r", "--recursive", action="store_true")
     fs.add_argument("--om", default="127.0.0.1:9860")
@@ -598,7 +607,7 @@ def build_parser() -> argparse.ArgumentParser:
     fr.add_argument("generator",
                     choices=["ockg", "ockr", "rawcoder", "omkg", "ommg",
                              "scmtb", "cmdw", "dbgen", "dcg", "dcv",
-                             "dsg"])
+                             "dsg", "hsg"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("-t", "--threads", type=int, default=4)

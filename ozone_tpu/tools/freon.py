@@ -136,6 +136,42 @@ def ockg(
     return BaseFreonGenerator("ockg", n_keys, threads).run(op)
 
 
+def hsg(
+    client,
+    n_keys: int = 20,
+    size: int = 10 * 1024,
+    syncs: int = 4,
+    threads: int = 4,
+    volume: str = "freon-vol",
+    bucket: str = "freon-hsync",
+    replication: str = "RATIS/THREE",
+) -> FreonReport:
+    """Hsync generator (freon HsyncGenerator analog): each op opens a key,
+    writes `syncs` slices with an hsync after every slice (the HBase
+    WAL-style durability pattern), then closes. The timer therefore covers
+    the full open -> (write+hsync)*n -> commit round trip."""
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket, replication)
+    except Exception:
+        pass
+    b = client.get_volume(volume).get_bucket(bucket)
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, size, dtype=np.uint8)
+
+    def op(i: int) -> int:
+        with b.open_key(f"hsync-{i}") as h:
+            for _ in range(syncs):
+                h.write(payload)
+                h.hsync()
+        return size * syncs
+
+    return BaseFreonGenerator("hsg", n_keys, threads).run(op)
+
+
 def ockr(client, n_keys: int, threads: int = 4, volume: str = "freon-vol",
          bucket: str = "freon-bucket", prefix: str = "key") -> FreonReport:
     """Key read generator (validation pass over ockg output)."""

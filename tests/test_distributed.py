@@ -13,7 +13,7 @@ from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ozone_client import OzoneClient
 from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
 from ozone_tpu.net.om_service import GrpcOmClient
-from ozone_tpu.storage.ids import BlockID, ChunkInfo
+from ozone_tpu.storage.ids import BlockID, ChunkInfo, StorageError
 
 EC = "rs-3-2-4096"
 
@@ -361,3 +361,27 @@ def test_decommission_survives_scm_restart(tmp_path):
             d.stop()
         for m in metas:
             m.stop()
+
+
+def test_hsync_and_recover_lease_over_grpc(cluster):
+    """hsync/recover-lease ride the remote OM protocol (GrpcOmClient
+    CommitKey hsync flag + RecoverLease verb)."""
+    meta, dns = cluster
+    oz = _client(meta)
+    oz.create_volume("hv")
+    b = oz.get_volume("hv").create_bucket("hb", replication="RATIS/THREE")
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, 30_000, dtype=np.uint8)
+    h = b.open_key("k")
+    h.write(data[:20_000])
+    h.hsync()
+    assert np.array_equal(b.read_key("k"), data[:20_000])
+    out = oz.om.recover_lease("hv", "hb", "k")
+    assert out["recovered"] is True
+    assert np.array_equal(b.read_key("k"), data[:20_000])
+    # fenced: the stale writer's close fails against the sealed key
+    h.write(data[20_000:])
+    with pytest.raises(StorageError) as ei:
+        h.close()
+    assert ei.value.code == "KEY_NOT_FOUND"
+    assert np.array_equal(b.read_key("k"), data[:20_000])
