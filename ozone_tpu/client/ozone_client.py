@@ -194,6 +194,13 @@ class OzoneBucket:
         assert out.size == info["size"], (out.size, info["size"])
         return out
 
+    def file_checksum(self, key: str) -> dict:
+        """Composite whole-key checksum from stored chunk CRCs, no data
+        read (getFileChecksum / ECFileChecksumHelper analog)."""
+        from ozone_tpu.client.file_checksum import file_checksum
+
+        return file_checksum(self.client, self.volume, self.name, key)
+
     def delete_key(self, key: str) -> None:
         self.client.om.delete_key(self.volume, self.name, key)
 

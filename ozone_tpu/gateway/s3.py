@@ -50,6 +50,14 @@ def _xml(root: ET.Element) -> bytes:
     return b'<?xml version="1.0" encoding="UTF-8"?>' + ET.tostring(root)
 
 
+def _iso_now() -> str:
+    import datetime
+
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.000Z"
+    )
+
+
 def _err(code: str, message: str, status: int) -> tuple[int, bytes]:
     e = ET.Element("Error")
     ET.SubElement(e, "Code").text = code
@@ -388,7 +396,39 @@ class S3Gateway:
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
 
+    def _parse_copy_source(self, h) -> Optional[tuple[str, str]]:
+        """x-amz-copy-source: '/bucket/key' or 'bucket/key' (URL-encoded).
+        Returns (bucket, key) or None when the header is absent."""
+        from urllib.parse import unquote
+
+        src = h.headers.get("x-amz-copy-source")
+        if not src:
+            return None
+        src = unquote(src).lstrip("/")
+        b, _, k = src.partition("/")
+        if not b or not k:
+            raise ValueError(src)
+        return b, k
+
     def _put_object(self, h, bucket: str, key: str) -> None:
+        try:
+            src = self._parse_copy_source(h)
+        except ValueError as e:
+            h._reply(*_err("InvalidArgument",
+                           f"bad x-amz-copy-source: {e}", 400))
+            return
+        if src is not None:  # CopyObject (ObjectEndpoint.put copyHeader)
+            h._body()  # drain any (ignored) request body
+            data = self._bucket_handle(src[0]).read_key(src[1]).tobytes()
+            self._bucket_handle(bucket).write_key(
+                key, np.frombuffer(data, np.uint8)
+            )
+            etag = hashlib.md5(data).hexdigest()
+            root = ET.Element("CopyObjectResult", xmlns=_NS)
+            ET.SubElement(root, "ETag").text = f'"{etag}"'
+            ET.SubElement(root, "LastModified").text = _iso_now()
+            h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+            return
         body = h._body()
         self._bucket_handle(bucket).write_key(
             key, np.frombuffer(body, np.uint8)
@@ -452,6 +492,41 @@ class S3Gateway:
         if mpu is None:
             return
         part_no = int(q.get("partNumber", ["1"])[0])
+        try:
+            src = self._parse_copy_source(h)
+        except ValueError as e:
+            h._reply(*_err("InvalidArgument",
+                           f"bad x-amz-copy-source: {e}", 400))
+            return
+        if src is not None:  # UploadPartCopy (ObjectEndpoint copy-part)
+            h._body()
+            data = self._bucket_handle(src[0]).read_key(src[1]).tobytes()
+            rng = h.headers.get("x-amz-copy-source-range")
+            if rng:
+                # AWS requires the full bytes=<lo>-<hi> form here (no
+                # open-ended or suffix ranges) and rejects bounds that
+                # fall outside the source object
+                lo_s, dash, hi_s = rng.removeprefix("bytes=").partition("-")
+                if (not rng.startswith("bytes=") or not dash
+                        or not lo_s.isdigit() or not hi_s.isdigit()):
+                    h._reply(*_err(
+                        "InvalidArgument",
+                        f"bad x-amz-copy-source-range: {rng}", 400))
+                    return
+                lo, hi = int(lo_s), int(hi_s)
+                if lo > hi or hi >= len(data):
+                    h._reply(*_err(
+                        "InvalidRange",
+                        f"range {lo}-{hi} outside source of "
+                        f"{len(data)} bytes", 416))
+                    return
+                data = data[lo : hi + 1]
+            etag = mpu.write_part(part_no, np.frombuffer(data, np.uint8))
+            root = ET.Element("CopyPartResult", xmlns=_NS)
+            ET.SubElement(root, "ETag").text = f'"{etag}"'
+            ET.SubElement(root, "LastModified").text = _iso_now()
+            h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+            return
         body = h._body()
         etag = mpu.write_part(part_no, np.frombuffer(body, np.uint8))
         h._reply(200, headers={"ETag": f'"{etag}"'})

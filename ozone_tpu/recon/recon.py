@@ -38,21 +38,34 @@ class ReconTasks:
         self.om = om
 
     def namespace_summary(self) -> dict:
+        """Namespace totals plus per-bucket heat cells — one walk serves
+        both the summary tiles and the heatmap (the reference Recon
+        heatmap's entity-heat view; access-frequency heat would need
+        audit-fed counters, size heat is the warehouse-derivable
+        equivalent)."""
         vols = self.om.list_volumes()
         out = {"volumes": len(vols), "buckets": 0, "keys": 0, "bytes": 0,
-               "per_volume": {}}
+               "per_volume": {}, "heat_cells": []}
         for v in vols:
             name = v["name"]
             buckets = self.om.list_buckets(name)
             vsum = {"buckets": len(buckets), "keys": 0, "bytes": 0}
             for b in buckets:
                 keys = self.om.list_keys(name, b["name"])
+                nbytes = int(sum(k["size"] for k in keys))
                 vsum["keys"] += len(keys)
-                vsum["bytes"] += sum(k["size"] for k in keys)
+                vsum["bytes"] += nbytes
+                out["heat_cells"].append({
+                    "volume": name,
+                    "bucket": b["name"],
+                    "keys": len(keys),
+                    "bytes": nbytes,
+                })
             out["buckets"] += vsum["buckets"]
             out["keys"] += vsum["keys"]
             out["bytes"] += vsum["bytes"]
             out["per_volume"][name] = vsum
+        out["heat_cells"].sort(key=lambda c: -c["bytes"])
         return out
 
     def file_size_histogram(self) -> dict:
@@ -328,6 +341,13 @@ class ReconServer:
                         str(k): v
                         for k, v in recon.key_index.container_key_map()
                         .items()
+                    },
+                    # derived from the (cached, warehouse-recorded)
+                    # namespace scan: no extra OM walk in the request path
+                    "/api/heatmap": lambda: {
+                        "cells": recon._scan(
+                            "namespace", recon.tasks.namespace_summary
+                        ).get("heat_cells", [])
                     },
                     "/api/containers/health": recon.scm_view.container_health,
                     "/api/nodes": recon.scm_view.node_table,

@@ -100,6 +100,22 @@ RECON_INDEX_HTML = """<!doctype html>
     min-width: 2px;
   }
   .bar-val { font-size: 12px; }
+  .heat-grid {
+    display: flex; flex-wrap: wrap; gap: 6px; max-width: 720px;
+  }
+  .heat-cell {
+    border: 1px solid var(--border); border-radius: 6px;
+    padding: 8px 10px; min-width: 120px;
+    /* sequential single-hue scale via opacity over the series color;
+       the text label carries the value, color is reinforcement only */
+    position: relative; overflow: hidden;
+  }
+  .heat-fill {
+    position: absolute; inset: 0; background: var(--series-1);
+  }
+  .heat-cell .lbl, .heat-cell .val { position: relative; }
+  .heat-cell .lbl { font-size: 12px; color: var(--text-secondary); }
+  .heat-cell .val { font-weight: 600; }
   .err { color: var(--status-critical); }
 </style>
 </head>
@@ -129,6 +145,11 @@ RECON_INDEX_HTML = """<!doctype html>
     <thead><tr><th>class</th><th>count</th></tr></thead>
     <tbody></tbody>
   </table>
+
+  <h2>Namespace heat</h2>
+  <div class="sub">bytes per bucket &mdash; darker is larger; each cell
+    carries its own value</div>
+  <div id="heat"></div>
 
   <h2>File sizes</h2>
   <div id="sizes"></div>
@@ -202,6 +223,18 @@ async function refresh() {
         Object.entries(s.containers || {})
           .map(([k, v]) =>
             `<tr><td>${esc(k)}</td><td>${esc(v)}</td></tr>`).join("");
+
+    const hm = await (await fetch("/api/heatmap")).json();
+    const hcells = hm.cells || [];
+    const hmax = Math.max(1, ...hcells.map(c => c.bytes));
+    document.getElementById("heat").innerHTML =
+      '<div class="heat-grid">' + hcells.map(c =>
+        `<div class="heat-cell">` +
+        `<div class="heat-fill" style="opacity:${
+            (0.08 + 0.62 * c.bytes / hmax).toFixed(3)}"></div>` +
+        `<div class="lbl">${esc(c.volume)}/${esc(c.bucket)}</div>` +
+        `<div class="val">${fmtBytes(c.bytes)} &middot; ` +
+        `${esc(c.keys)} keys</div></div>`).join("") + "</div>";
 
     const fs = await (await fetch("/api/filesizes")).json();
     const entries = Object.entries(fs);

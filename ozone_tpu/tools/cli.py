@@ -129,6 +129,8 @@ def cmd_sh(args) -> int:
             b.delete_key(key)
         elif verb == "info":
             _emit(oz.om.lookup_key(vol, bucket, key))
+        elif verb == "checksum":
+            _emit(b.file_checksum(key))
         elif verb == "rename":
             b.rename_key(key, args.to)
     return 0
@@ -201,6 +203,12 @@ def cmd_fs(args) -> int:
     elif args.verb == "recover-lease":
         _emit(om.recover_lease(vol, bucket, path))
     return 0
+
+
+def _cmd_audit(args) -> int:
+    from ozone_tpu.tools.audit_parser import run_cli
+
+    return run_cli(args)
 
 
 # -------------------------------------------------------------------- admin
@@ -546,7 +554,7 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("object", choices=["volume", "bucket", "key"])
     sh.add_argument("verb",
                     choices=["create", "delete", "info", "list", "put",
-                             "get", "rename"])
+                             "get", "rename", "checksum"])
     sh.add_argument("path", help="/volume[/bucket[/key]]")
     sh.add_argument("file", nargs="?", help="local file for key put/get")
     sh.add_argument("--om", default="127.0.0.1:9860")
@@ -693,6 +701,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="partition scope tag (default: whole process)")
     ins.add_argument("-n", "--num", type=int, default=100)
     ins.set_defaults(fn=cmd_insight)
+
+    au = sub.add_parser("audit",
+                        help="audit log parser (ozone auditparser analog)")
+    au.add_argument("verb", choices=["parse", "top", "failures"])
+    au.add_argument("logfile", help="audit log file (JSON lines)")
+    au.add_argument("--user", default="")
+    au.add_argument("--action", default="")
+    au.add_argument("--result", default="")
+    au.add_argument("--by", default="action",
+                    choices=["action", "user", "result"])
+    au.add_argument("-n", "--num", type=int, default=50)
+    au.set_defaults(fn=_cmd_audit)
 
     rp = sub.add_parser("repair", help="repair tools (ozone repair analog)")
     rp.add_argument("tool", choices=["orphans"])

@@ -1,0 +1,75 @@
+"""Audit parser tool (ozone auditparser analog): tolerant JSON-line
+parsing, filters, frequency aggregation, failures view, CLI."""
+
+import json
+
+from ozone_tpu.tools.audit_parser import (
+    aggregate,
+    failures,
+    filter_records,
+    parse_file,
+    parse_line,
+)
+from ozone_tpu.utils.audit import AuditLogger
+
+
+def test_parse_line_tolerates_logging_prefix():
+    rec = parse_line(
+        'INFO 2026-07-30 audit.om: {"ts": 1.0, "user": "alice", '
+        '"action": "CreateVolume", "params": {}, "result": "SUCCESS"}'
+    )
+    assert rec["action"] == "CreateVolume" and rec["user"] == "alice"
+    assert parse_line("not json at all") is None
+    assert parse_line('{"no_action": true}') is None
+
+
+def test_roundtrip_through_real_audit_logger(tmp_path, caplog):
+    import logging
+
+    logfile = tmp_path / "audit.log"
+    handler = logging.FileHandler(logfile)
+    logger = logging.getLogger("audit.test-component")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        al = AuditLogger("test-component")
+        al.log("CreateVolume", {"volume": "v"}, user="alice")
+        al.log("CreateBucket", {"bucket": "b"}, user="alice")
+        al.log("DeleteKey", {"key": "k"}, ok=False, error="nope",
+               user="bob")
+        handler.flush()
+    finally:
+        logger.removeHandler(handler)
+        handler.close()
+    recs = list(parse_file(logfile))
+    assert len(recs) == 3
+    assert [r["action"] for r in recs] == [
+        "CreateVolume", "CreateBucket", "DeleteKey"]
+    assert aggregate(recs, by="user")[0] == {"user": "alice", "count": 2}
+    fails = failures(recs)
+    assert len(fails) == 1 and fails[0]["error"] == "nope"
+    only_bob = list(filter_records(recs, user="bob"))
+    assert len(only_bob) == 1 and only_bob[0]["action"] == "DeleteKey"
+
+
+def test_cli_top_and_failures(tmp_path, capsys):
+    from ozone_tpu.tools.cli import main
+
+    logfile = tmp_path / "a.log"
+    lines = []
+    for i in range(5):
+        lines.append(json.dumps({
+            "ts": float(i), "user": "u", "action": "Put",
+            "params": {}, "result": "SUCCESS"}))
+    lines.append(json.dumps({
+        "ts": 9.0, "user": "u", "action": "Get", "params": {},
+        "result": "FAILURE", "error": "boom"}))
+    logfile.write_text("\n".join(lines) + "\n")
+
+    assert main(["audit", "top", str(logfile)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out[0] == {"action": "Put", "count": 5}
+
+    assert main(["audit", "failures", str(logfile)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert len(out) == 1 and out[0]["error"] == "boom"

@@ -1,0 +1,100 @@
+"""Composite file checksums (getFileChecksum / ECFileChecksumHelper +
+ECBlockChecksumComputer analog): the whole-key CRC composed from chunk
+checksums stored on the datanodes, without reading data — and equal
+across replication layouts, the distcp comparison property.
+"""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+from ozone_tpu.utils.checksum import crc32c
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniOzoneCluster(
+        tmp_path,
+        num_datanodes=5,
+        block_size=4 * 4096,  # multi-block keys for multi-group compose
+        container_size=1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+def _payload(n, seed):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def test_replicated_composite_matches_whole_stream(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication="RATIS/THREE")
+    data = _payload(50_000, 0)  # spans multiple blocks
+    b.write_key("k", data)
+    out = b.file_checksum("k")
+    assert out["algorithm"] == "COMPOSITE-CRC32C"
+    assert out["length"] == data.size
+    assert int(out["checksum"], 16) == crc32c(data)
+
+
+def test_ec_composite_matches_whole_stream(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    # multi-stripe with a partial last cell AND partial last stripe
+    data = _payload(3 * 4096 * 2 + 4096 + 123, 1)
+    b.write_key("k", data)
+    out = b.file_checksum("k")
+    assert out["length"] == data.size
+    assert int(out["checksum"], 16) == crc32c(data)
+
+
+def test_composite_equal_across_layouts(cluster):
+    """The distcp property: identical bytes under EC and replication
+    produce the same composite checksum."""
+    oz = cluster.client()
+    vol = oz.create_volume("v")
+    ec_b = vol.create_bucket("ecb", replication=EC)
+    rep_b = vol.create_bucket("repb", replication="RATIS/THREE")
+    data = _payload(27_001, 2)
+    ec_b.write_key("k", data)
+    rep_b.write_key("k", data)
+    assert ec_b.file_checksum("k") == rep_b.file_checksum("k")
+
+
+def test_composite_differs_on_different_data(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    b.write_key("a", _payload(10_000, 3))
+    b.write_key("b", _payload(10_000, 4))
+    assert b.file_checksum("a") != b.file_checksum("b")
+
+
+def test_replicated_composite_survives_replica_loss(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication="RATIS/THREE")
+    data = _payload(20_000, 5)
+    b.write_key("k", data)
+    info = oz.om.lookup_key("v", "b", "k")
+    dn0 = info["block_groups"][0]["nodes"][0]
+    cluster.stop_datanode(dn0)
+    out = b.file_checksum("k")
+    assert int(out["checksum"], 16) == crc32c(data)
+
+
+def test_ec_composite_fails_loudly_when_a_unit_is_unreachable(cluster):
+    """An unreachable data unit must raise, never return a plausible
+    short composition (the silent-shortening integrity hazard)."""
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    data = _payload(3 * 4096 * 2, 6)  # all units hold data
+    b.write_key("k", data)
+    info = oz.om.lookup_key("v", "b", "k")
+    # unit 0's datanode dies
+    cluster.stop_datanode(info["block_groups"][0]["nodes"][0])
+    with pytest.raises(Exception):
+        b.file_checksum("k")

@@ -148,3 +148,72 @@ def test_fs_adapter(cluster):
         fs.delete("/a", recursive=False)
     fs.delete("/a", recursive=True)
     assert not fs.exists("/a/b/file2")
+
+
+def test_s3_copy_object(s3):
+    """CopyObject via x-amz-copy-source (ObjectEndpoint.put copyHeader),
+    including cross-bucket copy."""
+    payload = bytes(np.random.default_rng(5).integers(0, 256, 12_000,
+                                                      dtype=np.uint8))
+    _req(s3, "PUT", "/srcb")
+    _req(s3, "PUT", "/dstb")
+    _req(s3, "PUT", "/srcb/orig", data=payload)
+    r = _req(s3, "PUT", "/dstb/copied",
+             headers={"x-amz-copy-source": "/srcb/orig"})
+    assert r.status == 200
+    body = r.read()
+    assert b"CopyObjectResult" in body and b"ETag" in body
+    assert _req(s3, "GET", "/dstb/copied").read() == payload
+    # source must be untouched
+    assert _req(s3, "GET", "/srcb/orig").read() == payload
+    # missing source -> 404
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "PUT", "/dstb/bad",
+             headers={"x-amz-copy-source": "/srcb/nope"})
+    assert ei.value.code == 404
+
+
+def test_s3_upload_part_copy(s3):
+    """UploadPartCopy: MPU parts sourced from an existing object with an
+    optional x-amz-copy-source-range."""
+    src = bytes(np.random.default_rng(6).integers(0, 256, 20_000,
+                                                  dtype=np.uint8))
+    _req(s3, "PUT", "/cpb")
+    _req(s3, "PUT", "/cpb/src", data=src)
+    r = _req(s3, "POST", "/cpb/assembled?uploads")
+    tree = ET.fromstring(r.read())
+    upload_id = next(e.text for e in tree.iter()
+                     if e.tag.endswith("UploadId"))
+    # part 1: first half of src via range copy; part 2: rest, plain upload
+    r = _req(s3, "PUT",
+             f"/cpb/assembled?partNumber=1&uploadId={upload_id}",
+             headers={"x-amz-copy-source": "/cpb/src",
+                      "x-amz-copy-source-range": "bytes=0-9999"})
+    assert r.status == 200 and b"CopyPartResult" in r.read()
+    r = _req(s3, "PUT",
+             f"/cpb/assembled?partNumber=2&uploadId={upload_id}",
+             data=src[10_000:])
+    assert r.status == 200
+    r = _req(s3, "POST", f"/cpb/assembled?uploadId={upload_id}", data=b"")
+    assert r.status == 200
+    assert _req(s3, "GET", "/cpb/assembled").read() == src
+
+
+def test_s3_upload_part_copy_rejects_bad_ranges(s3):
+    src = bytes(np.random.default_rng(7).integers(0, 256, 1_000,
+                                                  dtype=np.uint8))
+    _req(s3, "PUT", "/rgb")
+    _req(s3, "PUT", "/rgb/src", data=src)
+    r = _req(s3, "POST", "/rgb/part?uploads")
+    tree = ET.fromstring(r.read())
+    upload_id = next(e.text for e in tree.iter()
+                     if e.tag.endswith("UploadId"))
+    for rng, code in [("bytes=1000-1999", 416),  # past the end
+                      ("bytes=500-100", 416),    # inverted
+                      ("bytes=-500", 400),       # suffix form
+                      ("bytes=0-", 400)]:        # open-ended
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(s3, "PUT", f"/rgb/part?partNumber=1&uploadId={upload_id}",
+                 headers={"x-amz-copy-source": "/rgb/src",
+                          "x-amz-copy-source-range": rng})
+        assert ei.value.code == code, rng

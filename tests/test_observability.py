@@ -51,6 +51,15 @@ def test_recon_endpoints(cluster):
         assert not health["missing"]
         nodes = json.loads(urllib.request.urlopen(base + "/api/nodes").read())
         assert len(nodes) == 5
+        heat = json.loads(
+            urllib.request.urlopen(base + "/api/heatmap").read()
+        )
+        assert heat["cells"] == [
+            {"volume": "v", "bucket": "b", "keys": 3, "bytes": 65_100}
+        ]
+        # the dashboard page renders the heat panel
+        page = urllib.request.urlopen(base + "/").read().decode()
+        assert "Namespace heat" in page and "/api/heatmap" in page
         # base endpoints still work
         prom = urllib.request.urlopen(base + "/prom").read().decode()
         assert "om_" in prom
