@@ -250,6 +250,7 @@ class S3Gateway:
                 "BUCKET_NOT_EMPTY": ("BucketNotEmpty", 409),
                 "NO_SUCH_MULTIPART_UPLOAD": ("NoSuchUpload", 404),
                 "INVALID_PART": ("InvalidPart", 400),
+                "QUOTA_EXCEEDED": ("QuotaExceeded", 403),
             }.get(e.code, ("InternalError", 500))
             h._reply(*_err(code[0], str(e), code[1]))
         except Exception as e:  # noqa: BLE001
@@ -350,24 +351,128 @@ class S3Gateway:
             om.delete_bucket(self._vol, bucket)
             h._reply(204)
         elif method in ("GET",):
-            prefix = q.get("prefix", [""])[0]
-            keys = om.list_keys(self._vol, bucket, prefix)
-            root = ET.Element("ListBucketResult", xmlns=_NS)
-            ET.SubElement(root, "Name").text = bucket
-            ET.SubElement(root, "Prefix").text = prefix
-            ET.SubElement(root, "KeyCount").text = str(len(keys))
-            ET.SubElement(root, "IsTruncated").text = "false"
-            for k in keys:
-                c = ET.SubElement(root, "Contents")
-                ET.SubElement(c, "Key").text = k["name"]
-                ET.SubElement(c, "Size").text = str(k["size"])
-                ET.SubElement(c, "LastModified").text = str(k.get("modified", ""))
-            h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+            self._list_objects(h, bucket, q)
+        elif method == "POST" and "delete" in q:
+            self._multi_delete(h, bucket)
         elif method == "HEAD":
             om.bucket_info(self._vol, bucket)
             h._reply(200)
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
+
+    def _list_objects(self, h, bucket: str, q) -> None:
+        """ListObjectsV2: prefix, delimiter -> CommonPrefixes grouping,
+        max-keys truncation with NextContinuationToken / start-after
+        (BucketEndpoint list semantics; goofys/boto3 folder browsing)."""
+        om = self.client.om
+        prefix = q.get("prefix", [""])[0]
+        delim = q.get("delimiter", [""])[0]
+        try:
+            max_keys = max(0, int(q.get("max-keys", ["1000"])[0]))
+        except ValueError:
+            h._reply(*_err("InvalidArgument", "bad max-keys", 400))
+            return
+        after = (q.get("continuation-token", [""])[0]
+                 or q.get("start-after", [""])[0])
+        keys = sorted(om.list_keys(self._vol, bucket, prefix),
+                      key=lambda k: k["name"])
+        if after:
+            # binary-search to the resume point: pagination stays
+            # O(page + log n) per request instead of rescanning from the
+            # first key every page
+            import bisect
+
+            names = [k["name"] for k in keys]
+            keys = keys[bisect.bisect_right(names, after):]
+        contents: list[dict] = []
+        common: list[str] = []
+        truncated = False
+        next_token = ""
+        if max_keys == 0:
+            keys = []  # AWS: MaxKeys=0 returns empty, not truncated
+        for k in keys:
+            name = k["name"]
+            if delim:
+                rest = name[len(prefix):]
+                cut = rest.find(delim)
+                if cut >= 0:  # group under the rolled-up prefix
+                    cp = prefix + rest[: cut + len(delim)]
+                    if after and cp <= after:
+                        continue  # whole group already served last page
+                    if common and common[-1] == cp:
+                        continue
+                    if len(contents) + len(common) >= max_keys:
+                        truncated = True
+                        break
+                    common.append(cp)
+                    continue
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                break
+            contents.append(k)
+        if truncated:
+            next_token = (contents[-1]["name"] if contents else "")
+            last_cp = common[-1] if common else ""
+            next_token = max(next_token, last_cp)
+        root = ET.Element("ListBucketResult", xmlns=_NS)
+        ET.SubElement(root, "Name").text = bucket
+        ET.SubElement(root, "Prefix").text = prefix
+        if delim:
+            ET.SubElement(root, "Delimiter").text = delim
+        ET.SubElement(root, "KeyCount").text = str(
+            len(contents) + len(common))
+        ET.SubElement(root, "MaxKeys").text = str(max_keys)
+        ET.SubElement(root, "IsTruncated").text = (
+            "true" if truncated else "false")
+        if truncated and next_token:
+            ET.SubElement(root, "NextContinuationToken").text = next_token
+        for k in contents:
+            c = ET.SubElement(root, "Contents")
+            ET.SubElement(c, "Key").text = k["name"]
+            ET.SubElement(c, "Size").text = str(k["size"])
+            ET.SubElement(c, "LastModified").text = str(k.get("modified", ""))
+        for cp in common:
+            e = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(e, "Prefix").text = cp
+        h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+
+    def _multi_delete(self, h, bucket: str) -> None:
+        """POST /bucket?delete (BucketEndpoint multi-delete): per-key
+        success/error entries, quiet-mode suppression of successes."""
+        try:
+            tree = ET.fromstring(h._body())
+        except ET.ParseError as e:
+            h._reply(*_err("MalformedXML", str(e), 400))
+            return
+        quiet = (tree.findtext("{*}Quiet") or
+                 tree.findtext("Quiet") or "").lower() == "true"
+        names = [
+            el.findtext("{*}Key") or el.findtext("Key") or ""
+            for el in list(tree.iter("{%s}Object" % _NS)) +
+            list(tree.iter("Object"))
+        ]
+        bh = self._bucket_handle(bucket)
+        root = ET.Element("DeleteResult", xmlns=_NS)
+        for name in names:
+            if not name:
+                continue
+            try:
+                bh.delete_key(name)
+                if not quiet:
+                    d = ET.SubElement(root, "Deleted")
+                    ET.SubElement(d, "Key").text = name
+            except _OM_ERRORS as e:
+                # S3 treats deleting a missing key as success
+                if e.code == "KEY_NOT_FOUND":
+                    if not quiet:
+                        d = ET.SubElement(root, "Deleted")
+                        ET.SubElement(d, "Key").text = name
+                else:
+                    er = ET.SubElement(root, "Error")
+                    ET.SubElement(er, "Key").text = name
+                    ET.SubElement(er, "Code").text = e.code
+                    ET.SubElement(er, "Message").text = str(e)
+        h._reply(200, _xml(root), {"Content-Type": "application/xml"})
 
     # ------------------------------------------------------------- objects
     def _bucket_handle(self, bucket: str):

@@ -66,6 +66,16 @@ class OmGrpcService:
                 "AllocateBlock": self._allocate_block,
                 "CommitKey": self._commit_key,
                 "RecoverLease": self._recover_lease,
+                "SetQuota": self._wrap(
+                    lambda m: self.om.set_quota(
+                        m["volume"], m.get("bucket", ""),
+                        m.get("quota_bytes"),
+                        m.get("quota_namespace"),
+                    )
+                ),
+                "RepairQuota": self._wrap(
+                    lambda m: self.om.repair_quota(m["volume"])
+                ),
                 "LookupKey": self._wrap(
                     lambda m: self.om.lookup_key(m["volume"], m["bucket"], m["key"])
                 ),
@@ -469,6 +479,15 @@ class GrpcOmClient:
     def recover_lease(self, volume, bucket, key):
         return self._call("RecoverLease", volume=volume, bucket=bucket,
                           key=key)["result"]
+
+    def set_quota(self, volume, bucket="", quota_bytes=None,
+                  quota_namespace=None):
+        return self._call("SetQuota", volume=volume, bucket=bucket,
+                          quota_bytes=quota_bytes,
+                          quota_namespace=quota_namespace)["result"]
+
+    def repair_quota(self, volume):
+        return self._call("RepairQuota", volume=volume)["result"]
 
     def lookup_key(self, volume, bucket, key):
         return self._call("LookupKey", volume=volume, bucket=bucket, key=key)[

@@ -291,6 +291,10 @@ class DeleteFile(OMRequest):
         if stale_writer:
             store.delete("open_keys", f"{fk}/{stale_writer}")
         store.put("deleted_keys", f"{fk}:{self.ts}", info)
+        from ozone_tpu.om.requests import check_and_charge_quota
+
+        check_and_charge_quota(store, self.volume, self.bucket,
+                               -int(info.get("size", 0)), -1)
         return info
 
 
@@ -404,9 +408,14 @@ class PurgeDirectories(OMRequest):
     dir_moves: list[list] = field(default_factory=list)  # [deleted_dirs key, info]
 
     def apply(self, store):
+        from ozone_tpu.om.requests import check_and_charge_quota
+
         for fk, info, ts in self.file_moves:
             store.delete("files", fk)
             store.put("deleted_keys", f"{fk}:{ts}", info)
+            _, vol, bkt = fk.split("/", 3)[:3]
+            check_and_charge_quota(store, vol, bkt,
+                                   -int(info.get("size", 0)), -1)
         for dk, info in self.dir_moves:
             store.delete("dirs", dk)
             store.delete("dir_ids",

@@ -175,13 +175,21 @@ class CompleteMultipartUpload(rq.OMRequest):
             listed.append(part)
         if not listed:
             raise rq.OMError(INVALID_PART, "no parts listed")
+        kk = key_key(self.volume, self.bucket, self.key)
+        old = store.get("keys", kk)
+        # quota precedes EVERY mutation: a QUOTA_EXCEEDED complete must
+        # leave the upload fully intact for a retry after space is freed
+        rq.check_and_charge_quota(
+            store, self.volume, self.bucket,
+            sum(p["size"] for p in listed)
+            - (int(old.get("size", 0)) if old else 0),
+            0 if old is not None else 1,
+        )
         # orphaned parts: uploaded but omitted from the complete request
         listed_nos = {str(int(p["part_number"])) for p in self.parts}
         for no, part in mpu["parts"].items():
             if no not in listed_nos:
                 _release_blocks(store, part, self.ts, f"{mk}/part{no}")
-        kk = key_key(self.volume, self.bucket, self.key)
-        old = store.get("keys", kk)
         if old is not None:
             _release_blocks(store, old, self.ts, kk)
         info = {

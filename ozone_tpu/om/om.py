@@ -396,6 +396,18 @@ class OzoneManager:
         self.metrics.counter("leases_recovered").inc()
         return out
 
+    def set_quota(self, volume: str, bucket: str = "",
+                  quota_bytes: Optional[int] = None,
+                  quota_namespace: Optional[int] = None) -> dict:
+        """Space/namespace quota on a volume or bucket; None leaves a
+        dimension unchanged, -1 clears it to unlimited."""
+        return self.submit(rq.SetQuota(volume, bucket,
+                                       quota_bytes, quota_namespace))
+
+    def repair_quota(self, volume: str) -> dict:
+        """Recompute usage counters from the key/file tables."""
+        return self.submit(rq.RepairQuota(volume))
+
     def lookup_key(self, volume: str, bucket: str, key: str) -> dict:
         from ozone_tpu.om import fso
 

@@ -172,6 +172,67 @@ class DeleteBucket(OMRequest):
         store.delete("buckets", k)
 
 
+QUOTA_EXCEEDED = "QUOTA_EXCEEDED"
+
+
+def check_and_charge_quota(
+    store, volume: str, bucket: str, bytes_delta: int, keys_delta: int
+) -> None:
+    """Enforce volume/bucket space + namespace quotas on growth, then
+    update the usage counters (the reference's usedBytes/usedNamespace
+    accounting on OmBucketInfo/OmVolumeArgs; quota checked in the key
+    commit path). quota_bytes / quota_namespace of -1 mean unlimited."""
+    bk = bucket_key(volume, bucket)
+    vk = volume_key(volume)
+    brow = store.get("buckets", bk)
+    vrow = store.get("volumes", vk)
+    if bytes_delta > 0 or keys_delta > 0:
+        if brow is not None:
+            bq = int(brow.get("quota_bytes", -1))
+            used = int(brow.get("used_bytes", 0))
+            if bq >= 0 and used + bytes_delta > bq:
+                raise OMError(
+                    QUOTA_EXCEEDED,
+                    f"bucket {bk}: {used} + {bytes_delta} > quota {bq}",
+                )
+            nq = int(brow.get("quota_namespace", -1))
+            kc = int(brow.get("key_count", 0))
+            if nq >= 0 and kc + keys_delta > nq:
+                raise OMError(
+                    QUOTA_EXCEEDED,
+                    f"bucket {bk}: {kc + keys_delta} keys > quota {nq}",
+                )
+        if vrow is not None:
+            vq = int(vrow.get("quota_bytes", -1))
+            vused = int(vrow.get("used_bytes", 0))
+            if vq >= 0 and vused + bytes_delta > vq:
+                raise OMError(
+                    QUOTA_EXCEEDED,
+                    f"volume /{volume}: {vused} + {bytes_delta} > "
+                    f"quota {vq}",
+                )
+            vnq = int(vrow.get("quota_namespace", -1))
+            vkc = int(vrow.get("key_count", 0))
+            if vnq >= 0 and vkc + keys_delta > vnq:
+                raise OMError(
+                    QUOTA_EXCEEDED,
+                    f"volume /{volume}: {vkc + keys_delta} keys > "
+                    f"quota {vnq}",
+                )
+    if brow is not None:
+        brow["used_bytes"] = max(
+            0, int(brow.get("used_bytes", 0)) + bytes_delta)
+        brow["key_count"] = max(
+            0, int(brow.get("key_count", 0)) + keys_delta)
+        store.put("buckets", bk, brow)
+    if vrow is not None:
+        vrow["used_bytes"] = max(
+            0, int(vrow.get("used_bytes", 0)) + bytes_delta)
+        vrow["key_count"] = max(
+            0, int(vrow.get("key_count", 0)) + keys_delta)
+        store.put("volumes", vk, vrow)
+
+
 def direct_sessions_of(store, ek: str) -> list[str]:
     """Open-session storage keys belonging to entry `ek` itself — NOT to
     longer key names that extend it with a slash (OBS key names legally
@@ -190,7 +251,14 @@ def finalize_commit(store, table: str, ek: str, info: dict, old,
     superseded previous version to the purge chain — fencing its writer
     first if that version was a live hsync stream (its blocks are about to
     be purged, so its eventual commit must fail rather than resurrect
-    them)."""
+    them). Quota is enforced before any mutation: the space delta is the
+    new size minus whatever the previous version already charged."""
+    _, vol, bkt = ek.split("/", 3)[:3]
+    check_and_charge_quota(
+        store, vol, bkt,
+        int(info.get("size", 0)) - (int(old.get("size", 0)) if old else 0),
+        0 if old is not None else 1,
+    )
     if hsync:
         info["hsync_client_id"] = client_id
         store.put("open_keys", f"{ek}/{client_id}", info)  # session lives on
@@ -256,6 +324,70 @@ class CommitKey(OMRequest):
         finalize_commit(store, "keys", kk, info, old, self.client_id,
                         self.hsync, self.modified)
         return info
+
+
+@dataclass
+class SetQuota(OMRequest):
+    """Set space/namespace quota on a volume (bucket="") or bucket
+    (ozone sh volume/bucket setquota analog). None leaves a dimension
+    unchanged; -1 clears it to unlimited — setting one quota never
+    silently wipes the other."""
+
+    volume: str
+    bucket: str = ""
+    quota_bytes: Optional[int] = None
+    quota_namespace: Optional[int] = None
+
+    def apply(self, store):
+        if self.bucket:
+            k, table = bucket_key(self.volume, self.bucket), "buckets"
+            missing = BUCKET_NOT_FOUND
+        else:
+            k, table = volume_key(self.volume), "volumes"
+            missing = VOLUME_NOT_FOUND
+        row = store.get(table, k)
+        if row is None:
+            raise OMError(missing, k)
+        if self.quota_bytes is not None:
+            row["quota_bytes"] = int(self.quota_bytes)
+        if self.quota_namespace is not None:
+            row["quota_namespace"] = int(self.quota_namespace)
+        store.put(table, k, row)
+        return row
+
+
+@dataclass
+class RepairQuota(OMRequest):
+    """Recompute used_bytes/key_count from the key and file tables (the
+    OM quota repair service analog): fixes drift after crashes or
+    upgrades from pre-quota layouts."""
+
+    volume: str
+
+    def apply(self, store):
+        vk = volume_key(self.volume)
+        vrow = store.get("volumes", vk)
+        if vrow is None:
+            raise OMError(VOLUME_NOT_FOUND, self.volume)
+        vtotal = vkeys = 0
+        out = {}
+        for bk, brow in list(store.iterate("buckets", f"/{self.volume}/")):
+            used = keys = 0
+            for table in ("keys", "files"):
+                for _, info in store.iterate(table, f"{bk}/"):
+                    used += int(info.get("size", 0))
+                    keys += 1
+            brow["used_bytes"] = used
+            brow["key_count"] = keys
+            store.put("buckets", bk, brow)
+            vtotal += used
+            vkeys += keys
+            out[bk] = {"used_bytes": used, "key_count": keys}
+        vrow["used_bytes"] = vtotal
+        vrow["key_count"] = vkeys
+        store.put("volumes", vk, vrow)
+        return {"volume_used_bytes": vtotal, "volume_key_count": vkeys,
+                "buckets": out}
 
 
 @dataclass
@@ -373,6 +505,8 @@ class DeleteKey(OMRequest):
         if stale_writer:
             store.delete("open_keys", f"{kk}/{stale_writer}")
         store.put("deleted_keys", f"{kk}:{self.ts}", info)
+        check_and_charge_quota(store, self.volume, self.bucket,
+                               -int(info.get("size", 0)), -1)
         return info
 
 

@@ -75,6 +75,18 @@ def _emit(obj) -> None:
     print(json.dumps(obj, indent=2, default=str))
 
 
+def _quota_arg(v: str):
+    """'10MB'/'1073741824' -> bytes; '' -> None (leave unchanged);
+    'clear' -> -1 (unlimited)."""
+    from ozone_tpu.utils.config import parse_size
+
+    if not v:
+        return None
+    if v == "clear":
+        return -1
+    return int(parse_size(v))
+
+
 # ---------------------------------------------------------------------- sh
 def cmd_sh(args) -> int:
     oz = _client(args)
@@ -91,6 +103,10 @@ def cmd_sh(args) -> int:
             oz.om.delete_volume(vol)
         elif verb == "info":
             _emit(oz.om.volume_info(vol))
+        elif verb == "setquota":
+            _emit(oz.om.set_quota(
+                vol, quota_bytes=_quota_arg(args.quota),
+                quota_namespace=args.namespace_quota))
     elif kind == "bucket":
         if verb == "list":
             (vol,) = parts
@@ -104,6 +120,10 @@ def cmd_sh(args) -> int:
                 oz.om.delete_bucket(vol, bucket)
             elif verb == "info":
                 _emit(oz.om.bucket_info(vol, bucket))
+            elif verb == "setquota":
+                _emit(oz.om.set_quota(
+                    vol, bucket, quota_bytes=_quota_arg(args.quota),
+                    quota_namespace=args.namespace_quota))
     elif kind == "key":
         if verb == "list":
             vol, bucket = parts
@@ -494,6 +514,12 @@ def cmd_repair(args) -> int:
     from ozone_tpu.storage.ids import BlockID
 
     oz = _client(args)
+    if args.tool == "quota":
+        if not args.volume:
+            print("error: repair quota requires --volume", file=sys.stderr)
+            return 1
+        _emit(oz.om.repair_quota(args.volume))
+        return 0
     scm = GrpcScmClient(args.om)
     if args.tool != "orphans":
         print(f"unknown repair tool {args.tool}", file=sys.stderr)
@@ -554,12 +580,18 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("object", choices=["volume", "bucket", "key"])
     sh.add_argument("verb",
                     choices=["create", "delete", "info", "list", "put",
-                             "get", "rename", "checksum"])
+                             "get", "rename", "checksum", "setquota"])
     sh.add_argument("path", help="/volume[/bucket[/key]]")
     sh.add_argument("file", nargs="?", help="local file for key put/get")
     sh.add_argument("--om", default="127.0.0.1:9860")
     sh.add_argument("--replication", default="")
     sh.add_argument("--to", default="", help="rename target")
+    sh.add_argument("--quota", default="",
+                    help="setquota: space quota (e.g. 10MB; 'clear' "
+                         "for unlimited)")
+    sh.add_argument("--namespace-quota", type=int, default=None,
+                    help="setquota: max key count (-1 clears to "
+                         "unlimited; omitted leaves unchanged)")
     sh.add_argument("--layout", default="OBJECT_STORE",
                     choices=["OBJECT_STORE", "FILE_SYSTEM_OPTIMIZED"],
                     help="bucket layout (reference: ozone sh bucket create "
@@ -715,8 +747,10 @@ def build_parser() -> argparse.ArgumentParser:
     au.set_defaults(fn=_cmd_audit)
 
     rp = sub.add_parser("repair", help="repair tools (ozone repair analog)")
-    rp.add_argument("tool", choices=["orphans"])
+    rp.add_argument("tool", choices=["orphans", "quota"])
     rp.add_argument("--om", default="127.0.0.1:9860")
+    rp.add_argument("--volume", default="",
+                    help="quota: volume whose usage counters to rebuild")
     rp.add_argument("--delete", action="store_true",
                     help="reclaim orphaned blocks")
     rp.set_defaults(fn=cmd_repair)

@@ -217,3 +217,74 @@ def test_s3_upload_part_copy_rejects_bad_ranges(s3):
                  headers={"x-amz-copy-source": "/rgb/src",
                           "x-amz-copy-source-range": rng})
         assert ei.value.code == code, rng
+
+
+def test_s3_list_delimiter_and_pagination(s3):
+    _req(s3, "PUT", "/lb")
+    for k in ["a/1", "a/2", "b/1", "top1", "top2"]:
+        _req(s3, "PUT", f"/lb/{k}", data=b"x")
+    # delimiter groups folders into CommonPrefixes
+    r = _req(s3, "GET", "/lb?list-type=2&delimiter=/")
+    tree = ET.fromstring(r.read())
+    cps = [e.text for e in tree.iter() if e.tag.endswith("}Prefix")
+           and e.text and e.text.endswith("/")]
+    keys = [e.text for e in tree.iter() if e.tag.endswith("}Key")]
+    assert sorted(cps) == ["a/", "b/"]
+    assert sorted(keys) == ["top1", "top2"]
+    # prefix + delimiter: inside a folder
+    r = _req(s3, "GET", "/lb?list-type=2&prefix=a/&delimiter=/")
+    tree = ET.fromstring(r.read())
+    keys = [e.text for e in tree.iter() if e.tag.endswith("}Key")]
+    assert sorted(keys) == ["a/1", "a/2"]
+    # pagination: 2 per page across 5 entities (a/, b/, top1, top2 with
+    # delimiter -> 4 entities; without delimiter 5 keys)
+    seen = []
+    token = ""
+    for _ in range(5):
+        qs = "/lb?list-type=2&max-keys=2" + (
+            f"&continuation-token={token}" if token else "")
+        tree = ET.fromstring(_req(s3, "GET", qs).read())
+        seen += [e.text for e in tree.iter() if e.tag.endswith("}Key")]
+        if (next((e.text for e in tree.iter()
+                  if e.tag.endswith("IsTruncated")), "false") != "true"):
+            break
+        token = next(e.text for e in tree.iter()
+                     if e.tag.endswith("NextContinuationToken"))
+    assert seen == ["a/1", "a/2", "b/1", "top1", "top2"]
+
+
+def test_s3_multi_delete(s3):
+    _req(s3, "PUT", "/mdb")
+    for k in ["d1", "d2", "keep"]:
+        _req(s3, "PUT", f"/mdb/{k}", data=b"x")
+    body = (b'<Delete xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            b"<Object><Key>d1</Key></Object>"
+            b"<Object><Key>d2</Key></Object>"
+            b"<Object><Key>ghost</Key></Object></Delete>")
+    r = _req(s3, "POST", "/mdb?delete", data=body)
+    out = r.read()
+    assert out.count(b"<Deleted>") == 3  # missing key counts as deleted
+    tree = ET.fromstring(_req(s3, "GET", "/mdb?list-type=2").read())
+    keys = [e.text for e in tree.iter() if e.tag.endswith("}Key")]
+    assert keys == ["keep"]
+
+
+def test_s3_list_edge_cases_and_quota_mapping(s3):
+    _req(s3, "PUT", "/eb")
+    _req(s3, "PUT", "/eb/k1", data=b"x")
+    # MaxKeys=0: empty, NOT truncated (no dangling pagination)
+    tree = ET.fromstring(_req(s3, "GET", "/eb?list-type=2&max-keys=0").read())
+    assert next(e.text for e in tree.iter()
+                if e.tag.endswith("IsTruncated")) == "false"
+    assert not [e for e in tree.iter() if e.tag.endswith("}Key")]
+    # bad max-keys -> 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "GET", "/eb?list-type=2&max-keys=abc")
+    assert ei.value.code == 400
+    # quota exceeded surfaces as 403 QuotaExceeded, not 500
+    s3.client.om.set_quota(s3._vol, "eb", quota_bytes=2)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "PUT", "/eb/too-big", data=b"xxxx")
+    assert ei.value.code == 403
+    assert b"QuotaExceeded" in ei.value.read()
+    s3.client.om.set_quota(s3._vol, "eb", quota_bytes=-1)

@@ -1,0 +1,186 @@
+"""Volume/bucket quotas: space + namespace enforcement on the commit
+path, usage accounting across commit/overwrite/delete/hsync/multipart,
+and the quota repair recompute (reference: OmBucketInfo usedBytes /
+usedNamespace, OMKeyCommitRequest quota check, quota repair service).
+"""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.om.requests import OMError
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniOzoneCluster(
+        tmp_path,
+        num_datanodes=5,
+        block_size=4 * 4096,
+        container_size=1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def test_bucket_space_quota_enforced(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    oz.om.set_quota("v", "b", quota_bytes=10_000)
+    b.write_key("ok", _data(8_000))
+    with pytest.raises(OMError) as ei:
+        b.write_key("too-big", _data(5_000, 1))
+    assert ei.value.code == "QUOTA_EXCEEDED"
+    # usage unchanged by the rejected write
+    assert oz.om.bucket_info("v", "b")["used_bytes"] == 8_000
+    # freeing space lets writes through again
+    b.delete_key("ok")
+    b.write_key("fits", _data(5_000, 1))
+    assert oz.om.bucket_info("v", "b")["used_bytes"] == 5_000
+
+
+def test_namespace_quota_enforced(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    oz.om.set_quota("v", "b", quota_namespace=2)
+    b.write_key("k1", _data(100))
+    b.write_key("k2", _data(100, 1))
+    with pytest.raises(OMError) as ei:
+        b.write_key("k3", _data(100, 2))
+    assert ei.value.code == "QUOTA_EXCEEDED"
+    # overwrite is not a new name: allowed
+    b.write_key("k2", _data(200, 3))
+    assert oz.om.bucket_info("v", "b")["key_count"] == 2
+
+
+def test_volume_quota_spans_buckets(cluster):
+    oz = cluster.client()
+    vol = oz.create_volume("v")
+    b1 = vol.create_bucket("b1", replication=EC)
+    b2 = vol.create_bucket("b2", replication=EC)
+    oz.om.set_quota("v", quota_bytes=10_000)
+    b1.write_key("k", _data(6_000))
+    with pytest.raises(OMError):
+        b2.write_key("k", _data(6_000, 1))
+    b2.write_key("k", _data(3_000, 1))
+    assert oz.om.volume_info("v")["used_bytes"] == 9_000
+
+
+def test_usage_accounting_overwrite_and_multipart(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    b.write_key("k", _data(5_000))
+    b.write_key("k", _data(2_000, 1))  # overwrite shrinks usage
+    assert oz.om.bucket_info("v", "b")["used_bytes"] == 2_000
+    mpu = b.initiate_multipart_upload("big")
+    mpu.write_part(1, _data(6_000, 2))
+    mpu.write_part(2, _data(6_000, 3))
+    mpu.complete()
+    info = oz.om.bucket_info("v", "b")
+    assert info["used_bytes"] == 2_000 + 12_000
+    assert info["key_count"] == 2
+
+
+def test_hsync_stream_charges_incrementally(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication="RATIS/THREE")
+    oz.om.set_quota("v", "b", quota_bytes=30_000)
+    h = b.open_key("k")
+    h.write(_data(10_000))
+    h.hsync()
+    assert oz.om.bucket_info("v", "b")["used_bytes"] == 10_000
+    h.write(_data(10_000, 1))
+    h.hsync()
+    assert oz.om.bucket_info("v", "b")["used_bytes"] == 20_000
+    h.close()
+    info = oz.om.bucket_info("v", "b")
+    assert info["used_bytes"] == 20_000 and info["key_count"] == 1
+
+
+def test_quota_repair_recomputes_from_tables(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    b.write_key("k1", _data(4_000))
+    b.write_key("k2", _data(6_000, 1))
+    # corrupt the counters to simulate drift
+    oz.om.set_quota("v", "b")  # no-op write keeps row shape
+    store = cluster.om.store
+    row = store.get("buckets", "/v/b")
+    row["used_bytes"] = 999_999
+    store.put("buckets", "/v/b", row)
+    out = oz.om.repair_quota("v")
+    assert out["buckets"]["/v/b"] == {"used_bytes": 10_000, "key_count": 2}
+    assert oz.om.bucket_info("v", "b")["used_bytes"] == 10_000
+    assert oz.om.volume_info("v")["used_bytes"] == 10_000
+
+
+def test_fso_files_count_against_quota(cluster):
+    oz = cluster.client()
+    oz.create_volume("v")
+    oz.om.create_bucket("v", "fso", "RATIS/THREE",
+                        "FILE_SYSTEM_OPTIMIZED")
+    b = oz.get_volume("v").get_bucket("fso")
+    oz.om.set_quota("v", "fso", quota_bytes=5_000)
+    b.write_key("d/f1", _data(3_000))
+    with pytest.raises(OMError):
+        b.write_key("d/f2", _data(3_000, 1))
+    assert oz.om.bucket_info("v", "fso")["used_bytes"] == 3_000
+    # recursive dir delete releases the space
+    oz.om.delete_directory("v", "fso", "d", recursive=True)
+    from ozone_tpu.om import fso
+
+    fso.DirectoryDeletingService(cluster.om).run_to_completion()
+    info = oz.om.bucket_info("v", "fso")
+    assert info["used_bytes"] == 0 and info["key_count"] == 0
+
+
+def test_setquota_preserves_other_dimension(cluster):
+    oz = cluster.client()
+    oz.create_volume("v").create_bucket("b", replication=EC)
+    oz.om.set_quota("v", "b", quota_namespace=7)
+    oz.om.set_quota("v", "b", quota_bytes=1_000)  # must not wipe ns quota
+    info = oz.om.bucket_info("v", "b")
+    assert info["quota_namespace"] == 7 and info["quota_bytes"] == 1_000
+    oz.om.set_quota("v", "b", quota_namespace=-1)  # explicit clear
+    info = oz.om.bucket_info("v", "b")
+    assert info["quota_namespace"] == -1 and info["quota_bytes"] == 1_000
+
+
+def test_volume_namespace_quota_enforced(cluster):
+    oz = cluster.client()
+    vol = oz.create_volume("v")
+    b1 = vol.create_bucket("b1", replication=EC)
+    b2 = vol.create_bucket("b2", replication=EC)
+    oz.om.set_quota("v", quota_namespace=2)
+    b1.write_key("k1", _data(100))
+    b2.write_key("k2", _data(100, 1))
+    with pytest.raises(OMError) as ei:
+        b1.write_key("k3", _data(100, 2))
+    assert ei.value.code == "QUOTA_EXCEEDED"
+    assert oz.om.volume_info("v")["key_count"] == 2
+
+
+def test_mpu_complete_quota_failure_leaves_upload_retryable(cluster):
+    """A QUOTA_EXCEEDED complete must not purge any part blocks: after
+    freeing space the same complete succeeds with intact data."""
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    oz.om.set_quota("v", "b", quota_bytes=5_000)
+    mpu = b.initiate_multipart_upload("big")
+    data = _data(8_000, 9)
+    mpu.write_part(1, data[:4_000])
+    mpu.write_part(2, data[4_000:])
+    with pytest.raises(OMError) as ei:
+        mpu.complete()
+    assert ei.value.code == "QUOTA_EXCEEDED"
+    oz.om.set_quota("v", "b", quota_bytes=-1)
+    mpu.complete()
+    assert np.array_equal(b.read_key("big"), data)
