@@ -173,7 +173,19 @@ class OzoneBucket:
 
     def read_key(self, key: str) -> np.ndarray:
         om = self.client.om
-        info = om.lookup_key(self.volume, self.name, key)
+        if key.startswith(".snapshot/"):
+            # snapshot-scoped read via the path convention the reference
+            # FS exposes: .snapshot/<name>/<key>
+            parts = key.split("/", 2)
+            if len(parts) != 3 or not parts[2]:
+                from ozone_tpu.om.requests import OMError
+
+                raise OMError("KEY_NOT_FOUND",
+                              f"no key component in {key}")
+            info = om.snapshot_lookup_key(self.volume, self.name,
+                                          parts[1], parts[2])
+        else:
+            info = om.lookup_key(self.volume, self.name, key)
         groups = om.key_block_groups(info)
         parts: list[np.ndarray] = []
         for g in groups:

@@ -76,6 +76,35 @@ class OmGrpcService:
                 "RepairQuota": self._wrap(
                     lambda m: self.om.repair_quota(m["volume"])
                 ),
+                "CreateSnapshot": self._wrap(
+                    lambda m: self.om.create_snapshot(
+                        m["volume"], m["bucket"], m["name"])
+                ),
+                "ListSnapshots": self._wrap(
+                    lambda m: self.om.list_snapshots(
+                        m["volume"], m["bucket"])
+                ),
+                "SnapshotInfo": self._wrap(
+                    lambda m: self.om.snapshot_info(
+                        m["volume"], m["bucket"], m["name"])
+                ),
+                "DeleteSnapshot": self._wrap(
+                    lambda m: self.om.delete_snapshot(
+                        m["volume"], m["bucket"], m["name"])
+                ),
+                "SnapshotDiff": self._wrap(
+                    lambda m: self.om.snapshot_diff(
+                        m["volume"], m["bucket"], m["from_snapshot"],
+                        m.get("to_snapshot"))
+                ),
+                "SnapshotKeys": self._wrap(
+                    lambda m: self.om.snapshot_keys(
+                        m["volume"], m["bucket"], m["name"])
+                ),
+                "SnapshotLookupKey": self._wrap(
+                    lambda m: self.om.snapshot_lookup_key(
+                        m["volume"], m["bucket"], m["name"], m["key"])
+                ),
                 "LookupKey": self._wrap(
                     lambda m: self.om.lookup_key(m["volume"], m["bucket"], m["key"])
                 ),
@@ -488,6 +517,36 @@ class GrpcOmClient:
 
     def repair_quota(self, volume):
         return self._call("RepairQuota", volume=volume)["result"]
+
+    def create_snapshot(self, volume, bucket, name):
+        return self._call("CreateSnapshot", volume=volume, bucket=bucket,
+                          name=name)["result"]
+
+    def list_snapshots(self, volume, bucket):
+        return self._call("ListSnapshots", volume=volume,
+                          bucket=bucket)["result"]
+
+    def snapshot_info(self, volume, bucket, name):
+        return self._call("SnapshotInfo", volume=volume, bucket=bucket,
+                          name=name)["result"]
+
+    def delete_snapshot(self, volume, bucket, name):
+        self._call("DeleteSnapshot", volume=volume, bucket=bucket,
+                   name=name)
+
+    def snapshot_diff(self, volume, bucket, from_snapshot,
+                      to_snapshot=None):
+        return self._call("SnapshotDiff", volume=volume, bucket=bucket,
+                          from_snapshot=from_snapshot,
+                          to_snapshot=to_snapshot)["result"]
+
+    def snapshot_keys(self, volume, bucket, name):
+        return self._call("SnapshotKeys", volume=volume, bucket=bucket,
+                          name=name)["result"]
+
+    def snapshot_lookup_key(self, volume, bucket, name, key):
+        return self._call("SnapshotLookupKey", volume=volume,
+                          bucket=bucket, name=name, key=key)["result"]
 
     def lookup_key(self, volume, bucket, key):
         return self._call("LookupKey", volume=volume, bucket=bucket, key=key)[

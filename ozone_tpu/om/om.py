@@ -408,6 +408,39 @@ class OzoneManager:
         """Recompute usage counters from the key/file tables."""
         return self.submit(rq.RepairQuota(volume))
 
+    # ------------------------------------------------------------ snapshots
+    def _snapshots(self):
+        from ozone_tpu.om.snapshots import SnapshotManager
+
+        return SnapshotManager(self)
+
+    def create_snapshot(self, volume: str, bucket: str, name: str) -> dict:
+        return self._snapshots().create_snapshot(volume, bucket,
+                                                 name).to_json()
+
+    def list_snapshots(self, volume: str, bucket: str) -> list[dict]:
+        return [s.to_json()
+                for s in self._snapshots().list_snapshots(volume, bucket)]
+
+    def snapshot_info(self, volume: str, bucket: str, name: str) -> dict:
+        return self._snapshots().get_snapshot(volume, bucket,
+                                              name).to_json()
+
+    def delete_snapshot(self, volume: str, bucket: str, name: str) -> None:
+        self._snapshots().delete_snapshot(volume, bucket, name)
+
+    def snapshot_diff(self, volume: str, bucket: str, from_snapshot: str,
+                      to_snapshot=None) -> dict:
+        return self._snapshots().snapshot_diff(volume, bucket,
+                                               from_snapshot, to_snapshot)
+
+    def snapshot_keys(self, volume: str, bucket: str, name: str) -> list[dict]:
+        return self._snapshots().list_keys(volume, bucket, name)
+
+    def snapshot_lookup_key(self, volume: str, bucket: str, name: str,
+                            key: str) -> dict:
+        return self._snapshots().lookup_key(volume, bucket, name, key)
+
     def lookup_key(self, volume: str, bucket: str, key: str) -> dict:
         from ozone_tpu.om import fso
 

@@ -327,6 +327,83 @@ class CommitKey(OMRequest):
 
 
 @dataclass
+class CreateSnapshot(OMRequest):
+    """Materialize a bucket snapshot (OMSnapshotCreateRequest analog):
+    the bucket's live key rows are copied under the snapshot prefix and
+    chained to the previous snapshot. Runs through the replicated log so
+    HA replicas hold identical snapshot state."""
+
+    volume: str
+    bucket: str
+    name: str
+    snap_id: str = ""
+    created: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        import uuid
+
+        self.snap_id = uuid.uuid4().hex[:12]
+        self.created = time.time()
+
+    def apply(self, store):
+        if not store.exists("buckets", bucket_key(self.volume, self.bucket)):
+            raise OMError(BUCKET_NOT_FOUND, f"{self.volume}/{self.bucket}")
+        meta_key = f"/.snapmeta/{self.volume}/{self.bucket}/{self.name}"
+        if store.exists("open_keys", meta_key):
+            raise OMError("SNAPSHOT_EXISTS", self.name)
+        # chain head: the newest existing snapshot of this bucket
+        prev, prev_created = None, -1.0
+        for _, v in store.iterate(
+            "open_keys", f"/.snapmeta/{self.volume}/{self.bucket}/"
+        ):
+            if v["created"] > prev_created:
+                prev, prev_created = v["snap_id"], v["created"]
+        base = bucket_key(self.volume, self.bucket) + "/"
+        prefix = f"/.snapshot/{self.volume}/{self.bucket}/{self.snap_id}"
+        for k, v in list(store.iterate("keys", base)):
+            if k.startswith("/.snap"):
+                continue
+            store.put("keys", f"{prefix}/{k[len(base):]}", v)
+        # FSO buckets keep file rows in the "files" table keyed by parent
+        # id; each row carries its full path in "name", so snapshot rows
+        # are materialized path-keyed and all snapshot reads/diffs work
+        # identically across layouts
+        for _, v in list(store.iterate("files", base)):
+            store.put("keys", f"{prefix}/{v['name']}", v)
+        info = {
+            "volume": self.volume,
+            "bucket": self.bucket,
+            "name": self.name,
+            "snap_id": self.snap_id,
+            "created": self.created,
+            "previous": prev,
+        }
+        store.put("open_keys", meta_key, info)
+        return info
+
+
+@dataclass
+class DeleteSnapshot(OMRequest):
+    """Drop a snapshot's materialized rows and chain entry."""
+
+    volume: str
+    bucket: str
+    name: str
+
+    def apply(self, store):
+        meta_key = f"/.snapmeta/{self.volume}/{self.bucket}/{self.name}"
+        info = store.get("open_keys", meta_key)
+        if info is None:
+            raise OMError("SNAPSHOT_NOT_FOUND", self.name)
+        prefix = (f"/.snapshot/{self.volume}/{self.bucket}/"
+                  f"{info['snap_id']}")
+        for k, _ in list(store.iterate("keys", prefix)):
+            store.delete("keys", k)
+        store.delete("open_keys", meta_key)
+        return info
+
+
+@dataclass
 class SetQuota(OMRequest):
     """Set space/namespace quota on a volume (bucket="") or bucket
     (ozone sh volume/bucket setquota analog). None leaves a dimension

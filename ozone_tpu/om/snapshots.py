@@ -13,12 +13,9 @@ key: added / deleted / modified / renamed-as-delete+add.
 
 from __future__ import annotations
 
-import time
-import uuid
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
-from ozone_tpu.om.metadata import bucket_key, key_key
 from ozone_tpu.om.om import OzoneManager
 from ozone_tpu.om.requests import OMError
 
@@ -48,32 +45,12 @@ class SnapshotManager:
 
     # ------------------------------------------------------------- create
     def create_snapshot(self, volume: str, bucket: str, name: str) -> SnapshotInfo:
-        self.om.bucket_info(volume, bucket)
-        existing = self._chain_head(volume, bucket)
-        snap_id = uuid.uuid4().hex[:12]
-        info = SnapshotInfo(volume, bucket, name, snap_id, time.time(),
-                            previous=existing.snap_id if existing else None)
-        meta_key = f"/.snapmeta/{volume}/{bucket}/{name}"
-        if self.om.store.exists("open_keys", meta_key):
-            raise OMError("SNAPSHOT_EXISTS", name)
-        # materialize the bucket's live keys under the snapshot prefix
-        # (checkpoint analog)
-        base = bucket_key(volume, bucket) + "/"
-        prefix = _snap_prefix(volume, bucket, snap_id)
-        count = 0
-        for k, v in self.om.store.iterate("keys", base):
-            if k.startswith("/.snap"):
-                continue
-            rel = k[len(base):]
-            self.om.store.put("keys", f"{prefix}/{rel}", v)
-            count += 1
-        self.om.store.put("open_keys", meta_key, info.to_json())
-        self.om.store.flush()
-        return info
+        """Materialize via the replicated request log (CreateSnapshot
+        request), so HA replicas hold identical snapshot state."""
+        from ozone_tpu.om import requests as rq
 
-    def _chain_head(self, volume: str, bucket: str) -> Optional[SnapshotInfo]:
-        snaps = self.list_snapshots(volume, bucket)
-        return snaps[-1] if snaps else None
+        out = self.om.submit(rq.CreateSnapshot(volume, bucket, name))
+        return SnapshotInfo(**out)
 
     def list_snapshots(self, volume: str, bucket: str) -> list[SnapshotInfo]:
         out = []
@@ -91,12 +68,9 @@ class SnapshotManager:
         return SnapshotInfo(**v)
 
     def delete_snapshot(self, volume: str, bucket: str, name: str) -> None:
-        info = self.get_snapshot(volume, bucket, name)
-        prefix = _snap_prefix(volume, bucket, info.snap_id)
-        for k, _ in list(self.om.store.iterate("keys", prefix)):
-            self.om.store.delete("keys", k)
-        self.om.store.delete("open_keys",
-                             f"/.snapmeta/{volume}/{bucket}/{name}")
+        from ozone_tpu.om import requests as rq
+
+        self.om.submit(rq.DeleteSnapshot(volume, bucket, name))
 
     # ------------------------------------------------------------- reads
     def list_keys(self, volume: str, bucket: str, name: str) -> list[dict]:

@@ -87,11 +87,24 @@ def _quota_arg(v: str):
     return int(parse_size(v))
 
 
+#: verbs valid per sh object; anything else errors instead of no-opping
+_SH_VERBS = {
+    "volume": {"create", "delete", "info", "list", "setquota"},
+    "bucket": {"create", "delete", "info", "list", "setquota"},
+    "key": {"put", "get", "delete", "info", "list", "rename", "checksum"},
+    "snapshot": {"create", "list", "info", "delete", "diff"},
+}
+
+
 # ---------------------------------------------------------------------- sh
 def cmd_sh(args) -> int:
+    kind, verb = args.object, args.verb
+    if verb not in _SH_VERBS[kind]:
+        print(f"error: '{verb}' is not a {kind} verb (expected one of "
+              f"{sorted(_SH_VERBS[kind])})", file=sys.stderr)
+        return 2
     oz = _client(args)
     parts = _parse_path(args.path)
-    kind, verb = args.object, args.verb
     if kind == "volume":
         if verb == "list":  # accepts "/" (no volume component)
             _emit(oz.list_volumes())
@@ -124,6 +137,31 @@ def cmd_sh(args) -> int:
                 _emit(oz.om.set_quota(
                     vol, bucket, quota_bytes=_quota_arg(args.quota),
                     quota_namespace=args.namespace_quota))
+    elif kind == "snapshot":
+        if verb == "list":
+            vol, bucket = parts
+            _emit(oz.om.list_snapshots(vol, bucket))
+        elif verb == "diff":
+            vol, bucket = parts
+            if not args.name:
+                print("error: snapshot diff requires --name",
+                      file=sys.stderr)
+                return 1
+            _emit(oz.om.snapshot_diff(vol, bucket, args.name,
+                                      args.to or None))
+        else:
+            vol, bucket = parts
+            if not args.name:
+                print(f"error: snapshot {verb} requires --name",
+                      file=sys.stderr)
+                return 1
+            if verb == "create":
+                _emit(oz.om.create_snapshot(vol, bucket, args.name))
+            elif verb == "info":
+                _emit(oz.om.snapshot_info(vol, bucket, args.name))
+            elif verb == "delete":
+                oz.om.delete_snapshot(vol, bucket, args.name)
+                print(f"deleted snapshot {args.name}")
     elif kind == "key":
         if verb == "list":
             vol, bucket = parts
@@ -577,15 +615,20 @@ def build_parser() -> argparse.ArgumentParser:
     sub = ap.add_subparsers(dest="command", required=True)
 
     sh = sub.add_parser("sh", help="object store shell (ozone sh analog)")
-    sh.add_argument("object", choices=["volume", "bucket", "key"])
+    sh.add_argument("object",
+                    choices=["volume", "bucket", "key", "snapshot"])
     sh.add_argument("verb",
                     choices=["create", "delete", "info", "list", "put",
-                             "get", "rename", "checksum", "setquota"])
+                             "get", "rename", "checksum", "setquota",
+                             "diff"])
     sh.add_argument("path", help="/volume[/bucket[/key]]")
     sh.add_argument("file", nargs="?", help="local file for key put/get")
     sh.add_argument("--om", default="127.0.0.1:9860")
     sh.add_argument("--replication", default="")
     sh.add_argument("--to", default="", help="rename target")
+    sh.add_argument("--name", default="",
+                    help="snapshot verbs: snapshot name (diff: the "
+                         "from-snapshot)")
     sh.add_argument("--quota", default="",
                     help="setquota: space quota (e.g. 10MB; 'clear' "
                          "for unlimited)")

@@ -179,17 +179,19 @@ def test_timer_driven_election_after_leader_death(tmp_path):
     for n in nodes:
         n.start_timers()
     try:
-        deadline = time.monotonic() + 5.0
+        deadline = time.monotonic() + 10.0
         leader = None
         while leader is None and time.monotonic() < deadline:
             leader = next((n for n in nodes if n.is_leader), None)
             time.sleep(0.02)
         assert leader is not None, "no leader elected"
-        leader.propose("a", timeout=5.0)
+        leader.propose("a", timeout=10.0)
 
         transport.down.add(leader.node_id)
         survivors = [n for n in nodes if n is not leader]
-        deadline = time.monotonic() + 8.0
+        # generous: timer-driven elections can need several rounds when
+        # the host is under full-suite load
+        deadline = time.monotonic() + 15.0
         new_leader = None
         while time.monotonic() < deadline:
             new_leader = next((n for n in survivors if n.is_leader), None)
@@ -197,7 +199,7 @@ def test_timer_driven_election_after_leader_death(tmp_path):
                 break
             time.sleep(0.02)
         assert new_leader is not None, "no failover election"
-        new_leader.propose("b", timeout=5.0)
+        new_leader.propose("b", timeout=10.0)
         idx = nodes.index(new_leader)
         assert states[idx] == ["a", "b"]
     finally:

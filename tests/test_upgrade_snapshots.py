@@ -114,3 +114,125 @@ def test_snapshot_create_read_diff(cluster):
         sm.get_snapshot("v", "b", "snap1")
     # live namespace unaffected
     assert {k["name"] for k in b.list_keys()} == {"k2", "k3"}
+
+
+def test_snapshot_surface_over_grpc_and_dot_snapshot_reads(tmp_path):
+    """Snapshot verbs ride the remote OM protocol and snapshot-scoped
+    reads work through the .snapshot/<name>/<key> path convention."""
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=4 * 4096,
+                       stale_after_s=1000.0, dead_after_s=2000.0,
+                       background_interval_s=0.5)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.2) for i in range(5)]
+    for d in dns:
+        d.start()
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        b = oz.create_volume("v").create_bucket("b",
+                                                replication="rs-3-2-4096")
+        v1 = np.random.default_rng(0).integers(0, 256, 9_000,
+                                               dtype=np.uint8)
+        b.write_key("k", v1)
+        snap = oz.om.create_snapshot("v", "b", "s1")
+        assert snap["name"] == "s1"
+        # mutate live state after the snapshot
+        v2 = np.random.default_rng(1).integers(0, 256, 4_000,
+                                               dtype=np.uint8)
+        b.write_key("k", v2)
+        b.write_key("new", v2)
+        assert np.array_equal(b.read_key("k"), v2)
+        # snapshot-scoped read returns the pre-mutation bytes
+        assert np.array_equal(b.read_key(".snapshot/s1/k"), v1)
+        names = [s["name"] for s in oz.om.list_snapshots("v", "b")]
+        assert names == ["s1"]
+        diff = oz.om.snapshot_diff("v", "b", "s1")
+        assert "new" in diff["added"] and "k" in diff["modified"]
+        keys = {k["name"] for k in oz.om.snapshot_keys("v", "b", "s1")}
+        assert keys == {"k"}
+        oz.om.delete_snapshot("v", "b", "s1")
+        assert oz.om.list_snapshots("v", "b") == []
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
+
+
+def test_snapshots_replicate_across_ha_ring(tmp_path):
+    """CreateSnapshot rides the replicated request log: every replica
+    holds the snapshot rows, so a failover preserves snapshots."""
+    import time
+
+    from ozone_tpu.testing.minicluster import (
+        await_meta_leader,
+        free_ports,
+        make_meta_daemon,
+    )
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    ports = free_ports(3)
+    peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(3)}
+    metas = {}
+    try:
+        for i in range(3):
+            d = make_meta_daemon(tmp_path, i, peers)
+            d.start()
+            metas[f"m{i}"] = d
+        await_meta_leader(metas)
+        om = GrpcOmClient(",".join(peers.values()))
+        om.create_volume("v")
+        om.create_bucket("v", "b", "rs-3-2-4096")
+        om.create_snapshot("v", "b", "snapA")
+        # every replica converges to identical snapshot metadata
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            ok = all(
+                [s["name"] for s in d.om.list_snapshots("v", "b")]
+                == ["snapA"]
+                for d in metas.values()
+            )
+            if ok:
+                break
+            time.sleep(0.1)
+        for mid, d in metas.items():
+            assert [s["name"] for s in d.om.list_snapshots("v", "b")] \
+                == ["snapA"], mid
+        om.close()
+    finally:
+        for d in metas.values():
+            d.stop()
+
+
+def test_fso_bucket_snapshot_covers_files(cluster):
+    """FSO file rows are materialized path-keyed in the snapshot, so
+    snapshot reads/diffs behave identically across bucket layouts."""
+    oz = cluster.client()
+    oz.create_volume("v")
+    oz.om.create_bucket("v", "fso", "rs-3-2-4096",
+                        "FILE_SYSTEM_OPTIMIZED")
+    b = oz.get_volume("v").get_bucket("fso")
+    v1 = np.random.default_rng(3).integers(0, 256, 6_000, dtype=np.uint8)
+    b.write_key("dir/a", v1)
+    oz.om.create_snapshot("v", "fso", "s1")
+    b.delete_key("dir/a")
+    names = {k["name"] for k in oz.om.snapshot_keys("v", "fso", "s1")}
+    assert names == {"dir/a"}
+    assert np.array_equal(b.read_key(".snapshot/s1/dir/a"), v1)
+    diff = oz.om.snapshot_diff("v", "fso", "s1")
+    assert diff["deleted"] == ["dir/a"]
+
+
+def test_snapshot_path_without_key_component_errors_cleanly(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    b.write_key("k", np.zeros(10, np.uint8))
+    oz.om.create_snapshot("v", "b", "s1")
+    with pytest.raises(OMError):
+        b.read_key(".snapshot/s1")
