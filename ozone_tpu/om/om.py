@@ -688,7 +688,8 @@ class OzoneManager:
             # deletion via SnapshotDeletingService/SstFilteringService)
             vol, bkt = info.get("volume"), info.get("bucket")
             if vol and bkt and next(
-                self.store.iterate("open_keys", f"/.snapmeta/{vol}/{bkt}/"),
+                self.store.iterate("open_keys",
+                                   rq.snapmeta_key(vol, bkt, "")),
                 None,
             ):
                 continue

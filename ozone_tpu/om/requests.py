@@ -326,6 +326,18 @@ class CommitKey(OMRequest):
         return info
 
 
+def snap_prefix(volume: str, bucket: str, snap_id: str) -> str:
+    """Key-table prefix holding a snapshot's materialized rows — the ONE
+    definition of the layout, shared by the write side (requests) and
+    read side (snapshots.py) so they cannot drift."""
+    return f"/.snapshot/{volume}/{bucket}/{snap_id}"
+
+
+def snapmeta_key(volume: str, bucket: str, name: str) -> str:
+    """open_keys row carrying a snapshot's chain metadata."""
+    return f"/.snapmeta/{volume}/{bucket}/{name}"
+
+
 @dataclass
 class CreateSnapshot(OMRequest):
     """Materialize a bucket snapshot (OMSnapshotCreateRequest analog):
@@ -346,9 +358,14 @@ class CreateSnapshot(OMRequest):
         self.created = time.time()
 
     def apply(self, store):
+        if not self.name or "/" in self.name:
+            # names ride the .snapshot/<name>/<key> path convention and
+            # the snapmeta key space: a slash or empty name would make
+            # the snapshot unaddressable
+            raise OMError("INVALID_SNAPSHOT_NAME", repr(self.name))
         if not store.exists("buckets", bucket_key(self.volume, self.bucket)):
             raise OMError(BUCKET_NOT_FOUND, f"{self.volume}/{self.bucket}")
-        meta_key = f"/.snapmeta/{self.volume}/{self.bucket}/{self.name}"
+        meta_key = snapmeta_key(self.volume, self.bucket, self.name)
         if store.exists("open_keys", meta_key):
             raise OMError("SNAPSHOT_EXISTS", self.name)
         # chain head: the newest existing snapshot of this bucket
@@ -359,7 +376,7 @@ class CreateSnapshot(OMRequest):
             if v["created"] > prev_created:
                 prev, prev_created = v["snap_id"], v["created"]
         base = bucket_key(self.volume, self.bucket) + "/"
-        prefix = f"/.snapshot/{self.volume}/{self.bucket}/{self.snap_id}"
+        prefix = snap_prefix(self.volume, self.bucket, self.snap_id)
         for k, v in list(store.iterate("keys", base)):
             if k.startswith("/.snap"):
                 continue
@@ -391,12 +408,11 @@ class DeleteSnapshot(OMRequest):
     name: str
 
     def apply(self, store):
-        meta_key = f"/.snapmeta/{self.volume}/{self.bucket}/{self.name}"
+        meta_key = snapmeta_key(self.volume, self.bucket, self.name)
         info = store.get("open_keys", meta_key)
         if info is None:
             raise OMError("SNAPSHOT_NOT_FOUND", self.name)
-        prefix = (f"/.snapshot/{self.volume}/{self.bucket}/"
-                  f"{info['snap_id']}")
+        prefix = snap_prefix(self.volume, self.bucket, info["snap_id"])
         for k, _ in list(store.iterate("keys", prefix)):
             store.delete("keys", k)
         store.delete("open_keys", meta_key)

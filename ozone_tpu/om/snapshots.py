@@ -17,13 +17,9 @@ from dataclasses import dataclass
 from typing import Optional
 
 from ozone_tpu.om.om import OzoneManager
-from ozone_tpu.om.requests import OMError
+from ozone_tpu.om.requests import OMError, snap_prefix as _snap_prefix
 
 SNAP_TABLE = "keys"  # snapshot rows live in the keys table under a prefix
-
-
-def _snap_prefix(volume: str, bucket: str, snap_id: str) -> str:
-    return f"/.snapshot/{volume}/{bucket}/{snap_id}"
 
 
 @dataclass
@@ -61,8 +57,10 @@ class SnapshotManager:
         return sorted(out, key=lambda s: s.created)
 
     def get_snapshot(self, volume: str, bucket: str, name: str) -> SnapshotInfo:
+        from ozone_tpu.om.requests import snapmeta_key
+
         v = self.om.store.get("open_keys",
-                              f"/.snapmeta/{volume}/{bucket}/{name}")
+                              snapmeta_key(volume, bucket, name))
         if v is None:
             raise OMError("SNAPSHOT_NOT_FOUND", name)
         return SnapshotInfo(**v)

@@ -371,13 +371,23 @@ def cmd_freon(args) -> int:
     elif args.generator == "dbgen":
         _emit(freon.dbgen(args.root or "/tmp/ozone-dbgen.db",
                           n_keys=args.num).summary())
-    elif args.generator in ("dcg", "dcv", "dsg"):
+    elif args.generator == "ralg":
+        import tempfile
+
+        root = args.root or tempfile.mkdtemp(prefix="ozone-ralg-")
+        _emit(freon.ralg(root, n_entries=args.num, size=args.size,
+                         threads=args.threads).summary())
+    elif args.generator in ("dcg", "dcv", "dsg", "dnbp"):
         oz = _client(args)
         dn_ids = list(oz.clients.known_ids())
         if not dn_ids:
             print(f"error: no datanodes known (is the SCM at {args.om} "
                   "reachable?)", file=sys.stderr)
             return 1
+        if args.generator == "dnbp":
+            _emit(freon.dnbp(oz.clients, dn_ids, args.num,
+                             threads=args.threads).summary())
+            return 0
         gen = {"dcg": freon.dcg, "dcv": freon.dcv, "dsg": freon.dsg}[
             args.generator]
         _emit(gen(oz.clients, dn_ids, args.num, size=args.size,
@@ -690,7 +700,7 @@ def build_parser() -> argparse.ArgumentParser:
     fr.add_argument("generator",
                     choices=["ockg", "ockr", "rawcoder", "omkg", "ommg",
                              "scmtb", "cmdw", "dbgen", "dcg", "dcv",
-                             "dsg", "hsg"])
+                             "dsg", "hsg", "dnbp", "ralg"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("-t", "--threads", type=int, default=4)

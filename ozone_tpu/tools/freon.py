@@ -475,3 +475,73 @@ def rawcoder_bench(
         except Exception as e:
             out.append({"backend": be, "schema": schema, "error": str(e)})
     return out
+
+
+def dnbp(
+    clients,
+    dn_ids: list[str],
+    n_blocks: int = 200,
+    chunks_per_block: int = 4,
+    size: int = 1024 * 1024,
+    threads: int = 4,
+    container_id: int = 30_000_000,
+) -> FreonReport:
+    """Datanode block putter (DatanodeBlockPutter analog): raw putBlock
+    metadata commits against datanodes — block-manager throughput with no
+    chunk IO on the timed path."""
+    from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    rng = np.random.default_rng(3)
+    sample = rng.integers(0, 256, 4096, dtype=np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 4096).compute(sample)
+    _ensure_container(clients, dn_ids, container_id)
+
+    def op(i: int) -> int:
+        dn = dn_ids[i % len(dn_ids)]
+        bid = BlockID(container_id, i + 1)
+        chunks = [
+            ChunkInfo(f"{bid}_chunk_{c}", c * size, size, cs)
+            for c in range(chunks_per_block)
+        ]
+        clients.get(dn).put_block(BlockData(bid, chunks))
+        return 0
+
+    return BaseFreonGenerator("dnbp", n_blocks, threads).run(op)
+
+
+def ralg(
+    root,
+    n_entries: int = 2000,
+    size: int = 1024,
+    threads: int = 1,
+) -> FreonReport:
+    """Raft log append generator (LeaderAppendLogEntryGenerator analog):
+    a local 3-node consensus ring commits payload entries through the
+    leader — measures log append + quorum-commit throughput including
+    durable log writes."""
+    from pathlib import Path
+
+    from ozone_tpu.consensus.raft import InProcessTransport, RaftNode
+
+    root = Path(root)
+    transport = InProcessTransport()
+    ids = ["r0", "r1", "r2"]
+    sink: list = []
+    nodes = [
+        RaftNode(nid, ids, root / nid, (lambda _e: None) if nid != "r0"
+                 else sink.append, transport=transport)
+        for nid in ids
+    ]
+    assert nodes[0].start_election()
+    payload = "x" * size
+
+    def op(i: int) -> int:
+        nodes[0].propose(f"{i}:{payload}")
+        return size
+
+    try:
+        return BaseFreonGenerator("ralg", n_entries, threads).run(op)
+    finally:
+        for n in nodes:
+            n.stop()

@@ -153,3 +153,19 @@ def test_cli_admin_rejects_bad_input(cluster, capsys):
                      "--om", om]) == 1
     err = capsys.readouterr().err
     assert "NODE_NOT_FOUND" in err
+
+
+def test_freon_dnbp_and_ralg(cluster, tmp_path):
+    meta, dns = cluster
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+
+    clients = DatanodeClientFactory()
+    for d in dns:
+        clients.register_remote(d.dn.id, d.address)
+    dn_ids = [d.dn.id for d in dns]
+    rep = freon.dnbp(clients, dn_ids, n_blocks=20, threads=3)
+    assert rep.failures == 0 and rep.ops == 20
+
+    rep = freon.ralg(tmp_path / "ralg", n_entries=50, size=256)
+    assert rep.failures == 0 and rep.ops == 50
+    assert rep.summary()["ops_per_s"] > 0

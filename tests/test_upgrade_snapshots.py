@@ -236,3 +236,12 @@ def test_snapshot_path_without_key_component_errors_cleanly(cluster):
     oz.om.create_snapshot("v", "b", "s1")
     with pytest.raises(OMError):
         b.read_key(".snapshot/s1")
+
+
+def test_snapshot_name_validation(cluster):
+    oz = cluster.client()
+    oz.create_volume("v").create_bucket("b", replication=EC)
+    for bad in ("", "a/b"):
+        with pytest.raises(OMError) as ei:
+            oz.om.create_snapshot("v", "b", bad)
+        assert ei.value.code == "INVALID_SNAPSHOT_NAME"
