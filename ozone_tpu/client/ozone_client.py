@@ -153,22 +153,27 @@ class OzoneBucket:
         )
 
     def initiate_multipart_upload(
-        self, key: str, replication: Optional[str] = None
+        self, key: str, replication: Optional[str] = None,
+        metadata: Optional[dict] = None,
     ) -> MultipartUpload:
         upload_id = self.client.om.initiate_multipart_upload(
-            self.volume, self.name, key, replication
+            self.volume, self.name, key, replication, metadata=metadata
         )
         return MultipartUpload(self, key, upload_id)
 
     def open_key(
-        self, key: str, replication: Optional[str] = None
+        self, key: str, replication: Optional[str] = None,
+        metadata: Optional[dict] = None,
     ) -> KeyWriteHandle:
         om = self.client.om
-        session = om.open_key(self.volume, self.name, key, replication)
+        session = om.open_key(self.volume, self.name, key, replication,
+                              metadata=metadata)
         return KeyWriteHandle(session, om, self._make_writer(session))
 
-    def write_key(self, key: str, data, replication: Optional[str] = None) -> None:
-        with self.open_key(key, replication) as h:
+    def write_key(self, key: str, data,
+                  replication: Optional[str] = None,
+                  metadata: Optional[dict] = None) -> None:
+        with self.open_key(key, replication, metadata=metadata) as h:
             h.write(data)
 
     def read_key(self, key: str) -> np.ndarray:
@@ -186,6 +191,13 @@ class OzoneBucket:
                                           parts[1], parts[2])
         else:
             info = om.lookup_key(self.volume, self.name, key)
+        return self.read_key_info(info)
+
+    def read_key_info(self, info: dict) -> np.ndarray:
+        """Read a key's bytes from already-fetched key info — callers
+        that looked the key up for other reasons (metadata headers,
+        checksum type) avoid a second OM round-trip."""
+        om = self.client.om
         groups = om.key_block_groups(info)
         parts: list[np.ndarray] = []
         for g in groups:

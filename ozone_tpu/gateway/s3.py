@@ -524,9 +524,18 @@ class S3Gateway:
             return
         if src is not None:  # CopyObject (ObjectEndpoint.put copyHeader)
             h._body()  # drain any (ignored) request body
-            data = self._bucket_handle(src[0]).read_key(src[1]).tobytes()
+            src_info = self.client.om.lookup_key(self._vol, src[0], src[1])
+            data = self._bucket_handle(src[0]).read_key_info(
+                src_info).tobytes()
+            # metadata directive: COPY (default) carries the source
+            # object's user metadata; REPLACE takes this request's
+            if (h.headers.get("x-amz-metadata-directive", "COPY")
+                    .upper() == "REPLACE"):
+                meta = self._user_metadata(h)
+            else:
+                meta = src_info.get("metadata") or {}
             self._bucket_handle(bucket).write_key(
-                key, np.frombuffer(data, np.uint8)
+                key, np.frombuffer(data, np.uint8), metadata=meta
             )
             etag = hashlib.md5(data).hexdigest()
             root = ET.Element("CopyObjectResult", xmlns=_NS)
@@ -536,18 +545,45 @@ class S3Gateway:
             return
         body = h._body()
         self._bucket_handle(bucket).write_key(
-            key, np.frombuffer(body, np.uint8)
+            key, np.frombuffer(body, np.uint8),
+            metadata=self._user_metadata(h),
         )
         etag = hashlib.md5(body).hexdigest()
         h._reply(200, headers={"ETag": f'"{etag}"'})
 
+    @staticmethod
+    def _user_metadata(h) -> dict:
+        """x-amz-meta-* request headers -> user metadata map (stored on
+        the key like the reference's custom-metadata support)."""
+        out = {}
+        for name, value in h.headers.items():
+            low = name.lower()
+            if low.startswith("x-amz-meta-"):
+                out[low[len("x-amz-meta-"):]] = value
+        return out
+
+    @staticmethod
+    def _meta_headers_from(info: dict) -> dict:
+        return {
+            f"x-amz-meta-{k}": str(v)
+            for k, v in (info.get("metadata") or {}).items()
+        }
+
     def _get_object(self, h, bucket: str, key: str) -> None:
-        data = self._bucket_handle(bucket).read_key(key).tobytes()
+        # one lookup serves metadata headers AND the block list
+        info = self.client.om.lookup_key(self._vol, bucket, key)
+        data = self._bucket_handle(bucket).read_key_info(info).tobytes()
+        meta = self._meta_headers_from(info)
         rng = h.headers.get("Range")
         if rng and rng.startswith("bytes="):
             lo_s, _, hi_s = rng[6:].partition("-")
-            lo = int(lo_s) if lo_s else 0
-            hi = int(hi_s) if hi_s else len(data) - 1
+            if not lo_s:  # suffix form bytes=-N: the LAST N bytes
+                n = int(hi_s)
+                lo = max(0, len(data) - n)
+                hi = len(data) - 1
+            else:
+                lo = int(lo_s)
+                hi = int(hi_s) if hi_s else len(data) - 1
             part = data[lo : hi + 1]
             h._reply(
                 206,
@@ -555,11 +591,12 @@ class S3Gateway:
                 {
                     "Content-Type": "application/octet-stream",
                     "Content-Range": f"bytes {lo}-{hi}/{len(data)}",
+                    **meta,
                 },
             )
         else:
             h._reply(200, data,
-                     {"Content-Type": "application/octet-stream"})
+                     {"Content-Type": "application/octet-stream", **meta})
 
     def _head_object(self, h, bucket: str, key: str) -> None:
         """HEAD must report the real object size in Content-Length with no
@@ -569,6 +606,8 @@ class S3Gateway:
         h.send_response(200)
         h.send_header("Content-Type", "application/octet-stream")
         h.send_header("Content-Length", str(info["size"]))
+        for k, v in (info.get("metadata") or {}).items():
+            h.send_header(f"x-amz-meta-{k}", str(v))
         h.end_headers()
 
     # ------------------------------------------------------------- multipart
@@ -576,7 +615,8 @@ class S3Gateway:
     # design: the gateway is stateless, upload state survives restarts,
     # and parts stream through the normal EC/replicated datapath.
     def _mpu_initiate(self, h, bucket: str, key: str) -> None:
-        mpu = self._bucket_handle(bucket).initiate_multipart_upload(key)
+        mpu = self._bucket_handle(bucket).initiate_multipart_upload(
+            key, metadata=self._user_metadata(h))
         root = ET.Element("InitiateMultipartUploadResult", xmlns=_NS)
         ET.SubElement(root, "Bucket").text = bucket
         ET.SubElement(root, "Key").text = key

@@ -145,7 +145,7 @@ class OmGrpcService:
                 "InitiateMultipartUpload": self._wrap(
                     lambda m: self.om.initiate_multipart_upload(
                         m["volume"], m["bucket"], m["key"],
-                        m.get("replication"),
+                        m.get("replication"), m.get("metadata"),
                     )
                 ),
                 "MultipartInfo": self._wrap(
@@ -279,7 +279,8 @@ class OmGrpcService:
             with self.om.user_context(m.pop("_user", None),
                                       m.pop("_groups", ())):
                 s = self.om.open_key(
-                    m["volume"], m["bucket"], m["key"], m.get("replication")
+                    m["volume"], m["bucket"], m["key"],
+                    m.get("replication"), metadata=m.get("metadata"),
                 )
         except OMError as e:
             raise StorageError(e.code, e.msg)
@@ -469,9 +470,10 @@ class GrpcOmClient:
         return self._call("ListBuckets", volume=volume)["result"]
 
     # keys
-    def open_key(self, volume, bucket, key, replication=None):
+    def open_key(self, volume, bucket, key, replication=None,
+                 metadata=None):
         meta = self._call("OpenKey", volume=volume, bucket=bucket, key=key,
-                          replication=replication)
+                          replication=replication, metadata=metadata)
         self.block_size = meta.get("block_size", self.block_size)
         return RemoteOpenKeySession(volume, bucket, key, meta)
 
@@ -621,10 +623,10 @@ class GrpcOmClient:
 
     # multipart upload
     def initiate_multipart_upload(self, volume, bucket, key,
-                                  replication=None):
+                                  replication=None, metadata=None):
         return self._call(
             "InitiateMultipartUpload", volume=volume, bucket=bucket,
-            key=key, replication=replication,
+            key=key, replication=replication, metadata=metadata,
         )["result"]
 
     def multipart_info(self, volume, bucket, key, upload_id):

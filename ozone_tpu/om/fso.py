@@ -178,6 +178,7 @@ class OpenFile(OMRequest):
     overwrite: bool = True
     new_dir_ids: list[str] = field(default_factory=list)
     created: float = 0.0
+    metadata: dict = field(default_factory=dict)
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -200,24 +201,23 @@ class OpenFile(OMRequest):
             raise OMError(NOT_A_FILE, f"{fk} is a directory")
         if not self.overwrite and store.exists("files", fk):
             raise OMError(FILE_ALREADY_EXISTS, fk)
-        store.put(
-            "open_keys",
-            f"{fk}/{self.client_id}",
-            {
-                "volume": self.volume,
-                "bucket": self.bucket,
-                "name": self.path.strip("/"),
-                "file_name": name,
-                "parent_id": parent,
-                "replication": self.replication,
-                "checksum_type": self.checksum_type,
-                "bytes_per_checksum": self.bytes_per_checksum,
-                "size": 0,
-                "block_groups": [],
-                "created": self.created,
-                "modified": self.created,
-            },
-        )
+        row = {
+            "volume": self.volume,
+            "bucket": self.bucket,
+            "name": self.path.strip("/"),
+            "file_name": name,
+            "parent_id": parent,
+            "replication": self.replication,
+            "checksum_type": self.checksum_type,
+            "bytes_per_checksum": self.bytes_per_checksum,
+            "size": 0,
+            "block_groups": [],
+            "created": self.created,
+            "modified": self.created,
+        }
+        if self.metadata:
+            row["metadata"] = dict(self.metadata)
+        store.put("open_keys", f"{fk}/{self.client_id}", row)
         return parent
 
 

@@ -64,6 +64,7 @@ class InitiateMultipartUpload(rq.OMRequest):
     checksum_type: str = "CRC32C"
     bytes_per_checksum: int = 16 * 1024
     created: float = 0.0
+    metadata: dict = field(default_factory=dict)
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -92,6 +93,7 @@ class InitiateMultipartUpload(rq.OMRequest):
                 "bytes_per_checksum": self.bytes_per_checksum,
                 "created": self.created,
                 "parts": {},
+                "metadata": dict(self.metadata),
             },
         )
         return self.upload_id
@@ -205,6 +207,8 @@ class CompleteMultipartUpload(rq.OMRequest):
             "created": mpu["created"],
             "modified": self.ts,
         }
+        if mpu.get("metadata"):
+            info["metadata"] = mpu["metadata"]
         store.put("keys", kk, info)
         store.delete("multipart", mk)
         return info

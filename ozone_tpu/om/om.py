@@ -317,6 +317,7 @@ class OzoneManager:
         bucket: str,
         key: str,
         replication: Optional[str] = None,
+        metadata: Optional[dict] = None,
     ) -> OpenKeySession:
         from ozone_tpu.om import fso
 
@@ -325,12 +326,14 @@ class OzoneManager:
         repl = replication or binfo["replication"]
         client_id = uuid.uuid4().hex[:16]
         if self._is_fso(binfo):
-            req = fso.OpenFile(volume, bucket, key, client_id, repl)
+            req = fso.OpenFile(volume, bucket, key, client_id, repl,
+                               metadata=metadata or {})
             parent = self.submit(req)
             name = fso.split_path(key)[-1]
             open_k = f"{fso.dir_key(volume, bucket, parent, name)}/{client_id}"
         else:
-            req = rq.OpenKey(volume, bucket, key, client_id, repl)
+            req = rq.OpenKey(volume, bucket, key, client_id, repl,
+                             metadata=metadata or {})
             self.submit(req)
             open_k = f"{key_key(volume, bucket, key)}/{client_id}"
         info = self.store.get("open_keys", open_k)
@@ -526,12 +529,14 @@ class OzoneManager:
     def initiate_multipart_upload(
         self, volume: str, bucket: str, key: str,
         replication: Optional[str] = None,
+        metadata: Optional[dict] = None,
     ) -> str:
         from ozone_tpu.om import multipart as mpu
 
         return self.submit(
             mpu.InitiateMultipartUpload(
-                volume, bucket, key, replication=replication or ""
+                volume, bucket, key, replication=replication or "",
+                metadata=metadata or {},
             )
         )
 

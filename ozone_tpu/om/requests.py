@@ -547,6 +547,7 @@ class OpenKey(OMRequest):
     checksum_type: str = "CRC32C"
     bytes_per_checksum: int = 16 * 1024
     created: float = 0.0
+    metadata: dict = field(default_factory=dict)
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -555,22 +556,23 @@ class OpenKey(OMRequest):
         if not store.exists("buckets", bucket_key(self.volume, self.bucket)):
             raise OMError(BUCKET_NOT_FOUND, f"{self.volume}/{self.bucket}")
         kk = key_key(self.volume, self.bucket, self.key)
-        store.put(
-            "open_keys",
-            f"{kk}/{self.client_id}",
-            {
-                "volume": self.volume,
-                "bucket": self.bucket,
-                "name": self.key,
-                "replication": self.replication,
-                "checksum_type": self.checksum_type,
-                "bytes_per_checksum": self.bytes_per_checksum,
-                "size": 0,
-                "block_groups": [],
-                "created": self.created,
-                "modified": self.created,
-            },
-        )
+        row = {
+            "volume": self.volume,
+            "bucket": self.bucket,
+            "name": self.key,
+            "replication": self.replication,
+            "checksum_type": self.checksum_type,
+            "bytes_per_checksum": self.bytes_per_checksum,
+            "size": 0,
+            "block_groups": [],
+            "created": self.created,
+            "modified": self.created,
+        }
+        if self.metadata:
+            # user-defined key metadata (reference: OmKeyInfo metadata
+            # map carrying e.g. S3 x-amz-meta-* pairs)
+            row["metadata"] = dict(self.metadata)
+        store.put("open_keys", f"{kk}/{self.client_id}", row)
 
 
 @dataclass

@@ -288,3 +288,56 @@ def test_s3_list_edge_cases_and_quota_mapping(s3):
     assert ei.value.code == 403
     assert b"QuotaExceeded" in ei.value.read()
     s3.client.om.set_quota(s3._vol, "eb", quota_bytes=-1)
+
+
+def test_s3_user_metadata_roundtrip_and_copy_directives(s3):
+    _req(s3, "PUT", "/mb")
+    payload = b"hello-meta"
+    r = _req(s3, "PUT", "/mb/obj", data=payload,
+             headers={"x-amz-meta-owner": "alice",
+                      "x-amz-meta-env": "prod"})
+    assert r.status == 200
+    r = _req(s3, "GET", "/mb/obj")
+    assert r.read() == payload
+    assert r.headers["x-amz-meta-owner"] == "alice"
+    assert r.headers["x-amz-meta-env"] == "prod"
+    r = _req(s3, "HEAD", "/mb/obj")
+    assert r.headers["x-amz-meta-owner"] == "alice"
+    # COPY directive (default): metadata travels with the copy
+    r = _req(s3, "PUT", "/mb/copy1",
+             headers={"x-amz-copy-source": "/mb/obj"})
+    assert r.status == 200
+    assert _req(s3, "HEAD", "/mb/copy1").headers["x-amz-meta-owner"] \
+        == "alice"
+    # REPLACE directive: request headers win
+    r = _req(s3, "PUT", "/mb/copy2",
+             headers={"x-amz-copy-source": "/mb/obj",
+                      "x-amz-metadata-directive": "REPLACE",
+                      "x-amz-meta-owner": "bob"})
+    assert r.status == 200
+    hd = _req(s3, "HEAD", "/mb/copy2").headers
+    assert hd["x-amz-meta-owner"] == "bob"
+    assert hd.get("x-amz-meta-env") is None
+
+
+def test_s3_mpu_metadata_and_suffix_range(s3):
+    _req(s3, "PUT", "/mrb")
+    # MPU carries x-amz-meta-* from initiate through complete
+    r = _req(s3, "POST", "/mrb/assembled?uploads",
+             headers={"x-amz-meta-team": "storage"})
+    tree = ET.fromstring(r.read())
+    upload_id = next(e.text for e in tree.iter()
+                     if e.tag.endswith("UploadId"))
+    payload = bytes(np.random.default_rng(8).integers(0, 256, 9_000,
+                                                      dtype=np.uint8))
+    _req(s3, "PUT", f"/mrb/assembled?partNumber=1&uploadId={upload_id}",
+         data=payload)
+    _req(s3, "POST", f"/mrb/assembled?uploadId={upload_id}", data=b"")
+    assert _req(s3, "HEAD", "/mrb/assembled").headers["x-amz-meta-team"] \
+        == "storage"
+    # suffix range returns the LAST n bytes
+    r = _req(s3, "GET", "/mrb/assembled",
+             headers={"Range": "bytes=-100"})
+    assert r.status == 206
+    assert r.read() == payload[-100:]
+    assert r.headers["Content-Range"] == f"bytes 8900-8999/9000"
