@@ -53,6 +53,12 @@ class OmGrpcService:
                         m.get("layout", "OBJECT_STORE"),
                     )
                 ),
+                "CreateBucketLink": self._wrap(
+                    lambda m: self.om.create_bucket_link(
+                        m["src_volume"], m["src_bucket"],
+                        m["volume"], m["bucket"],
+                    )
+                ),
                 "DeleteBucket": self._wrap(
                     lambda m: self.om.delete_bucket(m["volume"], m["bucket"])
                 ),
@@ -291,6 +297,10 @@ class OmGrpcService:
                 "checksum_type": s.checksum_type,
                 "bytes_per_checksum": s.bytes_per_checksum,
                 "block_size": self.om.block_size,
+                # link buckets resolve server-side; the session must act
+                # on the REAL names or its commit targets the alias
+                "volume": s.volume,
+                "bucket": s.bucket,
                 # FSO sessions carry their tree position across the wire
                 "parent_id": s.parent_id,
                 "file_name": s.file_name,
@@ -364,8 +374,9 @@ class OmGrpcService:
 
 class RemoteOpenKeySession:
     def __init__(self, volume, bucket, key, meta):
-        self.volume = volume
-        self.bucket = bucket
+        # the server reply carries link-resolved names when they differ
+        self.volume = meta.get("volume", volume)
+        self.bucket = meta.get("bucket", bucket)
         self.key = key
         self.client_id = meta["client_id"]
         self.replication = ReplicationConfig.parse(meta["replication"])
@@ -459,6 +470,10 @@ class GrpcOmClient:
                       layout="OBJECT_STORE"):
         self._call("CreateBucket", volume=volume, bucket=bucket,
                    replication=replication, layout=layout)
+
+    def create_bucket_link(self, src_volume, src_bucket, volume, bucket):
+        self._call("CreateBucketLink", src_volume=src_volume,
+                   src_bucket=src_bucket, volume=volume, bucket=bucket)
 
     def delete_bucket(self, volume, bucket):
         self._call("DeleteBucket", volume=volume, bucket=bucket)
@@ -644,6 +659,9 @@ class GrpcOmClient:
                 "replication": info["replication"],
                 "checksum_type": info["checksum_type"],
                 "bytes_per_checksum": info["bytes_per_checksum"],
+                # MPU rows store the link-resolved names
+                "volume": info["volume"],
+                "bucket": info["bucket"],
             },
         )
 

@@ -292,6 +292,36 @@ class OzoneManager:
         self.check_access(volume, None, None, "CREATE")
         self.submit(rq.CreateBucket(volume, bucket, replication, layout))
 
+    def create_bucket_link(self, src_volume: str, src_bucket: str,
+                           volume: str, bucket: str) -> None:
+        """Create a link bucket aliasing src (ozone sh bucket link)."""
+        self.check_access(volume, None, None, "CREATE")
+        self.submit(rq.CreateBucket(
+            volume, bucket,
+            source_volume=src_volume, source_bucket=src_bucket,
+        ))
+
+    def resolve_bucket(self, volume: str, bucket: str) -> tuple[str, str]:
+        """Follow link-bucket chains to the real bucket (reference
+        OmBucketInfo source resolution): raises DANGLING_LINK when a
+        link's source is missing or the chain loops."""
+        seen = set()
+        while True:
+            row = self.store.get("buckets", bucket_key(volume, bucket))
+            if row is None:
+                if seen:  # we got here by following a link
+                    raise rq.OMError(rq.DANGLING_LINK,
+                                     f"{volume}/{bucket} missing")
+                raise rq.OMError(rq.BUCKET_NOT_FOUND, f"{volume}/{bucket}")
+            src = row.get("source")
+            if not src:
+                return volume, bucket
+            if (volume, bucket) in seen:
+                raise rq.OMError(rq.DANGLING_LINK,
+                                 f"link loop at {volume}/{bucket}")
+            seen.add((volume, bucket))
+            volume, bucket = src["volume"], src["bucket"]
+
     def delete_bucket(self, volume: str, bucket: str) -> None:
         self.check_access(volume, bucket, None, "DELETE")
         self.submit(rq.DeleteBucket(volume, bucket))
@@ -300,6 +330,14 @@ class OzoneManager:
         b = self.store.get("buckets", bucket_key(volume, bucket))
         if b is None:
             raise rq.OMError(rq.BUCKET_NOT_FOUND, f"{volume}/{bucket}")
+        if b.get("source"):
+            # a link reports its own identity but the SOURCE's effective
+            # replication/layout (that is where keys live)
+            rv, rb = self.resolve_bucket(volume, bucket)
+            eff = self.store.get("buckets", bucket_key(rv, rb)) or {}
+            b = dict(b)
+            b["replication"] = eff.get("replication", b["replication"])
+            b["layout"] = eff.get("layout", b["layout"])
         return b
 
     def list_buckets(self, volume: str) -> list[dict]:
@@ -321,6 +359,7 @@ class OzoneManager:
     ) -> OpenKeySession:
         from ozone_tpu.om import fso
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, None, "CREATE")
         binfo = self.bucket_info(volume, bucket)
         repl = replication or binfo["replication"]
@@ -395,6 +434,7 @@ class OzoneManager:
     def recover_lease(self, volume: str, bucket: str, key: str) -> dict:
         """Seal an abandoned hsynced write and fence its dead writer
         (recoverLease of the ozonefs adapter / OMRecoverLeaseRequest)."""
+        volume, bucket = self.resolve_bucket(volume, bucket)
         out = self.submit(rq.RecoverLease(volume, bucket, key))
         self.metrics.counter("leases_recovered").inc()
         return out
@@ -403,7 +443,10 @@ class OzoneManager:
                   quota_bytes: Optional[int] = None,
                   quota_namespace: Optional[int] = None) -> dict:
         """Space/namespace quota on a volume or bucket; None leaves a
-        dimension unchanged, -1 clears it to unlimited."""
+        dimension unchanged, -1 clears it to unlimited. Setting quota
+        through a link targets the source (where usage is charged)."""
+        if bucket:
+            volume, bucket = self.resolve_bucket(volume, bucket)
         return self.submit(rq.SetQuota(volume, bucket,
                                        quota_bytes, quota_namespace))
 
@@ -418,35 +461,43 @@ class OzoneManager:
         return SnapshotManager(self)
 
     def create_snapshot(self, volume: str, bucket: str, name: str) -> dict:
+        volume, bucket = self.resolve_bucket(volume, bucket)
         return self._snapshots().create_snapshot(volume, bucket,
                                                  name).to_json()
 
     def list_snapshots(self, volume: str, bucket: str) -> list[dict]:
+        volume, bucket = self.resolve_bucket(volume, bucket)
         return [s.to_json()
                 for s in self._snapshots().list_snapshots(volume, bucket)]
 
     def snapshot_info(self, volume: str, bucket: str, name: str) -> dict:
+        volume, bucket = self.resolve_bucket(volume, bucket)
         return self._snapshots().get_snapshot(volume, bucket,
                                               name).to_json()
 
     def delete_snapshot(self, volume: str, bucket: str, name: str) -> None:
+        volume, bucket = self.resolve_bucket(volume, bucket)
         self._snapshots().delete_snapshot(volume, bucket, name)
 
     def snapshot_diff(self, volume: str, bucket: str, from_snapshot: str,
                       to_snapshot=None) -> dict:
+        volume, bucket = self.resolve_bucket(volume, bucket)
         return self._snapshots().snapshot_diff(volume, bucket,
                                                from_snapshot, to_snapshot)
 
     def snapshot_keys(self, volume: str, bucket: str, name: str) -> list[dict]:
+        volume, bucket = self.resolve_bucket(volume, bucket)
         return self._snapshots().list_keys(volume, bucket, name)
 
     def snapshot_lookup_key(self, volume: str, bucket: str, name: str,
                             key: str) -> dict:
+        volume, bucket = self.resolve_bucket(volume, bucket)
         return self._snapshots().lookup_key(volume, bucket, name, key)
 
     def lookup_key(self, volume: str, bucket: str, key: str) -> dict:
         from ozone_tpu.om import fso
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, key, "READ")
 
         if self._is_fso(self.bucket_info(volume, bucket)):
@@ -468,8 +519,8 @@ class OzoneManager:
     def list_keys(self, volume: str, bucket: str, prefix: str = "") -> list[dict]:
         from ozone_tpu.om import fso
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, None, "LIST")
-
         binfo = self.bucket_info(volume, bucket)  # raises BUCKET_NOT_FOUND
         if self._is_fso(binfo):
             return [
@@ -482,8 +533,8 @@ class OzoneManager:
     def delete_key(self, volume: str, bucket: str, key: str) -> None:
         from ozone_tpu.om import fso
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, key, "DELETE")
-
         if self._is_fso(self.bucket_info(volume, bucket)):
             self.submit(fso.DeleteFile(volume, bucket, key))
         else:
@@ -493,8 +544,8 @@ class OzoneManager:
     def rename_key(self, volume: str, bucket: str, key: str, new_key: str) -> None:
         from ozone_tpu.om import fso
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, key, "WRITE")
-
         if self._is_fso(self.bucket_info(volume, bucket)):
             self.submit(fso.RenameEntry(volume, bucket, key, new_key))
         else:
@@ -533,6 +584,7 @@ class OzoneManager:
     ) -> str:
         from ozone_tpu.om import multipart as mpu
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         return self.submit(
             mpu.InitiateMultipartUpload(
                 volume, bucket, key, replication=replication or "",
@@ -545,6 +597,7 @@ class OzoneManager:
     ) -> dict:
         from ozone_tpu.om import multipart as mpu
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         info = self.store.get(
             "multipart", mpu.mpu_key(volume, bucket, key, upload_id)
         )
@@ -592,6 +645,7 @@ class OzoneManager:
     ) -> dict:
         from ozone_tpu.om import multipart as mpu
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         return self.submit(
             mpu.CompleteMultipartUpload(volume, bucket, key, upload_id, parts)
         )
@@ -601,6 +655,7 @@ class OzoneManager:
     ) -> None:
         from ozone_tpu.om import multipart as mpu
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         self.submit(mpu.AbortMultipartUpload(volume, bucket, key, upload_id))
 
     def list_parts(
@@ -614,6 +669,7 @@ class OzoneManager:
     def list_multipart_uploads(
         self, volume: str, bucket: str, prefix: str = ""
     ) -> list[dict]:
+        volume, bucket = self.resolve_bucket(volume, bucket)
         base = bucket_key(volume, bucket) + "/"
         return [
             m for _, m in self.store.iterate("multipart", base + prefix)
@@ -639,34 +695,38 @@ class OzoneManager:
     def create_directory(self, volume: str, bucket: str, path: str) -> None:
         from ozone_tpu.om import fso
 
-        self._require_fso(volume, bucket)
+        volume, bucket = self._require_fso(volume, bucket)
         self.submit(fso.CreateDirectory(volume, bucket, path))
 
-    def _require_fso(self, volume: str, bucket: str) -> None:
+    def _require_fso(self, volume: str, bucket: str) -> tuple[str, str]:
+        """Resolve links, then demand an FSO layout; returns the REAL
+        (volume, bucket) so directory ops act on the source tree."""
         from ozone_tpu.om import fso
 
+        volume, bucket = self.resolve_bucket(volume, bucket)
         if not self._is_fso(self.bucket_info(volume, bucket)):
             raise rq.OMError(fso.NOT_A_DIRECTORY,
                              f"{volume}/{bucket} is not an FSO bucket")
+        return volume, bucket
 
     def delete_directory(
         self, volume: str, bucket: str, path: str, recursive: bool = False
     ) -> None:
         from ozone_tpu.om import fso
 
-        self._require_fso(volume, bucket)
+        volume, bucket = self._require_fso(volume, bucket)
         self.submit(fso.DeleteDirectory(volume, bucket, path, recursive))
 
     def get_file_status(self, volume: str, bucket: str, path: str) -> dict:
         from ozone_tpu.om import fso
 
-        self._require_fso(volume, bucket)
+        volume, bucket = self._require_fso(volume, bucket)
         return fso.get_status(self.store, volume, bucket, path)
 
     def list_status(self, volume: str, bucket: str, path: str) -> list[dict]:
         from ozone_tpu.om import fso
 
-        self._require_fso(volume, bucket)
+        volume, bucket = self._require_fso(volume, bucket)
         return fso.list_status(self.store, volume, bucket, path)
 
     def run_dir_deleting_service_once(self, limit: int = 256) -> int:

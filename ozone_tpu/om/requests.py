@@ -42,6 +42,7 @@ BUCKET_NOT_FOUND = "BUCKET_NOT_FOUND"
 BUCKET_ALREADY_EXISTS = "BUCKET_ALREADY_EXISTS"
 BUCKET_NOT_EMPTY = "BUCKET_NOT_EMPTY"
 KEY_NOT_FOUND = "KEY_NOT_FOUND"
+DANGLING_LINK = "DANGLING_LINK"
 
 
 _REQUEST_TYPES: dict[str, type] = {}
@@ -119,12 +120,18 @@ class DeleteVolume(OMRequest):
 
 @dataclass
 class CreateBucket(OMRequest):
+    """Create a bucket — or, with source_volume/source_bucket set, a
+    LINK bucket (ozone sh bucket link analog): a named alias whose key
+    operations resolve to the source bucket."""
+
     volume: str
     bucket: str
     replication: str = "rs-6-3-1024k"
     layout: str = "OBJECT_STORE"
     versioning: bool = False
     created: float = 0.0
+    source_volume: str = ""
+    source_bucket: str = ""
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -138,21 +145,25 @@ class CreateBucket(OMRequest):
         k = bucket_key(self.volume, self.bucket)
         if store.exists("buckets", k):
             raise OMError(BUCKET_ALREADY_EXISTS, k)
-        store.put(
-            "buckets",
-            k,
-            {
-                "volume": self.volume,
-                "name": self.bucket,
-                "replication": self.replication,
-                "layout": self.layout,
-                "versioning": self.versioning,
-                "created": self.created,
-                # DEFAULT grants on the volume flow down as ACCESS grants
-                # (OzoneAclUtil.inheritDefaultAcls)
-                "acls": inherit_defaults(vrow.get("acls", [])),
-            },
-        )
+        row = {
+            "volume": self.volume,
+            "name": self.bucket,
+            "replication": self.replication,
+            "layout": self.layout,
+            "versioning": self.versioning,
+            "created": self.created,
+            # DEFAULT grants on the volume flow down as ACCESS grants
+            # (OzoneAclUtil.inheritDefaultAcls)
+            "acls": inherit_defaults(vrow.get("acls", [])),
+        }
+        if self.source_volume and self.source_bucket:
+            # links may be created before their source (reference
+            # semantics: dangling links resolve lazily and error on use)
+            row["source"] = {
+                "volume": self.source_volume,
+                "bucket": self.source_bucket,
+            }
+        store.put("buckets", k, row)
 
 
 @dataclass

@@ -90,7 +90,7 @@ def _quota_arg(v: str):
 #: verbs valid per sh object; anything else errors instead of no-opping
 _SH_VERBS = {
     "volume": {"create", "delete", "info", "list", "setquota"},
-    "bucket": {"create", "delete", "info", "list", "setquota"},
+    "bucket": {"create", "delete", "info", "list", "setquota", "link"},
     "key": {"put", "get", "delete", "info", "list", "rename", "checksum"},
     "snapshot": {"create", "list", "info", "delete", "diff"},
 }
@@ -137,6 +137,14 @@ def cmd_sh(args) -> int:
                 _emit(oz.om.set_quota(
                     vol, bucket, quota_bytes=_quota_arg(args.quota),
                     quota_namespace=args.namespace_quota))
+            elif verb == "link":
+                if not args.to:
+                    print("error: bucket link requires --to "
+                          "/volume/bucket", file=sys.stderr)
+                    return 1
+                dvol, dbkt = _parse_path(args.to)
+                oz.om.create_bucket_link(vol, bucket, dvol, dbkt)
+                print(f"linked /{dvol}/{dbkt} -> /{vol}/{bucket}")
     elif kind == "snapshot":
         if verb == "list":
             vol, bucket = parts
@@ -630,7 +638,7 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("verb",
                     choices=["create", "delete", "info", "list", "put",
                              "get", "rename", "checksum", "setquota",
-                             "diff"])
+                             "diff", "link"])
     sh.add_argument("path", help="/volume[/bucket[/key]]")
     sh.add_argument("file", nargs="?", help="local file for key put/get")
     sh.add_argument("--om", default="127.0.0.1:9860")

@@ -1,0 +1,139 @@
+"""Link buckets (ozone sh bucket link analog): a named alias whose key
+operations resolve to the source bucket; dangling links error on use;
+deleting a link never touches source data.
+"""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.om.requests import OMError
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniOzoneCluster(
+        tmp_path,
+        num_datanodes=5,
+        block_size=4 * 4096,
+        container_size=1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+def _data(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def test_link_bucket_read_write_through(cluster):
+    oz = cluster.client()
+    oz.create_volume("v").create_bucket("src", replication=EC)
+    oz.create_volume("lv")
+    oz.om.create_bucket_link("v", "src", "lv", "alias")
+    alias = oz.get_volume("lv").get_bucket("alias")
+    src = oz.get_volume("v").get_bucket("src")
+    data = _data(15_000)
+    alias.write_key("k", data)  # write through the link
+    assert np.array_equal(src.read_key("k"), data)  # lands in the source
+    assert np.array_equal(alias.read_key("k"), data)
+    assert [k["name"] for k in alias.list_keys()] == ["k"]
+    # effective replication/layout comes from the source
+    info = oz.om.bucket_info("lv", "alias")
+    assert info["replication"] == EC
+    assert info["source"] == {"volume": "v", "bucket": "src"}
+    # delete through the link removes the source key
+    alias.delete_key("k")
+    with pytest.raises(OMError):
+        src.read_key("k")
+
+
+def test_link_chain_and_loop_detection(cluster):
+    oz = cluster.client()
+    oz.create_volume("v").create_bucket("real", replication=EC)
+    oz.om.create_bucket_link("v", "real", "v", "l1")
+    oz.om.create_bucket_link("v", "l1", "v", "l2")  # link -> link -> real
+    b = oz.get_volume("v").get_bucket("l2")
+    b.write_key("k", _data(2_000, 1))
+    assert oz.get_volume("v").get_bucket("real").read_key("k").size == 2_000
+    # loop: l3 -> l4 -> l3
+    oz.om.create_bucket_link("v", "l4", "v", "l3")
+    oz.om.create_bucket_link("v", "l3", "v", "l4")
+    with pytest.raises(OMError) as ei:
+        oz.om.list_keys("v", "l3")
+    assert ei.value.code == "DANGLING_LINK"
+
+
+def test_dangling_link_errors_on_use_and_link_delete_is_safe(cluster):
+    oz = cluster.client()
+    oz.create_volume("v").create_bucket("src", replication=EC)
+    oz.om.create_bucket_link("v", "src", "v", "alias")
+    src_b = oz.get_volume("v").get_bucket("src")
+    src_b.write_key("k", _data(1_000, 2))
+    # deleting the LINK leaves source data intact
+    oz.om.delete_bucket("v", "alias")
+    assert src_b.read_key("k").size == 1_000
+    # a link to a missing bucket errors as DANGLING_LINK on use
+    oz.om.create_bucket_link("v", "ghost", "v", "dangling")
+    with pytest.raises(OMError) as ei:
+        oz.om.list_keys("v", "dangling")
+    assert ei.value.code == "DANGLING_LINK"
+
+
+def test_multipart_through_link(cluster):
+    oz = cluster.client()
+    oz.create_volume("v").create_bucket("src", replication=EC)
+    oz.om.create_bucket_link("v", "src", "v", "alias")
+    alias = oz.get_volume("v").get_bucket("alias")
+    data = _data(18_000, 3)
+    mpu = alias.initiate_multipart_upload("big")
+    mpu.write_part(1, data[:9_000])
+    mpu.write_part(2, data[9_000:])
+    mpu.complete()
+    assert np.array_equal(
+        oz.get_volume("v").get_bucket("src").read_key("big"), data)
+
+
+def test_link_write_through_remote_om(tmp_path):
+    """The remote-protocol session must carry link-RESOLVED names, or the
+    commit targets the alias's empty keyspace (caught by the live-CLI
+    drive; regression guard)."""
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=4 * 4096,
+                       stale_after_s=1000.0, dead_after_s=2000.0,
+                       background_interval_s=0.5)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.2) for i in range(5)]
+    for d in dns:
+        d.start()
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        oz.create_volume("v").create_bucket("src", replication=EC)
+        oz.create_volume("links")
+        oz.om.create_bucket_link("v", "src", "links", "alias")
+        data = _data(8_000, 5)
+        oz.get_volume("links").get_bucket("alias").write_key("doc", data)
+        assert np.array_equal(
+            oz.get_volume("v").get_bucket("src").read_key("doc"), data)
+        # MPU through the link over the remote protocol
+        mpu = oz.get_volume("links").get_bucket("alias") \
+            .initiate_multipart_upload("big")
+        mpu.write_part(1, data)
+        mpu.complete()
+        assert np.array_equal(
+            oz.get_volume("v").get_bucket("src").read_key("big"), data)
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()

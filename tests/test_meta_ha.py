@@ -173,8 +173,17 @@ def test_ha_admin_ops_survive_failover(ha_cluster):
     time.sleep(0.5)  # followers apply the replicated record
     metas.pop(leader).stop()
     new_leader = _await_leader(metas, timeout=15.0)
-    node = metas[new_leader].scm.nodes.get("dn3")
-    assert node.op_state.value in ("DECOMMISSIONING", "DECOMMISSIONED")
+    # the new leader holds the committed record but applies it
+    # asynchronously — poll instead of racing the apply thread
+    deadline = time.monotonic() + 10.0
+    state = None
+    while time.monotonic() < deadline:
+        node = metas[new_leader].scm.nodes.get("dn3")
+        state = node.op_state.value if node else None
+        if state in ("DECOMMISSIONING", "DECOMMISSIONED"):
+            break
+        time.sleep(0.1)
+    assert state in ("DECOMMISSIONING", "DECOMMISSIONED"), state
     scm.admin("recommission", "dn3")
     scm.close()
 
