@@ -95,6 +95,33 @@ class StripeWriteError(Exception):
         self.cause = cause
 
 
+def create_group_containers(clients, group: "BlockGroup",
+                            replica_indexed: bool) -> None:
+    """Create the group's container on every pipeline member, collecting
+    unreachable members into one StripeWriteError so writer retry paths
+    exclude them and reallocate (shared by the EC and replicated
+    writers; a dead member must not kill the whole write)."""
+    failed: list[str] = []
+    cause: Optional[Exception] = None
+    for i, dn_id in enumerate(group.pipeline.nodes):
+        try:
+            client = clients.get(dn_id)
+            if replica_indexed:
+                client.create_container(group.container_id,
+                                        replica_index=i + 1)
+            else:
+                client.create_container(group.container_id)
+        except StorageError as e:
+            if e.code != "CONTAINER_EXISTS":
+                failed.append(dn_id)
+                cause = e
+        except (KeyError, OSError) as e:
+            failed.append(dn_id)
+            cause = e
+    if failed:
+        raise StripeWriteError(failed, cause)
+
+
 def cell_lengths(group_length: int, stripe: int, k: int, cell: int) -> list[int]:
     """User-data length of each of the k data cells of stripe `stripe`."""
     start = stripe * k * cell
@@ -335,15 +362,18 @@ class ECKeyWriter:
         return self._group
 
     def _create_containers(self, group: BlockGroup) -> None:
-        """Create the replica-indexed container on each node if absent (the
-        reference datanode auto-creates on first write; explicit here)."""
-        for i, dn_id in enumerate(group.pipeline.nodes):
-            client = self.clients.get(dn_id)
-            try:
-                client.create_container(group.container_id, replica_index=i + 1)
-            except StorageError as e:
-                if e.code != "CONTAINER_EXISTS":
-                    raise
+        """Create the replica-indexed container on each node if absent;
+        unreachable members surface as StripeWriteError so the stripe
+        retry path excludes them and reallocates (excludePipelineAnd
+        FailedDN semantics from the first touch of the pipeline)."""
+        try:
+            create_group_containers(self.clients, group,
+                                    replica_indexed=True)
+        except StripeWriteError:
+            # discard the group before any data hits it: the retry path
+            # must allocate afresh without the failed members
+            self._group = None
+            raise
 
     def _finalize_group(self) -> None:
         if self._group is not None and self._group.length > 0:

@@ -21,7 +21,7 @@ import time
 from typing import Optional
 
 from ozone_tpu.client.dn_client import DatanodeClientFactory
-from ozone_tpu.client.ec_writer import BlockGroup
+from ozone_tpu.client.ec_writer import BlockGroup, StripeWriteError
 from ozone_tpu.client.replicated import ReplicatedKeyWriter
 from ozone_tpu.net.ratis_service import RatisClientFactory
 from ozone_tpu.scm.pipeline import Pipeline
@@ -153,18 +153,25 @@ class RatisKeyWriter(ReplicatedKeyWriter):
         return ok
 
     def _create_containers(self, group: BlockGroup) -> None:
-        x = self._xceiver(group)
-        out = x.submit({
-            "verb": "create_container",
-            "container_id": group.container_id,
-        })
-        # the data phase writes chunks straight to every member: the
-        # container must exist everywhere before bytes arrive, so wait
-        # for the create to apply on all replicas (short timeout — a dead
-        # member degrades this to majority and simply fails its data
-        # fan-out later, which the quorum data policy absorbs)
-        x.watch_for_commit(int(out.get("index", 0)),
-                           timeout=min(2.0, self.watch_timeout_s))
+        try:
+            x = self._xceiver(group)
+            out = x.submit({
+                "verb": "create_container",
+                "container_id": group.container_id,
+            })
+            # the data phase writes chunks straight to every member: the
+            # container must exist everywhere before bytes arrive, so wait
+            # for the create to apply on all replicas (short timeout — a
+            # dead member degrades this to majority and simply fails its
+            # data fan-out later, which the quorum data policy absorbs)
+            x.watch_for_commit(int(out.get("index", 0)),
+                               timeout=min(2.0, self.watch_timeout_s))
+        except (StorageError, ConnectionError, KeyError, OSError) as e:
+            # the whole pipeline is unreachable through its ring (e.g. a
+            # client-side partition): surface the base-class contract so
+            # the retry path excludes these members and reallocates
+            self._group = None
+            raise StripeWriteError(list(group.pipeline.nodes), e)
 
     def _commit_chunk(self, group: BlockGroup, info: ChunkInfo) -> None:
         x = self._xceiver(group)

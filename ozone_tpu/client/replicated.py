@@ -19,7 +19,11 @@ from typing import Callable, Optional
 import numpy as np
 
 from ozone_tpu.client.dn_client import DatanodeClientFactory
-from ozone_tpu.client.ec_writer import BlockGroup
+from ozone_tpu.client.ec_writer import (
+    BlockGroup,
+    StripeWriteError,
+    create_group_containers,
+)
 from ozone_tpu.storage.ids import BlockData, ChunkInfo, StorageError
 from ozone_tpu.utils.checksum import Checksum, ChecksumType
 
@@ -83,13 +87,15 @@ class ReplicatedKeyWriter:
 
     def _create_containers(self, group: BlockGroup) -> None:
         """Open the block's container on every member (overridden by the
-        Raft path to order the create through the pipeline leader)."""
-        for dn_id in group.pipeline.nodes:
-            try:
-                self.clients.get(dn_id).create_container(group.container_id)
-            except StorageError as e:
-                if e.code != "CONTAINER_EXISTS":
-                    raise
+        Raft path to order the create through the pipeline leader). An
+        unreachable member raises StripeWriteError so the chunk retry
+        path excludes it instead of failing the whole write."""
+        try:
+            create_group_containers(self.clients, group,
+                                    replica_indexed=False)
+        except StripeWriteError:
+            self._group = None  # retry must allocate without the failed
+            raise
 
     def _commit_chunk(self, group: BlockGroup, info: ChunkInfo) -> None:
         """Commit point after the chunk bytes reached every member: plain
@@ -104,10 +110,21 @@ class ReplicatedKeyWriter:
         data = self._buf[: self._buf_fill].copy()
         self._buf_fill = 0
         for attempt in range(self.max_retries + 1):
-            group = self._ensure_group()
-            if group.length + data.size > self.block_size * 1:
-                self._finalize_group()
+            try:
                 group = self._ensure_group()
+                if group.length + data.size > self.block_size * 1:
+                    # rollover allocation rides the same handler: a
+                    # create-time failure here must also exclude+retry
+                    self._finalize_group()
+                    group = self._ensure_group()
+            except StripeWriteError as e:
+                log.warning("group allocation failed on %s: %s",
+                            e.failed_nodes, e.cause)
+                self._excluded.extend(e.failed_nodes)
+                if attempt == self.max_retries:
+                    raise StorageError(
+                        "IO_EXCEPTION", f"write failed: {e.cause}")
+                continue
             info = ChunkInfo(
                 name=f"{group.block_id}_chunk_{len(self._chunks)}",
                 offset=group.length,

@@ -149,3 +149,153 @@ def test_leader_isolation_elects_new_leader_and_heals(tmp_path):
                 d.stop()
             except Exception:
                 pass
+
+
+def test_ec_writes_and_reads_survive_partitioned_datanode(tmp_path):
+    """Datanode-isolation blockade scenario on the datapath: with the
+    client's link to one datanode cut, EC writes exclude it and succeed;
+    reads of keys holding a unit there fall back to degraded (decode)
+    reads. Healing restores direct reads."""
+    import numpy as np
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=4 * 4096,
+                       stale_after_s=1000.0, dead_after_s=2000.0,
+                       background_interval_s=0.5)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.2) for i in range(6)]
+    for d in dns:
+        d.start()
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        b = oz.create_volume("v").create_bucket("b",
+                                                replication="rs-3-2-4096")
+        rng = np.random.default_rng(0)
+        pre = rng.integers(0, 256, 30_000, dtype=np.uint8)
+        b.write_key("pre", pre)
+
+        # cut the client's link to the datanode holding unit 1 of "pre"
+        info = oz.om.lookup_key("v", "b", "pre")
+        victim = info["block_groups"][0]["nodes"][0]
+        partition.block(dns[[d.dn.id for d in dns].index(victim)].address)
+
+        # degraded read: unit 1 is unreachable -> decode from survivors
+        assert np.array_equal(b.read_key("pre"), pre)
+
+        # writes keep flowing: the writer excludes the unreachable node
+        during = rng.integers(0, 256, 25_000, dtype=np.uint8)
+        b.write_key("during", during)
+        assert np.array_equal(b.read_key("during"), during)
+        nodes_used = {
+            n
+            for g in oz.om.lookup_key("v", "b", "during")["block_groups"]
+            for n in g["nodes"]
+        }
+        assert victim not in nodes_used
+
+        # heal: direct reads of the original key work again
+        partition.clear()
+        assert np.array_equal(b.read_key("pre"), pre)
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
+
+
+def test_replicated_writes_survive_partitioned_datanode(tmp_path):
+    """STANDALONE/ONE writes reallocate away from a member whose link is
+    cut at group-creation time (the _GroupCreateError exclusion path)."""
+    import numpy as np
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=4 * 4096,
+                       stale_after_s=1000.0, dead_after_s=2000.0,
+                       background_interval_s=0.5)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.2) for i in range(3)]
+    for d in dns:
+        d.start()
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        b = oz.create_volume("v").create_bucket(
+            "b", replication="STANDALONE/ONE")
+        partition.block(dns[0].address)  # cut one member preemptively
+        rng = np.random.default_rng(1)
+        for i in range(4):  # enough writes to hit the cut node's turn
+            data = rng.integers(0, 256, 6_000, dtype=np.uint8)
+            b.write_key(f"k{i}", data)
+            assert np.array_equal(b.read_key(f"k{i}"), data)
+            nodes = {
+                n
+                for g in oz.om.lookup_key("v", "b", f"k{i}")["block_groups"]
+                for n in g["nodes"]
+            }
+            assert dns[0].dn.id not in nodes
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
+
+
+def test_block_rollover_survives_partitioned_datanode(tmp_path):
+    """A key spanning multiple blocks keeps writing when the rollover
+    allocation lands on a partitioned member (the rollover _ensure_group
+    must ride the same exclude+retry handler)."""
+    import numpy as np
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=2 * 4096,
+                       stale_after_s=1000.0, dead_after_s=2000.0,
+                       background_interval_s=0.5)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.2) for i in range(3)]
+    for d in dns:
+        d.start()
+    try:
+        from ozone_tpu.client.replicated import ReplicatedKeyWriter
+
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        b = oz.create_volume("v").create_bucket(
+            "b", replication="STANDALONE/ONE")
+        partition.block(dns[1].address)
+        data = np.random.default_rng(2).integers(
+            0, 256, 40_000, dtype=np.uint8)
+        om = oz.om
+        session = om.open_key("v", "b", "multi")
+        # small chunks force flushes and block rollovers mid-write
+        writer = ReplicatedKeyWriter(
+            lambda excluded: om.allocate_block(session, excluded),
+            clients, block_size=8192, chunk_size=4096,
+        )
+        writer.write(data)
+        groups_out = writer.close()
+        om.commit_key(session, groups_out, writer.bytes_written)
+        assert np.array_equal(b.read_key("multi"), data)
+        groups = om.lookup_key("v", "b", "multi")["block_groups"]
+        assert len(groups) >= 3  # the rollover path really ran
+        assert all(dns[1].dn.id not in g["nodes"] for g in groups)
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
