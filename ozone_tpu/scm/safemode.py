@@ -2,8 +2,10 @@
 
 Mirrors server-scm safemode/SCMSafeModeManager.java:84 + exit rules:
 DataNodeSafeModeRule (min registered DN count), ContainerSafeModeRule
-(fraction of containers with at least one reported replica), and a
-healthy-pipeline rule.
+(fraction of containers with at least one reported replica),
+HealthyPipelineSafeModeRule (fraction of recovered OPEN pipelines with
+every member re-registered HEALTHY), and OneReplicaPipelineSafeModeRule
+(fraction of recovered pipelines with at least one member back).
 """
 
 from __future__ import annotations
@@ -23,6 +25,10 @@ class SafeModeError(Exception):
 class SafeModeConfig:
     min_datanodes: int = 1
     container_replica_fraction: float = 0.99
+    # reference defaults: hdds.scm.safemode.healthy.pipeline.pct 0.10,
+    # hdds.scm.safemode.atleast.one.node.reported.pipeline.pct 0.90
+    healthy_pipeline_fraction: float = 0.10
+    one_replica_pipeline_fraction: float = 0.90
 
 
 class SafeModeManager:
@@ -36,10 +42,41 @@ class SafeModeManager:
         self.containers = containers
         self.config = config
         self._forced: bool | None = None  # admin override
+        # safemode exit is ONE-WAY (reference SCMSafeModeManager): once
+        # the rules pass, later node flaps must not re-gate allocation
+        self._exited = False
+        # the pipeline rules gate on pipelines RECOVERED from the store
+        # at startup (the reference's pre-existing pipeline set) — new
+        # pipelines created after startup never hold up safemode exit,
+        # and pipelines closed/removed since drop out of the rule set
+        self._initial_pipeline_ids = {
+            p.id for p in containers.pipelines()
+        }
 
     def force(self, in_safemode: bool | None) -> None:
         """Admin override ('ozone admin safemode enter/exit' analog)."""
         self._forced = in_safemode
+
+    def _pipeline_counts(self) -> tuple[int, int, int]:
+        """(total, fully-healthy, with-at-least-one-member) over the
+        startup-recovered pipelines that still exist (a scrubbed/closed
+        pipeline must not hold safemode forever)."""
+        from ozone_tpu.scm.node_manager import NodeState
+
+        total = healthy = one = 0
+        for p in self.containers.pipelines():
+            if p.id not in self._initial_pipeline_ids:
+                continue
+            total += 1
+            states = []
+            for dn_id in p.nodes:
+                n = self.nodes.get(dn_id)
+                states.append(n.state if n is not None else None)
+            if states and all(st is NodeState.HEALTHY for st in states):
+                healthy += 1
+            if any(st is not None for st in states):
+                one += 1
+        return total, healthy, one
 
     def status(self) -> dict:
         relevant = [
@@ -48,16 +85,22 @@ class SafeModeManager:
             if c.state in (ContainerState.CLOSED, ContainerState.QUASI_CLOSED)
         ]
         with_replica = sum(1 for c in relevant if c.replicas)
+        total_p, healthy_p, one_p = self._pipeline_counts()
         return {
             "datanodes": self.nodes.node_count(),
             "datanodes_required": self.config.min_datanodes,
             "containers_with_replica": with_replica,
             "containers_total": len(relevant),
+            "pipelines_total": total_p,
+            "pipelines_healthy": healthy_p,
+            "pipelines_with_member": one_p,
         }
 
     def in_safemode(self) -> bool:
         if self._forced is not None:
             return self._forced
+        if self._exited:
+            return False
         s = self.status()
         if s["datanodes"] < s["datanodes_required"]:
             return True
@@ -65,6 +108,14 @@ class SafeModeManager:
             frac = s["containers_with_replica"] / s["containers_total"]
             if frac < self.config.container_replica_fraction:
                 return True
+        if s["pipelines_total"]:
+            if (s["pipelines_healthy"] / s["pipelines_total"]
+                    < self.config.healthy_pipeline_fraction):
+                return True
+            if (s["pipelines_with_member"] / s["pipelines_total"]
+                    < self.config.one_replica_pipeline_fraction):
+                return True
+        self._exited = True  # rules passed: exit is permanent
         return False
 
     def check_allocation_allowed(self) -> None:

@@ -211,7 +211,7 @@ def test_ec_writes_and_reads_survive_partitioned_datanode(tmp_path):
 
 def test_replicated_writes_survive_partitioned_datanode(tmp_path):
     """STANDALONE/ONE writes reallocate away from a member whose link is
-    cut at group-creation time (the _GroupCreateError exclusion path)."""
+    cut at group-creation time (the StripeWriteError exclusion path)."""
     import numpy as np
 
     from ozone_tpu.client.dn_client import DatanodeClientFactory

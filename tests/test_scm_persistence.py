@@ -106,3 +106,64 @@ def test_daemon_restart_keeps_cluster_readable(tmp_path):
         for d in dns:
             d.stop()
         meta2.stop()
+
+
+def test_pipeline_safemode_rules_gate_until_members_return(tmp_path):
+    """HealthyPipelineSafeModeRule analog: after a restart, recovered
+    pipelines hold safemode until their members re-register; a single
+    returning member satisfies the one-replica rule but not the
+    healthy-pipeline rule."""
+    db = tmp_path / "scm.db"
+    scm = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                  dead_after_s=2e6)
+    for i in range(3):
+        scm.register_datanode(f"dn{i}")
+    scm.allocate_block(ReplicationConfig.ratis(3), 500)
+    scm.stop()
+
+    scm2 = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                   dead_after_s=2e6)
+    scm2.register_datanode("dn0")
+    st = scm2.safemode.status()
+    assert st["pipelines_total"] >= 1
+    # one member back: one-replica rule ok, healthy-pipeline rule not
+    assert scm2.safemode.in_safemode()
+    scm2.register_datanode("dn1")
+    scm2.register_datanode("dn2")
+    assert not scm2.safemode.in_safemode()
+    scm2.stop()
+
+
+def test_safemode_exit_is_one_way_and_prunes_dead_pipelines(tmp_path):
+    """Once the rules pass, a later member flap must not re-enter
+    safemode; and a recovered pipeline that gets removed drops out of the
+    rule denominators instead of gating forever."""
+    db = tmp_path / "scm.db"
+    scm = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                  dead_after_s=2e6)
+    for i in range(3):
+        scm.register_datanode(f"dn{i}")
+    scm.allocate_block(ReplicationConfig.ratis(3), 500)
+    scm.stop()
+
+    scm2 = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                   dead_after_s=2e6)
+    for i in range(3):
+        scm2.register_datanode(f"dn{i}")
+    assert not scm2.safemode.in_safemode()
+    # flap: a member goes stale — exit already latched, no re-entry
+    from ozone_tpu.scm.node_manager import NodeState
+
+    scm2.nodes.get("dn0").state = NodeState.STALE
+    assert not scm2.safemode.in_safemode()
+    scm2.stop()
+
+    # a never-returning pipeline that gets REMOVED stops gating
+    scm3 = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                   dead_after_s=2e6)
+    scm3.register_datanode("dnX")  # min-DN satisfied, no members return
+    assert scm3.safemode.in_safemode()
+    for p in list(scm3.containers.pipelines()):
+        scm3.containers._pipelines.pop(p.id)
+    assert not scm3.safemode.in_safemode()
+    scm3.stop()
