@@ -13,7 +13,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 
 from ozone_tpu.scm.container_manager import ContainerManager
-from ozone_tpu.scm.node_manager import NodeManager
+from ozone_tpu.scm.node_manager import NodeManager, NodeState
+from ozone_tpu.scm.pipeline import PipelineState
 from ozone_tpu.storage.ids import ContainerState
 
 
@@ -49,8 +50,14 @@ class SafeModeManager:
         # at startup (the reference's pre-existing pipeline set) — new
         # pipelines created after startup never hold up safemode exit,
         # and pipelines closed/removed since drop out of the rule set
+        # only pipelines still carrying writes matter: restart
+        # resurrects a pipeline row per container regardless of state,
+        # so gate on pipelines attached to an OPEN container (closed
+        # containers are the container rule's job)
         self._initial_pipeline_ids = {
-            p.id for p in containers.pipelines()
+            c.pipeline.id
+            for c in containers.containers()
+            if c.state in (ContainerState.OPEN, ContainerState.CLOSING)
         }
 
     def force(self, in_safemode: bool | None) -> None:
@@ -61,11 +68,13 @@ class SafeModeManager:
         """(total, fully-healthy, with-at-least-one-member) over the
         startup-recovered pipelines that still exist (a scrubbed/closed
         pipeline must not hold safemode forever)."""
-        from ozone_tpu.scm.node_manager import NodeState
-
         total = healthy = one = 0
         for p in self.containers.pipelines():
-            if p.id not in self._initial_pipeline_ids:
+            if (p.id not in self._initial_pipeline_ids
+                    or p.state is not PipelineState.OPEN):
+                # a pipeline closed since startup (dead member, scrub)
+                # stops gating: its data's safety is the container and
+                # replication-manager rules' concern
                 continue
             total += 1
             states = []

@@ -45,12 +45,13 @@ def live_cluster(tmp_path_factory):
     port = _free_port()
     env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
     procs = []
-    meta = subprocess.Popen(
-        [sys.executable, "-m", "ozone_tpu.tools", "scm-om",
-         "--db", str(tmp / "om.db"), "--port", str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        cwd=str(REPO), env=env,
-    )
+    with open(tmp / "meta.log", "w") as meta_log:
+        meta = subprocess.Popen(
+            [sys.executable, "-m", "ozone_tpu.tools", "scm-om",
+             "--db", str(tmp / "om.db"), "--port", str(port)],
+            stdout=meta_log, stderr=subprocess.STDOUT, text=True,
+            cwd=str(REPO), env=env,
+        )  # the child holds its own duplicated descriptor
     procs.append(meta)
     om = f"127.0.0.1:{port}"
     # wait for the metadata server
@@ -130,6 +131,77 @@ def test_smoke_freon_ockg(live_cluster):
                timeout=120).stdout
     rep = json.loads(out)
     assert rep["ops"] == 10 and rep["failures"] == 0
+
+
+def test_smoke_data_lifecycle_verbs(live_cluster):
+    """The session's lifecycle surface end-to-end through the CLI:
+    quota, snapshots (+.snapshot reads), composite checksum, bucket
+    links, hsync freon, audit parser (robot ec/ + admincli parity)."""
+    om, tmp = live_cluster
+    _cli(["sh", "volume", "create", "/lc", "--om", om])
+    _cli(["sh", "bucket", "create", "/lc/b", "--om", om,
+          "--replication", "rs-3-2-4096"])
+    payload = bytes(np.random.default_rng(7).integers(0, 256, 30_000,
+                                                      dtype=np.uint8))
+    src = tmp / "lc.bin"
+    src.write_bytes(payload)
+
+    # quota: set, exceed, inspect
+    _cli(["sh", "bucket", "setquota", "/lc/b", "--om", om,
+          "--quota", "40KB"])
+    _cli(["sh", "key", "put", "/lc/b/doc", str(src), "--om", om])
+    over = _cli(["sh", "key", "put", "/lc/b/doc2", str(src), "--om", om],
+                check=False)
+    assert over.returncode != 0 and "QUOTA_EXCEEDED" in over.stderr
+    info = json.loads(
+        _cli(["sh", "bucket", "info", "/lc/b", "--om", om]).stdout)
+    assert info["used_bytes"] == 30_000
+
+    # composite checksum equals a local CRC32C of the payload
+    cs = json.loads(
+        _cli(["sh", "key", "checksum", "/lc/b/doc", "--om", om]).stdout)
+    from ozone_tpu.utils.checksum import crc32c
+
+    assert int(cs["checksum"], 16) == crc32c(
+        np.frombuffer(payload, np.uint8))
+
+    # snapshot + .snapshot read + diff
+    _cli(["sh", "snapshot", "create", "/lc/b", "--om", om,
+          "--name", "s1"])
+    _cli(["sh", "key", "delete", "/lc/b/doc", "--om", om])
+    diff = json.loads(_cli(["sh", "snapshot", "diff", "/lc/b", "--om",
+                            om, "--name", "s1"]).stdout)
+    assert diff["deleted"] == ["doc"]
+    snap_out = tmp / "snap.bin"
+    _cli(["sh", "key", "get", "/lc/b/.snapshot/s1/doc", str(snap_out),
+          "--om", om])
+    assert snap_out.read_bytes() == payload
+    _cli(["sh", "snapshot", "delete", "/lc/b", "--om", om,
+          "--name", "s1"])
+
+    # bucket link: write through the alias, read from the source
+    _cli(["sh", "volume", "create", "/lk", "--om", om])
+    _cli(["sh", "bucket", "link", "/lc/b", "--to", "/lk/alias",
+          "--om", om])
+    _cli(["sh", "bucket", "setquota", "/lc/b", "--om", om,
+          "--quota", "clear"])
+    _cli(["sh", "key", "put", "/lk/alias/via-link", str(src),
+          "--om", om])
+    got = tmp / "via.bin"
+    _cli(["sh", "key", "get", "/lc/b/via-link", str(got), "--om", om])
+    assert got.read_bytes() == payload
+
+    # hsync generator (RATIS replication)
+    rep = json.loads(_cli(["freon", "hsg", "-n", "4", "-s", "4096",
+                           "--om", om], timeout=120).stdout)
+    assert rep["failures"] == 0
+
+    # audit parser over the REAL daemon log: this suite's own verbs
+    # must appear in the aggregation
+    top = json.loads(
+        _cli(["audit", "top", str(tmp / "meta.log")]).stdout)
+    actions = {row["action"] for row in top}
+    assert {"CreateVolume", "CommitKey", "CreateSnapshot"} <= actions
 
 
 def test_ha_cluster_subprocesses(tmp_path):

@@ -167,3 +167,28 @@ def test_safemode_exit_is_one_way_and_prunes_dead_pipelines(tmp_path):
         scm3.containers._pipelines.pop(p.id)
     assert not scm3.safemode.in_safemode()
     scm3.stop()
+
+
+def test_dead_member_closes_pipeline_and_releases_safemode(tmp_path):
+    """A recovered pipeline whose member dies (pipeline CLOSED via the
+    dead-node path, not removed) must stop gating safemode."""
+    from ozone_tpu.scm.pipeline import PipelineState
+
+    db = tmp_path / "scm.db"
+    scm = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                  dead_after_s=2e6)
+    for i in range(3):
+        scm.register_datanode(f"dn{i}")
+    scm.allocate_block(ReplicationConfig.ratis(3), 500)
+    scm.stop()
+
+    scm2 = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                   dead_after_s=2e6)
+    scm2.register_datanode("dnX")
+    assert scm2.safemode.in_safemode()
+    # the never-returning members' pipeline gets CLOSED (dead-node path
+    # marks, does not pop)
+    for p in scm2.containers.pipelines():
+        p.state = PipelineState.CLOSED
+    assert not scm2.safemode.in_safemode()
+    scm2.stop()
