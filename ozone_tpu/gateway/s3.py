@@ -339,6 +339,26 @@ class S3Gateway:
         if "acl" in q:
             self._bucket_acl_op(h, method, bucket)
             return
+        if method == "GET" and "location" in q:
+            # SDK handshake endpoints (boto3 probes these): one region
+            om.bucket_info(self._vol, bucket)  # 404 on missing bucket
+            root = ET.Element("LocationConstraint", xmlns=_NS)
+            root.text = "us-east-1"
+            h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+            return
+        if method == "PUT" and "versioning" in q:
+            # not wired to object versions; failing loudly beats the
+            # silent 200 the create-bucket branch would return
+            h._reply(*_err("NotImplemented",
+                           "bucket versioning is not supported", 501))
+            return
+        if method == "GET" and "versioning" in q:
+            info = om.bucket_info(self._vol, bucket)
+            root = ET.Element("VersioningConfiguration", xmlns=_NS)
+            if info.get("versioning"):
+                ET.SubElement(root, "Status").text = "Enabled"
+            h._reply(200, _xml(root), {"Content-Type": "application/xml"})
+            return
         if method == "PUT":
             try:
                 om.create_bucket(self._vol, bucket, self.replication)

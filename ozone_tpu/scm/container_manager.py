@@ -122,11 +122,25 @@ class ContainerManager:
         state = self._db.load()
         for c in state["containers"]:
             repl = ReplicationConfig.parse(c["replication"])
-            pipe = self._pipeline_from_row(c)
-            self._pipelines[pipe.id] = pipe
+            cstate = ContainerState(c["state"])
+            pipe = self._pipelines.get(
+                int(c["pipeline_id"])
+                if c.get("pipeline_id") is not None else -1
+            )
+            if pipe is None:
+                pipe = self._pipeline_from_row(c)
+                # pipeline rows aren't persisted standalone: resurrect a
+                # retired pipeline as CLOSED until some attached
+                # container proves it still carries writes — otherwise
+                # admin/recon views and datanode join-pipeline commands
+                # would revive raft groups of retired pipelines
+                pipe.state = PipelineState.CLOSED
+                self._pipelines[pipe.id] = pipe
+            if cstate in (ContainerState.OPEN, ContainerState.CLOSING):
+                pipe.state = PipelineState.OPEN
             info = ContainerInfo(
                 c["id"], repl, pipe,
-                state=ContainerState(c["state"]),
+                state=cstate,
                 used_bytes=int(c["used_bytes"]),
             )
             self._containers[info.id] = info

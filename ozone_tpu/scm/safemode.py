@@ -50,14 +50,13 @@ class SafeModeManager:
         # at startup (the reference's pre-existing pipeline set) — new
         # pipelines created after startup never hold up safemode exit,
         # and pipelines closed/removed since drop out of the rule set
-        # only pipelines still carrying writes matter: restart
-        # resurrects a pipeline row per container regardless of state,
-        # so gate on pipelines attached to an OPEN container (closed
-        # containers are the container rule's job)
+        # only pipelines still carrying writes matter: recovery marks
+        # retired pipelines CLOSED, so the live set is simply the OPEN
+        # ones at startup
         self._initial_pipeline_ids = {
-            c.pipeline.id
-            for c in containers.containers()
-            if c.state in (ContainerState.OPEN, ContainerState.CLOSING)
+            p.id
+            for p in containers.pipelines()
+            if p.state is PipelineState.OPEN
         }
 
     def force(self, in_safemode: bool | None) -> None:

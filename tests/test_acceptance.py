@@ -69,7 +69,7 @@ def live_cluster(tmp_path_factory):
         p = subprocess.Popen(
             [sys.executable, "-m", "ozone_tpu.tools", "datanode",
              "--root", str(tmp / f"dn{i}"), "--scm", om, "--id", f"dn{i}"],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, text=True,
             cwd=str(REPO), env=env,
         )
         procs.append(p)
@@ -224,7 +224,7 @@ def test_ha_cluster_subprocesses(tmp_path):
              "--db", str(tmp_path / mid / "om.db"),
              "--port", peers[mid].rsplit(":", 1)[1],
              "--ha-id", mid, *peer_flags],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT, text=True,
             cwd=str(REPO), env=env,
         )
 
@@ -248,7 +248,7 @@ def test_ha_cluster_subprocesses(tmp_path):
                 [sys.executable, "-m", "ozone_tpu.tools", "datanode",
                  "--root", str(tmp_path / f"dn{i}"), "--scm", oms,
                  "--id", f"dn{i}"],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
                 text=True, cwd=str(REPO), env=env,
             )
             dn_procs.append(p)

@@ -341,3 +341,23 @@ def test_s3_mpu_metadata_and_suffix_range(s3):
     assert r.status == 206
     assert r.read() == payload[-100:]
     assert r.headers["Content-Range"] == f"bytes 8900-8999/9000"
+
+
+def test_s3_sdk_handshake_endpoints(s3):
+    _req(s3, "PUT", "/hsb")
+    r = _req(s3, "GET", "/hsb?location")
+    assert b"LocationConstraint" in r.read()
+    r = _req(s3, "GET", "/hsb?versioning")
+    body = r.read()
+    assert b"VersioningConfiguration" in body and b"Enabled" not in body
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "GET", "/nope-bucket?location")
+    assert ei.value.code == 404
+
+
+def test_s3_put_versioning_rejected_loudly(s3):
+    _req(s3, "PUT", "/vvb")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "PUT", "/vvb?versioning",
+             data=b"<VersioningConfiguration/>")
+    assert ei.value.code == 501

@@ -192,3 +192,29 @@ def test_dead_member_closes_pipeline_and_releases_safemode(tmp_path):
         p.state = PipelineState.CLOSED
     assert not scm2.safemode.in_safemode()
     scm2.stop()
+
+
+def test_recovery_marks_retired_pipelines_closed(tmp_path):
+    """Pipelines attached only to CLOSED containers come back CLOSED —
+    admin/recon views and datanode join commands must not revive retired
+    raft groups after a restart."""
+    from ozone_tpu.scm.pipeline import PipelineState
+
+    db = tmp_path / "scm.db"
+    scm = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                  dead_after_s=2e6)
+    for i in range(3):
+        scm.register_datanode(f"dn{i}")
+    g = scm.allocate_block(ReplicationConfig.ratis(3), 500)
+    scm.containers.mark_closed(g.container_id)
+    g2 = scm.allocate_block(ReplicationConfig.ratis(3), 500)  # live one
+    scm.stop()
+
+    scm2 = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                   dead_after_s=2e6)
+    states = {p.id: p.state for p in scm2.containers.pipelines()}
+    closed_pid = scm2.containers.get(g.container_id).pipeline.id
+    live_pid = scm2.containers.get(g2.container_id).pipeline.id
+    assert states[closed_pid] is PipelineState.CLOSED
+    assert states[live_pid] is PipelineState.OPEN
+    scm2.stop()
