@@ -347,6 +347,7 @@ class S3Gateway:
             h._reply(200, _xml(root), {"Content-Type": "application/xml"})
             return
         if method == "PUT" and "versioning" in q:
+            om.bucket_info(self._vol, bucket)  # NoSuchBucket -> 404
             # not wired to object versions; failing loudly beats the
             # silent 200 the create-bucket branch would return
             h._reply(*_err("NotImplemented",

@@ -414,8 +414,12 @@ class ScmOmDaemon:
         self.scm.containers.on_pipeline_closed = _retire_pipeline
 
         def _reannounce_pipelines_of(dn_id):
+            from ozone_tpu.scm.pipeline import PipelineState
+
             for p in self.scm.containers.pipelines():
-                if dn_id in p.nodes:
+                # a retired (CLOSED) pipeline must never be revived on a
+                # datanode's re-registration
+                if dn_id in p.nodes and p.state is PipelineState.OPEN:
                     _announce_pipeline(p)
 
         self.scm_service.on_register = _reannounce_pipelines_of

@@ -172,6 +172,7 @@ class ScmGrpcService:
         "decommission", "recommission", "maintenance",
         "balancer-start", "balancer-stop",
         "safemode-enter", "safemode-exit",
+        "close-container",
     })
 
     def _admin_op(self, req: bytes) -> bytes:

@@ -182,6 +182,10 @@ class ContainerManager:
                 self._containers[c.id] = c
             c.state = ContainerState(row["state"])
             c.used_bytes = int(row["used_bytes"])
+            # keep pipeline liveness consistent on every recovery path
+            # (WAL replay, follower apply, snapshot install): a pipeline
+            # is live iff some attached container still takes writes
+            self._refresh_pipeline_state(c.pipeline)
             pool = self._writable.setdefault(str(c.replication), [])
             if c.state is ContainerState.OPEN:
                 if c.id not in pool:
@@ -194,6 +198,15 @@ class ContainerManager:
                 self._db.save_container(
                     row, counters=(self._next_cid, self._next_lid)
                 )
+
+    def _refresh_pipeline_state(self, pipe) -> None:
+        live = any(
+            cc.pipeline.id == pipe.id
+            and cc.state in (ContainerState.OPEN, ContainerState.CLOSING)
+            for cc in self._containers.values()
+        )
+        pipe.state = (PipelineState.OPEN if live
+                      else PipelineState.CLOSED)
 
     def snapshot_state(self) -> dict:
         """Full durable-state dump for follower bootstrap

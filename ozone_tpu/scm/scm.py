@@ -174,6 +174,24 @@ class StorageContainerManager:
             else:
                 self.decommission_monitor.start_maintenance(target)
             return {"node": target, "op_state": node.op_state.value}
+        if op == "close-container":
+            try:
+                cid = int(target)
+            except (TypeError, ValueError):
+                raise StorageError("INVALID",
+                                   f"container id must be numeric: "
+                                   f"{target!r}")
+            c = self.containers.get_or_none(cid)
+            if c is None:
+                raise StorageError("CONTAINER_NOT_FOUND",
+                                   f"unknown container {target!r}")
+            from ozone_tpu.storage.ids import ContainerState
+
+            if c.state is ContainerState.OPEN:
+                # the normal close flow: CLOSING + close commands to the
+                # replicas; convergence marks it CLOSED
+                self.containers.finalize_container(c.id)
+            return {"container": c.id, "state": c.state.value}
         if op == "balancer-start":
             self.balancer_enabled = True
         elif op == "balancer-stop":

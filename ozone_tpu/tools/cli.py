@@ -309,7 +309,15 @@ def cmd_admin(args) -> int:
     elif subject == "pipeline":
         _emit(scm.admin("pipelines"))
     elif subject == "container":
-        _emit(scm.list_containers())
+        if verb == "close":
+            if not target:
+                return usage("container close requires a container id")
+            _emit(scm.admin("close-container", target))
+        elif verb in (None, "list"):
+            _emit(scm.list_containers())
+        else:
+            return usage(f"unknown container verb {verb!r} "
+                         "(expected list|close <id>)")
     elif subject == "balancer":
         if verb not in (None, "status", "start", "stop"):
             return usage(f"unknown balancer verb {verb!r} "

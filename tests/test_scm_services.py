@@ -171,3 +171,29 @@ def test_decommission_drain_flow():
     c.replicas[reps[0].target] = ContainerReplica(reps[0].target, "CLOSED", 1)
     assert mon.run_once() == [victim]
     assert nodes.get(victim).op_state is NodeOperationalState.DECOMMISSIONED
+
+
+def test_admin_close_container_op(tmp_path):
+    """ozone admin container close analog: the admin op drives the
+    normal CLOSING flow and is idempotent on non-OPEN containers."""
+    from ozone_tpu.scm.pipeline import ReplicationConfig
+    from ozone_tpu.scm.scm import StorageContainerManager
+    from ozone_tpu.storage.ids import ContainerState
+
+    scm = StorageContainerManager(db_path=tmp_path / "scm.db",
+                                  stale_after_s=1e6, dead_after_s=2e6)
+    for i in range(3):
+        scm.register_datanode(f"dn{i}")
+    g = scm.allocate_block(ReplicationConfig.ratis(3), 500)
+    out = scm.apply_admin_op("close-container", str(g.container_id))
+    assert out["state"] in ("CLOSING", "CLOSED")
+    assert scm.containers.get(g.container_id).state in (
+        ContainerState.CLOSING, ContainerState.CLOSED)
+    # idempotent second call reports current state
+    out2 = scm.apply_admin_op("close-container", str(g.container_id))
+    assert out2["container"] == g.container_id
+    import pytest as _p
+
+    with _p.raises(Exception):
+        scm.apply_admin_op("close-container", "999999")
+    scm.stop()
