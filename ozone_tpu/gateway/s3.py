@@ -393,44 +393,58 @@ class S3Gateway:
         except ValueError:
             h._reply(*_err("InvalidArgument", "bad max-keys", 400))
             return
-        after = (q.get("continuation-token", [""])[0]
-                 or q.get("start-after", [""])[0])
-        keys = sorted(om.list_keys(self._vol, bucket, prefix),
-                      key=lambda k: k["name"])
-        if after:
-            # binary-search to the resume point: pagination stays
-            # O(page + log n) per request instead of rescanning from the
-            # first key every page
-            import bisect
-
-            names = [k["name"] for k in keys]
-            keys = keys[bisect.bisect_right(names, after):]
+        token = q.get("continuation-token", [""])[0]
+        after = token or q.get("start-after", [""])[0]
         contents: list[dict] = []
         common: list[str] = []
         truncated = False
         next_token = ""
-        if max_keys == 0:
-            keys = []  # AWS: MaxKeys=0 returns empty, not truncated
-        for k in keys:
-            name = k["name"]
-            if delim:
-                rest = name[len(prefix):]
-                cut = rest.find(delim)
-                if cut >= 0:  # group under the rolled-up prefix
-                    cp = prefix + rest[: cut + len(delim)]
-                    if after and cp <= after:
-                        continue  # whole group already served last page
-                    if common and common[-1] == cp:
+        # FSO listing walks the whole directory tree per om.list_keys
+        # call, so fetch once and slice; OBS pages with bounded store
+        # scans — fetch windows until the entity budget fills or the
+        # listing runs dry (a large rolled-up group is skipped
+        # server-side inside THIS request, not bounced to the client)
+        fso = False
+        try:
+            fso = (om.bucket_info(self._vol, bucket).get("layout")
+                   == "FILE_SYSTEM_OPTIMIZED")
+        except _OM_ERRORS:
+            pass  # missing bucket surfaces from list_keys below
+        window = 0 if fso else ((max_keys + 1) if max_keys else 0)
+        cursor = after
+        while max_keys:  # AWS: MaxKeys=0 returns empty, not truncated
+            keys = om.list_keys(self._vol, bucket, prefix,
+                                start_after=cursor,
+                                limit=window or None)
+            for k in keys:
+                name = k["name"]
+                if delim:
+                    rest = name[len(prefix):]
+                    cut = rest.find(delim)
+                    if cut >= 0:  # group under the rolled-up prefix
+                        cp = prefix + rest[: cut + len(delim)]
+                        if token and cp <= token:
+                            # our continuation tokens emit entities in
+                            # key order, so cp <= token means the group
+                            # was served on a previous page. A raw
+                            # start-after inside a group must NOT skip
+                            # it (AWS emits the CommonPrefix when keys
+                            # remain beyond start-after).
+                            continue
+                        if common and common[-1] == cp:
+                            continue
+                        if len(contents) + len(common) >= max_keys:
+                            truncated = True
+                            break
+                        common.append(cp)
                         continue
-                    if len(contents) + len(common) >= max_keys:
-                        truncated = True
-                        break
-                    common.append(cp)
-                    continue
-            if len(contents) + len(common) >= max_keys:
-                truncated = True
+                if len(contents) + len(common) >= max_keys:
+                    truncated = True
+                    break
+                contents.append(k)
+            if truncated or not window or len(keys) < window:
                 break
-            contents.append(k)
+            cursor = keys[-1]["name"]
         if truncated:
             next_token = (contents[-1]["name"] if contents else "")
             last_cp = common[-1] if common else ""

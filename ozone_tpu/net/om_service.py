@@ -116,7 +116,8 @@ class OmGrpcService:
                 ),
                 "ListKeys": self._wrap(
                     lambda m: self.om.list_keys(
-                        m["volume"], m["bucket"], m.get("prefix", "")
+                        m["volume"], m["bucket"], m.get("prefix", ""),
+                        m.get("start_after", ""), m.get("limit"),
                     )
                 ),
                 "DeleteKey": self._wrap(
@@ -574,9 +575,11 @@ class GrpcOmClient:
         out = [BlockGroup.from_json(g) for g in info["block_groups"]]
         return out
 
-    def list_keys(self, volume, bucket, prefix=""):
+    def list_keys(self, volume, bucket, prefix="", start_after="",
+                  limit=None):
         return self._call("ListKeys", volume=volume, bucket=bucket,
-                          prefix=prefix)["result"]
+                          prefix=prefix, start_after=start_after,
+                          limit=limit)["result"]
 
     def delete_key(self, volume, bucket, key):
         self._call("DeleteKey", volume=volume, bucket=bucket, key=key)

@@ -128,20 +128,48 @@ class OMMetadataStore:
         self, table: str, prefix: str = ""
     ) -> Iterator[tuple[str, dict]]:
         """Sorted iteration merging cache over sqlite (prefix scan)."""
+        yield from self.iterate_range(table, prefix)
+
+    def iterate_range(
+        self, table: str, prefix: str = "", start_after: str = "",
+        limit: Optional[int] = None,
+    ) -> list[tuple[str, dict]]:
+        """Bounded sorted scan: rows under `prefix` with key >
+        `start_after`, at most `limit` (None = all) — the paged-listing
+        backend. The SQL window over-fetches by the write-back cache's
+        size so cached deletions can never displace a row out of the
+        window; merged rows beyond a truncated SQL horizon are dropped
+        to keep ordering exact."""
         with self._lock:
+            floor = start_after or ""
+            cache_rows = {
+                k: v
+                for k, v in self._cache[table].items()
+                if k.startswith(prefix) and k > floor
+            }
+            sql_limit = -1 if limit is None else limit + len(cache_rows)
+            if floor and floor >= prefix:
+                cond, bound = "k > ?", floor
+            else:
+                cond, bound = "k >= ?", prefix
             db_rows = self._conn.execute(
-                f"SELECT k, v FROM {table} WHERE k >= ? AND k < ? ORDER BY k",
-                (prefix, prefix + "￿"),
+                f"SELECT k, v FROM {table} WHERE {cond} AND k < ? "
+                f"ORDER BY k LIMIT ?",
+                (bound, prefix + "￿", sql_limit),
             ).fetchall()
             merged: dict[str, Optional[dict]] = {
                 k: json.loads(v) for k, v in db_rows
             }
-            for k, v in self._cache[table].items():
-                if k.startswith(prefix):
-                    merged[k] = v
-            for k in sorted(merged):
-                if merged[k] is not None:
-                    yield k, merged[k]
+            merged.update(cache_rows)
+            out = [(k, merged[k]) for k in sorted(merged)
+                   if merged[k] is not None]
+            if (limit is not None and len(db_rows) == sql_limit
+                    and db_rows):
+                horizon = db_rows[-1][0]
+                out = [kv for kv in out if kv[0] <= horizon]
+            if limit is not None:
+                out = out[: max(0, limit)]
+            return out
 
     # ------------------------------------------------------------------ flush
     def flush(self) -> None:

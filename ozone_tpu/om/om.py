@@ -516,19 +516,43 @@ class OzoneManager:
             out.append(BlockGroup.from_json(g))
         return out
 
-    def list_keys(self, volume: str, bucket: str, prefix: str = "") -> list[dict]:
+    def list_keys(self, volume: str, bucket: str, prefix: str = "",
+                  start_after: str = "",
+                  limit: Optional[int] = None) -> list[dict]:
+        """Keys of a bucket, name-ordered, optionally resuming after
+        `start_after` and capped at `limit` (the reference's paged
+        listKeys(startKey, maxKeys)). OBS buckets page with a bounded
+        store scan (no whole-namespace materialization); FSO buckets
+        walk the directory tree, then slice — the tree walk is
+        inherently full-bucket here."""
         from ozone_tpu.om import fso
 
         volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, None, "LIST")
         binfo = self.bucket_info(volume, bucket)  # raises BUCKET_NOT_FOUND
         if self._is_fso(binfo):
-            return [
+            out = [
                 f for f in fso.walk_files(self.store, volume, bucket)
                 if f.get("name", "").startswith(prefix)
             ]
+            out.sort(key=lambda f: f["name"])
+            if start_after:
+                import bisect
+
+                names = [k["name"] for k in out]
+                out = out[bisect.bisect_right(names, start_after):]
+            if limit is not None:
+                out = out[: max(0, int(limit))]
+            return out
         base = bucket_key(volume, bucket) + "/"
-        return [k for _, k in self.store.iterate("keys", base + prefix)]
+        floor = (base + start_after) if start_after else ""
+        return [
+            k
+            for _, k in self.store.iterate_range(
+                "keys", base + prefix, start_after=floor,
+                limit=None if limit is None else max(0, int(limit)),
+            )
+        ]
 
     def delete_key(self, volume: str, bucket: str, key: str) -> None:
         from ozone_tpu.om import fso

@@ -361,3 +361,72 @@ def test_s3_put_versioning_rejected_loudly(s3):
         _req(s3, "PUT", "/vvb?versioning",
              data=b"<VersioningConfiguration/>")
     assert ei.value.code == 501
+
+
+def test_om_list_pagination_pushdown():
+    """om.list_keys honors start_after/limit on both layouts."""
+    import tempfile
+
+    from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+    with tempfile.TemporaryDirectory() as td:
+        c = MiniOzoneCluster(
+            td, num_datanodes=5, block_size=4 * 4096,
+            stale_after_s=1000.0, dead_after_s=2000.0)
+        try:
+            oz = c.client()
+            b = oz.create_volume("pv").create_bucket(
+                "pb", replication="rs-3-2-4096")
+            for i in range(6):
+                b.write_key(f"k{i}", np.zeros(10, np.uint8))
+            page = oz.om.list_keys("pv", "pb", limit=2)
+            assert [k["name"] for k in page] == ["k0", "k1"]
+            page = oz.om.list_keys("pv", "pb", start_after="k1", limit=3)
+            assert [k["name"] for k in page] == ["k2", "k3", "k4"]
+            assert oz.om.list_keys("pv", "pb", start_after="k5") == []
+        finally:
+            c.close()
+
+
+def test_s3_delimiter_rollup_pagination_stays_truncated(s3):
+    """Many keys rolling into ONE CommonPrefix inside a small page must
+    still report IsTruncated with a token — the over-fetch window being
+    exhausted by roll-ups is not the end of the listing."""
+    _req(s3, "PUT", "/rob")
+    for i in range(8):
+        _req(s3, "PUT", f"/rob/dir/{i:02d}", data=b"x")
+    _req(s3, "PUT", "/rob/zz-tail", data=b"x")
+    seen_keys, seen_cps = [], []
+    token = ""
+    for _ in range(12):
+        qs = "/rob?list-type=2&delimiter=/&max-keys=2" + (
+            f"&continuation-token={token}" if token else "")
+        tree = ET.fromstring(_req(s3, "GET", qs).read())
+        seen_keys += [e.text for e in tree.iter()
+                      if e.tag.endswith("}Key")]
+        seen_cps += [e.text for p in tree.iter()
+                     if p.tag.endswith("CommonPrefixes")
+                     for e in p if e.tag.endswith("Prefix")]
+        if next((e.text for e in tree.iter()
+                 if e.tag.endswith("IsTruncated")), "false") != "true":
+            break
+        token = next(e.text for e in tree.iter()
+                     if e.tag.endswith("NextContinuationToken"))
+    assert "zz-tail" in seen_keys          # the tail key is reached
+    assert set(seen_cps) == {"dir/"}       # the rolled-up folder appears
+
+
+def test_s3_raw_start_after_inside_group_emits_common_prefix(s3):
+    """AWS semantics: start-after pointing INSIDE a delimiter group still
+    yields that group's CommonPrefix (only server continuation tokens
+    mark groups as already served)."""
+    _req(s3, "PUT", "/sab")
+    for i in range(4):
+        _req(s3, "PUT", f"/sab/dir/{i:02d}", data=b"x")
+    r = _req(s3, "GET",
+             "/sab?list-type=2&delimiter=/&start-after=dir/01")
+    tree = ET.fromstring(r.read())
+    cps = [e.text for p in tree.iter()
+           if p.tag.endswith("CommonPrefixes")
+           for e in p if e.tag.endswith("Prefix")]
+    assert cps == ["dir/"]
