@@ -832,7 +832,117 @@ def build_parser() -> argparse.ArgumentParser:
     dbg.add_argument("--om", default="127.0.0.1:9860")
     dbg.set_defaults(fn=cmd_debug)
 
+    fsck = sub.add_parser("fsck", help="namespace health walk "
+                                       "(ozone fsck analog)")
+    fsck.add_argument("--om", default="127.0.0.1:9860")
+    fsck.add_argument("--volume", default="")
+    fsck.add_argument("--bucket", default="")
+    fsck.set_defaults(fn=cmd_fsck)
+
     return ap
+
+
+# --------------------------------------------------------------------- fsck
+def cmd_fsck(args) -> int:
+    """Namespace-wide health walk (ozone fsck analog): for every key in
+    scope, check each block group's unit metadata on its datanodes and
+    classify HEALTHY (all units present) / DEGRADED (readable but
+    missing units — EC with >= k survivors, replication with >= 1) /
+    UNRECOVERABLE (too few units to reconstruct)."""
+    from ozone_tpu.scm.pipeline import ReplicationType
+
+    oz = _client(args)
+    if not oz.clients.known_ids():
+        print(f"error: no datanode addresses learned from {args.om} — "
+              "cannot distinguish missing units from an unreachable "
+              "SCM; aborting", file=sys.stderr)
+        return 2
+    vols = ([args.volume] if args.volume
+            else [v["name"] for v in oz.om.list_volumes()])
+    summary = {"HEALTHY": 0, "DEGRADED": 0, "UNRECOVERABLE": 0}
+    issues = []
+    for vol in vols:
+        buckets = ([args.bucket] if args.bucket
+                   else [b["name"] for b in oz.om.list_buckets(vol)])
+        for bucket in buckets:
+            try:
+                binfo = oz.om.bucket_info(vol, bucket)
+                if binfo.get("source"):
+                    continue  # links resolve to their source: walking
+                    # both would double-count every key
+                keys = oz.om.list_keys(vol, bucket)
+            except StorageError as e:
+                issues.append({"bucket": f"/{vol}/{bucket}",
+                               "state": e.code})
+                continue
+            for k in keys:
+                # listed rows carry the full stored record; no per-key
+                # lookup RPC needed
+                groups = oz.om.key_block_groups(k)
+                worst = "HEALTHY"
+                missing: list[dict] = []
+                for g in groups:
+                    repl = g.pipeline.replication
+                    # a short EC key legitimately never wrote its
+                    # trailing data units: only units holding bytes are
+                    # expected, and recovery needs as many survivors as
+                    # there are non-zero data units (absent units are
+                    # known-zero cells)
+                    if repl.type is ReplicationType.EC:
+                        from ozone_tpu.client.ec_writer import (
+                            block_lengths,
+                        )
+
+                        lens = block_lengths(g.length, repl.ec.data_units,
+                                             repl.ec.cell_size)
+                        data_expected = [i for i, ln in enumerate(lens)
+                                         if ln > 0]
+                        expected = data_expected + (
+                            list(range(repl.ec.data_units,
+                                       len(g.pipeline.nodes)))
+                            if g.length else [])
+                        need = len(data_expected)
+                    else:
+                        expected = (list(range(len(g.pipeline.nodes)))
+                                    if g.length else [])
+                        need = 1 if expected else 0
+                    present = 0
+                    for i in expected:
+                        dn_id = g.pipeline.nodes[i]
+                        client = oz.clients.maybe_get(dn_id)
+                        ok = False
+                        if client is not None:
+                            try:
+                                client.get_block(g.block_id)
+                                ok = True
+                            except Exception:
+                                ok = False
+                        if ok:
+                            present += 1
+                        else:
+                            missing.append({
+                                "container_id": g.container_id,
+                                "datanode": dn_id,
+                                "replica_index": i + 1,
+                            })
+                    if present >= len(expected):
+                        state = "HEALTHY"
+                    elif present >= need:
+                        state = "DEGRADED"
+                    else:
+                        state = "UNRECOVERABLE"
+                    order = ["HEALTHY", "DEGRADED", "UNRECOVERABLE"]
+                    if order.index(state) > order.index(worst):
+                        worst = state
+                summary[worst] += 1
+                if worst != "HEALTHY":
+                    issues.append({
+                        "key": f"/{vol}/{bucket}/{k['name']}",
+                        "state": worst,
+                        "missing_units": missing,
+                    })
+    _emit({"keys": summary, "issues": issues})
+    return 1 if summary["UNRECOVERABLE"] else 0
 
 
 # -------------------------------------------------------------------- debug

@@ -137,3 +137,50 @@ def test_link_write_through_remote_om(tmp_path):
         for d in dns:
             d.stop()
         meta.stop()
+
+
+def test_fsck_skips_links_and_reports_dangling(tmp_path):
+    """fsck walks source buckets once (no double-count through links)
+    and reports a dangling link instead of crashing."""
+    import io
+    import json
+    from contextlib import redirect_stdout
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.tools.cli import build_parser
+
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=4 * 4096,
+                       stale_after_s=1000.0, dead_after_s=2000.0,
+                       background_interval_s=0.5)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.2) for i in range(5)]
+    for d in dns:
+        d.start()
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        oz.create_volume("v").create_bucket("src", replication=EC)
+        oz.om.create_bucket_link("v", "src", "v", "alias")
+        oz.om.create_bucket_link("v", "ghost", "v", "dangling")
+        b = oz.get_volume("v").get_bucket("src")
+        b.write_key("k", _data(8_000, 20))
+
+        args = build_parser().parse_args(["fsck", "--om", meta.address])
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = args.fn(args)
+        out = json.loads(buf.getvalue())
+        assert rc == 0
+        assert out["keys"]["HEALTHY"] == 1  # not 2: the link is skipped
+        dangling = [i for i in out["issues"]
+                    if i.get("bucket") == "/v/dangling"]
+        assert dangling and dangling[0]["state"] == "DANGLING_LINK"
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()

@@ -169,3 +169,47 @@ def test_freon_dnbp_and_ralg(cluster, tmp_path):
     rep = freon.ralg(tmp_path / "ralg", n_entries=50, size=256)
     assert rep.failures == 0 and rep.ops == 50
     assert rep.summary()["ops_per_s"] > 0
+
+
+def test_fsck_classifies_key_health(cluster, tmp_path):
+    """fsck walks the namespace and classifies keys HEALTHY/DEGRADED/
+    UNRECOVERABLE from unit presence on the datanodes."""
+    import numpy as np
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.tools.cli import build_parser
+
+    meta, dns = cluster
+    clients = DatanodeClientFactory()
+    oz = OzoneClient(GrpcOmClient(meta.address, clients=clients), clients)
+    oz.create_volume("fv")
+    b = oz.get_volume("fv").create_bucket("fb", replication="rs-3-2-4096")
+    b.write_key("k", np.random.default_rng(0).integers(
+        0, 256, 20_000, dtype=np.uint8))
+
+    import json
+
+    args = build_parser().parse_args(
+        ["fsck", "--om", meta.address, "--volume", "fv"])
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = args.fn(args)
+    out = json.loads(buf.getvalue())
+    assert rc == 0 and out["keys"]["HEALTHY"] == 1
+
+    # kill one unit's datanode -> DEGRADED (EC still has k survivors)
+    info = oz.om.lookup_key("fv", "fb", "k")
+    victim = info["block_groups"][0]["nodes"][0]
+    next(d for d in dns if d.dn.id == victim).stop()
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = args.fn(args)
+    out = json.loads(buf.getvalue())
+    assert rc == 0 and out["keys"]["DEGRADED"] == 1
+    assert out["issues"][0]["state"] == "DEGRADED"
+    assert out["issues"][0]["missing_units"][0]["datanode"] == victim
