@@ -399,18 +399,12 @@ class S3Gateway:
         common: list[str] = []
         truncated = False
         next_token = ""
-        # FSO listing walks the whole directory tree per om.list_keys
-        # call, so fetch once and slice; OBS pages with bounded store
-        # scans — fetch windows until the entity budget fills or the
-        # listing runs dry (a large rolled-up group is skipped
-        # server-side inside THIS request, not bounced to the client)
-        fso = False
-        try:
-            fso = (om.bucket_info(self._vol, bucket).get("layout")
-                   == "FILE_SYSTEM_OPTIMIZED")
-        except _OM_ERRORS:
-            pass  # missing bucket surfaces from list_keys below
-        window = 0 if fso else ((max_keys + 1) if max_keys else 0)
+        # both layouts page server-side now (OBS: bounded store scan;
+        # FSO: pruned path-order tree walk) — fetch windows until the
+        # entity budget fills or the listing runs dry, so a large
+        # rolled-up group is skipped inside THIS request, not bounced
+        # back to the client
+        window = (max_keys + 1) if max_keys else 0
         cursor = after
         while max_keys:  # AWS: MaxKeys=0 returns empty, not truncated
             keys = om.list_keys(self._vol, bucket, prefix,

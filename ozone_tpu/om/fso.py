@@ -550,25 +550,57 @@ def list_status(
                           "/".join(split_path(path)))
 
 
-def walk_files(
-    store: OMMetadataStore, volume: str, bucket: str, path: str = ""
-) -> Iterator[dict]:
-    """Recursive file iterator in path order (for listKeys on FSO
-    buckets). One store scan per directory — ancestors are resolved once
-    at the root, then object ids thread through the recursion."""
-    st = get_status(store, volume, bucket, path)
-    if st["type"] == "FILE":
-        yield st
-        return
+def walk_files_paged(
+    store: OMMetadataStore, volume: str, bucket: str,
+    prefix: str = "", start_after: str = "",
+    limit: Optional[int] = None,
+) -> list[dict]:
+    """Lexicographic path-order file walk with subtree pruning: a
+    directory is descended only if its path range can still contain
+    entries matching `prefix` and beyond `start_after`; the walk stops
+    once `limit` files are collected. This is the paged listKeys backend
+    for FSO buckets — a page costs O(page + touched-directory scans),
+    not a full-tree walk."""
+    out: list[dict] = []
+    if limit is not None and limit <= 0:
+        return out
 
-    def _walk(object_id: str, base: str) -> Iterator[dict]:
-        for entry in _list_children(store, volume, bucket, object_id, base):
-            if entry["type"] == "FILE":
-                yield entry
+    def _full(entry) -> str:
+        return entry["name"]
+
+    def _walk(object_id: str, base: str) -> bool:
+        """Returns True when the limit is reached (stop unwinding)."""
+        entries = _list_children(store, volume, bucket, object_id, base)
+        # lexicographic path order: a dir 'd' expands where 'd/' sorts
+        # among its siblings
+        entries.sort(key=lambda e: _full(e) +
+                     ("/" if e["type"] == "DIRECTORY" else ""))
+        for e in entries:
+            if e["type"] == "FILE":
+                name = _full(e)
+                if prefix and not name.startswith(prefix):
+                    continue
+                if start_after and name <= start_after:
+                    continue
+                out.append(e)
+                if limit is not None and len(out) >= limit:
+                    return True
             else:
-                yield from _walk(entry["object_id"], entry["path"])
+                p = _full(e) + "/"
+                # prune: subtree cannot match the prefix
+                if prefix and not (p.startswith(prefix)
+                                   or prefix.startswith(p)):
+                    continue
+                # prune: every descendant of p sorts before the cursor
+                if (start_after and start_after > p
+                        and not start_after.startswith(p)):
+                    continue
+                if _walk(e["object_id"], e["path"]):
+                    return True
+        return False
 
-    yield from _walk(st["object_id"], "/".join(split_path(path)))
+    _walk(ROOT_ID, "")
+    return out
 
 
 def lookup_file(

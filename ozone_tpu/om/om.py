@@ -522,28 +522,19 @@ class OzoneManager:
         """Keys of a bucket, name-ordered, optionally resuming after
         `start_after` and capped at `limit` (the reference's paged
         listKeys(startKey, maxKeys)). OBS buckets page with a bounded
-        store scan (no whole-namespace materialization); FSO buckets
-        walk the directory tree, then slice — the tree walk is
-        inherently full-bucket here."""
+        store scan; FSO buckets page with a pruned lexicographic tree
+        walk — neither materializes the whole namespace per page."""
         from ozone_tpu.om import fso
 
         volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, None, "LIST")
         binfo = self.bucket_info(volume, bucket)  # raises BUCKET_NOT_FOUND
         if self._is_fso(binfo):
-            out = [
-                f for f in fso.walk_files(self.store, volume, bucket)
-                if f.get("name", "").startswith(prefix)
-            ]
-            out.sort(key=lambda f: f["name"])
-            if start_after:
-                import bisect
-
-                names = [k["name"] for k in out]
-                out = out[bisect.bisect_right(names, start_after):]
-            if limit is not None:
-                out = out[: max(0, int(limit))]
-            return out
+            return fso.walk_files_paged(
+                self.store, volume, bucket, prefix=prefix,
+                start_after=start_after,
+                limit=None if limit is None else max(0, int(limit)),
+            )
         base = bucket_key(volume, bucket) + "/"
         floor = (base + start_after) if start_after else ""
         return [

@@ -230,3 +230,49 @@ def test_obs_bucket_unaffected(cluster):
     assert bytes(ob.read_key("a/b/c")) == b"flat"
     with pytest.raises(OMError):
         cluster.om.create_directory("vol", "obs", "a")
+
+
+def test_walk_files_paged_order_pruning_and_limits(cluster):
+    """Paged FSO walk: lexicographic path order (a dir 'd' expands where
+    'd/' sorts — before sibling file 'd0'), prefix/cursor subtree
+    pruning, and limit stop; pages stitch to the exact full listing."""
+    oz = cluster.client()
+    oz.create_volume("v")
+    oz.om.create_bucket("v", "fso", "rs-3-2-4096",
+                        "FILE_SYSTEM_OPTIMIZED")
+    b = oz.get_volume("v").get_bucket("fso")
+    paths = ["a", "d/x", "d/y/deep", "d0", "m/1", "m/2", "z"]
+    for p in paths:
+        b.write_key(p, np.zeros(10, np.uint8))
+    full = [k["name"] for k in oz.om.list_keys("v", "fso")]
+    assert full == ["a", "d/x", "d/y/deep", "d0", "m/1", "m/2", "z"]
+    # pages stitch exactly
+    got, cursor = [], ""
+    while True:
+        page = oz.om.list_keys("v", "fso", start_after=cursor, limit=3)
+        if not page:
+            break
+        got += [k["name"] for k in page]
+        cursor = page[-1]["name"]
+    assert got == full
+    # prefix pruning only descends matching subtrees
+    assert [k["name"] for k in oz.om.list_keys("v", "fso", prefix="m/")] \
+        == ["m/1", "m/2"]
+    # cursor inside a subtree resumes mid-directory
+    assert [k["name"] for k in
+            oz.om.list_keys("v", "fso", start_after="d/x", limit=2)] \
+        == ["d/y/deep", "d0"]
+
+
+def test_list_keys_limit_zero_is_empty_on_both_layouts(cluster):
+    oz = cluster.client()
+    oz.create_volume("lv")
+    oz.om.create_bucket("lv", "obs", "rs-3-2-4096")
+    oz.om.create_bucket("lv", "fso", "rs-3-2-4096",
+                        "FILE_SYSTEM_OPTIMIZED")
+    oz.get_volume("lv").get_bucket("obs").write_key(
+        "k", np.zeros(10, np.uint8))
+    oz.get_volume("lv").get_bucket("fso").write_key(
+        "k", np.zeros(10, np.uint8))
+    assert oz.om.list_keys("lv", "obs", limit=0) == []
+    assert oz.om.list_keys("lv", "fso", limit=0) == []
