@@ -90,6 +90,16 @@ class DatanodeDaemon:
         self._scrubber = DeviceScrubber()
         self._scan_cursor = 0
         self._scanner: Optional[threading.Thread] = None
+        # layout-version / upgrade finalization (reference
+        # VersionedDatanodeFeatures + finalizeNewLayoutVersion command)
+        from ozone_tpu.utils.upgrade import (
+            LayoutVersionManager,
+            UpgradeFinalizer,
+        )
+
+        self.layout = LayoutVersionManager(Path(root) /
+                                           "layout_version.json")
+        self.finalizer = UpgradeFinalizer(self.layout)
         # persisted operational state (reference persistedOpState): set
         # by SCM commands, echoed back at registration so a restarted
         # SCM relearns an in-progress drain
@@ -236,6 +246,7 @@ class DatanodeDaemon:
         acks, self._pending_acks = self._pending_acks, []
         commands = self.scm.heartbeat(
             self.dn.id, container_report=report, used_bytes=used,
+            layout_version=self.layout.metadata_version,
             deleted_block_acks=acks,
         )
         for cmd in commands:
@@ -291,6 +302,10 @@ class DatanodeDaemon:
             elif isinstance(cmd, dict) and \
                     cmd.get("type") == "close-container":
                 self._close_container(cmd)
+            elif isinstance(cmd, dict) and cmd.get("type") == "finalize":
+                out = self.finalizer.finalize()
+                log.info("%s layout finalize: %s -> v%d", self.dn.id,
+                         out.value, self.layout.metadata_version)
             else:
                 log.debug("%s ignoring command %r", self.dn.id, cmd)
         except Exception:

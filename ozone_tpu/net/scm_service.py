@@ -141,6 +141,7 @@ class ScmGrpcService:
             container_report=m.get("container_report"),
             used_bytes=m.get("used_bytes", 0),
             deleted_block_acks=m.get("deleted_block_acks"),
+            layout_version=m.get("layout_version"),
         )
         return wire.pack(
             {
@@ -172,7 +173,7 @@ class ScmGrpcService:
         "decommission", "recommission", "maintenance",
         "balancer-start", "balancer-stop",
         "safemode-enter", "safemode-exit",
-        "close-container",
+        "close-container", "finalize-upgrade",
     })
 
     def _admin_op(self, req: bytes) -> bytes:
@@ -322,12 +323,14 @@ class GrpcScmClient:
 
     def heartbeat(self, dn_id: str, container_report=None,
                   used_bytes: int = 0,
-                  deleted_block_acks: Optional[list[int]] = None) -> list:
+                  deleted_block_acks: Optional[list[int]] = None,
+                  layout_version: Optional[int] = None) -> list:
         responses = self._broadcast("Heartbeat", {
             "dn_id": dn_id,
             "container_report": container_report,
             "used_bytes": used_bytes,
             "deleted_block_acks": deleted_block_acks or [],
+            "layout_version": layout_version,
         })
         cmds = []
         for m in responses:  # only the leader queues commands

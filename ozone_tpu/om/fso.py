@@ -25,7 +25,7 @@ from __future__ import annotations
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Iterator, Optional
+from typing import Any, Optional
 
 from ozone_tpu.om.metadata import OMMetadataStore, bucket_key
 from ozone_tpu.om.requests import (
@@ -565,39 +565,79 @@ def walk_files_paged(
     if limit is not None and limit <= 0:
         return out
 
-    def _full(entry) -> str:
-        return entry["name"]
+    def _children_window(object_id: str, base: str, floor: str,
+                         include_floor_dir: bool):
+        """One bounded sibling window of a directory, name-ordered with
+        dirs expanding at their path position. `floor` is the sibling
+        name to resume from (exclusive for files; the dir of that name
+        is included when the cursor descends into it)."""
+        kprefix = f"/{volume}/{bucket}/{object_id}/"
+        want = None if limit is None else (limit - len(out) + 1)
+        ents = []
+        if include_floor_dir and floor:
+            bd = store.get("dirs", dir_key(volume, bucket, object_id,
+                                           floor))
+            if bd is not None:
+                full = f"{base}/{floor}" if base else floor
+                ents.append({"type": "DIRECTORY", **bd, "path": full,
+                             "name": full})
+        sa = (kprefix + floor) if floor else ""
+        drained = True
+        for table, kind in (("dirs", "DIRECTORY"), ("files", "FILE")):
+            rows = store.iterate_range(table, kprefix, start_after=sa,
+                                       limit=want)
+            if want is not None and len(rows) >= want:
+                drained = False
+            for _, e in rows:
+                nm = e["name"] if kind == "DIRECTORY" else e["file_name"]
+                full = f"{base}/{nm}" if base else nm
+                ents.append({"type": kind, **e, "path": full,
+                             "name": full})
+        ents.sort(key=lambda e: e["name"] +
+                  ("/" if e["type"] == "DIRECTORY" else ""))
+        return ents, drained
 
     def _walk(object_id: str, base: str) -> bool:
         """Returns True when the limit is reached (stop unwinding)."""
-        entries = _list_children(store, volume, bucket, object_id, base)
-        # lexicographic path order: a dir 'd' expands where 'd/' sorts
-        # among its siblings
-        entries.sort(key=lambda e: _full(e) +
-                     ("/" if e["type"] == "DIRECTORY" else ""))
-        for e in entries:
-            if e["type"] == "FILE":
-                name = _full(e)
-                if prefix and not name.startswith(prefix):
-                    continue
-                if start_after and name <= start_after:
-                    continue
-                out.append(e)
-                if limit is not None and len(out) >= limit:
-                    return True
-            else:
-                p = _full(e) + "/"
-                # prune: subtree cannot match the prefix
-                if prefix and not (p.startswith(prefix)
-                                   or prefix.startswith(p)):
-                    continue
-                # prune: every descendant of p sorts before the cursor
-                if (start_after and start_after > p
-                        and not start_after.startswith(p)):
-                    continue
-                if _walk(e["object_id"], e["path"]):
-                    return True
-        return False
+        # resume floor: the next path segment of the cursor inside this
+        # directory (pushed into the store scan so a page never re-reads
+        # already-served siblings)
+        floor = ""
+        if start_after:
+            if not base:
+                floor = start_after.split("/", 1)[0]
+            elif start_after.startswith(base + "/"):
+                floor = start_after[len(base) + 1:].split("/", 1)[0]
+        include_floor_dir = True
+        while True:
+            ents, drained = _children_window(object_id, base, floor,
+                                             include_floor_dir)
+            include_floor_dir = False
+            for e in ents:
+                floor = max(floor, e["name"].rsplit("/", 1)[-1])
+                if e["type"] == "FILE":
+                    name = e["name"]
+                    if prefix and not name.startswith(prefix):
+                        continue
+                    if start_after and name <= start_after:
+                        continue
+                    out.append(e)
+                    if limit is not None and len(out) >= limit:
+                        return True
+                else:
+                    p = e["name"] + "/"
+                    # prune: subtree cannot match the prefix
+                    if prefix and not (p.startswith(prefix)
+                                       or prefix.startswith(p)):
+                        continue
+                    # prune: every descendant sorts before the cursor
+                    if (start_after and start_after > p
+                            and not start_after.startswith(p)):
+                        continue
+                    if _walk(e["object_id"], e["path"]):
+                        return True
+            if drained or not ents:
+                return False
 
     _walk(ROOT_ID, "")
     return out

@@ -285,6 +285,7 @@ class ReconScmView:
                 "state": n.state.value,
                 "op_state": n.op_state.value,
                 "capacity_bytes": n.capacity_bytes,
+                "layout_version": n.layout_version,
                 "used_bytes": n.used_bytes,
                 "utilization": (
                     n.used_bytes / n.capacity_bytes if n.capacity_bytes else 0

@@ -49,6 +49,7 @@ class NodeInfo:
     used_bytes: int = 0
     last_heartbeat: float = field(default_factory=time.monotonic)
     state: NodeState = NodeState.HEALTHY
+    layout_version: int = -1  # -1: not reported yet
     op_state: NodeOperationalState = NodeOperationalState.IN_SERVICE
 
 

@@ -56,6 +56,25 @@ class StorageContainerManager:
         self.safemode = SafeModeManager(
             self.nodes, self.containers, SafeModeConfig(min_datanodes)
         )
+        # layout-version manager for the metadata services themselves
+        # (HDDSLayoutFeature analog); persisted next to the SCM store
+        # when one exists, in-memory (fresh = finalized) otherwise
+        self.layout = None
+        self.finalizer = None
+        if db_path is not None:
+            from pathlib import Path
+
+            from ozone_tpu.utils.upgrade import (
+                LayoutVersionManager,
+                UpgradeFinalizer,
+            )
+
+            self.layout = LayoutVersionManager(
+                Path(db_path).parent / "layout_version.json"
+            )
+            # ONE persistent finalizer so future features can register
+            # migration actions on it (BasicUpgradeFinalizer contract)
+            self.finalizer = UpgradeFinalizer(self.layout)
         self.replication = ReplicationManager(
             self.containers, self.nodes, self.placement
         )
@@ -94,6 +113,7 @@ class StorageContainerManager:
         container_report: Optional[list[dict]] = None,
         used_bytes: int = 0,
         deleted_block_acks: Optional[list[int]] = None,
+        layout_version: Optional[int] = None,
     ) -> list:
         """Process a heartbeat (+optional full container report and block-
         deletion acks); return the commands queued for this datanode."""
@@ -111,6 +131,10 @@ class StorageContainerManager:
                 ):
                     self.containers.mark_closed(c.id)
         self.metrics.counter("heartbeats").inc()
+        if layout_version is not None:
+            n = self.nodes.get(dn_id)
+            if n is not None:
+                n.layout_version = int(layout_version)
         return self.nodes.process_heartbeat(dn_id, used_bytes)
 
     def _on_dead_node(self, dn_id: str) -> None:
@@ -174,6 +198,14 @@ class StorageContainerManager:
             else:
                 self.decommission_monitor.start_maintenance(target)
             return {"node": target, "op_state": node.op_state.value}
+        if op == "finalize-upgrade":
+            state = None
+            if self.finalizer is not None:
+                state = self.finalizer.finalize().value
+            for n in self.nodes.nodes():
+                self.nodes.queue_command(n.dn_id, {"type": "finalize"})
+            return {"scm": state,
+                    "datanodes_notified": self.nodes.node_count()}
         if op == "close-container":
             try:
                 cid = int(target)

@@ -308,6 +308,11 @@ def cmd_admin(args) -> int:
                          "list|decommission|recommission|maintenance)")
     elif subject == "pipeline":
         _emit(scm.admin("pipelines"))
+    elif subject == "finalizeupgrade":
+        # non-rolling upgrade completion (ozone admin scm
+        # finalizeupgrade analog): bump the metadata services' layout
+        # and command every datanode to finalize
+        _emit(scm.admin("finalize-upgrade"))
     elif subject == "container":
         if verb == "close":
             if not target:
@@ -700,7 +705,7 @@ def build_parser() -> argparse.ArgumentParser:
     ad = sub.add_parser("admin", help="cluster admin (ozone admin analog)")
     ad.add_argument("subject", choices=[
         "safemode", "datanode", "status", "pipeline", "container",
-        "balancer", "replicationmanager", "om",
+        "balancer", "replicationmanager", "om", "finalizeupgrade",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"

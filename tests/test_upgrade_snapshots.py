@@ -245,3 +245,65 @@ def test_snapshot_name_validation(cluster):
         with pytest.raises(OMError) as ei:
             oz.om.create_snapshot("v", "b", bad)
         assert ei.value.code == "INVALID_SNAPSHOT_NAME"
+
+
+def test_finalize_upgrade_propagates_to_datanodes(tmp_path):
+    """Non-rolling upgrade completion: admin finalize bumps the metadata
+    service's layout and commands every datanode to finalize; versions
+    ride heartbeats and persist across restarts."""
+    import json as _json
+    import time
+
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.scm_service import GrpcScmClient
+    from ozone_tpu.utils import upgrade as ug
+
+    # pre-seed an OLD layout version on dn0 and the metadata server
+    (tmp_path / "dn0").mkdir(parents=True)
+    (tmp_path / "dn0" / "layout_version.json").write_text(
+        _json.dumps({"layout_version": 0}))
+    (tmp_path / "layout_version.json").write_text(
+        _json.dumps({"layout_version": 0}))
+
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1000.0,
+                       dead_after_s=2000.0, background_interval_s=0.5)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.1) for i in range(2)]
+    for d in dns:
+        d.start()
+    try:
+        assert dns[0].layout.metadata_version == 0
+        assert dns[0].layout.needs_finalization()
+        assert meta.scm.layout.metadata_version == 0
+
+        scm = GrpcScmClient(meta.address)
+        out = scm.admin("finalize-upgrade")
+        assert out["scm"] == "FINALIZATION_DONE"
+        assert out["datanodes_notified"] == 2
+        # the finalize command rides the next heartbeats
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(not d.layout.needs_finalization() for d in dns):
+                break
+            time.sleep(0.1)
+        assert all(d.layout.metadata_version == ug.LATEST_VERSION
+                   for d in dns)
+        # reported versions reach the SCM node table
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if all(n.layout_version == ug.LATEST_VERSION
+                   for n in meta.scm.nodes.nodes()):
+                break
+            time.sleep(0.1)
+        assert all(n.layout_version == ug.LATEST_VERSION
+                   for n in meta.scm.nodes.nodes())
+        # persisted: a restarted datanode stays finalized
+        assert _json.loads(
+            (tmp_path / "dn0" / "layout_version.json").read_text()
+        )["layout_version"] == ug.LATEST_VERSION
+        scm.close()
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
