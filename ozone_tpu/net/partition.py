@@ -29,6 +29,7 @@ import threading
 
 _lock = threading.Lock()
 _blocked: set[tuple[str, str]] = set()
+_delayed: dict[tuple[str, str], float] = {}
 
 #: wildcard owner: matches calls from every channel in the process
 ANY = "*"
@@ -41,19 +42,43 @@ def block(dst: str, owner: str = ANY) -> None:
         _blocked.add((owner, dst))
 
 
+def delay(dst: str, seconds: float, owner: str = ANY) -> None:
+    """Add fixed latency to future calls to dst (the blockade slow/flaky
+    network scenario: the link works, slowly)."""
+    with _lock:
+        _delayed[(owner, dst)] = float(seconds)
+
+
 def heal(dst: str, owner: str = ANY) -> None:
     with _lock:
         _blocked.discard((owner, dst))
+        _delayed.pop((owner, dst), None)
 
 
 def clear() -> None:
     with _lock:
         _blocked.clear()
+        _delayed.clear()
 
 
 def blocked() -> list[tuple[str, str]]:
     with _lock:
         return sorted(_blocked)
+
+
+def delayed() -> list[tuple[str, str, float]]:
+    with _lock:
+        return sorted((o, d, sec) for (o, d), sec in _delayed.items())
+
+
+def delay_for(dst: str, owner: str | None = None) -> float:
+    with _lock:
+        if not _delayed:
+            return 0.0
+        d = _delayed.get((ANY, dst), 0.0)
+        if owner is not None:
+            d = max(d, _delayed.get((owner, dst), 0.0))
+        return d
 
 
 def is_blocked(dst: str, owner: str | None = None) -> bool:

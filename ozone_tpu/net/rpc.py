@@ -178,7 +178,8 @@ class RpcChannel:
                                 f"rpc {key} to {self.address}: "
                                 f"{e.code()}: {detail}")
 
-    def _check_partition(self, key: str) -> None:
+    def _check_partition(self, key: str,
+                         timeout: Optional[float] = None) -> None:
         from ozone_tpu.net import partition
 
         if partition.is_blocked(self.address, self.owner):
@@ -186,6 +187,21 @@ class RpcChannel:
                 "UNAVAILABLE",
                 f"rpc {key} to {self.address}: injected network partition",
             )
+        d = partition.delay_for(self.address, self.owner)
+        if d > 0:
+            import time as _time
+
+            # injected link latency (slow-network drill) honors the
+            # caller's deadline: latency past the timeout behaves like a
+            # real slow link — block until the deadline, then fail
+            if timeout is not None and d >= timeout:
+                _time.sleep(timeout)
+                raise StorageError(
+                    "UNAVAILABLE",
+                    f"rpc {key} to {self.address}: injected latency "
+                    f"{d}s exceeded deadline {timeout}s",
+                )
+            _time.sleep(d)
 
     def call_streaming(self, service: str, method: str, frames,
                        timeout: Optional[float] = 120.0) -> bytes:
@@ -194,7 +210,7 @@ class RpcChannel:
         from ozone_tpu.utils.tracing import Tracer
 
         key = f"/{service}/{method}"
-        self._check_partition(key)
+        self._check_partition(key, timeout)
         fn = self._calls.get(key)
         if fn is None:
             fn = self._channel.stream_unary(key)
@@ -213,7 +229,7 @@ class RpcChannel:
         from ozone_tpu.utils.tracing import Tracer
 
         key = f"/{service}/{method}"
-        self._check_partition(key)
+        self._check_partition(key, timeout)
         fn = self._calls.get(key)
         if fn is None:
             fn = self._channel.unary_unary(key)

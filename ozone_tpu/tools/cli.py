@@ -550,7 +550,8 @@ def cmd_insight(args) -> int:
                 return 1
             _emit(cli.heal(args.dst, owner=args.owner))
         elif args.verb == "partitions":
-            _emit({"blocked": cli.partition_list()})
+            _emit({"blocked": cli.partition_list(),
+                   "delayed": cli.delays()})
     finally:
         cli.close()
     return 0

@@ -131,6 +131,7 @@ class InsightService:
             "Logs": self._logs,
             "SetLogLevel": self._set_log_level,
             "Partition": self._partition,
+            "Delay": self._delay,
             "Heal": self._heal,
             "PartitionList": self._partition_list,
         })
@@ -180,6 +181,18 @@ class InsightService:
         partition.block(m["dst"], m.get("owner") or partition.ANY)
         return wire.pack({"blocked": partition.blocked()})
 
+    def _delay(self, req: bytes) -> bytes:
+        from ozone_tpu.net import partition
+        from ozone_tpu.storage.ids import StorageError
+
+        m, _ = wire.unpack(req)
+        if not m.get("dst"):
+            raise StorageError("INVALID", "delay requires a dst address")
+        partition.delay(m["dst"], float(m.get("seconds", 0.1)),
+                        m.get("owner") or partition.ANY)
+        return wire.pack({"blocked": partition.blocked(),
+                          "delayed": partition.delayed()})
+
     def _heal(self, req: bytes) -> bytes:
         from ozone_tpu.net import partition
         from ozone_tpu.storage.ids import StorageError
@@ -198,7 +211,8 @@ class InsightService:
     def _partition_list(self, req: bytes) -> bytes:
         from ozone_tpu.net import partition
 
-        return wire.pack({"blocked": partition.blocked()})
+        return wire.pack({"blocked": partition.blocked(),
+                          "delayed": partition.delayed()})
 
 
 class InsightClient:
@@ -228,12 +242,20 @@ class InsightClient:
         """Cut the target process's outbound link(s) to dst."""
         return self._call("Partition", dst=dst, owner=owner)
 
+    def delay(self, dst: str, seconds: float, owner: str = "") -> dict:
+        """Add latency to the target process's calls to dst."""
+        return self._call("Delay", dst=dst, seconds=seconds, owner=owner)
+
     def heal(self, dst: str = "", owner: str = "") -> dict:
         """Restore a cut link, or all links when dst is empty."""
         return self._call("Heal", dst=dst, owner=owner)
 
     def partition_list(self) -> list:
         return self._call("PartitionList")["blocked"]
+
+    def delays(self) -> list:
+        """Active latency-injection rules on the target process."""
+        return self._call("PartitionList")["delayed"]
 
     def close(self) -> None:
         self._ch.close()

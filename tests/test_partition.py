@@ -299,3 +299,50 @@ def test_block_rollover_survives_partitioned_datanode(tmp_path):
         for d in dns:
             d.stop()
         meta.stop()
+
+
+def test_delay_injection_slows_but_does_not_break():
+    """The blockade slow-network scenario: a delayed link still works,
+    with the injected latency; latency past the caller's deadline fails
+    like a real slow link; heal removes the rule."""
+    server = RpcServer()
+    server.add_service("echo", {"Echo": lambda b: b})
+    server.start()
+    try:
+        ch = RpcChannel(server.address)
+        ch.call("echo", "Echo", b"x")
+        partition.delay(server.address, 0.25)
+        t0 = time.perf_counter()
+        assert ch.call("echo", "Echo", b"x") == b"x"
+        slow = time.perf_counter() - t0
+        assert slow >= 0.25
+        # latency exceeding the deadline -> UNAVAILABLE, like a real
+        # slow link tripping DEADLINE_EXCEEDED
+        with pytest.raises(StorageError) as ei:
+            ch.call("echo", "Echo", b"x", timeout=0.05)
+        assert ei.value.code == "UNAVAILABLE"
+        partition.heal(server.address)
+        t0 = time.perf_counter()
+        ch.call("echo", "Echo", b"x")
+        healed = time.perf_counter() - t0
+        assert healed < slow / 2  # relative bound: no flaky wall-clock cap
+        ch.close()
+    finally:
+        server.stop()
+
+
+def test_delay_remote_control_plane():
+    from ozone_tpu.utils.insight import InsightClient, InsightService
+
+    server = RpcServer()
+    InsightService(server, "test")
+    server.start()
+    try:
+        cli = InsightClient(server.address)
+        cli.delay("10.0.0.9:1", 0.5)
+        assert partition.delay_for("10.0.0.9:1") == 0.5
+        cli.heal("10.0.0.9:1")
+        assert partition.delay_for("10.0.0.9:1") == 0.0
+        cli.close()
+    finally:
+        server.stop()
