@@ -33,6 +33,10 @@ class DatanodeClient(Protocol):
     def list_blocks(self, container_id: int) -> list[BlockData]: ...
     def get_committed_block_length(self, block_id: BlockID) -> int: ...
     def delete_block(self, block_id: BlockID) -> None: ...
+    def export_container(self, container_id: int,
+                         compress: bool = True) -> bytes: ...
+    def import_container(self, data: bytes,
+                         replica_index=None) -> int: ...
 
 
 class LocalDatanodeClient:
@@ -48,6 +52,20 @@ class LocalDatanodeClient:
 
     def close_container(self, container_id):
         self.dn.close_container(container_id)
+
+    def export_container(self, container_id, compress=True):
+        # state guard lives in the packer, shared with the gRPC path
+        from ozone_tpu.storage.container_packer import export_container
+
+        return export_container(self.dn.get_container(container_id),
+                                compress=compress)
+
+    def import_container(self, data, replica_index=None):
+        # failure cleanup lives in the packer, shared with the gRPC path
+        from ozone_tpu.storage.container_packer import import_container
+
+        return import_container(self.dn, data,
+                                replica_index=replica_index).id
 
     def delete_container(self, container_id, force=False):
         self.dn.delete_container(container_id, force)

@@ -95,6 +95,21 @@ class StripeWriteError(Exception):
         self.cause = cause
 
 
+def call_allocate(allocate_group, excluded, excluded_containers):
+    """Invoke an allocation callback, passing the excluded-container list
+    only when the callback accepts it (legacy single-arg callbacks keep
+    working; the OM/SCM chain gets the reference ExcludeList semantics)."""
+    import inspect
+
+    try:
+        two_arg = len(inspect.signature(allocate_group).parameters) >= 2
+    except (ValueError, TypeError):  # builtins/partials w/o signature
+        two_arg = False
+    if two_arg:
+        return allocate_group(excluded, excluded_containers)
+    return allocate_group(excluded)
+
+
 def create_group_containers(clients, group: "BlockGroup",
                             replica_indexed: bool) -> None:
     """Create the group's container on every pipeline member, collecting
@@ -192,6 +207,7 @@ class ECKeyWriter:
         self._group_chunks: list[list[ChunkInfo]] = []  # per unit
         self._containers_created = False
         self._excluded: list[str] = []
+        self._excluded_containers: list[int] = []
 
         self._buf = np.zeros((self.k, self.cell), dtype=np.uint8)
         self._cell_idx = 0
@@ -315,8 +331,11 @@ class ECKeyWriter:
                 if e.code == "INVALID_CONTAINER_STATE":
                     # container closed under us (filled concurrently /
                     # SCM finalize): the node is healthy — reallocate a
-                    # fresh group, never blacklist the whole pipeline
+                    # fresh group, never blacklist the whole pipeline;
+                    # the closed container itself is excluded so a stale
+                    # SCM pool can't hand it straight back
                     closed = True
+                    self._excluded_containers.append(group.container_id)
                 else:
                     failed.append(dn_id)
             except (KeyError, OSError) as e:
@@ -356,7 +375,9 @@ class ECKeyWriter:
     # ------------------------------------------------------------------ groups
     def _ensure_group(self) -> BlockGroup:
         if self._group is None:
-            self._group = self.allocate_group(list(self._excluded))
+            self._group = call_allocate(
+                self.allocate_group, list(self._excluded),
+                tuple(self._excluded_containers))
             self._group_chunks = [[] for _ in range(self.k + self.p)]
             self._create_containers(self._group)
         return self._group

@@ -119,7 +119,10 @@ class OzoneBucket:
 
     def _make_writer(self, session: OpenKeySession):
         om = self.client.om
-        allocate = lambda excluded: om.allocate_block(session, excluded)
+
+        def allocate(excluded, excluded_containers=()):
+            return om.allocate_block(session, excluded,
+                                     excluded_containers)
         if session.replication.type is ReplicationType.EC:
             return ECKeyWriter(
                 session.replication.ec,

@@ -47,7 +47,8 @@ def re_encode_key_to_ec(
     session = om.open_key(volume, bucket, key, replication=ec)
     writer = ECKeyWriter(
         ec_conf.ec,
-        lambda excluded: om.allocate_block(session, excluded),
+        lambda excluded, excluded_containers=():
+            om.allocate_block(session, excluded, excluded_containers),
         clients,
         block_size=om.block_size,
         checksum=ChecksumType(info.get("checksum_type", "CRC32C")),

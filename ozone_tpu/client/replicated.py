@@ -22,6 +22,7 @@ from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import (
     BlockGroup,
     StripeWriteError,
+    call_allocate,
     create_group_containers,
 )
 from ozone_tpu.storage.ids import BlockData, ChunkInfo, StorageError
@@ -56,6 +57,10 @@ class ReplicatedKeyWriter:
         self._buf = np.zeros(chunk_size, dtype=np.uint8)
         self._buf_fill = 0
         self._excluded: list[str] = []
+        #: containers seen CLOSED mid-write: the SCM may re-offer them
+        #: until their report lands, so exclusion rides the allocation
+        #: (reference ExcludeList container ids)
+        self._excluded_containers: list[int] = []
         self._closed = False
 
     def write(self, data) -> None:
@@ -80,7 +85,9 @@ class ReplicatedKeyWriter:
 
     def _ensure_group(self) -> BlockGroup:
         if self._group is None:
-            self._group = self.allocate_group(list(self._excluded))
+            self._group = call_allocate(
+                self.allocate_group, list(self._excluded),
+                tuple(self._excluded_containers))
             self._chunks = []
             self._create_containers(self._group)
         return self._group
@@ -141,8 +148,11 @@ class ReplicatedKeyWriter:
                     err = e
                     if e.code == "INVALID_CONTAINER_STATE":
                         # container closed under us: healthy node,
-                        # reallocate without blacklisting anyone
+                        # reallocate without blacklisting anyone — but
+                        # never accept the same container again
                         closed = True
+                        self._excluded_containers.append(
+                            group.container_id)
                     else:
                         failed.append(dn_id)
                 except (KeyError, OSError) as e:

@@ -44,7 +44,13 @@ class DatanodeGrpcService:
                 "DeleteBlock": self._delete_block,
                 "Echo": lambda req: req,
             },
-            stream_methods={"StreamWriteBlock": self._stream_write_block},
+            stream_methods={
+                "StreamWriteBlock": self._stream_write_block,
+                "ImportContainer": self._import_container,
+            },
+            server_stream_methods={
+                "ExportContainer": self._export_container,
+            },
         )
 
     def _stream_write_block(self, frames) -> bytes:
@@ -128,6 +134,39 @@ class DatanodeGrpcService:
             sync=m.get("sync", False),
         )
         return wire.pack({})
+
+    def _export_container(self, req: bytes):
+        """Packed container tarball streamed in frames (the reference's
+        GrpcReplicationService download stream: replication/
+        GrpcReplicationService.java:51): framing keeps each gRPC message
+        bounded. Note: the tarball currently materializes in memory at
+        both ends, so practical container size is bounded by RAM; the
+        state guard and failure cleanup live in container_packer, shared
+        with the in-process client."""
+        from ozone_tpu.storage.container_packer import export_container
+
+        m, _ = wire.unpack(req)
+        c = self.dn.get_container(int(m["container_id"]))
+        data = export_container(c, compress=bool(m.get("compress", True)))
+        frame = 4 * 1024 * 1024
+        yield wire.pack({"container_id": c.id, "size": len(data)})
+        for off in range(0, len(data), frame):
+            yield data[off:off + frame]
+
+    def _import_container(self, frames) -> bytes:
+        """Unpack a client-streamed container tarball onto this datanode
+        (the DownloadAndImportReplicator import half / operator
+        restore): frame 0 carries the metadata, the rest the tarball.
+        Failure cleanup (remove only a container THIS import created)
+        lives in container_packer."""
+        from ozone_tpu.storage.container_packer import import_container
+
+        it = iter(frames)
+        m, _ = wire.unpack(next(it))
+        data = b"".join(bytes(f) for f in it)
+        c = import_container(self.dn, data,
+                             replica_index=m.get("replica_index"))
+        return wire.pack({"container_id": c.id})
 
     def _read_chunk(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
@@ -232,6 +271,33 @@ class GrpcDatanodeClient:
     def list_blocks(self, container_id):
         m, _ = self._call("ListBlock", {"container_id": container_id})
         return [BlockData.from_json(b) for b in m["blocks"]]
+
+    def export_container(self, container_id: int,
+                         compress: bool = True) -> bytes:
+        """Download the packed container tarball, streamed in frames
+        (replication-download / operator-backup path)."""
+        frames = self._ch.call_server_stream(
+            SERVICE, "ExportContainer",
+            wire.pack({"container_id": container_id,
+                       "compress": compress}),
+        )
+        head = next(iter_frames := iter(frames))
+        wire.unpack(head)  # header frame: {container_id, size}
+        return b"".join(bytes(f) for f in iter_frames)
+
+    def import_container(self, data: bytes,
+                         replica_index=None) -> int:
+        """Upload + unpack a container tarball, streamed in frames."""
+        frame = 4 * 1024 * 1024
+
+        def gen():
+            yield wire.pack({"replica_index": replica_index})
+            for off in range(0, len(data), frame):
+                yield data[off:off + frame]
+
+        out = self._ch.call_streaming(SERVICE, "ImportContainer", gen())
+        m, _ = wire.unpack(out)
+        return int(m["container_id"])
 
     def get_committed_block_length(self, block_id):
         m, _ = self._call(

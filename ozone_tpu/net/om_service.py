@@ -314,6 +314,7 @@ class OmGrpcService:
             ReplicationConfig.parse(m["replication"]),
             self.om.block_size,
             m.get("excluded"),
+            m.get("excluded_containers"),
         )
         if self.scm_barrier is not None:
             # HA: the allocation must survive leader failover before the
@@ -493,11 +494,13 @@ class GrpcOmClient:
         self.block_size = meta.get("block_size", self.block_size)
         return RemoteOpenKeySession(volume, bucket, key, meta)
 
-    def allocate_block(self, session, excluded: Optional[list[str]] = None):
+    def allocate_block(self, session, excluded: Optional[list[str]] = None,
+                       excluded_containers=None):
         m = self._call(
             "AllocateBlock",
             replication=str(session.replication),
             excluded=excluded or [],
+            excluded_containers=list(excluded_containers or ()),
         )
         g = m["group"]
         if self.clients is not None:

@@ -32,9 +32,13 @@ StreamMethod = Callable[..., bytes]
 
 class _GenericHandler(grpc.GenericRpcHandler):
     def __init__(self, methods: dict[str, Method],
-                 stream_methods: Optional[dict[str, StreamMethod]] = None):
+                 stream_methods: Optional[dict[str, StreamMethod]] = None,
+                 server_stream_methods: Optional[dict[str, Method]] = None):
         self._methods = methods
         self._stream_methods = stream_methods or {}
+        #: unary request -> iterator of byte frames (the replication
+        #: download shape: large payloads never buffer in one message)
+        self._server_stream_methods = server_stream_methods or {}
 
     @staticmethod
     def _guard(fn, method_name):
@@ -62,6 +66,28 @@ class _GenericHandler(grpc.GenericRpcHandler):
 
         return wrapped
 
+    @staticmethod
+    def _guard_stream(fn, method_name):
+        """Guard for server-streaming handlers: exceptions fire during
+        ITERATION of the response generator, so the try must wrap the
+        yield loop, not just the call."""
+        def wrapped(request, context: grpc.ServicerContext):
+            try:
+                yield from fn(request)
+            except StorageError as e:
+                context.abort(
+                    grpc.StatusCode.ABORTED,
+                    json.dumps({"code": e.code, "message": e.msg}),
+                )
+            except Exception as e:  # noqa: BLE001 - surface as INTERNAL
+                log.exception("rpc %s failed", method_name)
+                context.abort(
+                    grpc.StatusCode.INTERNAL,
+                    json.dumps({"code": "IO_EXCEPTION", "message": str(e)}),
+                )
+
+        return wrapped
+
     def service(self, handler_call_details):
         name = handler_call_details.method
         fn = self._methods.get(name)
@@ -70,6 +96,10 @@ class _GenericHandler(grpc.GenericRpcHandler):
         sfn = self._stream_methods.get(name)
         if sfn is not None:
             return grpc.stream_unary_rpc_method_handler(self._guard(sfn, name))
+        ssfn = self._server_stream_methods.get(name)
+        if ssfn is not None:
+            return grpc.unary_stream_rpc_method_handler(
+                self._guard_stream(ssfn, name))
         return None
 
 
@@ -110,6 +140,7 @@ class RpcServer:
 
     def add_service(self, service_name: str, methods: dict[str, Method],
                     stream_methods: Optional[dict[str, StreamMethod]] = None,
+                    server_stream_methods: Optional[dict] = None,
                     ) -> None:
         full = {
             f"/{service_name}/{name}": fn for name, fn in methods.items()
@@ -118,8 +149,12 @@ class RpcServer:
             f"/{service_name}/{name}": fn
             for name, fn in (stream_methods or {}).items()
         }
+        ssfull = {
+            f"/{service_name}/{name}": fn
+            for name, fn in (server_stream_methods or {}).items()
+        }
         self._server.add_generic_rpc_handlers(
-            (_GenericHandler(full, sfull),))
+            (_GenericHandler(full, sfull, ssfull),))
 
     def start(self) -> None:
         self._server.start()
@@ -221,6 +256,22 @@ class RpcChannel:
                 ctx = tracer.inject()
                 metadata = (("x-trace-id", ctx),) if ctx else None
                 return fn(iter(frames), timeout=timeout, metadata=metadata)
+        except grpc.RpcError as e:
+            raise self._map_rpc_error(key, e) from e
+
+    def call_server_stream(self, service: str, method: str,
+                           request: bytes,
+                           timeout: Optional[float] = 300.0):
+        """Server-streaming call: one request, an iterator of byte
+        frames back (large downloads never buffer in one message)."""
+        key = f"/{service}/{method}"
+        self._check_partition(key, timeout)
+        fn = self._calls.get(key)
+        if fn is None:
+            fn = self._channel.unary_stream(key)
+            self._calls[key] = fn
+        try:
+            yield from fn(request, timeout=timeout)
         except grpc.RpcError as e:
             raise self._map_rpc_error(key, e) from e
 

@@ -380,12 +380,14 @@ class OzoneManager:
         return OpenKeySession(self, info, client_id)
 
     def allocate_block(
-        self, session: OpenKeySession, excluded: Optional[list[str]] = None
+        self, session: OpenKeySession, excluded: Optional[list[str]] = None,
+        excluded_containers: Optional[list[int]] = None,
     ) -> BlockGroup:
         """SCM block allocation for an open key (ScmBlockLocationProtocol
         .allocateBlock analog)."""
         return self.scm.allocate_block(
-            session.replication, self.block_size, excluded
+            session.replication, self.block_size, excluded,
+            excluded_containers,
         )
 
     def commit_key(

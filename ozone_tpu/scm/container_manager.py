@@ -282,10 +282,15 @@ class ContainerManager:
         replication: ReplicationConfig,
         block_size: int,
         excluded: Optional[list[str]] = None,
+        excluded_containers: Optional[list[int]] = None,
     ) -> BlockGroup:
         """Find-or-create an open container on a healthy pipeline and issue
-        a new block id in it (allocateBlock -> WritableContainerFactory)."""
+        a new block id in it (allocateBlock -> WritableContainerFactory).
+        `excluded_containers` mirrors the reference ExcludeList's
+        container ids: a client that just saw CONTAINER_CLOSED must not
+        be handed the same container back before its report lands."""
         excluded = excluded or []
+        excluded_containers = set(excluded_containers or ())
         with self._lock:
             key = str(replication)
             pool = self._writable.setdefault(key, [])
@@ -293,6 +298,8 @@ class ContainerManager:
                 c = self._containers.get(cid)
                 if c is None or c.state is not ContainerState.OPEN:
                     pool.remove(cid)
+                    continue
+                if cid in excluded_containers:
                     continue
                 if any(n in excluded for n in c.pipeline.nodes):
                     continue

@@ -157,9 +157,11 @@ class StorageContainerManager:
         replication: ReplicationConfig,
         block_size: int,
         excluded: Optional[list[str]] = None,
+        excluded_containers: Optional[list[int]] = None,
     ) -> BlockGroup:
         self.safemode.check_allocation_allowed()
-        g = self.containers.allocate_block(replication, block_size, excluded)
+        g = self.containers.allocate_block(replication, block_size, excluded,
+                                           excluded_containers)
         self.metrics.counter("blocks_allocated").inc()
         return g
 

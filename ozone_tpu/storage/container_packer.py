@@ -20,7 +20,24 @@ from ozone_tpu.storage.ids import BlockData, ContainerState, StorageError
 
 
 def export_container(container: Container, compress: bool = False) -> bytes:
-    """Pack a container replica: descriptor, block metadata, chunk files."""
+    """Pack a container replica: descriptor, block metadata, chunk files.
+
+    Only writer-free replicas export — an OPEN container mid-write would
+    snapshot torn chunks (the guard lives HERE so every transport shares
+    it)."""
+    from ozone_tpu.storage.ids import (
+        INVALID_CONTAINER_STATE,
+        ContainerState,
+        StorageError,
+    )
+
+    if container.state not in (ContainerState.CLOSED,
+                               ContainerState.QUASI_CLOSED):
+        raise StorageError(
+            INVALID_CONTAINER_STATE,
+            f"container {container.id} is {container.state.value}; only "
+            "closed replicas export (close it first)",
+        )
     buf = io.BytesIO()
     mode = "w:gz" if compress else "w"
     with tarfile.open(fileobj=buf, mode=mode) as tar:
@@ -50,25 +67,41 @@ def import_container(dn: Datanode, data: bytes,
                      replica_index: Optional[int] = None) -> Container:
     """Unpack a container replica onto a datanode; the imported replica
     lands CLOSED (import is only valid for closed/quasi-closed replicas,
-    like the reference's import path)."""
+    like the reference's import path). A failure after the RECOVERING
+    container was created removes it — ONLY a container this import
+    created; a pre-existing replica raising CONTAINER_EXISTS is never
+    touched — so the import can be retried (the reference's cleanup of
+    RECOVERING containers on reconstruction failure)."""
     buf = io.BytesIO(data)
-    with tarfile.open(fileobj=buf, mode="r:*") as tar:
-        desc = json.loads(tar.extractfile("container.json").read().decode())
-        blocks = json.loads(tar.extractfile("blocks.json").read().decode())
-        c = dn.create_container(
-            int(desc["id"]),
-            replica_index=(
-                replica_index if replica_index is not None
-                else int(desc.get("replica_index", 0))
-            ),
-            state=ContainerState.RECOVERING,
-        )
-        for member in tar.getmembers():
-            if member.name.startswith("chunks/") and member.isfile():
-                dest = c.chunks.chunks_dir / member.name[len("chunks/"):]
-                with open(dest, "wb") as out:
-                    out.write(tar.extractfile(member).read())
-        for b in blocks:
-            c.put_block(BlockData.from_json(b))
-        c.close()
-    return c
+    created: Optional[Container] = None
+    try:
+        with tarfile.open(fileobj=buf, mode="r:*") as tar:
+            desc = json.loads(
+                tar.extractfile("container.json").read().decode())
+            blocks = json.loads(
+                tar.extractfile("blocks.json").read().decode())
+            created = dn.create_container(
+                int(desc["id"]),
+                replica_index=(
+                    replica_index if replica_index is not None
+                    else int(desc.get("replica_index", 0))
+                ),
+                state=ContainerState.RECOVERING,
+            )
+            c = created
+            for member in tar.getmembers():
+                if member.name.startswith("chunks/") and member.isfile():
+                    dest = c.chunks.chunks_dir / member.name[len("chunks/"):]
+                    with open(dest, "wb") as out:
+                        out.write(tar.extractfile(member).read())
+            for b in blocks:
+                c.put_block(BlockData.from_json(b))
+            c.close()
+        return c
+    except Exception:
+        if created is not None:
+            try:
+                dn.delete_container(created.id, force=True)
+            except Exception:  # noqa: BLE001 - best-effort cleanup
+                pass
+        raise

@@ -831,11 +831,18 @@ def build_parser() -> argparse.ArgumentParser:
     rp.set_defaults(fn=cmd_repair)
 
     dbg = sub.add_parser("debug", help="debug tools (ozone debug analog)")
-    dbg.add_argument("tool", choices=["ldb", "chunk-info", "verify-replicas"])
-    dbg.add_argument("target", help="db path (ldb) or /vol/bucket/key")
+    dbg.add_argument("tool", choices=["ldb", "chunk-info", "verify-replicas",
+                                      "export-container",
+                                      "import-container"])
+    dbg.add_argument("target", help="db path (ldb), /vol/bucket/key, or "
+                                    "a container id (export/import)")
     dbg.add_argument("--table", default="keys")
     dbg.add_argument("--prefix", default="")
     dbg.add_argument("--om", default="127.0.0.1:9860")
+    dbg.add_argument("--dn", default="",
+                     help="export/import-container: datanode id")
+    dbg.add_argument("--file", default="",
+                     help="export/import-container: local tarball path")
     dbg.set_defaults(fn=cmd_debug)
 
     fsck = sub.add_parser("fsck", help="namespace health walk "
@@ -966,6 +973,33 @@ def cmd_debug(args) -> int:
         return 0
 
     oz = _client(args)
+    if args.tool in ("export-container", "import-container"):
+        # container replica backup/restore over the replication-download
+        # path (ozone debug container export/import analog)
+        if not args.dn or not args.file:
+            print("error: requires --dn <id> and --file <path>",
+                  file=sys.stderr)
+            return 1
+        client = oz.clients.maybe_get(args.dn)
+        if client is None:
+            print(f"error: unknown datanode {args.dn!r}", file=sys.stderr)
+            return 1
+        try:
+            cid = int(args.target)
+        except ValueError:
+            print(f"error: container id must be numeric: {args.target!r}",
+                  file=sys.stderr)
+            return 1
+        if args.tool == "export-container":
+            data = client.export_container(cid)
+            Path(args.file).write_bytes(data)
+            print(f"exported container {args.target} from {args.dn}: "
+                  f"{len(data)} bytes -> {args.file}")
+        else:
+            data = Path(args.file).read_bytes()
+            out = client.import_container(data)
+            print(f"imported container {out} on {args.dn}")
+        return 0
     vol, bucket, *rest = _parse_path(args.target)
     key = "/".join(rest)
     info = oz.om.lookup_key(vol, bucket, key)

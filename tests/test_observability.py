@@ -116,6 +116,7 @@ def test_container_export_import(cluster, tmp_path):
     info = oz.om.lookup_key("v", "b", "k")
     g = oz.om.key_block_groups(info)[0]
     src_dn = cluster.datanode(g.pipeline.nodes[0])
+    src_dn.close_container(g.container_id)  # export requires closed
     src = src_dn.get_container(g.container_id)
     for compress in (False, True):
         blob = export_container(src, compress=compress)

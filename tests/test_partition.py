@@ -285,7 +285,7 @@ def test_block_rollover_survives_partitioned_datanode(tmp_path):
         session = om.open_key("v", "b", "multi")
         # small chunks force flushes and block rollovers mid-write
         writer = ReplicatedKeyWriter(
-            lambda excluded: om.allocate_block(session, excluded),
+            lambda excluded, ec=(): om.allocate_block(session, excluded, ec),
             clients, block_size=8192, chunk_size=4096,
         )
         writer.write(data)
