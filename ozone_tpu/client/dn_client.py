@@ -9,12 +9,92 @@ the DatanodeClientProtocol verb surface of storage/datanode.py.
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
 from typing import Optional, Protocol
 
 import numpy as np
 
 from ozone_tpu.storage.datanode import Datanode
 from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo, ContainerState
+
+
+class TokenStore:
+    """Client-side cache of OM/SCM-granted block and container tokens.
+
+    The reference threads an encodedToken through every Xceiver request
+    builder; here the store is shared by every client the factory hands
+    out, and GrpcDatanodeClient consults it per call. Writers/readers
+    register the tokens that arrived with each BlockGroup (put_group).
+    `issuer` is the datanode-side fallback: a DN that holds the cluster
+    secret keys self-signs tokens for reconstruction/replication traffic
+    (ec/reconstruction/TokenHelper.java analog).
+    """
+
+    _CAP = 8192  # bounded: tokens expire in minutes anyway
+
+    def __init__(self, issuer=None):
+        self.issuer = issuer
+        self._blocks: OrderedDict[BlockID, dict] = OrderedDict()
+        self._containers: OrderedDict[int, dict] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def put_block_token(self, block_id: BlockID, token: dict) -> None:
+        with self._lock:
+            self._blocks[block_id] = token
+            self._blocks.move_to_end(block_id)
+            while len(self._blocks) > self._CAP:
+                self._blocks.popitem(last=False)
+
+    def put_container_token(self, container_id: int, token: dict) -> None:
+        with self._lock:
+            self._containers[int(container_id)] = token
+            self._containers.move_to_end(int(container_id))
+            while len(self._containers) > self._CAP:
+                self._containers.popitem(last=False)
+
+    def put_group(self, group) -> None:
+        """Register the tokens riding on a BlockGroup (if any)."""
+        tok = getattr(group, "token", None)
+        if tok is not None:
+            self.put_block_token(group.block_id, tok)
+        ctok = getattr(group, "container_token", None)
+        if ctok is not None:
+            self.put_container_token(group.container_id, ctok)
+
+    #: seconds of remaining validity below which a cached token is
+    #: treated as missing (re-issued via the issuer where one exists) —
+    #: a token must not expire mid-flight
+    _EXPIRY_MARGIN = 15.0
+
+    def _fresh(self, tok: Optional[dict]) -> Optional[dict]:
+        import time
+
+        if tok is not None and \
+                tok.get("expiry", 0) < time.time() + self._EXPIRY_MARGIN:
+            return None
+        return tok
+
+    def block_token(self, block_id: BlockID) -> Optional[dict]:
+        with self._lock:
+            tok = self._fresh(self._blocks.get(block_id))
+        if tok is None and self.issuer is not None:
+            from ozone_tpu.utils.security import AccessMode
+
+            tok = self.issuer.issue(
+                block_id, [AccessMode.READ, AccessMode.WRITE], owner="dn")
+            if tok is not None:
+                self.put_block_token(block_id, tok)
+        return tok
+
+    def container_token(self, container_id: int) -> Optional[dict]:
+        with self._lock:
+            tok = self._fresh(self._containers.get(int(container_id)))
+        if tok is None and self.issuer is not None:
+            tok = self.issuer.issue_container(container_id, owner="dn")
+            if tok is not None:
+                self.put_container_token(container_id, tok)
+        return tok
 
 
 class DatanodeClient(Protocol):
@@ -36,7 +116,8 @@ class DatanodeClient(Protocol):
     def export_container(self, container_id: int,
                          compress: bool = True) -> bytes: ...
     def import_container(self, data: bytes,
-                         replica_index=None) -> int: ...
+                         replica_index=None,
+                         container_id=None) -> int: ...
 
 
 class LocalDatanodeClient:
@@ -60,12 +141,13 @@ class LocalDatanodeClient:
         return export_container(self.dn.get_container(container_id),
                                 compress=compress)
 
-    def import_container(self, data, replica_index=None):
+    def import_container(self, data, replica_index=None, container_id=None):
         # failure cleanup lives in the packer, shared with the gRPC path
         from ozone_tpu.storage.container_packer import import_container
 
         return import_container(self.dn, data,
-                                replica_index=replica_index).id
+                                replica_index=replica_index,
+                                expect_id=container_id).id
 
     def delete_container(self, container_id, force=False):
         self.dn.delete_container(container_id, force)
@@ -102,6 +184,13 @@ class DatanodeClientFactory:
         self._local: dict[str, DatanodeClient] = {}
         self._addresses: dict[str, str] = {}
         self._remote: dict[str, DatanodeClient] = {}
+        #: shared by every remote client this factory creates; writers/
+        #: readers register OM-granted tokens here, datanode daemons
+        #: install a self-issuer for reconstruction traffic
+        self.tokens = TokenStore()
+        #: TlsMaterial presented by every remote client (mTLS clusters);
+        #: None = plaintext channels
+        self.tls = None
 
     def register_local(self, dn: Datanode) -> LocalDatanodeClient:
         c = LocalDatanodeClient(dn)
@@ -145,7 +234,8 @@ class DatanodeClientFactory:
         if addr is not None:
             from ozone_tpu.net.dn_service import GrpcDatanodeClient
 
-            c = GrpcDatanodeClient(dn_id, addr)
+            c = GrpcDatanodeClient(dn_id, addr, tokens=self.tokens,
+                                   tls=self.tls)
             self._remote[dn_id] = c
             return c
         return None

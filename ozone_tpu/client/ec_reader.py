@@ -60,6 +60,8 @@ class ECBlockGroupReader:
             options.cell_size,
         )
         self.clients = clients
+        if getattr(clients, "tokens", None) is not None:
+            clients.tokens.put_group(group)  # READ tokens from the lookup
         self.verify = verify
         self.spec = FusedSpec(options, checksum, bytes_per_checksum)
         self._block_meta: dict[int, Optional[BlockData]] = {}

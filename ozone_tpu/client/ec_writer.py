@@ -51,13 +51,19 @@ class BlockGroup:
     local_id: int
     pipeline: Pipeline
     length: int = 0  # committed user bytes in this group
+    #: short-lived capability tokens riding with the allocation/lookup
+    #: (AllocatedBlock's token in the reference, ScmBlockLocationProtocol;
+    #: never persisted — the OM strips them at commit and re-mints fresh
+    #: READ tokens at lookup)
+    token: Optional[dict] = None
+    container_token: Optional[dict] = None
 
     @property
     def block_id(self) -> BlockID:
         return BlockID(self.container_id, self.local_id)
 
-    def to_json(self) -> dict:
-        return {
+    def to_json(self, with_tokens: bool = False) -> dict:
+        out = {
             "container_id": self.container_id,
             "local_id": self.local_id,
             "length": self.length,
@@ -69,6 +75,12 @@ class BlockGroup:
             # address a nonexistent group
             "pipeline_id": self.pipeline.id,
         }
+        if with_tokens:
+            if self.token is not None:
+                out["token"] = self.token
+            if self.container_token is not None:
+                out["container_token"] = self.container_token
+        return out
 
     @classmethod
     def from_json(cls, g: dict) -> "BlockGroup":
@@ -85,6 +97,8 @@ class BlockGroup:
                 list(g["nodes"]), **kw,
             ),
             length=g.get("length", 0),
+            token=g.get("token"),
+            container_token=g.get("container_token"),
         )
 
 
@@ -116,6 +130,9 @@ def create_group_containers(clients, group: "BlockGroup",
     unreachable members into one StripeWriteError so writer retry paths
     exclude them and reallocate (shared by the EC and replicated
     writers; a dead member must not kill the whole write)."""
+    tokens = getattr(clients, "tokens", None)
+    if tokens is not None:
+        tokens.put_group(group)  # capability tokens rode the allocation
     failed: list[str] = []
     cause: Optional[Exception] = None
     for i, dn_id in enumerate(group.pipeline.nodes):

@@ -108,6 +108,10 @@ def file_checksum(client, volume: str, bucket: str, key: str) -> dict:
 
     info = client.om.lookup_key(volume, bucket, key)
     groups = client.om.key_block_groups(info)
+    tokens = getattr(client.clients, "tokens", None)
+    if tokens is not None:
+        for g in groups:
+            tokens.put_group(g)  # READ tokens from the lookup
     from ozone_tpu.scm.pipeline import ReplicationConfig
 
     repl = ReplicationConfig.parse(info.get("replication") or "rs-6-3-1024k")

@@ -153,12 +153,18 @@ class RatisKeyWriter(ReplicatedKeyWriter):
         return ok
 
     def _create_containers(self, group: BlockGroup) -> None:
+        tokens = getattr(self.clients, "tokens", None)
+        if tokens is not None:
+            tokens.put_group(group)  # data-phase fan-out needs them too
         try:
             x = self._xceiver(group)
-            out = x.submit({
+            req = {
                 "verb": "create_container",
                 "container_id": group.container_id,
-            })
+            }
+            if group.container_token is not None:
+                req["container_token"] = group.container_token
+            out = x.submit(req)
             # the data phase writes chunks straight to every member: the
             # container must exist everywhere before bytes arrive, so wait
             # for the create to apply on all replicas (short timeout — a
@@ -175,14 +181,16 @@ class RatisKeyWriter(ReplicatedKeyWriter):
 
     def _commit_chunk(self, group: BlockGroup, info: ChunkInfo) -> None:
         x = self._xceiver(group)
+        tok = {"token": group.token} if group.token is not None else {}
         x.submit({
             "verb": "write_chunk_commit",
             "block_id": group.block_id.to_json(),
             "offset": info.offset,
             "length": info.length,
+            **tok,
         })
         bd = BlockData(group.block_id, [*self._chunks, info])
-        out = x.submit({"verb": "put_block", "block": bd.to_json()})
+        out = x.submit({"verb": "put_block", "block": bd.to_json(), **tok})
         self._last_index = int(out.get("index", 0))
 
     def _finalize_group(self) -> None:

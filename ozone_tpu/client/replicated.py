@@ -222,6 +222,8 @@ class ReplicatedKeyReader:
                  verify: bool = True):
         self.group = group
         self.clients = clients
+        if getattr(clients, "tokens", None) is not None:
+            clients.tokens.put_group(group)  # READ tokens from the lookup
         self.verify = verify
 
     def read_all(self) -> np.ndarray:

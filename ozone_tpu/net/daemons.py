@@ -51,10 +51,38 @@ class DatanodeDaemon:
         rack: str = "/default-rack",
         heartbeat_interval_s: float = 1.0,
         scan_interval_s: float = 300.0,
+        ca_address: str | None = None,
+        enrollment_secret: str | None = None,
     ):
         self.dn = Datanode(Path(root), dn_id=dn_id)
-        self.server = RpcServer(host, port)
-        self.service = DatanodeGrpcService(self.dn, self.server)
+        # secure mode: enroll against the SCM CA's plaintext enrollment
+        # endpoint, then run EVERYTHING (our server, SCM client, peer
+        # datapath/raft channels) over mutual TLS — the reference's
+        # grpc.tls.enabled cluster posture
+        self.tls = None
+        if ca_address is not None:
+            from ozone_tpu.utils.ca import CertificateClient
+
+            cc = CertificateClient(
+                Path(root) / "certs", f"datanode-{dn_id}",
+                hostnames=["localhost", "127.0.0.1", dn_id],
+            )
+            if not cc.enrolled:
+                cc.enroll_remote(ca_address, secret=enrollment_secret)
+            self.tls = cc.tls()
+        self.server = RpcServer(host, port, tls=self.tls)
+        # datapath token verification (BlockTokenVerifier on the
+        # HddsDispatcher): starts disabled; the SCM's register/heartbeat
+        # responses deliver the secret keys and flip it on
+        from ozone_tpu.utils.security import (
+            BlockTokenVerifier,
+            SecretKeyManager,
+        )
+
+        self.secrets = SecretKeyManager(generate=False)
+        self.verifier = BlockTokenVerifier(self.secrets, enabled=False)
+        self.service = DatanodeGrpcService(self.dn, self.server,
+                                           verifier=self.verifier)
         # datanode raft pipelines (XceiverServerRatis analog): raft RPCs
         # and the client Submit/Watch surface ride the same RpcServer
         from ozone_tpu.net.raft_transport import RaftRpcService
@@ -64,18 +92,20 @@ class DatanodeDaemon:
         self.raft_rpc = RaftRpcService(self.server)
         self.xceiver_ratis = RatisXceiverServer(
             self.dn, Path(root), self.server.address,
-            rpc_service=self.raft_rpc,
+            rpc_service=self.raft_rpc, tls=self.tls,
         )
-        self.ratis_service = RatisGrpcService(self.xceiver_ratis, self.server)
+        self.ratis_service = RatisGrpcService(self.xceiver_ratis, self.server,
+                                              verifier=self.verifier)
         self._groups_file = Path(root) / "ratis" / "groups.json"
         from ozone_tpu.utils.insight import InsightService
 
         self.insight = InsightService(self.server, f"datanode:{dn_id}")
-        self.scm = GrpcScmClient(scm_address)
+        self.scm = GrpcScmClient(scm_address, tls=self.tls)
         self.rack = rack
         self.heartbeat_interval = heartbeat_interval_s
         # peer clients for reconstruction/replication work
         self.clients = DatanodeClientFactory()
+        self.clients.tls = self.tls
         self.clients.register_local(self.dn)
         self.reconstruction = ECReconstructionCoordinator(self.clients)
         self._pending_acks: list[int] = []
@@ -117,11 +147,32 @@ class DatanodeDaemon:
     def address(self) -> str:
         return self.server.address
 
+    def _sync_security(self) -> None:
+        """Install secret keys delivered on SCM responses and enable
+        datapath token enforcement + the reconstruction self-issuer
+        (TokenHelper analog — this DN signs its own repair traffic)."""
+        sec = self.scm.security
+        if not sec.get("block_tokens"):
+            return
+        if not self.verifier.enabled:
+            # fail CLOSED from the first moment we learn tokens are on:
+            # with no keys yet, every verification fails — better to
+            # refuse requests than to serve an enforcement-off window
+            self.verifier.enabled = True
+            log.info("%s: block-token enforcement enabled", self.dn.id)
+        if sec.get("secret_keys"):
+            self.secrets.import_keys(sec["secret_keys"])
+            if self.clients.tokens.issuer is None:
+                from ozone_tpu.utils.security import BlockTokenIssuer
+
+                self.clients.tokens.issuer = BlockTokenIssuer(self.secrets)
+
     def start(self) -> None:
         self.server.start()
         self._rejoin_pipelines()
         self.scm.register(self.dn.id, self.address, rack=self.rack,
                           op_state=self._op_state)
+        self._sync_security()
         self._hb = threading.Thread(
             target=self._heartbeat_loop, name=f"hb-{self.dn.id}", daemon=True
         )
@@ -249,6 +300,7 @@ class DatanodeDaemon:
             layout_version=self.layout.metadata_version,
             deleted_block_acks=acks,
         )
+        self._sync_security()
         for cmd in commands:
             self._execute(cmd)
 
@@ -360,6 +412,12 @@ class ScmOmDaemon:
         recon_interval_s: float = 30.0,
         ha_id: str | None = None,
         ha_peers: dict[str, str] | None = None,
+        block_tokens: bool = False,
+        secure: bool = False,
+        enroll_port: int = 0,
+        enrollment_secret: str | None = None,
+        insecure_secrets: bool = False,
+        ca_address: str | None = None,
     ):
         self.scm = StorageContainerManager(
             min_datanodes=min_datanodes,
@@ -367,9 +425,61 @@ class ScmOmDaemon:
             stale_after_s=stale_after_s,
             dead_after_s=dead_after_s,
             db_path=Path(om_db).parent / "scm.db",
+            block_tokens=block_tokens,
         )
-        self.server = RpcServer(host, port)
+        # secure mode: this process hosts the cluster CA (the reference
+        # puts the root CA in the SCM), serves the main plane over
+        # mutual TLS, and signs CSRs on a separate PLAINTEXT enrollment
+        # server (optionally gated by a shared bootstrap secret) — a
+        # fresh datanode has no cert yet, so enrollment cannot ride the
+        # mTLS plane
+        self.tls = None
+        self.ca = None
+        self.enroll_server = None
+        if secure:
+            from ozone_tpu.utils.ca import (
+                CertificateAuthority,
+                CertificateClient,
+                EnrollmentService,
+            )
+
+            # the meta-HA raft transport dials peers with
+            # server_name=<ha id>, so the cert must carry it as a SAN
+            names = ["localhost", "127.0.0.1"] + ([ha_id] if ha_id else [])
+            cc = CertificateClient(Path(om_db).parent / "certs", "scm-om",
+                                   hostnames=names)
+            if ca_address is not None:
+                # non-primordial HA replica: the root CA lives in the
+                # primordial metadata server (reference: SCM hosts it)
+                if not cc.enrolled:
+                    cc.enroll_remote(ca_address, secret=enrollment_secret)
+            else:
+                self.ca = CertificateAuthority(Path(om_db).parent / "ca")
+                if not cc.enrolled:
+                    cc.enroll(self.ca)
+                self.enroll_server = RpcServer(host, enroll_port)
+                EnrollmentService(self.ca, self.enroll_server,
+                                  secret=enrollment_secret)
+            self.tls = cc.tls()
+        if block_tokens and not secure and not insecure_secrets:
+            raise ValueError(
+                "block_tokens without secure=True would hand the signing "
+                "keys to any caller of Register/Heartbeat; pass "
+                "secure=True (mTLS) or insecure_secrets=True (tests only)")
+        if block_tokens and secure and self.enroll_server is not None \
+                and enrollment_secret is None:
+            # open CSR signing would admit ANY network caller into the
+            # mTLS trust domain, where the admin token ops live — the
+            # bootstrap secret is this cluster's admission credential
+            # (the role Kerberos plays in the reference)
+            raise ValueError(
+                "secure block-token clusters require an "
+                "enrollment_secret: open CSR signing would let any "
+                "caller enroll and mint admin tokens")
+        self.server = RpcServer(host, port, tls=self.tls)
         self.scm_service = ScmGrpcService(self.scm, self.server)
+        if insecure_secrets:
+            self.scm_service.distribute_secrets = True
         # RatisPipelineProvider analog: a freshly placed RATIS pipeline is
         # announced to its members so each opens the raft group (command
         # rides the next heartbeat response; the client's leader-retry
@@ -439,6 +549,15 @@ class ScmOmDaemon:
 
         self.scm_service.on_register = _reannounce_pipelines_of
         self.om = OzoneManager(Path(om_db), self.scm, block_size=block_size)
+        if block_tokens:
+            # mint the first signing key before serving (single-node:
+            # synchronous; under HA the ring replicates rotations and
+            # this pre-start key is replaced by the leader's)
+            if ha_id is None:
+                self.scm.ensure_secret_key()
+            from ozone_tpu.utils.security import BlockTokenIssuer
+
+            self.om.enable_block_tokens(BlockTokenIssuer(self.scm.secret_keys))
         self.om_service = OmGrpcService(
             self.om, self.server,
             addresses_provider=lambda: dict(self.scm_service.addresses),
@@ -523,6 +642,12 @@ class ScmOmDaemon:
     def address(self) -> str:
         return self.server.address
 
+    @property
+    def enroll_address(self) -> str | None:
+        """Plaintext cert-enrollment endpoint (secure mode only)."""
+        return (self.enroll_server.address
+                if self.enroll_server is not None else None)
+
     def _leader_address(self, hint: str | None) -> str:
         return self._ha_peers.get(hint or "", "")
 
@@ -546,7 +671,8 @@ class ScmOmDaemon:
         from ozone_tpu.om import requests as rq
 
         raft_rpc = RaftRpcService(self.server)
-        transport = GrpcRaftTransport("meta-ha", self._ha_peers, owner=ha_id)
+        transport = GrpcRaftTransport("meta-ha", self._ha_peers, owner=ha_id,
+                                      tls=self.tls)
         self.ha = MetaHARing(
             self.om, self.scm, raft_dir,
             ha_id, list(self._ha_peers), transport=transport,
@@ -592,6 +718,10 @@ class ScmOmDaemon:
         self.scm_service.admin_submitter = \
             lambda op, target: self._ha_call(
                 lambda: self.ha.submit_admin(op, target), "SCM_NOT_LEADER")
+        # token-key rotation is a replicated decision: every replica's
+        # OM issuer must sign with the keys datanodes verify against
+        self.scm.on_secret_rotate = lambda key: self.ha.submit_admin(
+            "import-secret-key", key.to_json())
 
     def _leader_gate(self) -> None:
         # ready-leader, not just leader: a freshly elected leader must
@@ -604,6 +734,8 @@ class ScmOmDaemon:
                 self._leader_address(self.ha.leader_hint))
 
     def start(self) -> None:
+        if self.enroll_server is not None:
+            self.enroll_server.start()
         self.server.start()
         if self.http is not None:
             self.http.start()
@@ -668,4 +800,6 @@ class ScmOmDaemon:
             self.recon.stop()
         self.scm.stop()
         self.server.stop()
+        if self.enroll_server is not None:
+            self.enroll_server.stop()
         self.om.close()

@@ -16,6 +16,7 @@ from ozone_tpu.net import wire
 from ozone_tpu.net.rpc import RpcChannel, RpcServer
 from ozone_tpu.storage.datanode import Datanode
 from ozone_tpu.storage.ids import (
+    BLOCK_TOKEN_VERIFICATION_FAILED,
     BlockData,
     BlockID,
     ChunkInfo,
@@ -27,8 +28,18 @@ SERVICE = "ozone.tpu.DatanodeService"
 
 
 class DatanodeGrpcService:
-    def __init__(self, dn: Datanode, server: RpcServer):
+    """The HddsDispatcher boundary: every externally reachable verb is
+    authorized here before it touches the container store. `verifier`
+    (utils/security.BlockTokenVerifier, shared with the Ratis submit
+    surface) enforces block tokens on block verbs and container tokens
+    on container verbs, per HddsDispatcher.validateToken +
+    BlockTokenVerifier.java semantics: mode, expiry, signature, and
+    id match all checked; failure surfaces as
+    BLOCK_TOKEN_VERIFICATION_FAILED without executing the verb."""
+
+    def __init__(self, dn: Datanode, server: RpcServer, verifier=None):
         self.dn = dn
+        self.verifier = verifier
         server.add_service(
             SERVICE,
             {
@@ -53,6 +64,31 @@ class DatanodeGrpcService:
             },
         )
 
+    # ------------------------------------------------------------ token gate
+    def _require_block(self, m: dict, mode: str,
+                       block_id: Optional[BlockID] = None) -> None:
+        if self.verifier is None or not self.verifier.enabled:
+            return
+        from ozone_tpu.utils.security import AccessMode, TokenError
+
+        if block_id is None:
+            block_id = BlockID.from_json(m["block_id"])
+        try:
+            self.verifier.verify(m.get("token"), block_id, AccessMode(mode))
+        except TokenError as e:
+            raise StorageError(BLOCK_TOKEN_VERIFICATION_FAILED, str(e))
+
+    def _require_container(self, m: dict, container_id: int) -> None:
+        if self.verifier is None or not self.verifier.enabled:
+            return
+        from ozone_tpu.utils.security import TokenError
+
+        try:
+            self.verifier.verify_container(m.get("container_token"),
+                                           int(container_id))
+        except TokenError as e:
+            raise StorageError(BLOCK_TOKEN_VERIFICATION_FAILED, str(e))
+
     def _stream_write_block(self, frames) -> bytes:
         """Streaming block write (the Ratis DataStream / StreamInit path:
         KeyValueHandler.java:273, client BlockDataStreamOutput): frame 0 is
@@ -66,6 +102,7 @@ class DatanodeGrpcService:
         it = iter(frames)
         header, _ = wire.unpack(next(it))
         block_id = BlockID.from_json(header["block_id"])
+        self._require_block(header, "WRITE", block_id)
         chunk_size = int(header.get("chunk_size", 4 * 1024 * 1024))
         if chunk_size <= 0:
             raise StorageError("INVALID_ARGUMENT",
@@ -108,6 +145,7 @@ class DatanodeGrpcService:
 
     def _create_container(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        self._require_container(m, m["container_id"])
         self.dn.create_container(
             m["container_id"],
             m.get("replica_index", 0),
@@ -117,16 +155,19 @@ class DatanodeGrpcService:
 
     def _close_container(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        self._require_container(m, m["container_id"])
         self.dn.close_container(m["container_id"])
         return wire.pack({})
 
     def _delete_container(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        self._require_container(m, m["container_id"])
         self.dn.delete_container(m["container_id"], m.get("force", False))
         return wire.pack({})
 
     def _write_chunk(self, req: bytes) -> bytes:
         m, payload = wire.unpack(req)
+        self._require_block(m, "WRITE")
         self.dn.write_chunk(
             BlockID.from_json(m["block_id"]),
             ChunkInfo.from_json(m["chunk"]),
@@ -146,6 +187,7 @@ class DatanodeGrpcService:
         from ozone_tpu.storage.container_packer import export_container
 
         m, _ = wire.unpack(req)
+        self._require_container(m, m["container_id"])
         c = self.dn.get_container(int(m["container_id"]))
         data = export_container(c, compress=bool(m.get("compress", True)))
         frame = 4 * 1024 * 1024
@@ -163,13 +205,19 @@ class DatanodeGrpcService:
 
         it = iter(frames)
         m, _ = wire.unpack(next(it))
+        # authorization names a container id; the packer enforces the
+        # tarball actually IS that container before any bytes land
+        expect_id = m.get("container_id")
+        self._require_container(m, expect_id if expect_id is not None else -1)
         data = b"".join(bytes(f) for f in it)
         c = import_container(self.dn, data,
-                             replica_index=m.get("replica_index"))
+                             replica_index=m.get("replica_index"),
+                             expect_id=expect_id)
         return wire.pack({"container_id": c.id})
 
     def _read_chunk(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        self._require_block(m, "READ")
         data = self.dn.read_chunk(
             BlockID.from_json(m["block_id"]),
             ChunkInfo.from_json(m["chunk"]),
@@ -179,41 +227,66 @@ class DatanodeGrpcService:
 
     def _put_block(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
-        self.dn.put_block(BlockData.from_json(m["block"]), sync=m.get("sync", False))
+        bd = BlockData.from_json(m["block"])
+        self._require_block(m, "WRITE", bd.block_id)
+        self.dn.put_block(bd, sync=m.get("sync", False))
         return wire.pack({})
 
     def _get_block(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        self._require_block(m, "READ")
         bd = self.dn.get_block(BlockID.from_json(m["block_id"]))
         return wire.pack({"block": bd.to_json()})
 
     def _list_block(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        self._require_container(m, m["container_id"])
         blocks = self.dn.list_blocks(m["container_id"])
         return wire.pack({"blocks": [b.to_json() for b in blocks]})
 
     def _committed_len(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        self._require_block(m, "READ")
         n = self.dn.get_committed_block_length(BlockID.from_json(m["block_id"]))
         return wire.pack({"length": n})
 
     def _delete_block(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
+        self._require_block(m, "WRITE")
         self.dn.delete_block(BlockID.from_json(m["block_id"]))
         return wire.pack({})
 
 
 class GrpcDatanodeClient:
-    """Remote DatanodeClient over gRPC (ECXceiverClientGrpc analog)."""
+    """Remote DatanodeClient over gRPC (ECXceiverClientGrpc analog).
 
-    def __init__(self, dn_id: str, address: str):
+    `tokens` (client/dn_client.TokenStore, shared across the factory's
+    clients) supplies the block/container capability tokens attached to
+    each request the way the reference's request builders carry
+    encodedToken; absent tokens simply aren't attached (insecure
+    clusters ignore them)."""
+
+    def __init__(self, dn_id: str, address: str, tokens=None, tls=None):
         self.dn_id = dn_id
-        self._ch = RpcChannel(address)
+        self.tokens = tokens
+        self._ch = RpcChannel(address, tls=tls)
 
     def _call(self, method: str, meta: dict,
               payload: Optional[np.ndarray] = None) -> tuple[dict, memoryview]:
         resp = self._ch.call(SERVICE, method, wire.pack(meta, payload))
         return wire.unpack(resp)
+
+    def _btok(self, block_id: BlockID) -> dict:
+        if self.tokens is None:
+            return {}
+        tok = self.tokens.block_token(block_id)
+        return {"token": tok} if tok is not None else {}
+
+    def _ctok(self, container_id: int) -> dict:
+        if self.tokens is None:
+            return {}
+        tok = self.tokens.container_token(container_id)
+        return {"container_token": tok} if tok is not None else {}
 
     def create_container(self, container_id, replica_index=0,
                          state=ContainerState.OPEN):
@@ -223,15 +296,18 @@ class GrpcDatanodeClient:
                 "container_id": container_id,
                 "replica_index": replica_index,
                 "state": state.value,
+                **self._ctok(container_id),
             },
         )
 
     def close_container(self, container_id):
-        self._call("CloseContainer", {"container_id": container_id})
+        self._call("CloseContainer", {"container_id": container_id,
+                                      **self._ctok(container_id)})
 
     def delete_container(self, container_id, force=False):
         self._call("DeleteContainer", {"container_id": container_id,
-                                       "force": force})
+                                       "force": force,
+                                       **self._ctok(container_id)})
 
     def write_chunk(self, block_id, info, data, sync=False):
         arr = np.asarray(
@@ -246,6 +322,7 @@ class GrpcDatanodeClient:
                 "block_id": block_id.to_json(),
                 "chunk": info.to_json(),
                 "sync": sync,
+                **self._btok(block_id),
             },
             arr,
         )
@@ -257,19 +334,23 @@ class GrpcDatanodeClient:
                 "block_id": block_id.to_json(),
                 "chunk": info.to_json(),
                 "verify": verify,
+                **self._btok(block_id),
             },
         )
         return wire.payload_array(payload).copy()
 
     def put_block(self, block, sync=False):
-        self._call("PutBlock", {"block": block.to_json(), "sync": sync})
+        self._call("PutBlock", {"block": block.to_json(), "sync": sync,
+                                **self._btok(block.block_id)})
 
     def get_block(self, block_id):
-        m, _ = self._call("GetBlock", {"block_id": block_id.to_json()})
+        m, _ = self._call("GetBlock", {"block_id": block_id.to_json(),
+                                       **self._btok(block_id)})
         return BlockData.from_json(m["block"])
 
     def list_blocks(self, container_id):
-        m, _ = self._call("ListBlock", {"container_id": container_id})
+        m, _ = self._call("ListBlock", {"container_id": container_id,
+                                        **self._ctok(container_id)})
         return [BlockData.from_json(b) for b in m["blocks"]]
 
     def export_container(self, container_id: int,
@@ -279,19 +360,28 @@ class GrpcDatanodeClient:
         frames = self._ch.call_server_stream(
             SERVICE, "ExportContainer",
             wire.pack({"container_id": container_id,
-                       "compress": compress}),
+                       "compress": compress,
+                       **self._ctok(container_id)}),
         )
         head = next(iter_frames := iter(frames))
         wire.unpack(head)  # header frame: {container_id, size}
         return b"".join(bytes(f) for f in iter_frames)
 
     def import_container(self, data: bytes,
-                         replica_index=None) -> int:
-        """Upload + unpack a container tarball, streamed in frames."""
+                         replica_index=None,
+                         container_id=None) -> int:
+        """Upload + unpack a container tarball, streamed in frames.
+        `container_id` (the id the caller believes the tarball holds)
+        scopes the authorization on secure clusters; the server rejects
+        a tarball whose descriptor names a different container."""
         frame = 4 * 1024 * 1024
+        meta = {"replica_index": replica_index}
+        if container_id is not None:
+            meta.update(container_id=int(container_id),
+                        **self._ctok(container_id))
 
         def gen():
-            yield wire.pack({"replica_index": replica_index})
+            yield wire.pack(meta)
             for off in range(0, len(data), frame):
                 yield data[off:off + frame]
 
@@ -301,12 +391,14 @@ class GrpcDatanodeClient:
 
     def get_committed_block_length(self, block_id):
         m, _ = self._call(
-            "GetCommittedBlockLength", {"block_id": block_id.to_json()}
+            "GetCommittedBlockLength", {"block_id": block_id.to_json(),
+                                        **self._btok(block_id)}
         )
         return m["length"]
 
     def delete_block(self, block_id):
-        self._call("DeleteBlock", {"block_id": block_id.to_json()})
+        self._call("DeleteBlock", {"block_id": block_id.to_json(),
+                                   **self._btok(block_id)})
 
     def stream_write_block(self, block_id, data_frames, chunk_size=4 * 1024 * 1024,
                            sync=False, checksum_type="CRC32C",
@@ -322,6 +414,7 @@ class GrpcDatanodeClient:
                 "sync": sync,
                 "checksum_type": checksum_type,
                 "bytes_per_checksum": bytes_per_checksum,
+                **self._btok(block_id),
             })
             for f in data_frames:
                 yield bytes(f)

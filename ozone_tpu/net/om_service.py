@@ -310,18 +310,19 @@ class OmGrpcService:
 
     def _allocate_block(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
-        g = self.om.scm.allocate_block(
+        g = self.om.grant_write_tokens(self.om.scm.allocate_block(
             ReplicationConfig.parse(m["replication"]),
             self.om.block_size,
             m.get("excluded"),
             m.get("excluded_containers"),
-        )
+        ))
         if self.scm_barrier is not None:
             # HA: the allocation must survive leader failover before the
             # client writes data against it
             self.scm_barrier()
         return wire.pack(
-            {"group": g.to_json(), "addresses": self.addresses_provider()}
+            {"group": g.to_json(with_tokens=True),
+             "addresses": self.addresses_provider()}
         )
 
     def _commit_multipart_part(self, req: bytes) -> bytes:
@@ -395,10 +396,11 @@ class GrpcOmClient:
     (OMFailoverProxyProvider analog): calls stick to the known leader,
     follow OM_NOT_LEADER hints, and rotate on connection failure."""
 
-    def __init__(self, address: str, clients=None):
+    def __init__(self, address: str, clients=None, tls=None):
         from ozone_tpu.net.rpc import FailoverChannels
 
-        self._pool = FailoverChannels(address)
+        self._pool = FailoverChannels(address, tls=tls)
+        self.tls = tls  # downstream tools (freon scmtb) dial the SCM too
         self.addresses = self._pool.addresses
         self.address = self.addresses[0]
         self.block_size = 16 * 1024 * 1024

@@ -23,16 +23,51 @@ SERVICE = "xceiver-ratis"
 
 
 class RatisGrpcService:
-    def __init__(self, xceiver: RatisXceiverServer, server: RpcServer):
+    def __init__(self, xceiver: RatisXceiverServer, server: RpcServer,
+                 verifier=None):
         self.xceiver = xceiver
+        #: shared with DatanodeGrpcService: the reference's
+        #: ContainerStateMachine routes proposals through the same
+        #: HddsDispatcher token check as direct gRPC ops
+        self.verifier = verifier
         server.add_service(SERVICE, {
             "Submit": self._submit,
             "Watch": self._watch,
             "Info": self._info,
         })
 
+    def _authorize(self, req: dict) -> None:
+        """Token-gate a pipeline proposal at the leader (followers apply
+        the committed log without re-checking, like the reference)."""
+        if self.verifier is None or not self.verifier.enabled:
+            return
+        from ozone_tpu.storage.ids import (
+            BLOCK_TOKEN_VERIFICATION_FAILED,
+            BlockID,
+            StorageError,
+        )
+        from ozone_tpu.utils.security import AccessMode, TokenError
+
+        verb = req.get("verb")
+        try:
+            if verb in ("create_container", "close_container"):
+                self.verifier.verify_container(
+                    req.get("container_token"), int(req["container_id"]))
+            elif verb == "write_chunk_commit":
+                self.verifier.verify(
+                    req.get("token"), BlockID.from_json(req["block_id"]),
+                    AccessMode.WRITE)
+            elif verb == "put_block":
+                self.verifier.verify(
+                    req.get("token"),
+                    BlockID.from_json(req["block"]["block_id"]),
+                    AccessMode.WRITE)
+        except TokenError as e:
+            raise StorageError(BLOCK_TOKEN_VERIFICATION_FAILED, str(e))
+
     def _submit(self, request: bytes) -> bytes:
         meta, _ = wire.unpack(request)
+        self._authorize(meta.get("request") or {})
         out = self.xceiver.submit(int(meta["pipeline_id"]), meta["request"],
                                   timeout=float(meta.get("timeout", 30.0)))
         return wire.pack(out)

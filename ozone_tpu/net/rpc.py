@@ -183,9 +183,11 @@ class RpcChannel:
             ("grpc.max_receive_message_length", 128 * 1024 * 1024),
         ]
         if tls is not None:
-            if server_name:
-                options.append((
-                    "grpc.ssl_target_name_override", server_name))
+            # daemons dial by IP:port while certs carry role + localhost
+            # SANs; authentication is CA membership (mutual TLS), so the
+            # default authority override targets the shared localhost SAN
+            options.append((
+                "grpc.ssl_target_name_override", server_name or "localhost"))
             self._channel = grpc.secure_channel(
                 address, tls.channel_credentials(), options=options)
         else:

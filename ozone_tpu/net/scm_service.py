@@ -91,6 +91,13 @@ def deserialize_command(d: dict):
 class ScmGrpcService:
     def __init__(self, scm: StorageContainerManager, server: RpcServer):
         self.scm = scm
+        #: secret keys leave the SCM only over channels that
+        #: authenticated the caller — mutual TLS on this server — or
+        #: when the operator explicitly opted into insecure distribution
+        #: (test clusters); otherwise ANY caller of Register/Heartbeat
+        #: could mint its own tokens and the datapath enforcement would
+        #: be decorative
+        self.distribute_secrets = server.tls_enabled
         self.addresses: dict[str, str] = {}
         #: optional hook fired when a node (re)registers with a new
         #: address (daemon wires pipeline re-announcement through it)
@@ -132,7 +139,21 @@ class ScmGrpcService:
             # a restarted node binds a new port: peers holding the old
             # address (e.g. its pipelines' raft transports) are refreshed
             self.on_register(m["dn_id"])
-        return wire.pack({})
+        return wire.pack(self._security_fields())
+
+    def _security_fields(self) -> dict:
+        """Token secret-key distribution rides the register/heartbeat
+        responses (the reference's SecretKeyProtocol served from the
+        SCM): datanodes import the keys and turn on datapath token
+        verification."""
+        if not getattr(self.scm, "block_tokens", False):
+            return {}
+        if not self.distribute_secrets:
+            return {"block_tokens": True}  # enforcement on, keys withheld
+        return {
+            "block_tokens": True,
+            "secret_keys": self.scm.secret_keys.export_keys(),
+        }
 
     def _heartbeat(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
@@ -147,7 +168,8 @@ class ScmGrpcService:
             {
                 "commands": [
                     serialize_command(c, dict(self.addresses)) for c in cmds
-                ]
+                ],
+                **self._security_fields(),
             }
         )
 
@@ -191,6 +213,27 @@ class ScmGrpcService:
                 out = scm.apply_admin_op(op, target)
         elif op == "balancer-status":
             out = {"running": scm.balancer_enabled}
+        elif op in ("container-token", "block-token"):
+            # operator token minting for dn-direct debug/repair tools
+            # (SCMSecurityProtocol.getContainerToken analog); no-op on
+            # insecure clusters so tools need no mode switch
+            if not getattr(scm, "block_tokens", False):
+                out = {"token": None}
+            else:
+                from ozone_tpu.storage.ids import BlockID
+                from ozone_tpu.utils.security import (
+                    AccessMode,
+                    BlockTokenIssuer,
+                )
+
+                issuer = BlockTokenIssuer(scm.secret_keys)
+                if op == "container-token":
+                    tok = issuer.issue_container(int(target), owner="admin")
+                else:
+                    tok = issuer.issue(
+                        BlockID.from_json(target),
+                        [AccessMode.READ, AccessMode.WRITE], owner="admin")
+                out = {"token": tok}
         elif op == "pipelines":
             out = {"pipelines": [
                 {"id": p.id, "nodes": p.nodes,
@@ -234,6 +277,7 @@ class ScmGrpcService:
             {
                 "safemode": self.scm.safemode.in_safemode(),
                 "safemode_status": self.scm.safemode.status(),
+                "block_tokens": getattr(self.scm, "block_tokens", False),
                 "nodes": [
                     {
                         "dn_id": n.dn_id,
@@ -255,11 +299,29 @@ class GrpcScmClient:
     leader starts with fresh node state; commands only come back from the
     leader), while reads rotate to the first reachable replica."""
 
-    def __init__(self, address: str):
+    def __init__(self, address: str, tls=None):
         from ozone_tpu.net.rpc import FailoverChannels
 
-        self._pool = FailoverChannels(address)
+        self._pool = FailoverChannels(address, tls=tls)
         self.addresses = self._pool.addresses
+        #: latest security fields seen on register/heartbeat responses
+        #: ({"block_tokens": bool, "secret_keys": [...]}); the datanode
+        #: daemon drains this into its verifier after each exchange
+        self.security: dict = {}
+
+    def _merge_security(self, responses: list[dict]) -> None:
+        import time
+
+        for m in responses:
+            if m.get("block_tokens"):
+                self.security["block_tokens"] = True
+                keys = {k["key_id"]: k
+                        for k in self.security.get("secret_keys", [])}
+                for k in m.get("secret_keys", []):
+                    keys[k["key_id"]] = k
+                now = time.time()  # expired keys must not accumulate
+                self.security["secret_keys"] = [
+                    k for k in keys.values() if k.get("expires", 0) >= now]
 
     def _call(self, method: str, meta: dict,
               timeout: Optional[float] = 30.0) -> dict:
@@ -316,10 +378,11 @@ class GrpcScmClient:
     def register(self, dn_id: str, address: str, rack: str = "/default-rack",
                  capacity_bytes: int = 0,
                  op_state: Optional[str] = None) -> None:
-        self._broadcast("Register", {
+        responses = self._broadcast("Register", {
             "dn_id": dn_id, "address": address, "rack": rack,
             "capacity_bytes": capacity_bytes, "op_state": op_state,
         })
+        self._merge_security(responses)
 
     def heartbeat(self, dn_id: str, container_report=None,
                   used_bytes: int = 0,
@@ -332,6 +395,7 @@ class GrpcScmClient:
             "deleted_block_acks": deleted_block_acks or [],
             "layout_version": layout_version,
         })
+        self._merge_security(responses)
         cmds = []
         for m in responses:  # only the leader queues commands
             cmds.extend(deserialize_command(c) for c in m["commands"])
@@ -360,3 +424,28 @@ class GrpcScmClient:
 
     def close(self) -> None:
         self._pool.close()
+
+
+class AdminTokenFetcher:
+    """TokenStore issuer that fetches operator tokens from the SCM
+    (SCMSecurityProtocol.getContainerToken analog) — lets dn-direct
+    debug/repair/freon tools run against token-enforcing clusters
+    without holding the secret keys. On insecure clusters the SCM
+    answers None and requests go out untokened."""
+
+    def __init__(self, scm_client: GrpcScmClient):
+        self.scm = scm_client
+
+    def issue(self, block_id, modes=None, owner="admin"):
+        try:
+            return self.scm.admin(
+                "block-token", block_id.to_json()).get("token")
+        except StorageError:
+            return None
+
+    def issue_container(self, container_id, modes=None, owner="admin"):
+        try:
+            return self.scm.admin(
+                "container-token", int(container_id)).get("token")
+        except StorageError:
+            return None

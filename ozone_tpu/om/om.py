@@ -76,6 +76,10 @@ class OzoneManager:
         self._authorizer = None
         self._superusers = {"root"}
         self._caller = threading.local()
+        # block-token minting (OzoneBlockTokenSecretManager analog,
+        # reference hdds.block.token.enabled): installed by the daemon
+        # via enable_block_tokens; None = insecure cluster, no tokens
+        self.token_issuer = None
 
     # ----------------------------------------------------------- acl/tenant
     def enable_acls(self, superusers=("root",)) -> None:
@@ -379,16 +383,60 @@ class OzoneManager:
         self.metrics.counter("keys_opened").inc()
         return OpenKeySession(self, info, client_id)
 
+    def enable_block_tokens(self, issuer) -> None:
+        """Install the token issuer (hdds.block.token.enabled=true):
+        every allocation carries WRITE capability tokens, every lookup
+        re-mints fresh READ tokens, and the OM's own datanode traffic
+        (key-deletion, lease recovery) self-signs via the shared store."""
+        self.token_issuer = issuer
+        if self.clients is not None:
+            self.clients.tokens.issuer = issuer
+
+    def grant_write_tokens(self, g: BlockGroup) -> BlockGroup:
+        """Attach capability tokens to a fresh allocation (the token in
+        the reference's AllocatedBlock). READ is included so the writer
+        can probe committed lengths on its own blocks (lease recovery)."""
+        if self.token_issuer is not None:
+            from ozone_tpu.utils.security import AccessMode
+
+            owner = self.current_user()[0] or "client"
+            g.token = self.token_issuer.issue(
+                g.block_id, [AccessMode.READ, AccessMode.WRITE], owner=owner)
+            g.container_token = self.token_issuer.issue_container(
+                g.container_id, owner=owner)
+        return g
+
+    def mint_read_tokens(self, info: dict) -> dict:
+        """Fresh READ tokens on a lookup result's block groups (the
+        reference mints block tokens in KeyManagerImpl lookup; stored
+        key info never holds tokens)."""
+        if self.token_issuer is None or not info.get("block_groups"):
+            return info
+        from ozone_tpu.storage.ids import BlockID
+        from ozone_tpu.utils.security import AccessMode
+
+        owner = self.current_user()[0] or "client"
+        info = dict(info)
+        groups = []
+        for g in info["block_groups"]:
+            g = dict(g)
+            bid = BlockID(int(g["container_id"]), int(g["local_id"]))
+            g["token"] = self.token_issuer.issue(
+                bid, [AccessMode.READ], owner=owner)
+            groups.append(g)
+        info["block_groups"] = groups
+        return info
+
     def allocate_block(
         self, session: OpenKeySession, excluded: Optional[list[str]] = None,
         excluded_containers: Optional[list[int]] = None,
     ) -> BlockGroup:
         """SCM block allocation for an open key (ScmBlockLocationProtocol
         .allocateBlock analog)."""
-        return self.scm.allocate_block(
+        return self.grant_write_tokens(self.scm.allocate_block(
             session.replication, self.block_size, excluded,
             excluded_containers,
-        )
+        ))
 
     def commit_key(
         self, session: OpenKeySession, groups: list[BlockGroup], size: int,
@@ -494,7 +542,8 @@ class OzoneManager:
     def snapshot_lookup_key(self, volume: str, bucket: str, name: str,
                             key: str) -> dict:
         volume, bucket = self.resolve_bucket(volume, bucket)
-        return self._snapshots().lookup_key(volume, bucket, name, key)
+        return self.mint_read_tokens(
+            self._snapshots().lookup_key(volume, bucket, name, key))
 
     def lookup_key(self, volume: str, bucket: str, key: str) -> dict:
         from ozone_tpu.om import fso
@@ -509,7 +558,7 @@ class OzoneManager:
         if info is None:
             raise rq.OMError(rq.KEY_NOT_FOUND, f"{volume}/{bucket}/{key}")
         self.metrics.counter("key_lookups").inc()
-        return info
+        return self.mint_read_tokens(info)
 
     def key_block_groups(self, info: dict) -> list[BlockGroup]:
         """Materialize BlockGroup objects (with pipelines) from key info."""

@@ -38,8 +38,23 @@ class StorageContainerManager:
         stale_after_s: float = 9.0,
         dead_after_s: float = 30.0,
         db_path=None,
+        block_tokens: bool = False,
     ):
         self.events = EventQueue()
+        # symmetric secret keys for block/container tokens (reference
+        # security/symmetric/SecretKeyManager lives in the SCM and feeds
+        # OM + datanodes). Keys are minted lazily by ensure_secret_key so
+        # HA replicas can replicate the material through the ring instead
+        # of each inventing their own.
+        from ozone_tpu.utils.security import SecretKeyManager
+
+        self.block_tokens = block_tokens
+        self.secret_keys = SecretKeyManager(generate=False,
+                                            activation_s=10.0)
+        #: HA hook: leader routes freshly minted keys through the ring
+        #: (apply lands in apply_admin_op("import-secret-key")); None =
+        #: single-node, install directly
+        self.on_secret_rotate = None
         self.nodes = NodeManager(
             self.events, stale_after_s=stale_after_s, dead_after_s=dead_after_s
         )
@@ -226,6 +241,13 @@ class StorageContainerManager:
                 # replicas; convergence marks it CLOSED
                 self.containers.finalize_container(c.id)
             return {"container": c.id, "state": c.state.value}
+        if op == "import-secret-key":
+            # token secret-key rotation decision (possibly replicated
+            # through the HA ring): install the material on this replica
+            from ozone_tpu.utils.security import SecretKey
+
+            self.secret_keys.import_key(SecretKey.from_json(target))
+            return {"key_id": target["key_id"]}
         if op == "balancer-start":
             self.balancer_enabled = True
         elif op == "balancer-stop":
@@ -241,10 +263,25 @@ class StorageContainerManager:
         return {"safemode": self.safemode.in_safemode(),
                 **self.safemode.status()}
 
+    # ------------------------------------------------------------- security
+    def ensure_secret_key(self) -> None:
+        """Mint/rotate the token-signing key when due. Single-node
+        installs directly; under HA the daemon's on_secret_rotate hook
+        replicates the material through the metadata ring so every
+        replica (and thus every OM issuer) signs with the same keys."""
+        if not self.block_tokens or not self.secret_keys.needs_rotation():
+            return
+        key = self.secret_keys.new_key()
+        if self.on_secret_rotate is not None:
+            self.on_secret_rotate(key)
+        else:
+            self.secret_keys.import_key(key)
+
     # ------------------------------------------------------------- background
     def run_background_once(self) -> None:
         """One tick of the SCM control loops (liveness + replication +
         decommission + balancer)."""
+        self.ensure_secret_key()
         self.nodes.check_liveness()
         if not self.safemode.in_safemode():
             self.replication.run_once()

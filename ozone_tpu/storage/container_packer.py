@@ -64,7 +64,8 @@ def export_container(container: Container, compress: bool = False) -> bytes:
 
 
 def import_container(dn: Datanode, data: bytes,
-                     replica_index: Optional[int] = None) -> Container:
+                     replica_index: Optional[int] = None,
+                     expect_id: Optional[int] = None) -> Container:
     """Unpack a container replica onto a datanode; the imported replica
     lands CLOSED (import is only valid for closed/quasi-closed replicas,
     like the reference's import path). A failure after the RECOVERING
@@ -78,6 +79,12 @@ def import_container(dn: Datanode, data: bytes,
         with tarfile.open(fileobj=buf, mode="r:*") as tar:
             desc = json.loads(
                 tar.extractfile("container.json").read().decode())
+            if expect_id is not None and int(desc["id"]) != int(expect_id):
+                # the caller's authorization (container token) named a
+                # different container than the tarball carries
+                raise StorageError(
+                    "CONTAINER_ID_MISMATCH",
+                    f"tarball is container {desc['id']}, not {expect_id}")
             blocks = json.loads(
                 tar.extractfile("blocks.json").read().decode())
             created = dn.create_container(

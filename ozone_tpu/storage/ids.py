@@ -130,3 +130,6 @@ CLOSED_CONTAINER_IO = "CLOSED_CONTAINER_IO"
 INVALID_CONTAINER_STATE = "INVALID_CONTAINER_STATE"
 IO_EXCEPTION = "IO_EXCEPTION"
 INVALID_WRITE_SIZE = "INVALID_WRITE_SIZE"
+# refused block/container capability token (BlockTokenVerifier.java);
+# shared by the gRPC datapath and the Ratis submit surface
+BLOCK_TOKEN_VERIFICATION_FAILED = "BLOCK_TOKEN_VERIFICATION_FAILED"

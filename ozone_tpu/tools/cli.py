@@ -27,24 +27,57 @@ import numpy as np
 from ozone_tpu.storage.ids import StorageError
 
 
+def _client_tls():
+    """CLI mTLS material for secure clusters, driven by environment:
+    OZONE_TPU_CERT_DIR (where the client keypair/cert live) plus, for
+    first contact, OZONE_TPU_ENROLL (the SCM enrollment address) and
+    optional OZONE_TPU_ENROLL_SECRET."""
+    import os
+
+    cert_dir = os.environ.get("OZONE_TPU_CERT_DIR")
+    if not cert_dir:
+        return None
+    from ozone_tpu.utils.ca import CertificateClient
+
+    cc = CertificateClient(Path(cert_dir), "client-cli")
+    if not cc.enrolled:
+        enroll = os.environ.get("OZONE_TPU_ENROLL")
+        if not enroll:
+            print("error: OZONE_TPU_CERT_DIR set but not enrolled; set "
+                  "OZONE_TPU_ENROLL to the SCM enrollment address",
+                  file=sys.stderr)
+            sys.exit(1)
+        cc.enroll_remote(enroll,
+                         secret=os.environ.get("OZONE_TPU_ENROLL_SECRET"))
+    return cc.tls()
+
+
 def _client(args):
     from ozone_tpu.client.dn_client import DatanodeClientFactory
     from ozone_tpu.client.ozone_client import OzoneClient
     from ozone_tpu.net.om_service import GrpcOmClient
 
+    tls = _client_tls()
     clients = DatanodeClientFactory()
-    om = GrpcOmClient(args.om, clients=clients)
+    clients.tls = tls
+    om = GrpcOmClient(args.om, clients=clients, tls=tls)
     # learn datanode addresses up front
-    from ozone_tpu.net.scm_service import GrpcScmClient
+    from ozone_tpu.net.scm_service import AdminTokenFetcher, GrpcScmClient
 
     try:
-        for dn_id, addr in GrpcScmClient(args.om).node_addresses().items():
+        scm = GrpcScmClient(args.om, tls=tls)
+        for dn_id, addr in scm.node_addresses().items():
             clients.register_remote(dn_id, addr)
+        if scm.status().get("block_tokens"):
+            # dn-direct debug/repair verbs fetch operator tokens from
+            # the SCM instead of holding the secret keys
+            clients.tokens.issuer = AdminTokenFetcher(scm)
     except Exception:
         pass
     from ozone_tpu.net.ratis_service import RatisClientFactory
 
     ratis = RatisClientFactory(address_source=clients.remote_address)
+    ratis.tls = tls
     return OzoneClient(om, clients, ratis_clients=ratis)
 
 
@@ -285,7 +318,7 @@ def cmd_admin(args) -> int:
         print(f"error: {msg}", file=sys.stderr)
         return 2
 
-    scm = GrpcScmClient(args.om)
+    scm = GrpcScmClient(args.om, tls=_client_tls())
     subject, verb, target = args.subject, args.verb, args.target
     if subject == "safemode":
         if verb in ("enter", "exit"):
@@ -333,7 +366,7 @@ def cmd_admin(args) -> int:
     elif subject == "om":
         from ozone_tpu.net.om_service import GrpcOmClient
 
-        om = GrpcOmClient(args.om)
+        om = GrpcOmClient(args.om, tls=_client_tls())
         if verb == "prepare":
             _emit(om.prepare())
         elif verb == "cancelprepare":
@@ -427,6 +460,8 @@ def cmd_datanode(args) -> int:
     d = DatanodeDaemon(
         Path(args.root), dn_id, args.scm, port=args.port, rack=args.rack,
         scan_interval_s=args.scan_interval,
+        ca_address=args.ca or None,
+        enrollment_secret=args.enrollment_secret or None,
     )
     d.start()
     print(f"datanode {dn_id} serving on {d.address}, scm={args.scm}")
@@ -451,10 +486,18 @@ def cmd_scm_om(args) -> int:
                     http_port=args.http_port,
                     recon_port=args.recon_port,
                     ha_id=args.ha_id if ha_peers else None,
-                    ha_peers=ha_peers)
+                    ha_peers=ha_peers,
+                    block_tokens=args.block_tokens,
+                    secure=args.secure,
+                    enroll_port=args.enroll_port,
+                    enrollment_secret=args.enrollment_secret or None,
+                    ca_address=args.ca or None)
     d.start()
     print(f"scm+om serving on {d.address}"
           + (f" as HA node {args.ha_id}" if ha_peers else "")
+          + (" [mTLS]" if d.tls is not None else "")
+          + (f", enrollment on {d.enroll_address}" if d.enroll_server
+             else "")
           + (f", http on {d.http.address}" if d.http else "")
           + (f", recon on {d.recon.address}" if d.recon else ""))
     return _serve(d.stop)
@@ -524,7 +567,7 @@ def cmd_insight(args) -> int:
     read metrics, tail logs, bump log levels on a running daemon."""
     from ozone_tpu.utils.insight import InsightClient
 
-    cli = InsightClient(args.address or args.om)
+    cli = InsightClient(args.address or args.om, tls=_client_tls())
     try:
         if args.verb == "list":
             _emit(cli.list_points())
@@ -590,7 +633,7 @@ def cmd_repair(args) -> int:
             return 1
         _emit(oz.om.repair_quota(args.volume))
         return 0
-    scm = GrpcScmClient(args.om)
+    scm = GrpcScmClient(args.om, tls=_client_tls())
     if args.tool != "orphans":
         print(f"unknown repair tool {args.tool}", file=sys.stderr)
         return 1
@@ -747,6 +790,11 @@ def build_parser() -> argparse.ArgumentParser:
     dn.add_argument("--scan-interval", type=float, default=300.0,
                     help="seconds between background container scrubs "
                          "(0 disables)")
+    dn.add_argument("--ca", default="",
+                    help="SCM cert-enrollment address (host:port) — "
+                         "enroll and serve/dial everything over mTLS")
+    dn.add_argument("--enrollment-secret", default="",
+                    help="shared bootstrap secret for CSR signing")
     dn.set_defaults(fn=cmd_datanode)
 
     s3g = sub.add_parser("s3g", help="run the S3 gateway daemon")
@@ -790,6 +838,20 @@ def build_parser() -> argparse.ArgumentParser:
     so.add_argument("--peer", action="append", default=[],
                     help="HA ring member as id=host:port (repeat; must "
                          "include --ha-id itself)")
+    so.add_argument("--block-tokens", action="store_true",
+                    help="enforce HMAC block/container tokens on the "
+                         "datanode datapath (hdds.block.token.enabled)")
+    so.add_argument("--secure", action="store_true",
+                    help="host the cluster CA and serve the main plane "
+                         "over mutual TLS (grpc.tls.enabled)")
+    so.add_argument("--enroll-port", type=int, default=0,
+                    help="plaintext cert-enrollment port (secure mode)")
+    so.add_argument("--enrollment-secret", default="",
+                    help="shared bootstrap secret gating CSR signing")
+    so.add_argument("--ca", default="",
+                    help="primordial metadata server's enrollment "
+                         "address (secure HA replicas enroll there "
+                         "instead of hosting their own CA)")
     so.set_defaults(fn=cmd_scm_om)
 
     ins = sub.add_parser("insight",
@@ -997,7 +1059,7 @@ def cmd_debug(args) -> int:
                   f"{len(data)} bytes -> {args.file}")
         else:
             data = Path(args.file).read_bytes()
-            out = client.import_container(data)
+            out = client.import_container(data, container_id=cid)
             print(f"imported container {out} on {args.dn}")
         return 0
     vol, bucket, *rest = _parse_path(args.target)

@@ -335,7 +335,8 @@ def scmtb(client, n_blocks: int = 1000, threads: int = 8,
         # remote OM: the co-located SCM service honors block_size
         from ozone_tpu.net.scm_service import GrpcScmClient
 
-        scm = GrpcScmClient(client.om.address)
+        scm = GrpcScmClient(client.om.address,
+                            tls=getattr(client.om, "tls", None))
         op_alloc = lambda: scm.allocate_block(replication, block_size)
 
     def op(i: int) -> int:

@@ -196,6 +196,26 @@ class CertificateClient:
         RPC and installs the response the same way."""
         self.install(ca.sign_csr(self.make_csr()), ca.root_pem)
 
+    def enroll_remote(self, address: str,
+                      secret: Optional[str] = None) -> None:
+        """Enroll against the SCM CA's plaintext enrollment endpoint
+        (SCMSecurityProtocol getDataNodeCertificate analog; the
+        reference authenticates the CSR channel with Kerberos — here an
+        optional shared bootstrap secret gates signing)."""
+        from ozone_tpu.net import wire
+        from ozone_tpu.net.rpc import RpcChannel
+
+        ch = RpcChannel(address)
+        try:
+            resp = ch.call(
+                ENROLL_SERVICE, "SignCsr",
+                wire.pack({"csr": self.make_csr().decode(),
+                           "secret": secret}))
+            m, _ = wire.unpack(resp)
+            self.install(m["cert"].encode(), m["ca"].encode())
+        finally:
+            ch.close()
+
     @property
     def enrolled(self) -> bool:
         return self.cert_path.exists() and self.ca_path.exists()
@@ -208,6 +228,45 @@ class CertificateClient:
             cert_pem=self.cert_path.read_bytes(),
             ca_pem=self.ca_path.read_bytes(),
         )
+
+
+ENROLL_SERVICE = "ozone.tpu.CertEnrollment"
+
+
+class EnrollmentService:
+    """CSR-signing endpoint served PLAINTEXT on its own RpcServer (the
+    chicken-and-egg breaker: a fresh datanode has no cert yet, so it
+    cannot reach the mTLS plane; the reference solves this with a
+    Kerberos-authenticated SCMSecurityProtocol — here an optional shared
+    `secret` gates who may obtain a certificate, and everything issued
+    is a leaf cert whose trust is still rooted in the SCM CA)."""
+
+    def __init__(self, ca: CertificateAuthority, server,
+                 secret: Optional[str] = None):
+        self.ca = ca
+        self.secret = secret
+        server.add_service(ENROLL_SERVICE, {
+            "SignCsr": self._sign,
+            "RootCert": self._root,
+        })
+
+    def _sign(self, req: bytes) -> bytes:
+        import hmac as _hmac
+
+        from ozone_tpu.net import wire
+
+        m, _ = wire.unpack(req)
+        if self.secret is not None and not _hmac.compare_digest(
+                str(m.get("secret") or ""), self.secret):
+            raise PermissionError("bad enrollment secret")
+        cert = self.ca.sign_csr(m["csr"].encode())
+        return wire.pack({"cert": cert.decode(),
+                          "ca": self.ca.root_pem.decode()})
+
+    def _root(self, req: bytes) -> bytes:
+        from ozone_tpu.net import wire
+
+        return wire.pack({"ca": self.ca.root_pem.decode()})
 
 
 @dataclass(frozen=True)

@@ -216,10 +216,10 @@ class InsightService:
 
 
 class InsightClient:
-    def __init__(self, address: str):
+    def __init__(self, address: str, tls=None):
         from ozone_tpu.net.rpc import RpcChannel
 
-        self._ch = RpcChannel(address)
+        self._ch = RpcChannel(address, tls=tls)
 
     def _call(self, method: str, **m) -> dict:
         out, _ = wire.unpack(self._ch.call(SERVICE, method, wire.pack(m)))

@@ -40,17 +40,44 @@ class SecretKey:
     created: float
     expires: float
 
+    def to_json(self) -> dict:
+        return {
+            "key_id": self.key_id,
+            "material": self.material.hex(),
+            "created": self.created,
+            "expires": self.expires,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "SecretKey":
+        return cls(d["key_id"], bytes.fromhex(d["material"]),
+                   float(d["created"]), float(d["expires"]))
+
 
 class SecretKeyManager:
-    """Rotating symmetric keys (security/symmetric/SecretKeyManager.java)."""
+    """Rotating symmetric keys (security/symmetric/SecretKeyManager.java).
 
-    def __init__(self, rotation_s: float = 3600.0, lifetime_s: float = 7200.0):
+    `generate=False` builds an empty manager that only holds imported
+    keys — the datanode-side verifier state, fed by the SCM over the
+    register/heartbeat channel the way the reference's
+    SecretKeyProtocol distributes keys to DNs."""
+
+    def __init__(self, rotation_s: float = 3600.0, lifetime_s: float = 7200.0,
+                 generate: bool = True, activation_s: float = 0.0):
         self.rotation_s = rotation_s
         self.lifetime_s = lifetime_s
+        #: a freshly minted key becomes the SIGNING key only after this
+        #: many seconds — verifiers (datanodes) learn keys over the
+        #: heartbeat channel, so signing with a key nobody can verify
+        #: yet would fail every request for one heartbeat interval after
+        #: each rotation. Verification accepts all non-expired keys
+        #: immediately; only signing waits.
+        self.activation_s = activation_s
         self._keys: dict[str, SecretKey] = {}
         self._current: Optional[SecretKey] = None
         self._lock = threading.Lock()
-        self.rotate()
+        if generate:
+            self.rotate()
 
     def rotate(self) -> SecretKey:
         with self._lock:
@@ -69,31 +96,76 @@ class SecretKeyManager:
                 del self._keys[kid]
             return k
 
-    def current(self) -> SecretKey:
+    def current(self) -> Optional[SecretKey]:
+        """The signing key: the newest key past its activation delay,
+        falling back to the newest key at all (bootstrap: the first key
+        must sign immediately or nothing works)."""
         with self._lock:
-            if (
-                self._current is None
-                or time.time() - self._current.created > self.rotation_s
-            ):
-                pass  # rotation is caller-driven (background service)
-            return self._current
+            if self._current is None or self.activation_s <= 0:
+                return self._current
+            cutoff = time.time() - self.activation_s
+            eligible = [k for k in self._keys.values()
+                        if k.created <= cutoff]
+            if not eligible:
+                return self._current
+            return max(eligible, key=lambda k: k.created)
 
     def get(self, key_id: str) -> Optional[SecretKey]:
         return self._keys.get(key_id)
 
     def import_key(self, key: SecretKey) -> None:
-        """Distribute secrets to verifiers (SCM -> DN in the reference)."""
+        """Distribute secrets to verifiers (SCM -> DN in the reference).
+        The newest imported key becomes the signing key, so a follower
+        OM or a datanode-side self-issuer always signs with the same key
+        the cluster verifies against."""
         with self._lock:
             self._keys[key.key_id] = key
-            if self._current is None:
+            if self._current is None or key.created > self._current.created:
                 self._current = key
+            # verifier-side managers never call rotate(), so expired
+            # material is pruned here or it accumulates forever
+            now = time.time()
+            for kid in [k2 for k2, v in self._keys.items()
+                        if v.expires < now]:
+                del self._keys[kid]
+
+    def needs_rotation(self) -> bool:
+        cur = self._current
+        return cur is None or time.time() - cur.created > self.rotation_s
+
+    def new_key(self) -> SecretKey:
+        """Mint a fresh key WITHOUT installing it (HA: the leader mints,
+        replicates through the ring, and every replica — itself included
+        — installs via import_key when the decision applies)."""
+        now = time.time()
+        return SecretKey(
+            key_id=secrets.token_hex(8),
+            material=secrets.token_bytes(32),
+            created=now,
+            expires=now + self.lifetime_s,
+        )
+
+    def export_keys(self) -> list[dict]:
+        """All non-expired keys, for distribution to verifiers."""
+        now = time.time()
+        with self._lock:
+            return [k.to_json() for k in self._keys.values()
+                    if k.expires >= now]
+
+    def import_keys(self, keys: list[dict]) -> None:
+        for d in keys:
+            self.import_key(SecretKey.from_json(d))
 
 
-def _payload(block_id: BlockID, modes: list[AccessMode], owner: str,
+def _payload(scope: str, subject, modes: list[AccessMode], owner: str,
              expiry: float, key_id: str) -> bytes:
+    """Signed bytes. `scope` separates block ("b") from container ("c")
+    tokens so one can never be replayed as the other (the reference keeps
+    OzoneBlockTokenIdentifier and ContainerTokenIdentifier distinct)."""
     return json.dumps(
         {
-            "b": block_id.to_json(),
+            "s": scope,
+            "b": subject,
             "m": sorted(m.value for m in modes),
             "o": owner,
             "e": round(expiry, 3),
@@ -105,27 +177,44 @@ def _payload(block_id: BlockID, modes: list[AccessMode], owner: str,
 
 
 class BlockTokenIssuer:
-    """OM/SCM-side token minting (OzoneBlockTokenSecretManager analog)."""
+    """OM/SCM-side token minting (OzoneBlockTokenSecretManager +
+    ContainerTokenSecretManager analog). Datanodes build one over their
+    imported keys to self-sign reconstruction traffic, the way the
+    reference's ec/reconstruction/TokenHelper does."""
 
     def __init__(self, secrets_mgr: SecretKeyManager,
                  token_lifetime_s: float = 600.0):
         self.secrets = secrets_mgr
         self.lifetime = token_lifetime_s
 
-    def issue(self, block_id: BlockID, modes: list[AccessMode],
-              owner: str = "client") -> dict:
+    def _sign(self, scope: str, subject, modes: list[AccessMode],
+              owner: str) -> dict:
         key = self.secrets.current()
+        if key is None:
+            raise TokenError("no signing key available")
         expiry = time.time() + self.lifetime
-        payload = _payload(block_id, modes, owner, expiry, key.key_id)
+        payload = _payload(scope, subject, modes, owner, expiry, key.key_id)
         sig = hmac.new(key.material, payload, hashlib.sha256).hexdigest()
         return {
-            "block_id": block_id.to_json(),
+            "scope": scope,
+            "subject": subject,
             "modes": sorted(m.value for m in modes),
             "owner": owner,
             "expiry": round(expiry, 3),
             "key_id": key.key_id,
             "sig": sig,
         }
+
+    def issue(self, block_id: BlockID, modes: list[AccessMode],
+              owner: str = "client") -> dict:
+        return self._sign("b", block_id.to_json(), modes, owner)
+
+    def issue_container(self, container_id: int,
+                        modes: Optional[list[AccessMode]] = None,
+                        owner: str = "client") -> dict:
+        return self._sign("c", int(container_id),
+                          modes or [AccessMode.READ, AccessMode.WRITE],
+                          owner)
 
 
 class BlockTokenVerifier:
@@ -135,25 +224,32 @@ class BlockTokenVerifier:
         self.secrets = secrets_mgr
         self.enabled = enabled
 
-    def verify(self, token: Optional[dict], block_id: BlockID,
-               mode: AccessMode) -> None:
+    def _check(self, token: Optional[dict], scope: str, subject,
+               what: str, mode: AccessMode) -> None:
         if not self.enabled:
             return
         if token is None:
-            raise TokenError("missing block token")
+            raise TokenError(f"missing {what} token")
+        if token.get("scope", "b") != scope:
+            raise TokenError(f"not a {what} token")
         if token.get("expiry", 0) < time.time():
-            raise TokenError("block token expired")
+            raise TokenError(f"{what} token expired")
         if mode.value not in token.get("modes", []):
             raise TokenError(f"token lacks {mode.value} access")
-        tb = BlockID.from_json(token["block_id"])
-        if tb != block_id:
-            raise TokenError(f"token is for {tb}, not {block_id}")
+        if token.get("subject") != subject:
+            raise TokenError(
+                f"token is for {token.get('subject')}, not {subject}")
         key = self.secrets.get(token.get("key_id", ""))
         if key is None:
             raise TokenError("unknown/expired secret key")
+        try:
+            modes = [AccessMode(m) for m in token["modes"]]
+        except ValueError as e:
+            raise TokenError(f"malformed token mode: {e}")
         payload = _payload(
-            block_id,
-            [AccessMode(m) for m in token["modes"]],
+            scope,
+            subject,
+            modes,
             token.get("owner", ""),
             token["expiry"],
             token["key_id"],
@@ -161,3 +257,11 @@ class BlockTokenVerifier:
         expect = hmac.new(key.material, payload, hashlib.sha256).hexdigest()
         if not hmac.compare_digest(expect, token.get("sig", "")):
             raise TokenError("bad token signature")
+
+    def verify(self, token: Optional[dict], block_id: BlockID,
+               mode: AccessMode) -> None:
+        self._check(token, "b", block_id.to_json(), "block", mode)
+
+    def verify_container(self, token: Optional[dict], container_id: int,
+                         mode: AccessMode = AccessMode.WRITE) -> None:
+        self._check(token, "c", int(container_id), "container", mode)

@@ -1,0 +1,326 @@
+"""Block/container token enforcement on the datanode datapath.
+
+The reference verifies a token on every dispatcher op
+(hadoop-hdds/container-service HddsDispatcher + framework
+BlockTokenVerifier.java); these tests prove the same over real gRPC:
+a secure cluster serves tokened clients normally and refuses untokened,
+mis-scoped, wrong-block, and expired requests with
+BLOCK_TOKEN_VERIFICATION_FAILED.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from ozone_tpu.client.dn_client import DatanodeClientFactory, TokenStore
+from ozone_tpu.client.ozone_client import OzoneClient
+from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+from ozone_tpu.net.dn_service import GrpcDatanodeClient
+from ozone_tpu.net.om_service import GrpcOmClient
+from ozone_tpu.storage.ids import BlockID, ChunkInfo, StorageError
+from ozone_tpu.utils.checksum import Checksum, ChecksumType
+from ozone_tpu.utils.security import (
+    AccessMode,
+    BlockTokenIssuer,
+    BlockTokenVerifier,
+    SecretKeyManager,
+    TokenError,
+)
+
+EC = "rs-3-2-4096"
+
+
+# ---------------------------------------------------------------- unit level
+def test_container_token_roundtrip():
+    keys = SecretKeyManager()
+    issuer = BlockTokenIssuer(keys)
+    verifier = BlockTokenVerifier(keys)
+    tok = issuer.issue_container(42)
+    verifier.verify_container(tok, 42)
+    with pytest.raises(TokenError):
+        verifier.verify_container(tok, 43)
+
+
+def test_scope_confusion_refused():
+    """A block token must not authorize container ops and vice versa."""
+    keys = SecretKeyManager()
+    issuer = BlockTokenIssuer(keys)
+    verifier = BlockTokenVerifier(keys)
+    btok = issuer.issue(BlockID(7, 1), [AccessMode.READ, AccessMode.WRITE])
+    ctok = issuer.issue_container(7)
+    with pytest.raises(TokenError):
+        verifier.verify_container(btok, 7)
+    with pytest.raises(TokenError):
+        verifier.verify(ctok, BlockID(7, 1), AccessMode.READ)
+
+
+def test_token_store_self_issuer():
+    """Datanode-side TokenHelper analog: with the secret keys installed,
+    the store mints tokens for blocks it has never seen."""
+    keys = SecretKeyManager()
+    store = TokenStore(issuer=BlockTokenIssuer(keys))
+    verifier = BlockTokenVerifier(keys)
+    tok = store.block_token(BlockID(5, 9))
+    verifier.verify(tok, BlockID(5, 9), AccessMode.WRITE)
+    ctok = store.container_token(5)
+    verifier.verify_container(ctok, 5)
+
+
+def test_secret_key_export_import():
+    src = SecretKeyManager()
+    dst = SecretKeyManager(generate=False)
+    assert dst.current() is None
+    dst.import_keys(src.export_keys())
+    issuer = BlockTokenIssuer(src)
+    tok = issuer.issue(BlockID(1, 1), [AccessMode.READ])
+    BlockTokenVerifier(dst).verify(tok, BlockID(1, 1), AccessMode.READ)
+
+
+# ------------------------------------------------------------- secure cluster
+#: the full reference security posture: mutual TLS on every channel
+#: (the CA lives in the SCM; datanodes enroll over the plaintext
+#: CSR endpoint gated by a bootstrap secret) + HMAC block tokens
+#: enforced on the datapath. Secret keys ride only the mTLS channels.
+ENROLL_SECRET = "drill-secret"
+
+
+@pytest.fixture(scope="module")
+def secure_cluster(tmp_path_factory):
+    tmp_path = tmp_path_factory.mktemp("secure")
+    meta = ScmOmDaemon(
+        tmp_path / "om.db",
+        block_size=4 * 4096,
+        container_size=1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+        background_interval_s=0.2,
+        block_tokens=True,
+        secure=True,
+        enrollment_secret=ENROLL_SECRET,
+    )
+    meta.start()
+    dns = []
+    for i in range(5):
+        d = DatanodeDaemon(
+            tmp_path / f"dn{i}", f"dn{i}", meta.address,
+            heartbeat_interval_s=0.2,
+            ca_address=meta.enroll_address,
+            enrollment_secret=ENROLL_SECRET,
+        )
+        d.start()
+        dns.append(d)
+    yield meta, dns
+    for d in dns:
+        d.stop()
+    meta.stop()
+
+
+@pytest.fixture(scope="module")
+def client_tls(secure_cluster, tmp_path_factory):
+    """An enrolled CLIENT certificate: the mTLS ticket onto the wire —
+    deliberately separate from any token, so the tests can model an
+    authenticated-but-unauthorized caller."""
+    from ozone_tpu.utils.ca import CertificateClient
+
+    meta, _ = secure_cluster
+    cc = CertificateClient(tmp_path_factory.mktemp("cli"), "client-cli")
+    cc.enroll_remote(meta.enroll_address, secret=ENROLL_SECRET)
+    return cc.tls()
+
+
+def _client(meta, tls=None) -> OzoneClient:
+    clients = DatanodeClientFactory()
+    clients.tls = tls
+    om = GrpcOmClient(meta.address, clients=clients, tls=tls)
+    return OzoneClient(om, clients)
+
+
+def test_enforcement_active_on_datanodes(secure_cluster):
+    meta, dns = secure_cluster
+    assert meta.scm.block_tokens
+    assert meta.om.token_issuer is not None
+    for d in dns:
+        assert d.verifier.enabled, f"{d.dn.id} never enabled enforcement"
+        assert d.secrets.current() is not None
+
+
+def test_tokened_write_and_read(secure_cluster, client_tls):
+    """The normal client path works unchanged: allocation carries WRITE
+    tokens, lookup mints READ tokens, everything verifies on the DN."""
+    meta, dns = secure_cluster
+    oz = _client(meta, client_tls)
+    b = oz.create_volume("v").create_bucket("b", replication=EC)
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 50_000, dtype=np.uint8)
+    b.write_key("k", data)
+    assert np.array_equal(b.read_key("k"), data)
+
+
+def test_untokened_write_refused(secure_cluster, client_tls):
+    """An AUTHENTICATED caller (holds a CA cert, so it gets through the
+    mTLS handshake) without tokens must NOT be able to write — the
+    round-1 gap: machinery existed, the wire never checked."""
+    meta, dns = secure_cluster
+    c = GrpcDatanodeClient("dn0", dns[0].address, tls=client_tls)
+    data = np.zeros(512, dtype=np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 4096).compute(data)
+    info = ChunkInfo("c0", 0, data.size, cs)
+    with pytest.raises(StorageError) as e:
+        c.create_container(7777)
+    assert e.value.code == "BLOCK_TOKEN_VERIFICATION_FAILED"
+    with pytest.raises(StorageError) as e:
+        c.write_chunk(BlockID(7777, 1), info, data)
+    assert e.value.code == "BLOCK_TOKEN_VERIFICATION_FAILED"
+    c.close()
+
+
+def test_untokened_read_refused(secure_cluster, client_tls):
+    """Committed data is unreadable without a token, per verb."""
+    meta, dns = secure_cluster
+    oz = _client(meta, client_tls)
+    b = oz.get_volume("v").get_bucket("b")
+    info = oz.om.lookup_key("v", "b", "k")
+    g = info["block_groups"][0]
+    bid = BlockID(int(g["container_id"]), int(g["local_id"]))
+    dn_id = g["nodes"][0]
+    addr = next(d.address for d in dns if d.dn.id == dn_id)
+    c = GrpcDatanodeClient(dn_id, addr, tls=client_tls)  # no token store
+    with pytest.raises(StorageError) as e:
+        c.get_block(bid)
+    assert e.value.code == "BLOCK_TOKEN_VERIFICATION_FAILED"
+    with pytest.raises(StorageError) as e:
+        c.list_blocks(bid.container_id)
+    assert e.value.code == "BLOCK_TOKEN_VERIFICATION_FAILED"
+    with pytest.raises(StorageError) as e:
+        c.get_committed_block_length(bid)
+    assert e.value.code == "BLOCK_TOKEN_VERIFICATION_FAILED"
+    c.close()
+
+
+def test_wrong_block_token_refused(secure_cluster, client_tls):
+    """A valid token for block A does not open block B."""
+    meta, dns = secure_cluster
+    oz = _client(meta, client_tls)
+    info = oz.om.lookup_key("v", "b", "k")
+    g = info["block_groups"][0]
+    bid = BlockID(int(g["container_id"]), int(g["local_id"]))
+    other = BlockID(bid.container_id, bid.local_id + 999)
+    # mint a REAL token (signed with the cluster key) for a different block
+    tok = meta.om.token_issuer.issue(other, [AccessMode.READ])
+    dn_id = g["nodes"][0]
+    addr = next(d.address for d in dns if d.dn.id == dn_id)
+    store = TokenStore()
+    store.put_block_token(bid, tok)  # deliberately mismatched
+    c = GrpcDatanodeClient(dn_id, addr, tokens=store, tls=client_tls)
+    with pytest.raises(StorageError) as e:
+        c.get_block(bid)
+    assert e.value.code == "BLOCK_TOKEN_VERIFICATION_FAILED"
+    c.close()
+
+
+def test_expired_token_refused(secure_cluster, client_tls):
+    meta, dns = secure_cluster
+    oz = _client(meta, client_tls)
+    info = oz.om.lookup_key("v", "b", "k")
+    g = info["block_groups"][0]
+    bid = BlockID(int(g["container_id"]), int(g["local_id"]))
+    issuer = BlockTokenIssuer(meta.scm.secret_keys, token_lifetime_s=-1.0)
+    tok = issuer.issue(bid, [AccessMode.READ])
+    dn_id = g["nodes"][0]
+    addr = next(d.address for d in dns if d.dn.id == dn_id)
+    store = TokenStore()
+    store.put_block_token(bid, tok)
+    c = GrpcDatanodeClient(dn_id, addr, tokens=store, tls=client_tls)
+    with pytest.raises(StorageError) as e:
+        c.get_block(bid)
+    assert e.value.code == "BLOCK_TOKEN_VERIFICATION_FAILED"
+    c.close()
+
+
+def test_mode_enforced(secure_cluster, client_tls):
+    """A READ token does not authorize writes on the same block."""
+    meta, dns = secure_cluster
+    oz = _client(meta, client_tls)
+    info = oz.om.lookup_key("v", "b", "k")
+    g = info["block_groups"][0]
+    bid = BlockID(int(g["container_id"]), int(g["local_id"]))
+    tok = meta.om.token_issuer.issue(bid, [AccessMode.READ])
+    dn_id = g["nodes"][0]
+    addr = next(d.address for d in dns if d.dn.id == dn_id)
+    store = TokenStore()
+    store.put_block_token(bid, tok)
+    c = GrpcDatanodeClient(dn_id, addr, tokens=store, tls=client_tls)
+    c.get_block(bid)  # READ is fine
+    data = np.zeros(16, dtype=np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 4096).compute(data)
+    with pytest.raises(StorageError) as e:
+        c.write_chunk(bid, ChunkInfo("cx", 10**9, 16, cs), data)
+    assert e.value.code == "BLOCK_TOKEN_VERIFICATION_FAILED"
+    c.close()
+
+
+def test_reconstruction_self_signs(secure_cluster, client_tls):
+    """Datanode-to-datanode repair traffic self-signs with the imported
+    secret keys (ec/reconstruction/TokenHelper.java analog) — kill a
+    replica, let the replication manager reconstruct it."""
+    meta, dns = secure_cluster
+    oz = _client(meta, client_tls)
+    b = oz.get_volume("v").get_bucket("b")
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, 30_000, dtype=np.uint8)
+    b.write_key("k2", data)
+    info = oz.om.lookup_key("v", "b", "k2")
+    g = info["block_groups"][0]
+    cid = int(g["container_id"])
+    # close the container everywhere so reconstruction may run
+    for d in dns:
+        if d.dn.id in g["nodes"]:
+            try:
+                d.dn.close_container(cid)
+            except StorageError:
+                pass
+    victim_id = g["nodes"][0]
+    victim = next(d for d in dns if d.dn.id == victim_id)
+    victim.dn.delete_container(cid, force=True)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            if victim.dn.get_container(cid) is not None:
+                break
+        except StorageError:
+            pass
+        time.sleep(0.3)
+    else:
+        pytest.fail("reconstruction did not restore the replica")
+    assert np.array_equal(b.read_key("k2"), data)
+
+
+def test_uncertified_caller_rejected_at_transport(secure_cluster):
+    """No CA-issued certificate -> the mTLS handshake itself fails; the
+    caller never reaches a verb, let alone the secret keys (closes the
+    bypass where anyone could Register and receive the signing keys)."""
+    meta, dns = secure_cluster
+    c = GrpcDatanodeClient("dn0", dns[0].address)  # plaintext channel
+    with pytest.raises(StorageError) as e:
+        c.echo(b"hi")
+    assert e.value.code in ("UNAVAILABLE", "IO_EXCEPTION")
+    c.close()
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    scm = GrpcScmClient(meta.address)  # plaintext against the mTLS plane
+    with pytest.raises(StorageError):
+        scm.register("evil", "127.0.0.1:1", rack="/r")
+    assert not scm.security.get("secret_keys")
+    scm.close()
+
+
+def test_bad_enrollment_secret_refused(secure_cluster, tmp_path):
+    """The bootstrap secret gates certificate issuance."""
+    from ozone_tpu.utils.ca import CertificateClient
+
+    meta, _ = secure_cluster
+    cc = CertificateClient(tmp_path / "rogue", "client-rogue")
+    with pytest.raises(StorageError):
+        cc.enroll_remote(meta.enroll_address, secret="wrong")
+    assert not cc.enrolled
