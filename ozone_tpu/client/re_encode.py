@@ -22,7 +22,12 @@ from ozone_tpu.client.replicated import ReplicatedKeyReader
 from ozone_tpu.om.om import OzoneManager
 from ozone_tpu.om import requests as rq
 from ozone_tpu.scm.pipeline import ReplicationConfig, ReplicationType
-from ozone_tpu.storage.ids import BlockID
+from ozone_tpu.storage.ids import (
+    BlockData,
+    BlockID,
+    ChunkInfo,
+    StorageError,
+)
 from ozone_tpu.utils.checksum import ChecksumType
 
 log = logging.getLogger(__name__)
@@ -36,11 +41,18 @@ def re_encode_key_to_ec(
     key: str,
     ec: str = "rs-6-3-1024k",
 ) -> dict:
-    """Convert one replicated key to EC. Returns the new key info."""
+    """Convert one replicated or XOR(1)-coded key to RS EC. Returns the
+    new key info. A replicated source streams through the standard EC
+    writer; an XOR source with a lost data unit takes the fused
+    decode->re-encode path (BASELINE config #4) — one device dispatch
+    recovers the unit AND produces the RS layout."""
     info = om.lookup_key(volume, bucket, key)
     old_groups = om.key_block_groups(info)
     repl = ReplicationConfig.parse(info["replication"])
     if repl.type is ReplicationType.EC:
+        if repl.ec.codec == "xor":
+            return re_encode_xor_key_to_rs(om, clients, volume, bucket,
+                                           key, ec)
         raise ValueError(f"{key} is already erasure coded ({repl})")
 
     ec_conf = ReplicationConfig.parse(ec)
@@ -68,5 +80,153 @@ def re_encode_key_to_ec(
         "re-encoded %s/%s/%s: %d bytes, %d replicated groups -> %d EC groups",
         volume, bucket, key, writer.bytes_written, len(old_groups),
         len(groups),
+    )
+    return om.lookup_key(volume, bucket, key)
+
+
+def _read_unit_cells(clients, group, unit, stripes, cell):
+    """One unit's cells as [stripes, cell] zero-padded, or None if the
+    replica is unreachable/missing."""
+    dn_id = group.pipeline.nodes[unit]
+    try:
+        client = clients.get(dn_id)
+        bd = client.get_block(group.block_id)
+    except Exception:  # noqa: BLE001 - any failure = unit unavailable
+        return None
+    out = np.zeros((stripes, cell), dtype=np.uint8)
+    for info in bd.chunks:
+        s = info.offset // cell
+        if s < stripes:
+            data = client.read_chunk(group.block_id, info)
+            out[s, : info.length] = data[: info.length]
+    return out
+
+
+def re_encode_xor_key_to_rs(
+    om: OzoneManager,
+    clients: DatanodeClientFactory,
+    volume: str,
+    bucket: str,
+    key: str,
+    ec: str = "rs-6-3-1024k",
+) -> dict:
+    """Convert an XOR(1)-coded key to RS(k,p), surviving one lost data
+    unit per group — the BASELINE config #4 path. The XOR decode and the
+    RS parity generation compose into ONE bit-linear device dispatch
+    (codec/fused.make_fused_reencoder), and the RS layout is written
+    straight to the freshly allocated group with the device-computed
+    CRCs (reference analog: XORRawDecoder.decode + RSRawEncoder.encode
+    inside the container-service conversion flow)."""
+    from ozone_tpu.client.ec_writer import (
+        block_lengths,
+        create_group_containers,
+    )
+    from ozone_tpu.codec.fused import (
+        FusedSpec,
+        effective_bpc,
+        make_fused_reencoder,
+        reencode_layout_crcs,
+    )
+    from ozone_tpu.utils.checksum import Checksum, ChecksumData
+
+    info = om.lookup_key(volume, bucket, key)
+    old_groups = om.key_block_groups(info)
+    src = ReplicationConfig.parse(info["replication"])
+    dst = ReplicationConfig.parse(ec)
+    if src.type is not ReplicationType.EC or src.ec.codec != "xor":
+        raise ValueError(f"{key} is not XOR-coded ({src})")
+    if dst.type is not ReplicationType.EC or dst.ec.codec != "rs":
+        raise ValueError(f"target must be RS EC, got {dst}")
+    k, cell = src.ec.data_units, src.ec.cell_size
+    if (dst.ec.data_units, dst.ec.cell_size) != (k, cell):
+        raise ValueError(
+            f"XOR->RS re-encode needs matching data units and cell size "
+            f"({src} -> {dst})")
+    ctype = ChecksumType(info.get("checksum_type", "CRC32C"))
+    bpc = effective_bpc(cell, info.get("bytes_per_checksum", 16 * 1024))
+    spec = FusedSpec(dst.ec, ctype, bpc)
+    host_checksum = Checksum(ctype, bpc)
+    p = dst.ec.parity_units
+
+    session = om.open_key(volume, bucket, key, replication=ec)
+    new_groups = []
+    total = 0
+    for g in old_groups:
+        stripes = -(-g.length // (k * cell))
+        # read the k input slots: data units where alive, the XOR parity
+        # in the lost unit's slot (or in slot 0 when nothing is lost —
+        # same IO volume, one uniform device program)
+        units = [
+            _read_unit_cells(clients, g, u, stripes, cell) for u in range(k)
+        ]
+        missing = [u for u, x in enumerate(units) if x is None]
+        if len(missing) > 1:
+            raise StorageError(
+                "INSUFFICIENT_LOCATIONS",
+                f"group {g.block_id}: {len(missing)} data units lost, "
+                f"XOR(1) tolerates one")
+        lost = missing[0] if missing else 0
+        parity_cells = _read_unit_cells(clients, g, k, stripes, cell)
+        if parity_cells is None:
+            if missing:
+                raise StorageError(
+                    "INSUFFICIENT_LOCATIONS",
+                    f"group {g.block_id}: data unit {lost} AND the XOR "
+                    f"parity are gone")
+            # no loss at all: slot 0 keeps its data; the device recovery
+            # output is discarded in favor of the original unit below
+            parity_cells = units[0]
+        units[lost] = parity_cells
+        batch = np.stack(units, axis=1)  # [S, k, C]
+
+        # the recovered slot is correct in BOTH cases: with a loss it is
+        # the decode; without one it equals the original unit 0 (XOR of
+        # parity and units 1..k-1), so writing it doubles as a parity
+        # consistency check
+        fn = make_fused_reencoder(spec, lost=lost)
+        out, ucrcs, ocrcs = (np.asarray(x) for x in fn(batch))
+        crcs = reencode_layout_crcs(ucrcs, ocrcs, lost)
+
+        ng = om.allocate_block(session)
+        create_group_containers(clients, ng, replica_indexed=True)
+        lengths = block_lengths(g.length, k, cell) + [
+            stripes * cell
+        ] * p
+        for u in range(k + p):
+            if u < k:
+                cells = out[:, 0] if u == lost else batch[:, u]
+            else:
+                cells = out[:, 1 + (u - k)]
+            dn = clients.get(ng.pipeline.nodes[u])
+            chunks = []
+            for s in range(stripes):
+                chunk_len = max(0, min(cell, lengths[u] - s * cell))
+                if chunk_len == 0:
+                    continue
+                if chunk_len == cell and cell % bpc == 0 and crcs.size:
+                    cs = ChecksumData(ctype, bpc, tuple(
+                        int(v).to_bytes(4, "big")
+                        for v in crcs[s, u].tolist()))
+                else:
+                    cs = host_checksum.compute(cells[s, :chunk_len])
+                ci = ChunkInfo(
+                    name=f"{ng.block_id}_chunk_{s}",
+                    offset=s * cell,
+                    length=chunk_len,
+                    checksum=cs,
+                )
+                dn.write_chunk(ng.block_id, ci, cells[s, :chunk_len])
+                chunks.append(ci)
+            dn.put_block(BlockData(
+                ng.block_id, chunks, block_group_length=g.length))
+        ng.length = g.length
+        new_groups.append(ng)
+        total += g.length
+
+    om.submit(rq.DeleteKey(volume, bucket, key))
+    om.commit_key(session, new_groups, total)
+    log.info(
+        "fused XOR->RS re-encode %s/%s/%s: %d bytes, %d groups",
+        volume, bucket, key, total, len(new_groups),
     )
     return om.lookup_key(volume, bucket, key)

@@ -58,11 +58,38 @@ class FusedSpec:
         )
 
 
+def _parity_matrix(options: CoderOptions) -> np.ndarray:
+    """p x k GF(2^8) parity generator for the option's codec: Cauchy for
+    RS, the all-ones row for XOR single parity (XORRawEncoder semantics —
+    parity = XOR of the k data units, coefficient 1 each)."""
+    if options.codec == "xor":
+        if options.parity_units != 1:
+            raise ValueError("xor codec has exactly one parity unit")
+        return np.ones((1, options.data_units), dtype=np.uint8)
+    return rs_math.parity_matrix(options.data_units, options.parity_units)
+
+
+def _decode_matrix(options: CoderOptions, valid: list[int],
+                   erased: list[int]) -> np.ndarray:
+    """e x len(valid) GF(2^8) recovery matrix. RS inverts the surviving
+    k x k submatrix (RSRawDecoder.java:133-157); XOR recovers its single
+    erasable unit as the XOR of everything else (XORRawDecoder)."""
+    if options.codec == "xor":
+        if len(erased) != 1:
+            raise ValueError("xor codec recovers at most one erasure")
+        if len(valid) != options.data_units:
+            raise ValueError("xor decode needs all other units")
+        if erased[0] == options.data_units:
+            # the parity itself: re-encode from the k data units
+            return np.ones((1, options.data_units), dtype=np.uint8)
+        return np.ones((1, len(valid)), dtype=np.uint8)
+    return rs_math.decode_matrix(
+        options.data_units, options.parity_units, list(erased), list(valid))
+
+
 @lru_cache(maxsize=16)
 def _fused_encode_cached(options: CoderOptions, checksum: ChecksumType, bpc: int):
-    a_np = expand_coding_matrix(
-        rs_math.parity_matrix(options.data_units, options.parity_units)
-    )
+    a_np = expand_coding_matrix(_parity_matrix(options))
     a = jnp.asarray(a_np, dtype=jnp.int8)
     if checksum in _POLY:
         k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
@@ -106,9 +133,7 @@ def _fused_decode_cached(
     valid: tuple,
     erased: tuple,
 ):
-    dm = rs_math.decode_matrix(
-        options.data_units, options.parity_units, list(erased), list(valid)
-    )
+    dm = _decode_matrix(options, list(valid), list(erased))
     a = jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
     if checksum in _POLY:
         k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
@@ -134,4 +159,79 @@ def make_fused_decoder(spec: FusedSpec, valid: list[int], erased: list[int]):
     return _fused_decode_cached(
         spec.options, spec.checksum, spec.bytes_per_checksum,
         tuple(valid), tuple(erased),
+    )
+
+
+@lru_cache(maxsize=16)
+def _fused_reencode_cached(options: CoderOptions, checksum: ChecksumType,
+                           bpc: int, lost: int):
+    """XOR(1)-decode -> RS(k,p)-encode as ONE bit-linear matrix.
+
+    The XOR decode (recover unit `lost` from the k-1 survivors plus the
+    XOR parity) and the RS parity generation are both GF(2^8)-linear, so
+    their composition is a single matrix: M = [D[lost] ; P @ D], where D
+    is the k x k XOR-decode matrix (identity rows for survivors, the
+    all-ones row for the lost unit) and P the Cauchy parity matrix.
+    Precomputing M host-side (gf_matmul) collapses what the reference
+    runs as XORRawDecoder.decode followed by RSRawEncoder.encode — and
+    what round 1 ran as two device dispatches with an HBM round trip —
+    into one gf_apply + fused CRC pass."""
+    from ozone_tpu.codec.gf256 import gf_matmul
+
+    k, p = options.data_units, options.parity_units
+    d = np.eye(k, dtype=np.uint8)
+    # input slot `lost` holds the XOR parity; over GF(2) the lost unit is
+    # the XOR of ALL k input slots (survivors + parity)
+    d[lost, :] = 1
+    pm = rs_math.parity_matrix(k, p)
+    m = np.vstack([d[lost:lost + 1], gf_matmul(pm, d)])
+    a = jnp.asarray(expand_coding_matrix(m), dtype=jnp.int8)
+    if checksum in _POLY:
+        k_np, zeros_crc = crc_device.crc_constants_planemajor(
+            bpc, _POLY[checksum])
+        k_dev = jnp.asarray(k_np)
+    else:
+        k_dev, zeros_crc = None, 0
+
+    @jax.jit
+    def fn(units: jax.Array):
+        out = gf_apply(units, a)  # [B, 1+p, C]: recovered unit, parity
+        if k_dev is None:
+            empty = jnp.zeros((units.shape[0], 0, 0), jnp.uint32)
+            return out, empty, empty
+        # CRCs stay in producer order — slicing/interleaving the big
+        # byte tensors on device would re-write the whole output through
+        # HBM (measured ~35% of the dispatch); the CRC arrays are tiny
+        # and the host assembles the k+p layout order for free
+        return (out,
+                crc_device.crc_slices(units, k_dev, zeros_crc),
+                crc_device.crc_slices(out, k_dev, zeros_crc))
+
+    return fn
+
+
+def make_fused_reencoder(spec: FusedSpec, lost: int = 0):
+    """jitted fn(units uint8 [B, k, C]) -> (out [B, 1+p, C],
+    units_crcs uint32 [B, k, S], out_crcs uint32 [B, 1+p, S]).
+
+    `units` carries the XOR(1) group with data unit `lost` replaced by
+    the XOR parity in its slot; the single dispatch recovers the lost
+    unit (out[:, 0]), produces the RS parity of the full group
+    (out[:, 1:]), and checksums every unit (BASELINE config #4 without
+    the lost unit ever round-tripping through HBM between decode and
+    encode). `reencode_layout_crcs` assembles the k+p EC-layout CRC
+    order host-side; units_crcs[:, lost] checksums the XOR parity slot
+    and is simply unused."""
+    return _fused_reencode_cached(
+        spec.options, spec.checksum, spec.bytes_per_checksum, int(lost))
+
+
+def reencode_layout_crcs(units_crcs: np.ndarray, out_crcs: np.ndarray,
+                         lost: int) -> np.ndarray:
+    """Assemble re-encode CRCs into EC layout order [B, k+p, S]: data
+    units 0..k-1 (the recovered unit in slot `lost`), then parity."""
+    return np.concatenate(
+        [units_crcs[:, :lost], out_crcs[:, :1],
+         units_crcs[:, lost + 1:], out_crcs[:, 1:]],
+        axis=1,
     )

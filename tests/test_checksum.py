@@ -115,6 +115,39 @@ def test_fused_encode_crc():
                 )
 
 
+def test_fused_reencode_crc():
+    """XOR(1)->RS re-encode as one composed matrix: recovering the lost
+    unit, the RS parity of the full group, and the CRCs of the whole EC
+    layout must all match the two-step reference computation."""
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.codec.fused import (
+        FusedSpec,
+        make_fused_reencoder,
+        reencode_layout_crcs,
+    )
+    from ozone_tpu.codec.numpy_coder import NumpyRSEncoder
+
+    rng = np.random.default_rng(7)
+    opts = CoderOptions(6, 3, "rs", cell_size=2048)
+    spec = FusedSpec(opts, ChecksumType.CRC32C, bytes_per_checksum=512)
+    data = rng.integers(0, 256, (2, 6, 2048), dtype=np.uint8)
+    for lost in (0, 3, 5):
+        units = data.copy()
+        # slot `lost` carries the XOR parity of the FULL group
+        units[:, lost] = np.bitwise_xor.reduce(data, axis=1)
+        fn = make_fused_reencoder(spec, lost=lost)
+        out, ucrcs, ocrcs = (np.asarray(x) for x in fn(units))
+        assert np.array_equal(out[:, 0], data[:, lost])
+        assert np.array_equal(out[:, 1:], NumpyRSEncoder(opts).encode(data))
+        crcs = reencode_layout_crcs(ucrcs, ocrcs, lost)
+        layout = np.concatenate([data, out[:, 1:]], axis=1)
+        for b in range(2):
+            for u in range(9):
+                for s in range(4):
+                    assert int(crcs[b, u, s]) == cs.crc32c(
+                        layout[b, u, s * 512:(s + 1) * 512])
+
+
 def test_fused_decode_crc():
     from ozone_tpu.codec.api import CoderOptions
     from ozone_tpu.codec.fused import FusedSpec, make_fused_decoder
