@@ -166,6 +166,38 @@ def bench_xor_reencode(batch: int = 128, cell: int = 1024 * 1024,
                        label="reencode")
 
 
+def bench_sharded_pipeline(batch: int = 128, cell: int = 1024 * 1024,
+                           iters: int = 10, rounds: int = 4) -> dict:
+    """BASELINE config #5's measurable half on this 1-chip environment:
+    the SAME sharded program (parallel/sharded.py DP fused encode, jit
+    with explicit NamedShardings over a Mesh) on a 1-device mesh. DP is
+    collective-free — per-chip throughput is what each of N chips
+    sustains, so matching the unsharded single-chip rate here validates
+    that the sharded pipeline adds no overhead; the N-chip aggregate is
+    N x this (ICI only enters the TP/ring paths, modeled in PERF.md)."""
+    import jax
+
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.codec.fused import FusedSpec
+    from ozone_tpu.parallel.sharded import (
+        make_mesh,
+        make_sharded_fused_encoder,
+    )
+    from ozone_tpu.utils.checksum import ChecksumType
+
+    mesh = make_mesh(1)
+    opts = CoderOptions(6, 3, "rs", cell_size=cell)
+    spec = FusedSpec(opts, ChecksumType.CRC32C, bytes_per_checksum=16 * 1024)
+    fn = make_sharded_fused_encoder(spec, mesh)
+    rng = np.random.default_rng(5)
+    data = jax.device_put(
+        rng.integers(0, 256, (batch, 6, cell), dtype=np.uint8)
+    )
+    gib = batch * 6 * cell / 2**30
+    return _run_rounds(fn, data, gib, iters, rounds, warmups=2,
+                       label="sharded-dp")
+
+
 def bench_cpu_reference(cell: int = 1024 * 1024) -> float:
     """Config #1: in-process numpy RawErasureEncoder.encode() RS(3,2)."""
     from ozone_tpu.codec import create_encoder
@@ -244,6 +276,12 @@ def main() -> None:
             f"GiB/s/chip (range {re['min']:.2f}-{re['best']:.2f})")
     except Exception as e:
         log(f"re-encode bench failed: {e}")
+    try:
+        sh = bench_sharded_pipeline()
+        log(f"sharded-pipeline DP encode (1-device mesh): median "
+            f"{sh['median']:.2f} GiB/s/chip — config #5 per-chip rate")
+    except Exception as e:
+        log(f"sharded bench failed: {e}")
     try:
         isal = bench_cpp_fused()
         log(f"C++ (ISA-L-class) fused encode+CRC baseline: {isal:.2f} GiB/s")

@@ -267,9 +267,14 @@ def _ring_decoder_cached(
         mesh=mesh,
         in_specs=(P(None, axis, None), P(axis, None)),
         out_specs=P(None, None, None),
-        # the replicated output comes out of a ppermute ring, which the
-        # static replication checker can't prove; every chip provably
-        # holds the same XOR-of-all-partials after n-1 hops
+        # check_vma=False: the output IS replicated, but only by a
+        # dynamic argument — after n-1 ppermute hops every chip has
+        # XOR-accumulated all n partials (each hop k adds the partial
+        # that originated k chips upstream), so all chips hold the same
+        # XOR-of-all-partials. The static replication checker cannot
+        # prove properties that depend on the permutation completing a
+        # cycle; the dryrun asserts cross-device equality of this
+        # output at runtime (__graft_entry__.dryrun_multichip).
         check_vma=False,
     )
     def ring_decode(units_local, a_local):
@@ -288,11 +293,7 @@ def _ring_decoder_cached(
 
     batch_sharding = NamedSharding(mesh, P(axis))
 
-    def fn(valid_units):
-        b, kk, c = valid_units.shape
-        if kk != upc * n:
-            pad = jnp.zeros((b, upc * n - kk, c), dtype=valid_units.dtype)
-            valid_units = jnp.concatenate([valid_units, pad], axis=1)
+    def inner(valid_units):
         rec = ring_decode(valid_units, a)
         if k_dev is None:
             crcs = jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
@@ -304,7 +305,24 @@ def _ring_decoder_cached(
             crcs = crc_device.crc_slices(rec_sh, k_dev, zeros_crc)
         return rec, crcs
 
-    return jax.jit(fn)
+    jitted = jax.jit(inner)
+
+    def fn(valid_units):
+        b, kk, c = valid_units.shape
+        if kk != upc * n:
+            # pad OUTSIDE the jitted program: inside it, the zeros pad
+            # is a broadcast whose unit axis (size upc*n-kk < n) cannot
+            # take the survivor sharding, forcing XLA's SPMD partitioner
+            # into an involuntary full rematerialization
+            # (replicate-then-repartition) — the round-1 dryrun warning.
+            # jnp (not np) keeps the wrapper traceable and device arrays
+            # on device; the jit call boundary below shards the result.
+            pad = jnp.zeros((b, upc * n - kk, c), dtype=valid_units.dtype)
+            valid_units = jnp.concatenate(
+                [jnp.asarray(valid_units), pad], axis=1)
+        return jitted(valid_units)
+
+    return fn
 
 
 def make_ring_decoder(
