@@ -191,6 +191,28 @@ class DatanodeClientFactory:
         #: TlsMaterial presented by every remote client (mTLS clusters);
         #: None = plaintext channels
         self.tls = None
+        #: network topology view: dn_id -> location path ("/dc/rack"),
+        #: learned from the SCM address book; plus this client's own
+        #: position for nearest-first replica ordering
+        #: (NetworkTopologyImpl sortDatanodes analog)
+        self.locations: dict[str, str] = {}
+        self.location: Optional[str] = None
+        self.node_id: Optional[str] = None
+
+    def learn_locations(self, locations: dict[str, str]) -> None:
+        if locations:
+            self.locations.update(locations)
+
+    def nearest_first(self, nodes) -> list[str]:
+        """Order datanodes nearest-first from this client's position;
+        no topology knowledge = input order unchanged."""
+        if not self.locations or (
+                self.location is None and self.node_id is None):
+            return list(nodes)
+        from ozone_tpu.scm.topology import sort_by_distance
+
+        return sort_by_distance(self.location, nodes, self.locations,
+                                reader_node=self.node_id)
 
     def register_local(self, dn: Datanode) -> LocalDatanodeClient:
         c = LocalDatanodeClient(dn)

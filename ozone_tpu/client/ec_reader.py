@@ -158,6 +158,18 @@ class ECBlockGroupReader:
             raise InsufficientLocationsError(
                 f"need {self.k} units, reachable: {avail}, erased: {list(erased)}"
             )
+        if len(avail) > self.k and \
+                getattr(self.clients, "nearest_first", None) is not None:
+            # more survivors than needed: read the k topology-nearest
+            # (the reference reads expectedDataLocations; with topology
+            # it sorts replicas nearest-first — here the survivor choice
+            # IS the replica choice)
+            nodes = self.group.pipeline.nodes
+            order = {dn: i for i, dn in
+                     enumerate(self.clients.nearest_first(
+                         [nodes[u] for u in avail]))}
+            avail.sort(key=lambda u: order.get(nodes[u], len(order)))
+            avail = sorted(avail[: self.k])
         return avail[: self.k]
 
     def recover_cells(

@@ -228,7 +228,13 @@ class ReplicatedKeyReader:
 
     def read_all(self) -> np.ndarray:
         last: Optional[Exception] = None
-        for dn_id in self.group.pipeline.nodes:
+        # topology-nearest replica first (XceiverClientGrpc reads via
+        # sortDatanodes order in the reference); farther replicas remain
+        # the failover chain
+        nodes = self.group.pipeline.nodes
+        if getattr(self.clients, "nearest_first", None) is not None:
+            nodes = self.clients.nearest_first(nodes)
+        for dn_id in nodes:
             try:
                 client = self.clients.get(dn_id)
                 bd = client.get_block(self.group.block_id)

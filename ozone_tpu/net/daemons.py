@@ -107,6 +107,10 @@ class DatanodeDaemon:
         self.clients = DatanodeClientFactory()
         self.clients.tls = self.tls
         self.clients.register_local(self.dn)
+        # this daemon's own topology position: reconstruction reads
+        # prefer the nearest surviving replicas
+        self.clients.location = rack
+        self.clients.node_id = dn_id
         self.reconstruction = ECReconstructionCoordinator(self.clients)
         self._pending_acks: list[int] = []
         self._stop = threading.Event()
@@ -316,6 +320,16 @@ class DatanodeDaemon:
             if dn_id != self.dn.id and self.clients.maybe_get(dn_id) is None:
                 self.clients.register_remote(dn_id, addr)
 
+    def _learn_topology(self) -> None:
+        """One NodeAddresses round-trip feeds both the address book and
+        the nearest-first read ordering."""
+        try:
+            addresses, locations = self.scm.node_topology()
+        except (StorageError, OSError):
+            return  # topology is an optimization, not a requirement
+        self._learn_addresses(addresses)
+        self.clients.learn_locations(locations)
+
     def _execute(self, cmd) -> None:
         from ozone_tpu.scm.block_deletion import DeleteBlocksCommand
 
@@ -328,12 +342,12 @@ class DatanodeDaemon:
                         pass
                 self._pending_acks.extend(cmd.tx_ids)
             elif isinstance(cmd, ReconstructionCommand):
-                self._learn_addresses(self.scm.node_addresses())
+                self._learn_topology()
                 self.reconstruction.reconstruct_container_group(cmd)
             elif isinstance(cmd, DeleteReplicaCommand):
                 self.dn.delete_container(cmd.container_id, force=True)
             elif isinstance(cmd, ReplicateCommand):
-                self._learn_addresses(self.scm.node_addresses())
+                self._learn_topology()
                 self._replicate(cmd)
             elif isinstance(cmd, dict) and cmd.get("type") == "register":
                 self.scm.register(self.dn.id, self.address, rack=self.rack,
@@ -561,6 +575,7 @@ class ScmOmDaemon:
         self.om_service = OmGrpcService(
             self.om, self.server,
             addresses_provider=lambda: dict(self.scm_service.addresses),
+            locations_provider=self.scm_service.node_locations,
         )
         # ---- metadata HA: one raft ring for OM + SCM state ----
         # (the reference's OM-HA + SCM-HA Ratis rings; co-located here,

@@ -25,11 +25,14 @@ SERVICE = "ozone.tpu.OmService"
 
 class OmGrpcService:
     def __init__(self, om: OzoneManager, server: RpcServer,
-                 addresses_provider=None):
+                 addresses_provider=None, locations_provider=None):
         self.om = om
         # callable returning the dn_id -> address book (from the co-located
         # SCM service or a remote SCM client)
         self.addresses_provider = addresses_provider or (lambda: {})
+        #: callable returning dn_id -> topology location, shipped with
+        #: allocations so clients order replica reads nearest-first
+        self.locations_provider = locations_provider
         #: HA leader gate, set by the daemon: raises
         #: StorageError("OM_NOT_LEADER", <leader address>) on followers so
         #: clients fail over. Reads are leader-gated too — followers
@@ -322,7 +325,9 @@ class OmGrpcService:
             self.scm_barrier()
         return wire.pack(
             {"group": g.to_json(with_tokens=True),
-             "addresses": self.addresses_provider()}
+             "addresses": self.addresses_provider(),
+             "locations": (self.locations_provider()
+                           if self.locations_provider else {})}
         )
 
     def _commit_multipart_part(self, req: bytes) -> bytes:
@@ -508,6 +513,7 @@ class GrpcOmClient:
         if self.clients is not None:
             for dn_id, addr in m.get("addresses", {}).items():
                 self.clients.update_remote(dn_id, addr)
+            self.clients.learn_locations(m.get("locations", {}))
         return BlockGroup.from_json(g)
 
     def commit_key(self, session, groups, size, hsync=False):

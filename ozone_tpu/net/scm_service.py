@@ -186,8 +186,13 @@ class ScmGrpcService:
             self.barrier()  # allocation must survive leader failover
         return wire.pack({"group": g.to_json(), "addresses": dict(self.addresses)})
 
+    def node_locations(self) -> dict[str, str]:
+        """dn_id -> topology location path (multi-level: "/dc/rack")."""
+        return {n.dn_id: n.rack for n in self.scm.nodes.nodes()}
+
     def _node_addresses(self, req: bytes) -> bytes:
-        return wire.pack({"addresses": dict(self.addresses)})
+        return wire.pack({"addresses": dict(self.addresses),
+                          "locations": self.node_locations()})
 
     #: admin verbs that change cluster state (leader-only under HA; the
     #: read-only ones may be answered by any replica)
@@ -415,6 +420,15 @@ class GrpcScmClient:
 
     def node_addresses(self) -> dict[str, str]:
         return self._call("NodeAddresses", {})["addresses"]
+
+    def node_topology(self) -> tuple[dict[str, str], dict[str, str]]:
+        """(addresses, locations) from ONE NodeAddresses round-trip."""
+        m = self._call("NodeAddresses", {})
+        return m["addresses"], m.get("locations", {})
+
+    def node_locations(self) -> dict[str, str]:
+        """dn_id -> topology location (for nearest-first read ordering)."""
+        return self.node_topology()[1]
 
     def admin(self, op: str, target: Optional[str] = None) -> dict:
         return self._call("AdminOp", {"op": op, "target": target})

@@ -68,23 +68,37 @@ class OMMetadataStore:
         # rescanning). Entries: (txid, table, key, value-or-None).
         self._updates: list[tuple[int, str, str, Optional[dict]]] = []
         self.max_journal = 100_000
+        #: process-local snapshot markers (snap_id -> txid at snapshot
+        #: apply), feeding the incremental snapshot diff. Deliberately
+        #: NOT replicated state: each replica's journal positions are
+        #: its own, and the markers are exactly as durable as the
+        #: in-memory journal they index — when either is gone the diff
+        #: falls back to the full listing comparison.
+        self.snapshot_markers: dict[str, int] = {}
 
     # ------------------------------------------------------------------ CRUD
-    def put(self, table: str, key: str, value: dict) -> None:
+    def put(self, table: str, key: str, value: dict,
+            journal: bool = True) -> None:
+        """`journal=False` skips the update journal (NOT durability):
+        bulk derived writes — snapshot materialization copies O(bucket)
+        rows — would otherwise evict the live-mutation history that
+        WAL-delta consumers (Recon, incremental snapdiff) depend on."""
         with self._lock:
             self._cache[table][key] = value
             self._dirty.append((table, key, value))
             self._txid += 1
-            self._journal(table, key, value)
+            if journal:
+                self._journal(table, key, value)
             if len(self._dirty) >= self.flush_every:
                 self._flush_locked()
 
-    def delete(self, table: str, key: str) -> None:
+    def delete(self, table: str, key: str, journal: bool = True) -> None:
         with self._lock:
             self._cache[table][key] = None
             self._dirty.append((table, key, None))
             self._txid += 1
-            self._journal(table, key, None)
+            if journal:
+                self._journal(table, key, None)
             if len(self._dirty) >= self.flush_every:
                 self._flush_locked()
 
@@ -213,6 +227,8 @@ class OMMetadataStore:
         with self._lock:
             self._dirty.clear()
             self._updates.clear()
+            # shipped markers would index the SENDER's journal, not ours
+            self.snapshot_markers.clear()
             cur = self._conn.cursor()
             for t in _TABLES:
                 self._cache[t].clear()

@@ -388,16 +388,20 @@ class CreateSnapshot(OMRequest):
                 prev, prev_created = v["snap_id"], v["created"]
         base = bucket_key(self.volume, self.bucket) + "/"
         prefix = snap_prefix(self.volume, self.bucket, self.snap_id)
+        # journal=False: materialization is O(bucket) of DERIVED rows —
+        # journaling them would evict the live-mutation history that the
+        # incremental snapdiff (and Recon's delta tail) reads
         for k, v in list(store.iterate("keys", base)):
             if k.startswith("/.snap"):
                 continue
-            store.put("keys", f"{prefix}/{k[len(base):]}", v)
+            store.put("keys", f"{prefix}/{k[len(base):]}", v,
+                      journal=False)
         # FSO buckets keep file rows in the "files" table keyed by parent
         # id; each row carries its full path in "name", so snapshot rows
         # are materialized path-keyed and all snapshot reads/diffs work
         # identically across layouts
         for _, v in list(store.iterate("files", base)):
-            store.put("keys", f"{prefix}/{v['name']}", v)
+            store.put("keys", f"{prefix}/{v['name']}", v, journal=False)
         info = {
             "volume": self.volume,
             "bucket": self.bucket,
@@ -407,6 +411,11 @@ class CreateSnapshot(OMRequest):
             "previous": prev,
         }
         store.put("open_keys", meta_key, info)
+        # local journal position of this snapshot: lets snapdiff walk
+        # only the updates BETWEEN two snapshots instead of listing the
+        # whole namespace (the compaction-DAG role of the reference's
+        # RocksDBCheckpointDiffer)
+        store.snapshot_markers[self.snap_id] = store.txid
         return info
 
 
@@ -425,8 +434,9 @@ class DeleteSnapshot(OMRequest):
             raise OMError("SNAPSHOT_NOT_FOUND", self.name)
         prefix = snap_prefix(self.volume, self.bucket, info["snap_id"])
         for k, _ in list(store.iterate("keys", prefix)):
-            store.delete("keys", k)
+            store.delete("keys", k, journal=False)
         store.delete("open_keys", meta_key)
+        store.snapshot_markers.pop(info["snap_id"], None)
         return info
 
 

@@ -85,13 +85,77 @@ class SnapshotManager:
         return v
 
     # ------------------------------------------------------------- diff
+    @staticmethod
+    def _key_sig(v: dict) -> tuple:
+        return (v["size"], v.get("modified"), v.get("block_groups"))
+
+    def _incremental_diff(self, volume: str, bucket: str,
+                          old_info: SnapshotInfo,
+                          new_info: Optional[SnapshotInfo]) -> Optional[dict]:
+        """O(changes) diff from the store's update journal (the role the
+        compaction-DAG SST tracking plays in the reference's
+        RocksDBCheckpointDiffer.getSSTDiffList:860): snapshot markers
+        pin journal positions, and only keys TOUCHED between the two
+        positions are compared. Returns None when the journal no longer
+        reaches back (restart, HA install, retention) or for FSO
+        buckets, whose journal rows key files by parent id — a deleted
+        row's path is not recoverable there, so FSO takes the
+        full-listing fallback."""
+        store = self.om.store
+        binfo = self.om.bucket_info(volume, bucket)
+        if binfo.get("layout") == "FILE_SYSTEM_OPTIMIZED":
+            return None
+        from_mark = store.snapshot_markers.get(old_info.snap_id)
+        to_mark = (store.snapshot_markers.get(new_info.snap_id)
+                   if new_info is not None else store.txid)
+        if from_mark is None or to_mark is None or to_mark < from_mark:
+            return None
+        updates, _, complete = store.get_updates_since(from_mark)
+        if not complete:
+            return None
+        base = f"/{volume}/{bucket}/"
+        names: set[str] = set()
+        for txid, table, key, _v in updates:
+            if txid > to_mark:
+                break
+            if table == "keys" and key.startswith(base):
+                names.add(key[len(base):])
+        old_prefix = _snap_prefix(volume, bucket, old_info.snap_id)
+        new_prefix = (_snap_prefix(volume, bucket, new_info.snap_id)
+                      if new_info is not None else None)
+        added, deleted, modified = [], [], []
+        for name in sorted(names):
+            ov = store.get("keys", f"{old_prefix}/{name}")
+            nv = store.get(
+                "keys",
+                f"{new_prefix}/{name}" if new_prefix else base + name)
+            if ov is None and nv is not None:
+                added.append(name)
+            elif ov is not None and nv is None:
+                deleted.append(name)
+            elif ov is not None and nv is not None \
+                    and self._key_sig(ov) != self._key_sig(nv):
+                modified.append(name)
+            # both None: created AND deleted inside the window
+        return {"added": added, "deleted": deleted, "modified": modified,
+                "mode": "incremental", "keys_examined": len(names)}
+
     def snapshot_diff(self, volume: str, bucket: str,
                       from_snapshot: str,
                       to_snapshot: Optional[str] = None) -> dict:
         """Key diff between two snapshots (or a snapshot and live state).
 
         Returns {added, deleted, modified} key-name lists
-        (SnapshotDiffManager's SnapshotDiffReport analog)."""
+        (SnapshotDiffManager's SnapshotDiffReport analog). Served
+        incrementally from the update journal when the snapshot's
+        journal marker is still reachable — O(changes), not
+        O(namespace); full-listing comparison otherwise."""
+        old_info = self.get_snapshot(volume, bucket, from_snapshot)
+        new_info = (self.get_snapshot(volume, bucket, to_snapshot)
+                    if to_snapshot is not None else None)
+        out = self._incremental_diff(volume, bucket, old_info, new_info)
+        if out is not None:
+            return out
         old = {
             k["name"]: k
             for k in self.list_keys(volume, bucket, from_snapshot)
@@ -112,9 +176,7 @@ class SnapshotManager:
         modified = sorted(
             n
             for n in set(old) & set(new)
-            if (old[n]["size"], old[n].get("modified"),
-                old[n].get("block_groups"))
-            != (new[n]["size"], new[n].get("modified"),
-                new[n].get("block_groups"))
+            if self._key_sig(old[n]) != self._key_sig(new[n])
         )
-        return {"added": added, "deleted": deleted, "modified": modified}
+        return {"added": added, "deleted": deleted, "modified": modified,
+                "mode": "full"}

@@ -108,7 +108,17 @@ class ContainerKeyIndex:
                 for k, info in self.om.store.iterate(table):
                     self._apply(table, k, info)
 
+    @staticmethod
+    def _derived(key: str) -> bool:
+        """Materialized snapshot rows are DERIVED state: they duplicate
+        live keys under /.snapshot/ and are written with journal=False
+        (the WAL delta deliberately omits them), so indexing them on a
+        rebuild would leave entries the delta path can never retire."""
+        return key.startswith("/.snap")
+
     def _apply(self, table: str, key: str, info) -> None:
+        if self._derived(key):
+            return
         # drop the previous mapping for this key path, then re-add
         for cid in self._key_containers.pop(key, []):
             m = self._index.get(cid)

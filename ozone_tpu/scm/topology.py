@@ -1,0 +1,68 @@
+"""Multi-level network topology: location paths, distance, nearest-first.
+
+Mirror of the reference's NetworkTopologyImpl (hadoop-hdds/common
+hdds/scm/net/NetworkTopologyImpl.java:51): cluster locations form a tree
+("/dc1/rack2" — any depth), a node's full path is its location plus the
+node itself, and distance between two nodes is the number of tree edges
+on the path between them (NetworkTopologyImpl.getDistanceCost). The
+reference uses this for topology-aware placement and for sorting replica
+reads nearest-first (XceiverClientGrpc via sortDatanodes); here the same
+ordering feeds client/replicated.py and the EC reader's survivor choice.
+
+Locations are plain strings — the tree is implicit in the path
+components, so no registration step is needed beyond knowing each
+node's location (shipped on the SCM address book).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+
+def norm_location(loc: Optional[str]) -> tuple[str, ...]:
+    """Split a location path into components ("/dc/rack" -> (dc, rack));
+    empty/None -> the root."""
+    if not loc:
+        return ()
+    return tuple(p for p in loc.split("/") if p)
+
+
+def distance(loc_a: Optional[str], loc_b: Optional[str],
+             node_a: Optional[str] = None,
+             node_b: Optional[str] = None) -> int:
+    """Tree-edge distance between two nodes at the given locations.
+
+    Same node: 0. Same location: 2 (up to the shared rack, down again).
+    Generally: (depth_a - common) + (depth_b - common) + 2 where common
+    is the shared path prefix length — the +2 being the two node->rack
+    edges (NetworkTopologyImpl.getDistanceCost semantics with nodes as
+    leaves)."""
+    if node_a is not None and node_a == node_b:
+        return 0
+    a, b = norm_location(loc_a), norm_location(loc_b)
+    common = 0
+    for x, y in zip(a, b):
+        if x != y:
+            break
+        common += 1
+    return (len(a) - common) + (len(b) - common) + 2
+
+
+def sort_by_distance(reader_loc: Optional[str],
+                     nodes: Iterable[str],
+                     locations: dict[str, str],
+                     reader_node: Optional[str] = None) -> list[str]:
+    """Nodes ordered nearest-first from the reader's position; ties keep
+    the input order (stable), unknown locations sort last at their
+    original relative order."""
+    seq = list(nodes)
+
+    def key(item):
+        i, dn = item
+        loc = locations.get(dn)
+        if loc is None and dn not in locations:
+            return (9999, i)
+        return (distance(reader_loc, loc, node_a=reader_node, node_b=dn), i)
+
+    return [dn for _, dn in
+            sorted(enumerate(seq), key=lambda p: key(p))]

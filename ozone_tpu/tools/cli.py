@@ -64,10 +64,15 @@ def _client(args):
     # learn datanode addresses up front
     from ozone_tpu.net.scm_service import AdminTokenFetcher, GrpcScmClient
 
+    import os
+
+    clients.location = os.environ.get("OZONE_TPU_CLIENT_LOCATION")
     try:
         scm = GrpcScmClient(args.om, tls=tls)
-        for dn_id, addr in scm.node_addresses().items():
+        addresses, locations = scm.node_topology()
+        for dn_id, addr in addresses.items():
             clients.register_remote(dn_id, addr)
+        clients.learn_locations(locations)
         if scm.status().get("block_tokens"):
             # dn-direct debug/repair verbs fetch operator tokens from
             # the SCM instead of holding the secret keys

@@ -1,0 +1,148 @@
+"""Multi-level network topology + distance-ordered reads.
+
+Reference: hadoop-hdds/common hdds/scm/net/NetworkTopologyImpl.java:51
+(dc/rack/node tree, getDistanceCost) and XceiverClientGrpc's
+topology-sorted replica reads. Locations here are plain multi-level
+paths ("/dc1/rack2") shipped on the SCM address book.
+"""
+
+import numpy as np
+
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.scm.topology import distance, sort_by_distance
+
+
+# ------------------------------------------------------------------ distance
+def test_distance_tree_edges():
+    # same node
+    assert distance("/dc1/r1", "/dc1/r1", node_a="a", node_b="a") == 0
+    # same rack, different nodes: up to the rack and down
+    assert distance("/dc1/r1", "/dc1/r1", node_a="a", node_b="b") == 2
+    # same dc, different racks
+    assert distance("/dc1/r1", "/dc1/r2") == 4
+    # different dcs
+    assert distance("/dc1/r1", "/dc2/r9") == 6
+    # mixed depth: flat rack vs dc/rack
+    assert distance("/r1", "/dc1/r1") == 5
+    # root/unknown locations still produce a finite ordering
+    assert distance(None, "/dc1/r1") == 4
+
+
+def test_sort_by_distance_orders_and_is_stable():
+    locs = {
+        "far": "/dc2/r1",
+        "same-rack": "/dc1/r1",
+        "same-dc": "/dc1/r2",
+        "also-same-rack": "/dc1/r1",
+    }
+    out = sort_by_distance("/dc1/r1", ["far", "same-rack", "same-dc",
+                                       "also-same-rack"], locs)
+    assert out == ["same-rack", "also-same-rack", "same-dc", "far"]
+    # unknown locations sort last, preserving input order
+    out2 = sort_by_distance("/dc1/r1", ["x", "same-rack", "y"], locs)
+    assert out2 == ["same-rack", "x", "y"]
+    # the reader node itself wins outright
+    out3 = sort_by_distance("/dc1/r1", ["same-rack", "me"],
+                            {**locs, "me": "/dc1/r1"}, reader_node="me")
+    assert out3 == ["me", "same-rack"]
+
+
+def test_factory_nearest_first():
+    f = DatanodeClientFactory()
+    # no topology knowledge: order unchanged
+    assert f.nearest_first(["b", "a"]) == ["b", "a"]
+    f.learn_locations({"a": "/dc1/r1", "b": "/dc2/r1", "c": "/dc1/r2"})
+    f.location = "/dc1/r1"
+    assert f.nearest_first(["b", "c", "a"]) == ["a", "c", "b"]
+
+
+# ------------------------------------------------------- read-path ordering
+class _RecordingClients(DatanodeClientFactory):
+    """Factory whose get() records which datanode is asked first."""
+
+    def __init__(self):
+        super().__init__()
+        self.asked: list[str] = []
+
+    def get(self, dn_id):
+        self.asked.append(dn_id)
+        return super().get(dn_id)
+
+
+def test_replicated_read_prefers_nearest(tmp_path):
+    from ozone_tpu.client.ec_writer import BlockGroup
+    from ozone_tpu.client.replicated import ReplicatedKeyReader
+    from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+    from ozone_tpu.storage.datanode import Datanode
+    from ozone_tpu.storage.ids import (
+        BlockData,
+        BlockID,
+        ChunkInfo,
+    )
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    clients = _RecordingClients()
+    data = np.arange(256, dtype=np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 4096).compute(data)
+    info = ChunkInfo("c0", 0, data.size, cs)
+    bid = BlockID(1, 1)
+    for i in range(3):
+        dn = Datanode(tmp_path / f"dn{i}", dn_id=f"dn{i}")
+        clients.register_local(dn)
+        dn.create_container(1)
+        dn.write_chunk(bid, info, data)
+        dn.put_block(BlockData(bid, [info]))
+    group = BlockGroup(
+        container_id=1, local_id=1,
+        pipeline=Pipeline(ReplicationConfig.parse("RATIS/THREE"),
+                          ["dn0", "dn1", "dn2"]),
+        length=data.size,
+    )
+    clients.learn_locations(
+        {"dn0": "/dc2/r1", "dn1": "/dc1/r2", "dn2": "/dc1/r1"})
+    clients.location = "/dc1/r1"
+    got = ReplicatedKeyReader(group, clients).read_all()
+    assert np.array_equal(got, data)
+    # dn2 (same rack) must be asked first, not pipeline-order dn0
+    assert clients.asked[0] == "dn2"
+
+
+def test_ec_degraded_read_prefers_near_survivors(tmp_path):
+    from ozone_tpu.client.ec_reader import ECBlockGroupReader
+    from ozone_tpu.client.ec_writer import BlockGroup, ECKeyWriter
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+
+    clients = _RecordingClients()
+    from ozone_tpu.storage.datanode import Datanode
+
+    for i in range(5):
+        clients.register_local(Datanode(tmp_path / f"d{i}", dn_id=f"d{i}"))
+    opts = CoderOptions.parse("rs-3-2-4096")
+    group = {"g": None}
+
+    def allocate(excluded, excluded_containers=()):
+        group["g"] = BlockGroup(
+            container_id=1, local_id=1,
+            pipeline=Pipeline(ReplicationConfig.parse("rs-3-2-4096"),
+                              [f"d{i}" for i in range(5)]),
+        )
+        return group["g"]
+
+    w = ECKeyWriter(opts, allocate, clients, block_size=8 * 4096)
+    data = np.random.default_rng(0).integers(0, 256, 30_000, dtype=np.uint8)
+    w.write(data)
+    groups = w.close()
+    g = groups[0]
+    # reader sits next to the parity nodes d3/d4; data unit d0 is "lost"
+    clients.learn_locations({"d0": "/dc9/r9", "d1": "/dc2/r1",
+                             "d2": "/dc2/r1", "d3": "/dc1/r1",
+                             "d4": "/dc1/r1"})
+    clients.location = "/dc1/r1"
+    reader = ECBlockGroupReader(g, opts, clients)
+    reader._failed.add(0)  # unit 0 unavailable -> degraded path
+    got = reader.read_all()
+    assert np.array_equal(got, data)
+    # the decode's chosen survivors must include the near parity units
+    valid = reader._choose_valid([0])
+    assert set(valid) >= {3, 4}, valid
