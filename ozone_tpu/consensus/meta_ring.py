@@ -183,6 +183,22 @@ class MetaHARing(RaftSCM):
             raise result
         return result
 
+    # -------------------------------------------------------- membership
+    def ring_add(self, node_id: str, address: str) -> dict:
+        """Grow the metadata ring by one replica (OM bootstrap /
+        Ratis setConfiguration analog): the new node starts as an empty
+        follower, the config entry admits it, and the leader catches it
+        up via snapshot-install + log replay."""
+        if not self.node.is_ready_leader:
+            raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        return self.node.change_membership(add=node_id, address=address)
+
+    def ring_remove(self, node_id: str) -> dict:
+        """Retire one replica (decommission-OM analog)."""
+        if not self.node.is_ready_leader:
+            raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        return self.node.change_membership(remove=node_id)
+
     @property
     def leader_hint(self):
         return self.node.leader_hint

@@ -17,10 +17,13 @@ Scope notes (what is and is not here):
 - Snapshot install for follower bootstrap (the SCMSnapshotProvider /
   OMDBCheckpointServlet analog): a new or lagging peer receives the
   application snapshot + last included index/term instead of the whole log.
-- No membership-change joint consensus: the cluster set is fixed at
-  construction (the reference similarly bootstraps OM/SCM rings from
-  static config; decommissioned metadata nodes are replaced, not removed
-  online).
+- Online membership change via SINGLE-server add/remove (Raft §4.1, the
+  Ratis setConfiguration analog): a config entry takes effect when
+  appended, changes are serialized until the previous one commits, a
+  joining node bootstraps by snapshot install + log replay, and clients/
+  datanodes learn the grown ring from heartbeat responses. Joint
+  consensus (arbitrary multi-node swaps in one step) is intentionally
+  not implemented — one change at a time keeps quorums overlapping.
 
 Transports are pluggable: `InProcessTransport` wires nodes directly for
 tests and the MiniCluster (the reference tests consensus the same way —
@@ -87,6 +90,12 @@ class RaftStorage:
         self.snapshot_index = 0
         self.snapshot_term = 0
         self.snapshot_data: Any = None
+        # membership-change history: [[index, {id: address}], ...] in
+        # index order; the LAST entry is the active configuration. Empty
+        # = legacy fixed membership (the constructor peer list governs).
+        # Persisted with term/vote: a node must never forget a config it
+        # acted on (Raft §4.1 — configs take effect when APPENDED).
+        self.config_history: list[list] = []
         self._load()
 
     def _load(self) -> None:
@@ -96,11 +105,52 @@ class RaftStorage:
             self.voted_for = m.get("voted_for")
             self.snapshot_index = m.get("snapshot_index", 0)
             self.snapshot_term = m.get("snapshot_term", 0)
+            self.config_history = m.get("config_history", [])
         if self.snap_path.exists():
-            self.snapshot_data = json.loads(self.snap_path.read_text())
+            raw = json.loads(self.snap_path.read_text())
+            if isinstance(raw, dict) and "_snapmeta" in raw:
+                # self-describing snapshot (crash recovery: the data
+                # file is written BEFORE the meta marker, so after a
+                # crash mid-compaction the file's own stamp wins)
+                self.snapshot_data = raw["data"]
+                sm = raw["_snapmeta"]
+                if sm["index"] > self.snapshot_index:
+                    self.snapshot_index = sm["index"]
+                    self.snapshot_term = sm["term"]
+            else:  # legacy bare payload
+                self.snapshot_data = raw
+        log_start = None
         if self.log_path.exists():
             with open(self.log_path) as f:
-                self.entries = [json.loads(ln) for ln in f if ln.strip()]
+                rows = [json.loads(ln) for ln in f if ln.strip()]
+            if rows and "_logstart" in rows[0]:
+                log_start = rows[0]["_logstart"]
+                rows = rows[1:]
+            self.entries = rows
+        # entries are POSITIONAL after the snapshot point; the header
+        # records which point the file was written against. A crash
+        # between the snapshot write and the log rewrite leaves a log
+        # that starts below the (now-authoritative) snapshot index —
+        # drop the prefix the snapshot already covers.
+        if log_start is None:
+            log_start = self.snapshot_index  # legacy / fresh file
+        if log_start < self.snapshot_index:
+            self.entries = self.entries[self.snapshot_index - log_start:]
+        # crash repair: a config entry is fsync'd to the log BEFORE its
+        # meta record (append -> record_config); a crash in that window
+        # must not silently revert membership — replay any _config
+        # entries the log holds past the newest recorded config
+        last_cfg = self.config_history[-1][0] if self.config_history else 0
+        repaired = False
+        for off, e in enumerate(self.entries):
+            idx = self.snapshot_index + off + 1
+            d = e.get("data")
+            if idx > last_cfg and isinstance(d, dict) and "_config" in d:
+                self.config_history.append(
+                    [idx, dict(d["_config"]["members"])])
+                repaired = True
+        if repaired:
+            self.persist_meta()
 
     @staticmethod
     def _write_durable(path: Path, payload: str) -> None:
@@ -124,11 +174,69 @@ class RaftStorage:
             "voted_for": self.voted_for,
             "snapshot_index": self.snapshot_index,
             "snapshot_term": self.snapshot_term,
+            "config_history": self.config_history,
         }))
 
+    @property
+    def members(self) -> Optional[dict]:
+        """Active configuration ({id: address}) or None (legacy fixed)."""
+        return self.config_history[-1][1] if self.config_history else None
+
+    def config_at(self, index: int) -> Optional[dict]:
+        """The configuration in force AT raft index `index` (newest
+        config entry stamped at or below it), or None. A snapshot must
+        ship THIS — not the live config: an uncommitted config entry
+        above the snapshot point still rides the log and must stay
+        truncatable on the receiver."""
+        base = None
+        for i, m in self.config_history:
+            if i <= index:
+                base = m
+        return base
+
+    def record_config(self, index: int, members: dict) -> None:
+        self.config_history.append([index, dict(members)])
+        self.persist_meta()
+
+    def truncate_configs_from(self, index: int) -> None:
+        """Drop config entries at raft index >= index (log conflict
+        repair must also revert the configurations those entries
+        carried)."""
+        before = len(self.config_history)
+        self.config_history = [c for c in self.config_history
+                               if c[0] < index]
+        if len(self.config_history) != before:
+            self.persist_meta()
+
+    def compact_configs(self, upto_index: int,
+                        persist: bool = True) -> None:
+        """Keep only the active config at/below the snapshot point.
+        `persist=False` when the caller sequences its own persist_meta
+        LAST (compact: persisting meta with the new snapshot_index
+        before the log/snapshot files hit disk would misindex the whole
+        log if we crash in between)."""
+        live = [c for c in self.config_history if c[0] > upto_index]
+        base = [c for c in self.config_history if c[0] <= upto_index]
+        if base:
+            self.config_history = [base[-1]] + live
+            if persist:
+                self.persist_meta()
+
     def persist_snapshot(self) -> None:
+        # self-describing: carries its own index/term so recovery never
+        # has to trust a meta marker that may not have been written yet
         self._write_durable(
-            self.snap_path, json.dumps(self.snapshot_data))
+            self.snap_path, json.dumps({
+                "_snapmeta": {"index": self.snapshot_index,
+                              "term": self.snapshot_term},
+                "data": self.snapshot_data,
+            }))
+
+    def _log_payload(self) -> str:
+        lines = [json.dumps({"_logstart": self.snapshot_index})]
+        lines += [json.dumps(e, separators=(",", ":"))
+                  for e in self.entries]
+        return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------- log ops
     @property
@@ -152,7 +260,12 @@ class RaftStorage:
         return None
 
     def append(self, entries: list[dict]) -> None:
+        fresh = (not self.log_path.exists()
+                 or self.log_path.stat().st_size == 0)
         with open(self.log_path, "a") as f:
+            if fresh:  # stamp which point the positions count from
+                f.write(json.dumps({"_logstart": self.snapshot_index})
+                        + "\n")
             for e in entries:
                 f.write(json.dumps(e, separators=(",", ":")) + "\n")
             f.flush()
@@ -164,44 +277,61 @@ class RaftStorage:
         keep = max(0, index - self.snapshot_index - 1)
         if keep >= len(self.entries):
             return
+        self.truncate_configs_from(index)
         self.entries = self.entries[:keep]
-        tmp = self.log_path.with_suffix(".tmp")
-        with open(tmp, "w") as f:
-            for e in self.entries:
-                f.write(json.dumps(e, separators=(",", ":")) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.log_path)
+        self._write_durable(self.log_path, self._log_payload())
 
-    def install_snapshot(self, index: int, term: int, data: Any) -> None:
+    def install_snapshot(self, index: int, term: int, data: Any,
+                         members: Optional[dict] = None) -> None:
         self.snapshot_index = index
         self.snapshot_term = term
         self.snapshot_data = data
         self.entries = []
+        if members is not None:
+            # the shipped snapshot's configuration supersedes anything
+            # this (wiped) log knew
+            self.config_history = [[index, dict(members)]]
+        else:
+            # the log was wiped: configs carried by entries above the
+            # snapshot point no longer have a backing log entry
+            self.config_history = [c for c in self.config_history
+                                   if c[0] <= index]
+        # crash-safe order: self-stamped snapshot first, then drop the
+        # log, meta last (a stale log next to a newer snapshot is
+        # reconciled by _load; a stale snapshot next to a newer meta
+        # marker is not recoverable)
+        self.persist_snapshot()
         if self.log_path.exists():
             self.log_path.unlink()
-        self.persist_snapshot()
         self.persist_meta()
 
     def compact(self, upto_index: int, term: int, data: Any,
                 trailing: int) -> None:
-        """Retain `trailing` entries behind the snapshot point."""
-        cut = max(0, upto_index - trailing)
+        """Compact the log to exactly `upto_index` — the snapshot DATA
+        is the state at that index, so the marker must match it: a
+        shipped snapshot whose index trailed its data would make the
+        receiving follower replay entries whose effects the snapshot
+        already contains (double-apply). `trailing` is a frequency
+        guard: don't bother compacting until at least that many entries
+        sit behind the apply point."""
+        if upto_index - self.snapshot_index <= trailing:
+            return
+        cut = upto_index
         if cut <= self.snapshot_index:
             return
-        new_snap_term = term if cut == upto_index else (
-            self.term_at(cut) or term)
         drop = cut - self.snapshot_index
         self.entries = self.entries[drop:]
         self.snapshot_index = cut
-        self.snapshot_term = new_snap_term
+        self.snapshot_term = term
+        self.compact_configs(cut, persist=False)
         self.snapshot_data = data
-        self._write_durable(
-            self.log_path,
-            "".join(json.dumps(e, separators=(",", ":")) + "\n"
-                    for e in self.entries),
-        )
+        # crash-safe order: snapshot data (self-stamped) first, then the
+        # log (headered with its start point), meta marker LAST. A crash
+        # at any boundary reloads consistently: the snapshot's own stamp
+        # overrides a stale meta, and _load drops log entries the
+        # snapshot already covers.
         self.persist_snapshot()
+        self._write_durable(self.log_path, self._log_payload())
         self.persist_meta()
 
 
@@ -230,12 +360,48 @@ class RaftNode:
         self.node_id = node_id
         self.peer_ids = [p for p in peer_ids if p != node_id]
         self.storage = RaftStorage(Path(storage_dir))
+        # membership (Raft §4: single-server changes carried as log
+        # entries, effective when APPENDED). A persisted configuration
+        # overrides the constructor peer list; without one the ring is
+        # fixed at construction (legacy behavior).
+        #: the construction-time ring — the fallback configuration when
+        #: a truncation erases every persisted config entry
+        self._initial_members = {p: "" for p in [node_id, *self.peer_ids]}
+        self.members: dict[str, str] = (
+            dict(self.storage.members)
+            if self.storage.members is not None
+            else dict(self._initial_members)
+        )
+        #: serializes change_membership end-to-end (check + propose);
+        #: ordered strictly before the node lock
+        self._membership_lock = threading.Lock()
+        if self.storage.members is not None:
+            self.peer_ids = [p for p in self.members if p != node_id]
+        #: optional hook fired on config adoption with {id: address} —
+        #: daemons refresh their peer address books through it (property:
+        #: registering it replays the persisted membership, so a restarted
+        #: node's address book reflects replicas added after its original
+        #: start)
+        self._on_config: Optional[Callable[[dict], None]] = None
+        #: raft index of the newest config entry in the log (0 = none);
+        #: a new change is refused until the previous one commits
+        self._config_index = (self.storage.config_history[-1][0]
+                              if self.storage.config_history else 0)
         self.apply_fn = apply_fn
         self.snapshot_fn = snapshot_fn
         self.restore_fn = restore_fn
         self.config = config
         self.transport = transport or InProcessTransport()
         self.transport.register(self)
+        # replay persisted membership addresses into the transport: a
+        # restarted node whose CLI peer list predates an online ring
+        # growth must still be able to reach the replicas the persisted
+        # config admitted, or as leader it would silently strand them
+        if self.storage.members is not None:
+            for p, addr in self.members.items():
+                if p != node_id and addr and hasattr(self.transport,
+                                                    "set_peer"):
+                    self.transport.set_peer(p, addr)
 
         self.role = FOLLOWER
         self.leader_hint: Optional[str] = None
@@ -317,6 +483,120 @@ class RaftNode:
                 # lockstep, splitting votes forever
                 self._election_deadline = self._new_deadline()
 
+    # ----------------------------------------------------------- membership
+    def _quorum(self) -> int:
+        return len(self.members) // 2 + 1
+
+    @property
+    def on_config(self) -> Optional[Callable[[dict], None]]:
+        return self._on_config
+
+    @on_config.setter
+    def on_config(self, cb: Optional[Callable[[dict], None]]) -> None:
+        self._on_config = cb
+        # replay: the persisted ring may differ from what the daemon
+        # derived from its (possibly stale) CLI peer list
+        if cb is not None and self.storage.members is not None:
+            self._notify_config()
+
+    def _notify_config(self) -> None:
+        if self._on_config is not None:
+            try:
+                self._on_config(dict(self.members))
+            except Exception:
+                log.exception("on_config callback failed")
+
+    def _adopt_config(self, index: int, members: dict,
+                      record: bool = True) -> None:
+        """Switch to a configuration the moment its entry is appended
+        (Raft §4.1). Called with the node lock held. `record=False`
+        when the storage already persisted it (snapshot install)."""
+        self.members = dict(members)
+        self.peer_ids = [p for p in self.members if p != self.node_id]
+        self._config_index = index
+        if record:
+            self.storage.record_config(index, members)
+        for p in self.peer_ids:
+            self.next_index.setdefault(p, self.storage.last_index + 1)
+            self.match_index.setdefault(p, 0)
+            addr = self.members.get(p)
+            if addr and hasattr(self.transport, "set_peer"):
+                self.transport.set_peer(p, addr)
+        log.info("raft %s: adopted config @%d: %s", self.node_id, index,
+                 sorted(self.members))
+        self._notify_config()
+
+    def _revert_config_after_truncate(self) -> None:
+        """A log conflict truncated entries that may have carried
+        configs; fall back to what the storage history now says — or to
+        the construction-time ring when the truncation erased every
+        persisted config (a phantom adopted config must not survive)."""
+        members = self.storage.members
+        if members is None:
+            members = self._initial_members
+        if members != self.members:
+            self.members = dict(members)
+            self.peer_ids = [p for p in self.members
+                             if p != self.node_id]
+            self._config_index = (self.storage.config_history[-1][0]
+                                  if self.storage.config_history else 0)
+            # the adopt path notified the daemon of the phantom config;
+            # the revert must notify too, or ring_provider keeps
+            # advertising a replica the ring never actually admitted
+            self._notify_config()
+
+    def change_membership(self, add: Optional[str] = None,
+                          address: str = "",
+                          remove: Optional[str] = None,
+                          timeout: float = 10.0) -> dict:
+        """Single-server membership change (leader only): add ONE node
+        (with its transport address) or remove ONE node. Changes are
+        serialized — a new change is refused while the previous config
+        entry is uncommitted — which keeps majorities of consecutive
+        configs overlapping without joint consensus (Raft §4.1; the
+        reference drives the same through Ratis setConfiguration)."""
+        with self._membership_lock:
+            return self._change_membership_locked(add, address, remove,
+                                                  timeout)
+
+    def _change_membership_locked(self, add, address, remove,
+                                  timeout) -> dict:
+        with self._lock:
+            if self.role != LEADER:
+                raise NotRaftLeaderError(self.node_id, self.leader_hint)
+            if self._config_index > self.commit_index:
+                raise RuntimeError(
+                    f"config change at index {self._config_index} still "
+                    f"uncommitted; one change at a time")
+            if (add is None) == (remove is None):
+                raise ValueError("exactly one of add/remove required")
+            if remove == self.node_id:
+                raise ValueError(
+                    "leader cannot remove itself; transfer leadership "
+                    "first (stop this node and let the ring elect)")
+            new = dict(self.members)
+            if add is not None:
+                new[add] = address
+            else:
+                if remove not in new:
+                    raise ValueError(f"{remove!r} is not a member")
+                del new[remove]
+        # propose() appends the entry; _propose_locked adopts it at
+        # append time, so replication to the NEW config starts at once
+        result = self.propose({"_config": {"members": new}},
+                              timeout=timeout)
+        if isinstance(result, Exception):
+            raise result
+        if remove is not None:
+            # best-effort: let the departing node learn the config that
+            # removed it, so it stops campaigning (Raft §4.2.3; the
+            # sticky-leader pre-vote covers the unreachable case)
+            try:
+                self._replicate_to(remove)
+            except Exception:
+                pass
+        return dict(new)
+
     # ----------------------------------------------------------- elections
     def start_election(self) -> bool:
         """Run one candidate round; returns True if this node won.
@@ -327,7 +607,10 @@ class RaftNode:
         can never depose a healthy leader just by campaigning — the
         disruptive-server problem the reference delegates to Ratis'
         leader election with pre-vote."""
-        quorum = (len(self.peer_ids) + 1) // 2 + 1
+        with self._lock:
+            if self.node_id not in self.members:
+                return False  # removed from the ring: never campaign
+            quorum = self._quorum()
         # randomized contact order + early exit: reachable peers decide
         # the election before any unreachable peer's RPC timeout is paid
         order = list(self.peer_ids)
@@ -471,6 +754,8 @@ class RaftNode:
         entry = {"term": self.storage.term, "data": data}
         self.storage.append([entry])
         index = self.storage.last_index
+        if isinstance(data, dict) and "_config" in data:
+            self._adopt_config(index, data["_config"]["members"])
         if register_waiter:
             self._waiters.add(index)
         self.match_index[self.node_id] = index
@@ -506,6 +791,14 @@ class RaftNode:
                     "last_included_index": self.storage.snapshot_index,
                     "last_included_term": self.storage.snapshot_term,
                     "data": self.storage.snapshot_data,
+                    # configuration travels with the snapshot — the one
+                    # in force AT the snapshot point, NOT the live one:
+                    # a config entry above snapshot_index reaches the
+                    # follower as a log entry and must stay truncatable
+                    # (shipping self.members could burn an uncommitted
+                    # ring change into the receiver's base config)
+                    "members": self.storage.config_at(
+                        self.storage.snapshot_index),
                 }
                 resp = None
                 self._lock.release()
@@ -558,7 +851,7 @@ class RaftNode:
         with self._lock:
             if self.role != LEADER:
                 return
-            quorum = (len(self.peer_ids) + 1) // 2 + 1
+            quorum = self._quorum()
             for n in range(self.storage.last_index, self.commit_index, -1):
                 if self.storage.term_at(n) != self.storage.term:
                     break  # only commit current-term entries by counting
@@ -579,7 +872,11 @@ class RaftNode:
                 continue
             data = entry["data"]
             result = None
-            if not (isinstance(data, dict) and data.get("_noop")):
+            if isinstance(data, dict) and "_config" in data:
+                # config entries mutate the ring, not the app state;
+                # adoption already happened at append time
+                result = dict(data["_config"]["members"])
+            elif not (isinstance(data, dict) and data.get("_noop")):
                 try:
                     result = self.apply_fn(data)
                 except Exception as e:  # deterministic app error
@@ -664,6 +961,7 @@ class RaftNode:
 
             idx = prev
             new = []
+            truncated = False
             for e in req["entries"]:
                 idx += 1
                 mine = self.storage.term_at(idx)
@@ -671,11 +969,20 @@ class RaftNode:
                     new.append(e)
                 elif mine != e["term"]:
                     self.storage.truncate_from(idx)
+                    truncated = True
                     new.append(e)
                 elif new:
                     new.append(e)  # already truncated past here
+            if truncated:
+                self._revert_config_after_truncate()
             if new:
                 self.storage.append(new)
+                base = self.storage.last_index - len(new)
+                for off, e in enumerate(new):
+                    d = e.get("data")
+                    if isinstance(d, dict) and "_config" in d:
+                        self._adopt_config(base + off + 1,
+                                           d["_config"]["members"])
             if req["leader_commit"] > self.commit_index:
                 self.commit_index = min(req["leader_commit"],
                                         self.storage.last_index)
@@ -695,7 +1002,14 @@ class RaftNode:
             idx = req["last_included_index"]
             if idx > self.storage.snapshot_index:
                 self.storage.install_snapshot(
-                    idx, req["last_included_term"], req["data"])
+                    idx, req["last_included_term"], req["data"],
+                    members=req.get("members"))
+                if req.get("members"):
+                    # storage already persisted the shipped config
+                    self._adopt_config(idx, req["members"], record=False)
+                else:
+                    # the wipe may have dropped configs above idx
+                    self._revert_config_after_truncate()
                 if self.restore_fn and req["data"] is not None:
                     self.restore_fn(req["data"])
                 self.commit_index = max(self.commit_index, idx)

@@ -29,8 +29,12 @@ import numpy as np
 
 from ozone_tpu.client.ozone_client import OzoneClient
 from ozone_tpu.gateway.s3_auth import (
+    STREAMING,
     AuthError,
+    decode_aws_chunked,
     parse_authorization,
+    parse_query_auth,
+    verify_presigned,
     verify_request,
 )
 from ozone_tpu.om.requests import OMError
@@ -69,9 +73,14 @@ class S3Gateway:
     def __init__(self, client: OzoneClient, host: str = "127.0.0.1",
                  port: int = 0, replication: str = "rs-6-3-1024k",
                  require_auth: bool = False,
-                 max_clock_skew_s: float = 900.0):
+                 max_clock_skew_s: float = 900.0,
+                 domain: Optional[str] = None):
         self.client = client
         self.replication = replication
+        #: virtual-host-style addressing (VirtualHostStyleFilter.java):
+        #: requests whose Host is <bucket>.<domain> route to that bucket
+        #: with the path holding only the key. None = path-style only.
+        self.domain = domain
         # require_auth=True enforces SigV4 on every request (anonymous
         # access still allowed per public bucket ACL grants); False
         # accepts unsigned requests but validates presented signatures
@@ -165,20 +174,75 @@ class S3Gateway:
     def _authenticate(self, h, method: str) -> Optional[str]:
         """SigV4 validation (reference: s3gateway AuthorizationFilter +
         AWSSignatureProcessor, secret from OM's s3SecretTable). Returns
-        the authenticated access id, or None for anonymous requests."""
+        the authenticated access id, or None for anonymous requests.
+        Handles all three SigV4 carriages: the Authorization header,
+        query parameters (presigned URLs), and aws-chunked streaming
+        payloads (per-chunk signatures chained from the seed)."""
+        u = urlparse(h.path)
         header = h.headers.get("Authorization")
         if not header:
+            # real parameter check, not a substring test: an anonymous
+            # request whose query merely CONTAINS the text (e.g. a key
+            # prefix filter) must not be misrouted into presigned auth
+            if "X-Amz-Signature" in parse_qs(u.query):
+                return self._authenticate_presigned(h, method, u)
+            if str(h.headers.get("x-amz-content-sha256", "")) == STREAMING:
+                # anonymous aws-chunked has no seed signature to verify
+                # a chunk chain against; storing the body verbatim would
+                # persist the chunk framing as object data
+                raise AuthError("InvalidRequest",
+                                "aws-chunked streaming requires SigV4")
             return None
         auth = parse_authorization(header)
         secret = self.client.om.get_s3_secret(auth.access_id, create=False)
         if secret is None:
             raise AuthError("InvalidAccessKeyId", auth.access_id)
-        u = urlparse(h.path)
         verify_request(
             secret, method, u.path, u.query, dict(h.headers), h._body(),
             auth, max_skew_s=self.max_clock_skew_s or None,
         )
+        if str(h.headers.get("x-amz-content-sha256", "")) == STREAMING:
+            # chunked-signature streaming PUT (ObjectEndpointStreaming):
+            # verify the chunk chain and hand the DECODED payload to the
+            # object op; declared decoded length must match
+            amz_date = str(h.headers.get("x-amz-date", ""))
+            decoded = decode_aws_chunked(
+                h._body(), secret, auth, amz_date, auth.signature)
+            declared = h.headers.get("x-amz-decoded-content-length")
+            if declared is not None:
+                try:
+                    expect = int(declared)
+                except ValueError:
+                    raise AuthError(  # 4xx, not an InternalError 500
+                        "InvalidArgument",
+                        f"bad x-amz-decoded-content-length: {declared!r}")
+                if expect != len(decoded):
+                    raise AuthError("IncompleteBody",
+                                    f"decoded {len(decoded)} != {declared}")
+            h._cached_body = decoded
         return auth.access_id
+
+    def _authenticate_presigned(self, h, method: str, u) -> str:
+        if str(h.headers.get("x-amz-content-sha256", "")) == STREAMING:
+            # presigned URLs sign UNSIGNED-PAYLOAD; there is no seed
+            # signature to chain chunk signatures from, and storing the
+            # body verbatim would persist the chunk framing
+            raise AuthError("InvalidRequest",
+                            "aws-chunked streaming cannot be presigned")
+        parsed = parse_query_auth(u.query)
+        auth = parsed[0]
+        secret = self.client.om.get_s3_secret(auth.access_id, create=False)
+        if secret is None:
+            raise AuthError("InvalidAccessKeyId", auth.access_id)
+        # hand over the REAL request headers: X-Amz-SignedHeaders picks
+        # which ones enter the canonical request, and SDKs may sign more
+        # than just host (e.g. host;x-amz-content-sha256)
+        headers = {k.lower(): v for k, v in h.headers.items()}
+        headers.setdefault("host", "")
+        return verify_presigned(
+            secret, method, u.path, u.query, headers,
+            parsed=parsed, max_skew_s=self.max_clock_skew_s or None,
+        )
 
     def _public_grants(self, bucket: str) -> set:
         try:
@@ -215,10 +279,25 @@ class S3Gateway:
         self._tenant_cache[access_id] = (vol, now + self._tenant_cache_ttl_s)
         return vol
 
+    def _vhost_bucket(self, h) -> Optional[str]:
+        """Bucket from virtual-host-style addressing: Host =
+        <bucket>.<domain> (VirtualHostStyleFilter.java semantics; the
+        port is ignored, an exact-domain Host stays path-style)."""
+        if self.domain is None:
+            return None
+        host = (h.headers.get("Host") or "").split(":")[0]
+        suffix = "." + self.domain
+        if host.endswith(suffix) and len(host) > len(suffix):
+            return host[: -len(suffix)]
+        return None
+
     def _route(self, h, method: str) -> None:
         u = urlparse(h.path)
         q = parse_qs(u.query, keep_blank_values=True)
         parts = [unquote(p) for p in u.path.strip("/").split("/") if p]
+        vbucket = self._vhost_bucket(h)
+        if vbucket is not None:
+            parts = [vbucket] + parts
         try:
             principal = self._authenticate(h, method)
             self._request_ctx.volume = (
@@ -240,7 +319,10 @@ class S3Gateway:
             else:
                 self._object_op(h, method, bucket, key, q)
         except AuthError as e:
-            status = 400 if "Malformed" in e.code else 403
+            status = (400 if "Malformed" in e.code or e.code in
+                      ("InvalidRequest", "InvalidArgument",
+                       "IncompleteBody",
+                       "AuthorizationQueryParametersError") else 403)
             h._reply(*_err(e.code, str(e), status))
         except _OM_ERRORS as e:
             code = {

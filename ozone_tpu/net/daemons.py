@@ -668,7 +668,9 @@ class ScmOmDaemon:
 
     def _ha_call(self, fn, not_leader_code: str):
         """Run a ring operation, translating NotRaftLeaderError into the
-        wire error (with the leader's address) clients fail over on."""
+        wire error (with the leader's address) clients fail over on, and
+        operator-input errors (unknown member, change in flight) into
+        INVALID instead of an opaque INTERNAL."""
         from ozone_tpu.consensus.raft import NotRaftLeaderError
 
         try:
@@ -676,6 +678,8 @@ class ScmOmDaemon:
         except NotRaftLeaderError as e:
             raise StorageError(not_leader_code,
                                self._leader_address(e.leader_hint))
+        except (ValueError, RuntimeError) as e:
+            raise StorageError("INVALID", str(e))
 
     def _init_ha(self, ha_id: str, raft_dir: Path) -> None:
         from ozone_tpu.consensus.meta_ring import MetaHARing
@@ -737,6 +741,30 @@ class ScmOmDaemon:
         # OM issuer must sign with the keys datanodes verify against
         self.scm.on_secret_rotate = lambda key: self.ha.submit_admin(
             "import-secret-key", key.to_json())
+        # ring membership (ring-add/ring-remove admin verbs): config
+        # entries carry peer addresses, so every replica's client-hint
+        # address book follows the ring
+        def _ring_ops(op, target):
+            if op == "ring-add":
+                node_id, _, address = str(target).partition("=")
+                if not address:
+                    raise StorageError(
+                        "INVALID", "ring-add needs id=host:port")
+                return self.ha.ring_add(node_id, address)
+            return self.ha.ring_remove(str(target))
+
+        self.scm_service.ring_ops = lambda op, target: self._ha_call(
+            lambda: _ring_ops(op, target), "SCM_NOT_LEADER")
+
+        def _on_ring_config(members: dict) -> None:
+            self._ha_peers = {
+                k: (v or self._ha_peers.get(k, ""))
+                for k, v in members.items()
+            }
+
+        self.ha.node.on_config = _on_ring_config
+        self.scm_service.ring_provider = \
+            lambda: [a for a in self._ha_peers.values() if a]
 
     def _leader_gate(self) -> None:
         # ready-leader, not just leader: a freshly elected leader must

@@ -315,6 +315,9 @@ class FailoverChannels:
             raise ValueError("empty address list")
         self._tls = tls
         self._chs: dict[str, RpcChannel] = {}
+        #: channels to replicas retired by reconcile(); closed with the
+        #: pool (an immediate close could race an in-flight RPC)
+        self._retired: list[RpcChannel] = []
         self._idx = 0
         self._lock = threading.Lock()
 
@@ -335,6 +338,31 @@ class FailoverChannels:
         with self._lock:
             self._idx = (self._idx + 1) % len(self.addresses)
 
+    def reconcile(self, ring: list) -> None:
+        """Adopt a server-shipped membership as the address list (online
+        ring growth AND retirement: the server ships the full current
+        ring on heartbeat responses, so clients both learn added
+        replicas and stop dialing removed ones). The sticky index stays
+        on the replica currently in use when it survives the change."""
+        ring = [a.strip() for a in ring if a and a.strip()]
+        if not ring:
+            return
+        with self._lock:
+            if set(ring) == set(self.addresses):
+                return
+            cur = self.addresses[self._idx]
+            # in place: callers alias this list (GrpcScmClient.addresses)
+            self.addresses[:] = dict.fromkeys(ring)
+            self._idx = (self.addresses.index(cur)
+                         if cur in self.addresses else 0)
+            # drop retired channels from the cache but DON'T close them
+            # here: a concurrent caller may be mid-RPC on one, and a
+            # forced close would surface a spurious error instead of a
+            # clean rotate-and-retry. Ring changes are rare, so parking
+            # them until close() is bounded in practice.
+            self._retired.extend(self._chs.pop(a) for a in list(self._chs)
+                                 if a not in self.addresses)
+
     def follow_hint(self, addr: Optional[str]) -> None:
         """Pin to a hinted leader address; a hint that is unknown or
         points back at the current replica rotates instead (a deposed
@@ -349,7 +377,8 @@ class FailoverChannels:
 
     def close(self) -> None:
         with self._lock:
-            chans = list(self._chs.values())
+            chans = list(self._chs.values()) + self._retired
             self._chs.clear()
+            self._retired = []
         for ch in chans:
             ch.close()

@@ -113,6 +113,15 @@ class ScmGrpcService:
         #: ring (callable(op, target) -> dict) so the decision survives
         #: leader failover; None = apply directly to the local SCM
         self.admin_submitter = None
+        #: HA hook: ring membership changes (callable(op, target) ->
+        #: members dict); None = not an HA deployment
+        self.ring_ops = None
+        #: HA hook: current ring replica addresses, shipped on
+        #: register/heartbeat responses so datanodes follow an online-
+        #: grown ring without reconfiguration (a freshly added replica
+        #: that never receives heartbeats would sit in safemode forever
+        #: if it won an election)
+        self.ring_provider = None
         server.add_service(
             SERVICE,
             {
@@ -146,14 +155,15 @@ class ScmGrpcService:
         responses (the reference's SecretKeyProtocol served from the
         SCM): datanodes import the keys and turn on datapath token
         verification."""
+        out = {}
+        if self.ring_provider is not None:
+            out["ring"] = list(self.ring_provider())
         if not getattr(self.scm, "block_tokens", False):
-            return {}
-        if not self.distribute_secrets:
-            return {"block_tokens": True}  # enforcement on, keys withheld
-        return {
-            "block_tokens": True,
-            "secret_keys": self.scm.secret_keys.export_keys(),
-        }
+            return out
+        out["block_tokens"] = True
+        if self.distribute_secrets:
+            out["secret_keys"] = self.scm.secret_keys.export_keys()
+        return out
 
     def _heartbeat(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
@@ -209,6 +219,16 @@ class ScmGrpcService:
         m, _ = wire.unpack(req)
         op, target = m["op"], m.get("target")
         scm = self.scm
+        if op in ("ring-add", "ring-remove"):
+            # membership change IS its own replication (the config
+            # entry rides the raft log), so it does not go through the
+            # admin submitter
+            if self.ring_ops is None:
+                raise StorageError("UNSUPPORTED_REQUEST",
+                                   "not an HA deployment")
+            if self.gate is not None:
+                self.gate()
+            return wire.pack({"members": self.ring_ops(op, target)})
         if op in self._MUTATING_ADMIN:
             if self.gate is not None:
                 self.gate()
@@ -318,6 +338,11 @@ class GrpcScmClient:
         import time
 
         for m in responses:
+            if m.get("ring"):
+                # online ring growth AND retirement: adopt the full
+                # shipped membership so removed replicas stop being
+                # dialed on every heartbeat round
+                self._pool.reconcile(m["ring"])
             if m.get("block_tokens"):
                 self.security["block_tokens"] = True
                 keys = {k["key_id"]: k

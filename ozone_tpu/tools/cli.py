@@ -368,6 +368,20 @@ def cmd_admin(args) -> int:
         _emit(scm.admin(f"balancer-{verb or 'status'}"))
     elif subject == "replicationmanager":
         _emit(scm.admin("replication-status"))
+    elif subject == "ring":
+        # metadata-ring membership (OM bootstrap / decommission-OM
+        # analog): add a started-but-empty replica, or retire one
+        if verb == "add":
+            if not target or "=" not in target:
+                return usage("ring add needs <id>=<host:port>")
+            _emit(scm.admin("ring-add", target))
+        elif verb == "remove":
+            if not target:
+                return usage("ring remove needs the replica id")
+            _emit(scm.admin("ring-remove", target))
+        else:
+            return usage(f"unknown ring verb {verb!r} "
+                         "(expected add <id>=<addr>|remove <id>)")
     elif subject == "om":
         from ozone_tpu.net.om_service import GrpcOmClient
 
@@ -518,7 +532,8 @@ def cmd_s3g(args) -> int:
     logging.basicConfig(level=logging.INFO)
     gw = S3Gateway(_client(args), port=args.port,
                    replication=args.replication,
-                   require_auth=args.require_auth)
+                   require_auth=args.require_auth,
+                   domain=args.domain or None)
     gw.start()
     print(f"s3 gateway serving on {gw.address}, om={args.om}")
     return _serve(gw.stop)
@@ -755,6 +770,7 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("subject", choices=[
         "safemode", "datanode", "status", "pipeline", "container",
         "balancer", "replicationmanager", "om", "finalizeupgrade",
+        "ring",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"
@@ -806,6 +822,9 @@ def build_parser() -> argparse.ArgumentParser:
     s3g.add_argument("--om", default="127.0.0.1:9860")
     s3g.add_argument("--port", type=int, default=9878)
     s3g.add_argument("--replication", default="rs-6-3-1024k")
+    s3g.add_argument("--domain", default="",
+                     help="serve virtual-host-style addressing for "
+                          "Host: <bucket>.<domain>")
     s3g.add_argument("--require-auth", action="store_true",
                      help="enforce SigV4 signatures")
     s3g.set_defaults(fn=cmd_s3g)

@@ -265,3 +265,192 @@ def test_ha_ratis_pipeline_write(ha_cluster):
     b = oz.get_volume("rv").create_bucket("rb", replication="RATIS/THREE")
     b.write_key("rk", payload)
     assert b.read_key("rk").tobytes() == payload
+
+
+def test_ring_grows_three_to_five_under_load(tmp_path):
+    import threading
+
+    """VERDICT round-2 item 7: grow the metadata ring 3 -> 5 with the
+    admin verbs while writes flow; new replicas bootstrap from the
+    leader (snapshot install + log replay), converge to the same
+    namespace, and the 5-ring tolerates two failures."""
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    ports = _free_ports(5)
+    peers3 = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(3)}
+    all_peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(5)}
+    metas, dns = {}, []
+    stop = threading.Event()
+    acked, write_errors = [], []
+    try:
+        for i in range(3):
+            d = _make_meta(tmp_path, i, peers3)
+            d.start()
+            metas[f"m{i}"] = d
+        _await_leader(metas)
+        scm_addrs = ",".join(all_peers.values())
+        for i in range(5):
+            d = DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", scm_addrs,
+                               heartbeat_interval_s=0.15)
+            d.start()
+            dns.append(d)
+        oz = _client(all_peers)
+        oz.create_volume("v")
+        bucket = oz.get_volume("v").create_bucket(
+            "b", replication="rs-3-2-4096")
+        payload = np.random.default_rng(5).integers(
+            0, 256, 40_000, dtype=np.uint8).tobytes()
+
+        def writer():
+            n = 0
+            while not stop.is_set():
+                try:
+                    bucket.write_key(f"k{n}", payload)
+                    acked.append(f"k{n}")
+                except StorageError:
+                    pass
+                except Exception as e:  # noqa: BLE001
+                    write_errors.append(e)
+                    return
+                n += 1
+
+        wt = threading.Thread(target=writer, daemon=True)
+        wt.start()
+        time.sleep(1.0)
+
+        # ---- grow: start empty replicas, admit them one at a time ----
+        scm_cli = GrpcScmClient(",".join(all_peers.values()))
+        for i in (3, 4):
+            # the joining replica knows the CURRENT ring plus itself
+            joining = {**{k: v for k, v in all_peers.items()
+                          if k in metas}, f"m{i}": all_peers[f"m{i}"]}
+            d = _make_meta(tmp_path, i, joining)
+            d.start()
+            metas[f"m{i}"] = d
+            out = scm_cli.admin("ring-add",
+                                f"m{i}={all_peers[f'm{i}']}")
+            assert f"m{i}" in out["members"]
+            time.sleep(0.5)
+
+        time.sleep(2.0)  # let the new replicas catch up under load
+        stop.set()
+        wt.join(timeout=10)
+        assert not write_errors, write_errors[:1]
+        assert len(acked) > 3
+
+        # every replica converged to the same committed namespace
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            counts = {}
+            for mid, d in metas.items():
+                if d.ha.node.last_applied >= \
+                        max(x.ha.node.commit_index for x in metas.values()):
+                    counts[mid] = True
+            if len(counts) == 5:
+                break
+            time.sleep(0.3)
+        for mid, d in metas.items():
+            assert [v["name"] for v in d.om.list_volumes()] == ["v"], mid
+        assert all(len(d.ha.node.members) == 5 for d in metas.values())
+
+        # ---- the 5-ring survives TWO failures (quorum 3) ----
+        leader_id = _await_leader(metas)
+        metas.pop(leader_id).stop()
+        other = next(iter(metas))
+        metas.pop(other).stop()
+        _await_leader(metas, timeout=20.0)
+        for key in acked[-2:]:
+            assert bucket.read_key(key).tobytes() == payload
+
+        # ---- shrink: retire a DEAD replica (the operator's headroom
+        # restore: a 4-member ring with 3 alive commits at quorum 3) ----
+        scm_cli2 = GrpcScmClient(
+            ",".join(all_peers[m] for m in metas))
+        out = scm_cli2.admin("ring-remove", other)
+        assert other not in out["members"]
+        assert all(len(d.ha.node.members) == 4 for d in metas.values())
+        bucket.write_key("after-shrink", payload)
+        assert bucket.read_key("after-shrink").tobytes() == payload
+    finally:
+        stop.set()
+        for d in dns:
+            d.stop()
+        for d in metas.values():
+            d.stop()
+
+
+def test_datanodes_follow_ring_growth(tmp_path):
+    """Datanodes configured with the ORIGINAL replica list must learn a
+    newly added replica from heartbeat responses, register with it, and
+    get it out of safemode — otherwise the new replica would be a
+    zero-datanode leader candidate."""
+    ports = _free_ports(4)
+    peers3 = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(3)}
+    metas, dns = {}, []
+    try:
+        for i in range(3):
+            d = _make_meta(tmp_path, i, peers3)
+            d.start()
+            metas[f"m{i}"] = d
+        _await_leader(metas)
+        # DNs know ONLY the original three replicas
+        for i in range(2):
+            d = DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}",
+                               ",".join(peers3.values()),
+                               heartbeat_interval_s=0.15)
+            d.start()
+            dns.append(d)
+        time.sleep(0.5)
+
+        m3_addr = f"127.0.0.1:{ports[3]}"
+        joining = {**peers3, "m3": m3_addr}
+        d3 = _make_meta(tmp_path, 3, joining)
+        d3.start()
+        metas["m3"] = d3
+        from ozone_tpu.net.scm_service import GrpcScmClient
+
+        scm_cli = GrpcScmClient(",".join(peers3.values()))
+        out = scm_cli.admin("ring-add", f"m3={m3_addr}")
+        assert "m3" in out["members"]
+
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if (d3.scm.nodes.node_count() == 2
+                    and not d3.scm.safemode.in_safemode()):
+                break
+            time.sleep(0.2)
+        assert d3.scm.nodes.node_count() == 2, \
+            "datanodes never registered with the added replica"
+        assert not d3.scm.safemode.in_safemode()
+        # and the DN clients now heartbeat all four replicas
+        assert any(m3_addr in dn.scm.addresses for dn in dns)
+    finally:
+        for d in dns:
+            d.stop()
+        for d in metas.values():
+            d.stop()
+
+
+def test_failover_pool_reconciles_to_shipped_ring():
+    """The client address pool adopts the full server-shipped ring:
+    added replicas are dialed, retired ones are dropped (no heartbeat
+    to a dead address forever), and the sticky index survives when its
+    replica stays in the ring. The list mutates IN PLACE because
+    GrpcScmClient aliases it."""
+    from ozone_tpu.net.rpc import FailoverChannels
+
+    pool = FailoverChannels("h0:1,h1:2,h2:3")
+    alias = pool.addresses
+    pool.follow_hint("h1:2")
+    assert pool.current == "h1:2"
+    # growth + retirement in one shipped ring
+    pool.reconcile(["h1:2", "h2:3", "h3:4"])
+    assert alias == ["h1:2", "h2:3", "h3:4"]  # alias still live
+    assert pool.current == "h1:2"             # sticky index kept
+    # current replica retired -> index resets to a live one
+    pool.reconcile(["h2:3", "h3:4"])
+    assert pool.current == "h2:3"
+    # empty / unchanged rings are no-ops
+    pool.reconcile([])
+    pool.reconcile(["h3:4", "h2:3"])
+    assert alias == ["h2:3", "h3:4"]

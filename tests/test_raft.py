@@ -315,3 +315,292 @@ def test_raft_scm_cluster(tmp_path):
     blk2 = proxy.submit("allocate_block", repl, 1024 * 1024)
     assert blk2.local_id != blk.local_id
     assert blk2.container_id >= blk.container_id
+
+
+# ------------------------------------------------------- membership change
+def _add_node(tmp_path, transport, ids, states, nid):
+    """A fresh empty node joining an existing transport."""
+    states.append([])
+    s = states[-1]
+    return RaftNode(
+        nid, [nid], tmp_path / nid, s.append,
+        snapshot_fn=(lambda s=s: list(s)),
+        restore_fn=(lambda data, s=s: (s.clear(), s.extend(data))),
+        transport=transport,
+    )
+
+
+def test_membership_add_grows_ring(tmp_path):
+    """Single-server add (Raft section 4.1 / Ratis setConfiguration
+    analog): a 3-ring grows to 5 with writes flowing before, during and
+    after, and the new nodes converge to the full history."""
+    nodes, states, transport = make_cluster(tmp_path)
+    leader = nodes[0]
+    assert leader.start_election()
+    leader.propose("before")
+
+    for i in (3, 4):
+        n = _add_node(tmp_path, transport, [x.node_id for x in nodes],
+                      states, f"n{i}")
+        nodes.append(n)
+        members = leader.change_membership(add=f"n{i}")
+        assert f"n{i}" in members
+        leader.propose(f"during-{i}")
+    leader.propose("after")
+    leader.tick()
+    assert len(leader.members) == 5
+    # every replica (old and new) applied the same history
+    expect = ["before", "during-3", "during-4", "after"]
+    for st in states:
+        assert st == expect
+    # the new config commits under the NEW quorum (3 of 5)
+    assert leader.commit_index == leader.last_applied
+
+
+def test_membership_config_survives_restart(tmp_path):
+    nodes, states, transport = make_cluster(tmp_path)
+    leader = nodes[0]
+    assert leader.start_election()
+    n3 = _add_node(tmp_path, transport, [x.node_id for x in nodes],
+                   states, "n3")
+    nodes.append(n3)
+    leader.change_membership(add="n3")
+    leader.propose("x")
+    leader.tick()
+    assert len(n3.members) == 4
+    # restart the new node: the adopted config must come back from disk
+    r = RaftNode("n3", ["n3"], tmp_path / "n3", states[3].append,
+                 transport=InProcessTransport())
+    assert set(r.members) == {"n0", "n1", "n2", "n3"}
+
+
+def test_storage_config_at_snapshot_base(tmp_path):
+    """config_at returns the configuration in force AT an index — what
+    a shipped snapshot must carry. Shipping the live config would burn
+    an uncommitted (still truncatable) ring change into a lagging
+    follower's base configuration: quorum over the wrong ring."""
+    from ozone_tpu.consensus.raft import RaftStorage
+
+    st = RaftStorage(tmp_path / "s")
+    st.record_config(5, {"a": "", "b": ""})
+    st.record_config(12, {"a": "", "b": "", "c": ""})
+    assert st.config_at(4) is None
+    assert st.config_at(5) == {"a": "", "b": ""}
+    assert st.config_at(11) == {"a": "", "b": ""}
+    assert st.config_at(12) == st.members
+
+
+def test_storage_config_crash_repair(tmp_path):
+    """The log entry carrying a config is fsync'd BEFORE the meta
+    record; a crash between the two must not revert membership — reload
+    replays _config entries the meta file missed."""
+    from ozone_tpu.consensus.raft import RaftStorage
+
+    st = RaftStorage(tmp_path / "s")
+    ring = {"n0": "", "n1": "", "n2": "127.0.0.1:7"}
+    st.append([{"term": 1, "data": {"_config": {"members": ring}}}])
+    # simulated crash: meta never recorded the config
+    st2 = RaftStorage(tmp_path / "s")
+    assert st2.members == ring
+    assert st2.config_history[-1][0] == 1
+    # and the repair persisted: a third load needs no repair
+    assert RaftStorage(tmp_path / "s").members == ring
+
+
+def test_storage_install_snapshot_drops_configs_above(tmp_path):
+    """A snapshot install wipes the log; configs stamped above the
+    snapshot point no longer have a backing entry and must go."""
+    from ozone_tpu.consensus.raft import RaftStorage
+
+    st = RaftStorage(tmp_path / "s")
+    st.record_config(3, {"a": ""})
+    st.record_config(8, {"a": "", "b": ""})
+    st.install_snapshot(5, 2, {"s": 1}, members=None)
+    assert st.members == {"a": ""}
+
+
+def test_storage_compact_crash_window_recovers(tmp_path):
+    """Crash mid-compaction: the self-stamped snapshot reached disk but
+    the log rewrite and meta marker did not. Reload must trust the
+    snapshot's own stamp and drop the log prefix it covers — the old
+    code reloaded every entry shifted to the wrong index."""
+    from ozone_tpu.consensus.raft import RaftStorage
+
+    st = RaftStorage(tmp_path / "s")
+    st.append([{"term": 1, "data": i} for i in range(6)])  # idx 1..6
+    st.snapshot_index, st.snapshot_term = 4, 1
+    st.snapshot_data = {"upto": 4}
+    st.persist_snapshot()  # ...and crash before log rewrite/meta
+
+    st2 = RaftStorage(tmp_path / "s")
+    assert st2.snapshot_index == 4 and st2.snapshot_term == 1
+    assert st2.snapshot_data == {"upto": 4}
+    assert [e["data"] for e in st2.entries] == [4, 5]  # idx 5..6
+    assert st2.last_index == 6
+    assert st2.term_at(5) == 1
+
+
+def test_storage_loads_legacy_files(tmp_path):
+    """Pre-header log files and bare snapshot payloads (round-1 format)
+    still load: entries count from the meta snapshot marker."""
+    import json as _json
+
+    from ozone_tpu.consensus.raft import RaftStorage
+
+    root = tmp_path / "s"
+    root.mkdir()
+    (root / "meta.json").write_text(_json.dumps(
+        {"term": 3, "voted_for": "n1", "snapshot_index": 2,
+         "snapshot_term": 1, "config_history": []}))
+    (root / "snapshot.json").write_text(_json.dumps(["a", "b"]))
+    (root / "log.jsonl").write_text(
+        _json.dumps({"term": 2, "data": "c"}) + "\n")
+    st = RaftStorage(root)
+    assert st.term == 3 and st.snapshot_index == 2
+    assert st.snapshot_data == ["a", "b"]
+    assert st.last_index == 3 and st.entry_at(3)["data"] == "c"
+
+
+def test_membership_restart_replays_config(tmp_path):
+    """A restarted node replays the persisted ring into its transport
+    and fires on_config when the daemon registers it — a node restarted
+    with a pre-growth CLI peer list must still know the grown ring."""
+
+    class RecordingTransport(InProcessTransport):
+        def __init__(self):
+            super().__init__()
+            self.peers: dict = {}
+
+        def set_peer(self, node_id, addr):
+            self.peers[node_id] = addr
+
+    transport = RecordingTransport()
+    states: list[list] = [[] for _ in range(3)]
+    ids = ["n0", "n1", "n2"]
+    nodes = [RaftNode(nid, ids, tmp_path / nid, states[i].append,
+                      transport=transport)
+             for i, nid in enumerate(ids)]
+    leader = nodes[0]
+    assert leader.start_election()
+    n3 = _add_node(tmp_path, transport, ids, states, "n3")
+    leader.change_membership(add="n3", address="127.0.0.1:7777")
+    leader.propose("x")
+    leader.tick()
+    del n3
+    # restart n0 with its ORIGINAL (stale) peer list
+    rt = RecordingTransport()
+    r = RaftNode("n0", ids, tmp_path / "n0", states[0].append,
+                 transport=rt)
+    # the persisted config reached the transport at construction
+    assert rt.peers.get("n3") == "127.0.0.1:7777"
+    # ...and registering the daemon hook replays the membership
+    seen: list[dict] = []
+    r.on_config = seen.append
+    assert seen and set(seen[0]) == {"n0", "n1", "n2", "n3"}
+    assert seen[0]["n3"] == "127.0.0.1:7777"
+
+
+def test_membership_revert_notifies_on_config(tmp_path):
+    """A truncated (never-committed) config entry must UN-notify the
+    daemon: the adopt path fired on_config, so the revert path fires it
+    again with the restored ring or heartbeat responses keep shipping a
+    phantom replica address."""
+    nodes, states, transport = make_cluster(tmp_path)
+    leader = nodes[0]
+    assert leader.start_election()
+    leader.propose("a")
+    rings: list[dict] = []
+    leader.on_config = rings.append
+    # cut the leader off, then append an uncommittable config entry
+    transport.partition("n0", "n1")
+    transport.partition("n0", "n2")
+    _swallow(lambda: leader.change_membership(
+        add="n9", address="127.0.0.1:9999", timeout=0.2))
+    assert rings and "n9" in rings[-1]  # adopted at append
+    # the majority side elects a new leader and overwrites the entry
+    assert nodes[1].start_election()
+    nodes[1].propose("b")
+    transport.heal()
+    nodes[1].tick()
+    nodes[1].tick()
+    assert "n9" not in leader.members
+    assert rings[-1] is not None and "n9" not in rings[-1]  # reverted
+    assert len(rings) >= 2
+
+
+def test_membership_remove_shrinks_quorum(tmp_path):
+    nodes, states, transport = make_cluster(tmp_path)
+    leader = nodes[0]
+    assert leader.start_election()
+    leader.propose("a")
+    members = leader.change_membership(remove="n2")
+    assert set(members) == {"n0", "n1"}
+    # the removed node learned the config and never campaigns again
+    assert "n2" not in nodes[2].members or \
+        nodes[2].node_id not in nodes[2].members
+    assert nodes[2].start_election() is False
+    # the 2-ring still commits (quorum 2)
+    leader.propose("b")
+    leader.tick()
+    assert states[0] == ["a", "b"] and states[1] == ["a", "b"]
+    # leader self-removal is refused
+    with pytest.raises(ValueError):
+        leader.change_membership(remove="n0")
+
+
+def test_membership_snapshot_bootstraps_new_node(tmp_path):
+    """A node added after log compaction comes up via snapshot install
+    and adopts the shipped configuration."""
+    cfg = RaftConfig(snapshot_trailing=2)
+    transport = InProcessTransport()
+    states: list[list] = [[] for _ in range(3)]
+    ids = ["n0", "n1", "n2"]
+    nodes = [
+        RaftNode(nid, ids, tmp_path / nid, states[i].append,
+                 snapshot_fn=(lambda s=states[i]: list(s)),
+                 restore_fn=(lambda d, s=states[i]: (s.clear(),
+                                                     s.extend(d))),
+                 config=cfg, transport=transport)
+        for i, nid in enumerate(ids)
+    ]
+    leader = nodes[0]
+    assert leader.start_election()
+    for i in range(10):
+        leader.propose(f"e{i}")
+    leader.take_snapshot()
+    assert leader.storage.snapshot_index > 0
+    n3 = _add_node(tmp_path, transport, ids, states, "n3")
+    leader.change_membership(add="n3")
+    leader.propose("tail")
+    leader.tick()
+    assert states[3] == [f"e{i}" for i in range(10)] + ["tail"]
+    assert set(n3.members) == {"n0", "n1", "n2", "n3"}
+
+
+def test_membership_change_serialized(tmp_path):
+    """A second change is refused while the first config entry is
+    uncommitted (single-server-change safety)."""
+    nodes, states, transport = make_cluster(tmp_path)
+    leader = nodes[0]
+    assert leader.start_election()
+    # cut the leader off so the config entry cannot commit
+    transport.partition("n0", "n1")
+    transport.partition("n0", "n2")
+    import threading
+
+    t = threading.Thread(
+        target=lambda: _swallow(
+            lambda: leader.change_membership(remove="n2", timeout=0.2)))
+    t.start()
+    t.join()
+    # config appended but uncommitted: next change must be refused
+    with pytest.raises((RuntimeError, NotRaftLeaderError)):
+        leader.change_membership(remove="n1", timeout=0.2)
+    transport.heal()
+
+
+def _swallow(fn):
+    try:
+        fn()
+    except Exception:
+        pass
