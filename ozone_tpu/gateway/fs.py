@@ -35,6 +35,16 @@ class FileStatus:
     is_dir: bool
     length: int
     modification_time: float
+    #: filesystem attributes set via SETOWNER/SETPERMISSION/SETTIMES
+    #: (owner, group, permission, mtime, atime); empty when never set
+    attrs: dict = None
+
+    def __post_init__(self):
+        if self.attrs is None:
+            self.attrs = {}
+        # explicit SETTIMES overrides the write timestamp
+        if "mtime" in self.attrs:
+            self.modification_time = self.attrs["mtime"]
 
 
 class OzoneFile:
@@ -133,14 +143,16 @@ class OzoneFileSystem:
         try:
             info = om.lookup_key(self.bucket.volume, self.bucket.name, key)
             return FileStatus(key, False, info["size"],
-                              info.get("modified", 0.0))
+                              info.get("modified", 0.0),
+                              attrs=info.get("attrs", {}))
         except _OM_ERRORS:
             pass
         try:
             info = om.lookup_key(
                 self.bucket.volume, self.bucket.name, key + "/"
             )
-            return FileStatus(key, True, 0, info.get("modified", 0.0))
+            return FileStatus(key, True, 0, info.get("modified", 0.0),
+                              attrs=info.get("attrs", {}))
         except _OM_ERRORS:
             # implicit directory: any key under the prefix (a missing
             # bucket raises here too and must surface as not-found)
@@ -170,10 +182,20 @@ class OzoneFileSystem:
             head = rest.split("/")[0]
             child = prefix + head
             if "/" in rest.rstrip("/") or rest.endswith("/"):
-                out.setdefault(child, FileStatus(child, True, 0, 0.0))
+                if rest == head + "/":
+                    # the immediate child's own marker key: it carries
+                    # the directory's attrs (SETPERMISSION/SETOWNER) —
+                    # LISTSTATUS must agree with GETFILESTATUS
+                    out[child] = FileStatus(
+                        child, True, 0, k.get("modified", 0.0),
+                        attrs=k.get("attrs", {}))
+                else:
+                    out.setdefault(child,
+                                   FileStatus(child, True, 0, 0.0))
             else:
                 out[child] = FileStatus(
-                    child, False, k["size"], k.get("modified", 0.0)
+                    child, False, k["size"], k.get("modified", 0.0),
+                    attrs=k.get("attrs", {}),
                 )
         return sorted(out.values(), key=lambda s: s.path)
 
@@ -206,6 +228,57 @@ class OzoneFileSystem:
                 self.bucket.rename_key(k["name"], new)
         else:
             self.bucket.rename_key(s, d)
+
+    def set_attrs(self, path: str, attrs: dict) -> None:
+        """SETOWNER/SETPERMISSION/SETTIMES backing (merge semantics;
+        None deletes). Directories resolve through their marker key."""
+        st = self.get_file_status(path)
+        key = self._norm(st.path)
+        om = self.bucket.client.om
+        try:
+            om.set_key_attrs(self.bucket.volume, self.bucket.name, key,
+                             attrs)
+        except _OM_ERRORS:
+            if not st.is_dir:
+                raise
+            # implicit OBS directory: materialize its marker, retry
+            self.mkdirs(path)
+            om.set_key_attrs(self.bucket.volume, self.bucket.name, key,
+                             attrs)
+
+    def checksum(self, path: str) -> dict:
+        """Composite file checksum (the DistributedFileSystem
+        getFileChecksum analog; client/file_checksum.py combines
+        per-block device CRCs)."""
+        from ozone_tpu.client.file_checksum import file_checksum
+
+        st = self.get_file_status(path)
+        if st.is_dir:
+            raise IsADirectoryError(path)
+        return file_checksum(self.bucket.client, self.bucket.volume,
+                             self.bucket.name, self._norm(path))
+
+    def append(self, path: str, data) -> None:
+        """APPEND: keys are immutable on the datapath, so append is a
+        read-modify-write re-put (the reference's OzoneFileSystem throws
+        here; the HttpFS surface is served by making the semantic work,
+        at O(file) cost for small-file workloads)."""
+        buf = np.frombuffer(
+            data, np.uint8) if isinstance(data, (bytes, bytearray)) else \
+            np.asarray(data, dtype=np.uint8)
+        old = self.bucket.read_key(self._norm(path))
+        self.bucket.write_key(self._norm(path),
+                              np.concatenate([old, buf]))
+
+    def truncate(self, path: str, new_length: int) -> bool:
+        """TRUNCATE to `new_length` (must not exceed the current size),
+        same read-modify-write tradeoff as append."""
+        old = self.bucket.read_key(self._norm(path))
+        if new_length > old.size:
+            raise OSError(
+                f"truncate length {new_length} > size {old.size}")
+        self.bucket.write_key(self._norm(path), old[:new_length])
+        return True
 
 
 class RootedOzoneFileSystem:
@@ -290,12 +363,13 @@ class RootedOzoneFileSystem:
             if not rest:
                 b = om.bucket_info(vol, bkt)
                 return FileStatus(f"{vol}/{bkt}", True, 0,
-                                  b.get("created", 0.0))
+                                  b.get("created", 0.0),
+                                  attrs=b.get("attrs", {}))
         except _OM_ERRORS:
             raise FileNotFoundError(path)
         st = self._bucket_fs(vol, bkt).get_file_status(rest)
         return FileStatus(f"{vol}/{bkt}/{st.path}", st.is_dir, st.length,
-                          st.modification_time)
+                          st.modification_time, attrs=st.attrs)
 
     def list_status(self, path: str) -> list[FileStatus]:
         vol, bkt, rest = self._resolve(path)
@@ -312,13 +386,14 @@ class RootedOzoneFileSystem:
                 raise FileNotFoundError(path)
             return [
                 FileStatus(f"{vol}/{b['name']}", True, 0,
-                           b.get("created", 0.0))
+                           b.get("created", 0.0),
+                           attrs=b.get("attrs", {}))
                 for b in om.list_buckets(vol)
             ]
         out = self._bucket_fs(vol, bkt).list_status(rest)
         return [
             FileStatus(f"{vol}/{bkt}/{s.path}", s.is_dir, s.length,
-                       s.modification_time)
+                       s.modification_time, attrs=s.attrs)
             for s in out
         ]
 
@@ -351,3 +426,31 @@ class RootedOzoneFileSystem:
             # same constraint as the reference: no cross-bucket rename
             raise OSError("rename cannot cross bucket boundaries")
         self._bucket_fs(sv, sb).rename(srest, drest)
+
+    def _in_bucket(self, path: str):
+        vol, bkt, rest = self._resolve(path)
+        if not (vol and bkt and rest):
+            raise IsADirectoryError(path)
+        return self._bucket_fs(vol, bkt), rest
+
+    def set_attrs(self, path: str, attrs: dict) -> None:
+        vol, bkt, rest = self._resolve(path)
+        if vol and bkt and not rest:
+            # buckets appear as directories at depth 2 — chmod/chown on
+            # a mount's top level lands on the bucket row itself
+            self.client.om.set_bucket_attrs(vol, bkt, attrs)
+            return
+        fs, rest = self._in_bucket(path)
+        fs.set_attrs(rest, attrs)
+
+    def checksum(self, path: str) -> dict:
+        fs, rest = self._in_bucket(path)
+        return fs.checksum(rest)
+
+    def append(self, path: str, data) -> None:
+        fs, rest = self._in_bucket(path)
+        fs.append(rest, data)
+
+    def truncate(self, path: str, new_length: int) -> bool:
+        fs, rest = self._in_bucket(path)
+        return fs.truncate(rest, new_length)

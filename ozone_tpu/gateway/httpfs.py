@@ -6,10 +6,12 @@ adapter). Serves the standard `/webhdfs/v1/<path>?op=...` verbs over the
 cluster-rooted filesystem (gateway/fs.py:RootedOzoneFileSystem):
 
   GET    OPEN (offset/length), GETFILESTATUS, LISTSTATUS,
-         GETCONTENTSUMMARY
+         GETCONTENTSUMMARY, GETFILECHECKSUM
   PUT    CREATE (two-step 307 redirect per the WebHDFS spec, or direct
-         with ?data=true), MKDIRS, RENAME (destination=)
-  POST   APPEND -> not implemented (matches immutable-key semantics)
+         with ?data=true), MKDIRS, RENAME (destination=),
+         SETPERMISSION, SETOWNER, SETTIMES
+  POST   APPEND (two-step 307, read-modify-write re-put underneath:
+         keys are immutable on the datapath), TRUNCATE (newlength=)
   DELETE DELETE (recursive=)
 
 Responses follow the WebHDFS JSON schema (FileStatus.type FILE/DIRECTORY,
@@ -36,17 +38,20 @@ PREFIX = "/webhdfs/v1"
 
 def _status_json(st: FileStatus, suffix_only: bool = False) -> dict:
     name = st.path.rstrip("/").rpartition("/")[2] if suffix_only else ""
+    a = st.attrs or {}
+    atime = a.get("atime", st.modification_time)
     return {
         "pathSuffix": name,
         "type": "DIRECTORY" if st.is_dir else "FILE",
         "length": st.length,
         "modificationTime": int(st.modification_time * 1000),
-        "accessTime": int(st.modification_time * 1000),
+        "accessTime": int(atime * 1000),
         "blockSize": 16 * 1024 * 1024,
         "replication": 1,
-        "permission": "755" if st.is_dir else "644",
-        "owner": "ozone",
-        "group": "ozone",
+        "permission": a.get("permission",
+                            "755" if st.is_dir else "644"),
+        "owner": a.get("owner", "ozone"),
+        "group": a.get("group", "ozone"),
     }
 
 
@@ -142,6 +147,11 @@ class HttpFSGateway:
             handler(h, path, q)
         except FileNotFoundError as e:
             h._json(*self._exception(404, "FileNotFoundException", str(e)))
+        except ValueError as e:
+            # malformed numeric query params (newlength=abc) are client
+            # errors, not server faults
+            h._json(*self._exception(400, "IllegalArgumentException",
+                                     str(e)))
         except (IsADirectoryError, OSError) as e:
             h._json(*self._exception(403, "IOException", str(e)))
         except (OMError, StorageError) as e:
@@ -197,7 +207,56 @@ class HttpFSGateway:
             }
         })
 
+    def _op_get_getfilechecksum(self, h, path: str, q) -> None:
+        ck = self.fs.checksum(path)
+        h._json(200, {
+            "FileChecksum": {
+                "algorithm": ck["algorithm"],
+                "bytes": ck["checksum"],
+                # WebHDFS: length of the checksum BLOB, not the file
+                # (Hadoop FileChecksum deserialization depends on it)
+                "length": len(ck["checksum"]) // 2,
+            }
+        })
+
     # ----------------------------------------------------------------- PUT
+    def _op_put_setpermission(self, h, path: str, q) -> None:
+        import re
+
+        perm = q.get("permission", ["755"])[0]
+        # strictly octal: WebHDFS clients parse this as FsPermission and
+        # a stored "999" would poison every later list/stat of the path
+        if not re.fullmatch(r"[0-7]{3,4}", perm):
+            raise OSError(f"bad permission {perm!r}")
+        self.fs.set_attrs(path, {"permission": perm})
+        h._reply(200)
+
+    def _op_put_setowner(self, h, path: str, q) -> None:
+        attrs = {}
+        owner = q.get("owner", [""])[0]
+        group = q.get("group", [""])[0]
+        if owner:
+            attrs["owner"] = owner
+        if group:
+            attrs["group"] = group
+        if not attrs:
+            raise OSError("owner or group required")
+        self.fs.set_attrs(path, attrs)
+        h._reply(200)
+
+    def _op_put_settimes(self, h, path: str, q) -> None:
+        # WebHDFS times are epoch millis; -1 means leave unchanged
+        attrs = {}
+        mtime = int(q.get("modificationtime", ["-1"])[0])
+        atime = int(q.get("accesstime", ["-1"])[0])
+        if mtime >= 0:
+            attrs["mtime"] = mtime / 1000.0
+        if atime >= 0:
+            attrs["atime"] = atime / 1000.0
+        if attrs:
+            self.fs.set_attrs(path, attrs)
+        h._reply(200)
+
     def _op_put_create(self, h, path: str, q) -> None:
         if q.get("data", ["false"])[0] != "true":
             # WebHDFS two-step: redirect the client to the data endpoint
@@ -221,6 +280,24 @@ class HttpFSGateway:
             raise OSError("destination required")
         self.fs.rename(path, dst)
         h._json(200, {"boolean": True})
+
+    # ----------------------------------------------------------------- POST
+    def _op_post_append(self, h, path: str, q) -> None:
+        if q.get("data", ["false"])[0] != "true":
+            # WebHDFS two-step, same shape as CREATE
+            loc = (f"http://{self.address}{PREFIX}{quote(path)}"
+                   f"?op=APPEND&data=true")
+            h._reply(307, headers={"Location": loc})
+            return
+        self.fs.append(path, h._body())
+        h._reply(200)
+
+    def _op_post_truncate(self, h, path: str, q) -> None:
+        new_length = int(q.get("newlength", ["0"])[0])
+        if new_length < 0:
+            raise OSError("newlength must be >= 0")
+        ok = self.fs.truncate(path, new_length)
+        h._json(200, {"boolean": bool(ok)})
 
     # ----------------------------------------------------------------- DELETE
     def _op_delete_delete(self, h, path: str, q) -> None:

@@ -131,6 +131,16 @@ class OmGrpcService:
                         m["volume"], m["bucket"], m["key"], m["new_key"]
                     )
                 ),
+                "SetKeyAttrs": self._wrap(
+                    lambda m: self.om.set_key_attrs(
+                        m["volume"], m["bucket"], m["key"], m["attrs"]
+                    )
+                ),
+                "SetBucketAttrs": self._wrap(
+                    lambda m: self.om.set_bucket_attrs(
+                        m["volume"], m["bucket"], m["attrs"]
+                    )
+                ),
                 # S3 secret + ACL verbs (reference OmClientProtocol
                 # GetS3Secret/RevokeS3Secret/SetAcl/GetAcl)
                 "GetS3Secret": self._wrap(
@@ -598,6 +608,14 @@ class GrpcOmClient:
     def rename_key(self, volume, bucket, key, new_key):
         self._call("RenameKey", volume=volume, bucket=bucket, key=key,
                    new_key=new_key)
+
+    def set_key_attrs(self, volume, bucket, key, attrs):
+        return self._call("SetKeyAttrs", volume=volume, bucket=bucket,
+                          key=key, attrs=attrs)["result"]
+
+    def set_bucket_attrs(self, volume, bucket, attrs):
+        return self._call("SetBucketAttrs", volume=volume,
+                          bucket=bucket, attrs=attrs)["result"]
 
     # s3 secrets / acl
     def get_s3_secret(self, access_id, create=True):

@@ -338,6 +338,37 @@ class DeleteDirectory(OMRequest):
 
 
 @dataclass
+class SetEntryAttrs(OMRequest):
+    """Merge filesystem attributes (owner/group/permission/mtime/atime)
+    into a file or directory row (the FSO side of HttpFS SETOWNER /
+    SETPERMISSION / SETTIMES). A None value deletes the attribute."""
+
+    volume: str
+    bucket: str
+    path: str
+    attrs: dict
+
+    def apply(self, store):
+        parent, name = resolve_parent(
+            store, self.volume, self.bucket, self.path
+        )
+        ek = dir_key(self.volume, self.bucket, parent, name)
+        table = "dirs" if store.exists("dirs", ek) else "files"
+        info = store.get(table, ek)
+        if info is None:
+            raise OMError(KEY_NOT_FOUND, ek)
+        merged = dict(info.get("attrs", {}))
+        for k, v in self.attrs.items():
+            if v is None:
+                merged.pop(k, None)
+            else:
+                merged[k] = v
+        info["attrs"] = merged
+        store.put(table, ek, info)
+        return info
+
+
+@dataclass
 class RenameEntry(OMRequest):
     """Rename a file or directory. Directory rename moves ONE row — the
     whole subtree follows because children are keyed by the directory's

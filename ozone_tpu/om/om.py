@@ -617,6 +617,29 @@ class OzoneManager:
         else:
             self.submit(rq.RenameKey(volume, bucket, key, new_key))
 
+    def set_key_attrs(self, volume: str, bucket: str, key: str,
+                      attrs: dict) -> dict:
+        """Merge filesystem attributes (owner/group/permission/mtime/
+        atime) onto a key, file, or directory (the HttpFS SETOWNER /
+        SETPERMISSION / SETTIMES verbs; reference KeyManagerImpl
+        setattr paths). None values delete attributes."""
+        from ozone_tpu.om import fso
+
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, key, "WRITE")
+        if self._is_fso(self.bucket_info(volume, bucket)):
+            return self.submit(fso.SetEntryAttrs(volume, bucket, key,
+                                                 attrs))
+        return self.submit(rq.SetKeyAttrs(volume, bucket, key, attrs))
+
+    def set_bucket_attrs(self, volume: str, bucket: str,
+                         attrs: dict) -> dict:
+        """Filesystem attrs on the bucket itself (ofs exposes buckets
+        as directories; chmod on /volume/bucket lands here)."""
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "WRITE")
+        return self.submit(rq.SetBucketAttrs(volume, bucket, attrs))
+
     # ----------------------------------------------------- s3 secrets / acl
     def get_s3_secret(self, access_id: str, create: bool = True) -> Optional[str]:
         """Fetch (creating on first use, like the reference's

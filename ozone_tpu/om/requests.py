@@ -627,6 +627,38 @@ class DeleteKey(OMRequest):
 
 
 @dataclass
+class SetKeyAttrs(OMRequest):
+    """Merge filesystem attributes (owner/group/permission/mtime/atime)
+    into a key or directory-marker row (reference: HttpFS SETOWNER /
+    SETPERMISSION / SETTIMES land in KeyManagerImpl setattr paths; OBS
+    layout stores them on the key info). A None value deletes the
+    attribute."""
+
+    volume: str
+    bucket: str
+    key: str
+    attrs: dict
+
+    def apply(self, store):
+        kk = key_key(self.volume, self.bucket, self.key)
+        info = store.get("keys", kk)
+        if info is None:  # directory marker
+            kk = key_key(self.volume, self.bucket, self.key + "/")
+            info = store.get("keys", kk)
+        if info is None:
+            raise OMError(KEY_NOT_FOUND, kk)
+        merged = dict(info.get("attrs", {}))
+        for k, v in self.attrs.items():
+            if v is None:
+                merged.pop(k, None)
+            else:
+                merged[k] = v
+        info["attrs"] = merged
+        store.put("keys", kk, info)
+        return info
+
+
+@dataclass
 class RenameKey(OMRequest):
     volume: str
     bucket: str
@@ -691,6 +723,33 @@ class SetBucketAcl(OMRequest):
             raise OMError(BUCKET_NOT_FOUND, k)
         b["acl"] = self.acl
         store.put("buckets", k, b)
+
+
+@dataclass
+class SetBucketAttrs(OMRequest):
+    """Merge filesystem attributes onto the bucket row itself — the
+    ofs model exposes /volume/bucket as a directory, so chmod/chown on
+    a mount's top level must land somewhere (HttpFS SETPERMISSION on a
+    bucket-root path). None values delete."""
+
+    volume: str
+    bucket: str
+    attrs: dict = field(default_factory=dict)
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", k)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, k)
+        merged = dict(b.get("attrs", {}))
+        for key, v in self.attrs.items():
+            if v is None:
+                merged.pop(key, None)
+            else:
+                merged[key] = v
+        b["attrs"] = merged
+        store.put("buckets", k, b)
+        return b
 
 
 PREFIX_NOT_FOUND = "PREFIX_NOT_FOUND"

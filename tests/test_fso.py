@@ -276,3 +276,26 @@ def test_list_keys_limit_zero_is_empty_on_both_layouts(cluster):
         "k", np.zeros(10, np.uint8))
     assert oz.om.list_keys("lv", "obs", limit=0) == []
     assert oz.om.list_keys("lv", "fso", limit=0) == []
+
+
+def test_fso_set_key_attrs(cluster):
+    """SETOWNER/SETPERMISSION/SETTIMES land on FSO file and dir rows
+    (the HttpFS verbs' FSO backing) with merge + delete semantics."""
+    b = _bucket(cluster)
+    b.write_key("p/q/f.txt", np.frombuffer(b"data", np.uint8))
+    om = cluster.om
+    om.set_key_attrs("vol", "fsb", "p/q/f.txt",
+                     {"owner": "alice", "permission": "640"})
+    om.set_key_attrs("vol", "fsb", "p/q/f.txt", {"mtime": 1700.0})
+    st = om.get_file_status("vol", "fsb", "p/q/f.txt")
+    assert st["attrs"] == {"owner": "alice", "permission": "640",
+                           "mtime": 1700.0}
+    # dirs take attrs too; None deletes
+    om.set_key_attrs("vol", "fsb", "p/q", {"permission": "700"})
+    om.set_key_attrs("vol", "fsb", "p/q/f.txt", {"owner": None})
+    assert om.get_file_status("vol", "fsb", "p/q")["attrs"] == \
+        {"permission": "700"}
+    assert "owner" not in om.get_file_status(
+        "vol", "fsb", "p/q/f.txt")["attrs"]
+    with pytest.raises(OMError):
+        om.set_key_attrs("vol", "fsb", "p/nope", {"owner": "x"})

@@ -180,3 +180,116 @@ def test_webhdfs_unknown_op_400(hfs):
     assert ei.value.code == 400
     body = json.load(ei.value)
     assert "RemoteException" in body
+
+
+def test_webhdfs_setowner_setpermission_settimes(hfs):
+    urllib.request.urlopen(urllib.request.Request(
+        _url(hfs, "/wv/wb/attrs/f", op="CREATE", data="true"),
+        data=b"attr-data", method="PUT"))
+    assert _req(hfs, "PUT", "/wv/wb/attrs/f", op="SETOWNER",
+                owner="alice", group="eng").status == 200
+    assert _req(hfs, "PUT", "/wv/wb/attrs/f", op="SETPERMISSION",
+                permission="640").status == 200
+    assert _req(hfs, "PUT", "/wv/wb/attrs/f", op="SETTIMES",
+                modificationtime=1700000000000,
+                accesstime=1700000001000).status == 200
+    st = json.load(_req(hfs, "GET", "/wv/wb/attrs/f",
+                        op="GETFILESTATUS"))["FileStatus"]
+    assert st["owner"] == "alice" and st["group"] == "eng"
+    assert st["permission"] == "640"
+    assert st["modificationTime"] == 1700000000000
+    assert st["accessTime"] == 1700000001000
+    # attributes survive on directories too
+    assert _req(hfs, "PUT", "/wv/wb/attrs", op="SETPERMISSION",
+                permission="700").status == 200
+    std = json.load(_req(hfs, "GET", "/wv/wb/attrs",
+                         op="GETFILESTATUS"))["FileStatus"]
+    assert std["permission"] == "700"
+    # LISTSTATUS must agree with GETFILESTATUS on directory attrs
+    sts = json.load(_req(hfs, "GET", "/wv/wb",
+                         op="LISTSTATUS"))["FileStatuses"]["FileStatus"]
+    row = next(s for s in sts if s["pathSuffix"] == "attrs")
+    assert row["permission"] == "700"
+    # bucket-root chmod lands on the bucket row (ofs top-level dirs)
+    assert _req(hfs, "PUT", "/wv/wb", op="SETPERMISSION",
+                permission="750").status == 200
+    stb = json.load(_req(hfs, "GET", "/wv/wb",
+                         op="GETFILESTATUS"))["FileStatus"]
+    assert stb["permission"] == "750"
+    # non-octal permission strings are refused, not stored
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(hfs, "PUT", "/wv/wb/attrs/f", op="SETPERMISSION",
+             permission="999")
+    assert ei.value.code == 403
+
+
+def test_webhdfs_append_two_step(hfs):
+    urllib.request.urlopen(urllib.request.Request(
+        _url(hfs, "/wv/wb/app/f", op="CREATE", data="true"),
+        data=b"hello ", method="PUT"))
+
+    class NoRedirect(urllib.request.HTTPRedirectHandler):
+        def redirect_request(self, *a, **kw):
+            return None
+
+    opener = urllib.request.build_opener(NoRedirect)
+    try:
+        opener.open(urllib.request.Request(
+            _url(hfs, "/wv/wb/app/f", op="APPEND"), method="POST"))
+        assert False, "expected 307"
+    except urllib.error.HTTPError as e:
+        assert e.code == 307
+        loc = e.headers["Location"]
+    r = urllib.request.urlopen(
+        urllib.request.Request(loc, data=b"world", method="POST"))
+    assert r.status == 200
+    got = _req(hfs, "GET", "/wv/wb/app/f", op="OPEN").read()
+    assert got == b"hello world"
+
+
+def test_webhdfs_truncate(hfs):
+    urllib.request.urlopen(urllib.request.Request(
+        _url(hfs, "/wv/wb/tr/f", op="CREATE", data="true"),
+        data=b"0123456789", method="PUT"))
+    r = _req(hfs, "POST", "/wv/wb/tr/f", op="TRUNCATE", newlength=4)
+    assert json.load(r)["boolean"] is True
+    assert _req(hfs, "GET", "/wv/wb/tr/f", op="OPEN").read() == b"0123"
+    # growing a file via truncate is refused
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(hfs, "POST", "/wv/wb/tr/f", op="TRUNCATE", newlength=99)
+    assert ei.value.code == 403
+
+
+def test_webhdfs_getfilechecksum(hfs):
+    payload = bytes(np.random.default_rng(7).integers(
+        0, 256, 50_000, dtype=np.uint8))
+    urllib.request.urlopen(urllib.request.Request(
+        _url(hfs, "/wv/wb/ck/f", op="CREATE", data="true"),
+        data=payload, method="PUT"))
+    ck = json.load(_req(hfs, "GET", "/wv/wb/ck/f",
+                        op="GETFILECHECKSUM"))["FileChecksum"]
+    assert ck["algorithm"].startswith("COMPOSITE-")
+    assert ck["length"] == 4  # byte-length of the checksum blob (CRC32)
+    assert len(ck["bytes"]) == 8  # crc32 hex
+    # identical content -> identical composite checksum
+    urllib.request.urlopen(urllib.request.Request(
+        _url(hfs, "/wv/wb/ck/g", op="CREATE", data="true"),
+        data=payload, method="PUT"))
+    ck2 = json.load(_req(hfs, "GET", "/wv/wb/ck/g",
+                         op="GETFILECHECKSUM"))["FileChecksum"]
+    assert ck2["bytes"] == ck["bytes"]
+
+
+def test_webhdfs_malformed_numeric_params_400(hfs):
+    urllib.request.urlopen(urllib.request.Request(
+        _url(hfs, "/wv/wb/num/f", op="CREATE", data="true"),
+        data=b"12345", method="PUT"))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(hfs, "POST", "/wv/wb/num/f", op="TRUNCATE", newlength="abc")
+    assert ei.value.code == 400
+    assert json.load(ei.value)["RemoteException"]["exception"] == \
+        "IllegalArgumentException"
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(hfs, "PUT", "/wv/wb/num/f", op="SETTIMES",
+             modificationtime="xyz")
+    assert ei.value.code == 400
