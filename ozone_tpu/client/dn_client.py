@@ -198,6 +198,9 @@ class DatanodeClientFactory:
         self.locations: dict[str, str] = {}
         self.location: Optional[str] = None
         self.node_id: Optional[str] = None
+        #: clients retired by a cert rotation, closed at factory close
+        self._retired: list[DatanodeClient] = []
+        self._tls_ver = None
 
     def learn_locations(self, locations: dict[str, str]) -> None:
         if locations:
@@ -249,6 +252,16 @@ class DatanodeClientFactory:
         c = self._local.get(dn_id)
         if c is not None:
             return c
+        # cert rotation (RotatingTls.version bump): drop cached remote
+        # clients so reconnects present the renewed identity, not a
+        # retired cert the peer may no longer trust. Parked, not closed:
+        # an in-flight repair RPC may still be on one (closed at
+        # factory close()).
+        ver = getattr(self.tls, "version", None)
+        if ver != getattr(self, "_tls_ver", None):
+            self._tls_ver = ver
+            self._retired.extend(self._remote.values())
+            self._remote.clear()
         c = self._remote.get(dn_id)
         if c is not None:
             return c
@@ -261,3 +274,13 @@ class DatanodeClientFactory:
             self._remote[dn_id] = c
             return c
         return None
+
+    def close(self) -> None:
+        clients = list(self._remote.values()) + self._retired
+        self._remote.clear()
+        self._retired = []
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass

@@ -60,16 +60,35 @@ class DatanodeDaemon:
         # datapath/raft channels) over mutual TLS — the reference's
         # grpc.tls.enabled cluster posture
         self.tls = None
+        self.cert_renewal = None
         if ca_address is not None:
-            from ozone_tpu.utils.ca import CertificateClient
+            from ozone_tpu.utils.ca import (
+                CertificateClient,
+                CertRenewalService,
+            )
 
-            cc = CertificateClient(
+            cc = self.cert_client = CertificateClient(
                 Path(root) / "certs", f"datanode-{dn_id}",
                 hostnames=["localhost", "127.0.0.1", dn_id],
             )
             if not cc.enrolled:
                 cc.enroll_remote(ca_address, secret=enrollment_secret)
-            self.tls = cc.tls()
+            # live TLS view + auto-renewal: a renewed cert is served on
+            # the next handshake without a daemon restart
+            self.tls = cc.rotating_tls()
+            # recurring trust refresh ONLY when the bootstrap secret
+            # authenticates the responses — without it, a periodic
+            # unauthenticated fetch would be a standing MITM
+            # trust-poisoning channel (enrollment stays one-shot TOFU)
+            trust = (
+                (lambda: cc.refresh_trust_remote(
+                    ca_address, secret=enrollment_secret))
+                if enrollment_secret is not None else None)
+            self.cert_renewal = CertRenewalService(
+                self.tls,
+                lambda: cc.renew_remote(ca_address,
+                                        secret=enrollment_secret),
+                trust_fn=trust)
         self.server = RpcServer(host, port, tls=self.tls)
         # datapath token verification (BlockTokenVerifier on the
         # HddsDispatcher): starts disabled; the SCM's register/heartbeat
@@ -173,6 +192,8 @@ class DatanodeDaemon:
 
     def start(self) -> None:
         self.server.start()
+        if self.cert_renewal is not None:
+            self.cert_renewal.start()
         self._rejoin_pipelines()
         self.scm.register(self.dn.id, self.address, rack=self.rack,
                           op_state=self._op_state)
@@ -397,6 +418,8 @@ class DatanodeDaemon:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.cert_renewal is not None:
+            self.cert_renewal.stop()
         if self._hb:
             self._hb.join(timeout=5)
         if self._scanner:
@@ -404,6 +427,7 @@ class DatanodeDaemon:
         self.xceiver_ratis.stop()
         self.server.stop()
         self.scm.close()
+        self.clients.close()
         self.dn.close()
 
 
@@ -450,23 +474,33 @@ class ScmOmDaemon:
         self.tls = None
         self.ca = None
         self.enroll_server = None
+        self.cert_renewal = None
         if secure:
             from ozone_tpu.utils.ca import (
                 CertificateAuthority,
                 CertificateClient,
+                CertRenewalService,
                 EnrollmentService,
             )
 
             # the meta-HA raft transport dials peers with
             # server_name=<ha id>, so the cert must carry it as a SAN
             names = ["localhost", "127.0.0.1"] + ([ha_id] if ha_id else [])
-            cc = CertificateClient(Path(om_db).parent / "certs", "scm-om",
-                                   hostnames=names)
+            cc = self.cert_client = CertificateClient(
+                Path(om_db).parent / "certs", "scm-om", hostnames=names)
             if ca_address is not None:
                 # non-primordial HA replica: the root CA lives in the
                 # primordial metadata server (reference: SCM hosts it)
                 if not cc.enrolled:
                     cc.enroll_remote(ca_address, secret=enrollment_secret)
+                renew = lambda: cc.renew_remote(  # noqa: E731
+                    ca_address, secret=enrollment_secret)
+                # same MITM gate as the datanode side: no secret, no
+                # recurring plaintext trust refresh
+                trust = (
+                    (lambda: cc.refresh_trust_remote(
+                        ca_address, secret=enrollment_secret))
+                    if enrollment_secret is not None else None)
             else:
                 self.ca = CertificateAuthority(Path(om_db).parent / "ca")
                 if not cc.enrolled:
@@ -474,7 +508,11 @@ class ScmOmDaemon:
                 self.enroll_server = RpcServer(host, enroll_port)
                 EnrollmentService(self.ca, self.enroll_server,
                                   secret=enrollment_secret)
-            self.tls = cc.tls()
+                renew = lambda: cc.renew(self.ca)  # noqa: E731
+                trust = lambda: cc.refresh_trust(self.ca)  # noqa: E731
+            self.tls = cc.rotating_tls()
+            self.cert_renewal = CertRenewalService(self.tls, renew,
+                                                   trust_fn=trust)
         if block_tokens and not secure and not insecure_secrets:
             raise ValueError(
                 "block_tokens without secure=True would hand the signing "
@@ -784,6 +822,8 @@ class ScmOmDaemon:
             self.http.start()
         if self.recon is not None:
             self.recon.start()
+        if self.cert_renewal is not None:
+            self.cert_renewal.start()
         if self.ha is not None:
             self.ha.start()
         else:
@@ -841,6 +881,8 @@ class ScmOmDaemon:
             self.http.stop()
         if self.recon is not None:
             self.recon.stop()
+        if self.cert_renewal is not None:
+            self.cert_renewal.stop()
         self.scm.stop()
         self.server.stop()
         if self.enroll_server is not None:

@@ -86,6 +86,11 @@ class GrpcRaftTransport(Transport):
         #: an election round delays the candidate's next campaign
         self._vote_timeout = vote_timeout_s
         self._channels: dict[str, RpcChannel] = {}
+        #: cert-rotation watermark (RotatingTls.version); retired
+        #: channels are parked until close() — an immediate close could
+        #: race an in-flight raft RPC
+        self._tls_ver = getattr(tls, "version", None)
+        self._retired: list[RpcChannel] = []
         self._lock = threading.Lock()
 
     def register(self, node: RaftNode) -> None:  # transport API, no-op
@@ -101,6 +106,12 @@ class GrpcRaftTransport(Transport):
 
     def _channel(self, peer_id: str) -> RpcChannel:
         with self._lock:
+            ver = getattr(self._tls, "version", None)
+            if ver != self._tls_ver:
+                # cert rotated: reconnect with the renewed identity
+                self._retired.extend(self._channels.values())
+                self._channels.clear()
+                self._tls_ver = ver
             ch = self._channels.get(peer_id)
             if ch is None:
                 addr = self._peers.get(peer_id)
@@ -130,6 +141,8 @@ class GrpcRaftTransport(Transport):
 
     def close(self) -> None:
         with self._lock:
-            for ch in self._channels.values():
-                ch.close()
+            chans = list(self._channels.values()) + self._retired
             self._channels.clear()
+            self._retired = []
+        for ch in chans:
+            ch.close()

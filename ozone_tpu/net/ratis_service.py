@@ -160,6 +160,10 @@ class RatisClientFactory:
         self._remote_addr: dict[str, str] = {}
         self._remote: dict[str, GrpcRatisClient] = {}
         self.tls = None
+        #: cert-rotation watermark; retired clients are parked until
+        #: close() so in-flight RPCs finish on the old channel
+        self._tls_ver = None
+        self._retired: list[GrpcRatisClient] = []
         #: optional dn_id -> address resolver (typically the datapath
         #: DatanodeClientFactory.remote_address — both services ride the
         #: same RpcServer, so one address book serves both)
@@ -182,6 +186,12 @@ class RatisClientFactory:
         c = self._local.get(dn_id)
         if c is not None:
             return c
+        ver = getattr(self.tls, "version", None)
+        if ver != self._tls_ver:
+            # cert rotated: reconnect with the renewed identity
+            self._retired.extend(self._remote.values())
+            self._remote.clear()
+            self._tls_ver = ver
         if self._address_source is not None:
             # re-resolve every time: a restarted datanode binds a new
             # port and the shared address book is refreshed by the OM
@@ -197,6 +207,13 @@ class RatisClientFactory:
         c = GrpcRatisClient(dn_id, addr, tls=self.tls)
         self._remote[dn_id] = c
         return c
+
+    def close(self) -> None:
+        clients = list(self._remote.values()) + self._retired
+        self._remote.clear()
+        self._retired = []
+        for c in clients:
+            c.close()
 
     def get(self, dn_id: str) -> RatisClient:
         c = self.maybe_get(dn_id)

@@ -318,6 +318,10 @@ class FailoverChannels:
         #: channels to replicas retired by reconcile(); closed with the
         #: pool (an immediate close could race an in-flight RPC)
         self._retired: list[RpcChannel] = []
+        #: cert-rotation watermark (RotatingTls.version): cached
+        #: channels minted under a retired identity are dropped so the
+        #: next call reconnects with the renewed cert
+        self._tls_ver = getattr(tls, "version", None)
         self._idx = 0
         self._lock = threading.Lock()
 
@@ -328,6 +332,13 @@ class FailoverChannels:
 
     def channel(self, addr: Optional[str] = None) -> tuple[str, RpcChannel]:
         with self._lock:
+            ver = getattr(self._tls, "version", None)
+            if ver != self._tls_ver:
+                # the cert rotated: retire every cached channel (parked,
+                # not closed — an in-flight RPC may still be using one)
+                self._retired.extend(self._chs.values())
+                self._chs.clear()
+                self._tls_ver = ver
             a = addr if addr is not None else self.addresses[self._idx]
             ch = self._chs.get(a)
             if ch is None:

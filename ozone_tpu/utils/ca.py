@@ -78,8 +78,12 @@ class CertificateAuthority:
         self.root_dir = Path(root_dir)
         self.root_dir.mkdir(parents=True, exist_ok=True)
         self.valid_days = valid_days
+        self.cluster_id = cluster_id
         key_path = self.root_dir / "ca.key.pem"
         cert_path = self.root_dir / "ca.cert.pem"
+        gen_path = self.root_dir / "generation"
+        self.generation = (int(gen_path.read_text())
+                           if gen_path.exists() else 0)
         if key_path.exists() and cert_path.exists():
             self.key = serialization.load_pem_private_key(
                 key_path.read_bytes(), password=None)
@@ -87,7 +91,12 @@ class CertificateAuthority:
         else:
             self.key = _new_key()
             now = datetime.datetime.now(datetime.timezone.utc)
-            name = _name(f"{cluster_id}-root-ca")
+            # each rotation generation gets a DISTINCT subject DN:
+            # trust stores select anchors by subject, and two roots
+            # sharing one subject make the TLS stack verify against
+            # whichever key it finds first (BAD_SIGNATURE failures)
+            suffix = f"-g{self.generation}" if self.generation else ""
+            name = _name(f"{cluster_id}-root-ca{suffix}")
             self.cert = (
                 x509.CertificateBuilder()
                 .subject_name(name)
@@ -114,7 +123,46 @@ class CertificateAuthority:
 
     @property
     def root_pem(self) -> bytes:
-        return self.cert.public_bytes(serialization.Encoding.PEM)
+        """Trust bundle: the active root, plus the previous root while a
+        rotation is in flight (leaves issued by either still verify)."""
+        pem = self.cert.public_bytes(serialization.Encoding.PEM)
+        prev = self.root_dir / "ca.cert.prev.pem"
+        if prev.exists():
+            pem += prev.read_bytes()
+        return pem
+
+    def rotate_root(self) -> None:
+        """Root-CA rotation (reference: root-CA rotation in
+        hadoop-hdds/framework security/x509): mint a NEW root key+cert,
+        keep the old root in the trust bundle so existing leaf certs
+        keep verifying, and issue all future leaves from the new root.
+        Once every leaf has renewed, `retire_previous_root()` drops the
+        old trust anchor."""
+        prev = self.root_dir / "ca.cert.prev.pem"
+        if prev.exists():
+            # a second rotation would silently drop the generation-N-1
+            # anchor while leaves issued under it may still be live,
+            # failing mutual TLS cluster-wide; the operator must finish
+            # the in-flight transition (all leaves renewed, then
+            # retire_previous_root) before rotating again
+            raise RuntimeError(
+                "root rotation already in flight: previous root not "
+                "yet retired (call retire_previous_root once every "
+                "leaf has renewed under the new root)")
+        old_cert = self.root_dir / "ca.cert.pem"
+        prev.write_bytes(old_cert.read_bytes())
+        (self.root_dir / "generation").write_text(
+            str(self.generation + 1))
+        (self.root_dir / "ca.key.pem").unlink()
+        old_cert.unlink()
+        # re-run the constructor's bootstrap path for the new root
+        self.__init__(self.root_dir, cluster_id=self.cluster_id,
+                      valid_days=self.valid_days)
+
+    def retire_previous_root(self) -> None:
+        prev = self.root_dir / "ca.cert.prev.pem"
+        if prev.exists():
+            prev.unlink()
 
     def sign_csr(self, csr_pem: bytes, valid_days: int = 398) -> bytes:
         """Issue a leaf cert for a CSR (DefaultApprover analog: SANs are
@@ -156,11 +204,15 @@ class CertificateClient:
     storage under the role dir."""
 
     def __init__(self, role_dir: Path, role: str,
-                 hostnames: Optional[list[str]] = None):
+                 hostnames: Optional[list[str]] = None,
+                 valid_days: int = 398):
         self.role_dir = Path(role_dir)
         self.role_dir.mkdir(parents=True, exist_ok=True)
         self.role = role
         self.hostnames = hostnames or ["localhost", "127.0.0.1"]
+        #: requested leaf lifetime for in-process enrollment/renewal
+        #: (short-lived certs + auto-renewal are the hardened posture)
+        self.valid_days = valid_days
         self.key_path = self.role_dir / f"{role}.key.pem"
         self.cert_path = self.role_dir / f"{role}.cert.pem"
         self.ca_path = self.role_dir / "ca.cert.pem"
@@ -171,7 +223,7 @@ class CertificateClient:
             self.key = _new_key()
             _write_private(self.key_path, _pem_key(self.key))
 
-    def make_csr(self) -> bytes:
+    def make_csr(self, key=None) -> bytes:
         sans: list[x509.GeneralName] = []
         for h in self.hostnames:
             try:
@@ -182,7 +234,7 @@ class CertificateClient:
             x509.CertificateSigningRequestBuilder()
             .subject_name(_name(self.role))
             .add_extension(x509.SubjectAlternativeName(sans), critical=False)
-            .sign(self.key, hashes.SHA256())
+            .sign(key or self.key, hashes.SHA256())
         )
         return csr.public_bytes(serialization.Encoding.PEM)
 
@@ -194,14 +246,30 @@ class CertificateClient:
         """In-process enrollment (daemons co-located with the SCM CA or
         test clusters); remote enrollment ships make_csr() over the SCM
         RPC and installs the response the same way."""
-        self.install(ca.sign_csr(self.make_csr()), ca.root_pem)
+        self.install(ca.sign_csr(self.make_csr(),
+                                 valid_days=self.valid_days),
+                     ca.root_pem)
 
-    def enroll_remote(self, address: str,
-                      secret: Optional[str] = None) -> None:
-        """Enroll against the SCM CA's plaintext enrollment endpoint
-        (SCMSecurityProtocol getDataNodeCertificate analog; the
-        reference authenticates the CSR channel with Kerberos — here an
-        optional shared bootstrap secret gates signing)."""
+    @staticmethod
+    def _require_mac(secret: Optional[str], domain: bytes,
+                     payload: bytes, mac: Optional[str]) -> None:
+        """When this client holds the bootstrap secret, the server's
+        response MUST carry a matching HMAC — the enrollment plane is
+        plaintext, and an unauthenticated response would let a MITM
+        substitute a rogue CA bundle (trust poisoning)."""
+        import hmac as _hmac
+
+        if secret is None:
+            return
+        expect = _hmac.new(secret.encode(), domain + payload,
+                           "sha256").hexdigest()
+        if not (mac and _hmac.compare_digest(expect, mac)):
+            raise PermissionError(
+                "enrollment response failed authentication (missing or "
+                "bad response MAC) — possible MITM on the CSR channel")
+
+    def _sign_csr_remote(self, address: str, csr: bytes,
+                         secret: Optional[str]) -> tuple[bytes, bytes]:
         from ozone_tpu.net import wire
         from ozone_tpu.net.rpc import RpcChannel
 
@@ -209,16 +277,118 @@ class CertificateClient:
         try:
             resp = ch.call(
                 ENROLL_SERVICE, "SignCsr",
-                wire.pack({"csr": self.make_csr().decode(),
-                           "secret": secret}))
+                wire.pack({"csr": csr.decode(), "secret": secret}))
             m, _ = wire.unpack(resp)
-            self.install(m["cert"].encode(), m["ca"].encode())
         finally:
             ch.close()
+        cert, ca_pem = m["cert"].encode(), m["ca"].encode()
+        self._require_mac(secret, b"enroll:", csr + cert + ca_pem,
+                          m.get("mac"))
+        return cert, ca_pem
+
+    def enroll_remote(self, address: str,
+                      secret: Optional[str] = None) -> None:
+        """Enroll against the SCM CA's plaintext enrollment endpoint
+        (SCMSecurityProtocol getDataNodeCertificate analog; the
+        reference authenticates the CSR channel with Kerberos — here
+        the shared bootstrap secret both gates signing server-side and
+        authenticates the response client-side)."""
+        csr = self.make_csr()
+        cert, ca_pem = self._sign_csr_remote(address, csr, secret)
+        self.install(cert, ca_pem)
 
     @property
     def enrolled(self) -> bool:
         return self.cert_path.exists() and self.ca_path.exists()
+
+    # ------------------------------------------------------- lifecycle
+    @property
+    def cert(self) -> x509.Certificate:
+        return x509.load_pem_x509_certificate(self.cert_path.read_bytes())
+
+    @property
+    def expires_at(self) -> datetime.datetime:
+        return self.cert.not_valid_after_utc
+
+    def remaining_fraction(self) -> float:
+        """Fraction of the cert's lifetime still ahead (0.0 = expired)."""
+        c = self.cert
+        now = datetime.datetime.now(datetime.timezone.utc)
+        total = (c.not_valid_after_utc
+                 - c.not_valid_before_utc).total_seconds()
+        left = (c.not_valid_after_utc - now).total_seconds()
+        return max(0.0, left / total) if total > 0 else 0.0
+
+    def needs_renewal(self, threshold: float = 0.25) -> bool:
+        """True once less than `threshold` of the lifetime remains (the
+        reference renews inside its renewal grace window)."""
+        return self.enrolled and self.remaining_fraction() < threshold
+
+    def _commit_renewal(self, new_key, cert_pem: bytes,
+                        ca_pem: bytes) -> None:
+        """Persist a successful renewal. The fresh key lives only in
+        memory until the CA signed its CSR — a failed renewal RPC must
+        leave the on-disk key/cert pair matched, or the next reload or
+        restart serves a cert whose public key the private key can't
+        back."""
+        _write_private(self.key_path, _pem_key(new_key))
+        self.key = new_key
+        self.install(cert_pem, ca_pem)
+
+    def renew(self, ca: CertificateAuthority) -> None:
+        # renewal mints a FRESH keypair (reference cert clients do the
+        # same: a long-lived private key defeats short-lived certs)
+        new_key = _new_key()
+        cert = ca.sign_csr(self.make_csr(key=new_key),
+                           valid_days=self.valid_days)
+        self._commit_renewal(new_key, cert, ca.root_pem)
+
+    def renew_remote(self, address: str,
+                     secret: Optional[str] = None) -> None:
+        """Re-enroll over the enrollment endpoint with a fresh keypair;
+        nothing touches disk until the CA answers (and, with a secret,
+        until the response authenticates)."""
+        new_key = _new_key()
+        csr = self.make_csr(key=new_key)
+        cert, ca_pem = self._sign_csr_remote(address, csr, secret)
+        self._commit_renewal(new_key, cert, ca_pem)
+
+    def refresh_trust(self, ca: CertificateAuthority) -> bool:
+        """Adopt the CA's CURRENT trust bundle (phase 1 of a root
+        rotation: every party must trust the new root BEFORE any leaf
+        is issued from it, or mutual-TLS peers reject each other
+        mid-transition). Returns True when the bundle changed."""
+        return self._install_trust(ca.root_pem)
+
+    def refresh_trust_remote(self, address: str,
+                             secret: Optional[str] = None) -> bool:
+        """Periodic trust refresh. With a bootstrap secret, the fetch
+        is challenge-response authenticated (client nonce, HMAC'd
+        reply): a recurring UNauthenticated fetch would turn the
+        one-shot enrollment bootstrap into a lifelong MITM
+        trust-poisoning vector."""
+        import os as _os
+
+        from ozone_tpu.net import wire
+        from ozone_tpu.net.rpc import RpcChannel
+
+        nonce = _os.urandom(16).hex()
+        ch = RpcChannel(address)
+        try:
+            m, _ = wire.unpack(ch.call(ENROLL_SERVICE, "RootCert",
+                                       wire.pack({"nonce": nonce})))
+        finally:
+            ch.close()
+        bundle = m["ca"].encode()
+        self._require_mac(secret, b"root:",
+                          nonce.encode() + bundle, m.get("mac"))
+        return self._install_trust(bundle)
+
+    def _install_trust(self, bundle: bytes) -> bool:
+        if self.ca_path.exists() and self.ca_path.read_bytes() == bundle:
+            return False
+        self.ca_path.write_bytes(bundle)
+        return True
 
     def tls(self) -> "TlsMaterial":
         if not self.enrolled:
@@ -228,6 +398,9 @@ class CertificateClient:
             cert_pem=self.cert_path.read_bytes(),
             ca_pem=self.ca_path.read_bytes(),
         )
+
+    def rotating_tls(self) -> "RotatingTls":
+        return RotatingTls(self)
 
 
 ENROLL_SERVICE = "ozone.tpu.CertEnrollment"
@@ -242,13 +415,23 @@ class EnrollmentService:
     is a leaf cert whose trust is still rooted in the SCM CA)."""
 
     def __init__(self, ca: CertificateAuthority, server,
-                 secret: Optional[str] = None):
+                 secret: Optional[str] = None,
+                 leaf_valid_days: int = 398):
         self.ca = ca
         self.secret = secret
+        self.leaf_valid_days = leaf_valid_days
         server.add_service(ENROLL_SERVICE, {
             "SignCsr": self._sign,
             "RootCert": self._root,
         })
+
+    def _mac(self, domain: bytes, payload: bytes) -> Optional[str]:
+        import hmac as _hmac
+
+        if self.secret is None:
+            return None
+        return _hmac.new(self.secret.encode(), domain + payload,
+                         "sha256").hexdigest()
 
     def _sign(self, req: bytes) -> bytes:
         import hmac as _hmac
@@ -259,14 +442,140 @@ class EnrollmentService:
         if self.secret is not None and not _hmac.compare_digest(
                 str(m.get("secret") or ""), self.secret):
             raise PermissionError("bad enrollment secret")
-        cert = self.ca.sign_csr(m["csr"].encode())
-        return wire.pack({"cert": cert.decode(),
-                          "ca": self.ca.root_pem.decode()})
+        csr = m["csr"].encode()
+        cert = self.ca.sign_csr(csr, valid_days=self.leaf_valid_days)
+        ca_pem = self.ca.root_pem
+        # response authentication: the plaintext channel is only safe
+        # because both sides can prove knowledge of the bootstrap secret
+        return wire.pack({
+            "cert": cert.decode(),
+            "ca": ca_pem.decode(),
+            "mac": self._mac(b"enroll:", csr + cert + ca_pem),
+        })
 
     def _root(self, req: bytes) -> bytes:
         from ozone_tpu.net import wire
 
-        return wire.pack({"ca": self.ca.root_pem.decode()})
+        m, _ = wire.unpack(req)
+        nonce = str(m.get("nonce") or "")
+        bundle = self.ca.root_pem
+        return wire.pack({
+            "ca": bundle.decode(),
+            "mac": self._mac(b"root:", nonce.encode() + bundle),
+        })
+
+
+class RotatingTls:
+    """Live TLS view over a CertificateClient (the reference's
+    certificate-reload path: renewed certs are picked up WITHOUT a
+    restart). Servers built from this use gRPC dynamic server
+    credentials — every new handshake reads the current cert — and
+    channel pools compare `version` to drop connections that present a
+    retired identity."""
+
+    def __init__(self, client: CertificateClient):
+        self._client = client
+        self._version = 0
+        self._cached = client.tls()
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def current(self) -> "TlsMaterial":
+        return self._cached
+
+    def reload(self) -> None:
+        """Re-read the PEMs after a renewal/rotation."""
+        self._cached = self._client.tls()
+        self._version += 1
+
+    # --- grpc credential builders (same surface as TlsMaterial) ---
+    def server_credentials(self, mutual: bool = True):
+        import grpc
+
+        def fetch():
+            m = self._cached
+            return grpc.ssl_server_certificate_configuration(
+                [(m.key_pem, m.cert_pem)], root_certificates=m.ca_pem)
+
+        return grpc.dynamic_ssl_server_credentials(
+            fetch(), lambda: fetch(),
+            require_client_authentication=mutual)
+
+    def channel_credentials(self):
+        return self._cached.channel_credentials()
+
+
+class CertRenewalService:
+    """Background auto-renewal (DefaultCertificateClient's renewal
+    monitor analog): wakes periodically, renews once the cert is inside
+    the grace window, and reloads the live TLS view so servers hand out
+    the new identity on the next handshake — no restart, no dropped
+    RPCs."""
+
+    def __init__(self, tls: RotatingTls, renew_fn, trust_fn=None,
+                 check_interval_s: float = 60.0,
+                 threshold: float = 0.25):
+        self.tls = tls
+        self.renew_fn = renew_fn  # () -> None; performs the re-enroll
+        #: () -> bool; refreshes the trust bundle (root-rotation phase 1)
+        #: and reports whether it changed. None = no trust refresh.
+        self.trust_fn = trust_fn
+        self.check_interval_s = check_interval_s
+        self.threshold = threshold
+        self.renewals = 0
+        import threading
+
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        import threading
+
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="cert-renewal")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+
+    def check_once(self) -> bool:
+        """One renewal check (the loop body; tests drive this
+        directly). Returns True when a renewal happened."""
+        if self.trust_fn is not None and self.trust_fn():
+            # the root rotated: serve the new bundle right away so
+            # peers holding new-root leaves are accepted
+            self.tls.reload()
+        if not self._client_needs_renewal():
+            return False
+        self.renew_fn()
+        self.tls.reload()
+        self.renewals += 1
+        import logging
+
+        logging.getLogger(__name__).info(
+            "cert renewed for %s; now valid until %s",
+            self.tls._client.role, self.tls._client.expires_at)
+        return True
+
+    def _client_needs_renewal(self) -> bool:
+        try:
+            return self.tls._client.needs_renewal(self.threshold)
+        except Exception:
+            return False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.check_interval_s):
+            try:
+                self.check_once()
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "certificate renewal failed; will retry")
 
 
 @dataclass(frozen=True)

@@ -109,3 +109,246 @@ def test_untrusted_ca_rejected(tmp_path):
         ch.close()
     finally:
         srv.stop()
+
+
+# ------------------------------------------------ certificate lifecycle
+def test_renewal_grace_window_math(tmp_path):
+    ca = CertificateAuthority(tmp_path / "ca")
+    cc = CertificateClient(tmp_path / "dn", "datanode-dn")
+    cc.enroll(ca)  # default 398d: nowhere near the grace window
+    assert not cc.needs_renewal(threshold=0.25)
+    assert 0.9 < cc.remaining_fraction() <= 1.0
+    # re-issue with an already-expired leaf -> inside the window
+    cc.install(ca.sign_csr(cc.make_csr(), valid_days=0), ca.root_pem)
+    assert cc.needs_renewal(threshold=0.25)
+
+
+def test_renew_mints_fresh_key_and_serial(tmp_path):
+    ca = CertificateAuthority(tmp_path / "ca")
+    cc = CertificateClient(tmp_path / "dn", "datanode-dn")
+    cc.enroll(ca)
+    old_serial = cc.cert.serial_number
+    old_key = cc.key_path.read_bytes()
+    cc.renew(ca)
+    assert cc.cert.serial_number != old_serial
+    assert cc.key_path.read_bytes() != old_key
+    # the renewed identity still handshakes against the same root
+    srv = RpcServer(port=0, tls=cc.tls())
+    srv.add_service("Test", _echo_service())
+    srv.start()
+    try:
+        cli = CertificateClient(tmp_path / "cli", "client-cli")
+        cli.enroll(ca)
+        ch = RpcChannel(srv.address, tls=cli.tls(),
+                        server_name="localhost")
+        assert ch.call("Test", "Echo", b"hi") == b"echo:hi"
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_live_renewal_no_dropped_rpcs(tmp_path):
+    """The rotation drill: RPCs flow continuously while the server's
+    cert is renewed; the dynamic server credentials serve the new cert
+    on the next handshake with zero downtime and zero dropped calls."""
+    from ozone_tpu.utils.ca import CertRenewalService
+
+    ca = CertificateAuthority(tmp_path / "ca")
+    server_cc = CertificateClient(tmp_path / "srv", "datanode-srv")
+    client_cc = CertificateClient(tmp_path / "cli", "client-cli")
+    server_cc.enroll(ca)
+    client_cc.enroll(ca)
+    rot = server_cc.rotating_tls()
+    srv = RpcServer(port=0, tls=rot, mutual=True)
+    srv.add_service("Test", _echo_service())
+    srv.start()
+    renewal = CertRenewalService(rot, lambda: server_cc.renew(ca),
+                                 threshold=0.25)
+    try:
+        ch = RpcChannel(srv.address, tls=client_cc.tls(),
+                        server_name="localhost")
+        assert ch.call("Test", "Echo", b"a") == b"echo:a"
+        # not in the window yet -> no-op
+        assert renewal.check_once() is False
+        # force into the window (expired leaf), then drive the check
+        server_cc.install(ca.sign_csr(server_cc.make_csr(),
+                                      valid_days=0), ca.root_pem)
+        rot.reload()
+        assert renewal.check_once() is True
+        assert renewal.renewals == 1
+        # the EXISTING connection keeps working (no forced reset)...
+        assert ch.call("Test", "Echo", b"b") == b"echo:b"
+        ch.close()
+        # ...and a brand-new handshake gets the renewed cert
+        ch2 = RpcChannel(srv.address, tls=client_cc.tls(),
+                         server_name="localhost")
+        assert ch2.call("Test", "Echo", b"c") == b"echo:c"
+        ch2.close()
+        assert server_cc.remaining_fraction() > 0.9
+    finally:
+        srv.stop()
+
+
+def test_root_ca_rotation_trust_bundle(tmp_path):
+    """Root rotation: the trust bundle carries old+new roots during the
+    transition, so pre-rotation leaves and post-rotation leaves verify
+    against each other; retiring the old root ends the transition."""
+    ca = CertificateAuthority(tmp_path / "ca")
+    old_root = x509.load_pem_x509_certificate(ca.root_pem)
+    server_cc = CertificateClient(tmp_path / "srv", "datanode-srv")
+    server_cc.enroll(ca)  # leaf from the OLD root
+
+    ca.rotate_root()
+    new_root = x509.load_pem_x509_certificate(ca.root_pem)
+    assert new_root.serial_number != old_root.serial_number
+    assert b"BEGIN CERTIFICATE" in ca.root_pem
+    assert ca.root_pem.count(b"BEGIN CERTIFICATE") == 2  # bundle of 2
+
+    # phase 1: the pre-rotation party adopts the new trust bundle
+    # (without this, mutual TLS rejects new-root peers mid-transition)
+    assert server_cc.refresh_trust(ca) is True
+    assert server_cc.refresh_trust(ca) is False  # idempotent
+
+    # a client enrolled AFTER rotation can reach a server still serving
+    # its pre-rotation cert (old root in the bundle)
+    client_cc = CertificateClient(tmp_path / "cli", "client-cli")
+    client_cc.enroll(ca)
+    rot = server_cc.rotating_tls()
+    srv = RpcServer(port=0, tls=rot, mutual=True)
+    srv.add_service("Test", _echo_service())
+    srv.start()
+    try:
+        ch = RpcChannel(srv.address, tls=client_cc.tls(),
+                        server_name="localhost")
+        assert ch.call("Test", "Echo", b"x") == b"echo:x"
+        ch.close()
+        # server renews onto the new root mid-flight; new handshakes OK
+        server_cc.renew(ca)
+        rot.reload()
+        ch2 = RpcChannel(srv.address, tls=client_cc.tls(),
+                         server_name="localhost")
+        assert ch2.call("Test", "Echo", b"y") == b"echo:y"
+        ch2.close()
+        issuer = server_cc.cert.issuer
+        assert issuer == new_root.subject
+    finally:
+        srv.stop()
+    ca.retire_previous_root()
+    assert ca.root_pem.count(b"BEGIN CERTIFICATE") == 1
+
+
+def test_failover_pool_drops_channels_on_cert_rotation(tmp_path):
+    """FailoverChannels watches RotatingTls.version and reconnects with
+    the renewed client identity instead of presenting a retired cert."""
+    from ozone_tpu.net.rpc import FailoverChannels
+
+    ca = CertificateAuthority(tmp_path / "ca")
+    server_cc = CertificateClient(tmp_path / "srv", "datanode-srv")
+    client_cc = CertificateClient(tmp_path / "cli", "client-cli")
+    server_cc.enroll(ca)
+    client_cc.enroll(ca)
+    srv = RpcServer(port=0, tls=server_cc.tls())
+    srv.add_service("Test", _echo_service())
+    srv.start()
+    try:
+        rot = client_cc.rotating_tls()
+        pool = FailoverChannels(srv.address, tls=rot)
+        _, ch1 = pool.channel()
+        _, same = pool.channel()
+        assert ch1 is same  # cached
+        client_cc.renew(ca)
+        rot.reload()
+        _, ch2 = pool.channel()
+        assert ch2 is not ch1  # rebuilt under the new identity
+        pool.close()
+    finally:
+        srv.stop()
+
+
+def test_failed_renewal_leaves_matched_key_and_cert(tmp_path):
+    """A renewal whose RPC fails must not touch the on-disk identity:
+    the fresh key lives only in memory until the CA answers, so a
+    retry loop never leaves a cert whose public key the stored private
+    key can't back."""
+    ca = CertificateAuthority(tmp_path / "ca")
+    cc = CertificateClient(tmp_path / "srv", "datanode-srv")
+    cc.enroll(ca)
+    key_before = cc.key_path.read_bytes()
+    cert_before = cc.cert_path.read_bytes()
+    with pytest.raises(Exception):
+        cc.renew_remote("127.0.0.1:1")  # nothing listens there
+    assert cc.key_path.read_bytes() == key_before
+    assert cc.cert_path.read_bytes() == cert_before
+    # the untouched identity still works end-to-end
+    srv = RpcServer(port=0, tls=cc.tls())
+    srv.add_service("Test", _echo_service())
+    srv.start()
+    try:
+        cli = CertificateClient(tmp_path / "cli", "client-cli")
+        cli.enroll(ca)
+        ch = RpcChannel(srv.address, tls=cli.tls(),
+                        server_name="localhost")
+        assert ch.call("Test", "Echo", b"ok") == b"echo:ok"
+        ch.close()
+    finally:
+        srv.stop()
+
+
+def test_enrollment_response_mac_required(tmp_path):
+    """A client that holds the bootstrap secret REFUSES enrollment /
+    trust responses that don't authenticate — otherwise a MITM on the
+    plaintext CSR channel could substitute a rogue CA bundle."""
+    from ozone_tpu.utils.ca import EnrollmentService
+
+    ca = CertificateAuthority(tmp_path / "ca")
+    srv = RpcServer(port=0)
+    EnrollmentService(ca, srv, secret=None)  # server never MACs
+    srv.start()
+    try:
+        cc = CertificateClient(tmp_path / "dn", "datanode-dn")
+        with pytest.raises(PermissionError):
+            cc.enroll_remote(srv.address, secret="client-has-secret")
+        assert not cc.enrolled
+        with pytest.raises(PermissionError):
+            cc.refresh_trust_remote(srv.address,
+                                    secret="client-has-secret")
+    finally:
+        srv.stop()
+
+
+def test_enrollment_response_mac_roundtrip(tmp_path):
+    """With the secret on both sides, enroll + renew + trust refresh
+    all verify their response MACs and succeed."""
+    from ozone_tpu.utils.ca import EnrollmentService
+
+    ca = CertificateAuthority(tmp_path / "ca")
+    srv = RpcServer(port=0)
+    EnrollmentService(ca, srv, secret="s3cr3t")
+    srv.start()
+    try:
+        cc = CertificateClient(tmp_path / "dn", "datanode-dn")
+        cc.enroll_remote(srv.address, secret="s3cr3t")
+        assert cc.enrolled
+        old_serial = cc.cert.serial_number
+        cc.renew_remote(srv.address, secret="s3cr3t")
+        assert cc.cert.serial_number != old_serial
+        assert cc.refresh_trust_remote(srv.address,
+                                       secret="s3cr3t") is False
+        ca.rotate_root()
+        assert cc.refresh_trust_remote(srv.address,
+                                       secret="s3cr3t") is True
+    finally:
+        srv.stop()
+
+
+def test_double_root_rotation_refused(tmp_path):
+    """A second rotation while the previous root is still in the trust
+    bundle would strand every generation-0 leaf; the CA refuses until
+    the operator retires the old anchor."""
+    ca = CertificateAuthority(tmp_path / "ca")
+    ca.rotate_root()
+    with pytest.raises(RuntimeError):
+        ca.rotate_root()
+    ca.retire_previous_root()
+    ca.rotate_root()  # transition finished: next rotation allowed
+    assert ca.generation == 2
