@@ -138,6 +138,24 @@ class OMMetadataStore:
     def exists(self, table: str, key: str) -> bool:
         return self.get(table, key) is not None
 
+    def count(self, table: str) -> int:
+        """Row count without materializing rows: SQL COUNT(*) adjusted
+        by the (bounded, <= flush_every) write-back cache — insights
+        endpoints must not deserialize millions of rows to report a
+        number."""
+        with self._lock:
+            n = self._conn.execute(
+                f"SELECT COUNT(*) FROM {table}").fetchone()[0]
+            for k, v in self._cache[table].items():
+                in_db = self._conn.execute(
+                    f"SELECT 1 FROM {table} WHERE k=?", (k,)
+                ).fetchone() is not None
+                if v is None and in_db:
+                    n -= 1
+                elif v is not None and not in_db:
+                    n += 1
+            return n
+
     def iterate(
         self, table: str, prefix: str = ""
     ) -> Iterator[tuple[str, dict]]:

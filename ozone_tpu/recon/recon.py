@@ -81,6 +81,261 @@ class ReconTasks:
         return dict(sorted(buckets.items(),
                            key=lambda kv: int(kv[0].split("^")[1])))
 
+class TableInsights:
+    """OM DB insights (the reference Recon's OM DB Insights page +
+    table-insight task endpoints: row counts per table, open-key and
+    pending-deletion listings with ages, so an operator can spot leaked
+    open keys or a stuck purge chain without touching the OM)."""
+
+    def __init__(self, om: OzoneManager):
+        self.om = om
+
+    def table_counts(self) -> dict:
+        from ozone_tpu.om.metadata import _TABLES
+
+        return {t: self.om.store.count(t) for t in _TABLES}
+
+    def open_keys(self, limit: int = 100) -> list[dict]:
+        # collect ALL before sorting: the oldest (most interesting)
+        # entry may sort last lexicographically, and a pre-sort limit
+        # would hide exactly the stuck session the operator is hunting
+        now = time.time()
+        rows = []
+        for k, info in self.om.store.iterate("open_keys"):
+            rows.append({
+                "key": k,
+                "size": info.get("size", 0),
+                "replication": info.get("replication"),
+                "hsync": bool(info.get("hsync_client_id")),
+                "age_s": round(now - info.get("created", now), 1),
+            })
+        rows.sort(key=lambda r: -r["age_s"])
+        return rows[:limit]
+
+    def deleted_keys(self, limit: int = 100) -> list[dict]:
+        now = time.time()
+        rows = []
+        for k, info in self.om.store.iterate("deleted_keys"):
+            # store key is <key>:<ts> (DeleteKey.apply)
+            ts = None
+            if ":" in k:
+                try:
+                    ts = float(k.rpartition(":")[2])
+                except ValueError:
+                    ts = None
+            rows.append({
+                "key": k,
+                "size": info.get("size", 0),
+                "blocks": len(info.get("block_groups", [])),
+                "pending_s": (round(now - ts, 1)
+                              if ts is not None else None),
+            })
+        rows.sort(key=lambda r: -(r["pending_s"] or 0))
+        return rows[:limit]
+
+
+class NSSummaryIndex:
+    """Delta-fed per-directory namespace summaries (the reference's
+    NSSummaryTask family: NSSummaryTaskWithFSO aggregates file count /
+    bytes per directory object id from OM update batches; OBS/LEGACY
+    buckets aggregate at bucket level). Serves du-style queries: direct
+    totals per directory plus recursive totals down the subtree —
+    without walking the namespace per request."""
+
+    def __init__(self, om: OzoneManager):
+        self.om = om
+        self._txid = 0
+        self.full_rebuilds = 0
+        self._lock = threading.RLock()
+        # FSO: (vol, bkt, object_id) -> {"files": n, "bytes": n}
+        self._dir_agg: dict[tuple, dict] = {}
+        # FSO structure: (vol,bkt) -> {object_id: {"name","parent_id"}}
+        self._dirs: dict[tuple, dict[str, dict]] = {}
+        self._children: dict[tuple, set] = {}  # (v,b,parent) -> ids
+        # retirement maps: store key -> prior contribution
+        self._file_at: dict[str, tuple] = {}  # -> (v,b,parent,size)
+        self._dir_at: dict[str, tuple] = {}   # -> (v,b,object_id,parent)
+        # OBS: (vol,bkt) -> {"files": n, "bytes": n}; key -> (v,b,size)
+        self._obs_agg: dict[tuple, dict] = {}
+        self._key_at: dict[str, tuple] = {}
+        self._rebuild()
+
+    # ------------------------------------------------------------ feed
+    def _rebuild(self) -> None:
+        with self._lock:
+            for d in (self._dir_agg, self._dirs, self._children,
+                      self._file_at, self._dir_at, self._obs_agg,
+                      self._key_at):
+                d.clear()
+            self._txid = self.om.store.txid
+            self.full_rebuilds += 1
+            for table in ("dirs", "files", "keys"):
+                for k, info in self.om.store.iterate(table):
+                    self._apply(table, k, info)
+
+    def refresh(self) -> None:
+        with self._lock:
+            updates, txid, complete = self.om.store.get_updates_since(
+                self._txid)
+            if not complete:
+                self._rebuild()
+                return
+            for _, table, key, value in updates:
+                if table in ("dirs", "files", "keys"):
+                    self._apply(table, key, value)
+            self._txid = txid
+
+    @staticmethod
+    def _vb(store_key: str):
+        parts = store_key.split("/")
+        return (parts[1], parts[2]) if len(parts) >= 3 else None
+
+    def _apply(self, table: str, key: str, info) -> None:
+        if key.startswith("/.snap"):
+            return  # derived snapshot rows (journal=False)
+        if table == "keys":
+            if key.endswith("/"):
+                return  # LEGACY directory markers are not files
+            prior = self._key_at.pop(key, None)
+            if prior is not None:
+                v, b, sz = prior
+                agg = self._obs_agg.get((v, b))
+                if agg is not None:
+                    agg["files"] -= 1
+                    agg["bytes"] -= sz
+            if info is None:
+                return
+            vb = self._vb(key)
+            if vb is None:
+                return
+            sz = int(info.get("size", 0))
+            agg = self._obs_agg.setdefault(vb, {"files": 0, "bytes": 0})
+            agg["files"] += 1
+            agg["bytes"] += sz
+            self._key_at[key] = (*vb, sz)
+            return
+        if table == "files":
+            prior = self._file_at.pop(key, None)
+            if prior is not None:
+                v, b, parent, sz = prior
+                agg = self._dir_agg.get((v, b, parent))
+                if agg is not None:
+                    agg["files"] -= 1
+                    agg["bytes"] -= sz
+            if info is None:
+                return
+            vb = self._vb(key)
+            if vb is None:
+                return
+            parent = str(info.get("parent_id", key.split("/")[3]))
+            sz = int(info.get("size", 0))
+            agg = self._dir_agg.setdefault(
+                (*vb, parent), {"files": 0, "bytes": 0})
+            agg["files"] += 1
+            agg["bytes"] += sz
+            self._file_at[key] = (*vb, parent, sz)
+            return
+        # dirs table: structural rows
+        prior = self._dir_at.pop(key, None)
+        if prior is not None:
+            v, b, oid, parent = prior
+            self._dirs.get((v, b), {}).pop(oid, None)
+            self._children.get((v, b, parent), set()).discard(oid)
+        if info is None:
+            return
+        vb = self._vb(key)
+        if vb is None:
+            return
+        oid = str(info["object_id"])
+        parent = str(info.get("parent_id", key.split("/")[3]))
+        self._dirs.setdefault(vb, {})[oid] = {
+            "name": info.get("name", ""), "parent_id": parent}
+        self._children.setdefault((*vb, parent), set()).add(oid)
+        self._dir_at[key] = (*vb, oid, parent)
+
+    # ----------------------------------------------------------- query
+    def _recursive(self, v: str, b: str, oid: str) -> dict:
+        direct = self._dir_agg.get((v, b, oid), {"files": 0, "bytes": 0})
+        total_f, total_b = direct["files"], direct["bytes"]
+        for child in self._children.get((v, b, oid), ()):  # DFS
+            sub = self._recursive(v, b, child)
+            total_f += sub["total_files"]
+            total_b += sub["total_bytes"]
+        return {"files": direct["files"], "bytes": direct["bytes"],
+                "total_files": total_f, "total_bytes": total_b}
+
+    def du(self, path: str) -> dict:
+        """du-style breakdown for /vol/bucket[/dir...]: direct and
+        recursive totals plus immediate children (the reference's
+        /api/v1/namespace/du)."""
+        from ozone_tpu.om import fso
+        from ozone_tpu.om.requests import OMError
+
+        self.refresh()
+        parts = [p for p in path.split("/") if p]
+        with self._lock:
+            if len(parts) < 2:
+                # volume or root: bucket-level rollup
+                out = {"path": path or "/", "children": []}
+                tf = tb = 0
+                for (v, b), agg in sorted(self._obs_agg.items()):
+                    if parts and v != parts[0]:
+                        continue
+                    out["children"].append({
+                        "path": f"/{v}/{b}",
+                        "total_files": agg["files"],
+                        "total_bytes": agg["bytes"]})
+                    tf += agg["files"]
+                    tb += agg["bytes"]
+                fso_buckets = set(self._dirs) | {
+                    (v, b) for (v, b, _) in self._dir_agg}
+                for v, b in sorted(fso_buckets):
+                    if parts and v != parts[0]:
+                        continue
+                    s = self._recursive(v, b, fso.ROOT_ID)
+                    out["children"].append({
+                        "path": f"/{v}/{b}",
+                        "total_files": s["total_files"],
+                        "total_bytes": s["total_bytes"]})
+                    tf += s["total_files"]
+                    tb += s["total_bytes"]
+                out["total_files"], out["total_bytes"] = tf, tb
+                return out
+            v, b, rest = parts[0], parts[1], "/".join(parts[2:])
+            from ozone_tpu.om.metadata import bucket_key
+
+            if not self.om.store.exists("buckets", bucket_key(v, b)):
+                raise KeyError(path)  # typo must not read as "empty"
+            if (v, b) in self._obs_agg and not rest:
+                agg = self._obs_agg[(v, b)]
+                return {"path": f"/{v}/{b}", "children": [],
+                        "files": agg["files"], "bytes": agg["bytes"],
+                        "total_files": agg["files"],
+                        "total_bytes": agg["bytes"]}
+            # FSO: resolve the path to a directory object id
+            oid = fso.ROOT_ID
+            if rest:
+                try:
+                    parent, missing = fso.resolve(self.om.store, v, b,
+                                                  rest)
+                except OMError:
+                    missing = [rest]
+                    parent = None
+                if missing or parent is None:
+                    raise KeyError(path)
+                oid = parent
+            out = {"path": f"/{v}/{b}" + (f"/{rest}" if rest else ""),
+                   **self._recursive(v, b, oid), "children": []}
+            for child in sorted(self._children.get((v, b, oid), ())):
+                d = self._dirs.get((v, b), {}).get(child, {})
+                s = self._recursive(v, b, child)
+                out["children"].append({
+                    "path": out["path"] + "/" + d.get("name", child),
+                    "total_files": s["total_files"],
+                    "total_bytes": s["total_bytes"]})
+            return out
+
+
 class ContainerKeyIndex:
     """Incrementally-maintained container -> keys index fed by OM WAL
     deltas (the reference's OMDBUpdatesHandler + ContainerKeyMapperTask:
@@ -276,6 +531,77 @@ class ReconScmView:
             "missing": missing,
         }
 
+    def _rack_of(self) -> dict:
+        return {n.dn_id: n.rack for n in self.scm.nodes.nodes()}
+
+    def unhealthy_containers(self,
+                             state: Optional[str] = None) -> list[dict]:
+        """Per-container detail for every unhealthy container
+        (reference: /api/v1/containers/unhealthy/{state} from the
+        ContainerHealthTask's UnhealthyContainers table): replica
+        placement, missing/excess indexes, and rack-spread
+        mis-replication. `state` filters to MISSING / UNDER_REPLICATED /
+        OVER_REPLICATED / MIS_REPLICATED."""
+        from ozone_tpu.scm.placement import RackScatterPlacement
+
+        racks = self._rack_of()
+        total_racks = len(set(racks.values())) or 1
+        out = []
+        for c in self.scm.containers.containers():
+            if c.state in (ContainerState.DELETED, ContainerState.OPEN):
+                continue
+            replicas = [
+                {"dn": dn,
+                 "index": getattr(r, "replica_index", None),
+                 "rack": racks.get(dn)}
+                for dn, r in sorted(c.replicas.items())
+            ]
+            states = []
+            detail: dict = {}
+            if c.replication.type is ReplicationType.EC:
+                count = ECReplicaCount(c, self.scm.nodes)
+                expected = c.replication.ec.all_units
+                if count.missing_indexes and not count.recoverable:
+                    states.append("MISSING")
+                elif count.missing_indexes:
+                    states.append("UNDER_REPLICATED")
+                if count.excess_indexes:
+                    states.append("OVER_REPLICATED")
+                detail = {
+                    "missing_indexes": sorted(count.missing_indexes),
+                    "excess_indexes": sorted(count.excess_indexes),
+                }
+            else:
+                expected = c.replication.factor
+                live = len(c.replicas)
+                if live == 0:
+                    states.append("MISSING")
+                elif live < expected:
+                    states.append("UNDER_REPLICATED")
+                elif live > expected:
+                    states.append("OVER_REPLICATED")
+            racks_used = len({r["rack"] for r in replicas
+                              if r["rack"] is not None})
+            if replicas and not RackScatterPlacement.validate(
+                    racks_used, total_racks, expected):
+                states.append("MIS_REPLICATED")
+            if not states:
+                continue
+            if state is not None and state.upper() not in states:
+                continue
+            out.append({
+                "container": c.id,
+                "states": states,
+                "replication": str(c.replication),
+                "expected": expected,
+                "actual": len(replicas),
+                "racks_used": racks_used,
+                "racks_expected": min(expected, total_racks),
+                "replicas": replicas,
+                **detail,
+            })
+        return out
+
     def pipeline_table(self) -> list[dict]:
         return [
             {
@@ -314,6 +640,8 @@ class ReconServer:
         self.tasks = ReconTasks(om)
         self.scm_view = ReconScmView(scm)
         self.key_index = ContainerKeyIndex(om)
+        self.nssummary = NSSummaryIndex(om)
+        self.insights = TableInsights(om)
         self.warehouse = (
             ReconWarehouse(db_path) if db_path is not None else None
         )
@@ -336,7 +664,27 @@ class ReconServer:
 
         class Handler(orig_handler):
             def do_GET(self):
-                path = self.path.split("?")[0]
+                from urllib.parse import parse_qs, urlparse
+
+                u = urlparse(self.path)
+                path, q = u.path, parse_qs(u.query)
+                if path == "/api/nssummary":
+                    try:
+                        out = recon.nssummary.du(
+                            q.get("path", ["/"])[0])
+                    except KeyError as e:
+                        self._send(404, json.dumps(
+                            {"error": f"no such path {e}"}))
+                        return
+                    self._send(200, json.dumps(out, indent=2,
+                                               default=str))
+                    return
+                if path == "/api/containers/unhealthy":
+                    out = recon.scm_view.unhealthy_containers(
+                        q.get("state", [None])[0])
+                    self._send(200, json.dumps(out, indent=2,
+                                               default=str))
+                    return
                 if path in ("/", "/ui"):
                     from ozone_tpu.recon.ui import RECON_INDEX_HTML
 
@@ -364,6 +712,12 @@ class ReconServer:
                     "/api/nodes": recon.scm_view.node_table,
                     "/api/pipelines": recon.scm_view.pipeline_table,
                     "/api/summary": recon.api_summary,
+                    "/api/insights/tables": lambda: recon._scan(
+                        "table_counts", recon.insights.table_counts),
+                    "/api/insights/open_keys":
+                        recon.insights.open_keys,
+                    "/api/insights/deleted_keys":
+                        recon.insights.deleted_keys,
                 }
                 fn = routes.get(path)
                 if fn is not None:
@@ -412,6 +766,7 @@ class ReconServer:
         timestamp so operators get history, not just now. Runs the scans
         fresh and primes the serving cache with the results."""
         self.key_index.refresh()
+        self.nssummary.refresh()
         ns = self.tasks.namespace_summary()
         sizes = self.tasks.file_size_histogram()
         with self._scan_lock:
@@ -427,6 +782,7 @@ class ReconServer:
             "container_health", {k: len(v) for k, v in health.items()}
         )
         self.warehouse.record("nodes", {"nodes": self.scm_view.node_table()})
+        self.warehouse.record("table_counts", self.insights.table_counts())
 
     @property
     def address(self) -> str:

@@ -189,3 +189,137 @@ def test_get_updates_since_contract(cluster):
     assert complete2
     assert txid2 > txid
     assert all(u[0] > txid for u in updates2)
+
+
+# --------------------------------------------------- round-2 task breadth
+def test_nssummary_fso_du(cluster):
+    """Delta-fed per-directory namespace summaries over an FSO bucket
+    (NSSummaryTaskWithFSO analog): direct vs recursive totals, du
+    children, and incremental updates without a rebuild."""
+    from ozone_tpu.recon.recon import NSSummaryIndex
+
+    oz = cluster.client()
+    try:
+        vol = oz.create_volume("rv")
+    except Exception:
+        vol = oz.get_volume("rv")
+    cluster.om.create_bucket("rv", "nsfso", EC, "FILE_SYSTEM_OPTIMIZED")
+    b = vol.get_bucket("nsfso")
+    b.write_key("a/one.dat", np.zeros(1000, np.uint8))
+    b.write_key("a/b/two.dat", np.zeros(2000, np.uint8))
+    b.write_key("top.dat", np.zeros(400, np.uint8))
+    ns = NSSummaryIndex(cluster.om)
+    root = ns.du("/rv/nsfso")
+    assert root["files"] == 1 and root["bytes"] == 400  # direct
+    assert root["total_files"] == 3
+    assert root["total_bytes"] == 3400
+    a = ns.du("/rv/nsfso/a")
+    assert a["files"] == 1 and a["total_files"] == 2
+    assert a["total_bytes"] == 3000
+    assert [c["path"] for c in a["children"]] == ["/rv/nsfso/a/b"]
+    # incremental: new file + delete ride the WAL delta, no rebuild
+    rebuilds = ns.full_rebuilds
+    b.write_key("a/b/three.dat", np.zeros(500, np.uint8))
+    assert ns.du("/rv/nsfso/a/b")["total_bytes"] == 2500
+    b.delete_key("a/b/three.dat")
+    assert ns.du("/rv/nsfso/a/b")["total_bytes"] == 2000
+    assert ns.full_rebuilds == rebuilds
+    with pytest.raises(KeyError):
+        ns.du("/rv/nsfso/nope")
+
+
+def test_nssummary_obs_and_volume_rollup(cluster):
+    from ozone_tpu.recon.recon import NSSummaryIndex
+
+    _write_keys(cluster, "nsobs", ["p/x", "p/y"])
+    ns = NSSummaryIndex(cluster.om)
+    b = ns.du("/rv/nsobs")
+    assert b["total_files"] == 2 and b["total_bytes"] == 10000
+    vol = ns.du("/rv")
+    assert any(c["path"] == "/rv/nsobs" for c in vol["children"])
+    assert vol["total_files"] >= 2
+
+
+def test_table_insights(cluster):
+    from ozone_tpu.recon.recon import TableInsights
+
+    _write_keys(cluster, "ins", ["k1", "k2"])
+    ti = TableInsights(cluster.om)
+    counts = ti.table_counts()
+    assert counts["keys"] >= 2
+    assert counts["volumes"] >= 1 and counts["buckets"] >= 1
+    # an open (uncommitted) key shows up with its age
+    sess = cluster.om.open_key("rv", "ins", "leaked", replication=EC)
+    rows = ti.open_keys()
+    assert any("leaked" in r["key"] for r in rows)
+    assert all(r["age_s"] >= 0 for r in rows)
+    del sess
+    # deleted keys await the purge chain with pending ages
+    oz = cluster.client()
+    oz.get_volume("rv").get_bucket("ins").delete_key("k1")
+    assert any("k1" in r["key"] for r in ti.deleted_keys())
+
+
+def test_unhealthy_containers_endpoint(cluster, tmp_path):
+    """Unhealthy-container detail (reference /containers/unhealthy):
+    killing replicas surfaces UNDER_REPLICATED with per-replica rack
+    placement; single-rack clusters report MIS_REPLICATED."""
+    import urllib.error
+
+    _write_keys(cluster, "uh", ["k"])
+    recon = ReconServer(cluster.om, cluster.scm,
+                        db_path=tmp_path / "r.db")
+    recon.start()
+    try:
+        rows = json.loads(urllib.request.urlopen(
+            f"http://{recon.address}/api/containers/unhealthy").read())
+        # the minicluster puts every DN in one rack: rack-scatter says
+        # mis-replicated (capacity placement is rack-blind)
+        if rows:
+            assert all("states" in r and "replicas" in r for r in rows)
+        # filter: a state nothing is in returns empty
+        none = json.loads(urllib.request.urlopen(
+            f"http://{recon.address}/api/containers/unhealthy"
+            "?state=MISSING").read())
+        assert none == [] or all("MISSING" in r["states"] for r in none)
+        # insights endpoints serve over HTTP too
+        counts = json.loads(urllib.request.urlopen(
+            f"http://{recon.address}/api/insights/tables").read())
+        assert counts["keys"] >= 1
+        du = json.loads(urllib.request.urlopen(
+            f"http://{recon.address}/api/nssummary?path=/rv/uh").read())
+        assert du["total_files"] >= 1
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://{recon.address}/api/nssummary?path=/rv/zzz/q")
+        assert ei.value.code == 404
+    finally:
+        recon.stop()
+
+
+def test_unhealthy_detail_under_replication(cluster):
+    """Dropping a replica from the SCM's view surfaces the container
+    with per-replica detail and the right state tags."""
+    from ozone_tpu.recon.recon import ReconScmView
+    from ozone_tpu.storage.ids import ContainerState
+
+    _write_keys(cluster, "uh2", ["kk"])
+    cluster.heartbeat_all()  # replicas enter the SCM via reports
+    view = ReconScmView(cluster.scm)
+    c = next(c for c in cluster.scm.containers.containers()
+             if c.replicas)
+    dn, saved = next(iter(c.replicas.items()))
+    prev_state = c.state
+    c.state = ContainerState.CLOSED
+    del c.replicas[dn]
+    try:
+        rows = view.unhealthy_containers("UNDER_REPLICATED")
+        row = next(r for r in rows if r["container"] == c.id)
+        assert "UNDER_REPLICATED" in row["states"]
+        assert row["actual"] == row["expected"] - 1
+        assert all(rep["dn"] != dn for rep in row["replicas"])
+        if row["replication"].startswith("rs"):
+            assert len(row["missing_indexes"]) == 1
+    finally:
+        c.replicas[dn] = saved
+        c.state = prev_state
