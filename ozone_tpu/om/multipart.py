@@ -65,6 +65,8 @@ class InitiateMultipartUpload(rq.OMRequest):
     bytes_per_checksum: int = 16 * 1024
     created: float = 0.0
     metadata: dict = field(default_factory=dict)
+    #: LEGACY bucket: key pre-normalized; enforce filesystem shape
+    fs_paths: bool = False
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -80,6 +82,9 @@ class InitiateMultipartUpload(rq.OMRequest):
             raise rq.OMError(
                 rq.BUCKET_NOT_FOUND, f"{self.volume}/{self.bucket}"
             )
+        if self.fs_paths:
+            rq.check_fs_conflicts(store, self.volume, self.bucket,
+                                  self.key)
         store.put(
             "multipart",
             mpu_key(self.volume, self.bucket, self.key, self.upload_id),
@@ -153,6 +158,8 @@ class CompleteMultipartUpload(rq.OMRequest):
     upload_id: str
     parts: list[dict] = field(default_factory=list)  # {part_number, etag}
     ts: float = 0.0
+    #: LEGACY bucket: enforce filesystem shape on the final key
+    fs_paths: bool = False
 
     def pre_execute(self, om) -> None:
         self.ts = time.time()
@@ -162,6 +169,12 @@ class CompleteMultipartUpload(rq.OMRequest):
         mpu = store.get("multipart", mk)
         if mpu is None:
             raise rq.OMError(NO_SUCH_UPLOAD, mk)
+        if self.fs_paths:
+            # re-checked at complete time (the namespace may have
+            # changed since initiate); quota for the markers joins the
+            # key's single upfront charge below
+            rq.check_fs_conflicts(store, self.volume, self.bucket,
+                                  self.key)
         listed: list[dict] = []
         prev = 0
         for p in self.parts:
@@ -179,14 +192,20 @@ class CompleteMultipartUpload(rq.OMRequest):
             raise rq.OMError(INVALID_PART, "no parts listed")
         kk = key_key(self.volume, self.bucket, self.key)
         old = store.get("keys", kk)
+        markers = (rq.missing_parent_markers(store, self.volume,
+                                             self.bucket, self.key)
+                   if self.fs_paths else [])
         # quota precedes EVERY mutation: a QUOTA_EXCEEDED complete must
         # leave the upload fully intact for a retry after space is freed
         rq.check_and_charge_quota(
             store, self.volume, self.bucket,
             sum(p["size"] for p in listed)
             - (int(old.get("size", 0)) if old else 0),
-            0 if old is not None else 1,
+            (0 if old is not None else 1) + len(markers),
         )
+        if markers:
+            rq.put_parent_markers(store, self.volume, self.bucket,
+                                  markers, mpu["replication"], self.ts)
         # orphaned parts: uploaded but omitted from the complete request
         listed_nos = {str(int(p["part_number"])) for p in self.parts}
         for no, part in mpu["parts"].items():

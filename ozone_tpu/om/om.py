@@ -353,6 +353,10 @@ class OzoneManager:
     def _is_fso(self, binfo: dict) -> bool:
         return binfo.get("layout") == "FILE_SYSTEM_OPTIMIZED"
 
+    @staticmethod
+    def _is_legacy(binfo: dict) -> bool:
+        return binfo.get("layout") == "LEGACY"
+
     def open_key(
         self,
         volume: str,
@@ -375,8 +379,11 @@ class OzoneManager:
             name = fso.split_path(key)[-1]
             open_k = f"{fso.dir_key(volume, bucket, parent, name)}/{client_id}"
         else:
+            legacy = self._is_legacy(binfo)
+            if legacy:
+                key = rq.normalize_fs_path(key)
             req = rq.OpenKey(volume, bucket, key, client_id, repl,
-                             metadata=metadata or {})
+                             metadata=metadata or {}, fs_paths=legacy)
             self.submit(req)
             open_k = f"{key_key(volume, bucket, key)}/{client_id}"
         info = self.store.get("open_keys", open_k)
@@ -551,9 +558,12 @@ class OzoneManager:
         volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, key, "READ")
 
-        if self._is_fso(self.bucket_info(volume, bucket)):
+        binfo = self.bucket_info(volume, bucket)
+        if self._is_fso(binfo):
             info = fso.lookup_file(self.store, volume, bucket, key)
         else:
+            if self._is_legacy(binfo):
+                key = rq.normalize_fs_path(key)
             info = self.store.get("keys", key_key(volume, bucket, key))
         if info is None:
             raise rq.OMError(rq.KEY_NOT_FOUND, f"{volume}/{bucket}/{key}")
@@ -601,9 +611,12 @@ class OzoneManager:
 
         volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, key, "DELETE")
-        if self._is_fso(self.bucket_info(volume, bucket)):
+        binfo = self.bucket_info(volume, bucket)
+        if self._is_fso(binfo):
             self.submit(fso.DeleteFile(volume, bucket, key))
         else:
+            if self._is_legacy(binfo):
+                key = rq.normalize_fs_path(key)
             self.submit(rq.DeleteKey(volume, bucket, key))
         self.metrics.counter("keys_deleted").inc()
 
@@ -612,10 +625,16 @@ class OzoneManager:
 
         volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, key, "WRITE")
-        if self._is_fso(self.bucket_info(volume, bucket)):
+        binfo = self.bucket_info(volume, bucket)
+        if self._is_fso(binfo):
             self.submit(fso.RenameEntry(volume, bucket, key, new_key))
         else:
-            self.submit(rq.RenameKey(volume, bucket, key, new_key))
+            legacy = self._is_legacy(binfo)
+            if legacy:
+                key = rq.normalize_fs_path(key)
+                new_key = rq.normalize_fs_path(new_key)
+            self.submit(rq.RenameKey(volume, bucket, key, new_key,
+                                     fs_paths=legacy))
 
     def set_key_attrs(self, volume: str, bucket: str, key: str,
                       attrs: dict) -> dict:
@@ -674,10 +693,13 @@ class OzoneManager:
         from ozone_tpu.om import multipart as mpu
 
         volume, bucket = self.resolve_bucket(volume, bucket)
+        legacy = self._is_legacy(self.bucket_info(volume, bucket))
+        if legacy:
+            key = rq.normalize_fs_path(key)
         return self.submit(
             mpu.InitiateMultipartUpload(
                 volume, bucket, key, replication=replication or "",
-                metadata=metadata or {},
+                metadata=metadata or {}, fs_paths=legacy,
             )
         )
 
@@ -687,6 +709,8 @@ class OzoneManager:
         from ozone_tpu.om import multipart as mpu
 
         volume, bucket = self.resolve_bucket(volume, bucket)
+        if self._is_legacy(self.bucket_info(volume, bucket)):
+            key = rq.normalize_fs_path(key)
         info = self.store.get(
             "multipart", mpu.mpu_key(volume, bucket, key, upload_id)
         )
@@ -735,8 +759,12 @@ class OzoneManager:
         from ozone_tpu.om import multipart as mpu
 
         volume, bucket = self.resolve_bucket(volume, bucket)
+        legacy = self._is_legacy(self.bucket_info(volume, bucket))
+        if legacy:
+            key = rq.normalize_fs_path(key)
         return self.submit(
-            mpu.CompleteMultipartUpload(volume, bucket, key, upload_id, parts)
+            mpu.CompleteMultipartUpload(volume, bucket, key, upload_id,
+                                        parts, fs_paths=legacy)
         )
 
     def abort_multipart_upload(
@@ -745,6 +773,8 @@ class OzoneManager:
         from ozone_tpu.om import multipart as mpu
 
         volume, bucket = self.resolve_bucket(volume, bucket)
+        if self._is_legacy(self.bucket_info(volume, bucket)):
+            key = rq.normalize_fs_path(key)
         self.submit(mpu.AbortMultipartUpload(volume, bucket, key, upload_id))
 
     def list_parts(

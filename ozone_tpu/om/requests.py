@@ -136,9 +136,18 @@ class CreateBucket(OMRequest):
     def pre_execute(self, om) -> None:
         self.created = time.time()
 
+    #: the reference's three bucket layouts
+    #: (BucketLayoutAwareOMKeyRequestFactory): OBS = flat object table,
+    #: FSO = directory tree tables, LEGACY = flat table with filesystem
+    #: path semantics (normalization, parent markers, conflict checks)
+    LAYOUTS = ("OBJECT_STORE", "FILE_SYSTEM_OPTIMIZED", "LEGACY")
+
     def apply(self, store):
         from ozone_tpu.om.acl import inherit_defaults
 
+        if self.layout not in self.LAYOUTS:
+            raise OMError(INVALID_REQUEST,
+                          f"unknown bucket layout {self.layout!r}")
         vrow = store.get("volumes", volume_key(self.volume))
         if vrow is None:
             raise OMError(VOLUME_NOT_FOUND, self.volume)
@@ -331,6 +340,21 @@ class CommitKey(OMRequest):
             b = store.get("buckets", bucket_key(self.volume, self.bucket))
             if b is not None:
                 info["acls"] = inherit_defaults(b.get("acls", []))
+        fs_paths = info.pop("fs_paths", False)
+        if fs_paths:
+            # LEGACY layout: materialize missing parent directory
+            # markers (quota-charged) BEFORE the key commit so a quota
+            # refusal leaves at worst empty directories, never a key
+            # whose parents are missing (OMKeyCommitRequest creates
+            # missing parents when filesystem paths are enabled)
+            markers = missing_parent_markers(store, self.volume,
+                                             self.bucket, self.key)
+            if markers:
+                check_and_charge_quota(store, self.volume, self.bucket,
+                                       0, len(markers))
+                put_parent_markers(store, self.volume, self.bucket,
+                                   markers, self.replication,
+                                   self.modified)
         old = store.get("keys", kk)
         finalize_commit(store, "keys", kk, info, old, self.client_id,
                         self.hsync, self.modified)
@@ -554,11 +578,89 @@ class RecoverLease(OMRequest):
                       f"{self.volume}/{self.bucket}/{self.key}")
 
 
+FILE_ALREADY_EXISTS = "FILE_ALREADY_EXISTS"
+NOT_A_DIRECTORY = "NOT_A_DIRECTORY"
+
+
+def check_fs_conflicts(store, volume: str, bucket: str,
+                       key: str) -> None:
+    """LEGACY filesystem-shape invariants on the flat key table (the
+    reference's checkDirectoryAlreadyExists / checkKeyExists pair): a
+    file and a directory marker may not share a name in either
+    direction, and no ancestor of a new entry may be a plain file."""
+    base = key.rstrip("/")
+    if not key.endswith("/") and store.exists(
+            "keys", key_key(volume, bucket, base + "/")):
+        raise OMError(FILE_ALREADY_EXISTS,
+                      f"{base} exists as a directory")
+    if key.endswith("/") and store.exists(
+            "keys", key_key(volume, bucket, base)):
+        raise OMError(FILE_ALREADY_EXISTS, f"{base} exists as a file")
+    parts = base.split("/")[:-1]
+    for i in range(1, len(parts) + 1):
+        anc = "/".join(parts[:i])
+        if store.exists("keys", key_key(volume, bucket, anc)):
+            raise OMError(NOT_A_DIRECTORY, f"ancestor {anc} is a file")
+
+
+def missing_parent_markers(store, volume: str, bucket: str,
+                           key: str) -> list[str]:
+    parts = key.rstrip("/").split("/")[:-1]
+    out = []
+    for i in range(1, len(parts) + 1):
+        marker = "/".join(parts[:i]) + "/"
+        if not store.exists("keys",
+                            key_key(volume, bucket, marker)):
+            out.append(marker)
+    return out
+
+
+def put_parent_markers(store, volume: str, bucket: str,
+                       markers: list[str], replication: str,
+                       ts: float) -> None:
+    """Materialize LEGACY parent directory markers. Callers charge the
+    namespace quota for them FIRST (one count per marker) so live
+    enforcement, delete accounting (DeleteKey charges -1 per marker),
+    and RepairQuota's recount all agree."""
+    for marker in markers:
+        store.put("keys", key_key(volume, bucket, marker), {
+            "volume": volume,
+            "bucket": bucket,
+            "name": marker,
+            "replication": replication,
+            "size": 0,
+            "block_groups": [],
+            "created": ts,
+            "modified": ts,
+        })
+
+
+def normalize_fs_path(key: str) -> str:
+    """LEGACY-bucket filesystem-path normalization (the reference's
+    `ozone.om.enable.filesystem.paths` posture, OmUtils.normalizeKey):
+    collapse duplicate separators, strip a leading '/', refuse '.'/'..'
+    segments. A trailing '/' (directory marker) survives."""
+    is_dir = key.endswith("/")
+    parts = [p for p in key.split("/") if p]
+    if not parts:
+        raise OMError(INVALID_REQUEST, f"empty key {key!r}")
+    for p in parts:
+        if p in (".", ".."):
+            raise OMError(INVALID_REQUEST,
+                          f"illegal path segment {p!r} in {key!r}")
+    return "/".join(parts) + ("/" if is_dir else "")
+
+
 @dataclass
 class OpenKey(OMRequest):
     """Record an open-key session (OMKeyCreateRequest analog — block
     allocation happens in pre_execute via SCM, like the reference's
-    preExecute asking SCM for blocks)."""
+    preExecute asking SCM for blocks). `fs_paths` marks a LEGACY-layout
+    bucket: the flat key table gains filesystem semantics — ancestor
+    file/directory conflicts are refused here, and the commit
+    materializes the missing parent directory markers (the reference's
+    BucketLayoutAwareOMKeyRequestFactory routes LEGACY through the same
+    flat-table requests with these extra checks)."""
 
     volume: str
     bucket: str
@@ -569,6 +671,7 @@ class OpenKey(OMRequest):
     bytes_per_checksum: int = 16 * 1024
     created: float = 0.0
     metadata: dict = field(default_factory=dict)
+    fs_paths: bool = False
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -576,6 +679,9 @@ class OpenKey(OMRequest):
     def apply(self, store):
         if not store.exists("buckets", bucket_key(self.volume, self.bucket)):
             raise OMError(BUCKET_NOT_FOUND, f"{self.volume}/{self.bucket}")
+        if self.fs_paths:
+            check_fs_conflicts(store, self.volume, self.bucket,
+                               self.key)
         kk = key_key(self.volume, self.bucket, self.key)
         row = {
             "volume": self.volume,
@@ -593,6 +699,8 @@ class OpenKey(OMRequest):
             # user-defined key metadata (reference: OmKeyInfo metadata
             # map carrying e.g. S3 x-amz-meta-* pairs)
             row["metadata"] = dict(self.metadata)
+        if self.fs_paths:
+            row["fs_paths"] = True  # commit materializes parent markers
         store.put("open_keys", f"{kk}/{self.client_id}", row)
 
 
@@ -664,6 +772,13 @@ class RenameKey(OMRequest):
     bucket: str
     key: str
     new_key: str
+    #: LEGACY layout: the destination obeys filesystem shape (conflict
+    #: checks + parent markers), same as OpenKey/CommitKey
+    fs_paths: bool = False
+    ts: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        self.ts = time.time()
 
     def apply(self, store):
         src = key_key(self.volume, self.bucket, self.key)
@@ -671,6 +786,18 @@ class RenameKey(OMRequest):
         if info is None:
             raise OMError(KEY_NOT_FOUND, src)
         dst = key_key(self.volume, self.bucket, self.new_key)
+        if self.fs_paths:
+            check_fs_conflicts(store, self.volume, self.bucket,
+                               self.new_key)
+            markers = missing_parent_markers(store, self.volume,
+                                             self.bucket, self.new_key)
+            if markers:
+                check_and_charge_quota(store, self.volume, self.bucket,
+                                       0, len(markers))
+                put_parent_markers(store, self.volume, self.bucket,
+                                   markers,
+                                   info.get("replication", ""),
+                                   self.ts or time.time())
         info["name"] = self.new_key
         store.delete("keys", src)
         store.put("keys", dst, info)

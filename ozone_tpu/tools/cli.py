@@ -731,7 +731,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="setquota: max key count (-1 clears to "
                          "unlimited; omitted leaves unchanged)")
     sh.add_argument("--layout", default="OBJECT_STORE",
-                    choices=["OBJECT_STORE", "FILE_SYSTEM_OPTIMIZED"],
+                    choices=["OBJECT_STORE", "FILE_SYSTEM_OPTIMIZED",
+                             "LEGACY"],
                     help="bucket layout (reference: ozone sh bucket create "
                          "--layout)")
     sh.set_defaults(fn=cmd_sh)

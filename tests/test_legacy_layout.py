@@ -1,0 +1,149 @@
+"""LEGACY bucket layout: flat key table with filesystem path semantics.
+
+The reference's third layout (BucketLayoutAwareOMKeyRequestFactory
+routes LEGACY through the flat-table key requests with
+`ozone.om.enable.filesystem.paths` behaviors): path normalization,
+server-side parent directory markers on commit, and file/directory
+conflict refusal.
+"""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.om.requests import OMError, normalize_fs_path
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = MiniOzoneCluster(
+        tmp_path_factory.mktemp("legacy"),
+        num_datanodes=5,
+        block_size=8 * 4096,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    c.client().create_volume("lv")
+    c.om.create_bucket("lv", "lb", EC, layout="LEGACY")
+    yield c
+    c.close()
+
+
+def _bucket(cluster):
+    return cluster.client().get_volume("lv").get_bucket("lb")
+
+
+def test_normalize_fs_path():
+    assert normalize_fs_path("/a//b/c") == "a/b/c"
+    assert normalize_fs_path("a/b/") == "a/b/"
+    for bad in ("", "/", "a/../b", "./a"):
+        with pytest.raises(OMError):
+            normalize_fs_path(bad)
+
+
+def test_unknown_layout_refused(cluster):
+    with pytest.raises(OMError):
+        cluster.om.create_bucket("lv", "bad", EC, layout="NOPE")
+
+
+def test_legacy_normalizes_and_creates_parent_markers(cluster):
+    b = _bucket(cluster)
+    data = np.arange(9000, dtype=np.uint8) % 251
+    # write through a denormalized path; read back via the clean one
+    b.write_key("/d1//d2/f.bin", data)
+    assert np.array_equal(b.read_key("d1/d2/f.bin"), data)
+    # the OM materialized the parent markers server-side
+    names = {k["name"] for k in cluster.om.list_keys("lv", "lb")}
+    assert {"d1/", "d1/d2/", "d1/d2/f.bin"} <= names
+
+
+def test_legacy_file_directory_conflicts_refused(cluster):
+    b = _bucket(cluster)
+    b.write_key("c1/leaf", np.zeros(100, np.uint8))
+    # a file cannot shadow an existing directory
+    with pytest.raises(Exception) as ei:
+        b.write_key("c1", np.zeros(10, np.uint8))
+    assert "FILE_ALREADY_EXISTS" in str(ei.value)
+    # a key cannot be created under a file
+    with pytest.raises(Exception) as ei:
+        b.write_key("c1/leaf/under", np.zeros(10, np.uint8))
+    assert "NOT_A_DIRECTORY" in str(ei.value)
+
+
+def test_legacy_rename_delete_normalized(cluster):
+    b = _bucket(cluster)
+    b.write_key("r/a.txt", np.zeros(64, np.uint8))
+    cluster.om.rename_key("lv", "lb", "//r/a.txt", "r/b.txt")
+    assert np.array_equal(b.read_key("r/b.txt"),
+                          np.zeros(64, np.uint8))
+    b.delete_key("/r//b.txt")
+    with pytest.raises(Exception):
+        b.read_key("r/b.txt")
+
+
+def test_legacy_webhdfs_roundtrip(cluster):
+    """The rooted fs adapter + WebHDFS semantics work unchanged over a
+    LEGACY bucket (the layout the reference's ozoneFS predates FSO
+    with)."""
+    from ozone_tpu.gateway.fs import RootedOzoneFileSystem
+
+    fs = RootedOzoneFileSystem(cluster.client(), replication=EC)
+    fs.create("/lv/lb/w/x/deep.bin", b"legacy-bytes")
+    st = fs.get_file_status("/lv/lb/w/x/deep.bin")
+    assert not st.is_dir and st.length == 12
+    assert fs.get_file_status("/lv/lb/w/x").is_dir
+    names = [s.path for s in fs.list_status("/lv/lb/w")]
+    assert names == ["lv/lb/w/x"]
+    with fs.open("/lv/lb/w/x/deep.bin") as f:
+        assert f.read() == b"legacy-bytes"
+
+
+def test_legacy_rename_enforces_fs_shape(cluster):
+    b = _bucket(cluster)
+    b.write_key("rn/file", np.zeros(32, np.uint8))
+    b.write_key("rn/plain", np.zeros(32, np.uint8))
+    # destination under a plain FILE is refused
+    with pytest.raises(Exception) as ei:
+        cluster.om.rename_key("lv", "lb", "rn/file", "rn/plain/x")
+    assert "NOT_A_DIRECTORY" in str(ei.value)
+    # rename into a fresh directory materializes its marker
+    cluster.om.rename_key("lv", "lb", "rn/file", "rn/newdir/file")
+    names = {k["name"] for k in cluster.om.list_keys("lv", "lb", "rn/")}
+    assert "rn/newdir/" in names and "rn/newdir/file" in names
+
+
+def test_legacy_mpu_normalized_with_markers(cluster):
+    """Multipart uploads obey the same LEGACY path semantics as plain
+    writes: denormalized names are normalized at initiate and the
+    completed key gets parent markers."""
+    oz = cluster.client()
+    b = oz.get_volume("lv").get_bucket("lb")
+    up = b.initiate_multipart_upload("//m1//deep/obj")
+    data = np.arange(6000, dtype=np.uint8) % 251
+    up.write_part(1, data)
+    up.complete()
+    assert np.array_equal(b.read_key("m1/deep/obj"), data)
+    names = {k["name"] for k in cluster.om.list_keys("lv", "lb", "m1/")}
+    assert {"m1/", "m1/deep/", "m1/deep/obj"} <= names
+
+
+def test_legacy_quota_counts_markers(cluster):
+    """Namespace quota accounting agrees across live enforcement,
+    deletes, and RepairQuota when markers are materialized."""
+    cluster.om.create_bucket("lv", "qb", EC, layout="LEGACY")
+    oz = cluster.client()
+    b = oz.get_volume("lv").get_bucket("qb")
+    b.write_key("a/b/f", np.zeros(64, np.uint8))
+    assert cluster.om.bucket_info("lv", "qb")["key_count"] == 3
+    # RepairQuota's recount agrees with live accounting
+    from ozone_tpu.om import requests as rq
+    repaired = cluster.om.submit(rq.RepairQuota("lv"))
+    assert repaired["buckets"]["/lv/qb"]["key_count"] == 3
+    # deleting a marker and the file settles back to agreement
+    b.delete_key("a/b/f")
+    b.delete_key("a/b/")
+    b.delete_key("a/")
+    assert cluster.om.bucket_info("lv", "qb")["key_count"] == 0
