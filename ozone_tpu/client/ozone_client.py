@@ -23,15 +23,29 @@ from ozone_tpu.utils.checksum import ChecksumType
 
 
 class KeyWriteHandle:
-    """Streaming write handle; commits the key on close."""
+    """Streaming write handle; commits the key on close. With `dek`
+    set (TDE/GDPR bucket) every byte is AES-CTR encrypted client-side
+    before it reaches the datapath — datanodes, checksums, scrubbing
+    and reconstruction all operate on ciphertext."""
 
-    def __init__(self, session: OpenKeySession, om: OzoneManager, writer):
+    def __init__(self, session: OpenKeySession, om: OzoneManager, writer,
+                 dek: Optional[bytes] = None):
         self._session = session
         self._om = om
         self._writer = writer
         self._committed = False
+        self._dek = dek
+        self._iv = (bytes.fromhex(session.encryption["iv"])
+                    if dek is not None else b"")
+        self._enc_offset = 0
 
     def write(self, data) -> None:
+        if self._dek is not None:
+            from ozone_tpu.utils.kms import ctr_crypt
+
+            data = ctr_crypt(data, self._dek, self._iv,
+                             self._enc_offset)
+            self._enc_offset += data.size
         self._writer.write(data)
 
     def hsync(self) -> None:
@@ -74,17 +88,30 @@ class MultipartUpload:
 
     def write_part(self, part_number: int, data) -> str:
         import hashlib
+        import os as _os
 
         om = self.bucket.client.om
         session = om.open_multipart_part(
             self.bucket.volume, self.bucket.name, self.key, self.upload_id
         )
         writer = self.bucket._make_writer(session)
+        etag = hashlib.md5(np.asarray(data, np.uint8).tobytes()).hexdigest()
+        iv = ""
+        if session.encryption:
+            # encrypted upload: each part gets its own IV (parts are
+            # written independently, possibly out of order, so a
+            # whole-stream counter cannot work)
+            from ozone_tpu.utils.kms import ctr_crypt
+
+            dek = self.bucket._data_key(session.encryption)
+            raw = _os.urandom(16)
+            data = ctr_crypt(data, dek, raw)
+            iv = raw.hex()
         writer.write(data)
         groups = writer.close()
-        etag = hashlib.md5(np.asarray(data, np.uint8).tobytes()).hexdigest()
         om.commit_multipart_part(
-            session, part_number, groups, writer.bytes_written, etag
+            session, part_number, groups, writer.bytes_written, etag,
+            iv=iv,
         )
         self._etags[part_number] = etag
         return etag
@@ -164,6 +191,17 @@ class OzoneBucket:
         )
         return MultipartUpload(self, key, upload_id)
 
+    def _data_key(self, enc: dict) -> Optional[bytes]:
+        """Resolve the DEK for an encryption bundle: GDPR secrets are
+        inline; TDE EDEKs unwrap through the OM (access-checked KMS
+        decrypt)."""
+        if not enc:
+            return None
+        if "gdpr_secret" in enc:
+            return bytes.fromhex(enc["gdpr_secret"])
+        return bytes.fromhex(
+            self.client.om.kms_decrypt(self.volume, self.name, enc))
+
     def open_key(
         self, key: str, replication: Optional[str] = None,
         metadata: Optional[dict] = None,
@@ -171,7 +209,8 @@ class OzoneBucket:
         om = self.client.om
         session = om.open_key(self.volume, self.name, key, replication,
                               metadata=metadata)
-        return KeyWriteHandle(session, om, self._make_writer(session))
+        return KeyWriteHandle(session, om, self._make_writer(session),
+                              dek=self._data_key(session.encryption))
 
     def write_key(self, key: str, data,
                   replication: Optional[str] = None,
@@ -219,6 +258,24 @@ class OzoneBucket:
                 )
         out = np.concatenate(parts) if parts else np.zeros(0, np.uint8)
         assert out.size == info["size"], (out.size, info["size"])
+        enc = info.get("encryption", {})
+        if enc:
+            from ozone_tpu.utils.kms import ctr_crypt
+
+            dek = self._data_key(enc)
+            if "enc_parts" in info:
+                # multipart: each part was encrypted independently with
+                # its own IV at offset 0
+                segs, pos = [], 0
+                for p in info["enc_parts"]:
+                    n = int(p["size"])
+                    segs.append(ctr_crypt(out[pos:pos + n], dek,
+                                          bytes.fromhex(p["iv"])))
+                    pos += n
+                out = (np.concatenate(segs) if segs
+                       else np.zeros(0, np.uint8))
+            else:
+                out = ctr_crypt(out, dek, bytes.fromhex(enc["iv"]))
         return out
 
     def file_checksum(self, key: str) -> dict:

@@ -54,7 +54,23 @@ class OmGrpcService:
                         m["volume"], m["bucket"],
                         m.get("replication", "rs-6-3-1024k"),
                         m.get("layout", "OBJECT_STORE"),
+                        encryption_key=m.get("encryption_key", ""),
+                        gdpr=m.get("gdpr", False),
                     )
+                ),
+                "KmsCreateKey": self._wrap(
+                    lambda m: self.om.kms_create_key(
+                        m["name"], rotate=m.get("rotate", False))
+                ),
+                "KmsKeyInfo": self._wrap(
+                    lambda m: self.om.kms_key_info(m["name"])
+                ),
+                "KmsListKeys": self._wrap(
+                    lambda m: self.om.kms_list_keys()
+                ),
+                "KmsDecrypt": self._wrap(
+                    lambda m: self.om.kms_decrypt(
+                        m["volume"], m["bucket"], m["bundle"])
                 ),
                 "CreateBucketLink": self._wrap(
                     lambda m: self.om.create_bucket_link(
@@ -318,6 +334,7 @@ class OmGrpcService:
                 # FSO sessions carry their tree position across the wire
                 "parent_id": s.parent_id,
                 "file_name": s.file_name,
+                "encryption": s.encryption,
             }
         )
 
@@ -352,7 +369,7 @@ class OmGrpcService:
         try:
             etag = self.om.commit_multipart_part(
                 _S(), m["part_number"], self._groups_from(m["groups"]),
-                m["size"], m["etag"],
+                m["size"], m["etag"], iv=m.get("iv", ""),
             )
         except OMError as e:
             raise StorageError(e.code, e.msg)
@@ -402,6 +419,7 @@ class RemoteOpenKeySession:
         self.bytes_per_checksum = meta["bytes_per_checksum"]
         self.parent_id = meta.get("parent_id")
         self.file_name = meta.get("file_name")
+        self.encryption = meta.get("encryption", {})
 
 
 class GrpcOmClient:
@@ -486,9 +504,26 @@ class GrpcOmClient:
         return self._call("ListVolumes")["result"]
 
     def create_bucket(self, volume, bucket, replication="rs-6-3-1024k",
-                      layout="OBJECT_STORE"):
+                      layout="OBJECT_STORE", encryption_key="",
+                      gdpr=False):
         self._call("CreateBucket", volume=volume, bucket=bucket,
-                   replication=replication, layout=layout)
+                   replication=replication, layout=layout,
+                   encryption_key=encryption_key, gdpr=gdpr)
+
+    # TDE / KMS (OzoneKMSUtil + KMSClientProvider surface)
+    def kms_create_key(self, name, rotate=False):
+        return self._call("KmsCreateKey", name=name,
+                          rotate=rotate)["result"]
+
+    def kms_key_info(self, name):
+        return self._call("KmsKeyInfo", name=name)["result"]
+
+    def kms_list_keys(self):
+        return self._call("KmsListKeys")["result"]
+
+    def kms_decrypt(self, volume, bucket, bundle):
+        return self._call("KmsDecrypt", volume=volume, bucket=bucket,
+                          bundle=bundle)["result"]
 
     def create_bucket_link(self, src_volume, src_bucket, volume, bucket):
         self._call("CreateBucketLink", src_volume=src_volume,
@@ -694,11 +729,12 @@ class GrpcOmClient:
                 # MPU rows store the link-resolved names
                 "volume": info["volume"],
                 "bucket": info["bucket"],
+                "encryption": info.get("encryption", {}),
             },
         )
 
     def commit_multipart_part(self, session, part_number, groups, size,
-                              etag):
+                              etag, iv=""):
         return self._call(
             "CommitMultipartPart",
             volume=session.volume,
@@ -709,6 +745,7 @@ class GrpcOmClient:
             groups=[g.to_json() for g in groups],
             size=size,
             etag=etag,
+            iv=iv,
         )["result"]
 
     def complete_multipart_upload(self, volume, bucket, key, upload_id,

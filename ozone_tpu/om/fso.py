@@ -179,6 +179,9 @@ class OpenFile(OMRequest):
     new_dir_ids: list[str] = field(default_factory=list)
     created: float = 0.0
     metadata: dict = field(default_factory=dict)
+    #: envelope-encryption bundle (TDE EDEK / GDPR secret) minted by
+    #: the OM at open — see requests.OpenKey.encryption
+    encryption: dict = field(default_factory=dict)
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -217,6 +220,8 @@ class OpenFile(OMRequest):
         }
         if self.metadata:
             row["metadata"] = dict(self.metadata)
+        if self.encryption:
+            row["encryption"] = dict(self.encryption)
         store.put("open_keys", f"{fk}/{self.client_id}", row)
         return parent
 
@@ -251,6 +256,9 @@ class CommitFile(OMRequest):
             # the already-written blocks to the deleted-key purge chain
             store.delete("open_keys", open_k)
             info.update(size=self.size, block_groups=self.block_groups)
+            from ozone_tpu.om.requests import erase_gdpr_secret
+
+            erase_gdpr_secret(info)
             store.put("deleted_keys", f"{fk}:{self.modified}", info)
             raise OMError(DIRECTORY_NOT_FOUND,
                           f"parent of {fk} deleted during write")
@@ -290,6 +298,9 @@ class DeleteFile(OMRequest):
         stale_writer = info.get("hsync_client_id")
         if stale_writer:
             store.delete("open_keys", f"{fk}/{stale_writer}")
+        from ozone_tpu.om.requests import erase_gdpr_secret
+
+        erase_gdpr_secret(info)
         store.put("deleted_keys", f"{fk}:{self.ts}", info)
         from ozone_tpu.om.requests import check_and_charge_quota
 
@@ -441,8 +452,11 @@ class PurgeDirectories(OMRequest):
     def apply(self, store):
         from ozone_tpu.om.requests import check_and_charge_quota
 
+        from ozone_tpu.om.requests import erase_gdpr_secret
+
         for fk, info, ts in self.file_moves:
             store.delete("files", fk)
+            erase_gdpr_secret(info)
             store.put("deleted_keys", f"{fk}:{ts}", info)
             _, vol, bkt = fk.split("/", 3)[:3]
             check_and_charge_quota(store, vol, bkt,

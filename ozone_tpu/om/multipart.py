@@ -51,6 +51,7 @@ def _final_etag(listed: list[dict]) -> str:
 def _release_blocks(store, info: dict, ts: float, tag: str) -> None:
     """Route a part/key entry's blocks into the deleted-keys purge chain."""
     if info.get("block_groups"):
+        rq.erase_gdpr_secret(info)
         store.put("deleted_keys", f"{tag}:{ts}", info)
 
 
@@ -67,6 +68,9 @@ class InitiateMultipartUpload(rq.OMRequest):
     metadata: dict = field(default_factory=dict)
     #: LEGACY bucket: key pre-normalized; enforce filesystem shape
     fs_paths: bool = False
+    #: TDE/GDPR: one envelope bundle for the whole upload; each part
+    #: encrypts independently under it with a per-part IV
+    encryption: dict = field(default_factory=dict)
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -99,6 +103,8 @@ class InitiateMultipartUpload(rq.OMRequest):
                 "created": self.created,
                 "parts": {},
                 "metadata": dict(self.metadata),
+                **({"encryption": dict(self.encryption)}
+                   if self.encryption else {}),
             },
         )
         return self.upload_id
@@ -119,6 +125,8 @@ class CommitMultipartPart(rq.OMRequest):
     etag: str
     block_groups: list[dict] = field(default_factory=list)
     ts: float = 0.0
+    #: CTR IV this part's ciphertext was produced with (encrypted MPU)
+    iv: str = ""
 
     def pre_execute(self, om) -> None:
         self.ts = time.time()
@@ -140,6 +148,7 @@ class CommitMultipartPart(rq.OMRequest):
             "etag": self.etag,
             "block_groups": self.block_groups,
             "modified": self.ts,
+            **({"iv": self.iv} if self.iv else {}),
         }
         store.put("multipart", mk, mpu)
         return self.etag
@@ -228,6 +237,13 @@ class CompleteMultipartUpload(rq.OMRequest):
         }
         if mpu.get("metadata"):
             info["metadata"] = mpu["metadata"]
+        if mpu.get("encryption"):
+            info["encryption"] = mpu["encryption"]
+            # each part carries its own IV: the reader decrypts the
+            # stitched stream segment by segment
+            info["enc_parts"] = [
+                {"size": p["size"], "iv": p["iv"]} for p in listed
+            ]
         store.put("keys", kk, info)
         store.delete("multipart", mk)
         return info

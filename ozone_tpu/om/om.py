@@ -51,6 +51,8 @@ class OpenKeySession:
         # FSO sessions carry their resolved tree position
         self.parent_id: Optional[str] = info.get("parent_id")
         self.file_name: Optional[str] = info.get("file_name")
+        #: TDE/GDPR envelope bundle the OM minted at open ({} = plain)
+        self.encryption: dict = info.get("encryption", {})
 
 
 class OzoneManager:
@@ -80,6 +82,11 @@ class OzoneManager:
         # reference hdds.block.token.enabled): installed by the daemon
         # via enable_block_tokens; None = insecure cluster, no tokens
         self.token_issuer = None
+        # TDE key authority (OzoneKMSUtil / KMSClientProvider role):
+        # master keys live in the replicated store
+        from ozone_tpu.utils.kms import KeyProvider
+
+        self.kms = KeyProvider(self.store)
 
     # ----------------------------------------------------------- acl/tenant
     def enable_acls(self, superusers=("root",)) -> None:
@@ -291,10 +298,13 @@ class OzoneManager:
     # ----------------------------------------------------------- buckets
     def create_bucket(
         self, volume: str, bucket: str, replication: str = "rs-6-3-1024k",
-        layout: str = "OBJECT_STORE",
+        layout: str = "OBJECT_STORE", encryption_key: str = "",
+        gdpr: bool = False,
     ) -> None:
         self.check_access(volume, None, None, "CREATE")
-        self.submit(rq.CreateBucket(volume, bucket, replication, layout))
+        self.submit(rq.CreateBucket(volume, bucket, replication, layout,
+                                    encryption_key=encryption_key,
+                                    gdpr=gdpr))
 
     def create_bucket_link(self, src_volume: str, src_bucket: str,
                            volume: str, bucket: str) -> None:
@@ -357,6 +367,49 @@ class OzoneManager:
     def _is_legacy(binfo: dict) -> bool:
         return binfo.get("layout") == "LEGACY"
 
+    # ------------------------------------------------------------- TDE/KMS
+    def _mint_encryption(self, binfo: dict) -> dict:
+        """Per-key envelope bundle for an encrypted or GDPR bucket
+        (generateEncryptedKey at open; rides the replicated OpenKey so
+        every replica stores the same bundle)."""
+        import os as _os
+
+        if binfo.get("encryption_key"):
+            return self.kms.generate_edek(binfo["encryption_key"])
+        if binfo.get("gdpr"):
+            return {"gdpr_secret": _os.urandom(32).hex(),
+                    "iv": _os.urandom(16).hex()}
+        return {}
+
+    def kms_create_key(self, name: str, rotate: bool = False) -> dict:
+        self._check_superuser()  # key authority ops are admin-only
+        return self.submit(rq.CreateMasterKey(name, rotate=rotate))
+
+    def kms_key_info(self, name: str) -> dict:
+        return self.kms.master_info(name)
+
+    def kms_list_keys(self) -> list[str]:
+        return self.kms.master_key_names()
+
+    def kms_decrypt(self, volume: str, bucket: str,
+                    bundle: dict) -> str:
+        """EDEK -> DEK for an authorized reader/writer. The bundle must
+        belong to THIS bucket (its master key must be the bucket's
+        configured key) — otherwise READ on any bucket would unwrap any
+        bucket's EDEKs (confused-deputy). Writers qualify too: the open
+        path hands them a fresh EDEK they must be able to use."""
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        binfo = self.bucket_info(volume, bucket)
+        if binfo.get("encryption_key") != bundle.get("master"):
+            raise rq.OMError(
+                rq.PERMISSION_DENIED,
+                "EDEK was not issued for this bucket's master key")
+        try:
+            self.check_access(volume, bucket, None, "READ")
+        except rq.OMError:
+            self.check_access(volume, bucket, None, "WRITE")
+        return self.kms.unwrap_edek(bundle).hex()
+
     def open_key(
         self,
         volume: str,
@@ -372,9 +425,10 @@ class OzoneManager:
         binfo = self.bucket_info(volume, bucket)
         repl = replication or binfo["replication"]
         client_id = uuid.uuid4().hex[:16]
+        enc = self._mint_encryption(binfo)
         if self._is_fso(binfo):
             req = fso.OpenFile(volume, bucket, key, client_id, repl,
-                               metadata=metadata or {})
+                               metadata=metadata or {}, encryption=enc)
             parent = self.submit(req)
             name = fso.split_path(key)[-1]
             open_k = f"{fso.dir_key(volume, bucket, parent, name)}/{client_id}"
@@ -383,7 +437,8 @@ class OzoneManager:
             if legacy:
                 key = rq.normalize_fs_path(key)
             req = rq.OpenKey(volume, bucket, key, client_id, repl,
-                             metadata=metadata or {}, fs_paths=legacy)
+                             metadata=metadata or {}, fs_paths=legacy,
+                             encryption=enc)
             self.submit(req)
             open_k = f"{key_key(volume, bucket, key)}/{client_id}"
         info = self.store.get("open_keys", open_k)
@@ -693,13 +748,15 @@ class OzoneManager:
         from ozone_tpu.om import multipart as mpu
 
         volume, bucket = self.resolve_bucket(volume, bucket)
-        legacy = self._is_legacy(self.bucket_info(volume, bucket))
+        binfo = self.bucket_info(volume, bucket)
+        legacy = self._is_legacy(binfo)
         if legacy:
             key = rq.normalize_fs_path(key)
         return self.submit(
             mpu.InitiateMultipartUpload(
                 volume, bucket, key, replication=replication or "",
                 metadata=metadata or {}, fs_paths=legacy,
+                encryption=self._mint_encryption(binfo),
             )
         )
 
@@ -736,6 +793,7 @@ class OzoneManager:
         groups: list[BlockGroup],
         size: int,
         etag: str,
+        iv: str = "",
     ) -> str:
         from ozone_tpu.om import multipart as mpu
 
@@ -749,6 +807,7 @@ class OzoneManager:
                 size,
                 etag,
                 [g.to_json() for g in groups],
+                iv=iv,
             )
         )
 

@@ -132,9 +132,18 @@ class CreateBucket(OMRequest):
     created: float = 0.0
     source_volume: str = ""
     source_bucket: str = ""
+    #: TDE: name of the KMS master key every key in this bucket gets an
+    #: EDEK under (BucketEncryptionKeyInfo analog); "" = plaintext
+    encryption_key: str = ""
+    #: GDPR right-to-erasure: per-key plaintext secret destroyed in the
+    #: same apply that deletes the key (crypto-erasure)
+    gdpr: bool = False
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
+        if self.encryption_key:
+            # fail fast at create, not at first write
+            om.kms.master_info(self.encryption_key)
 
     #: the reference's three bucket layouts
     #: (BucketLayoutAwareOMKeyRequestFactory): OBS = flat object table,
@@ -165,6 +174,10 @@ class CreateBucket(OMRequest):
             # (OzoneAclUtil.inheritDefaultAcls)
             "acls": inherit_defaults(vrow.get("acls", [])),
         }
+        if self.encryption_key:
+            row["encryption_key"] = self.encryption_key
+        if self.gdpr:
+            row["gdpr"] = True
         if self.source_volume and self.source_bucket:
             # links may be created before their source (reference
             # semantics: dangling links resolve lazily and error on use)
@@ -293,6 +306,9 @@ def finalize_commit(store, table: str, ek: str, info: dict, old,
         stale_writer = old.get("hsync_client_id")
         if stale_writer:
             store.delete("open_keys", f"{ek}/{stale_writer}")
+        # overwrites are deletions of the old version: its GDPR secret
+        # must die here, not linger in the purge chain
+        erase_gdpr_secret(old)
         store.put("deleted_keys", f"{ek}:{modified}", old)
     store.put(table, ek, info)
 
@@ -672,6 +688,10 @@ class OpenKey(OMRequest):
     created: float = 0.0
     metadata: dict = field(default_factory=dict)
     fs_paths: bool = False
+    #: envelope-encryption bundle minted by the OM at open (EDEK for a
+    #: TDE bucket, plaintext per-key secret for a GDPR bucket); rides
+    #: the replicated request so every replica stores the same bundle
+    encryption: dict = field(default_factory=dict)
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -701,7 +721,19 @@ class OpenKey(OMRequest):
             row["metadata"] = dict(self.metadata)
         if self.fs_paths:
             row["fs_paths"] = True  # commit materializes parent markers
+        if self.encryption:
+            row["encryption"] = dict(self.encryption)
         store.put("open_keys", f"{kk}/{self.client_id}", row)
+
+
+def erase_gdpr_secret(info: dict) -> None:
+    """GDPR right-to-erasure: destroy the per-key encryption secret in
+    the SAME apply that deletes the key. The blocks ride the async
+    purge chain, but without the secret they are ciphertext noise from
+    this moment on (the reference's GDPR_FLAG crypto-erasure)."""
+    enc = info.get("encryption")
+    if enc and "gdpr_secret" in enc:
+        info["encryption"] = {"erased": True}
 
 
 @dataclass
@@ -728,6 +760,7 @@ class DeleteKey(OMRequest):
         stale_writer = info.get("hsync_client_id")
         if stale_writer:
             store.delete("open_keys", f"{kk}/{stale_writer}")
+        erase_gdpr_secret(info)
         store.put("deleted_keys", f"{kk}:{self.ts}", info)
         check_and_charge_quota(store, self.volume, self.bucket,
                                -int(info.get("size", 0)), -1)
@@ -850,6 +883,41 @@ class SetBucketAcl(OMRequest):
             raise OMError(BUCKET_NOT_FOUND, k)
         b["acl"] = self.acl
         store.put("buckets", k, b)
+
+
+@dataclass
+class CreateMasterKey(OMRequest):
+    """Mint (or rotate) a named KMS master key. The key material is
+    generated in pre_execute on the leader and replicates through the
+    log — every OM replica can unwrap EDEKs (the reference delegates
+    this to an external Hadoop KMS; here the metadata ring IS the key
+    authority)."""
+
+    name: str
+    rotate: bool = False
+    material: str = ""
+
+    def pre_execute(self, om) -> None:
+        import os as _os
+
+        self.material = _os.urandom(32).hex()
+
+    def apply(self, store):
+        from ozone_tpu.utils.kms import MASTER_PREFIX
+
+        k = MASTER_PREFIX + self.name
+        row = store.get("system", k)
+        if row is None:
+            if self.rotate:
+                raise OMError(INVALID_REQUEST,
+                              f"no master key {self.name!r} to rotate")
+            row = {"versions": []}
+        elif not self.rotate:
+            raise OMError(INVALID_REQUEST,
+                          f"master key {self.name!r} exists")
+        row["versions"].append(self.material)
+        store.put("system", k, row)
+        return {"name": self.name, "versions": len(row["versions"])}
 
 
 @dataclass
