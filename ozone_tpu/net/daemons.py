@@ -119,6 +119,13 @@ class DatanodeDaemon:
         from ozone_tpu.utils.insight import InsightService
 
         self.insight = InsightService(self.server, f"datanode:{dn_id}")
+        # span export to the cluster collector on the metadata server
+        # (TracingUtil's Jaeger sender analog)
+        from ozone_tpu.utils.tracing import SpanExporter, Tracer
+
+        self.trace_exporter = SpanExporter(
+            Tracer.instance(), f"datanode-{dn_id}",
+            scm_address.split(",")[0].strip(), tls=self.tls)
         self.scm = GrpcScmClient(scm_address, tls=self.tls)
         self.rack = rack
         self.heartbeat_interval = heartbeat_interval_s
@@ -194,6 +201,7 @@ class DatanodeDaemon:
         self.server.start()
         if self.cert_renewal is not None:
             self.cert_renewal.start()
+        self.trace_exporter.start()
         self._rejoin_pipelines()
         self.scm.register(self.dn.id, self.address, rack=self.rack,
                           op_state=self._op_state)
@@ -420,6 +428,7 @@ class DatanodeDaemon:
         self._stop.set()
         if self.cert_renewal is not None:
             self.cert_renewal.stop()
+        self.trace_exporter.stop()
         if self._hb:
             self._hb.join(timeout=5)
         if self._scanner:
@@ -625,6 +634,18 @@ class ScmOmDaemon:
         from ozone_tpu.utils.insight import InsightService
 
         self.insight = InsightService(self.server, "scm-om")
+        # cluster trace collector (Jaeger-collector role) + this
+        # process's own spans fed straight in (no wire round-trip)
+        from ozone_tpu.utils.tracing import (
+            SpanExporter,
+            TraceCollector,
+            Tracer,
+        )
+
+        self.trace_collector = TraceCollector(self.server)
+        self.trace_exporter = SpanExporter(
+            Tracer.instance(), "scm-om",
+            collector=self.trace_collector)
         self._bg_interval = background_interval_s
         # optional HTTP endpoint: /prom, /prof, /stacks, and live
         # reconfiguration of the service knobs (ReconfigureProtocol
@@ -824,6 +845,7 @@ class ScmOmDaemon:
             self.recon.start()
         if self.cert_renewal is not None:
             self.cert_renewal.start()
+        self.trace_exporter.start()
         if self.ha is not None:
             self.ha.start()
         else:
@@ -883,6 +905,7 @@ class ScmOmDaemon:
             self.recon.stop()
         if self.cert_renewal is not None:
             self.cert_renewal.stop()
+        self.trace_exporter.stop()
         self.scm.stop()
         self.server.stop()
         if self.enroll_server is not None:

@@ -175,9 +175,13 @@ class RpcChannel:
 
     def __init__(self, address: str, tls=None,
                  server_name: Optional[str] = None,
-                 owner: Optional[str] = None):
+                 owner: Optional[str] = None,
+                 traced: bool = True):
         self.address = address
         self.owner = owner
+        #: False for infrastructure channels (the span exporter) whose
+        #: own RPCs must not generate spans — self-tracing feedback
+        self.traced = traced
         options = [
             ("grpc.max_send_message_length", 128 * 1024 * 1024),
             ("grpc.max_receive_message_length", 128 * 1024 * 1024),
@@ -287,8 +291,10 @@ class RpcChannel:
         if fn is None:
             fn = self._channel.unary_unary(key)
             self._calls[key] = fn
-        tracer = Tracer.instance()
         try:
+            if not self.traced:
+                return fn(request, timeout=timeout)
+            tracer = Tracer.instance()
             with tracer.span(f"client:{key}", address=self.address):
                 ctx = tracer.inject()
                 metadata = (("x-trace-id", ctx),) if ctx else None

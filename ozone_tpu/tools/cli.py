@@ -166,7 +166,9 @@ def cmd_sh(args) -> int:
             vol, bucket = parts
             if verb == "create":
                 oz.om.create_bucket(vol, bucket, args.replication,
-                                    layout=args.layout)
+                                    layout=args.layout,
+                                    encryption_key=args.encryption_key,
+                                    gdpr=args.gdpr)
             elif verb == "delete":
                 oz.om.delete_bucket(vol, bucket)
             elif verb == "info":
@@ -382,6 +384,28 @@ def cmd_admin(args) -> int:
         else:
             return usage(f"unknown ring verb {verb!r} "
                          "(expected add <id>=<addr>|remove <id>)")
+    elif subject == "kms":
+        # TDE master-key authority (ozone admin + KMS keyadmin analog)
+        from ozone_tpu.net.om_service import GrpcOmClient
+
+        om = GrpcOmClient(args.om, tls=_client_tls())
+        if verb == "create-key":
+            if not target:
+                return usage("kms create-key needs a key name")
+            _emit(om.kms_create_key(target))
+        elif verb == "rotate-key":
+            if not target:
+                return usage("kms rotate-key needs a key name")
+            _emit(om.kms_create_key(target, rotate=True))
+        elif verb in (None, "list"):
+            _emit(om.kms_list_keys())
+        elif verb == "info":
+            if not target:
+                return usage("kms info needs a key name")
+            _emit(om.kms_key_info(target))
+        else:
+            return usage(f"unknown kms verb {verb!r} (expected "
+                         "create-key|rotate-key|list|info)")
     elif subject == "om":
         from ozone_tpu.net.om_service import GrpcOmClient
 
@@ -730,6 +754,12 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("--namespace-quota", type=int, default=None,
                     help="setquota: max key count (-1 clears to "
                          "unlimited; omitted leaves unchanged)")
+    sh.add_argument("--encryption-key", default="",
+                    help="TDE: bucket master-key name (admin kms "
+                         "create-key first)")
+    sh.add_argument("--gdpr", action="store_true",
+                    help="GDPR right-to-erasure bucket (per-key secret "
+                         "destroyed on delete)")
     sh.add_argument("--layout", default="OBJECT_STORE",
                     choices=["OBJECT_STORE", "FILE_SYSTEM_OPTIMIZED",
                              "LEGACY"],
@@ -771,7 +801,7 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("subject", choices=[
         "safemode", "datanode", "status", "pipeline", "container",
         "balancer", "replicationmanager", "om", "finalizeupgrade",
-        "ring",
+        "ring", "kms",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"
@@ -920,9 +950,11 @@ def build_parser() -> argparse.ArgumentParser:
     dbg = sub.add_parser("debug", help="debug tools (ozone debug analog)")
     dbg.add_argument("tool", choices=["ldb", "chunk-info", "verify-replicas",
                                       "export-container",
-                                      "import-container"])
-    dbg.add_argument("target", help="db path (ldb), /vol/bucket/key, or "
-                                    "a container id (export/import)")
+                                      "import-container", "trace"])
+    dbg.add_argument("target", nargs="?", default="",
+                     help="db path (ldb), /vol/bucket/key, a container "
+                          "id (export/import), or a trace id (trace; "
+                          "empty = list recent)")
     dbg.add_argument("--table", default="keys")
     dbg.add_argument("--prefix", default="")
     dbg.add_argument("--om", default="127.0.0.1:9860")
@@ -1058,6 +1090,59 @@ def cmd_debug(args) -> int:
         finally:
             store.close()
         return 0
+
+    if args.tool != "trace" and not args.target:
+        # target became optional only for `trace` (empty = recent list)
+        print(f"error: debug {args.tool} requires a target",
+              file=sys.stderr)
+        return 1
+    if args.tool == "trace":
+        # cluster trace assembly (the Jaeger-query role): list recent
+        # traces, or print one trace's span tree across services
+        from ozone_tpu.net import wire
+        from ozone_tpu.net.rpc import RpcChannel
+        from ozone_tpu.utils.tracing import TRACING_SERVICE
+
+        ch = RpcChannel(args.om.split(",")[0].strip(),
+                        tls=_client_tls())
+        try:
+            if not args.target:
+                m, _ = wire.unpack(ch.call(TRACING_SERVICE, "Recent",
+                                           wire.pack({})))
+                _emit(m["traces"])
+                return 0
+            m, _ = wire.unpack(ch.call(
+                TRACING_SERVICE, "Query",
+                wire.pack({"trace_id": args.target})))
+            spans = m["spans"]
+            if not spans:
+                print(f"error: no trace {args.target!r}",
+                      file=sys.stderr)
+                return 1
+            # roots = spans whose parent never reached the collector
+            # (external clients usually don't export), not just
+            # parentId == ""
+            ids = {s["spanId"] for s in spans}
+            by_parent: dict = {}
+            roots = []
+            for s in spans:
+                pid = s.get("parentId", "")
+                if pid and pid in ids:
+                    by_parent.setdefault(pid, []).append(s)
+                else:
+                    roots.append(s)
+
+            def walk(items, depth):
+                for s in sorted(items, key=lambda x: x["start"]):
+                    svc = s.get("service", "?")
+                    print(f"{'  ' * depth}{s['name']}  "
+                          f"[{svc}]  {s['durationMs']}ms")
+                    walk(by_parent.get(s["spanId"], []), depth + 1)
+
+            walk(roots, 0)
+            return 0
+        finally:
+            ch.close()
 
     oz = _client(args)
     if args.tool in ("export-container", "import-container"):

@@ -43,6 +43,8 @@ class Tracer:
         self.spans: deque[Span] = deque(maxlen=max_spans)
         self.sample_rate = sample_rate
         self._lock = threading.Lock()
+        #: filled by an attached SpanExporter; None = local-only mode
+        self._export_q: Optional[deque] = None
 
     @classmethod
     def instance(cls) -> "Tracer":
@@ -80,6 +82,8 @@ class Tracer:
             if random.random() < self.sample_rate:
                 with self._lock:
                     self.spans.append(s)
+                    if self._export_q is not None:
+                        self._export_q.append(s)
 
     def inject(self) -> str:
         """Export the current context for the wire ("traceID" field analog);
@@ -107,3 +111,194 @@ class Tracer:
             }
             for s in self.traces()
         ]
+
+
+def span_json(s: Span, service: str = "") -> dict:
+    return {
+        "traceId": s.trace_id,
+        "spanId": s.span_id,
+        "parentId": s.parent_id,
+        "name": s.name,
+        "start": s.start,
+        "durationMs": round(s.duration * 1e3, 3),
+        "tags": s.tags,
+        **({"service": service} if service else {}),
+    }
+
+
+TRACING_SERVICE = "ozone.tpu.Tracing"
+
+
+class SpanExporter:
+    """Ship finished spans to a cluster collector (the reference sends
+    every span to Jaeger via the jaeger-client sender — spans here ride
+    the existing gRPC plane in batches). Lossy by design: the deque is
+    bounded and a down collector just drops batches; tracing must never
+    backpressure the datapath."""
+
+    def __init__(self, tracer: Tracer, service: str, address: str = "",
+                 tls=None, interval_s: float = 2.0,
+                 max_batch: int = 512, collector=None):
+        self.tracer = tracer
+        self.service = service
+        self.address = address
+        self.tls = tls
+        #: in-process collector: the metadata server feeds its own
+        #: spans straight in, no loopback RPC
+        self.collector = collector
+        self.interval_s = interval_s
+        self.max_batch = max_batch
+        self.exported = 0
+        self._q: deque[Span] = deque(maxlen=10_000)
+        tracer._export_q = self._q
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._ch = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"trace-export-{self.service}")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+        self.flush()
+        if self._ch is not None:
+            self._ch.close()
+            self._ch = None
+
+    def flush(self) -> None:
+        """Drain and ship everything pending (one batch per call chunk);
+        errors drop the batch (collector down != datapath problem)."""
+        from ozone_tpu.net import wire as _wire
+
+        batch = []
+        while self._q and len(batch) < self.max_batch:
+            s = self._q.popleft()
+            if TRACING_SERVICE in s.name:
+                continue  # never trace the tracing plane itself
+            batch.append(s)
+        if not batch:
+            return
+        if self.collector is not None:
+            self.collector.add(self.service,
+                               [span_json(s) for s in batch])
+            self.exported += len(batch)
+            return
+        try:
+            if self._ch is None:
+                from ozone_tpu.net.rpc import RpcChannel
+
+                self._ch = RpcChannel(self.address, tls=self.tls,
+                                      traced=False)
+            self._ch.call(TRACING_SERVICE, "Report", _wire.pack({
+                "service": self.service,
+                "spans": [span_json(s) for s in batch],
+            }))
+            self.exported += len(batch)
+        except Exception:
+            # reconnect next round; spans already popped are dropped
+            if self._ch is not None:
+                try:
+                    self._ch.close()
+                except Exception:
+                    pass
+                self._ch = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.flush()
+
+
+class TraceCollector:
+    """Cluster-wide trace assembly (the Jaeger-collector role): every
+    daemon's exporter reports finished spans here; queries see ONE
+    trace stitched across services. Bounded LRU over trace ids."""
+
+    def __init__(self, server=None, max_traces: int = 2000):
+        from collections import OrderedDict
+
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self.max_traces = max_traces
+        self._lock = threading.Lock()
+        if server is not None:
+            server.add_service(TRACING_SERVICE, {
+                "Report": self._report,
+                "Query": self._query,
+                "Recent": self._recent,
+            })
+
+    # ------------------------------------------------------------ ingest
+    def add(self, service: str, spans: list[dict]) -> None:
+        with self._lock:
+            for sp in spans:
+                tid = sp.get("traceId", "")
+                if not tid:
+                    continue
+                sp = dict(sp)
+                sp.setdefault("service", service)
+                t = self._traces.get(tid)
+                if t is None:
+                    t = self._traces[tid] = {
+                        "spans": [], "services": set(),
+                        "start": sp["start"], "end": 0.0,
+                    }
+                    while len(self._traces) > self.max_traces:
+                        self._traces.popitem(last=False)
+                t["spans"].append(sp)
+                t["services"].add(sp.get("service") or service)
+                t["start"] = min(t["start"], sp["start"])
+                t["end"] = max(t["end"],
+                               sp["start"] + sp["durationMs"] / 1e3)
+
+    def _report(self, req: bytes) -> bytes:
+        from ozone_tpu.net import wire as _wire
+
+        m, _ = _wire.unpack(req)
+        self.add(m.get("service", ""), m.get("spans", []))
+        return _wire.pack({"ok": True})
+
+    # ------------------------------------------------------------- query
+    def trace(self, trace_id: str) -> list[dict]:
+        with self._lock:
+            t = self._traces.get(trace_id)
+            return sorted((dict(s) for s in t["spans"]),
+                          key=lambda s: s["start"]) if t else []
+
+    def recent(self, limit: int = 50) -> list[dict]:
+        with self._lock:
+            # deep-enough copies: concurrent Report RPCs mutate the
+            # per-trace spans list and services set under the lock
+            items = [
+                (tid, {"spans": list(t["spans"]),
+                       "services": set(t["services"]),
+                       "start": t["start"], "end": t["end"]})
+                for tid, t in list(self._traces.items())[-limit:]
+            ]
+        out = []
+        for tid, t in reversed(items):
+            roots = [s["name"] for s in t["spans"]
+                     if not s.get("parentId")]
+            out.append({
+                "traceId": tid,
+                "spans": len(t["spans"]),
+                "services": sorted(t["services"]),
+                "root": roots[0] if roots else t["spans"][0]["name"],
+                "start": t["start"],
+                "durationMs": round((t["end"] - t["start"]) * 1e3, 3),
+            })
+        return out
+
+    def _query(self, req: bytes) -> bytes:
+        from ozone_tpu.net import wire as _wire
+
+        m, _ = _wire.unpack(req)
+        return _wire.pack({"spans": self.trace(m.get("trace_id", ""))})
+
+    def _recent(self, req: bytes) -> bytes:
+        from ozone_tpu.net import wire as _wire
+
+        m, _ = _wire.unpack(req)
+        return _wire.pack({"traces": self.recent(m.get("limit", 50))})

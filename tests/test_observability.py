@@ -137,3 +137,102 @@ def test_container_export_import(cluster, tmp_path):
                 expect = src_dn.read_chunk(blk.block_id, ci)
                 assert np.array_equal(got, expect)
         dst_dn.close()
+
+
+def test_trace_collector_assembles_across_services():
+    """Exporter -> collector over real gRPC: spans reported by distinct
+    services stitch into ONE queryable trace (the Jaeger
+    collector/query role the round-1 tracing lacked)."""
+    from ozone_tpu.net.rpc import RpcServer
+    from ozone_tpu.utils.tracing import (
+        SpanExporter,
+        TraceCollector,
+        Tracer,
+    )
+
+    srv = RpcServer()
+    collector = TraceCollector(srv)
+    srv.start()
+    try:
+        t = Tracer.instance()
+        exp = SpanExporter(t, "svc-a", srv.address, interval_s=60.0)
+        with t.span("a-root") as root:
+            with t.span("a-child"):
+                ctx = t.inject()
+        exp.flush()
+        # a second service continues the SAME trace (context import)
+        with t.span("b-remote", child_of=ctx):
+            pass
+        exp.service = "svc-b"
+        exp.flush()
+        assert exp.exported == 3
+        spans = collector.trace(root.trace_id)
+        assert {s["name"] for s in spans} == {"a-root", "a-child",
+                                              "b-remote"}
+        recent = collector.recent()
+        row = next(r for r in recent if r["traceId"] == root.trace_id)
+        assert set(row["services"]) == {"svc-a", "svc-b"}
+        assert row["root"] == "a-root"
+        exp.stop()
+    finally:
+        srv.stop()
+
+
+def test_daemon_spans_ship_to_metadata_collector(tmp_path):
+    """Live daemons: a key write's datanode-side spans ship to the
+    scm-om collector and assemble with the OM service spans under the
+    trace id the client propagated."""
+    import time as _time
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.utils.tracing import Tracer
+
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=4 * 4096,
+                       container_size=1024 * 1024,
+                       stale_after_s=1000.0, dead_after_s=2000.0)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.2)
+           for i in range(5)]
+    for d in dns:
+        d.start()
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        b = oz.create_volume("tv").create_bucket(
+            "tb", replication="rs-3-2-4096")
+        t = Tracer.instance()
+        with t.span("client-write") as root:
+            b.write_key("k", np.zeros(20_000, np.uint8))
+        # exporters run on an interval; force the ship now. NOTE: in
+        # one process every daemon shares the singleton tracer, so all
+        # spans drain through one exporter — per-service attribution is
+        # exercised by the unit test above and the live multi-process
+        # drill; this test proves the daemon plumbing end to end.
+        deadline = _time.time() + 10
+        spans = []
+        while _time.time() < deadline:
+            for d in dns:
+                d.trace_exporter.flush()
+            meta.trace_exporter.flush()
+            spans = meta.trace_collector.trace(root.trace_id)
+            names = {s["name"] for s in spans}
+            if "client-write" in names and any(
+                    "OmService" in n for n in names) and any(
+                    "Datanode" in n for n in names):
+                break
+            _time.sleep(0.2)
+        names = {s["name"] for s in spans}
+        assert "client-write" in names, names
+        # the OM verbs and the datapath writes assembled under ONE id
+        assert any("OmService" in n for n in names), names
+        assert any("Datanode" in n for n in names), names
+        assert all(s.get("service") for s in spans)
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
