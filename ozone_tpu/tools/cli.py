@@ -439,6 +439,28 @@ def cmd_freon(args) -> int:
     elif args.generator == "ockr":
         oz = _client(args)
         _emit(freon.ockr(oz, args.num, threads=args.threads).summary())
+    elif args.generator == "ockv":
+        oz = _client(args)
+        _emit(freon.ockv(oz, n_keys=args.num, size=args.size,
+                         threads=args.threads).summary())
+    elif args.generator == "fskg":
+        oz = _client(args)
+        _emit(freon.fskg(
+            oz, n_files=args.num, size=args.size, threads=args.threads,
+            replication=args.replication or None,
+        ).summary())
+    elif args.generator == "mpug":
+        oz = _client(args)
+        _emit(freon.mpug(
+            oz, n_uploads=args.num, part_size=args.size,
+            threads=args.threads,
+            replication=args.replication or None,
+        ).summary())
+    elif args.generator == "s3kg":
+        _emit(freon.s3kg(
+            args.endpoint, n_keys=args.num, size=args.size,
+            threads=args.threads, validate=args.validate,
+        ).summary())
     elif args.generator == "hsg":
         oz = _client(args)
         _emit(freon.hsg(
@@ -815,15 +837,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     fr = sub.add_parser("freon", help="load generators")
     fr.add_argument("generator",
-                    choices=["ockg", "ockr", "rawcoder", "omkg", "ommg",
-                             "scmtb", "cmdw", "dbgen", "dcg", "dcv",
-                             "dsg", "hsg", "dnbp", "ralg"])
+                    choices=["ockg", "ockr", "ockv", "rawcoder", "omkg",
+                             "ommg", "scmtb", "cmdw", "dbgen", "dcg",
+                             "dcv", "dsg", "hsg", "dnbp", "ralg",
+                             "fskg", "mpug", "s3kg"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("-t", "--threads", type=int, default=4)
     fr.add_argument("--om", default="127.0.0.1:9860")
     fr.add_argument("--replication", default="")
     fr.add_argument("--validate", action="store_true")
+    fr.add_argument("--endpoint", default="127.0.0.1:9878",
+                    help="s3kg: S3 gateway host:port")
     fr.add_argument("--schema", default="rs-6-3")
     fr.add_argument("--cell", type=int, default=1024 * 1024)
     fr.add_argument("--batch", type=int, default=8)

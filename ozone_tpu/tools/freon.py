@@ -55,10 +55,31 @@ class FreonReport:
             else 0,
             "mean_ms": round(1e3 * sum(lat) / len(lat), 3) if lat else 0,
             "p50_ms": round(1e3 * pct(0.5), 3),
+            "p75_ms": round(1e3 * pct(0.75), 3),
             "p90_ms": round(1e3 * pct(0.9), 3),
+            "p95_ms": round(1e3 * pct(0.95), 3),
             "p99_ms": round(1e3 * pct(0.99), 3),
+            "p999_ms": round(1e3 * pct(0.999), 3),
             "max_ms": round(1e3 * (lat[-1] if lat else 0), 3),
+            "histogram": self.histogram(),
         }
+
+    def histogram(self) -> list[dict]:
+        """Power-of-two latency buckets (the HdrHistogram-style
+        distribution the reference prints via printReport). PER-BUCKET
+        counts: each entry counts ops whose latency falls in
+        (previous_le_ms, le_ms] — not cumulative."""
+        if not self.latencies_s:
+            return []
+        import math
+
+        counts: dict[float, int] = {}
+        for dt in self.latencies_s:
+            ms = dt * 1e3
+            le = 2 ** max(0, math.ceil(math.log2(max(ms, 1e-3))))
+            counts[le] = counts.get(le, 0) + 1
+        return [{"le_ms": k, "count": counts[k]}
+                for k in sorted(counts)]
 
 
 class BaseFreonGenerator:
@@ -101,6 +122,14 @@ class BaseFreonGenerator:
         )
 
 
+def _det_payload(size: int, seed: int = 0) -> np.ndarray:
+    """The deterministic ockg payload; ockv re-derives it to validate,
+    so both MUST use this one helper (a drifting expression would read
+    as cluster-wide corruption)."""
+    return np.random.default_rng(seed).integers(0, 256, size,
+                                                dtype=np.uint8)
+
+
 def ockg(
     client,
     n_keys: int = 100,
@@ -123,8 +152,7 @@ def ockg(
     except Exception:
         pass
     b = client.get_volume(volume).get_bucket(bucket)
-    rng = np.random.default_rng(0)
-    payload = rng.integers(0, 256, size, dtype=np.uint8)
+    payload = _det_payload(size)
 
     def op(i: int) -> int:
         b.write_key(f"{prefix}-{i}", payload, replication)
@@ -546,3 +574,114 @@ def ralg(
     finally:
         for n in nodes:
             n.stop()
+
+
+def ockv(client, n_keys: int = 100, size: int = 10 * 1024,
+         threads: int = 4, volume: str = "freon-vol",
+         bucket: str = "freon-bucket",
+         prefix: str = "key") -> FreonReport:
+    """Key VALIDATOR (freon ockv / the validate-writes family): read
+    back keys previously written by ockg and verify content — a
+    deterministic per-key payload, so corruption anywhere in the path
+    (datanode, codec, decrypt) fails the op rather than passing bytes
+    through."""
+    b = client.get_volume(volume).get_bucket(bucket)
+    expect = _det_payload(size)
+
+    def op(i: int) -> int:
+        got = b.read_key(f"{prefix}-{i}")
+        assert np.array_equal(got, expect), f"corrupt key {prefix}-{i}"
+        return int(got.size)
+
+    return BaseFreonGenerator("ockv", n_keys, threads).run(op)
+
+
+def fskg(client, n_files: int = 100, size: int = 10 * 1024,
+         depth: int = 3, threads: int = 4, volume: str = "freon-vol",
+         bucket: str = "freon-fso",
+         replication: Optional[str] = None) -> FreonReport:
+    """Nested-file generator over an FSO bucket (the reference's
+    HadoopNestedDirGenerator + file create family): each op creates a
+    file `depth` directories down, exercising the directory-tree
+    resolve/create path rather than the flat key table."""
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket,
+                                replication or "rs-6-3-1024k",
+                                layout="FILE_SYSTEM_OPTIMIZED")
+    except Exception:
+        pass
+    b = client.get_volume(volume).get_bucket(bucket)
+    payload = np.random.default_rng(1).integers(0, 256, size,
+                                                dtype=np.uint8)
+
+    def op(i: int) -> int:
+        parts = [f"d{(i >> (4 * d)) & 0xF}" for d in range(depth)]
+        b.write_key("/".join(parts) + f"/f{i}", payload, replication)
+        return size
+
+    return BaseFreonGenerator("fskg", n_files, threads).run(op)
+
+
+def mpug(client, n_uploads: int = 20, parts: int = 3,
+         part_size: int = 16 * 1024, threads: int = 4,
+         volume: str = "freon-vol", bucket: str = "freon-mpu",
+         replication: Optional[str] = None) -> FreonReport:
+    """Multipart-upload generator (S3MultipartUpload freon family):
+    each op runs initiate -> N part writes -> complete and counts the
+    full upload round trip."""
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket,
+                                replication or "rs-6-3-1024k")
+    except Exception:
+        pass
+    b = client.get_volume(volume).get_bucket(bucket)
+    payload = np.random.default_rng(2).integers(0, 256, part_size,
+                                                dtype=np.uint8)
+
+    def op(i: int) -> int:
+        up = b.initiate_multipart_upload(f"mpu-{i}", replication)
+        for p in range(1, parts + 1):
+            up.write_part(p, payload)
+        up.complete()
+        return part_size * parts
+
+    return BaseFreonGenerator("mpug", n_uploads, threads).run(op)
+
+
+def s3kg(endpoint: str, n_keys: int = 100, size: int = 10 * 1024,
+         threads: int = 4, bucket: str = "freon-s3",
+         validate: bool = False) -> FreonReport:
+    """S3 gateway key generator (freon s3kg): PUTs (and optionally
+    GET-validates) through the HTTP gateway, covering the full
+    XML/HTTP/auth surface rather than the native RPC path."""
+    import urllib.request
+
+    base = f"http://{endpoint}"
+    try:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/{bucket}", method="PUT"))
+    except Exception:
+        pass
+    payload = bytes(np.random.default_rng(3).integers(
+        0, 256, size, dtype=np.uint8))
+
+    def op(i: int) -> int:
+        with urllib.request.urlopen(urllib.request.Request(
+                f"{base}/{bucket}/k{i}", data=payload,
+                method="PUT")) as r:
+            r.read()
+        if validate:
+            with urllib.request.urlopen(f"{base}/{bucket}/k{i}") as r:
+                got = r.read()
+            assert got == payload, f"corrupt s3 key k{i}"
+        return size * (2 if validate else 1)
+
+    return BaseFreonGenerator("s3kg", n_keys, threads).run(op)

@@ -296,3 +296,66 @@ def test_export_rejects_open_container_and_import_cleans_up(cluster):
         clients.get(dn).import_container(blob[: len(blob) // 2])
     out = clients.get(dn).import_container(blob)
     assert out == cid
+
+
+def _oz(cluster):
+    meta, _ = cluster
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    clients = DatanodeClientFactory()
+    return meta, OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                             clients)
+
+
+def test_freon_round2_generators(cluster):
+    """ockv validate, FSO nested files, multipart uploads, and the
+    histogram/percentile report fields (BaseFreonGenerator.printReport
+    analog) across them."""
+    meta, oz = _oz(cluster)
+    # RATIS/THREE: an earlier admin test drains one of the 5 datanodes,
+    # so 5-node EC groups can no longer place
+    freon.ockg(oz, n_keys=8, size=4000, threads=2,
+               replication="RATIS/THREE")
+    rep = freon.ockv(oz, n_keys=8, size=4000, threads=2)
+    s = rep.summary()
+    assert s["failures"] == 0 and s["ops"] == 8
+    for f in ("p50_ms", "p75_ms", "p90_ms", "p95_ms", "p99_ms",
+              "p999_ms", "max_ms"):
+        assert f in s
+    assert s["histogram"] and sum(
+        b["count"] for b in s["histogram"]) == 8
+    # monotone buckets
+    uppers = [b["le_ms"] for b in s["histogram"]]
+    assert uppers == sorted(uppers)
+
+    rep = freon.fskg(oz, n_files=6, size=3000, depth=2, threads=2,
+                     replication="RATIS/THREE")
+    assert rep.summary()["failures"] == 0
+    # the files landed in the FSO tree
+    assert meta.om.get_file_status(
+        "freon-vol", "freon-fso", "d0")["type"] == "DIRECTORY"
+
+    rep = freon.mpug(oz, n_uploads=3, parts=2, part_size=5000,
+                     threads=2, replication="RATIS/THREE")
+    assert rep.summary()["failures"] == 0
+    got = oz.get_volume("freon-vol").get_bucket("freon-mpu") \
+        .read_key("mpu-0")
+    assert got.size == 10_000
+
+
+def test_freon_s3kg(cluster):
+    from ozone_tpu.gateway.s3 import S3Gateway
+
+    _, oz = _oz(cluster)
+    g = S3Gateway(oz, replication="RATIS/THREE")
+    g.start()
+    try:
+        rep = freon.s3kg(g.address, n_keys=6, size=2000, threads=2,
+                         validate=True)
+        s = rep.summary()
+        assert s["failures"] == 0 and s["ops"] == 6
+        assert s["throughput_mib_s"] >= 0
+    finally:
+        g.stop()
