@@ -421,6 +421,19 @@ class S3Gateway:
         if "acl" in q:
             self._bucket_acl_op(h, method, bucket)
             return
+        if "tagging" in q:
+            # bucket tagging is not supported (object tagging is);
+            # answer the AWS way instead of falling through to a
+            # ListBucketResult that get-bucket-tagging would misparse
+            om.bucket_info(self._vol, bucket)  # 404 on missing bucket
+            if method == "GET":
+                h._reply(*_err("NoSuchTagSet",
+                               "no tag set on this bucket", 404))
+            else:
+                h._body()
+                h._reply(*_err("NotImplemented",
+                               "bucket tagging is not supported", 501))
+            return
         if method == "GET" and "location" in q:
             # SDK handshake endpoints (boto3 probes these): one region
             om.bucket_info(self._vol, bucket)  # 404 on missing bucket
@@ -600,6 +613,8 @@ class S3Gateway:
             self._mpu_abort(h, bucket, key, q)
         elif method == "GET" and "uploadId" in q:
             self._mpu_list_parts(h, bucket, key, q)
+        elif "tagging" in q:
+            self._object_tagging(h, method, bucket, key)
         elif method == "PUT":
             self._put_object(h, bucket, key)
         elif method == "GET":
@@ -608,6 +623,64 @@ class S3Gateway:
             self._head_object(h, bucket, key)
         elif method == "DELETE":
             self._bucket_handle(bucket).delete_key(key)
+            h._reply(204)
+        else:
+            h._reply(*_err("MethodNotAllowed", method, 405))
+
+    @staticmethod
+    def _validate_tags(tags: dict) -> Optional[str]:
+        """AWS tag restrictions: <=10 tags per object, key <=128 chars,
+        value <=256, no duplicate keys (dict dedupes already)."""
+        if len(tags) > 10:
+            return "object tags cannot exceed 10"
+        for k, v in tags.items():
+            if not k or len(k) > 128:
+                return f"invalid tag key {k!r}"
+            if len(v) > 256:
+                return f"tag value too long for {k!r}"
+        return None
+
+    def _object_tagging(self, h, method: str, bucket: str,
+                        key: str) -> None:
+        """?tagging sub-resource (ObjectEndpoint PUT/GET/DELETE tagging;
+        S3 PutObjectTagging family). Tags live on the key row's attrs,
+        replicated like every other key mutation."""
+        om = self.client.om
+        if method == "PUT":
+            try:
+                # bytes straight in: ET honors XML encoding decls, and
+                # a bad .decode() here would 500 instead of 400
+                root = ET.fromstring(h._body())
+                tags = {
+                    t.findtext(f"{{{_NS}}}Key", t.findtext("Key", "")):
+                    t.findtext(f"{{{_NS}}}Value", t.findtext("Value", ""))
+                    for ts in (root.findall(f"{{{_NS}}}TagSet")
+                               or root.findall("TagSet"))
+                    for t in (ts.findall(f"{{{_NS}}}Tag")
+                              or ts.findall("Tag"))
+                }
+            except ET.ParseError as e:
+                h._reply(*_err("MalformedXML", str(e), 400))
+                return
+            bad = self._validate_tags(tags)
+            if bad:
+                h._reply(*_err("InvalidTag", bad, 400))
+                return
+            om.set_key_attrs(self._vol, bucket, key, {"tags": tags})
+            h._reply(200)
+        elif method == "GET":
+            info = om.lookup_key(self._vol, bucket, key)
+            tags = (info.get("attrs") or {}).get("tags", {})
+            root = ET.Element("Tagging", xmlns=_NS)
+            ts = ET.SubElement(root, "TagSet")
+            for k, v in sorted(tags.items()):
+                t = ET.SubElement(ts, "Tag")
+                ET.SubElement(t, "Key").text = k
+                ET.SubElement(t, "Value").text = v
+            h._reply(200, _xml(root),
+                     {"Content-Type": "application/xml"})
+        elif method == "DELETE":
+            om.set_key_attrs(self._vol, bucket, key, {"tags": None})
             h._reply(204)
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
@@ -645,20 +718,46 @@ class S3Gateway:
                 meta = self._user_metadata(h)
             else:
                 meta = src_info.get("metadata") or {}
+            # tagging directive: COPY (default) carries the source's
+            # tags; REPLACE takes this request's x-amz-tagging header
+            if (h.headers.get("x-amz-tagging-directive", "COPY")
+                    .upper() == "REPLACE"):
+                tags = {k: v[0] for k, v in parse_qs(
+                    h.headers.get("x-amz-tagging", ""),
+                    keep_blank_values=True).items()}
+            else:
+                tags = (src_info.get("attrs") or {}).get("tags", {})
             self._bucket_handle(bucket).write_key(
                 key, np.frombuffer(data, np.uint8), metadata=meta
             )
+            if tags:
+                self.client.om.set_key_attrs(self._vol, bucket, key,
+                                             {"tags": tags})
             etag = hashlib.md5(data).hexdigest()
             root = ET.Element("CopyObjectResult", xmlns=_NS)
             ET.SubElement(root, "ETag").text = f'"{etag}"'
             ET.SubElement(root, "LastModified").text = _iso_now()
             h._reply(200, _xml(root), {"Content-Type": "application/xml"})
             return
+        tags = None
+        hdr = h.headers.get("x-amz-tagging")
+        if hdr:
+            # query-string-encoded tags on the PUT itself
+            tags = {k: v[0] for k, v in parse_qs(
+                hdr, keep_blank_values=True).items()}
+            bad = self._validate_tags(tags)
+            if bad:
+                h._body()  # drain, or keep-alive desyncs on early 400
+                h._reply(*_err("InvalidTag", bad, 400))
+                return
         body = h._body()
         self._bucket_handle(bucket).write_key(
             key, np.frombuffer(body, np.uint8),
             metadata=self._user_metadata(h),
         )
+        if tags:
+            self.client.om.set_key_attrs(self._vol, bucket, key,
+                                         {"tags": tags})
         etag = hashlib.md5(body).hexdigest()
         h._reply(200, headers={"ETag": f'"{etag}"'})
 

@@ -430,3 +430,73 @@ def test_s3_raw_start_after_inside_group_emits_common_prefix(s3):
            if p.tag.endswith("CommonPrefixes")
            for e in p if e.tag.endswith("Prefix")]
     assert cps == ["dir/"]
+
+
+def test_s3_object_tagging(s3):
+    """?tagging sub-resource + x-amz-tagging header (S3
+    Put/Get/DeleteObjectTagging; reference ObjectEndpoint tagging)."""
+    import urllib.error
+    import urllib.request
+
+    base = f"http://{s3.address}"
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/tagbkt", method="PUT"))
+    # tags on the PUT itself via header
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/tagbkt/obj", data=b"tagged-bytes", method="PUT",
+        headers={"x-amz-tagging": "team=storage&tier=hot"}))
+    got = urllib.request.urlopen(f"{base}/tagbkt/obj?tagging").read()
+    assert b"<Key>team</Key>" in got and b"<Value>storage</Value>" in got
+    assert b"<Key>tier</Key>" in got
+    # replace via PUT ?tagging XML
+    xml = (b"<Tagging><TagSet><Tag><Key>owner</Key>"
+           b"<Value>alice</Value></Tag></TagSet></Tagging>")
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/tagbkt/obj?tagging", data=xml, method="PUT"))
+    got = urllib.request.urlopen(f"{base}/tagbkt/obj?tagging").read()
+    assert b"owner" in got and b"team" not in got
+    # limits: >10 tags refused
+    many = "&".join(f"k{i}=v" for i in range(11))
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/tagbkt/obj2", data=b"x", method="PUT",
+            headers={"x-amz-tagging": many}))
+    assert ei.value.code == 400
+    assert b"InvalidTag" in ei.value.read()
+    # delete tagging
+    r = urllib.request.urlopen(urllib.request.Request(
+        f"{base}/tagbkt/obj?tagging", method="DELETE"))
+    assert r.status == 204
+    got = urllib.request.urlopen(f"{base}/tagbkt/obj?tagging").read()
+    assert b"<Tag>" not in got
+
+
+def test_s3_copy_carries_tags_and_bucket_tagging_answers(s3):
+    import urllib.error
+    import urllib.request
+
+    base = f"http://{s3.address}"
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/tagcp", method="PUT"))
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/tagcp/src", data=b"copy-me", method="PUT",
+        headers={"x-amz-tagging": "a=1"}))
+    # COPY directive (default): destination inherits the source tags
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/tagcp/dst", method="PUT",
+        headers={"x-amz-copy-source": "/tagcp/src"}))
+    got = urllib.request.urlopen(f"{base}/tagcp/dst?tagging").read()
+    assert b"<Key>a</Key>" in got
+    # REPLACE directive: the request's header wins
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/tagcp/dst2", method="PUT",
+        headers={"x-amz-copy-source": "/tagcp/src",
+                 "x-amz-tagging-directive": "REPLACE",
+                 "x-amz-tagging": "b=2"}))
+    got = urllib.request.urlopen(f"{base}/tagcp/dst2?tagging").read()
+    assert b"<Key>b</Key>" in got and b"<Key>a</Key>" not in got
+    # bucket-level GET ?tagging answers NoSuchTagSet, not a listing
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{base}/tagcp?tagging")
+    assert ei.value.code == 404
+    assert b"NoSuchTagSet" in ei.value.read()

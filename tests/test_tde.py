@@ -206,3 +206,16 @@ def test_kms_decrypt_bound_to_bucket(cluster):
         om.kms_decrypt("ev", "plain", bundle)
     # the owning bucket still unwraps
     assert om.kms_decrypt("ev", "enc", bundle)
+
+
+def test_encrypted_key_readable_through_snapshot(cluster):
+    """Snapshots capture the encryption bundle with the key row, so
+    .snapshot reads decrypt like live reads — and stay readable after
+    the live key is overwritten."""
+    b = cluster.client().get_volume("ev").get_bucket("enc")
+    v1 = _payload(20, 12_000)
+    b.write_key("snapk", v1)
+    cluster.om.create_snapshot("ev", "enc", "s1")
+    b.write_key("snapk", _payload(21, 12_000))  # overwrite live
+    got = b.read_key(".snapshot/s1/snapk")
+    assert np.array_equal(got, v1)
