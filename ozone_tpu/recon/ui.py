@@ -159,6 +159,32 @@ RECON_INDEX_HTML = """<!doctype html>
       <tbody></tbody>
     </table>
   </details>
+
+  <h2>Namespace du</h2>
+  <div class="sub">recursive totals from the delta-fed NSSummary index;
+    click a row to drill in</div>
+  <div class="sub" id="du-path"></div>
+  <table id="du">
+    <thead><tr><th>path</th><th>total files</th><th>total bytes</th>
+    </tr></thead>
+    <tbody></tbody>
+  </table>
+
+  <h2>OM table insights</h2>
+  <div class="tiles" id="insight-tiles"></div>
+  <details><summary>open keys (oldest first)</summary>
+    <table id="open-keys">
+      <thead><tr><th>key</th><th>age (s)</th><th>hsync</th></tr></thead>
+      <tbody></tbody>
+    </table>
+  </details>
+
+  <h2>Unhealthy containers</h2>
+  <table id="unhealthy">
+    <thead><tr><th>container</th><th>states</th><th>replicas</th>
+      <th>racks used/expected</th></tr></thead>
+    <tbody></tbody>
+  </table>
 </div>
 <script>
 // state -> reserved status palette; always icon(dot)+label, never color alone
@@ -246,11 +272,59 @@ async function refresh() {
     document.querySelector("#sizes-table tbody").innerHTML = entries
       .map(([k, v]) =>
         `<tr><td>${esc(k)}</td><td>${esc(v)}</td></tr>`).join("");
+    await refreshDu(duPath);
+    const ti = await (await fetch("/api/insights/tables")).json();
+    document.getElementById("insight-tiles").innerHTML =
+      Object.entries(ti).filter(([, v]) => v > 0)
+        .map(([k, v]) => tile(k, v)).join("") || tile("tables", "empty");
+    const ok = await (await fetch("/api/insights/open_keys")).json();
+    document.querySelector("#open-keys tbody").innerHTML = ok
+      .map(r => `<tr><td>${esc(r.key)}</td><td>${esc(r.age_s)}</td>` +
+                `<td>${r.hsync ? "yes" : ""}</td></tr>`).join("");
+    const uh = await (await fetch("/api/containers/unhealthy")).json();
+    document.querySelector("#unhealthy tbody").innerHTML = uh
+      .map(r => `<tr><td>${esc(r.container)}</td>` +
+                `<td>${esc((r.states || []).join(", "))}</td>` +
+                `<td>${esc(r.actual)}/${esc(r.expected)}</td>` +
+                `<td>${esc(r.racks_used)}/${esc(r.racks_expected)}` +
+                `</td></tr>`).join("") ||
+      '<tr><td colspan="4">all containers healthy</td></tr>';
   } catch (e) {
     const ts = document.getElementById("ts");
     ts.innerHTML = '<span class="err"></span>';
     ts.firstChild.textContent = "failed to load: " + e;
   }
+}
+// du drill-down: click rows to descend, the header crumb to reset
+let duPath = "/";
+async function refreshDu(p) {
+  const res = await fetch(
+      "/api/nssummary?path=" + encodeURIComponent(p));
+  if (p !== duPath) return;  // a newer navigation superseded this one
+  if (!res.ok) {
+    // the path vanished (bucket/dir deleted): reset to the root view
+    // instead of rendering a dead path as an empty-but-healthy du
+    if (p !== "/") { duPath = "/"; return refreshDu("/"); }
+    document.getElementById("du-path").textContent =
+        "du unavailable (" + res.status + ")";
+    return;
+  }
+  const du = await res.json();
+  if (p !== duPath) return;
+  const crumb = document.getElementById("du-path");
+  crumb.innerHTML = `<a href="#" id="du-root">/</a> ${esc(p)} &mdash; ` +
+      `${esc(du.total_files ?? 0)} files, ` +
+      `${fmtBytes(du.total_bytes ?? 0)}`;
+  crumb.querySelector("#du-root").onclick =
+      () => { duPath = "/"; refreshDu("/"); return false; };
+  const rows = (du.children || []);
+  document.querySelector("#du tbody").innerHTML = rows.map(c =>
+    `<tr data-p="${esc(c.path)}" style="cursor:pointer">` +
+    `<td>${esc(c.path)}</td><td>${esc(c.total_files)}</td>` +
+    `<td>${fmtBytes(c.total_bytes)}</td></tr>`).join("") ||
+    '<tr><td colspan="3">no children</td></tr>';
+  for (const tr of document.querySelectorAll("#du tbody tr[data-p]"))
+    tr.onclick = () => { duPath = tr.dataset.p; refreshDu(duPath); };
 }
 refresh();
 setInterval(refresh, 10000);

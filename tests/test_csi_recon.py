@@ -323,3 +323,36 @@ def test_unhealthy_detail_under_replication(cluster):
     finally:
         c.replicas[dn] = saved
         c.state = prev_state
+
+
+def test_recon_ui_contract(cluster, tmp_path):
+    """The dashboard's JS contract holds: every /api endpoint the page
+    fetches answers 200, and every DOM id the script addresses exists
+    in the served HTML (no headless browser in CI, so the contract is
+    pinned structurally)."""
+    import re
+
+    recon = ReconServer(cluster.om, cluster.scm,
+                        db_path=tmp_path / "ui.db")
+    recon.start()
+    try:
+        html = urllib.request.urlopen(
+            f"http://{recon.address}/").read().decode()
+        urls = sorted(set(re.findall(
+            r'fetch\(\s*"(/api/[^"?]+)', html)))
+        assert any("nssummary" in u for u in urls), urls
+        assert urls, "UI fetches nothing?"
+        for u in urls:
+            full = u + ("?path=/" if "nssummary" in u else "")
+            assert urllib.request.urlopen(
+                f"http://{recon.address}{full}").status == 200, u
+        ids = set(re.findall(r'getElementById\("([^"]+)"\)', html))
+        ids |= {m.split(" ")[0] for m in
+                re.findall(r'querySelector\("#([^" ]+)', html)}
+        missing = [i for i in ids
+                   if f'id="{i}"' not in html and i != "du-root"]
+        assert not missing, missing
+        for o, c in ("{}", "()", "[]"):
+            assert html.count(o) >= html.count(c) - 2  # sanity only
+    finally:
+        recon.stop()
