@@ -433,6 +433,107 @@ class RootedOzoneFileSystem:
             raise IsADirectoryError(path)
         return self._bucket_fs(vol, bkt), rest
 
+    # ------------------------------------------------------------- trash
+    #: per-bucket trash root (the reference's getTrashRoot:
+    #: /<vol>/<bucket>/.Trash/<user>; deletes move under Current, the
+    #: emptier rotates Current into timestamped checkpoints and purges
+    #: checkpoints older than the interval — TrashPolicyOzone)
+    TRASH = ".Trash"
+
+    def trash_delete(self, path: str, user: str = "anonymous",
+                     recursive: bool = True) -> str:
+        """Move a file/dir into the bucket trash instead of deleting
+        (fs -rm without -skipTrash). Returns the trash path."""
+        vol, bkt, rest = self._resolve(path)
+        if not (vol and bkt and rest):
+            raise OSError("only bucket contents can be trashed")
+        user = user or "anonymous"  # blank would nest under a
+        # pseudo-user the emptier can never parse
+        if rest == self.TRASH or rest.startswith(self.TRASH + "/"):
+            # already in trash: a second delete is permanent. Exact
+            # component match only — a user dir NAMED ".Trash-backup"
+            # must still be trashable, not silently destroyed.
+            self.delete(path, recursive=True)
+            return ""
+        fs = self._bucket_fs(vol, bkt)
+        st = fs.get_file_status(rest)
+        if st.is_dir and not recursive and fs.list_status(rest):
+            # the non-recursive safety guard must hold on the trash
+            # path too, or skiptrash=false silently bypasses it
+            raise OSError(f"directory {path} not empty")
+        dst = f"{self.TRASH}/{user}/Current/{rest}"
+        # a prior trashed entry at the same path is displaced (the
+        # reference appends a numeric suffix; timestamped checkpoints
+        # make collisions rare — keep last-in semantics per Current)
+        if fs.exists(dst):
+            fs.delete(dst, recursive=True)
+        fs.mkdirs("/".join(dst.split("/")[:-1]))
+        fs.rename(rest, dst)
+        return f"/{vol}/{bkt}/{dst}"
+
+    def trash_checkpoint(self,
+                         user: Optional[str] = None) -> list[str]:
+        """Rotate Current into a timestamped checkpoint
+        (Trash.checkpoint) for `user`, or for EVERY user with trash
+        when None (the emptier covers all principals); returns the
+        checkpoint paths created."""
+        out = []
+        stamp = time.strftime("%y%m%d%H%M%S")
+        for v in self.client.om.list_volumes():
+            for b in self.client.om.list_buckets(v["name"]):
+                fs = self._bucket_fs(v["name"], b["name"])
+                if user is not None:
+                    users = [user]
+                else:
+                    try:
+                        users = [u.path.rpartition("/")[2]
+                                 for u in fs.list_status(self.TRASH)]
+                    except FileNotFoundError:
+                        continue
+                for u in users:
+                    cur = f"{self.TRASH}/{u}/Current"
+                    if not fs.exists(cur):
+                        continue
+                    dst = f"{self.TRASH}/{u}/{stamp}"
+                    n = 0
+                    while fs.exists(dst):  # two rotations in a second
+                        n += 1
+                        dst = f"{self.TRASH}/{u}/{stamp}-{n}"
+                    fs.rename(cur, dst)
+                    out.append(f"/{v['name']}/{b['name']}/{dst}")
+        return out
+
+    def trash_expunge(self, older_than_s: float,
+                      now: Optional[float] = None) -> list[str]:
+        """Purge trash checkpoints older than the interval (the
+        TrashPolicyOzone emptier). Checkpoint age comes from its
+        timestamp name; Current is never purged here."""
+        purged = []
+        now = now if now is not None else time.time()
+        for v in self.client.om.list_volumes():
+            for b in self.client.om.list_buckets(v["name"]):
+                fs = self._bucket_fs(v["name"], b["name"])
+                troot = self.TRASH
+                try:
+                    users = fs.list_status(troot)
+                except FileNotFoundError:
+                    continue
+                for u in users:
+                    for cp in fs.list_status(u.path):
+                        name = cp.path.rpartition("/")[2]
+                        if name == "Current":
+                            continue
+                        try:
+                            ts = time.mktime(time.strptime(
+                                name.partition("-")[0], "%y%m%d%H%M%S"))
+                        except ValueError:
+                            continue
+                        if now - ts >= older_than_s:
+                            fs.delete(cp.path, recursive=True)
+                            purged.append(
+                                f"/{v['name']}/{b['name']}/{cp.path}")
+        return purged
+
     def set_attrs(self, path: str, attrs: dict) -> None:
         vol, bkt, rest = self._resolve(path)
         if vol and bkt and not rest:

@@ -57,8 +57,16 @@ def _status_json(st: FileStatus, suffix_only: bool = False) -> dict:
 
 class HttpFSGateway:
     def __init__(self, client, host: str = "127.0.0.1", port: int = 0,
-                 replication: Optional[str] = None):
+                 replication: Optional[str] = None,
+                 trash_interval_s: Optional[float] = None):
         self.fs = RootedOzoneFileSystem(client, replication=replication)
+        #: trash emptier cadence (TrashPolicyOzone's fs.trash.interval):
+        #: every interval, Current rotates into a checkpoint and
+        #: checkpoints older than the interval are purged. None = the
+        #: operator runs trash_checkpoint/trash_expunge manually.
+        self.trash_interval_s = trash_interval_s
+        self._trash_stop = threading.Event()
+        self._trash_thread: Optional[threading.Thread] = None
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -112,8 +120,29 @@ class HttpFSGateway:
             target=self._httpd.serve_forever, name="httpfs", daemon=True
         )
         self._thread.start()
+        if self.trash_interval_s:
+            self._trash_thread = threading.Thread(
+                target=self._trash_loop, name="trash-emptier",
+                daemon=True)
+            self._trash_thread.start()
+
+    def run_trash_emptier_once(self) -> list[str]:
+        """One emptier tick (the loop body; tests drive this): rotate
+        Current, purge checkpoints past the interval."""
+        self.fs.trash_checkpoint()
+        return self.fs.trash_expunge(self.trash_interval_s or 0)
+
+    def _trash_loop(self) -> None:
+        while not self._trash_stop.wait(self.trash_interval_s):
+            try:
+                self.run_trash_emptier_once()
+            except Exception:
+                log.exception("trash emptier tick failed; will retry")
 
     def stop(self) -> None:
+        self._trash_stop.set()
+        if self._trash_thread:
+            self._trash_thread.join(timeout=2.0)
         self._httpd.shutdown()
         self._httpd.server_close()
 
@@ -301,6 +330,14 @@ class HttpFSGateway:
 
     # ----------------------------------------------------------------- DELETE
     def _op_delete_delete(self, h, path: str, q) -> None:
+        if q.get("skiptrash", ["true"])[0] == "false":
+            # fs -rm semantics without -skipTrash: move into the bucket
+            # trash (TrashPolicyOzone); the emptier purges checkpoints
+            dst = self.fs.trash_delete(
+                path, user=q.get("user.name", ["anonymous"])[0],
+                recursive=q.get("recursive", ["false"])[0] == "true")
+            h._json(200, {"boolean": True, "trashPath": dst})
+            return
         recursive = q.get("recursive", ["false"])[0] == "true"
         ok = self.fs.delete(path, recursive=recursive)
         h._json(200, {"boolean": bool(ok)})

@@ -594,7 +594,8 @@ def cmd_httpfs(args) -> int:
 
     logging.basicConfig(level=logging.INFO)
     gw = HttpFSGateway(_client(args), port=args.port,
-                       replication=args.replication)
+                       replication=args.replication,
+                       trash_interval_s=args.trash_interval or None)
     gw.start()
     print(f"httpfs gateway serving on {gw.address}, om={args.om}")
     return _serve(gw.stop)
@@ -890,6 +891,9 @@ def build_parser() -> argparse.ArgumentParser:
     hf.add_argument("--port", type=int, default=14000)
     hf.add_argument("--replication", default=None,
                     help="replication for implicitly created buckets")
+    hf.add_argument("--trash-interval", type=float, default=0.0,
+                    help="fs.trash.interval seconds: rotate + purge "
+                         "trash checkpoints on this cadence (0 = off)")
     hf.set_defaults(fn=cmd_httpfs)
 
     csi = sub.add_parser("csi", help="run the CSI driver daemon")

@@ -293,3 +293,73 @@ def test_webhdfs_malformed_numeric_params_400(hfs):
         _req(hfs, "PUT", "/wv/wb/num/f", op="SETTIMES",
              modificationtime="xyz")
     assert ei.value.code == 400
+
+
+def test_trash_delete_checkpoint_expunge(ofs):
+    """FS trash (TrashPolicyOzone analog): deletes move under
+    /<vol>/<bkt>/.Trash/<user>/Current, checkpoints rotate Current by
+    timestamp, and the emptier purges checkpoints past the interval
+    while leaving Current alone."""
+    import time
+
+    ofs.create("/vol1/bkt1/t/doomed.txt", b"keep me a while")
+    tp = ofs.trash_delete("/vol1/bkt1/t/doomed.txt", user="alice")
+    assert tp == "/vol1/bkt1/.Trash/alice/Current/t/doomed.txt"
+    assert not ofs.exists("/vol1/bkt1/t/doomed.txt")
+    with ofs.open(tp) as f:
+        assert f.read() == b"keep me a while"
+    # rotate Current into a timestamped checkpoint
+    cps = ofs.trash_checkpoint(user="alice")
+    assert len(cps) == 1 and "/Current" not in cps[0]
+    assert not ofs.exists("/vol1/bkt1/.Trash/alice/Current")
+    # not old enough: nothing purged
+    assert ofs.trash_expunge(older_than_s=3600) == []
+    assert ofs.exists(cps[0])
+    # past the interval (simulated clock): checkpoint purged
+    purged = ofs.trash_expunge(older_than_s=3600,
+                               now=time.time() + 7200)
+    assert purged == cps
+    assert not ofs.exists(cps[0])
+    # deleting something already IN trash is permanent
+    ofs.create("/vol1/bkt1/t2/x", b"x")
+    tp2 = ofs.trash_delete("/vol1/bkt1/t2/x")
+    assert ofs.trash_delete(tp2) == ""
+    assert not ofs.exists(tp2)
+
+
+def test_webhdfs_delete_to_trash(hfs):
+    urllib.request.urlopen(urllib.request.Request(
+        _url(hfs, "/wv/wb/tr2/f", op="CREATE", data="true"),
+        data=b"trash-bytes", method="PUT"))
+    r = _req(hfs, "DELETE", "/wv/wb/tr2/f", op="DELETE",
+             skiptrash="false", **{"user.name": "bob"})
+    out = json.load(r)
+    assert out["boolean"] is True
+    assert out["trashPath"] == "/wv/wb/.Trash/bob/Current/tr2/f"
+    got = _req(hfs, "GET", out["trashPath"], op="OPEN").read()
+    assert got == b"trash-bytes"
+
+
+def test_trash_guards_and_emptier(cluster, ofs):
+    """Non-recursive trash of a non-empty dir keeps the safety guard;
+    files named LIKE .Trash are still trashable; the gateway emptier
+    tick rotates + purges for every user."""
+    import urllib.error
+
+    ofs.create("/vol1/bkt1/g/one", b"1")
+    with pytest.raises(OSError):
+        ofs.trash_delete("/vol1/bkt1/g", recursive=False)
+    # a sibling whose name merely starts with .Trash is NOT in-trash
+    ofs.create("/vol1/bkt1/.Trash-backup/x", b"x")
+    tp = ofs.trash_delete("/vol1/bkt1/.Trash-backup/x", user="u1")
+    assert ofs.exists(tp)
+    # emptier tick on the gateway covers every user's trash
+    ofs.trash_delete("/vol1/bkt1/g", user="u2", recursive=True)
+    gw = HttpFSGateway(cluster.client(), replication=EC,
+                       trash_interval_s=0.0)
+    cps = gw.fs.trash_checkpoint()
+    assert any("/u1/" in c for c in cps)
+    assert any("/u2/" in c for c in cps)
+    import time as _time
+    purged = gw.fs.trash_expunge(3600, now=_time.time() + 7200)
+    assert set(purged) >= set(cps)
