@@ -90,6 +90,10 @@ class DatanodeDaemon:
                                         secret=enrollment_secret),
                 trust_fn=trust)
         self.server = RpcServer(host, port, tls=self.tls)
+        if self.tls is not None:
+            # revocation: refuse peers whose cert serial is on the CRL
+            # (learned via the MAC'd trust refresh)
+            self.server.crl_provider = self.tls.crl
         # datapath token verification (BlockTokenVerifier on the
         # HddsDispatcher): starts disabled; the SCM's register/heartbeat
         # responses deliver the secret keys and flip it on
@@ -538,7 +542,41 @@ class ScmOmDaemon:
                 "enrollment_secret: open CSR signing would let any "
                 "caller enroll and mint admin tokens")
         self.server = RpcServer(host, port, tls=self.tls)
+        if self.tls is not None:
+            self.server.crl_provider = self.tls.crl
         self.scm_service = ScmGrpcService(self.scm, self.server)
+        if self.ca is not None:
+            # this replica hosts the cluster CA: serve cert lifecycle
+            # admin ops (list issued, revoke by serial)
+            def _cert_ops(op, target):
+                if op == "cert-list":
+                    return self.ca.issued()
+                try:
+                    serial = int(str(target), 0)
+                except (TypeError, ValueError):
+                    raise StorageError("INVALID",
+                                       f"bad serial {target!r}")
+                try:
+                    self.ca.revoke(serial)
+                except ValueError as e:
+                    raise StorageError("INVALID", str(e))
+                # our own server must enforce the new CRL immediately;
+                # peers learn it on their next trust refresh
+                if self.cert_renewal is not None:
+                    self.cert_renewal.check_once()
+                out = {"revoked": serial,
+                       "crl": sorted(self.ca.crl())}
+                if enrollment_secret is None:
+                    # without the bootstrap secret, peers never run the
+                    # (MAC-authenticated) recurring trust refresh — the
+                    # CRL only reaches them at their next re-enrollment
+                    out["warning"] = (
+                        "no enrollment secret: datanodes cannot fetch "
+                        "CRL updates; revocation takes effect on their "
+                        "next renewal, not immediately")
+                return out
+
+            self.scm_service.cert_ops = _cert_ops
         if insecure_secrets:
             self.scm_service.distribute_secrets = True
         # RatisPipelineProvider analog: a freshly placed RATIS pipeline is

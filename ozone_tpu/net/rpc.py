@@ -22,6 +22,9 @@ from ozone_tpu.storage.ids import StorageError
 
 log = logging.getLogger(__name__)
 
+#: pem -> serial parse cache for revocation checks (bounded)
+_SERIAL_CACHE: dict = {}
+
 Method = Callable[[bytes], bytes]
 
 
@@ -33,16 +36,51 @@ StreamMethod = Callable[..., bytes]
 class _GenericHandler(grpc.GenericRpcHandler):
     def __init__(self, methods: dict[str, Method],
                  stream_methods: Optional[dict[str, StreamMethod]] = None,
-                 server_stream_methods: Optional[dict[str, Method]] = None):
+                 server_stream_methods: Optional[dict[str, Method]] = None,
+                 server: Optional["RpcServer"] = None):
         self._methods = methods
         self._stream_methods = stream_methods or {}
         #: unary request -> iterator of byte frames (the replication
         #: download shape: large payloads never buffer in one message)
         self._server_stream_methods = server_stream_methods or {}
+        #: owning server: read at call time for its live crl_provider
+        self._server = server
 
-    @staticmethod
-    def _guard(fn, method_name):
+    def _check_revoked(self, context) -> None:
+        """Certificate revocation (the CRL the reference distributes
+        from the SCM CA): a peer presenting a revoked-but-unexpired
+        cert is refused at the application layer — the TLS handshake
+        itself cannot consult a live CRL. Aborts UNAUTHENTICATED."""
+        srv = self._server
+        provider = getattr(srv, "crl_provider", None) if srv else None
+        if provider is None:
+            return
+        crl = provider()
+        if not crl:
+            return
+        pems = dict(context.auth_context()).get("x509_pem_cert") or []
+        if not pems:
+            return
+        pem = bytes(pems[0])
+        serial = _SERIAL_CACHE.get(pem)
+        if serial is None:
+            from cryptography import x509 as _x509
+
+            serial = _x509.load_pem_x509_certificate(pem).serial_number
+            if len(_SERIAL_CACHE) > 256:
+                _SERIAL_CACHE.clear()
+            _SERIAL_CACHE[pem] = serial
+        if serial in crl:
+            context.abort(
+                grpc.StatusCode.UNAUTHENTICATED,
+                json.dumps({"code": "CERTIFICATE_REVOKED",
+                            "message": f"serial {serial} is revoked"}))
+
+    def _guard(self, fn, method_name):
         def wrapped(request, context: grpc.ServicerContext) -> bytes:
+            # before the try: context.abort raises to terminate, and
+            # the generic except below must not re-wrap it as INTERNAL
+            self._check_revoked(context)
             from ozone_tpu.utils.tracing import Tracer
 
             remote_ctx = dict(context.invocation_metadata()).get("x-trace-id")
@@ -66,12 +104,12 @@ class _GenericHandler(grpc.GenericRpcHandler):
 
         return wrapped
 
-    @staticmethod
-    def _guard_stream(fn, method_name):
+    def _guard_stream(self, fn, method_name):
         """Guard for server-streaming handlers: exceptions fire during
         ITERATION of the response generator, so the try must wrap the
         yield loop, not just the call."""
         def wrapped(request, context: grpc.ServicerContext):
+            self._check_revoked(context)
             try:
                 yield from fn(request)
             except StorageError as e:
@@ -133,6 +171,9 @@ class RpcServer:
             self.port = self._server.add_insecure_port(f"{host}:{port}")
         self.host = host
         self.tls_enabled = tls is not None
+        #: callable() -> set of revoked cert serials (CRL); None = no
+        #: revocation checking. Read per-request so updates apply live.
+        self.crl_provider = None
 
     @property
     def address(self) -> str:
@@ -154,7 +195,7 @@ class RpcServer:
             for name, fn in (server_stream_methods or {}).items()
         }
         self._server.add_generic_rpc_handlers(
-            (_GenericHandler(full, sfull, ssfull),))
+            (_GenericHandler(full, sfull, ssfull, server=self),))
 
     def start(self) -> None:
         self._server.start()

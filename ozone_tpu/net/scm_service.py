@@ -116,6 +116,9 @@ class ScmGrpcService:
         #: HA hook: ring membership changes (callable(op, target) ->
         #: members dict); None = not an HA deployment
         self.ring_ops = None
+        #: CA lifecycle hook (callable(op, target)); set by the daemon
+        #: that hosts the cluster CA (cert-list / cert-revoke)
+        self.cert_ops = None
         #: HA hook: current ring replica addresses, shipped on
         #: register/heartbeat responses so datanodes follow an online-
         #: grown ring without reconfiguration (a freshly added replica
@@ -229,6 +232,14 @@ class ScmGrpcService:
             if self.gate is not None:
                 self.gate()
             return wire.pack({"members": self.ring_ops(op, target)})
+        if op in ("cert-list", "cert-revoke"):
+            # CA lifecycle ops: answered by the replica hosting the
+            # root CA (daemon wires cert_ops when it owns one)
+            if self.cert_ops is None:
+                raise StorageError(
+                    "UNSUPPORTED_REQUEST",
+                    "this replica does not host the cluster CA")
+            return wire.pack({"result": self.cert_ops(op, target)})
         if op in self._MUTATING_ADMIN:
             if self.gate is not None:
                 self.gate()

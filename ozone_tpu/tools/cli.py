@@ -384,6 +384,18 @@ def cmd_admin(args) -> int:
         else:
             return usage(f"unknown ring verb {verb!r} "
                          "(expected add <id>=<addr>|remove <id>)")
+    elif subject == "cert":
+        # CA lifecycle (ozone admin cert list/revoke analog): answered
+        # by the replica hosting the cluster CA
+        if verb in (None, "list"):
+            _emit(scm.admin("cert-list", None))
+        elif verb == "revoke":
+            if not target:
+                return usage("cert revoke needs the cert serial")
+            _emit(scm.admin("cert-revoke", target))
+        else:
+            return usage(f"unknown cert verb {verb!r} "
+                         "(expected list|revoke <serial>)")
     elif subject == "kms":
         # TDE master-key authority (ozone admin + KMS keyadmin analog)
         from ozone_tpu.net.om_service import GrpcOmClient
@@ -824,7 +836,7 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("subject", choices=[
         "safemode", "datanode", "status", "pipeline", "container",
         "balancer", "replicationmanager", "om", "finalizeupgrade",
-        "ring", "kms",
+        "ring", "kms", "cert",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import datetime
 import ipaddress
+import json
+import threading
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional
@@ -79,6 +81,10 @@ class CertificateAuthority:
         self.root_dir.mkdir(parents=True, exist_ok=True)
         self.valid_days = valid_days
         self.cluster_id = cluster_id
+        #: serializes issued.json / crl.json read-modify-writes: the
+        #: enrollment endpoint signs from a 16-worker thread pool, and
+        #: a lost issuance record would make that cert unrevocable
+        self._ledger_lock = threading.Lock()
         key_path = self.root_dir / "ca.key.pem"
         cert_path = self.root_dir / "ca.cert.pem"
         gen_path = self.root_dir / "generation"
@@ -195,7 +201,54 @@ class CertificateAuthority:
         except x509.ExtensionNotFound:
             pass
         cert = builder.sign(self.key, hashes.SHA256())
+        self._log_issued(cert, csr.subject)
         return cert.public_bytes(serialization.Encoding.PEM)
+
+    # --------------------------------------------------- issued certs / CRL
+    def _issued_path(self):
+        return self.root_dir / "issued.json"
+
+    def _crl_path(self):
+        return self.root_dir / "crl.json"
+
+    def _log_issued(self, cert: x509.Certificate, subject) -> None:
+        with self._ledger_lock:
+            p = self._issued_path()
+            rows = json.loads(p.read_text()) if p.exists() else []
+            rows.append({
+                "serial": cert.serial_number,
+                "subject": subject.rfc4514_string(),
+                "not_after": cert.not_valid_after_utc.isoformat(),
+            })
+            p.write_text(json.dumps(rows))
+
+    def issued(self) -> list[dict]:
+        p = self._issued_path()
+        rows = json.loads(p.read_text()) if p.exists() else []
+        crl = self.crl()
+        for r in rows:
+            r["revoked"] = r["serial"] in crl
+        return rows
+
+    def crl(self) -> set:
+        p = self._crl_path()
+        return set(json.loads(p.read_text())) if p.exists() else set()
+
+    def revoke(self, serial: int) -> None:
+        """Add a leaf serial to the CRL (the reference's SCM CA cert
+        revocation). Distribution rides the MAC'd trust-refresh
+        responses; enforcement happens per-RPC on every server that
+        installed the CRL — revocation takes effect without waiting for
+        the cert to expire."""
+        with self._ledger_lock:
+            p = self._issued_path()
+            rows = json.loads(p.read_text()) if p.exists() else []
+            if not any(r["serial"] == serial for r in rows):
+                raise ValueError(
+                    f"serial {serial} was never issued here")
+            crl = self.crl()
+            crl.add(serial)
+            self._crl_path().write_text(json.dumps(sorted(crl)))
 
 
 class CertificateClient:
@@ -238,9 +291,27 @@ class CertificateClient:
         )
         return csr.public_bytes(serialization.Encoding.PEM)
 
-    def install(self, cert_pem: bytes, ca_pem: bytes) -> None:
+    def install(self, cert_pem: bytes, ca_pem: bytes,
+                crl: Optional[list] = None) -> None:
         self.cert_path.write_bytes(cert_pem)
         self.ca_path.write_bytes(ca_pem)
+        if crl is not None:
+            self._install_crl(crl)
+
+    @property
+    def crl_path(self):
+        return self.role_dir / "crl.json"
+
+    def crl(self) -> set:
+        p = self.crl_path
+        return set(json.loads(p.read_text())) if p.exists() else set()
+
+    def _install_crl(self, crl: list) -> bool:
+        new = set(crl)
+        if new == self.crl():
+            return False
+        self.crl_path.write_text(json.dumps(sorted(new)))
+        return True
 
     def enroll(self, ca: CertificateAuthority) -> None:
         """In-process enrollment (daemons co-located with the SCM CA or
@@ -248,7 +319,7 @@ class CertificateClient:
         RPC and installs the response the same way."""
         self.install(ca.sign_csr(self.make_csr(),
                                  valid_days=self.valid_days),
-                     ca.root_pem)
+                     ca.root_pem, crl=sorted(ca.crl()))
 
     @staticmethod
     def _require_mac(secret: Optional[str], domain: bytes,
@@ -268,8 +339,9 @@ class CertificateClient:
                 "enrollment response failed authentication (missing or "
                 "bad response MAC) — possible MITM on the CSR channel")
 
-    def _sign_csr_remote(self, address: str, csr: bytes,
-                         secret: Optional[str]) -> tuple[bytes, bytes]:
+    def _sign_csr_remote(
+            self, address: str, csr: bytes,
+            secret: Optional[str]) -> tuple[bytes, bytes, list]:
         from ozone_tpu.net import wire
         from ozone_tpu.net.rpc import RpcChannel
 
@@ -282,9 +354,12 @@ class CertificateClient:
         finally:
             ch.close()
         cert, ca_pem = m["cert"].encode(), m["ca"].encode()
-        self._require_mac(secret, b"enroll:", csr + cert + ca_pem,
-                          m.get("mac"))
-        return cert, ca_pem
+        crl = m.get("crl", [])
+        self._require_mac(
+            secret, b"enroll:",
+            csr + cert + ca_pem + json.dumps(sorted(crl)).encode(),
+            m.get("mac"))
+        return cert, ca_pem, crl
 
     def enroll_remote(self, address: str,
                       secret: Optional[str] = None) -> None:
@@ -294,8 +369,8 @@ class CertificateClient:
         the shared bootstrap secret both gates signing server-side and
         authenticates the response client-side)."""
         csr = self.make_csr()
-        cert, ca_pem = self._sign_csr_remote(address, csr, secret)
-        self.install(cert, ca_pem)
+        cert, ca_pem, crl = self._sign_csr_remote(address, csr, secret)
+        self.install(cert, ca_pem, crl=crl)
 
     @property
     def enrolled(self) -> bool:
@@ -342,6 +417,7 @@ class CertificateClient:
         cert = ca.sign_csr(self.make_csr(key=new_key),
                            valid_days=self.valid_days)
         self._commit_renewal(new_key, cert, ca.root_pem)
+        self._install_crl(sorted(ca.crl()))
 
     def renew_remote(self, address: str,
                      secret: Optional[str] = None) -> None:
@@ -350,15 +426,16 @@ class CertificateClient:
         until the response authenticates)."""
         new_key = _new_key()
         csr = self.make_csr(key=new_key)
-        cert, ca_pem = self._sign_csr_remote(address, csr, secret)
+        cert, ca_pem, crl = self._sign_csr_remote(address, csr, secret)
         self._commit_renewal(new_key, cert, ca_pem)
+        self._install_crl(crl)
 
     def refresh_trust(self, ca: CertificateAuthority) -> bool:
-        """Adopt the CA's CURRENT trust bundle (phase 1 of a root
-        rotation: every party must trust the new root BEFORE any leaf
-        is issued from it, or mutual-TLS peers reject each other
-        mid-transition). Returns True when the bundle changed."""
-        return self._install_trust(ca.root_pem)
+        """Adopt the CA's CURRENT trust bundle + CRL (phase 1 of a root
+        rotation; revocations propagate the same way). Returns True
+        when either changed."""
+        crl_changed = self._install_crl(sorted(ca.crl()))
+        return self._install_trust(ca.root_pem) or crl_changed
 
     def refresh_trust_remote(self, address: str,
                              secret: Optional[str] = None) -> bool:
@@ -380,9 +457,14 @@ class CertificateClient:
         finally:
             ch.close()
         bundle = m["ca"].encode()
-        self._require_mac(secret, b"root:",
-                          nonce.encode() + bundle, m.get("mac"))
-        return self._install_trust(bundle)
+        crl = m.get("crl", [])
+        self._require_mac(
+            secret, b"root:",
+            nonce.encode() + bundle
+            + json.dumps(sorted(crl)).encode(),
+            m.get("mac"))
+        crl_changed = self._install_crl(crl)
+        return self._install_trust(bundle) or crl_changed
 
     def _install_trust(self, bundle: bytes) -> bool:
         if self.ca_path.exists() and self.ca_path.read_bytes() == bundle:
@@ -445,12 +527,16 @@ class EnrollmentService:
         csr = m["csr"].encode()
         cert = self.ca.sign_csr(csr, valid_days=self.leaf_valid_days)
         ca_pem = self.ca.root_pem
+        crl = sorted(self.ca.crl())
         # response authentication: the plaintext channel is only safe
         # because both sides can prove knowledge of the bootstrap secret
         return wire.pack({
             "cert": cert.decode(),
             "ca": ca_pem.decode(),
-            "mac": self._mac(b"enroll:", csr + cert + ca_pem),
+            "crl": crl,
+            "mac": self._mac(
+                b"enroll:",
+                csr + cert + ca_pem + json.dumps(crl).encode()),
         })
 
     def _root(self, req: bytes) -> bytes:
@@ -459,9 +545,13 @@ class EnrollmentService:
         m, _ = wire.unpack(req)
         nonce = str(m.get("nonce") or "")
         bundle = self.ca.root_pem
+        crl = sorted(self.ca.crl())
         return wire.pack({
             "ca": bundle.decode(),
-            "mac": self._mac(b"root:", nonce.encode() + bundle),
+            "crl": crl,
+            "mac": self._mac(
+                b"root:",
+                nonce.encode() + bundle + json.dumps(crl).encode()),
         })
 
 
@@ -477,6 +567,7 @@ class RotatingTls:
         self._client = client
         self._version = 0
         self._cached = client.tls()
+        self._crl = client.crl()
 
     @property
     def version(self) -> int:
@@ -486,9 +577,14 @@ class RotatingTls:
         return self._cached
 
     def reload(self) -> None:
-        """Re-read the PEMs after a renewal/rotation."""
+        """Re-read the PEMs + CRL after a renewal/rotation/revocation."""
         self._cached = self._client.tls()
+        self._crl = self._client.crl()
         self._version += 1
+
+    def crl(self) -> set:
+        """Revoked serials (live view for RpcServer.crl_provider)."""
+        return self._crl
 
     # --- grpc credential builders (same surface as TlsMaterial) ---
     def server_credentials(self, mutual: bool = True):

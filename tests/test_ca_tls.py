@@ -352,3 +352,61 @@ def test_double_root_rotation_refused(tmp_path):
     ca.retire_previous_root()
     ca.rotate_root()  # transition finished: next rotation allowed
     assert ca.generation == 2
+
+
+def test_cert_revocation_enforced_live(tmp_path):
+    """CRL lifecycle: the CA logs issued certs, revocation rides the
+    MAC'd trust refresh, and a server refuses a revoked-but-unexpired
+    peer per-RPC while other peers keep working — no waiting for
+    expiry, no restart."""
+    from ozone_tpu.utils.ca import EnrollmentService
+
+    ca = CertificateAuthority(tmp_path / "ca")
+    server_cc = CertificateClient(tmp_path / "srv", "datanode-srv")
+    good_cc = CertificateClient(tmp_path / "good", "client-good")
+    bad_cc = CertificateClient(tmp_path / "bad", "client-bad")
+    for cc in (server_cc, good_cc, bad_cc):
+        cc.enroll(ca)
+    issued = ca.issued()
+    assert len(issued) == 3 and not any(r["revoked"] for r in issued)
+    bad_serial = bad_cc.cert.serial_number
+    assert any(r["serial"] == bad_serial for r in issued)
+
+    rot = server_cc.rotating_tls()
+    srv = RpcServer(port=0, tls=rot, mutual=True)
+    srv.crl_provider = rot.crl
+    srv.add_service("Test", _echo_service())
+    srv.start()
+    try:
+        chb = RpcChannel(srv.address, tls=bad_cc.tls(),
+                         server_name="localhost")
+        assert chb.call("Test", "Echo", b"ok") == b"echo:ok"
+        # revoke + distribute (phase: trust refresh installs the CRL)
+        ca.revoke(bad_serial)
+        with pytest.raises(ValueError):
+            ca.revoke(12345)  # never issued here
+        assert server_cc.refresh_trust(ca) is True
+        rot.reload()
+        with pytest.raises(StorageError) as ei:
+            chb.call("Test", "Echo", b"again")
+        assert ei.value.code == "CERTIFICATE_REVOKED"
+        chb.close()
+        # an unrevoked peer is untouched
+        chg = RpcChannel(srv.address, tls=good_cc.tls(),
+                         server_name="localhost")
+        assert chg.call("Test", "Echo", b"fine") == b"echo:fine"
+        chg.close()
+    finally:
+        srv.stop()
+    # the CRL rides the MAC'd enrollment-plane responses
+    esrv = RpcServer(port=0)
+    EnrollmentService(ca, esrv, secret="s")
+    esrv.start()
+    try:
+        late = CertificateClient(tmp_path / "late", "client-late")
+        late.enroll_remote(esrv.address, secret="s")
+        assert bad_serial in late.crl()
+        assert late.refresh_trust_remote(esrv.address,
+                                         secret="s") is False
+    finally:
+        esrv.stop()
