@@ -53,8 +53,12 @@ class DatanodeDaemon:
         scan_interval_s: float = 300.0,
         ca_address: str | None = None,
         enrollment_secret: str | None = None,
+        num_volumes: int = 1,
+        volume_policy: str = "round-robin",
     ):
-        self.dn = Datanode(Path(root), dn_id=dn_id)
+        self.dn = Datanode(Path(root), dn_id=dn_id,
+                           num_volumes=num_volumes,
+                           volume_policy=volume_policy)
         # secure mode: enroll against the SCM CA's plaintext enrollment
         # endpoint, then run EVERYTHING (our server, SCM client, peer
         # datapath/raft channels) over mutual TLS — the reference's

@@ -38,9 +38,12 @@ from ozone_tpu.utils.metrics import MetricsRegistry
 class Datanode:
     """One datanode instance over a root directory of volumes."""
 
-    def __init__(self, root: Path, dn_id: str = "dn0", num_volumes: int = 1):
+    def __init__(self, root: Path, dn_id: str = "dn0",
+                 num_volumes: int = 1,
+                 volume_policy: str = "round-robin"):
         self.root = Path(root)
         self.id = dn_id
+        self.volume_policy = volume_policy
         self.volumes = [
             HddsVolume(self.root / f"vol{i}") for i in range(num_volumes)
         ]
@@ -52,8 +55,25 @@ class Datanode:
             for c in vol.load_containers():
                 self.containers.add(c)
 
-    # -- volume choice: round-robin (reference RoundRobinVolumeChoosingPolicy)
+    # -- volume choice (reference VolumeChoosingPolicy SPI):
+    # "round-robin" = RoundRobinVolumeChoosingPolicy (default),
+    # "capacity" = CapacityVolumeChoosingPolicy — new containers land
+    # on the least-used volume so disks fill evenly under skew
+    def _volume_used(self, vol: HddsVolume) -> int:
+        # volume identity via the shared VolumeDB handle — a path
+        # prefix test would alias vol1 with vol10..vol19
+        return sum(c.used_bytes() for c in self.containers
+                   if c.db is vol.db)
+
     def _choose_volume(self) -> HddsVolume:
+        if len(self.volumes) > 1 and self.volume_policy == "capacity":
+            # one pass over the containers, not one per volume
+            used = {id(v.db): 0 for v in self.volumes}
+            for c in self.containers:
+                k = id(c.db)
+                if k in used:
+                    used[k] += c.used_bytes()
+            return min(self.volumes, key=lambda v: used[id(v.db)])
         return self.volumes[next(self._rr) % len(self.volumes)]
 
     # -- container verbs --

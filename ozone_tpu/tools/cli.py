@@ -539,6 +539,8 @@ def cmd_datanode(args) -> int:
         scan_interval_s=args.scan_interval,
         ca_address=args.ca or None,
         enrollment_secret=args.enrollment_secret or None,
+        num_volumes=args.volumes,
+        volume_policy=args.volume_policy,
     )
     d.start()
     print(f"datanode {dn_id} serving on {d.address}, scm={args.scm}")
@@ -877,6 +879,12 @@ def build_parser() -> argparse.ArgumentParser:
     dn.add_argument("--id", default="")
     dn.add_argument("--port", type=int, default=0)
     dn.add_argument("--rack", default="/default-rack")
+    dn.add_argument("--volumes", type=int, default=1,
+                    help="storage volumes under --root (hdds.datanode"
+                         ".dir analog)")
+    dn.add_argument("--volume-policy", default="round-robin",
+                    choices=["round-robin", "capacity"],
+                    help="volume chooser for new containers")
     dn.add_argument("--scan-interval", type=float, default=300.0,
                     help="seconds between background container scrubs "
                          "(0 disables)")

@@ -147,3 +147,43 @@ def test_container_report(dn):
     dn.create_container(2, replica_index=1)
     rep = dn.container_report()
     assert {r["container_id"] for r in rep} == {1, 2}
+
+
+def test_capacity_volume_chooser(tmp_path):
+    """CapacityVolumeChoosingPolicy analog: with skewed volumes, new
+    containers land on the least-used one; round-robin stays default."""
+    import numpy as np
+
+    from ozone_tpu.storage.datanode import Datanode
+    from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    dn = Datanode(tmp_path / "dn", num_volumes=3,
+                  volume_policy="capacity")
+    data = np.ones(8192, np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 4096).compute(data)
+
+    def fill(cid, nblocks):
+        dn.create_container(cid)
+        for i in range(nblocks):
+            info = ChunkInfo("c0", 0, data.size, cs)
+            dn.write_chunk(BlockID(cid, i), info, data)
+            dn.put_block(BlockData(BlockID(cid, i), [info]))
+
+    # skew: first containers land round-robin-ish via capacity=0 ties,
+    # then load one volume heavily and confirm new containers avoid it
+    fill(1, 6)
+    heavy = next(v for v in dn.volumes
+                 if dn._volume_used(v) > 0)
+    # every subsequent empty-tie-broken container must avoid `heavy`
+    # (volume membership by shared VolumeDB identity, not path prefix)
+    for cid in (2, 3):
+        fill(cid, 1)
+        assert dn.containers.get(cid).db is not heavy.db, cid
+    # round-robin default unchanged
+    rr = Datanode(tmp_path / "dn2", num_volumes=2)
+    rr.create_container(10)
+    rr.create_container(11)
+    roots = {str(rr.containers.get(c).root)[:len(str(rr.volumes[0].root))]
+             for c in (10, 11)}
+    assert len(roots) == 2
