@@ -468,6 +468,16 @@ def cmd_freon(args) -> int:
             threads=args.threads,
             replication=args.replication or None,
         ).summary())
+    elif args.generator == "fsg":
+        _emit(freon.fsg(
+            _client(args), n_files=args.num, size=args.size,
+            threads=args.threads,
+            replication=args.replication or None).summary())
+    elif args.generator == "sdg":
+        # -t is deliberately not honored: the snapshot chain is ordered
+        _emit(freon.sdg(
+            _client(args), n_rounds=args.num, size=args.size,
+            replication=args.replication or None).summary())
     elif args.generator == "s3kg":
         _emit(freon.s3kg(
             args.endpoint, n_keys=args.num, size=args.size,
@@ -855,7 +865,7 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["ockg", "ockr", "ockv", "rawcoder", "omkg",
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
                              "dcv", "dsg", "hsg", "dnbp", "ralg",
-                             "fskg", "mpug", "s3kg"])
+                             "fskg", "mpug", "s3kg", "fsg", "sdg"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("-t", "--threads", type=int, default=4)

@@ -685,3 +685,71 @@ def s3kg(endpoint: str, n_keys: int = 100, size: int = 10 * 1024,
         return size * (2 if validate else 1)
 
     return BaseFreonGenerator("s3kg", n_keys, threads).run(op)
+
+
+def fsg(client, n_files: int = 50, size: int = 10 * 1024,
+        threads: int = 4, volume: str = "freon-vol",
+        bucket: str = "freon-ofs",
+        replication: Optional[str] = None) -> FreonReport:
+    """ofs filesystem generator (HadoopFsGenerator analog): each op is
+    a create + read-back through the RootedOzoneFileSystem adapter —
+    the path HttpFS and Hadoop-compatible workloads take."""
+    from ozone_tpu.gateway.fs import RootedOzoneFileSystem
+
+    fs = RootedOzoneFileSystem(client,
+                               replication=replication or "rs-6-3-1024k")
+    fs.mkdirs(f"/{volume}/{bucket}")
+    payload = bytes(np.random.default_rng(4).integers(
+        0, 256, size, dtype=np.uint8))
+
+    def op(i: int) -> int:
+        p = f"/{volume}/{bucket}/d{i % 8}/f{i}"
+        fs.create(p, payload)
+        with fs.open(p) as f:
+            got = f.read()
+        assert len(got) == size
+        return size * 2
+
+    return BaseFreonGenerator("fsg", n_files, threads).run(op)
+
+
+def sdg(client, n_rounds: int = 10, keys_per_round: int = 5,
+        size: int = 2048, volume: str = "freon-vol",
+        bucket: str = "freon-snap",
+        replication: Optional[str] = None) -> FreonReport:
+    """Snapshot-diff generator: each op writes a handful of keys,
+    snapshots, and diffs against the previous snapshot — timing the
+    incremental-diff path end to end. Single-threaded by design: round
+    i diffs against round i-1's snapshot, so concurrency would race
+    the chain. Snapshot names carry a per-run prefix so reruns against
+    a live cluster don't collide with earlier runs' snapshots."""
+    import uuid
+
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket,
+                                replication or "rs-6-3-1024k")
+    except Exception:
+        pass
+    b = client.get_volume(volume).get_bucket(bucket)
+    payload = np.random.default_rng(6).integers(0, 256, size,
+                                                dtype=np.uint8)
+    run = uuid.uuid4().hex[:8]
+
+    def op(i: int) -> int:
+        for k in range(keys_per_round):
+            b.write_key(f"{run}-r{i}-k{k}", payload)
+        client.om.create_snapshot(volume, bucket, f"{run}-s{i}")
+        if i > 0:
+            d = client.om.snapshot_diff(volume, bucket,
+                                        f"{run}-s{i - 1}",
+                                        f"{run}-s{i}")
+            added = set(d.get("added", []))
+            assert all(f"{run}-r{i}-k{k}" in added
+                       for k in range(keys_per_round)), d
+        return keys_per_round * int(payload.size)
+
+    return BaseFreonGenerator("sdg", n_rounds, threads=1).run(op)

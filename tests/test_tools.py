@@ -359,3 +359,18 @@ def test_freon_s3kg(cluster):
         assert s["throughput_mib_s"] >= 0
     finally:
         g.stop()
+
+
+def test_freon_fsg_and_sdg(cluster):
+    meta, oz = _oz(cluster)
+    rep = freon.fsg(oz, n_files=6, size=2000, threads=2,
+                    replication="RATIS/THREE")
+    assert rep.summary()["failures"] == 0
+    rep = freon.sdg(oz, n_rounds=3, keys_per_round=2,
+                    replication="RATIS/THREE")
+    s = rep.summary()
+    assert s["failures"] == 0 and s["ops"] == 3
+    # re-runnable: a second run must not collide with round 1 snapshots
+    rep2 = freon.sdg(oz, n_rounds=2, keys_per_round=1,
+                     replication="RATIS/THREE")
+    assert rep2.summary()["failures"] == 0
