@@ -94,6 +94,39 @@ def test_ec_key_over_grpc(cluster):
     assert np.array_equal(got2, data)
 
 
+
+
+def _await_replica_rebuild(meta, groups, victim_id,
+                           timeout_s: float = 20.0) -> None:
+    """Wait until every group's full replica-index set exists off the
+    victim (the reconstruction convergence condition both repair tests
+    share)."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if all(
+            {r.replica_index
+             for dn_id, r in
+             meta.scm.containers.get(g.container_id).replicas.items()
+             if dn_id != victim_id} == {1, 2, 3, 4, 5}
+            for g in groups
+        ):
+            return
+        time.sleep(0.2)
+    raise AssertionError("reconstruction did not complete in time")
+
+
+def _repoint_groups(meta, groups, victim_id) -> None:
+    """Point each group's unit slots at the post-repair replica homes.
+    NOTE: reads here bypass OM placement refresh on purpose — the OM
+    hands out the placement captured at write time; repair-aware reads
+    go through SCM container state, which is what this mimics."""
+    for g in groups:
+        c = meta.scm.containers.get(g.container_id)
+        for dn_id, r in c.replicas.items():
+            if r.replica_index and dn_id != victim_id:
+                g.pipeline.nodes[r.replica_index - 1] = dn_id
+
+
 def test_reconstruction_over_grpc(cluster):
     meta, dns = cluster
     oz = _client(meta)
@@ -121,31 +154,10 @@ def test_reconstruction_over_grpc(cluster):
     meta.scm.nodes.check_liveness()
 
     # wait for reconstruction driven by background loop + heartbeats
-    deadline = time.time() + 20
-    ok = False
-    while time.time() < deadline:
-        good = True
-        for g in groups:
-            c = meta.scm.containers.get(g.container_id)
-            present = {
-                r.replica_index
-                for dn_id, r in c.replicas.items()
-                if dn_id != victim_id
-            }
-            if present != {1, 2, 3, 4, 5}:
-                good = False
-        if good:
-            ok = True
-            break
-        time.sleep(0.2)
-    assert ok, "reconstruction did not complete in time"
+    _await_replica_rebuild(meta, groups, victim_id)
 
     # repoint groups at live replicas and verify bytes
-    for g in groups:
-        c = meta.scm.containers.get(g.container_id)
-        for dn_id, r in c.replicas.items():
-            if r.replica_index and dn_id != victim_id:
-                g.pipeline.nodes[r.replica_index - 1] = dn_id
+    _repoint_groups(meta, groups, victim_id)
     from ozone_tpu.client.ec_reader import ECBlockGroupReader
     from ozone_tpu.codec.api import CoderOptions
 
@@ -385,3 +397,50 @@ def test_hsync_and_recover_lease_over_grpc(cluster):
         h.close()
     assert ei.value.code == "KEY_NOT_FOUND"
     assert np.array_equal(b.read_key("k"), data[:20_000])
+
+
+def test_reconstruction_of_encrypted_key(cluster):
+    """TDE composes with EC repair: reconstruction operates on
+    ciphertext units (no DEK anywhere near the datanodes), and the
+    repaired key decrypts byte-exactly. Placement is repointed from
+    SCM container state like the sibling test — OM-served post-repair
+    placement is NOT what is covered here."""
+    meta, dns = cluster
+    oz = _client(meta)
+    meta.om.kms_create_key("reck")
+    oz.create_volume("ev")
+    meta.om.create_bucket("ev", "enc", EC, encryption_key="reck")
+    b = oz.get_volume("ev").get_bucket("enc")
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, 40_000, dtype=np.uint8)
+    b.write_key("k", data)
+
+    info = oz.om.lookup_key("ev", "enc", "k")
+    assert "edek" in info["encryption"]
+    groups = oz.om.key_block_groups(info)
+    for g in groups:
+        for dn in dns:
+            if dn.dn.id in g.pipeline.nodes:
+                try:
+                    dn.dn.close_container(g.container_id)
+                except Exception:
+                    pass
+    victim_id = groups[0].pipeline.nodes[0]  # a DATA unit this time
+    victim = next(d for d in dns if d.dn.id == victim_id)
+    victim.stop()
+    meta.scm.nodes.get(victim_id).last_heartbeat = -1e9
+    meta.scm.nodes.check_liveness()
+
+    _await_replica_rebuild(meta, groups, victim_id)
+
+    # fresh client + fresh lookup; placement then repointed from SCM
+    oz2 = _client(meta)
+    for dn_id, addr in meta.scm_service.addresses.items():
+        if oz2.clients.maybe_get(dn_id) is None:
+            oz2.clients.register_remote(dn_id, addr)
+    info2 = oz2.om.lookup_key("ev", "enc", "k")
+    g2 = oz2.om.key_block_groups(info2)
+    _repoint_groups(meta, g2, victim_id)
+    info2["block_groups"] = [g.to_json() for g in g2]
+    got = oz2.get_volume("ev").get_bucket("enc").read_key_info(info2)
+    assert np.array_equal(got, data)
