@@ -199,6 +199,56 @@ class OzoneFileSystem:
                 )
         return sorted(out.values(), key=lambda s: s.path)
 
+    def list_status_page(self, path: str, start_after: str = "",
+                         limit: int = 1000
+                         ) -> tuple[list[FileStatus], bool]:
+        """Bounded page of immediate children after child name
+        `start_after` (the LISTSTATUS_BATCH backend): key pages come
+        from the OM's bounded listing, a directory child's whole
+        subtree is skipped via a floor key past it, and server work is
+        proportional to the PAGE, not the directory."""
+        base = self._norm(path)
+        prefix = base + "/" if base else ""
+        st = self.get_file_status(path)
+        if not st.is_dir:
+            return ([st] if not start_after else [], False)
+        om = self.bucket.client.om
+        out: dict[str, FileStatus] = {}
+        # resume AFTER the named child: for a dir child the floor must
+        # clear its subtree ("name/￿"); for a file child any key
+        # > its own name qualifies — the dir floor covers both
+        floor = (prefix + start_after + "/￿"
+                 if start_after else "")
+        while len(out) <= limit:
+            keys = om.list_keys(self.bucket.volume, self.bucket.name,
+                                prefix, start_after=floor, limit=512)
+            if not keys:
+                break
+            for k in keys:
+                rest = k["name"][len(prefix):]
+                if not rest:
+                    continue
+                head = rest.split("/")[0]
+                child = prefix + head
+                if "/" in rest.rstrip("/") or rest.endswith("/"):
+                    if rest == head + "/":
+                        out[child] = FileStatus(
+                            child, True, 0, k.get("modified", 0.0),
+                            attrs=k.get("attrs", {}))
+                    else:
+                        out.setdefault(
+                            child, FileStatus(child, True, 0, 0.0))
+                else:
+                    out[child] = FileStatus(
+                        child, False, k["size"],
+                        k.get("modified", 0.0),
+                        attrs=k.get("attrs", {}))
+                if len(out) > limit:
+                    break
+            floor = keys[-1]["name"]
+        children = sorted(out.values(), key=lambda s: s.path)
+        return children[:limit], len(children) > limit
+
     def delete(self, path: str, recursive: bool = False) -> bool:
         st = self.get_file_status(path)
         om = self.bucket.client.om
@@ -533,6 +583,22 @@ class RootedOzoneFileSystem:
                             purged.append(
                                 f"/{v['name']}/{b['name']}/{cp.path}")
         return purged
+
+    def list_status_page(self, path: str, start_after: str = "",
+                         limit: int = 1000
+                         ) -> tuple[list[FileStatus], bool]:
+        vol, bkt, rest = self._resolve(path)
+        if vol and bkt:
+            page, more = self._bucket_fs(vol, bkt).list_status_page(
+                rest, start_after=start_after, limit=limit)
+            return ([FileStatus(f"{vol}/{bkt}/{s.path}", s.is_dir,
+                                s.length, s.modification_time,
+                                attrs=s.attrs) for s in page], more)
+        # volume / root levels are small namespaces: slice the full list
+        sts = [s for s in self.list_status(path)
+               if not start_after
+               or s.path.rstrip("/").rpartition("/")[2] > start_after]
+        return sts[:limit], len(sts) > limit
 
     def set_attrs(self, path: str, attrs: dict) -> None:
         vol, bkt, rest = self._resolve(path)

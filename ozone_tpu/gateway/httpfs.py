@@ -6,7 +6,7 @@ adapter). Serves the standard `/webhdfs/v1/<path>?op=...` verbs over the
 cluster-rooted filesystem (gateway/fs.py:RootedOzoneFileSystem):
 
   GET    OPEN (offset/length), GETFILESTATUS, LISTSTATUS,
-         GETCONTENTSUMMARY, GETFILECHECKSUM
+         LISTSTATUS_BATCH (paged), GETCONTENTSUMMARY, GETFILECHECKSUM
   PUT    CREATE (two-step 307 redirect per the WebHDFS spec, or direct
          with ?data=true), MKDIRS, RENAME (destination=),
          SETPERMISSION, SETOWNER, SETTIMES
@@ -36,8 +36,14 @@ log = logging.getLogger(__name__)
 PREFIX = "/webhdfs/v1"
 
 
+def _child_name(st: FileStatus) -> str:
+    """The one name-derivation rule: pathSuffix values clients echo
+    back as startAfter must match what the paging filter compares."""
+    return st.path.rstrip("/").rpartition("/")[2]
+
+
 def _status_json(st: FileStatus, suffix_only: bool = False) -> dict:
-    name = st.path.rstrip("/").rpartition("/")[2] if suffix_only else ""
+    name = _child_name(st) if suffix_only else ""
     a = st.attrs or {}
     atime = a.get("atime", st.modification_time)
     return {
@@ -208,6 +214,35 @@ class HttpFSGateway:
             "FileStatuses": {
                 "FileStatus": [_status_json(s, suffix_only=True)
                                for s in sts]
+            }
+        })
+
+    def _op_get_liststatus_batch(self, h, path: str, q) -> None:
+        """Paged listing (WebHDFS LISTSTATUS_BATCH): resumes after
+        ?startAfter=<childName> and reports how many entries remain —
+        huge directories stream in bounded pages instead of one
+        response."""
+        batch = int(q.get("batchsize", ["1000"])[0])
+        if batch <= 0:
+            raise ValueError(f"batchsize must be positive: {batch}")
+        start_after = q.get("startAfter", [""])[0]
+        page, more = self.fs.list_status_page(
+            path, start_after=start_after, limit=batch)
+        h._json(200, {
+            "DirectoryListing": {
+                "partialListing": {
+                    "FileStatuses": {
+                        "FileStatus": [
+                            _status_json(s, suffix_only=True)
+                            for s in page
+                        ]
+                    }
+                },
+                # WebHDFS reports a remaining COUNT; computing it
+                # exactly would walk the rest of the directory, so a
+                # bounded server reports 1 as "more exist" (clients
+                # only test for zero)
+                "remainingEntries": 1 if more else 0,
             }
         })
 

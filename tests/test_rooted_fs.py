@@ -363,3 +363,49 @@ def test_trash_guards_and_emptier(cluster, ofs):
     import time as _time
     purged = gw.fs.trash_expunge(3600, now=_time.time() + 7200)
     assert set(purged) >= set(cps)
+
+
+def test_webhdfs_liststatus_batch(hfs):
+    """LISTSTATUS_BATCH pages a directory with startAfter resumption
+    and a remainingEntries more-exists signal."""
+    _req(hfs, "PUT", "/wv/wb/batch", op="MKDIRS")  # order-independent
+    for i in range(7):
+        urllib.request.urlopen(urllib.request.Request(
+            _url(hfs, f"/wv/wb/batch/f{i:02d}", op="CREATE",
+                 data="true"),
+            data=b"x", method="PUT"))
+    seen, start = [], ""
+    while True:
+        params = {"op": "LISTSTATUS_BATCH", "batchsize": 3}
+        if start:
+            params["startAfter"] = start
+        d = json.load(_req(hfs, "GET", "/wv/wb/batch", **params))
+        listing = d["DirectoryListing"]
+        page = listing["partialListing"]["FileStatuses"]["FileStatus"]
+        assert len(page) <= 3
+        seen += [s["pathSuffix"] for s in page]
+        if listing["remainingEntries"] == 0:
+            break
+        start = page[-1]["pathSuffix"]
+    assert seen == [f"f{i:02d}" for i in range(7)]
+    # bad batchsize is a 400 client error
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(hfs, "GET", "/wv/wb/batch", op="LISTSTATUS_BATCH",
+             batchsize=0)
+    assert ei.value.code == 400
+
+
+def test_list_status_page_skips_subtrees(ofs):
+    """Paging resumes AFTER a directory child's entire subtree (the
+    floor-key skip), and dir children carry their marker attrs."""
+    for f in ("a-file", "z-file"):
+        ofs.create(f"/vol1/bkt1/pg/{f}", b"x")
+    for i in range(20):
+        ofs.create(f"/vol1/bkt1/pg/mid-dir/k{i:02d}", b"y")
+    page, more = ofs.list_status_page("/vol1/bkt1/pg", limit=2)
+    assert [s.path.rpartition("/")[2] for s in page] == \
+        ["a-file", "mid-dir"] and more
+    page2, more2 = ofs.list_status_page("/vol1/bkt1/pg",
+                                        start_after="mid-dir", limit=5)
+    assert [s.path.rpartition("/")[2] for s in page2] == ["z-file"]
+    assert not more2
