@@ -613,6 +613,8 @@ class S3Gateway:
             self._mpu_abort(h, bucket, key, q)
         elif method == "GET" and "uploadId" in q:
             self._mpu_list_parts(h, bucket, key, q)
+        elif "acl" in q:
+            self._object_acl(h, method, bucket, key)
         elif "tagging" in q:
             self._object_tagging(h, method, bucket, key)
         elif method == "PUT":
@@ -624,6 +626,48 @@ class S3Gateway:
         elif method == "DELETE":
             self._bucket_handle(bucket).delete_key(key)
             h._reply(204)
+        else:
+            h._reply(*_err("MethodNotAllowed", method, 405))
+
+    def _object_acl(self, h, method: str, bucket: str,
+                    key: str) -> None:
+        """Object ?acl sub-resource. Like the reference, per-object
+        grants don't exist — GET renders the effective policy (owner
+        FULL_CONTROL + the bucket's public grants); PUT answers
+        NotImplemented instead of silently accepting grants that could
+        never be enforced."""
+        if method == "GET":
+            self.client.om.lookup_key(self._vol, bucket, key)  # 404s
+            root = ET.Element("AccessControlPolicy", xmlns=_NS)
+            owner = ET.SubElement(root, "Owner")
+            ET.SubElement(owner, "ID").text = "ozone"
+            acl = ET.SubElement(root, "AccessControlList")
+
+            def grant(grantee, perm):
+                g = ET.SubElement(acl, "Grant")
+                ge = ET.SubElement(g, "Grantee")
+                xsi = "{http://www.w3.org/2001/XMLSchema-instance}type"
+                if grantee == "*":
+                    # the AWS Group shape: clients detect public access
+                    # by the AllUsers URI, not an ID
+                    ge.set(xsi, "Group")
+                    ET.SubElement(ge, "URI").text = (
+                        "http://acs.amazonaws.com/groups/global/"
+                        "AllUsers")
+                else:
+                    ge.set(xsi, "CanonicalUser")
+                    ET.SubElement(ge, "ID").text = grantee
+                ET.SubElement(g, "Permission").text = perm
+
+            grant("ozone", "FULL_CONTROL")
+            for p in sorted(self._public_grants(bucket)):
+                grant("*", p)
+            h._reply(200, _xml(root),
+                     {"Content-Type": "application/xml"})
+        elif method == "PUT":
+            h._body()
+            h._reply(*_err("NotImplemented",
+                           "object ACLs are bucket-derived", 501))
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
 

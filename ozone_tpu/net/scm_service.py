@@ -366,9 +366,17 @@ class GrpcScmClient:
 
     def _call(self, method: str, meta: dict,
               timeout: Optional[float] = 30.0) -> dict:
+        import time as _time
+
         payload = wire.pack(meta)
         last: Optional[Exception] = None
-        for attempt in range(2 * len(self.addresses)):
+        # backoff between failover attempts (same shape as the OM
+        # client): during an election every replica answers
+        # SCM_NOT_LEADER instantly, and a sleepless loop burns the
+        # whole retry budget in milliseconds instead of outliving the
+        # election
+        attempts = max(4, 3 * len(self.addresses))
+        for attempt in range(attempts):
             addr, ch = self._pool.channel()
             try:
                 m, _ = wire.unpack(ch.call(
@@ -382,6 +390,8 @@ class GrpcScmClient:
                     self._pool.rotate()
                 else:
                     raise
+            if attempt < attempts - 1:  # no dead time before raising
+                _time.sleep(min(0.1 * (attempt + 1), 0.5))
         raise last
 
     def _broadcast(self, method: str, meta: dict,

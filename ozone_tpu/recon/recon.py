@@ -696,10 +696,14 @@ class ReconServer:
                         "namespace", recon.tasks.namespace_summary),
                     "/api/filesizes": lambda: recon._scan(
                         "filesizes", recon.tasks.file_size_histogram),
+                    # ?id=<cid> narrows to one container (the
+                    # reference's per-container key endpoint)
                     "/api/containers/keys": lambda: {
                         str(k): v
                         for k, v in recon.key_index.container_key_map()
                         .items()
+                        if not q.get("id")
+                        or str(k) == q["id"][0]
                     },
                     # derived from the (cached, warehouse-recorded)
                     # namespace scan: no extra OM walk in the request path

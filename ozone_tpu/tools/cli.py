@@ -213,7 +213,8 @@ def cmd_sh(args) -> int:
     elif kind == "key":
         if verb == "list":
             vol, bucket = parts
-            _emit(oz.om.list_keys(vol, bucket))
+            _emit(oz.om.list_keys(vol, bucket, args.prefix,
+                                  args.start_after, args.limit))
             return 0
         vol, bucket, *rest = parts
         key = "/".join(rest)
@@ -792,6 +793,12 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("--om", default="127.0.0.1:9860")
     sh.add_argument("--replication", default="")
     sh.add_argument("--to", default="", help="rename target")
+    sh.add_argument("--prefix", default="",
+                    help="key list: name prefix filter")
+    sh.add_argument("--start-after", default="",
+                    help="key list: resume after this key (paging)")
+    sh.add_argument("--limit", type=int, default=None,
+                    help="key list: page size")
     sh.add_argument("--name", default="",
                     help="snapshot verbs: snapshot name (diff: the "
                          "from-snapshot)")

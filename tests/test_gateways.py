@@ -500,3 +500,42 @@ def test_s3_copy_carries_tags_and_bucket_tagging_answers(s3):
         urllib.request.urlopen(f"{base}/tagcp?tagging")
     assert ei.value.code == 404
     assert b"NoSuchTagSet" in ei.value.read()
+
+
+def test_s3_object_acl_and_cli_paging(s3, cluster):
+    import urllib.error
+    import urllib.request
+
+    base = f"http://{s3.address}"
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/aclb", method="PUT"))
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/aclb/o", data=b"acl-bytes", method="PUT"))
+    got = urllib.request.urlopen(f"{base}/aclb/o?acl").read()
+    assert b"AccessControlPolicy" in got and b"FULL_CONTROL" in got
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/aclb/o?acl", data=b"<x/>", method="PUT"))
+    assert ei.value.code == 501
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(f"{base}/aclb/nope?acl")
+    assert ei.value.code == 404
+    # a public bucket's object renders the AWS AllUsers group grant
+    urllib.request.urlopen(urllib.request.Request(
+        f"{base}/aclb?acl", data=b"", method="PUT",
+        headers={"x-amz-acl": "public-read"}))
+    got = urllib.request.urlopen(f"{base}/aclb/o?acl").read()
+    assert b"AllUsers" in got and b"<Permission>READ" in got
+    # real paged listing: limit + start_after + prefix (the OM surface
+    # the CLI's --prefix/--start-after/--limit flags call)
+    oz = cluster.client()
+    b = oz.create_volume("pgv").create_bucket("pgb", replication=EC)
+    for i in range(5):
+        b.write_key(f"p/k{i}", np.zeros(64, np.uint8))
+    om = oz.om
+    page = om.list_keys("pgv", "pgb", "", "", 2)
+    assert [k["name"] for k in page] == ["p/k0", "p/k1"]
+    page2 = om.list_keys("pgv", "pgb", "", "p/k1", 2)
+    assert [k["name"] for k in page2] == ["p/k2", "p/k3"]
+    assert om.list_keys("pgv", "pgb", "p/k4", "", None)[0]["name"] \
+        == "p/k4"

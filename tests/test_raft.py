@@ -178,29 +178,39 @@ def test_timer_driven_election_after_leader_death(tmp_path):
     nodes, states, transport = make_cluster(tmp_path)
     for n in nodes:
         n.start_timers()
-    try:
-        deadline = time.monotonic() + 10.0
-        leader = None
-        while leader is None and time.monotonic() < deadline:
-            leader = next((n for n in nodes if n.is_leader), None)
-            time.sleep(0.02)
-        assert leader is not None, "no leader elected"
-        leader.propose("a", timeout=10.0)
 
+    def propose_retrying(candidates, value, timeout_s=15.0):
+        """Find the live leader and propose; under full-suite host load
+        elections can churn BETWEEN leader detection and the propose,
+        so a deposed-leader error re-detects instead of failing."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            # a timed-out propose may still have committed; re-sending
+            # would double-apply, so check the replicas first
+            if any(value in s for s in states):
+                return next(n for n in candidates if n.is_leader) \
+                    if any(n.is_leader for n in candidates) else \
+                    candidates[0]
+            ldr = next((n for n in candidates if n.is_leader), None)
+            if ldr is None:
+                time.sleep(0.02)
+                continue
+            try:
+                ldr.propose(value, timeout=5.0)
+                return ldr
+            except Exception:
+                time.sleep(0.05)
+        raise AssertionError(f"could not commit {value!r} in time")
+
+    try:
+        leader = propose_retrying(nodes, "a")
         transport.down.add(leader.node_id)
         survivors = [n for n in nodes if n is not leader]
-        # generous: timer-driven elections can need several rounds when
-        # the host is under full-suite load
-        deadline = time.monotonic() + 15.0
-        new_leader = None
-        while time.monotonic() < deadline:
-            new_leader = next((n for n in survivors if n.is_leader), None)
-            if new_leader is not None:
-                break
-            time.sleep(0.02)
-        assert new_leader is not None, "no failover election"
-        new_leader.propose("b", timeout=10.0)
+        new_leader = propose_retrying(survivors, "b")
         idx = nodes.index(new_leader)
+        deadline = time.monotonic() + 5.0
+        while states[idx] != ["a", "b"] and time.monotonic() < deadline:
+            time.sleep(0.02)
         assert states[idx] == ["a", "b"]
     finally:
         for n in nodes:
