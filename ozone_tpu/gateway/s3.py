@@ -477,10 +477,14 @@ class S3Gateway:
             h._reply(*_err("MethodNotAllowed", method, 405))
 
     def _list_objects(self, h, bucket: str, q) -> None:
-        """ListObjectsV2: prefix, delimiter -> CommonPrefixes grouping,
-        max-keys truncation with NextContinuationToken / start-after
-        (BucketEndpoint list semantics; goofys/boto3 folder browsing)."""
+        """ListObjects V2 AND V1 over one paging engine: prefix,
+        delimiter -> CommonPrefixes grouping, max-keys truncation.
+        V2 (?list-type=2) resumes via NextContinuationToken /
+        start-after; V1 (no list-type — older SDKs) resumes via
+        ?marker and reports Marker/NextMarker instead of
+        KeyCount/ContinuationToken (BucketEndpoint list semantics)."""
         om = self.client.om
+        v1 = q.get("list-type", [""])[0] != "2"
         prefix = q.get("prefix", [""])[0]
         delim = q.get("delimiter", [""])[0]
         try:
@@ -488,7 +492,11 @@ class S3Gateway:
         except ValueError:
             h._reply(*_err("InvalidArgument", "bad max-keys", 400))
             return
-        token = q.get("continuation-token", [""])[0]
+        marker = q.get("marker", [""])[0]
+        # both resume cursors emit entities in key order, so the
+        # group-already-served check below treats them identically
+        token = (marker if v1
+                 else q.get("continuation-token", [""])[0])
         after = token or q.get("start-after", [""])[0]
         contents: list[dict] = []
         common: list[str] = []
@@ -512,13 +520,16 @@ class S3Gateway:
                     cut = rest.find(delim)
                     if cut >= 0:  # group under the rolled-up prefix
                         cp = prefix + rest[: cut + len(delim)]
-                        if token and cp <= token:
-                            # our continuation tokens emit entities in
-                            # key order, so cp <= token means the group
-                            # was served on a previous page. A raw
-                            # start-after inside a group must NOT skip
-                            # it (AWS emits the CommonPrefix when keys
-                            # remain beyond start-after).
+                        # V2 continuation tokens are SERVER-issued and
+                        # emit entities in key order, so cp <= token
+                        # means the group was served on a prior page.
+                        # V1 markers are client-arbitrary (like raw
+                        # start-after): only a marker EQUAL to the
+                        # prefix consumes the group (AWS NextMarker
+                        # semantics); a marker inside the group must
+                        # still emit its CommonPrefix.
+                        if token and (cp == token
+                                      or (not v1 and cp <= token)):
                             continue
                         if common and common[-1] == cp:
                             continue
@@ -543,13 +554,18 @@ class S3Gateway:
         ET.SubElement(root, "Prefix").text = prefix
         if delim:
             ET.SubElement(root, "Delimiter").text = delim
-        ET.SubElement(root, "KeyCount").text = str(
-            len(contents) + len(common))
+        if v1:
+            ET.SubElement(root, "Marker").text = marker
+        else:
+            ET.SubElement(root, "KeyCount").text = str(
+                len(contents) + len(common))
         ET.SubElement(root, "MaxKeys").text = str(max_keys)
         ET.SubElement(root, "IsTruncated").text = (
             "true" if truncated else "false")
         if truncated and next_token:
-            ET.SubElement(root, "NextContinuationToken").text = next_token
+            ET.SubElement(root,
+                          "NextMarker" if v1
+                          else "NextContinuationToken").text = next_token
         for k in contents:
             c = ET.SubElement(root, "Contents")
             ET.SubElement(c, "Key").text = k["name"]
