@@ -776,10 +776,48 @@ def cmd_repair(args) -> int:
     return 0
 
 
+def cmd_version(args) -> int:
+    """`ozone version` analog: framework + runtime stack versions.
+    Must ALWAYS succeed — device discovery initializes the JAX backend,
+    which can fail when another process owns the accelerator."""
+    import jax
+    import numpy
+
+    import ozone_tpu
+
+    try:
+        devices = [str(d) for d in jax.devices()]
+    except RuntimeError as e:
+        devices = [f"unavailable: {e}"]
+    _emit({
+        "ozone_tpu": ozone_tpu.__version__,
+        "jax": jax.__version__,
+        "numpy": numpy.__version__,
+        "python": sys.version.split()[0],
+        "devices": devices,
+    })
+    return 0
+
+
+def cmd_getconf(args) -> int:
+    """`ozone getconf` analog: the generated defaults document for
+    every typed config group (the @Config annotation surface)."""
+    from ozone_tpu.utils.config import ALL_GROUPS, generate_defaults
+
+    print(generate_defaults(list(ALL_GROUPS)))
+    return 0
+
+
 # -------------------------------------------------------------------- main
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="ozone-tpu")
     sub = ap.add_subparsers(dest="command", required=True)
+
+    ver = sub.add_parser("version", help="framework + stack versions")
+    ver.set_defaults(fn=cmd_version)
+    gc = sub.add_parser("getconf",
+                        help="generated config defaults (ozone getconf)")
+    gc.set_defaults(fn=cmd_getconf)
 
     sh = sub.add_parser("sh", help="object store shell (ozone sh analog)")
     sh.add_argument("object",

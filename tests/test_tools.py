@@ -374,3 +374,12 @@ def test_freon_fsg_and_sdg(cluster):
     rep2 = freon.sdg(oz, n_rounds=2, keys_per_round=1,
                      replication="RATIS/THREE")
     assert rep2.summary()["failures"] == 0
+
+
+def test_cli_version_and_getconf(capsys):
+    assert cli_main(["version"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ozone_tpu"] and out["jax"]
+    assert cli_main(["getconf"]) == 0
+    text = capsys.readouterr().out
+    assert "client.checksum.type" in text and "ScmConfig" in text
