@@ -168,7 +168,9 @@ def test_ha_admin_ops_survive_failover(ha_cluster):
     metas, dns, peers, _ = ha_cluster
     scm = GrpcScmClient(",".join(peers.values()))
     out = scm.admin("decommission", "dn3")
-    assert out["op_state"] == "DECOMMISSIONING"
+    # dn3 holds no containers, so the drain monitor may complete the
+    # decommission between the apply and this response under load
+    assert out["op_state"] in ("DECOMMISSIONING", "DECOMMISSIONED")
     leader = _await_leader(metas)
     time.sleep(0.5)  # followers apply the replicated record
     metas.pop(leader).stop()
