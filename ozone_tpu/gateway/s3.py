@@ -55,11 +55,17 @@ def _xml(root: ET.Element) -> bytes:
 
 
 def _iso_now() -> str:
+    import time
+
+    return _iso_ts(time.time())
+
+
+def _iso_ts(ts: float) -> str:
     import datetime
 
-    return datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%S.000Z"
-    )
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
 
 
 def _err(code: str, message: str, status: int) -> tuple[int, bytes]:
@@ -455,6 +461,9 @@ class S3Gateway:
                 ET.SubElement(root, "Status").text = "Enabled"
             h._reply(200, _xml(root), {"Content-Type": "application/xml"})
             return
+        if method == "GET" and "uploads" in q:
+            self._list_uploads(h, bucket, q)
+            return
         if method == "PUT":
             try:
                 om.create_bucket(self._vol, bucket, self.replication)
@@ -475,6 +484,108 @@ class S3Gateway:
             h._reply(200)
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
+
+    def _list_uploads(self, h, bucket: str, q) -> None:
+        """GET /bucket?uploads — ListMultipartUploads (BucketEndpoint
+        ?uploads listing, BucketEndpoint.java:325): every in-progress
+        upload in (key, uploadId) order, with prefix filtering,
+        delimiter -> CommonPrefixes grouping, key-marker /
+        upload-id-marker resume, and max-uploads truncation."""
+        om = self.client.om
+        om.bucket_info(self._vol, bucket)  # NoSuchBucket -> 404
+        prefix = q.get("prefix", [""])[0]
+        delim = q.get("delimiter", [""])[0]
+        try:
+            max_uploads = int(q.get("max-uploads", ["1000"])[0])
+        except ValueError:
+            max_uploads = -1
+        if not 1 <= max_uploads <= 1000:
+            # AWS bounds MaxUploads to 1-1000; clamping 0 to "truncated
+            # with empty markers" would spin paginating clients forever
+            h._reply(*_err("InvalidArgument", "max-uploads must be in "
+                           "1..1000", 400))
+            return
+        key_marker = q.get("key-marker", [""])[0]
+        id_marker = q.get("upload-id-marker", [""])[0]
+        # the OM scan bounds by STORE key (/vol/bucket/<key>/<uploadId>)
+        # — a superset when the prefix crosses the key/uploadId
+        # boundary (key "a" matches prefix "a/"); re-check the key name
+        entries = [
+            m for m in om.list_multipart_uploads(self._vol, bucket, prefix)
+            if m["name"].startswith(prefix)
+        ]
+        # AWS ordering: ascending key, then ascending uploadId
+        entries.sort(key=lambda m: (m["name"], m["upload_id"]))
+        uploads: list[dict] = []
+        common: list[str] = []
+        truncated = False
+        for m in entries:
+            name, uid = m["name"], m["upload_id"]
+            if key_marker:
+                # resume AFTER the marker pair: without an
+                # upload-id-marker the whole marker key is consumed;
+                # with one, later uploads of that key still list
+                if name < key_marker or (
+                        name == key_marker
+                        and (not id_marker or uid <= id_marker)):
+                    continue
+            if delim:
+                rest = name[len(prefix):]
+                cut = rest.find(delim)
+                if cut >= 0:
+                    cp = prefix + rest[: cut + len(delim)]
+                    # a key-marker equal to (or past) a served group's
+                    # prefix consumes the group, like V1 NextMarker
+                    if key_marker and cp <= key_marker:
+                        continue
+                    if common and common[-1] == cp:
+                        continue
+                    if len(uploads) + len(common) >= max_uploads:
+                        truncated = True
+                        break
+                    common.append(cp)
+                    continue
+            if len(uploads) + len(common) >= max_uploads:
+                truncated = True
+                break
+            uploads.append(m)
+        root = ET.Element("ListMultipartUploadsResult", xmlns=_NS)
+        ET.SubElement(root, "Bucket").text = bucket
+        ET.SubElement(root, "KeyMarker").text = key_marker
+        ET.SubElement(root, "UploadIdMarker").text = id_marker
+        if truncated:
+            # next markers name the last entity served; a CommonPrefix
+            # resumes key-only (uploads inside it were never listed)
+            last_key = uploads[-1]["name"] if uploads else ""
+            last_cp = common[-1] if common else ""
+            if last_cp > last_key:
+                ET.SubElement(root, "NextKeyMarker").text = last_cp
+                ET.SubElement(root, "NextUploadIdMarker").text = ""
+            else:
+                ET.SubElement(root, "NextKeyMarker").text = last_key
+                ET.SubElement(root, "NextUploadIdMarker").text = (
+                    uploads[-1]["upload_id"])
+        ET.SubElement(root, "Prefix").text = prefix
+        if delim:
+            ET.SubElement(root, "Delimiter").text = delim
+        ET.SubElement(root, "MaxUploads").text = str(max_uploads)
+        ET.SubElement(root, "IsTruncated").text = (
+            "true" if truncated else "false")
+        for m in uploads:
+            u = ET.SubElement(root, "Upload")
+            ET.SubElement(u, "Key").text = m["name"]
+            ET.SubElement(u, "UploadId").text = m["upload_id"]
+            owner = ET.SubElement(u, "Owner")
+            ET.SubElement(owner, "ID").text = "ozone"
+            init = ET.SubElement(u, "Initiator")
+            ET.SubElement(init, "ID").text = "ozone"
+            ET.SubElement(u, "StorageClass").text = "STANDARD"
+            ET.SubElement(u, "Initiated").text = _iso_ts(
+                m.get("created", 0.0))
+        for cp in common:
+            e = ET.SubElement(root, "CommonPrefixes")
+            ET.SubElement(e, "Prefix").text = cp
+        h._reply(200, _xml(root), {"Content-Type": "application/xml"})
 
     def _list_objects(self, h, bucket: str, q) -> None:
         """ListObjects V2 AND V1 over one paging engine: prefix,

@@ -600,3 +600,90 @@ def test_s3_v1_marker_inside_group_emits_prefix(s3):
     assert cps == []
     keys = [e.text for e in tree.iter() if e.tag.endswith("}Key")]
     assert keys == ["z9"]
+
+
+def _initiate(s3, bucket, key):
+    tree = ET.fromstring(
+        _req(s3, "POST", f"/{bucket}/{key}?uploads").read())
+    return next(e.text for e in tree.iter()
+                if e.tag.endswith("UploadId"))
+
+
+def _uploads_of(tree):
+    return [
+        (u.findtext("{*}Key"), u.findtext("{*}UploadId"))
+        for u in tree.iter() if u.tag.endswith("}Upload")
+    ]
+
+
+def _prefixes_of(tree):
+    return [e.text for p in tree.iter()
+            if p.tag.endswith("CommonPrefixes")
+            for e in p if e.tag.endswith("Prefix")]
+
+
+def test_s3_list_multipart_uploads(s3):
+    """GET ?uploads (ListMultipartUploads, BucketEndpoint.java:325):
+    (key, uploadId) ordering, prefix filter, delimiter grouping,
+    Initiated timestamps, and abort removing the entry."""
+    _req(s3, "PUT", "/lmu")
+    ids = {}
+    for key in ("a/one", "a/two", "b/three", "plain"):
+        ids[key] = _initiate(s3, "lmu", key)
+    id2 = _initiate(s3, "lmu", "a/one")  # second upload, same key
+    tree = ET.fromstring(_req(s3, "GET", "/lmu?uploads").read())
+    assert tree.tag.endswith("ListMultipartUploadsResult")
+    got = _uploads_of(tree)
+    assert got == sorted(
+        [("a/one", ids["a/one"]), ("a/one", id2), ("a/two", ids["a/two"]),
+         ("b/three", ids["b/three"]), ("plain", ids["plain"])])
+    assert tree.findtext("{*}IsTruncated") == "false"
+    inits = [u.findtext("{*}Initiated") for u in tree.iter()
+             if u.tag.endswith("}Upload")]
+    assert all(i and i.endswith("Z") for i in inits)
+    # prefix filter — and a key named exactly "a" must NOT match
+    # prefix "a/" through the store-key /key/uploadId boundary
+    ids["a"] = _initiate(s3, "lmu", "a")
+    tree = ET.fromstring(_req(s3, "GET", "/lmu?uploads&prefix=a/").read())
+    assert {k for k, _ in _uploads_of(tree)} == {"a/one", "a/two"}
+    # delimiter grouping ("a" has no delimiter -> plain Upload entry)
+    tree = ET.fromstring(
+        _req(s3, "GET", "/lmu?uploads&delimiter=/").read())
+    assert _prefixes_of(tree) == ["a/", "b/"]
+    assert _uploads_of(tree) == [("a", ids["a"]),
+                                 ("plain", ids["plain"])]
+    # abort removes the entry
+    _req(s3, "DELETE", f"/lmu/plain?uploadId={ids['plain']}")
+    tree = ET.fromstring(_req(s3, "GET", "/lmu?uploads").read())
+    assert ("plain", ids["plain"]) not in _uploads_of(tree)
+
+
+def test_s3_list_multipart_uploads_paging(s3):
+    """max-uploads truncation + NextKeyMarker/NextUploadIdMarker resume
+    walks the full set exactly once, including same-key upload pairs."""
+    _req(s3, "PUT", "/lmup")
+    expect = set()
+    for key in ("k1", "k1", "k2", "k3", "k4"):  # k1 twice
+        expect.add((key, _initiate(s3, "lmup", key)))
+    got = []
+    key_marker, id_marker = "", ""
+    for _ in range(10):
+        tree = ET.fromstring(_req(
+            s3, "GET", "/lmup?uploads&max-uploads=2"
+            f"&key-marker={key_marker}&upload-id-marker={id_marker}"
+        ).read())
+        page = _uploads_of(tree)
+        assert len(page) <= 2
+        got.extend(page)
+        if tree.findtext("{*}IsTruncated") != "true":
+            break
+        key_marker = tree.findtext("{*}NextKeyMarker")
+        id_marker = tree.findtext("{*}NextUploadIdMarker") or ""
+    assert sorted(got) == sorted(expect)
+    assert len(got) == len(expect)
+    # bad / out-of-range max-uploads -> InvalidArgument, never an
+    # unpageable truncated response
+    for bad in ("zz", "0", "-3", "1001"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(s3, "GET", f"/lmup?uploads&max-uploads={bad}")
+        assert ei.value.code == 400
