@@ -182,12 +182,16 @@ class OpenFile(OMRequest):
     #: envelope-encryption bundle (TDE EDEK / GDPR secret) minted by
     #: the OM at open — see requests.OpenKey.encryption
     encryption: dict = field(default_factory=dict)
+    #: stable identity of this file version (OmKeyInfo objectID) —
+    #: rename-carried, overwrite-fresh; snapdiff pairs rows by it
+    file_id: str = ""
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
         self.new_dir_ids = [
             uuid.uuid4().hex[:16] for _ in split_path(self.path)
         ]
+        self.file_id = uuid.uuid4().hex[:16]
 
     def apply(self, store):
         _require_bucket(store, self.volume, self.bucket)
@@ -208,6 +212,7 @@ class OpenFile(OMRequest):
             "volume": self.volume,
             "bucket": self.bucket,
             "name": self.path.strip("/"),
+            "object_id": self.file_id,
             "file_name": name,
             "parent_id": parent,
             "replication": self.replication,

@@ -169,9 +169,12 @@ class CompleteMultipartUpload(rq.OMRequest):
     ts: float = 0.0
     #: LEGACY bucket: enforce filesystem shape on the final key
     fs_paths: bool = False
+    #: stable identity of the assembled key version (OmKeyInfo objectID)
+    key_id: str = ""
 
     def pre_execute(self, om) -> None:
         self.ts = time.time()
+        self.key_id = uuid.uuid4().hex[:16]
 
     def apply(self, store):
         mk = mpu_key(self.volume, self.bucket, self.key, self.upload_id)
@@ -226,6 +229,7 @@ class CompleteMultipartUpload(rq.OMRequest):
             "volume": self.volume,
             "bucket": self.bucket,
             "name": self.key,
+            "object_id": self.key_id,
             "replication": mpu["replication"],
             "checksum_type": mpu["checksum_type"],
             "bytes_per_checksum": mpu["bytes_per_checksum"],

@@ -437,11 +437,18 @@ class CreateSnapshot(OMRequest):
             store.put("keys", f"{prefix}/{k[len(base):]}", v,
                       journal=False)
         # FSO buckets keep file rows in the "files" table keyed by parent
-        # id; each row carries its full path in "name", so snapshot rows
-        # are materialized path-keyed and all snapshot reads/diffs work
-        # identically across layouts
-        for _, v in list(store.iterate("files", base)):
-            store.put("keys", f"{prefix}/{v['name']}", v, journal=False)
+        # id; full paths must be DERIVED by tree walk — the stored "name"
+        # is the path at creation time and goes stale when an ancestor
+        # directory is renamed (the O(1) reparent never touches
+        # descendants). Snapshot rows are materialized path-keyed so all
+        # snapshot reads/diffs work identically across layouts.
+        from ozone_tpu.om.fso import walk_files_paged
+
+        for v in walk_files_paged(store, self.volume, self.bucket):
+            row = {k2: v[k2] for k2 in v
+                   if k2 not in ("type", "path")}
+            store.put("keys", f"{prefix}/{v['name']}", row,
+                      journal=False)
         info = {
             "volume": self.volume,
             "bucket": self.bucket,
@@ -692,9 +699,16 @@ class OpenKey(OMRequest):
     #: TDE bucket, plaintext per-key secret for a GDPR bucket); rides
     #: the replicated request so every replica stores the same bundle
     encryption: dict = field(default_factory=dict)
+    #: stable identity of THIS key version (OmKeyInfo objectID): renames
+    #: carry it unchanged, overwrites mint a fresh one — snapdiff pairs
+    #: deleted+added rows by it to report RENAME entries
+    key_id: str = ""
 
     def pre_execute(self, om) -> None:
+        import uuid
+
         self.created = time.time()
+        self.key_id = uuid.uuid4().hex[:16]
 
     def apply(self, store):
         if not store.exists("buckets", bucket_key(self.volume, self.bucket)):
@@ -707,6 +721,7 @@ class OpenKey(OMRequest):
             "volume": self.volume,
             "bucket": self.bucket,
             "name": self.key,
+            "object_id": self.key_id,
             "replication": self.replication,
             "checksum_type": self.checksum_type,
             "bytes_per_checksum": self.bytes_per_checksum,

@@ -8,7 +8,8 @@ compaction-DAG tracker rocksdb-checkpoint-differ RocksDBCheckpointDiffer
 key-table rows into a dedicated snapshot table (the sqlite analog of a
 checkpoint), snapshots chain per bucket, reads can be served from a
 snapshot, and snapdiff compares two snapshots (or snapshot vs live) by
-key: added / deleted / modified / renamed-as-delete+add.
+key: added / deleted / modified / renamed (delete+add pairs matched by
+object id, the SnapshotDiffManager.java:1246 RENAME mechanism).
 """
 
 from __future__ import annotations
@@ -89,6 +90,29 @@ class SnapshotManager:
     def _key_sig(v: dict) -> tuple:
         return (v["size"], v.get("modified"), v.get("block_groups"))
 
+    @staticmethod
+    def _pair_renames(deleted: dict, added: dict
+                      ) -> tuple[list, list, list]:
+        """Pair deleted+added rows whose object_id matches into RENAME
+        entries (the object-ID tracking SnapshotDiffManager.java:1246
+        uses): returns (added_names, deleted_names, renamed_pairs).
+        Rows predating object ids (or genuinely new objects) stay plain
+        adds/deletes."""
+        by_id = {
+            v.get("object_id"): n
+            for n, v in deleted.items() if v.get("object_id")
+        }
+        renamed, still_added = [], []
+        gone = set(deleted)
+        for n in sorted(added):
+            src = by_id.get(added[n].get("object_id"))
+            if src is not None and src in gone:
+                renamed.append([src, n])
+                gone.discard(src)
+            else:
+                still_added.append(n)
+        return still_added, sorted(gone), sorted(renamed)
+
     def _incremental_diff(self, volume: str, bucket: str,
                           old_info: SnapshotInfo,
                           new_info: Optional[SnapshotInfo]) -> Optional[dict]:
@@ -123,21 +147,23 @@ class SnapshotManager:
         old_prefix = _snap_prefix(volume, bucket, old_info.snap_id)
         new_prefix = (_snap_prefix(volume, bucket, new_info.snap_id)
                       if new_info is not None else None)
-        added, deleted, modified = [], [], []
+        added_v, deleted_v, modified = {}, {}, []
         for name in sorted(names):
             ov = store.get("keys", f"{old_prefix}/{name}")
             nv = store.get(
                 "keys",
                 f"{new_prefix}/{name}" if new_prefix else base + name)
             if ov is None and nv is not None:
-                added.append(name)
+                added_v[name] = nv
             elif ov is not None and nv is None:
-                deleted.append(name)
+                deleted_v[name] = ov
             elif ov is not None and nv is not None \
                     and self._key_sig(ov) != self._key_sig(nv):
                 modified.append(name)
             # both None: created AND deleted inside the window
+        added, deleted, renamed = self._pair_renames(deleted_v, added_v)
         return {"added": added, "deleted": deleted, "modified": modified,
+                "renamed": renamed,
                 "mode": "incremental", "keys_examined": len(names)}
 
     def snapshot_diff(self, volume: str, bucket: str,
@@ -171,12 +197,14 @@ class SnapshotManager:
                 k["name"]: k
                 for k in self.list_keys(volume, bucket, to_snapshot)
             }
-        added = sorted(set(new) - set(old))
-        deleted = sorted(set(old) - set(new))
         modified = sorted(
             n
             for n in set(old) & set(new)
             if self._key_sig(old[n]) != self._key_sig(new[n])
         )
+        added, deleted, renamed = self._pair_renames(
+            {n: old[n] for n in set(old) - set(new)},
+            {n: new[n] for n in set(new) - set(old)},
+        )
         return {"added": added, "deleted": deleted, "modified": modified,
-                "mode": "full"}
+                "renamed": renamed, "mode": "full"}

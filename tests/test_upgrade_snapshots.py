@@ -369,3 +369,55 @@ def test_incremental_diff_100k_keys_10_changes(cluster):
     # O(changes) vs O(namespace): the incremental path must be at least
     # an order of magnitude faster on 100k keys / 10 changes
     assert dt_inc < dt_full / 10, (dt_inc, dt_full)
+
+
+def test_snapdiff_rename_entries_obs_incremental(cluster):
+    """A renamed key appears as ONE RENAME entry — not delete+add —
+    matched by object id through the update journal
+    (SnapshotDiffManager.java:143,1246 object-ID rename tracking)."""
+    oz = cluster.client()
+    b = oz.create_volume("vr").create_bucket("rb", replication=EC)
+    rng = np.random.default_rng(5)
+    b.write_key("keep", rng.integers(0, 256, 100, dtype=np.uint8))
+    b.write_key("old-name", rng.integers(0, 256, 200, dtype=np.uint8))
+    sm = SnapshotManager(cluster.om)
+    sm.create_snapshot("vr", "rb", "r1")
+    cluster.om.rename_key("vr", "rb", "old-name", "new-name")
+    diff = sm.snapshot_diff("vr", "rb", "r1")
+    assert diff["mode"] == "incremental"
+    assert diff["renamed"] == [["old-name", "new-name"]]
+    assert diff["added"] == [] and diff["deleted"] == []
+    # a DIFFERENT key written at a deleted key's former name is NOT a
+    # rename (fresh object id)
+    b.delete_key("keep")
+    b.write_key("keep", rng.integers(0, 256, 50, dtype=np.uint8))
+    diff = sm.snapshot_diff("vr", "rb", "r1")
+    assert diff["renamed"] == [["old-name", "new-name"]]
+    assert diff["modified"] == ["keep"]
+
+
+def test_snapdiff_fso_directory_rename(cluster):
+    """FSO directory rename: the O(1) subtree reparent must surface as
+    per-key RENAME entries, and snapshots taken AFTER the rename must
+    materialize the post-rename derived paths (stored file rows keep
+    their creation-time path string)."""
+    oz = cluster.client()
+    oz.create_volume("vr2")
+    cluster.om.create_bucket("vr2", "fb", EC,
+                             layout="FILE_SYSTEM_OPTIMIZED")
+    b = oz.get_volume("vr2").get_bucket("fb")
+    rng = np.random.default_rng(6)
+    for name in ("dir/a", "dir/b", "top"):
+        b.write_key(name, rng.integers(0, 256, 64, dtype=np.uint8))
+    sm = SnapshotManager(cluster.om)
+    sm.create_snapshot("vr2", "fb", "f1")
+    cluster.om.rename_key("vr2", "fb", "dir", "moved")
+    b.write_key("moved/c", rng.integers(0, 256, 64, dtype=np.uint8))
+    sm.create_snapshot("vr2", "fb", "f2")
+    # post-rename snapshot sees derived (current) paths
+    assert sorted(k["name"] for k in sm.list_keys("vr2", "fb", "f2")) == [
+        "moved/a", "moved/b", "moved/c", "top"]
+    diff = sm.snapshot_diff("vr2", "fb", "f1", "f2")
+    assert diff["renamed"] == [["dir/a", "moved/a"], ["dir/b", "moved/b"]]
+    assert diff["added"] == ["moved/c"]
+    assert diff["deleted"] == [] and diff["modified"] == []
