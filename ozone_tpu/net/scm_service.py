@@ -248,7 +248,7 @@ class ScmGrpcService:
             else:
                 out = scm.apply_admin_op(op, target)
         elif op == "balancer-status":
-            out = {"running": scm.balancer_enabled}
+            out = scm.balancer_status()
         elif op in ("container-token", "block-token"):
             # operator token minting for dn-direct debug/repair tools
             # (SCMSecurityProtocol.getContainerToken analog); no-op on

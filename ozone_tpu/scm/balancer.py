@@ -54,11 +54,14 @@ class ContainerBalancer:
         self,
         containers: ContainerManager,
         nodes: NodeManager,
-        config: BalancerConfig = BalancerConfig(),
+        config: BalancerConfig = None,
     ):
         self.containers = containers
         self.nodes = nodes
-        self.config = config
+        # fresh default per balancer: the config is mutated by restores
+        # and operator overrides, so sharing one instance would leak
+        # settings across SCMs in the same process
+        self.config = config if config is not None else BalancerConfig()
         self.status = BalancerStatus()
 
     def _utilization(self) -> dict[str, float]:

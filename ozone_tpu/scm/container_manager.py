@@ -97,6 +97,9 @@ class ContainerManager:
         # HA-safe SequenceIdGenerator; replicas rebuild from reports)
         self._db = None
         self._node_op_states: dict[str, str] = {}
+        # StatefulService rows (balancer config/progress): persisted AND
+        # replicated so services resume across restart and failover
+        self._service_states: dict[str, dict] = {}
         if db_path is not None:
             from ozone_tpu.scm.scm_store import ScmStore
 
@@ -149,6 +152,7 @@ class ContainerManager:
         self._next_cid = state["next_container_id"]
         self._next_lid = state["next_local_id"]
         self._node_op_states = dict(state.get("node_op_states", {}))
+        self._service_states = dict(state.get("service_states", {}))
 
     def _row(self, c: ContainerInfo) -> dict:
         return {
@@ -171,7 +175,15 @@ class ContainerManager:
     def apply_mutation(self, row: dict, counters: tuple[int, int]) -> None:
         """Follower-side deterministic apply of a leader mutation record
         (SCMStateMachine.applyTransaction analog): upsert the container row
-        and advance the HA-safe id counters."""
+        and advance the HA-safe id counters. Service-state rows (the
+        StatefulService records) ride the same channel."""
+        if "service" in row:
+            with self._lock:
+                self._service_states[row["service"]] = dict(row["state"])
+                if self._db is not None:
+                    self._db.save_service_state(row["service"],
+                                                dict(row["state"]))
+            return
         with self._lock:
             c = self._containers.get(int(row["id"]))
             if c is None:
@@ -217,6 +229,9 @@ class ContainerManager:
                     self._row(c) for c in self._containers.values()
                 ],
                 "counters": [self._next_cid, self._next_lid],
+                "service_states": {
+                    k: dict(v) for k, v in self._service_states.items()
+                },
             }
 
     def install_snapshot(self, snap: dict) -> None:
@@ -233,8 +248,18 @@ class ContainerManager:
                     self._pipelines.pop(c.pipeline.id, None)
             for pool in self._writable.values():
                 pool[:] = [cid for cid in pool if cid in keep]
+        # service rows are replace-all too: a stale local 'balancer'
+        # record not present in the leader's checkpoint must die here,
+        # or a bootstrapped node resumes a service the cluster stopped
+        with self._lock:
+            self._service_states = {}
+            if self._db is not None:
+                self._db.replace_service_states({})
         for row in snap["containers"]:
             self.apply_mutation(row, tuple(snap["counters"]))
+        for name, state in snap.get("service_states", {}).items():
+            self.apply_mutation({"service": name, "state": state},
+                                tuple(snap["counters"]))
         with self._lock:
             self._next_cid = max(self._next_cid, int(snap["counters"][0]))
             self._next_lid = max(self._next_lid, int(snap["counters"][1]))
@@ -360,6 +385,27 @@ class ContainerManager:
                 self.on_container_closing(c)
             except Exception:  # noqa: BLE001 - lifecycle must not fail
                 log.exception("container-closing hook failed for %s", c.id)
+
+    def service_state(self, name: str) -> Optional[dict]:
+        """Persisted state of a stateful background service (reference:
+        StatefulServiceStateManager.readConfiguration)."""
+        with self._lock:
+            v = self._service_states.get(name)
+            return dict(v) if v is not None else None
+
+    def persist_service_state(self, name: str, state: dict) -> None:
+        """Durably record + replicate a service's config/progress
+        (StatefulServiceStateManager.saveConfiguration analog — the
+        reference's ContainerBalancer persists via exactly that hook,
+        ContainerBalancer.java:281)."""
+        with self._lock:
+            self._service_states[name] = dict(state)
+            counters = (self._next_cid, self._next_lid)
+            if self._db is not None:
+                self._db.save_service_state(name, dict(state))
+            if self.mutation_listener is not None:
+                self.mutation_listener(
+                    {"service": name, "state": dict(state)}, counters)
 
     def node_op_states(self) -> dict[str, str]:
         """Durable node operational states loaded at recovery."""

@@ -101,7 +101,12 @@ class StorageContainerManager:
         from ozone_tpu.scm.decommission import DecommissionMonitor
 
         self.balancer = ContainerBalancer(self.containers, self.nodes)
-        self.balancer_enabled = False
+        # resume a persisted balancing run (the reference's
+        # StatefulServiceStateManager read at ContainerBalancer start,
+        # ContainerBalancer.java:391): config + progress counters come
+        # back from the replicated store; the running flag itself is
+        # always read live from it (see balancer_enabled)
+        self._hydrate_balancer_from_state()
         self.decommission_monitor = DecommissionMonitor(
             self.nodes, self.containers, self.replication
         )
@@ -249,6 +254,18 @@ class StorageContainerManager:
             self.secret_keys.import_key(SecretKey.from_json(target))
             return {"key_id": target["key_id"]}
         if op == "balancer-start":
+            if isinstance(target, dict):
+                # operator config overrides ride the replicated admin
+                # decision, so every replica balances identically
+                cfg = self.balancer.config
+                cfg.threshold = float(
+                    target.get("threshold", cfg.threshold))
+                cfg.max_moves_per_iteration = int(target.get(
+                    "max_moves_per_iteration",
+                    cfg.max_moves_per_iteration))
+                cfg.max_size_per_iteration = int(target.get(
+                    "max_size_per_iteration",
+                    cfg.max_size_per_iteration))
             self.balancer_enabled = True
         elif op == "balancer-stop":
             self.balancer_enabled = False
@@ -259,9 +276,81 @@ class StorageContainerManager:
         else:
             raise StorageError("UNSUPPORTED_REQUEST", f"admin op {op!r}")
         if op.startswith("balancer"):
-            return {"running": self.balancer_enabled}
+            return self.balancer_status()
         return {"safemode": self.safemode.in_safemode(),
                 **self.safemode.status()}
+
+    # ------------------------------------------------------------- balancer
+    def _hydrate_balancer_from_state(self) -> None:
+        """Pull the replicated service row into the live balancer. The
+        row is authoritative for CONFIG (a promoted follower's in-memory
+        balancer still holds defaults — using them would clobber the
+        operator's replicated settings); progress counters take the max
+        of memory and row so an idle leader's unpersisted iteration
+        count is never rolled back."""
+        svc = self.containers.service_state("balancer")
+        if not svc:
+            return
+        cfg, st = self.balancer.config, self.balancer.status
+        cfg.threshold = float(svc.get("threshold", cfg.threshold))
+        cfg.max_moves_per_iteration = int(svc.get(
+            "max_moves_per_iteration", cfg.max_moves_per_iteration))
+        cfg.max_size_per_iteration = int(svc.get(
+            "max_size_per_iteration", cfg.max_size_per_iteration))
+        st.iterations = max(st.iterations, int(svc.get("iterations", 0)))
+        st.moves_scheduled = max(
+            st.moves_scheduled, int(svc.get("moves_scheduled", 0)))
+        st.bytes_scheduled = max(
+            st.bytes_scheduled, int(svc.get("bytes_scheduled", 0)))
+
+    @property
+    def balancer_enabled(self) -> bool:
+        """Live view of the persisted running flag: replicas learn it
+        through the replicated service-state row, so a promoted follower
+        resumes balancing without any re-start command."""
+        svc = self.containers.service_state("balancer")
+        return bool(svc and svc.get("running"))
+
+    @balancer_enabled.setter
+    def balancer_enabled(self, running: bool) -> None:
+        self._persist_balancer_state(running=bool(running))
+
+    def _persist_balancer_state(self, running=None) -> None:
+        """Write the balancer's StatefulService record (config + progress,
+        ContainerBalancer.java:281 saveConfiguration) through the store so
+        restart and failover resume mid-run."""
+        svc = self.containers.service_state("balancer") or {}
+        if running is None:
+            running = bool(svc.get("running"))
+        cfg, st = self.balancer.config, self.balancer.status
+        self.containers.persist_service_state("balancer", {
+            "running": bool(running),
+            "threshold": cfg.threshold,
+            "max_moves_per_iteration": cfg.max_moves_per_iteration,
+            "max_size_per_iteration": cfg.max_size_per_iteration,
+            "iterations": st.iterations,
+            "moves_scheduled": st.moves_scheduled,
+            "bytes_scheduled": st.bytes_scheduled,
+        })
+
+    def balancer_status(self) -> dict:
+        """Live progress: in-memory counters run ahead of the persisted
+        row on move-less iterations (which are not persisted), so report
+        whichever is larger — status must not look frozen while
+        running."""
+        svc = self.containers.service_state("balancer") or {}
+        st = self.balancer.status
+        return {
+            "running": self.balancer_enabled,
+            "iterations": max(st.iterations,
+                              int(svc.get("iterations", 0))),
+            "moves_scheduled": max(st.moves_scheduled,
+                                   int(svc.get("moves_scheduled", 0))),
+            "bytes_scheduled": max(st.bytes_scheduled,
+                                   int(svc.get("bytes_scheduled", 0))),
+            "threshold": float(
+                svc.get("threshold", self.balancer.config.threshold)),
+        }
 
     # ------------------------------------------------------------- security
     def ensure_secret_key(self) -> None:
@@ -289,7 +378,15 @@ class StorageContainerManager:
             self.block_deleting.run_once()
             self.containers.resend_closing()
             if self.balancer_enabled:
-                self.balancer.run_iteration()
+                # replicated row first: a freshly promoted follower must
+                # balance with the operator's config, not defaults
+                self._hydrate_balancer_from_state()
+                moves = self.balancer.run_iteration()
+                if moves:
+                    # persist progress only when something was scheduled —
+                    # an idle tick must not append a WAL/replication
+                    # record every second
+                    self._persist_balancer_state()
 
     def start_background(self, interval_s: float = 1.0) -> None:
         def loop():

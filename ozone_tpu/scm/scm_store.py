@@ -55,12 +55,43 @@ class ScmStore:
                 "SELECT v FROM meta WHERE k='node_op_states'"
             ).fetchone()
         counters = json.loads(meta[0]) if meta else [1, 1]
+        with self._lock:
+            svc = self._conn.execute(
+                "SELECT v FROM meta WHERE k='service_states'"
+            ).fetchone()
         return {
             "containers": [json.loads(r[0]) for r in rows],
             "next_container_id": counters[0],
             "next_local_id": counters[1],
             "node_op_states": json.loads(ops[0]) if ops else {},
+            "service_states": json.loads(svc[0]) if svc else {},
         }
+
+    def replace_service_states(self, states: dict) -> None:
+        """Replace-all write of the service-state map (snapshot install)."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta VALUES ('service_states', ?)",
+                (json.dumps(states),),
+            )
+            self._conn.commit()
+
+    def save_service_state(self, name: str, state: dict) -> None:
+        """Durably record a background service's config + progress (the
+        reference's StatefulServiceStateManager rows,
+        StatefulServiceStateManagerImpl.java:71): a restarted or failed-
+        over SCM resumes the service where it stopped."""
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT v FROM meta WHERE k='service_states'"
+            ).fetchone()
+            states = json.loads(row[0]) if row else {}
+            states[name] = state
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta VALUES ('service_states', ?)",
+                (json.dumps(states),),
+            )
+            self._conn.commit()
 
     def save_node_op_state(self, dn_id: str, state: str) -> None:
         """Durably record a node's operational state (IN_SERVICE clears

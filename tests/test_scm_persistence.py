@@ -218,3 +218,93 @@ def test_recovery_marks_retired_pipelines_closed(tmp_path):
     assert states[closed_pid] is PipelineState.CLOSED
     assert states[live_pid] is PipelineState.OPEN
     scm2.stop()
+
+
+def _imbalanced_scm(db):
+    """SCM with one hot node holding a movable CLOSED container."""
+    from ozone_tpu.scm.container_manager import ContainerReplica
+
+    scm = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                  dead_after_s=2e6, min_datanodes=1)
+    for i in range(4):
+        scm.register_datanode(f"dn{i}", capacity_bytes=1000)
+    g = scm.containers.allocate_block(
+        ReplicationConfig.ratis(1), 100,
+        excluded=["dn1", "dn2", "dn3"])
+    c = scm.containers.get(g.container_id)
+    c.used_bytes = 500
+    scm.containers.mark_closed(c.id)
+    c.replicas["dn0"] = ContainerReplica("dn0", "CLOSED", 0)
+    scm.nodes.get("dn0").used_bytes = 900
+    scm.nodes.get("dn1").used_bytes = 500
+    scm.nodes.get("dn2").used_bytes = 500
+    scm.nodes.get("dn3").used_bytes = 50
+    scm.safemode.force(False)
+    return scm
+
+
+def test_balancer_state_survives_restart(tmp_path):
+    """Balancer config + iteration progress persist through the SCM
+    store (StatefulServiceStateManager analog,
+    ContainerBalancer.java:67,281): an SCM killed mid-run comes back
+    BALANCING, with the operator's config and the progress counters."""
+    db = tmp_path / "scm.db"
+    scm = _imbalanced_scm(db)
+    scm.apply_admin_op("balancer-start", {"threshold": 0.2,
+                                          "max_moves_per_iteration": 3})
+    scm.run_background_once()
+    st = scm.balancer_status()
+    assert st["running"] and st["iterations"] == 1
+    assert st["moves_scheduled"] == 1
+    assert st["bytes_scheduled"] == 500
+    scm.stop()  # "kill" mid-run: no balancer-stop was issued
+
+    scm2 = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                   dead_after_s=2e6, min_datanodes=1)
+    assert scm2.balancer_enabled  # resumes without operator action
+    st2 = scm2.balancer_status()
+    assert st2["iterations"] == 1 and st2["moves_scheduled"] == 1
+    assert st2["threshold"] == 0.2
+    assert scm2.balancer.config.max_moves_per_iteration == 3
+    # a stopped balancer stays stopped across restart
+    scm2.apply_admin_op("balancer-stop")
+    scm2.stop()
+    scm3 = StorageContainerManager(db_path=db, stale_after_s=1e6,
+                                   dead_after_s=2e6, min_datanodes=1)
+    assert not scm3.balancer_enabled
+    st3 = scm3.balancer_status()
+    assert st3["iterations"] == 1  # progress history kept
+    scm3.stop()
+
+
+def test_balancer_state_replicates_to_ha_follower(tmp_path):
+    """The balancer's service-state row rides the SCM-HA mutation log:
+    a promoted follower sees the running flag + progress and resumes
+    balancing with no re-start command (ContainerBalancer.java:391
+    shouldRun after failover)."""
+    from ozone_tpu.scm.ha import ReplicatedSCM
+
+    leader_scm = _imbalanced_scm(tmp_path / "a.db")
+    follower_scm = StorageContainerManager(
+        db_path=tmp_path / "b.db", stale_after_s=1e6, dead_after_s=2e6,
+        min_datanodes=1)
+    leader = ReplicatedSCM(leader_scm, tmp_path / "a.wal", "scm-a",
+                           is_leader=True)
+    follower = ReplicatedSCM(follower_scm, tmp_path / "b.wal", "scm-b")
+    follower.bootstrap_from(leader)
+    leader_scm.apply_admin_op("balancer-start", {"threshold": 0.25})
+    leader_scm.run_background_once()
+    assert follower_scm.balancer_enabled
+    assert follower_scm.balancer_status()["iterations"] == 1
+    follower.promote()
+    assert follower_scm.balancer_enabled
+    # the promoted follower balances with the OPERATOR'S replicated
+    # config and progress — not its in-memory defaults, and its first
+    # idle tick must not clobber the replicated record
+    follower_scm.safemode.force(False)
+    follower_scm.run_background_once()
+    assert follower_scm.balancer.config.threshold == 0.25
+    st = follower_scm.balancer_status()
+    assert st["iterations"] == 1 and st["threshold"] == 0.25
+    leader_scm.stop()
+    follower_scm.stop()
