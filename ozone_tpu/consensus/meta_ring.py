@@ -148,6 +148,10 @@ class MetaHARing(RaftSCM):
                 "OM_PREPARED",
                 "OM is prepared for upgrade; writes are rejected until "
                 "cancelprepare")
+        # layout gating at the same admission point as the standalone
+        # submit: only the leader admits, so a mixed-version ring stays
+        # deterministic (followers apply whatever was admitted)
+        self.om.check_layout_allowed(type(request).__name__)
         request.pre_execute(self.om)
         result = self.node.propose({"om": request.to_json()})
         # block allocation in preExecute produced SCM decision records;

@@ -93,6 +93,11 @@ class S3Gateway:
         self.require_auth = require_auth
         # signed-request freshness window (AWS: 15 min); 0 disables
         self.max_clock_skew_s = max_clock_skew_s
+        # layout-feature view (refreshed from the OM on a short TTL):
+        # gates gateway-side feature paths like aws-chunked uploads
+        self._upgrade_cache: Optional[dict] = None
+        self._upgrade_cache_t = 0.0
+        self.upgrade_cache_ttl_s = 5.0
         try:
             client.om.create_volume(S3_VOLUME)
         except _OM_ERRORS:
@@ -208,6 +213,14 @@ class S3Gateway:
             auth, max_skew_s=self.max_clock_skew_s or None,
         )
         if str(h.headers.get("x-amz-content-sha256", "")) == STREAMING:
+            if not self._feature_allowed("S3_CHUNKED_UPLOAD"):
+                # layout-gated gateway feature (RequestFeatureValidator
+                # pattern applied at the S3 admission point): refuse
+                # until the cluster finalizes
+                raise AuthError(
+                    "NotImplemented",
+                    "aws-chunked uploads need layout feature "
+                    "S3_CHUNKED_UPLOAD; cluster is not finalized")
             # chunked-signature streaming PUT (ObjectEndpointStreaming):
             # verify the chunk chain and hand the DECODED payload to the
             # object op; declared decoded length must match
@@ -227,6 +240,26 @@ class S3Gateway:
                                     f"decoded {len(decoded)} != {declared}")
             h._cached_body = decoded
         return auth.access_id
+
+    def _feature_allowed(self, name: str) -> bool:
+        """Is a layout-gated feature finalized cluster-wide? Served from
+        the OM's UpgradeStatus with a short cache. Fails OPEN on a
+        status-fetch error: an unreachable OM will fail the actual
+        upload anyway, and gating only matters while the (reachable)
+        cluster is mid-upgrade."""
+        import time as _time
+
+        now = _time.monotonic()
+        if (self._upgrade_cache is None
+                or now - self._upgrade_cache_t > self.upgrade_cache_ttl_s):
+            try:
+                self._upgrade_cache = self.client.om.upgrade_status()
+                self._upgrade_cache_t = now
+            except Exception:  # noqa: BLE001
+                return True
+        feats = {f["name"]: f.get("allowed", True)
+                 for f in self._upgrade_cache.get("features", [])}
+        return bool(feats.get(name, True))
 
     def _authenticate_presigned(self, h, method: str, u) -> str:
         if str(h.headers.get("x-amz-content-sha256", "")) == STREAMING:
@@ -328,7 +361,8 @@ class S3Gateway:
             status = (400 if "Malformed" in e.code or e.code in
                       ("InvalidRequest", "InvalidArgument",
                        "IncompleteBody",
-                       "AuthorizationQueryParametersError") else 403)
+                       "AuthorizationQueryParametersError")
+                      else 501 if e.code == "NotImplemented" else 403)
             h._reply(*_err(e.code, str(e), status))
         except _OM_ERRORS as e:
             code = {

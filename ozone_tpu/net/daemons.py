@@ -108,8 +108,20 @@ class DatanodeDaemon:
 
         self.secrets = SecretKeyManager(generate=False)
         self.verifier = BlockTokenVerifier(self.secrets, enabled=False)
+        # layout-version / upgrade finalization (reference
+        # VersionedDatanodeFeatures + finalizeNewLayoutVersion command);
+        # the gRPC service gates layout-gated verbs on it
+        from ozone_tpu.utils.upgrade import (
+            LayoutVersionManager,
+            UpgradeFinalizer,
+        )
+
+        self.layout = LayoutVersionManager(Path(root) /
+                                           "layout_version.json")
+        self.finalizer = UpgradeFinalizer(self.layout)
         self.service = DatanodeGrpcService(self.dn, self.server,
-                                           verifier=self.verifier)
+                                           verifier=self.verifier,
+                                           layout=self.layout)
         # datanode raft pipelines (XceiverServerRatis analog): raft RPCs
         # and the client Submit/Watch surface ride the same RpcServer
         from ozone_tpu.net.raft_transport import RaftRpcService
@@ -145,7 +157,13 @@ class DatanodeDaemon:
         # prefer the nearest surviving replicas
         self.clients.location = rack
         self.clients.node_id = dn_id
-        self.reconstruction = ECReconstructionCoordinator(self.clients)
+        # multi-chip hosts repair across every local chip (DP over the
+        # default mesh); single-chip hosts take the fused path
+        from ozone_tpu.parallel.sharded import default_codec_mesh
+
+        self._codec_mesh = default_codec_mesh()
+        self.reconstruction = ECReconstructionCoordinator(
+            self.clients, mesh=self._codec_mesh)
         self._pending_acks: list[int] = []
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None
@@ -155,19 +173,9 @@ class DatanodeDaemon:
         from ozone_tpu.storage.scrubber import DeviceScrubber
 
         self.scan_interval = scan_interval_s
-        self._scrubber = DeviceScrubber()
+        self._scrubber = DeviceScrubber(mesh=self._codec_mesh)
         self._scan_cursor = 0
         self._scanner: Optional[threading.Thread] = None
-        # layout-version / upgrade finalization (reference
-        # VersionedDatanodeFeatures + finalizeNewLayoutVersion command)
-        from ozone_tpu.utils.upgrade import (
-            LayoutVersionManager,
-            UpgradeFinalizer,
-        )
-
-        self.layout = LayoutVersionManager(Path(root) /
-                                           "layout_version.json")
-        self.finalizer = UpgradeFinalizer(self.layout)
         # persisted operational state (reference persistedOpState): set
         # by SCM commands, echoed back at registration so a restarted
         # SCM relearns an in-progress drain

@@ -37,9 +37,14 @@ class DatanodeGrpcService:
     id match all checked; failure surfaces as
     BLOCK_TOKEN_VERIFICATION_FAILED without executing the verb."""
 
-    def __init__(self, dn: Datanode, server: RpcServer, verifier=None):
+    def __init__(self, dn: Datanode, server: RpcServer, verifier=None,
+                 layout=None):
         self.dn = dn
         self.verifier = verifier
+        #: LayoutVersionManager of the hosting daemon — verbs introduced
+        #: by a layout feature are refused until the datanode finalizes
+        #: (the DN side of RequestFeatureValidator-style gating)
+        self.layout = layout
         server.add_service(
             SERVICE,
             {
@@ -99,6 +104,19 @@ class DatanodeGrpcService:
         the response is the committed BlockData."""
         from ozone_tpu.utils.checksum import Checksum, ChecksumType
 
+        if self.layout is not None:
+            from ozone_tpu.utils.upgrade import (
+                PRE_FINALIZE_ERROR,
+                RATIS_STREAMING_WRITE,
+            )
+
+            if not self.layout.is_allowed(RATIS_STREAMING_WRITE):
+                raise StorageError(
+                    PRE_FINALIZE_ERROR,
+                    f"StreamWriteBlock needs layout feature "
+                    f"{RATIS_STREAMING_WRITE.name} "
+                    f"(v{RATIS_STREAMING_WRITE.version}); datanode is at "
+                    f"layout {self.layout.metadata_version}")
         it = iter(frames)
         header, _ = wire.unpack(next(it))
         block_id = BlockID.from_json(header["block_id"])

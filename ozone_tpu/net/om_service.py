@@ -164,6 +164,9 @@ class OmGrpcService:
                         m["access_id"], m.get("create", True)
                     )
                 ),
+                "UpgradeStatus": self._wrap(
+                    lambda m: self.om.upgrade_status()
+                ),
                 "RevokeS3Secret": self._wrap(
                     lambda m: self.om.revoke_s3_secret(m["access_id"])
                 ),
@@ -651,6 +654,9 @@ class GrpcOmClient:
     def set_bucket_attrs(self, volume, bucket, attrs):
         return self._call("SetBucketAttrs", volume=volume,
                           bucket=bucket, attrs=attrs)["result"]
+
+    def upgrade_status(self):
+        return self._call("UpgradeStatus")["result"]
 
     # s3 secrets / acl
     def get_s3_secret(self, access_id, create=True):

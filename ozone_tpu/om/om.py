@@ -241,9 +241,52 @@ class OzoneManager:
         return getattr(self, "_prepared", False)
 
     # ----------------------------------------------------------- write path
+    def check_layout_allowed(self, request_name: str) -> None:
+        """Layout-feature request gating (RequestFeatureValidator.java:84
+        via RequestValidations.java:108): a request touching a feature
+        the cluster has not finalized yet is refused at admission. Runs
+        on the leader's preExecute side — followers apply whatever the
+        leader admitted, so a mixed ring stays deterministic."""
+        from ozone_tpu.utils.upgrade import (
+            GATED_OM_REQUESTS,
+            PRE_FINALIZE_ERROR,
+        )
+
+        feat = GATED_OM_REQUESTS.get(request_name)
+        lvm = getattr(self.scm, "layout", None)
+        if feat is None or lvm is None:
+            return
+        if not lvm.is_allowed(feat):
+            raise rq.OMError(
+                PRE_FINALIZE_ERROR,
+                f"{request_name} needs layout feature {feat.name} "
+                f"(v{feat.version}); cluster is at layout "
+                f"{lvm.metadata_version} — run `admin finalizeupgrade`",
+            )
+
+    def upgrade_status(self) -> dict:
+        """Cluster finalization view (UpgradeFinalizer.status analog),
+        served over the OM protocol so gateways can gate their own
+        feature paths (see S3 aws-chunked)."""
+        fin = getattr(self.scm, "finalizer", None)
+        if fin is None:
+            from ozone_tpu.utils.upgrade import FEATURES, LATEST_VERSION
+
+            return {
+                "metadata_version": LATEST_VERSION,
+                "software_version": LATEST_VERSION,
+                "needs_finalization": False,
+                "features": [
+                    {"name": f.name, "version": f.version, "allowed": True}
+                    for f in FEATURES
+                ],
+            }
+        return fin.status()
+
     def submit(self, request: rq.OMRequest) -> Any:
         """preExecute on the leader, then apply (the future Raft boundary
         sits between the two)."""
+        self.check_layout_allowed(type(request).__name__)
         if self.prepared:
             raise rq.OMError(
                 "OM_PREPARED",

@@ -30,6 +30,20 @@ class LayoutFeature:
 
 #: Feature catalog (grows monotonically; versions never reused).
 INITIAL_VERSION = 0
+BUCKET_SNAPSHOTS = LayoutFeature(
+    "BUCKET_SNAPSHOTS", 3,
+    "bucket snapshot create/delete verbs (OMLayoutFeature analog: "
+    "FILESYSTEM_SNAPSHOT)",
+)
+S3_CHUNKED_UPLOAD = LayoutFeature(
+    "S3_CHUNKED_UPLOAD", 4,
+    "aws-chunked signed streaming uploads at the S3 gateway",
+)
+RATIS_STREAMING_WRITE = LayoutFeature(
+    "RATIS_STREAMING_WRITE", 5,
+    "client-streaming block writes on the datanode "
+    "(HDDSLayoutFeature analog: RATIS_DATASTREAM_PORT...)",
+)
 FEATURES = [
     LayoutFeature("INITIAL", 0, "base layout"),
     LayoutFeature(
@@ -39,8 +53,24 @@ FEATURES = [
     LayoutFeature(
         "OM_REPLICATED_LOG", 2, "OM HA request-log replication"
     ),
+    BUCKET_SNAPSHOTS,
+    S3_CHUNKED_UPLOAD,
+    RATIS_STREAMING_WRITE,
 ]
 LATEST_VERSION = max(f.version for f in FEATURES)
+
+#: OM request classes gated on a layout feature — the admission path
+#: (OzoneManager.submit) refuses these before the cluster finalizes,
+#: the RequestFeatureValidator mechanism
+#: (request/validation/RequestFeatureValidator.java:33,84 routed by
+#: RequestValidations.java:108). Keyed by request class name so the
+#: request module needs no import of this one.
+GATED_OM_REQUESTS = {
+    "CreateSnapshot": BUCKET_SNAPSHOTS,
+    "DeleteSnapshot": BUCKET_SNAPSHOTS,
+}
+
+PRE_FINALIZE_ERROR = "NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION"
 
 
 class FinalizationState(Enum):

@@ -421,3 +421,242 @@ def test_snapdiff_fso_directory_rename(cluster):
     assert diff["renamed"] == [["dir/a", "moved/a"], ["dir/b", "moved/b"]]
     assert diff["added"] == ["moved/c"]
     assert diff["deleted"] == [] and diff["modified"] == []
+
+
+def test_layout_feature_gating_pre_finalize(tmp_path):
+    """Request admission is layout-gated (RequestFeatureValidator.java:
+    33,84 via RequestValidations.java:108): on a cluster running new
+    software over OLD metadata, the snapshot verbs (OM), StreamWriteBlock
+    (DN) and aws-chunked uploads (S3 gateway) are refused until
+    `admin finalizeupgrade` — then all three work."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.gateway.s3 import S3Gateway
+    from ozone_tpu.gateway.s3_auth import sign_request_streaming
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.dn_service import GrpcDatanodeClient
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.net.scm_service import GrpcScmClient
+    from ozone_tpu.storage.ids import BlockID, StorageError
+    import time
+
+    # new binaries over old (v2) metadata — finalization pending
+    for d in ("dn0", "dn1", "dn2", "dn3", "dn4"):
+        (tmp_path / d).mkdir(parents=True)
+        (tmp_path / d / "layout_version.json").write_text(
+            _json.dumps({"layout_version": 2}))
+    (tmp_path / "layout_version.json").write_text(
+        _json.dumps({"layout_version": 2}))
+
+    meta = ScmOmDaemon(tmp_path / "om.db", block_size=4 * 4096,
+                       stale_after_s=1000.0, dead_after_s=2000.0,
+                       background_interval_s=0.3)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.1) for i in range(5)]
+    for d in dns:
+        d.start()
+    gw = None
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        oz.create_volume("v").create_bucket("b", replication=EC)
+
+        # OM verb: snapshot create refused pre-finalize (over the wire
+        # the OMError code rides the rpc detail as a StorageError)
+        with pytest.raises((OMError, StorageError)) as ei:
+            oz.om.create_snapshot("v", "b", "s1")
+        assert ei.value.code == "NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION"
+
+        # DN verb: streaming write refused pre-finalize
+        c = GrpcDatanodeClient("dn0", dns[0].address)
+        c.create_container(42, replica_index=1)
+        with pytest.raises(StorageError) as se:
+            c.stream_write_block(BlockID(42, 1), [b"x" * 100])
+        assert se.value.code == "NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION"
+
+        # S3 gateway: aws-chunked upload refused pre-finalize
+        gw = S3Gateway(oz, replication=EC)
+        gw.upgrade_cache_ttl_s = 0.0
+        gw.start()
+        secret = meta.om.get_s3_secret("u1")
+        urllib.request.urlopen(urllib.request.Request(
+            f"http://{gw.address}/cb", method="PUT"))
+        url = f"http://{gw.address}/cb/chunked"
+        import datetime as _dt
+        now = _dt.datetime.now(_dt.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+        headers, body = sign_request_streaming(
+            "u1", secret, "PUT", url,
+            {"host": gw.address, "x-amz-date": now}, b"p" * 50_000,
+            chunk_size=16 * 1024)
+        with pytest.raises(urllib.error.HTTPError) as he:
+            urllib.request.urlopen(urllib.request.Request(
+                url, data=body, method="PUT", headers=headers))
+        assert he.value.code == 501
+
+        # finalize cluster-wide
+        scm = GrpcScmClient(meta.address)
+        out = scm.admin("finalize-upgrade")
+        assert out["scm"] == "FINALIZATION_DONE"
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(not d.layout.needs_finalization() for d in dns):
+                break
+            time.sleep(0.1)
+
+        # all three now work
+        oz.om.create_snapshot("v", "b", "s1")
+        bd = c.stream_write_block(BlockID(42, 1), [b"x" * 100])
+        assert bd.length == 100
+        headers, body = sign_request_streaming(
+            "u1", secret, "PUT", url,
+            {"host": gw.address, "x-amz-date": now}, b"p" * 50_000,
+            chunk_size=16 * 1024)
+        r = urllib.request.urlopen(urllib.request.Request(
+            url, data=body, method="PUT", headers=headers))
+        assert r.status == 200
+        c.close()
+        scm.close()
+    finally:
+        if gw is not None:
+            gw.stop()
+        for d in dns:
+            d.stop()
+        meta.stop()
+
+
+def test_layout_gating_mixed_version_datanodes(tmp_path):
+    """Mixed-software cluster: a datanode still running OLD software
+    (software_version=2) finalizes only to ITS version when the cluster
+    finalizes — gated verbs stay refused there while upgraded nodes
+    serve them (the reference's per-node VersionedDatanodeFeatures)."""
+    import json as _json
+
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.dn_service import GrpcDatanodeClient
+    from ozone_tpu.net.scm_service import GrpcScmClient
+    from ozone_tpu.storage.ids import BlockID, StorageError
+    from ozone_tpu.utils import upgrade as ug
+    import time
+
+    for d in ("dn0", "dn1"):
+        (tmp_path / d).mkdir(parents=True)
+        (tmp_path / d / "layout_version.json").write_text(
+            _json.dumps({"layout_version": 2}))
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1000.0,
+                       dead_after_s=2000.0)
+    meta.start()
+    dns = [DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", meta.address,
+                          heartbeat_interval_s=0.1) for i in range(2)]
+    # dn1 runs old software: its manager cannot finalize past v2
+    dns[1].layout.software_version = 2
+    dns[1].finalizer.manager = dns[1].layout
+    for d in dns:
+        d.start()
+    try:
+        scm = GrpcScmClient(meta.address)
+        scm.admin("finalize-upgrade")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if dns[0].layout.metadata_version == ug.LATEST_VERSION:
+                break
+            time.sleep(0.1)
+        assert dns[0].layout.metadata_version == ug.LATEST_VERSION
+        assert dns[1].layout.metadata_version == 2  # old software ceiling
+
+        for i in (0, 1):
+            c = GrpcDatanodeClient(f"dn{i}", dns[i].address)
+            c.create_container(7 + i, replica_index=1)
+            if i == 0:
+                assert c.stream_write_block(
+                    BlockID(7, 1), [b"y" * 10]).length == 10
+            else:
+                with pytest.raises(StorageError) as se:
+                    c.stream_write_block(BlockID(8, 1), [b"y" * 10])
+                assert se.value.code == \
+                    "NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION"
+            c.close()
+        scm.close()
+    finally:
+        for d in dns:
+            d.stop()
+        meta.stop()
+
+
+def test_layout_gating_across_ha_ring(tmp_path):
+    """Finalization is a replicated admin decision on the metadata ring:
+    gated verbs are refused ring-wide pre-finalize, one finalize bumps
+    every replica, and the verbs keep working after a failover."""
+    import json as _json
+    import time
+
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.net.scm_service import GrpcScmClient
+    from ozone_tpu.storage.ids import StorageError
+    from ozone_tpu.testing.minicluster import (
+        await_meta_leader,
+        free_ports,
+        make_meta_daemon,
+    )
+    from ozone_tpu.utils import upgrade as ug
+
+    ports = free_ports(3)
+    peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(3)}
+    for i in range(3):
+        d = tmp_path / f"meta{i}"
+        d.mkdir(parents=True)
+        (d / "layout_version.json").write_text(
+            _json.dumps({"layout_version": 2}))
+    metas = {}
+    try:
+        for i in range(3):
+            d = make_meta_daemon(tmp_path, i, peers)
+            d.start()
+            metas[f"m{i}"] = d
+        await_meta_leader(metas)
+        oms = ",".join(peers.values())
+        om = GrpcOmClient(oms)
+        om.create_volume("v")
+        om.create_bucket("v", "b", "rs-3-2-4096")
+        with pytest.raises((OMError, StorageError)) as ei:
+            om.create_snapshot("v", "b", "s1")
+        assert ei.value.code == "NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION"
+
+        scm = GrpcScmClient(oms)
+        scm.admin("finalize-upgrade")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(m.scm.layout.metadata_version == ug.LATEST_VERSION
+                   for m in metas.values()):
+                break
+            time.sleep(0.1)
+        assert all(m.scm.layout.metadata_version == ug.LATEST_VERSION
+                   for m in metas.values())
+        om.create_snapshot("v", "b", "s1")
+
+        # failover: kill the leader; the new leader still serves the
+        # finalized feature
+        leader = next(m for m in metas.values() if m.ha.is_leader)
+        leader.stop()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                om.create_snapshot("v", "b", "s2")
+                break
+            except StorageError:
+                time.sleep(0.3)
+        names = [s["name"] for s in om.list_snapshots("v", "b")]
+        assert names == ["s1", "s2"]
+        scm.close()
+        om.close()
+    finally:
+        for m in metas.values():
+            try:
+                m.stop()
+            except Exception:
+                pass
