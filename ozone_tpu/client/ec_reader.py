@@ -51,7 +51,15 @@ class ECBlockGroupReader:
         verify: bool = True,
         checksum: ChecksumType = ChecksumType.CRC32C,
         bytes_per_checksum: int = 16 * 1024,
+        mesh=None,
+        use_ring: bool = False,
     ):
+        #: optional jax.sharding.Mesh: recovery decodes run stripe-
+        #: parallel (DP) over it — or survivor-sharded around the
+        #: ppermute ring with use_ring=True — instead of single-device
+        #: (parallel/sharded.py; the multi-chip production path)
+        self.mesh = mesh
+        self.use_ring = use_ring
         self.group = group
         self.opts = options
         self.k, self.p, self.cell = (
@@ -209,9 +217,31 @@ class ECBlockGroupReader:
         for bi, s in enumerate(stripes):
             for vi, u in enumerate(valid):
                 batch[bi, vi] = self._read_cell_checked(u, s)
+        if self.mesh is not None:
+            return self._decode_on_mesh(batch, valid, list(targets))
         fn = make_fused_decoder(self.spec, valid, list(targets))
         rec, crcs = fn(batch)
         return np.asarray(rec), np.asarray(crcs)
+
+    def _decode_on_mesh(
+        self, batch: np.ndarray, valid: list[int], targets: list[int]
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Multi-chip decode (ECReconstructionCoordinator.java:146 run on
+        a device mesh instead of one device): DP shards the stripe batch;
+        the SP ring shards SURVIVORS (one group per chip — the layout
+        where each chip fronts one source datanode's bytes)."""
+        from ozone_tpu.parallel import sharded
+
+        if self.use_ring:
+            fn = sharded.make_ring_decoder(
+                self.spec, valid, targets, self.mesh)
+            rec, crcs = fn(batch)
+            return np.asarray(rec), np.asarray(crcs)
+        fn = sharded.make_sharded_decoder(
+            self.spec, valid, targets, self.mesh)
+        padded, orig = sharded.pad_batch(batch, self.mesh.devices.size)
+        rec, crcs = fn(padded)
+        return np.asarray(rec)[:orig], np.asarray(crcs)[:orig]
 
     def _read_reconstructed(self) -> np.ndarray:
         avail = set(self.available_units())

@@ -68,6 +68,19 @@ def make_mesh(
     return Mesh(np.array(devs), (axis,))
 
 
+def default_codec_mesh(axis: str = "dn") -> Optional[Mesh]:
+    """Production mesh policy: all local devices when more than one is
+    attached, None (single-chip fused path) otherwise. Datanode daemons
+    and the minicluster hand this to the reconstruction coordinator and
+    scrubber so multi-chip hosts repair/scrub across every chip without
+    configuration."""
+    try:
+        n = jax.device_count()
+    except Exception:  # noqa: BLE001 - no backend: single-device path
+        return None
+    return make_mesh(axis=axis) if n > 1 else None
+
+
 def pad_batch(batch: np.ndarray, n: int) -> tuple[np.ndarray, int]:
     """Pad the leading axis to a multiple of n; returns (padded, original)."""
     b = batch.shape[0]

@@ -60,10 +60,19 @@ class ECReconstructionCoordinator:
         clients: DatanodeClientFactory,
         checksum: ChecksumType = ChecksumType.CRC32C,
         bytes_per_checksum: int = 16 * 1024,
+        mesh=None,
+        use_ring: bool = False,
     ):
         self.clients = clients
         self.checksum = checksum
         self.bpc = bytes_per_checksum
+        #: device mesh for the decode: stripe-parallel (DP) by default,
+        #: survivor-sharded ring (SP) with use_ring — the reference runs
+        #: its codec inside this same repair flow
+        #: (ECReconstructionCoordinator.java:98,146); here the flow is
+        #: the one that owns the mesh
+        self.mesh = mesh
+        self.use_ring = use_ring
         self.metrics = MetricsRegistry("ec.reconstruction")
 
     def reconstruct_container_group(self, cmd: ReconstructionCommand) -> None:
@@ -154,6 +163,8 @@ class ECReconstructionCoordinator:
             self.clients,
             checksum=self.checksum,
             bytes_per_checksum=bpc,
+            mesh=self.mesh,
+            use_ring=self.use_ring,
         )
         target_units = [idx - 1 for idx in targets]  # 0-based unit indexes
         cells, crcs = reader.recover_cells_with_crcs(target_units)

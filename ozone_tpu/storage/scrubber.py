@@ -48,10 +48,16 @@ def _next_pow2(n: int) -> int:
 
 
 class DeviceScrubber:
-    """Batched CRC32C verification over container contents."""
+    """Batched CRC32C verification over container contents.
 
-    def __init__(self, max_batch_bytes: int = 64 * 1024 * 1024):
+    With a `mesh`, the slice batch is sharded over it (DP) so one scrub
+    dispatch spreads across every chip — the scrub-side twin of the
+    sharded reconstruction decode (parallel/sharded.py)."""
+
+    def __init__(self, max_batch_bytes: int = 64 * 1024 * 1024,
+                 mesh=None):
         self.max_batch_bytes = max_batch_bytes
+        self.mesh = mesh
         self._fns: dict[int, object] = {}
 
     def _crc_fn(self, bpc: int):
@@ -59,7 +65,21 @@ class DeviceScrubber:
         if fn is None:
             from ozone_tpu.codec.crc_device import make_crc_fn
 
-            fn = self._fns[bpc] = make_crc_fn(bpc)
+            if self.mesh is None:
+                fn = make_crc_fn(bpc)
+            else:
+                import jax
+                from jax.sharding import (
+                    NamedSharding,
+                    PartitionSpec as P,
+                )
+
+                axis = self.mesh.axis_names[0]
+                sharding = NamedSharding(self.mesh, P(axis))
+                fn = jax.jit(make_crc_fn(bpc),
+                             in_shardings=sharding,
+                             out_shardings=sharding)
+            self._fns[bpc] = fn
         return fn
 
     def _dispatch(self, bpc: int, bufs: list, exps: list, labels: list,
@@ -76,6 +96,12 @@ class DeviceScrubber:
             return
         n = len(bufs)
         padded = _next_pow2(n)
+        if self.mesh is not None:
+            # the sharded dim must divide by the mesh — which may be any
+            # size (default_codec_mesh spans all local devices): round
+            # the pow2 up to the next multiple of it
+            m = self.mesh.devices.size
+            padded += (-padded) % m
         batch = np.zeros((padded, bpc), dtype=np.uint8)
         batch[:n] = np.stack(bufs)
         crcs = np.asarray(

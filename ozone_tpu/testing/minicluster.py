@@ -70,7 +70,10 @@ class MiniOzoneCluster:
             clients=self.clients,
             block_size=block_size,
         )
-        self.reconstruction = ECReconstructionCoordinator(self.clients)
+        from ozone_tpu.parallel.sharded import default_codec_mesh
+
+        self.reconstruction = ECReconstructionCoordinator(
+            self.clients, mesh=default_codec_mesh())
         self._stopped_dns: set[str] = set()
         self._hb_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()

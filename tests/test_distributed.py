@@ -129,6 +129,11 @@ def _repoint_groups(meta, groups, victim_id) -> None:
 
 def test_reconstruction_over_grpc(cluster):
     meta, dns = cluster
+    # the daemons' coordinators repair on the device mesh (8 virtual
+    # devices under the test harness) — the production multi-chip path
+    # fed by real gRPC datanode reads
+    assert all(d.reconstruction.mesh is not None
+               and d.reconstruction.mesh.devices.size == 8 for d in dns)
     oz = _client(meta)
     b = oz.create_volume("v").create_bucket("b", replication=EC)
     rng = np.random.default_rng(2)
