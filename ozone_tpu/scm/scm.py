@@ -257,15 +257,7 @@ class StorageContainerManager:
             if isinstance(target, dict):
                 # operator config overrides ride the replicated admin
                 # decision, so every replica balances identically
-                cfg = self.balancer.config
-                cfg.threshold = float(
-                    target.get("threshold", cfg.threshold))
-                cfg.max_moves_per_iteration = int(target.get(
-                    "max_moves_per_iteration",
-                    cfg.max_moves_per_iteration))
-                cfg.max_size_per_iteration = int(target.get(
-                    "max_size_per_iteration",
-                    cfg.max_size_per_iteration))
+                self._apply_balancer_config(target)
             self.balancer_enabled = True
         elif op == "balancer-stop":
             self.balancer_enabled = False
@@ -281,6 +273,19 @@ class StorageContainerManager:
                 **self.safemode.status()}
 
     # ------------------------------------------------------------- balancer
+    def _apply_balancer_config(self, src: dict) -> None:
+        """Copy config knobs present in `src` onto the live config — the
+        ONE field list (dataclasses.fields) shared by operator override,
+        row hydration, and persistence, so a new knob cannot silently
+        drop out of one of them."""
+        import dataclasses
+
+        cfg = self.balancer.config
+        for f in dataclasses.fields(cfg):
+            if f.name in src:
+                cur = getattr(cfg, f.name)
+                setattr(cfg, f.name, type(cur)(src[f.name]))
+
     def _hydrate_balancer_from_state(self) -> None:
         """Pull the replicated service row into the live balancer. The
         row is authoritative for CONFIG (a promoted follower's in-memory
@@ -291,12 +296,8 @@ class StorageContainerManager:
         svc = self.containers.service_state("balancer")
         if not svc:
             return
-        cfg, st = self.balancer.config, self.balancer.status
-        cfg.threshold = float(svc.get("threshold", cfg.threshold))
-        cfg.max_moves_per_iteration = int(svc.get(
-            "max_moves_per_iteration", cfg.max_moves_per_iteration))
-        cfg.max_size_per_iteration = int(svc.get(
-            "max_size_per_iteration", cfg.max_size_per_iteration))
+        self._apply_balancer_config(svc)
+        st = self.balancer.status
         st.iterations = max(st.iterations, int(svc.get("iterations", 0)))
         st.moves_scheduled = max(
             st.moves_scheduled, int(svc.get("moves_scheduled", 0)))
@@ -319,15 +320,15 @@ class StorageContainerManager:
         """Write the balancer's StatefulService record (config + progress,
         ContainerBalancer.java:281 saveConfiguration) through the store so
         restart and failover resume mid-run."""
+        import dataclasses
+
         svc = self.containers.service_state("balancer") or {}
         if running is None:
             running = bool(svc.get("running"))
-        cfg, st = self.balancer.config, self.balancer.status
+        st = self.balancer.status
         self.containers.persist_service_state("balancer", {
             "running": bool(running),
-            "threshold": cfg.threshold,
-            "max_moves_per_iteration": cfg.max_moves_per_iteration,
-            "max_size_per_iteration": cfg.max_size_per_iteration,
+            **dataclasses.asdict(self.balancer.config),
             "iterations": st.iterations,
             "moves_scheduled": st.moves_scheduled,
             "bytes_scheduled": st.bytes_scheduled,
