@@ -118,9 +118,78 @@ def _fused_encode_cached(options: CoderOptions, checksum: ChecksumType, bpc: int
     return fn
 
 
+def _prefer_host_coder() -> bool:
+    """True when jax's default backend is the CPU: XLA's GF(2)
+    bit-matmul formulation is an MXU shape — on plain CPUs the native
+    AVX2 nibble-shuffle coder + SSE4.2 CRC is an order of magnitude
+    faster, so hosts without an accelerator (gateways, CPU clients,
+    CPU datanodes) take the native path. Overridable with
+    OZONE_TPU_FUSED_BACKEND=jax|native."""
+    import os
+
+    forced = os.environ.get("OZONE_TPU_FUSED_BACKEND", "")
+    if forced == "jax":
+        return False
+    if forced == "native":
+        return True
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 - no backend at all
+        return True
+
+
+def _native_crc_slices(units: np.ndarray, bpc: int) -> np.ndarray:
+    """[B, U, C] uint8 -> [B, U, C // bpc] uint32 via the native
+    hardware-CRC slicer; C divides by bpc (FusedSpec contract), so one
+    flat pass never crosses a unit boundary."""
+    from ozone_tpu.codec.cpp_coder import _require_lib
+
+    lib = _require_lib()
+    flat = np.ascontiguousarray(units).reshape(-1)
+    out = np.empty(flat.size // bpc, dtype=np.uint32)
+    lib.crc32c_slices(flat.ctypes.data, flat.size, bpc, out.ctypes.data)
+    return out.reshape(units.shape[0], units.shape[1], -1)
+
+
+@lru_cache(maxsize=16)
+def _native_fused_encoder(options: CoderOptions, checksum: ChecksumType,
+                          bpc: int):
+    """Host twin of the fused device pass: AVX2 GF multiply + hardware
+    CRC32C, same (parity, crcs) contract, numpy in/out. Returns None
+    when the native library or checksum type can't serve it."""
+    if checksum is not ChecksumType.CRC32C:
+        return None
+    try:
+        from ozone_tpu.codec.cpp_coder import _nibble_tables, _apply, \
+            _require_lib
+
+        lib = _require_lib()
+    except Exception:  # noqa: BLE001 - no native lib: jax path
+        return None
+    tables = _nibble_tables(_parity_matrix(options))
+    p, k = options.parity_units, options.data_units
+
+    def fn(data: np.ndarray):
+        data = np.ascontiguousarray(data, dtype=np.uint8)
+        parity = _apply(lib, tables, p, k, data)
+        crcs = np.concatenate(
+            [_native_crc_slices(data, bpc),
+             _native_crc_slices(parity, bpc)], axis=1)
+        return parity, crcs
+
+    return fn
+
+
 def make_fused_encoder(spec: FusedSpec):
-    """jitted fn(data uint8 [B, k, C]) -> (parity [B, p, C],
-    crcs uint32 [B, k+p, C // bpc]). C must divide by bytes_per_checksum."""
+    """fn(data uint8 [B, k, C]) -> (parity [B, p, C],
+    crcs uint32 [B, k+p, C // bpc]). C must divide by bytes_per_checksum.
+    Jitted on accelerator backends; the native AVX2+CRC twin on CPU-only
+    hosts (same registry jax>cpp priority the codec SPI uses)."""
+    if _prefer_host_coder():
+        fn = _native_fused_encoder(spec.options, spec.checksum,
+                                   spec.bytes_per_checksum)
+        if fn is not None:
+            return fn
     return _fused_encode_cached(spec.options, spec.checksum,
                                 spec.bytes_per_checksum)
 
@@ -152,10 +221,41 @@ def _fused_decode_cached(
     return fn
 
 
+@lru_cache(maxsize=64)
+def _native_fused_decoder(options: CoderOptions, checksum: ChecksumType,
+                          bpc: int, valid: tuple, erased: tuple):
+    if checksum is not ChecksumType.CRC32C:
+        return None
+    try:
+        from ozone_tpu.codec.cpp_coder import _nibble_tables, _apply, \
+            _require_lib
+
+        lib = _require_lib()
+    except Exception:  # noqa: BLE001
+        return None
+    dm = _decode_matrix(options, list(valid), list(erased))
+    tables = _nibble_tables(dm)
+    e, kk = len(erased), len(valid)
+
+    def fn(valid_units: np.ndarray):
+        valid_units = np.ascontiguousarray(valid_units, dtype=np.uint8)
+        rec = _apply(lib, tables, e, kk, valid_units)
+        return rec, _native_crc_slices(rec, bpc)
+
+    return fn
+
+
 def make_fused_decoder(spec: FusedSpec, valid: list[int], erased: list[int]):
-    """jitted fn(valid_units uint8 [B, k, C]) -> (recovered [B, e, C],
+    """fn(valid_units uint8 [B, k, C]) -> (recovered [B, e, C],
     crcs uint32 [B, e, C // bpc]). valid lists the unit indexes of the rows
-    supplied, erased the unit indexes to reconstruct."""
+    supplied, erased the unit indexes to reconstruct. Jitted on
+    accelerator backends; native AVX2+CRC twin on CPU-only hosts."""
+    if _prefer_host_coder():
+        fn = _native_fused_decoder(
+            spec.options, spec.checksum, spec.bytes_per_checksum,
+            tuple(valid), tuple(erased))
+        if fn is not None:
+            return fn
     return _fused_decode_cached(
         spec.options, spec.checksum, spec.bytes_per_checksum,
         tuple(valid), tuple(erased),
