@@ -201,6 +201,10 @@ class DatanodeClientFactory:
         #: clients retired by a cert rotation, closed at factory close
         self._retired: list[DatanodeClient] = []
         self._tls_ver = None
+        # maybe_get runs concurrently from writer/reader worker threads
+        # (one per unit stream): the rotation check + cache insert must
+        # be atomic or a stale-cert client can be cached past a rotation
+        self._remote_lock = threading.Lock()
 
     def learn_locations(self, locations: dict[str, str]) -> None:
         if locations:
@@ -252,27 +256,28 @@ class DatanodeClientFactory:
         c = self._local.get(dn_id)
         if c is not None:
             return c
-        # cert rotation (RotatingTls.version bump): drop cached remote
-        # clients so reconnects present the renewed identity, not a
-        # retired cert the peer may no longer trust. Parked, not closed:
-        # an in-flight repair RPC may still be on one (closed at
-        # factory close()).
-        ver = getattr(self.tls, "version", None)
-        if ver != getattr(self, "_tls_ver", None):
-            self._tls_ver = ver
-            self._retired.extend(self._remote.values())
-            self._remote.clear()
-        c = self._remote.get(dn_id)
-        if c is not None:
-            return c
-        addr = self._addresses.get(dn_id)
-        if addr is not None:
-            from ozone_tpu.net.dn_service import GrpcDatanodeClient
+        with self._remote_lock:
+            # cert rotation (RotatingTls.version bump): drop cached
+            # remote clients so reconnects present the renewed identity,
+            # not a retired cert the peer may no longer trust. Parked,
+            # not closed: an in-flight repair RPC may still be on one
+            # (closed at factory close()).
+            ver = getattr(self.tls, "version", None)
+            if ver != getattr(self, "_tls_ver", None):
+                self._tls_ver = ver
+                self._retired.extend(self._remote.values())
+                self._remote.clear()
+            c = self._remote.get(dn_id)
+            if c is not None:
+                return c
+            addr = self._addresses.get(dn_id)
+            if addr is not None:
+                from ozone_tpu.net.dn_service import GrpcDatanodeClient
 
-            c = GrpcDatanodeClient(dn_id, addr, tokens=self.tokens,
-                                   tls=self.tls)
-            self._remote[dn_id] = c
-            return c
+                c = GrpcDatanodeClient(dn_id, addr, tokens=self.tokens,
+                                       tls=self.tls)
+                self._remote[dn_id] = c
+                return c
         return None
 
     def close(self) -> None:

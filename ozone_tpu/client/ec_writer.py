@@ -21,6 +21,7 @@ axis), with per-chunk checksums coming back from the same pass.
 from __future__ import annotations
 
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -232,6 +233,15 @@ class ECKeyWriter:
         self._queue: list[_Stripe] = []
         self._stripe_in_group = 0
         self._closed = False
+        # one worker per unit stream: the k+p chunk RPCs of a stripe
+        # (and the putBlock barrier) go out concurrently — gRPC releases
+        # the GIL, so the stripe wall-time is the slowest node, not the
+        # sum (the reference's per-stream async BlockOutputStreams)
+        self._rpc_pool: Optional[ThreadPoolExecutor] = None
+        # encode pipeline: the device batch in flight (stripes, parity,
+        # crcs device arrays); network writes of batch N overlap the
+        # device encode + device->host pull of batch N+1
+        self._pending: Optional[tuple] = None
 
     # ------------------------------------------------------------------ write
     def write(self, data) -> None:
@@ -266,16 +276,38 @@ class ECKeyWriter:
 
     # ------------------------------------------------------------------ flush
     def _flush_queue(self) -> None:
-        """Encode all queued stripes in one device dispatch, then write and
-        commit them stripe-by-stripe (commit order defines the ack
-        watermark, as in flushStripeFromQueue:526)."""
+        """Encode all queued stripes in one device dispatch; the batch
+        goes in flight (device encode + device->host pull run async) and
+        the PREVIOUS in-flight batch's network writes happen now — a
+        two-stage pipeline that overlaps accelerator work with the RPC
+        fan-out (the role of the reference's async stream executors)."""
         if not self._queue:
             return
         stripes, self._queue = self._queue, []
         batch = np.stack([s.data for s in stripes])  # [B, k, C]
-        parity, crcs = self._fused(batch)
-        parity = np.asarray(parity)
-        crcs = np.asarray(crcs)  # [B, k+p, S] uint32
+        parity_dev, crcs_dev = self._fused(batch)  # async dispatch
+        for a in (parity_dev, crcs_dev):
+            # start the D2H transfer eagerly where the backend supports
+            # it, so it runs under the previous batch's network writes
+            try:
+                a.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+        prev, self._pending = self._pending, (stripes, parity_dev,
+                                              crcs_dev)
+        if prev is not None:
+            self._write_batch(*prev)
+
+    def _drain_pending(self) -> None:
+        prev, self._pending = self._pending, None
+        if prev is not None:
+            self._write_batch(*prev)
+
+    def _write_batch(self, stripes, parity_dev, crcs_dev) -> None:
+        """Write one encoded batch stripe-by-stripe (commit order defines
+        the ack watermark, as in flushStripeFromQueue:526)."""
+        parity = np.asarray(parity_dev)
+        crcs = np.asarray(crcs_dev)  # [B, k+p, S] uint32
 
         for b, stripe in enumerate(stripes):
             for attempt in range(self.max_retries + 1):
@@ -325,11 +357,11 @@ class ECKeyWriter:
         cause: Optional[Exception] = None
         new_chunks: list[Optional[ChunkInfo]] = [None] * (self.k + self.p)
 
-        for u in range(self.k + self.p):
+        def write_unit(u: int):
             is_data = u < self.k
             length = stripe.lengths[u] if is_data else self.cell
             if length == 0:
-                continue
+                return u, None, None
             cell_data = stripe.data[u] if is_data else parity[u - self.k]
             info = ChunkInfo(
                 name=f"{group.block_id}_chunk_{stripe.index}",
@@ -337,15 +369,24 @@ class ECKeyWriter:
                 length=length,
                 checksum=self._chunk_checksum(crcs[u], length, cell_data),
             )
-            dn_id = group.pipeline.nodes[u]
             try:
-                self.clients.get(dn_id).write_chunk(
+                self.clients.get(group.pipeline.nodes[u]).write_chunk(
                     group.block_id, info, cell_data[:length]
                 )
+                return u, info, None
+            except (StorageError, KeyError, OSError) as e:
+                return u, None, e
+
+        # all k+p unit streams in parallel: gRPC releases the GIL, so
+        # the stripe costs the slowest node's RPC, not the sum of nine
+        for u, info, err in self._ensure_pool().map(
+                write_unit, range(self.k + self.p)):
+            if info is not None:
                 new_chunks[u] = info
-            except StorageError as e:
-                cause = e
-                if e.code == "INVALID_CONTAINER_STATE":
+            elif err is not None:
+                cause = err
+                if isinstance(err, StorageError) \
+                        and err.code == "INVALID_CONTAINER_STATE":
                     # container closed under us (filled concurrently /
                     # SCM finalize): the node is healthy — reallocate a
                     # fresh group, never blacklist the whole pipeline;
@@ -354,40 +395,95 @@ class ECKeyWriter:
                     closed = True
                     self._excluded_containers.append(group.container_id)
                 else:
-                    failed.append(dn_id)
-            except (KeyError, OSError) as e:
-                failed.append(dn_id)
-                cause = e
+                    failed.append(group.pipeline.nodes[u])
         if failed or closed:
             raise StripeWriteError(failed, cause)
 
-        # stripe barrier: putBlock on every participating stream
+        # stripe barrier: putBlock on every participating stream —
+        # issued concurrently; the barrier is completion of ALL
         stripe_bytes = sum(stripe.lengths)
         group_len_after = group.length + stripe_bytes
+        puts: list[tuple[str, BlockData]] = []
         for u in range(self.k + self.p):
             if new_chunks[u] is not None:
                 self._group_chunks[u].append(new_chunks[u])
             if not self._group_chunks[u]:
                 continue
-            dn_id = group.pipeline.nodes[u]
-            bd = BlockData(
-                group.block_id,
-                list(self._group_chunks[u]),
-                block_group_length=group_len_after,
-            )
+            puts.append((
+                group.pipeline.nodes[u],
+                BlockData(
+                    group.block_id,
+                    list(self._group_chunks[u]),
+                    block_group_length=group_len_after,
+                ),
+            ))
+
+        def put_unit(entry):
+            dn_id, bd = entry
             try:
                 self.clients.get(dn_id).put_block(bd)
-            except StorageError as e:
-                # putBlock failure fails the whole stripe: the group rolls
-                # over and chunks past the committed length are orphaned.
-                # A closed container is a reallocation signal, not a node
-                # failure — exclude nobody.
-                bad = [] if e.code == "INVALID_CONTAINER_STATE" else [dn_id]
-                raise StripeWriteError(bad, e)
-            except (KeyError, OSError) as e:
-                raise StripeWriteError([dn_id], e)
+                return None
+            except (StorageError, KeyError, OSError) as e:
+                return dn_id, e
+
+        errors = [r for r in self._ensure_pool().map(put_unit, puts)
+                  if r is not None]
+        if errors:
+            all_closed = all(
+                isinstance(e, StorageError)
+                and e.code == "INVALID_CONTAINER_STATE"
+                for _, e in errors)
+            if all_closed:
+                # container filled/closed between the chunk phase and
+                # the barrier: a reallocation signal, not a node fault —
+                # exclude the closed container (like the chunk phase)
+                # and skip the rollback, whose putBlocks against the
+                # closed container could only fail the same way
+                self._excluded_containers.append(group.container_id)
+                raise StripeWriteError([], errors[0][1])
+            # putBlock failure fails the whole stripe: the group rolls
+            # over and chunks past the committed length are orphaned.
+            # The OTHER units' putBlocks (dispatched concurrently) have
+            # already recorded the inflated group length, and offline
+            # reconstruction trusts datanode metadata — roll the
+            # survivors back to the pre-stripe commit so no datanode
+            # reports bytes the client never acked (best-effort: a
+            # node that also fails the rollback keeps the inflated
+            # record, which is no worse than the sequential path's
+            # already-committed prefix).
+            failed_dns = {dn_id for dn_id, _ in errors}
+            rollbacks = []
+            for u in range(self.k + self.p):
+                dn_id = group.pipeline.nodes[u]
+                if dn_id in failed_dns or not self._group_chunks[u]:
+                    continue
+                prev_chunks = (self._group_chunks[u][:-1]
+                               if new_chunks[u] is not None
+                               else list(self._group_chunks[u]))
+                if not prev_chunks:
+                    continue
+                rollbacks.append((dn_id, BlockData(
+                    group.block_id, prev_chunks,
+                    block_group_length=group.length)))
+            for res in self._ensure_pool().map(put_unit, rollbacks):
+                if res is not None:
+                    log.warning("putBlock rollback failed on %s: %s",
+                                res[0], res[1])
+            # A closed container is a reallocation signal, not a node
+            # failure — exclude nobody for those.
+            bad = [d for d, e in errors
+                   if not (isinstance(e, StorageError)
+                           and e.code == "INVALID_CONTAINER_STATE")]
+            raise StripeWriteError(bad, errors[0][1])
         group.length = group_len_after
         self._stripe_in_group += 1
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        if self._rpc_pool is None:
+            self._rpc_pool = ThreadPoolExecutor(
+                max_workers=self.k + self.p,
+                thread_name_prefix="ec-writer")
+        return self._rpc_pool
 
     # ------------------------------------------------------------------ groups
     def _ensure_group(self) -> BlockGroup:
@@ -433,20 +529,26 @@ class ECKeyWriter:
         committed block groups in key order."""
         if self._closed:
             return self._groups
-        # partial stripe: pad for parity, write true lengths
-        if self._cell_idx > 0 or self._cell_off > 0:
-            lengths = [
-                self.cell if i < self._cell_idx
-                else (self._cell_off if i == self._cell_idx else 0)
-                for i in range(self.k)
-            ]
-            self._queue.append(_Stripe(self._buf, lengths))
-            self._buf = np.zeros((self.k, self.cell), dtype=np.uint8)
-            self._cell_idx = 0
-            self._cell_off = 0
-        self._flush_queue()
-        self._finalize_group()
-        self._closed = True
+        try:
+            # partial stripe: pad for parity, write true lengths
+            if self._cell_idx > 0 or self._cell_off > 0:
+                lengths = [
+                    self.cell if i < self._cell_idx
+                    else (self._cell_off if i == self._cell_idx else 0)
+                    for i in range(self.k)
+                ]
+                self._queue.append(_Stripe(self._buf, lengths))
+                self._buf = np.zeros((self.k, self.cell), dtype=np.uint8)
+                self._cell_idx = 0
+                self._cell_off = 0
+            self._flush_queue()
+            self._drain_pending()  # the last in-flight encoded batch
+            self._finalize_group()
+            self._closed = True
+        finally:
+            if self._rpc_pool is not None:
+                self._rpc_pool.shutdown(wait=True)
+                self._rpc_pool = None
         return self._groups
 
     @property
@@ -454,5 +556,7 @@ class ECKeyWriter:
         done = sum(g.length for g in self._groups)
         cur = self._group.length if self._group else 0
         queued = sum(sum(s.lengths) for s in self._queue)
+        inflight = (sum(sum(s.lengths) for s in self._pending[0])
+                    if self._pending is not None else 0)
         partial = self._cell_idx * self.cell + self._cell_off
-        return done + cur + queued + partial
+        return done + cur + queued + inflight + partial

@@ -222,3 +222,50 @@ def test_checksums_stored_and_verified(cluster):
     path.write_bytes(bytes(raw))
     got = cluster.reader(g).read_all()
     assert np.array_equal(got, data)
+
+
+class FlakyPutBlockClient(LocalDatanodeClient):
+    """Fails put_block call number `fail_call` (0-based; chunks always
+    succeed), so a chosen stripe's commit phase fails mid-flight."""
+
+    def __init__(self, dn, fail_call=1):
+        super().__init__(dn)
+        self.fail_call = fail_call
+        self.calls = 0
+
+    def put_block(self, block, sync=False):
+        me = self.calls
+        self.calls += 1
+        if me == self.fail_call:
+            raise StorageError("IO_EXCEPTION", "injected putBlock failure")
+        return super().put_block(block, sync)
+
+
+def test_putblock_failure_rolls_back_survivor_commits(cluster):
+    """A putBlock failure mid-stripe must not leave OTHER datanodes
+    committed at the inflated group length: the concurrently dispatched
+    putBlocks are rolled back to the pre-stripe watermark, so datanode
+    metadata (which offline reconstruction trusts) never reports bytes
+    the client did not ack."""
+    cluster.clients._local["dn0"] = FlakyPutBlockClient(
+        cluster.dns[0], fail_call=1)  # stripe 0 commits; stripe 1 fails
+    rng = np.random.default_rng(13)
+    # two stripes: stripe 0 commits, stripe 1's putBlock fails on dn0
+    # and replays into a fresh group after rollover
+    data = rng.integers(0, 256, 2 * 3 * CELL, dtype=np.uint8)
+    groups = _write_key(cluster, data)
+    got = _read_key(cluster, groups)
+    assert np.array_equal(got, data)
+    # the rolled-over first group is finalized at its committed length;
+    # EVERY datanode holding it must agree (no inflated survivor)
+    first = cluster.allocated[0]
+    if first.length and first is not groups[-1]:
+        for u, dn_id in enumerate(first.pipeline.nodes):
+            dn = next(d for d in cluster.dns if d.id == dn_id)
+            try:
+                bd = dn.get_block(first.block_id)
+            except StorageError:
+                continue  # failed node holds no commit: fine
+            assert bd.block_group_length == first.length, \
+                f"unit {u} on {dn_id} reports inflated group length " \
+                f"{bd.block_group_length} != {first.length}"
