@@ -73,6 +73,7 @@ class ECBlockGroupReader:
         self.verify = verify
         self.spec = FusedSpec(options, checksum, bytes_per_checksum)
         self._block_meta: dict[int, Optional[BlockData]] = {}
+        self._read_pool = None  # lazy; see _recover_cells_once
         # units that failed a read/verify; excluded like missing replicas
         # (reference ECBlockInputStream setFailed + proxy failover)
         self._failed: set[int] = set()
@@ -214,9 +215,24 @@ class ECBlockGroupReader:
         stripes = list(stripes if stripes is not None else range(self.num_stripes))
         valid = self._choose_valid(list(targets))
         batch = np.zeros((len(stripes), self.k, self.cell), dtype=np.uint8)
-        for bi, s in enumerate(stripes):
-            for vi, u in enumerate(valid):
+
+        def fill_unit(vi_u):
+            vi, u = vi_u
+            for bi, s in enumerate(stripes):
                 batch[bi, vi] = self._read_cell_checked(u, s)
+
+        # one reader thread per survivor unit: the k unit streams come
+        # off k DIFFERENT datanodes, so the read fan-in costs the
+        # slowest node, not the sum (the reference reads survivors with
+        # parallel stream readers in
+        # ECBlockReconstructedStripeInputStream). Pool cached on the
+        # reader: recovery retries up to p+1 times per block group.
+        if self._read_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._read_pool = ThreadPoolExecutor(
+                max_workers=self.k, thread_name_prefix="ec-read")
+        list(self._read_pool.map(fill_unit, enumerate(valid)))
         if self.mesh is not None:
             return self._decode_on_mesh(batch, valid, list(targets))
         fn = make_fused_decoder(self.spec, valid, list(targets))
