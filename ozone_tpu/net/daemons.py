@@ -165,6 +165,11 @@ class DatanodeDaemon:
         self.reconstruction = ECReconstructionCoordinator(
             self.clients, mesh=self._codec_mesh)
         self._pending_acks: list[int] = []
+        # container-report gating (see heartbeat_once)
+        self.full_report_every_s = 10.0
+        self._last_report_fp = None
+        self._last_report_t = 0.0
+        self._last_used = 0
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None
         # background data scanner (BackgroundContainerDataScanner analog):
@@ -341,14 +346,34 @@ class DatanodeDaemon:
         )
 
     def heartbeat_once(self) -> None:
-        report = self.dn.container_report()
-        used = sum(r["used_bytes"] for r in report)
+        # full container reports only on change or every
+        # full_report_every_s (the reference's ICR-on-change +
+        # periodic-FCR cadence): building one walks every container's
+        # block table — per-heartbeat it makes an IDLE datanode burn a
+        # core's worth of sqlite scans as containers accumulate
+        fp = (self.dn.mutation_count,
+              tuple(sorted((c.id, c.state.value)
+                           for c in self.dn.containers)))
+        now = time.monotonic()
+        if (fp != self._last_report_fp
+                or now - self._last_report_t >= self.full_report_every_s):
+            report = self.dn.container_report()
+            self._last_used = sum(r["used_bytes"] for r in report)
+        else:
+            report = None
+        used = self._last_used
         acks, self._pending_acks = self._pending_acks, []
         commands = self.scm.heartbeat(
             self.dn.id, container_report=report, used_bytes=used,
             layout_version=self.layout.metadata_version,
             deleted_block_acks=acks,
         )
+        if report is not None:
+            # delivered-only bookkeeping: a heartbeat that raised (every
+            # SCM briefly unreachable) must NOT consume the change —
+            # the report retries on the next beat, not in 10 s
+            self._last_report_fp = fp
+            self._last_report_t = now
         self._sync_security()
         for cmd in commands:
             self._execute(cmd)

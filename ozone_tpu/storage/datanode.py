@@ -48,6 +48,12 @@ class Datanode:
             HddsVolume(self.root / f"vol{i}") for i in range(num_volumes)
         ]
         self.containers = ContainerSet()
+        #: bumped on every container/block mutation — heartbeats send a
+        #: full container report only when this moved (or periodically),
+        #: the reference's ICR-on-change + periodic-FCR cadence; building
+        #: a full report walks every container's block table, far too
+        #: expensive to do per heartbeat on an idle node
+        self.mutation_count = 0
         self.metrics = MetricsRegistry(f"datanode.{dn_id}")
         self._rr = itertools.count()
         self._lock = threading.Lock()
@@ -95,6 +101,7 @@ class Datanode:
             c.root.mkdir(parents=True, exist_ok=True)
             c.save_descriptor()
             self.containers.add(c)
+            self.mutation_count += 1
             self.metrics.counter("container_created").inc()
             return c
 
@@ -103,6 +110,7 @@ class Datanode:
 
     def close_container(self, container_id: int) -> None:
         self.containers.get(container_id).close()
+        self.mutation_count += 1
         self.metrics.counter("container_closed").inc()
 
     def delete_container(self, container_id: int, force: bool = False) -> None:
@@ -119,6 +127,7 @@ class Datanode:
 
             shutil.rmtree(c.root, ignore_errors=True)
         self.containers.remove(container_id)
+        self.mutation_count += 1
         self.metrics.counter("container_deleted").inc()
 
     def list_containers(self) -> list[Container]:
@@ -131,6 +140,7 @@ class Datanode:
         c = self.containers.get(block_id.container_id)
         c.require_writable()
         c.chunks.write_chunk(block_id, info, data, sync=sync)
+        self.mutation_count += 1
         self.metrics.counter("bytes_written").inc(info.length)
 
     def read_chunk(
@@ -155,6 +165,7 @@ class Datanode:
             c.chunks.fsync_block(block.block_id)
         block.committed = True
         c.put_block(block)
+        self.mutation_count += 1
         self.metrics.counter("blocks_committed").inc()
 
     def get_block(self, block_id: BlockID) -> BlockData:
@@ -170,6 +181,7 @@ class Datanode:
         c = self.containers.get(block_id.container_id)
         c.db.delete_block(block_id)
         c.chunks.delete_block(block_id)
+        self.mutation_count += 1
 
     # -- scanners --
     def on_read_error(self, container: Container) -> None:
@@ -177,6 +189,7 @@ class Datanode:
         # conservative: a checksum failure marks the container unhealthy;
         # the SCM-side ReplicationManager will re-replicate/reconstruct.
         container.mark_unhealthy()
+        self.mutation_count += 1
 
     def scan_container(self, container_id: int) -> list[str]:
         """Full-data scan: verify every chunk checksum
