@@ -447,6 +447,7 @@ def cmd_freon(args) -> int:
         rep = freon.ockg(
             oz, n_keys=args.num, size=args.size, threads=args.threads,
             replication=args.replication or None, validate=args.validate,
+            warmup=args.warmup,
         )
         _emit(rep.summary())
     elif args.generator == "ockr":
@@ -474,6 +475,14 @@ def cmd_freon(args) -> int:
             _client(args), n_files=args.num, size=args.size,
             threads=args.threads,
             replication=args.replication or None).summary())
+    elif args.generator == "ecrd":
+        from ozone_tpu.net.scm_service import GrpcScmClient
+
+        scm = GrpcScmClient(args.om, tls=_client_tls())
+        _emit(freon.ecrd(
+            _client(args), scm, size=args.size, rounds=args.num,
+            replication=args.replication or "rs-6-3-1048576",
+        ))
     elif args.generator == "sdg":
         # -t is deliberately not honored: the snapshot chain is ordered
         _emit(freon.sdg(
@@ -907,12 +916,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     fr = sub.add_parser("freon", help="load generators")
     fr.add_argument("generator",
-                    choices=["ockg", "ockr", "ockv", "rawcoder", "omkg",
+                    choices=["ockg", "ockr", "ockv", "ecrd", "rawcoder", "omkg",
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
                              "dcv", "dsg", "hsg", "dnbp", "ralg",
                              "fskg", "mpug", "s3kg", "fsg", "sdg"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
+    fr.add_argument("--warmup", type=int, default=0,
+                    help="unmeasured warm-up keys before the clock "
+                    "(absorbs the first-dispatch XLA compile)")
     fr.add_argument("-t", "--threads", type=int, default=4)
     fr.add_argument("--om", default="127.0.0.1:9860")
     fr.add_argument("--replication", default="")

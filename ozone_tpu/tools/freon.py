@@ -140,8 +140,12 @@ def ockg(
     replication: Optional[str] = None,
     prefix: str = "key",
     validate: bool = False,
+    warmup: int = 0,
 ) -> FreonReport:
-    """Ozone Client Key Generator (freon ockg)."""
+    """Ozone Client Key Generator (freon ockg). `warmup` keys are
+    written before the clock starts — on TPU the first fused-encode
+    dispatch carries a 20-40 s XLA compile that would otherwise be
+    billed to the measured throughput."""
     try:
         client.om.create_volume(volume)
     except Exception:
@@ -161,6 +165,8 @@ def ockg(
             assert np.array_equal(got, payload)
         return size
 
+    for w in range(warmup):
+        b.write_key(f"{prefix}-warmup-{w}", payload, replication)
     return BaseFreonGenerator("ockg", n_keys, threads).run(op)
 
 
@@ -753,3 +759,90 @@ def sdg(client, n_rounds: int = 10, keys_per_round: int = 5,
         return keys_per_round * int(payload.size)
 
     return BaseFreonGenerator("sdg", n_rounds, threads=1).run(op)
+
+
+def ecrd(
+    client,
+    scm,
+    size: int = 64 * 1024 * 1024,
+    rounds: int = 3,
+    replication: str = "rs-6-3-1048576",
+    volume: str = "freon-vol",
+    bucket: str = "freon-ecrd",
+) -> dict:
+    """EC Reconstruction Drill: the END-TO-END repair path in BASELINE's
+    unit (MiB/s/datanode). Writes an EC key, closes its containers,
+    wipes one unit's replica, and times ECReconstructionCoordinator
+    repairing it onto a spare datanode — survivor reads + device decode
+    + target writes, all over the real wire
+    (ECReconstructionCoordinator.java:146 reconstructECContainerGroup).
+    """
+    import time as _time
+
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.storage.reconstruction import (
+        ECReconstructionCoordinator,
+        ReconstructionCommand,
+    )
+
+    opts = CoderOptions.parse(replication)
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket, replication)
+    except Exception:
+        pass
+    b = client.get_volume(volume).get_bucket(bucket)
+    payload = _det_payload(size, seed=9)
+    all_nodes = [n["dn_id"] for n in scm.status()["nodes"]]
+    results = []
+    for r in range(rounds):
+        key = f"drill-{r}"
+        b.write_key(key, payload, replication)
+        groups = client.om.key_block_groups(
+            client.om.lookup_key(volume, bucket, key))
+        g = groups[0]
+        # close replicas DIRECTLY on the datanodes (synchronous): going
+        # through the SCM would queue close commands that arrive over
+        # later heartbeats and race the drill's RECOVERING container
+        for dn_id in set(g.pipeline.nodes):
+            try:
+                client.clients.get(dn_id).close_container(g.container_id)
+            except Exception:
+                pass
+        lost = 1  # a data unit
+        client.clients.get(g.pipeline.nodes[lost]).delete_container(
+            g.container_id, force=True)
+        # a node holding no replica of this group; when the pipeline
+        # spans every node, the wiped node itself (it no longer holds
+        # one) — matching the placement policy's candidate set
+        spare = next((d for d in all_nodes
+                      if d not in g.pipeline.nodes),
+                     g.pipeline.nodes[lost])
+        cmd = ReconstructionCommand(
+            g.container_id, opts,
+            sources={u + 1: g.pipeline.nodes[u]
+                     for u in range(opts.all_units) if u != lost},
+            targets={lost + 1: spare},
+        )
+        coord = ECReconstructionCoordinator(client.clients)
+        t0 = _time.perf_counter()
+        coord.reconstruct_container_group(cmd)
+        dt = _time.perf_counter() - t0
+        unit_bytes = -(-g.length // opts.data_units)
+        results.append((unit_bytes, dt))
+        b.delete_key(key)
+    per_dn = [ub / 2**20 / dt for ub, dt in results]
+    per_dn.sort()
+    out = {
+        "name": "ecrd",
+        "rounds": rounds,
+        "unit_mib": round(results[0][0] / 2**20, 2),
+        "reconstruct_mib_s_per_datanode": round(
+            per_dn[len(per_dn) // 2], 2),
+        "best_mib_s_per_datanode": round(per_dn[-1], 2),
+        "times_s": [round(dt, 3) for _, dt in results],
+    }
+    return out
