@@ -1,0 +1,281 @@
+"""The combined randomized soak: every fault instrument at once.
+
+Mirror of the reference's mini-chaos-tests (fault-injection-test
+OzoneChaosCluster + FailureManager: random failures injected while load
+generators run, invariants asserted at the end). One seeded run drives
+EVERY instrument this framework has — metadata-HA replica kills, datanode
+restarts, client-side network partitions, an LD_PRELOAD disk-fault
+datanode subprocess — under concurrent EC, Ratis and metadata
+(snapshot/rename) load, then asserts the end-state invariants:
+
+  1. every ACKED write reads back byte-exact,
+  2. `ozone-tpu fsck` finds nothing UNRECOVERABLE,
+  3. no datanode is left holding a stuck RECOVERING container,
+  4. quota accounting matches a full recompute (RepairQuota drift = 0).
+"""
+
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ozone_tpu.net import partition
+from ozone_tpu.net.daemons import DatanodeDaemon
+from ozone_tpu.storage.ids import ContainerState, StorageError
+from ozone_tpu.tools.cli import main as cli_main
+from tests.test_meta_ha import _client, _free_ports, _make_meta
+from tests.test_meta_ha import _await_leader
+
+N_META = 3
+N_DN = 6
+CHAOS_S = 40.0
+
+
+def _start_injected_dn(tmp_path, dn_id, scm_addrs):
+    """One datanode as a SUBPROCESS under the LD_PRELOAD failure
+    injector (native/failure_injector.cpp), so disk faults hit a real
+    process boundary like the reference's fault-injection service."""
+    from ozone_tpu.testing.fault_injection import FaultInjector
+
+    fi = FaultInjector(tmp_path)
+    root = tmp_path / dn_id
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ozone_tpu.tools", "datanode",
+         "--root", str(root), "--scm", scm_addrs, "--id", dn_id],
+        env={**os.environ, **fi.env(), "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": os.getcwd()},
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    return proc, fi, root
+
+
+@pytest.mark.parametrize("seed", [1729])
+def test_soak_all_instruments_under_load(tmp_path, seed):
+    rng = random.Random(seed)
+    ports = _free_ports(N_META)
+    peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(N_META)}
+    scm_addrs = ",".join(peers.values())
+    metas, dns = {}, []
+    fi_proc = fi = None
+    stop = threading.Event()
+    acked_ec: list[str] = []
+    acked_ratis: list[str] = []
+    hard_errors: list[Exception] = []
+    snapshots_made: list[str] = []
+    rename_intents: dict[str, str] = {}
+
+    try:
+        for i in range(N_META):
+            d = _make_meta(tmp_path, i, peers)
+            d.start()
+            metas[f"m{i}"] = d
+        _await_leader(metas)
+        for i in range(N_DN - 1):
+            d = DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", scm_addrs,
+                               heartbeat_interval_s=0.15)
+            d.start()
+            dns.append(d)
+        fi_proc, fi, fi_root = _start_injected_dn(tmp_path, "dn-fi",
+                                                  scm_addrs)
+
+        oz = _client(peers)
+        oz.create_volume("v")
+        vol = oz.get_volume("v")
+        ec_bucket = vol.create_bucket("ec", replication="rs-3-2-4096")
+        ratis_bucket = vol.create_bucket("r3", replication="RATIS/THREE")
+        ec_payload = np.random.default_rng(seed).integers(
+            0, 256, 50_000, dtype=np.uint8).tobytes()
+        r_payload = np.random.default_rng(seed + 1).integers(
+            0, 256, 20_000, dtype=np.uint8).tobytes()
+
+        def writer(bucket, payload, acked, prefix):
+            n = 0
+            while not stop.is_set():
+                key = f"{prefix}-{n}"
+                try:
+                    bucket.write_key(key, payload)
+                    acked.append(key)
+                except (StorageError, OSError):
+                    pass  # un-acked: no durability claim
+                except Exception as e:  # noqa: BLE001
+                    hard_errors.append(e)
+                    return
+                n += 1
+
+        def metadata_load():
+            n = 0
+            while not stop.is_set():
+                try:
+                    if acked_ec and n % 3 == 0:
+                        src = acked_ec[len(acked_ec) // 2]
+                        if not src.endswith("-moved"):
+                            # record the intent FIRST: a rename whose
+                            # response is lost mid-failover may still
+                            # have applied (at-least-once visibility)
+                            rename_intents[src] = src + "-moved"
+                            oz.om.rename_key("v", "ec", src,
+                                             src + "-moved")
+                    elif n % 3 == 1:
+                        name = f"soak-s{n}"
+                        oz.om.create_snapshot("v", "ec", name)
+                        snapshots_made.append(name)
+                    else:
+                        oz.om.list_keys("v", "ec")
+                except (StorageError, ValueError, OSError):
+                    pass  # NOT_LEADER / mid-failover: retried next tick
+                except Exception as e:  # noqa: BLE001
+                    hard_errors.append(e)
+                    return
+                n += 1
+                time.sleep(0.25)
+
+        threads = [
+            threading.Thread(target=writer,
+                             args=(ec_bucket, ec_payload, acked_ec, "ec"),
+                             daemon=True),
+            threading.Thread(target=writer,
+                             args=(ratis_bucket, r_payload, acked_ratis,
+                                   "r"),
+                             daemon=True),
+            threading.Thread(target=metadata_load, daemon=True),
+        ]
+        for t in threads:
+            t.start()
+
+        # ------------------------------------------------ chaos loop
+        blocked: list[str] = []
+        t_end = time.time() + CHAOS_S
+        while time.time() < t_end:
+            action = rng.choice(
+                ["meta_restart", "dn_restart", "partition", "heal",
+                 "disk_fault", "disk_clear", "breathe"])
+            try:
+                if action == "meta_restart":
+                    victim = rng.choice(sorted(metas))
+                    idx = int(victim[1:])
+                    metas.pop(victim).stop()
+                    time.sleep(1.0)
+                    revived = _make_meta(tmp_path, idx, peers)
+                    revived.start()
+                    metas[victim] = revived
+                elif action == "dn_restart":
+                    i = rng.randrange(len(dns))
+                    dn_id = dns[i].dn.id
+                    dns[i].stop()
+                    time.sleep(0.5)
+                    dns[i] = DatanodeDaemon(
+                        tmp_path / dn_id, dn_id, scm_addrs,
+                        heartbeat_interval_s=0.15)
+                    dns[i].start()
+                elif action == "partition":
+                    d = rng.choice(dns)
+                    addr = d.address
+                    partition.block(addr)
+                    blocked.append(addr)
+                elif action == "heal":
+                    while blocked:
+                        partition.heal(blocked.pop())
+                elif action == "disk_fault":
+                    # latency then hard EIO on the injected dn's data dir
+                    if rng.random() < 0.5:
+                        fi.delay("write", fi_root, 20)
+                    else:
+                        fi.fail("write", fi_root, "EIO")
+                elif action == "disk_clear":
+                    fi.clear()
+            except Exception as e:  # noqa: BLE001 - chaos must not wedge
+                hard_errors.append(e)
+                break
+            time.sleep(rng.uniform(1.0, 2.5))
+
+        # ------------------------------------------------ heal + drain
+        partition.clear()
+        fi.clear()
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "load wedged"
+        assert not hard_errors, hard_errors
+        assert len(acked_ec) >= 5, f"EC writer starved: {len(acked_ec)}"
+        assert len(acked_ratis) >= 5, \
+            f"Ratis writer starved: {len(acked_ratis)}"
+        _await_leader(metas, timeout=30)
+        time.sleep(2.0)  # let heartbeats re-register restarted nodes
+
+        # 1. every acked write reads back byte-exact. EVENTUALLY-
+        # consistent like the reference chaos asserts: a replica the
+        # chaos poisoned (UNHEALTHY after injected EIO/corruption) may
+        # still be mid-re-replication — bounded retries, never forever
+        def read_back(bucket_name, key, want):
+            # a key with an in-flight rename intent is valid under
+            # EITHER name (the rename may or may not have applied
+            # before the chaos cut the response)
+            names = [key]
+            if key in rename_intents:
+                names.append(rename_intents[key])
+            last = None
+            for attempt in range(4):
+                for name in names:
+                    try:
+                        got = oz.get_volume("v").get_bucket(
+                            bucket_name).read_key(name).tobytes()
+                        assert got == want, f"{name}: wrong bytes"
+                        return
+                    except (StorageError, OSError) as e:
+                        last = e
+                time.sleep(2.0)
+            raise AssertionError(f"{bucket_name}/{key} unreadable "
+                                 f"after chaos: {last}")
+
+        for key in acked_ec:
+            read_back("ec", key, ec_payload)
+        for key in acked_ratis:
+            read_back("r3", key, r_payload)
+
+        # 2. fsck: nothing UNRECOVERABLE anywhere in the namespace
+        assert cli_main(["fsck", "--om", scm_addrs]) == 0
+
+        # 3. no datanode left with a stuck RECOVERING container
+        for d in dns:
+            states = {c.id: c.state for c in d.dn.containers}
+            stuck = [cid for cid, s in states.items()
+                     if s is ContainerState.RECOVERING]
+            assert not stuck, f"{d.dn.id} stuck RECOVERING: {stuck}"
+
+        # 4. quota accounting survived the chaos: recompute == stored
+        stored = {
+            b["name"]: (int(b.get("used_bytes", 0)),
+                        int(b.get("key_count", 0)))
+            for b in oz.om.list_buckets("v")
+        }
+        repaired = oz.om.repair_quota("v")
+        for bk, vals in repaired["buckets"].items():
+            name = bk.rsplit("/", 1)[-1]
+            assert stored[name] == (vals["used_bytes"],
+                                    vals["key_count"]), \
+                f"quota drift on {bk}: stored {stored[name]} " \
+                f"recomputed {vals}"
+    finally:
+        stop.set()
+        partition.clear()
+        if fi_proc is not None:
+            fi_proc.terminate()
+            try:
+                fi_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                fi_proc.kill()
+        for d in dns:
+            try:
+                d.stop()
+            except Exception:
+                pass
+        for d in metas.values():
+            try:
+                d.stop()
+            except Exception:
+                pass
