@@ -249,6 +249,19 @@ class ScmGrpcService:
                 out = scm.apply_admin_op(op, target)
         elif op == "balancer-status":
             out = scm.balancer_status()
+        elif op == "upgrade-status":
+            # finalization progress (ozone admin scm finalizationstatus
+            # analog): read-only view of the layout-feature catalog
+            if scm.finalizer is not None:
+                out = scm.finalizer.status()
+            else:
+                from ozone_tpu.utils.upgrade import FEATURES, LATEST_VERSION
+
+                out = {"metadata_version": LATEST_VERSION,
+                       "software_version": LATEST_VERSION,
+                       "needs_finalization": False,
+                       "features": [{"name": f.name, "version": f.version,
+                                     "allowed": True} for f in FEATURES]}
         elif op in ("container-token", "block-token"):
             # operator token minting for dn-direct debug/repair tools
             # (SCMSecurityProtocol.getContainerToken analog); no-op on

@@ -170,6 +170,11 @@ RECON_INDEX_HTML = """<!doctype html>
     <tbody></tbody>
   </table>
 
+  <h2>Growth</h2>
+  <div class="sub">namespace keys and bytes over the warehouse history
+    (newest right); the labels carry the current values</div>
+  <div id="trend"></div>
+
   <h2>OM table insights</h2>
   <div class="tiles" id="insight-tiles"></div>
   <details><summary>open keys (oldest first)</summary>
@@ -178,6 +183,30 @@ RECON_INDEX_HTML = """<!doctype html>
       <tbody></tbody>
     </table>
   </details>
+  <details><summary>pending deletions (purge chain)</summary>
+    <table id="deleted-keys">
+      <thead><tr><th>entry</th><th>size</th><th>blocks</th>
+        <th>pending (s)</th></tr></thead>
+      <tbody></tbody>
+    </table>
+  </details>
+
+  <h2>Container &rarr; keys</h2>
+  <div class="sub">which keys reference a container (the reference's
+    ContainerKeyMapper view) &mdash; enter a container id</div>
+  <div>
+    <input id="ck-id" inputmode="numeric" placeholder="container id"
+      style="padding:6px 8px;border:1px solid var(--border);
+             border-radius:6px;background:var(--surface-2);
+             color:var(--text-primary)">
+    <button id="ck-go" style="padding:6px 12px;border:1px solid
+      var(--border);border-radius:6px;background:var(--surface-2);
+      color:var(--text-primary);cursor:pointer">look up</button>
+  </div>
+  <table id="ck">
+    <thead><tr><th>container</th><th>keys</th></tr></thead>
+    <tbody></tbody>
+  </table>
 
   <h2>Unhealthy containers</h2>
   <table id="unhealthy">
@@ -281,6 +310,20 @@ async function refresh() {
     document.querySelector("#open-keys tbody").innerHTML = ok
       .map(r => `<tr><td>${esc(r.key)}</td><td>${esc(r.age_s)}</td>` +
                 `<td>${r.hsync ? "yes" : ""}</td></tr>`).join("");
+    // history needs the warehouse (a db_path'd Recon): skip the panel,
+    // never abort the shared refresh, when it answers 404
+    const hres = await fetch("/api/history/namespace");
+    const hist = hres.ok ? await hres.json() : null;
+    document.getElementById("trend").innerHTML = Array.isArray(hist)
+      ? spark("keys", hist.map(h => h.keys ?? 0).reverse(), String) +
+        spark("bytes", hist.map(h => h.bytes ?? 0).reverse(), fmtBytes)
+      : '<span class="sub">no history warehouse</span>';
+    const dk = await (await fetch("/api/insights/deleted_keys")).json();
+    document.querySelector("#deleted-keys tbody").innerHTML = dk
+      .map(r => `<tr><td>${esc(r.key)}</td><td>${fmtBytes(r.size)}</td>` +
+                `<td>${esc(r.blocks)}</td><td>${esc(r.pending_s ?? "")}` +
+                `</td></tr>`).join("") ||
+      '<tr><td colspan="4">purge chain empty</td></tr>';
     const uh = await (await fetch("/api/containers/unhealthy")).json();
     document.querySelector("#unhealthy tbody").innerHTML = uh
       .map(r => `<tr><td>${esc(r.container)}</td>` +
@@ -295,6 +338,40 @@ async function refresh() {
     ts.firstChild.textContent = "failed to load: " + e;
   }
 }
+// one-hue inline-SVG sparkline with a direct label (no axes/legend:
+// it shows shape; the label carries the current value)
+function spark(label, vals, fmt) {
+  if (!vals.length) vals = [0];
+  const w = 220, h = 36, max = Math.max(1, ...vals);
+  const step = vals.length > 1 ? w / (vals.length - 1) : 0;
+  const pts = vals.map((v, i) =>
+      `${(i * step).toFixed(1)},${(h - 2 - (h - 6) * v / max).toFixed(1)}`)
+    .join(" ");
+  return `<div class="bar-row"><span class="bar-label">${esc(label)}` +
+    `</span><svg width="${w}" height="${h}" role="img" ` +
+    `aria-label="${esc(label)} trend">` +
+    `<polyline points="${pts}" fill="none" ` +
+    `stroke="var(--series-1)" stroke-width="1.5"/></svg>` +
+    `<span class="bar-val">${esc(fmt(vals[vals.length - 1]))}</span></div>`;
+}
+// container -> keys lookup (ContainerKeyMapper view)
+async function lookupContainer() {
+  const id = document.getElementById("ck-id").value.trim();
+  if (!id) {  // the unfiltered map is every key of every container
+    document.querySelector("#ck tbody").innerHTML =
+      '<tr><td colspan="2">enter a container id first</td></tr>';
+    return;
+  }
+  const res = await fetch("/api/containers/keys?id=" +
+      encodeURIComponent(id));
+  const m = res.ok ? await res.json() : {};
+  document.querySelector("#ck tbody").innerHTML =
+    Object.entries(m).map(([cid, keys]) =>
+      `<tr><td>${esc(cid)}</td><td>${esc((keys || []).join(", "))}` +
+      `</td></tr>`).join("") ||
+    '<tr><td colspan="2">no keys reference it</td></tr>';
+}
+document.getElementById("ck-go").onclick = lookupContainer;
 // du drill-down: click rows to descend, the header crumb to reset
 let duPath = "/";
 async function refreshDu(p) {

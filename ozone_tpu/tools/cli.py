@@ -349,6 +349,10 @@ def cmd_admin(args) -> int:
                          "list|decommission|recommission|maintenance)")
     elif subject == "pipeline":
         _emit(scm.admin("pipelines"))
+    elif subject == "upgrade":
+        # finalization progress view (`ozone admin scm finalizationstatus`
+        # analog): which layout features are live vs gated
+        _emit(scm.admin("upgrade-status"))
     elif subject == "finalizeupgrade":
         # non-rolling upgrade completion (ozone admin scm
         # finalizeupgrade analog): bump the metadata services' layout
@@ -368,7 +372,18 @@ def cmd_admin(args) -> int:
         if verb not in (None, "status", "start", "stop"):
             return usage(f"unknown balancer verb {verb!r} "
                          "(expected start|stop|status)")
-        _emit(scm.admin(f"balancer-{verb or 'status'}"))
+        cfg = {}
+        if args.threshold is not None:
+            cfg["threshold"] = args.threshold
+        if args.max_moves is not None:
+            cfg["max_moves_per_iteration"] = args.max_moves
+        if args.max_size is not None:
+            cfg["max_size_per_iteration"] = args.max_size
+        if cfg and verb != "start":
+            # config only applies at start; silently dropping it would
+            # leave the operator believing the settings took
+            return usage("balancer config flags require the 'start' verb")
+        _emit(scm.admin(f"balancer-{verb or 'status'}", cfg or None))
     elif subject == "replicationmanager":
         _emit(scm.admin("replication-status"))
     elif subject == "ring":
@@ -902,7 +917,7 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("subject", choices=[
         "safemode", "datanode", "status", "pipeline", "container",
         "balancer", "replicationmanager", "om", "finalizeupgrade",
-        "ring", "kms", "cert",
+        "upgrade", "ring", "kms", "cert",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"
@@ -912,6 +927,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="datanode id for decommission/recommission/"
                          "maintenance")
     ad.add_argument("--om", default="127.0.0.1:9860")
+    ad.add_argument("--threshold", type=float, default=None,
+                    help="balancer start: utilization band around the "
+                         "cluster average (e.g. 0.1)")
+    ad.add_argument("--max-moves", type=int, default=None,
+                    help="balancer start: moves per iteration")
+    ad.add_argument("--max-size", type=int, default=None,
+                    help="balancer start: bytes moved per iteration")
     ad.set_defaults(fn=cmd_admin)
 
     fr = sub.add_parser("freon", help="load generators")

@@ -97,11 +97,22 @@ def test_cli_admin_operator_verbs(cluster, capsys):
 
     assert cli_main(["admin", "balancer", "status", "--om", om]) == 0
     assert json.loads(capsys.readouterr().out)["running"] is False
-    assert cli_main(["admin", "balancer", "start", "--om", om]) == 0
-    assert json.loads(capsys.readouterr().out)["running"] is True
+    # operator config overrides ride the replicated start decision
+    assert cli_main(["admin", "balancer", "start", "--threshold", "0.2",
+                     "--max-moves", "7", "--om", om]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["running"] is True and out["threshold"] == 0.2
     assert meta.scm.balancer_enabled
+    assert meta.scm.balancer.config.max_moves_per_iteration == 7
     assert cli_main(["admin", "balancer", "stop", "--om", om]) == 0
     capsys.readouterr()
+
+    # finalization progress view: fresh install = fully finalized
+    assert cli_main(["admin", "upgrade", "--om", om]) == 0
+    up = json.loads(capsys.readouterr().out)
+    assert up["needs_finalization"] is False
+    assert any(f["name"] == "BUCKET_SNAPSHOTS" and f["allowed"]
+               for f in up["features"])
 
     assert cli_main(["admin", "pipeline", "--om", om]) == 0
     pls = json.loads(capsys.readouterr().out)["pipelines"]
