@@ -203,6 +203,21 @@ class MetaHARing(RaftSCM):
             raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
         return self.node.change_membership(remove=node_id)
 
+    def ring_status(self) -> dict:
+        """This replica's view of the ring (ozone admin om roles /
+        scm roles analog): answered by ANY replica — operators ask a
+        follower who the leader is."""
+        n = self.node
+        return {
+            "replica_id": self.scm_id,
+            "role": "LEADER" if n.is_leader else "FOLLOWER",
+            "term": n.storage.term,
+            "last_applied": n.last_applied,
+            "leader": (self.scm_id if n.is_leader
+                       else (n.leader_hint or None)),
+            "members": sorted([*n.peer_ids, self.scm_id]),
+        }
+
     @property
     def leader_hint(self):
         return self.node.leader_hint

@@ -889,6 +889,7 @@ class ScmOmDaemon:
 
         self.scm_service.ring_ops = lambda op, target: self._ha_call(
             lambda: _ring_ops(op, target), "SCM_NOT_LEADER")
+        self.scm_service.ring_status = self.ha.ring_status
 
         def _on_ring_config(members: dict) -> None:
             self._ha_peers = {

@@ -116,6 +116,9 @@ class ScmGrpcService:
         #: HA hook: ring membership changes (callable(op, target) ->
         #: members dict); None = not an HA deployment
         self.ring_ops = None
+        #: HA hook: this replica's ring view (roles verb); any replica
+        #: answers, so it is NOT leader-gated
+        self.ring_status = None
         #: CA lifecycle hook (callable(op, target)); set by the daemon
         #: that hosts the cluster CA (cert-list / cert-revoke)
         self.cert_ops = None
@@ -222,6 +225,13 @@ class ScmGrpcService:
         m, _ = wire.unpack(req)
         op, target = m["op"], m.get("target")
         scm = self.scm
+        if op == "ring-status":
+            # any replica answers (followers report the leader hint);
+            # NOT leader-gated, unlike the membership mutations below
+            if self.ring_status is None:
+                raise StorageError("UNSUPPORTED_REQUEST",
+                                   "not an HA deployment")
+            return wire.pack(self.ring_status())
         if op in ("ring-add", "ring-remove"):
             # membership change IS its own replication (the config
             # entry rides the raft log), so it does not go through the

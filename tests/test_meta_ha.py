@@ -456,3 +456,26 @@ def test_failover_pool_reconciles_to_shipped_ring():
     pool.reconcile([])
     pool.reconcile(["h3:4", "h2:3"])
     assert alias == ["h2:3", "h3:4"]
+
+
+def test_ring_status_answered_by_any_replica(ha_cluster):
+    """`admin ring status` (ozone admin om roles analog): every replica
+    answers with its own role, a correct leader hint, and the member
+    list — followers included (NOT leader-gated)."""
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    metas, dns, peers, tmp_path = ha_cluster
+    leaders = set()
+    for mid, addr in peers.items():
+        scm = GrpcScmClient(addr)
+        st = scm.admin("ring-status")
+        assert st["replica_id"] == mid
+        assert sorted(st["members"]) == sorted(peers)
+        assert st["role"] in ("LEADER", "FOLLOWER")
+        if st["role"] == "LEADER":
+            assert st["leader"] == mid
+            leaders.add(mid)
+        elif st["leader"] is not None:
+            leaders.add(st["leader"])
+        scm.close()
+    assert len(leaders) == 1, leaders
