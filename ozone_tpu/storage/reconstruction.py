@@ -62,10 +62,16 @@ class ECReconstructionCoordinator:
         bytes_per_checksum: int = 16 * 1024,
         mesh=None,
         use_ring: bool = False,
+        max_parallel_blocks: int = 2,
     ):
         self.clients = clients
         self.checksum = checksum
         self.bpc = bytes_per_checksum
+        #: blocks of a container group repair in flight at once — each
+        #: block's read+decode+write chain is independent, so a small
+        #: pool overlaps one block's survivor reads with another's
+        #: target writes (memory-bounded: each holds its cell batch)
+        self.max_parallel_blocks = max(1, int(max_parallel_blocks))
         #: device mesh for the decode: stripe-parallel (DP) by default,
         #: survivor-sharded ring (SP) with use_ring — the reference runs
         #: its codec inside this same repair flow
@@ -94,9 +100,22 @@ class ECReconstructionCoordinator:
             # 1. block list from any source
             blocks = self._list_blocks(cmd)
 
-            # 3.-4. per block: recover + write + putBlock
-            for bd in blocks:
-                self._reconstruct_block(cmd, bd, targets)
+            # 3.-4. per block: recover + write + putBlock. Independent
+            # chains run through a small pool so survivor reads of one
+            # block overlap target writes of another; any failure fails
+            # the group (RECOVERING cleanup below)
+            if self.max_parallel_blocks > 1 and len(blocks) > 1:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                        max_workers=self.max_parallel_blocks,
+                        thread_name_prefix="ec-recon") as pool:
+                    list(pool.map(
+                        lambda bd: self._reconstruct_block(
+                            cmd, bd, targets), blocks))
+            else:
+                for bd in blocks:
+                    self._reconstruct_block(cmd, bd, targets)
 
             # close targets
             for idx in targets:
