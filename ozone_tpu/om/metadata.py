@@ -75,6 +75,11 @@ class OMMetadataStore:
         #: in-memory journal they index — when either is gone the diff
         #: falls back to the full listing comparison.
         self.snapshot_markers: dict[str, int] = {}
+        # group-commit coordination (flush_group): _txid doubles as the
+        # apply sequence; _flushed_txid/_flushing live under _flush_cv
+        self._flush_cv = threading.Condition()
+        self._flushed_txid = 0
+        self._flushing = False
 
     # ------------------------------------------------------------------ CRUD
     def put(self, table: str, key: str, value: dict,
@@ -206,7 +211,48 @@ class OMMetadataStore:
     # ------------------------------------------------------------------ flush
     def flush(self) -> None:
         with self._lock:
+            seq = self._txid
             self._flush_locked()
+        with self._flush_cv:
+            self._flushed_txid = max(self._flushed_txid, seq)
+            self._flush_cv.notify_all()
+
+    def flush_group(self) -> None:
+        """Group commit: make everything THIS caller applied durable,
+        batching with whatever concurrent callers applied meanwhile —
+        one sqlite commit (one fsync) covers them all. The reference's
+        OzoneManagerDoubleBuffer.flushTransactions:293 trick: client
+        futures complete only after the batch lands, but many requests
+        share one durable batch write. One thread flushes; the rest
+        wait for a flush covering their apply sequence."""
+        with self._lock:
+            target = self._txid
+        while True:
+            with self._flush_cv:
+                if self._flushed_txid >= target:
+                    return
+                if not self._flushing:
+                    self._flushing = True
+                    break
+                self._flush_cv.wait(timeout=5.0)
+            # woken uncovered: the previous flusher finished without
+            # covering us (or FAILED) — loop and become the flusher
+            # ourselves. An error therefore never wedges the write
+            # path: every caller either gets a covering durable flush
+            # or its OWN exception from its own attempt.
+        seq = 0
+        ok = False
+        try:
+            with self._lock:
+                seq = self._txid
+                self._flush_locked()
+            ok = True
+        finally:
+            with self._flush_cv:
+                self._flushing = False
+                if ok:
+                    self._flushed_txid = max(self._flushed_txid, seq)
+                self._flush_cv.notify_all()
 
     def _flush_locked(self) -> None:
         if not self._dirty:

@@ -305,17 +305,17 @@ class OzoneManager:
                         "until cancelprepare")
                 try:
                     result = request.apply(self.store)
-                    # durable before ack: the reference's double buffer
-                    # completes client futures only after the RocksDB
-                    # batch lands (OzoneManagerDoubleBuffer
-                    # .flushTransactions:293) — an acked mutation must
-                    # survive a crash. Requests batch their own puts, so
-                    # this is one WAL commit per write request.
-                    self.store.flush()
                 except rq.OMError as e:
                     self.audit.log(request.audit_action, vars(request),
                                    ok=False, error=e.code)
                     raise
+            # durable before ack: the reference's double buffer
+            # completes client futures only after the RocksDB batch
+            # lands (OzoneManagerDoubleBuffer.flushTransactions:293) —
+            # an acked mutation must survive a crash. GROUP commit,
+            # outside the apply lock: concurrent submits share one
+            # sqlite commit (one fsync), the double buffer's batching.
+            self.store.flush_group()
             self.audit.log(request.audit_action, vars(request), ok=True)
             self.metrics.counter("write_ops").inc()
             return result

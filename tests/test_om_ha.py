@@ -105,3 +105,65 @@ def test_follower_gap_catch_up(tmp_path):
     leader.submit(rq.CreateBucket("v", "b2"))
     assert f1.applied_index == 3
     assert f1.om.bucket_info("v", "b")["name"] == "b"
+
+
+def test_flush_group_commit_batches_and_propagates(tmp_path):
+    """Group commit (OzoneManagerDoubleBuffer.flushTransactions:293
+    analog): concurrent appliers share sqlite commits, everything acked
+    is durable, and a flush error reaches the waiters."""
+    import threading
+    import time
+
+    from ozone_tpu.om.metadata import OMMetadataStore
+
+    store = OMMetadataStore(tmp_path / "gc.db")
+    N, PER = 8, 40
+    commits = {"n": 0}
+    orig = store._flush_locked
+
+    def counting_slow_flush():
+        # the sleep forces a pile-up: while one flusher sleeps, other
+        # workers apply and enqueue, so later flushes cover MANY ops —
+        # without batching this test takes 320 commits, with it far fewer
+        commits["n"] += 1
+        time.sleep(0.002)
+        orig()
+
+    store._flush_locked = counting_slow_flush
+
+    def worker(tid):
+        for i in range(PER):
+            store.put("keys", f"/v/b/k{tid}-{i}", {"size": i})
+            store.flush_group()
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(N)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # durability: a FRESH store sees every row
+    store2 = OMMetadataStore(tmp_path / "gc.db")
+    for tid in range(N):
+        for i in range(PER):
+            assert store2.get("keys", f"/v/b/k{tid}-{i}") == {"size": i}
+    # batching: concurrent appliers MUST share commits (the double-
+    # buffer property); one-commit-per-op would be N*PER = 320
+    assert commits["n"] < N * PER // 2, commits
+
+    # error propagation: a failing flush surfaces to group waiters
+    def broken_flush():
+        raise RuntimeError("disk gone")
+
+    store._flush_locked = broken_flush
+    store.put("keys", "/v/b/doomed", {"size": 1})
+    try:
+        store.flush_group()
+        raise AssertionError("flush_group swallowed the flush error")
+    except RuntimeError:
+        pass
+    # a transient failure must NOT wedge the write path: once the
+    # "disk" recovers, the next flush_group retries and succeeds
+    store._flush_locked = orig
+    store.flush_group()
+    assert OMMetadataStore(tmp_path / "gc.db").get(
+        "keys", "/v/b/doomed") == {"size": 1}
