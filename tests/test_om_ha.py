@@ -126,7 +126,7 @@ def test_flush_group_commit_batches_and_propagates(tmp_path):
         # workers apply and enqueue, so later flushes cover MANY ops —
         # without batching this test takes 320 commits, with it far fewer
         commits["n"] += 1
-        time.sleep(0.002)
+        time.sleep(0.004)
         orig()
 
     store._flush_locked = counting_slow_flush
@@ -147,8 +147,10 @@ def test_flush_group_commit_batches_and_propagates(tmp_path):
         for i in range(PER):
             assert store2.get("keys", f"/v/b/k{tid}-{i}") == {"size": i}
     # batching: concurrent appliers MUST share commits (the double-
-    # buffer property); one-commit-per-op would be N*PER = 320
-    assert commits["n"] < N * PER // 2, commits
+    # buffer property). One-commit-per-op would be exactly N*PER = 320;
+    # the bound leaves scheduler slack while still failing a silent
+    # revert to unbatched per-request commits
+    assert commits["n"] < N * PER * 3 // 4, commits
 
     # error propagation: a failing flush surfaces to group waiters
     def broken_flush():
