@@ -279,22 +279,27 @@ class OzoneFileSystem:
         else:
             self.bucket.rename_key(s, d)
 
-    def set_attrs(self, path: str, attrs: dict) -> None:
+    def set_attrs(self, path: str, attrs: dict,
+                  preconds: Optional[dict] = None) -> None:
         """SETOWNER/SETPERMISSION/SETTIMES backing (merge semantics;
-        None deletes). Directories resolve through their marker key."""
+        None deletes; preconds = atomic xattr flag checks).
+        Directories resolve through their marker key."""
         st = self.get_file_status(path)
         key = self._norm(st.path)
         om = self.bucket.client.om
         try:
             om.set_key_attrs(self.bucket.volume, self.bucket.name, key,
-                             attrs)
-        except _OM_ERRORS:
-            if not st.is_dir:
+                             attrs, preconds)
+        except _OM_ERRORS as e:
+            # only the missing-marker case retries: a precondition
+            # refusal (XATTR_EXISTS/XATTR_NOT_FOUND) must surface, not
+            # loop through a second unchecked write
+            if not st.is_dir or "KEY_NOT_FOUND" not in str(e):
                 raise
             # implicit OBS directory: materialize its marker, retry
             self.mkdirs(path)
             om.set_key_attrs(self.bucket.volume, self.bucket.name, key,
-                             attrs)
+                             attrs, preconds)
 
     def checksum(self, path: str) -> dict:
         """Composite file checksum (the DistributedFileSystem
@@ -600,7 +605,8 @@ class RootedOzoneFileSystem:
                or s.path.rstrip("/").rpartition("/")[2] > start_after]
         return sts[:limit], len(sts) > limit
 
-    def set_attrs(self, path: str, attrs: dict) -> None:
+    def set_attrs(self, path: str, attrs: dict,
+                  preconds: Optional[dict] = None) -> None:
         vol, bkt, rest = self._resolve(path)
         if vol and bkt and not rest:
             # buckets appear as directories at depth 2 — chmod/chown on
@@ -608,7 +614,7 @@ class RootedOzoneFileSystem:
             self.client.om.set_bucket_attrs(vol, bkt, attrs)
             return
         fs, rest = self._in_bucket(path)
-        fs.set_attrs(rest, attrs)
+        fs.set_attrs(rest, attrs, preconds)
 
     def checksum(self, path: str) -> dict:
         fs, rest = self._in_bucket(path)

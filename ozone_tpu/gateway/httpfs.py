@@ -6,13 +6,16 @@ adapter). Serves the standard `/webhdfs/v1/<path>?op=...` verbs over the
 cluster-rooted filesystem (gateway/fs.py:RootedOzoneFileSystem):
 
   GET    OPEN (offset/length), GETFILESTATUS, LISTSTATUS,
-         LISTSTATUS_BATCH (paged), GETCONTENTSUMMARY, GETFILECHECKSUM
+         LISTSTATUS_BATCH (paged), GETCONTENTSUMMARY, GETFILECHECKSUM,
+         GETXATTRS (text/hex/base64 encodings), LISTXATTRS,
+         GETHOMEDIRECTORY, GETTRASHROOT, GETQUOTAUSAGE, GETSNAPSHOTDIFF
   PUT    CREATE (two-step 307 redirect per the WebHDFS spec, or direct
          with ?data=true), MKDIRS, RENAME (destination=),
-         SETPERMISSION, SETOWNER, SETTIMES
+         SETPERMISSION, SETOWNER, SETTIMES, SETXATTR (CREATE/REPLACE
+         flags), REMOVEXATTR, CREATESNAPSHOT, RENAMESNAPSHOT
   POST   APPEND (two-step 307, read-modify-write re-put underneath:
          keys are immutable on the datapath), TRUNCATE (newlength=)
-  DELETE DELETE (recursive=)
+  DELETE DELETE (recursive=, skiptrash=), DELETESNAPSHOT
 
 Responses follow the WebHDFS JSON schema (FileStatus.type FILE/DIRECTORY,
 modificationTime in ms, RemoteException envelope on errors).
@@ -283,7 +286,158 @@ class HttpFSGateway:
             }
         })
 
+    def _op_get_gethomedirectory(self, h, path: str, q) -> None:
+        user = q.get("user.name", ["anonymous"])[0]
+        h._json(200, {"Path": f"/user/{user}"})
+
+    def _op_get_gettrashroot(self, h, path: str, q) -> None:
+        """Per-bucket trash root (TrashPolicyOzone getTrashRoot:
+        /<vol>/<bucket>/.Trash/<user>)."""
+        user = q.get("user.name", ["anonymous"])[0]
+        vol, bkt, _ = self.fs._resolve(path)
+        if not (vol and bkt):
+            raise OSError(f"no bucket in path {path!r}")
+        h._json(200, {"Path": f"/{vol}/{bkt}/{self.fs.TRASH}/{user}"})
+
+    def _op_get_getquotausage(self, h, path: str, q) -> None:
+        """Bucket quota + usage counters (GETQUOTAUSAGE; the OM tracks
+        used_bytes/key_count live on the bucket row)."""
+        vol, bkt, _ = self.fs._resolve(path)
+        if not (vol and bkt):
+            raise OSError(f"no bucket in path {path!r}")
+        b = self.fs.client.om.bucket_info(vol, bkt)
+        h._json(200, {
+            "QuotaUsage": {
+                "fileAndDirectoryCount": int(b.get("key_count", 0)),
+                "quota": int(b.get("quota_namespace", -1)),
+                "spaceConsumed": int(b.get("used_bytes", 0)),
+                "spaceQuota": int(b.get("quota_bytes", -1)),
+            }
+        })
+
+    #: attrs-dict prefix holding user xattrs; the raw xattr name (which
+    #: legally contains dots) survives verbatim after the prefix
+    XATTR = "xattr:"
+
+    def _xattrs_of(self, path: str) -> dict:
+        st = self.fs.get_file_status(path)
+        a = st.attrs or {}
+        return {k[len(self.XATTR):]: v for k, v in a.items()
+                if k.startswith(self.XATTR)}
+
+    def _op_get_getxattrs(self, h, path: str, q) -> None:
+        """GETXATTRS: all xattrs, or the ?xattr.name= selection. Values
+        answer in the requested ?encoding= (text quotes them, hex/base64
+        encode the bytes — the WebHDFS XAttr JSON contract)."""
+        import base64
+
+        xattrs = self._xattrs_of(path)
+        names = q.get("xattr.name", [])
+        if names:
+            missing = [n for n in names if n not in xattrs]
+            if missing:
+                raise OSError(f"xattr not found: {missing}")
+            xattrs = {n: xattrs[n] for n in names}
+        enc = q.get("encoding", ["text"])[0].lower()
+
+        def encode(v: str):
+            raw = v.encode()
+            if enc == "hex":
+                return "0x" + raw.hex()
+            if enc == "base64":
+                return base64.b64encode(raw).decode()
+            return json.dumps(v)  # text: quoted string
+
+        h._json(200, {"XAttrs": [
+            {"name": n, "value": encode(v)}
+            for n, v in sorted(xattrs.items())
+        ]})
+
+    def _op_get_listxattrs(self, h, path: str, q) -> None:
+        # WebHDFS quirk: XAttrNames is a JSON array SERIALIZED AS A
+        # STRING inside the JSON response
+        h._json(200, {
+            "XAttrNames": json.dumps(sorted(self._xattrs_of(path)))
+        })
+
+    def _op_get_getsnapshotdiff(self, h, path: str, q) -> None:
+        """GETSNAPSHOTDIFF mapped onto the bucket snapshot diff: CREATE/
+        DELETE/MODIFY/RENAME entries in the SnapshotDiffReport shape."""
+        vol, bkt, _ = self.fs._resolve(path)
+        if not (vol and bkt):
+            raise OSError(f"no bucket in path {path!r}")
+        old = q.get("oldsnapshotname", [""])[0]
+        new = q.get("snapshotname", [""])[0]
+        if not old:
+            raise OSError("oldsnapshotname required")
+        d = self.fs.client.om.snapshot_diff(vol, bkt, old, new or None)
+        diff_list = (
+            [{"sourcePath": p, "type": "CREATE"} for p in d["added"]]
+            + [{"sourcePath": p, "type": "DELETE"} for p in d["deleted"]]
+            + [{"sourcePath": p, "type": "MODIFY"} for p in d["modified"]]
+            + [{"sourcePath": a, "targetPath": b, "type": "RENAME"}
+               for a, b in d.get("renamed", [])]
+        )
+        h._json(200, {"SnapshotDiffReport": {
+            "diffList": diff_list,
+            "fromSnapshot": old,
+            "toSnapshot": new or ".",
+            "snapshotRoot": f"/{vol}/{bkt}",
+        }})
+
     # ----------------------------------------------------------------- PUT
+    def _op_put_setxattr(self, h, path: str, q) -> None:
+        """SETXATTR with the CREATE/REPLACE flag semantics of the
+        WebHDFS contract: CREATE refuses an existing name, REPLACE
+        refuses a missing one, no flag upserts. The flag check rides
+        the request as a precondition evaluated inside the OM's
+        serialized apply — a gateway-side read-then-write would race
+        concurrent setters (even across httpfs daemons)."""
+        name = q.get("xattr.name", [""])[0]
+        if not name:
+            raise OSError("xattr.name required")
+        flag = q.get("flag", [""])[0].upper()
+        preconds = ({self.XATTR + name: False} if flag == "CREATE"
+                    else {self.XATTR + name: True} if flag == "REPLACE"
+                    else None)
+        value = q.get("xattr.value", [""])[0]
+        self.fs.set_attrs(path, {self.XATTR + name: value},
+                          preconds=preconds)
+        h._reply(200)
+
+    def _op_put_removexattr(self, h, path: str, q) -> None:
+        name = q.get("xattr.name", [""])[0]
+        if not name:
+            raise OSError("xattr.name required")
+        self.fs.set_attrs(path, {self.XATTR + name: None},
+                          preconds={self.XATTR + name: True})
+        h._reply(200)
+
+    def _op_put_createsnapshot(self, h, path: str, q) -> None:
+        """CREATESNAPSHOT on any path inside a bucket snapshots the
+        BUCKET (snapshots are per-bucket here, like Ozone's)."""
+        vol, bkt, _ = self.fs._resolve(path)
+        if not (vol and bkt):
+            raise OSError(f"no bucket in path {path!r}")
+        name = q.get("snapshotname", [""])[0]
+        if not name:
+            import time as _time
+
+            name = f"s{int(_time.time() * 1000)}"
+        self.fs.client.om.create_snapshot(vol, bkt, name)
+        h._json(200, {"Path": f"/{vol}/{bkt}/.snapshot/{name}"})
+
+    def _op_put_renamesnapshot(self, h, path: str, q) -> None:
+        vol, bkt, _ = self.fs._resolve(path)
+        if not (vol and bkt):
+            raise OSError(f"no bucket in path {path!r}")
+        old = q.get("oldsnapshotname", [""])[0]
+        new = q.get("snapshotname", [""])[0]
+        if not old or not new:
+            raise OSError("oldsnapshotname and snapshotname required")
+        self.fs.client.om.rename_snapshot(vol, bkt, old, new)
+        h._reply(200)
+
     def _op_put_setpermission(self, h, path: str, q) -> None:
         import re
 
@@ -364,6 +518,16 @@ class HttpFSGateway:
         h._json(200, {"boolean": bool(ok)})
 
     # ----------------------------------------------------------------- DELETE
+    def _op_delete_deletesnapshot(self, h, path: str, q) -> None:
+        vol, bkt, _ = self.fs._resolve(path)
+        if not (vol and bkt):
+            raise OSError(f"no bucket in path {path!r}")
+        name = q.get("snapshotname", [""])[0]
+        if not name:
+            raise OSError("snapshotname required")
+        self.fs.client.om.delete_snapshot(vol, bkt, name)
+        h._reply(200)
+
     def _op_delete_delete(self, h, path: str, q) -> None:
         if q.get("skiptrash", ["true"])[0] == "false":
             # fs -rm semantics without -skipTrash: move into the bucket

@@ -117,6 +117,11 @@ class OmGrpcService:
                     lambda m: self.om.delete_snapshot(
                         m["volume"], m["bucket"], m["name"])
                 ),
+                "RenameSnapshot": self._wrap(
+                    lambda m: self.om.rename_snapshot(
+                        m["volume"], m["bucket"], m["name"],
+                        m["new_name"])
+                ),
                 "SnapshotDiff": self._wrap(
                     lambda m: self.om.snapshot_diff(
                         m["volume"], m["bucket"], m["from_snapshot"],
@@ -149,7 +154,8 @@ class OmGrpcService:
                 ),
                 "SetKeyAttrs": self._wrap(
                     lambda m: self.om.set_key_attrs(
-                        m["volume"], m["bucket"], m["key"], m["attrs"]
+                        m["volume"], m["bucket"], m["key"], m["attrs"],
+                        m.get("preconds"),
                     )
                 ),
                 "SetBucketAttrs": self._wrap(
@@ -611,6 +617,10 @@ class GrpcOmClient:
         self._call("DeleteSnapshot", volume=volume, bucket=bucket,
                    name=name)
 
+    def rename_snapshot(self, volume, bucket, name, new_name):
+        return self._call("RenameSnapshot", volume=volume, bucket=bucket,
+                          name=name, new_name=new_name)["result"]
+
     def snapshot_diff(self, volume, bucket, from_snapshot,
                       to_snapshot=None):
         return self._call("SnapshotDiff", volume=volume, bucket=bucket,
@@ -647,9 +657,10 @@ class GrpcOmClient:
         self._call("RenameKey", volume=volume, bucket=bucket, key=key,
                    new_key=new_key)
 
-    def set_key_attrs(self, volume, bucket, key, attrs):
+    def set_key_attrs(self, volume, bucket, key, attrs, preconds=None):
         return self._call("SetKeyAttrs", volume=volume, bucket=bucket,
-                          key=key, attrs=attrs)["result"]
+                          key=key, attrs=attrs,
+                          preconds=preconds)["result"]
 
     def set_bucket_attrs(self, volume, bucket, attrs):
         return self._call("SetBucketAttrs", volume=volume,

@@ -357,14 +357,18 @@ class DeleteDirectory(OMRequest):
 class SetEntryAttrs(OMRequest):
     """Merge filesystem attributes (owner/group/permission/mtime/atime)
     into a file or directory row (the FSO side of HttpFS SETOWNER /
-    SETPERMISSION / SETTIMES). A None value deletes the attribute."""
+    SETPERMISSION / SETTIMES). A None value deletes the attribute;
+    `preconds` enforces the xattr CREATE/REPLACE flags atomically."""
 
     volume: str
     bucket: str
     path: str
     attrs: dict
+    preconds: dict = field(default_factory=dict)
 
     def apply(self, store):
+        from ozone_tpu.om.requests import check_attr_preconds
+
         parent, name = resolve_parent(
             store, self.volume, self.bucket, self.path
         )
@@ -373,6 +377,7 @@ class SetEntryAttrs(OMRequest):
         info = store.get(table, ek)
         if info is None:
             raise OMError(KEY_NOT_FOUND, ek)
+        check_attr_preconds(info, self.preconds)
         merged = dict(info.get("attrs", {}))
         for k, v in self.attrs.items():
             if v is None:

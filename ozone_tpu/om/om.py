@@ -634,6 +634,12 @@ class OzoneManager:
         volume, bucket = self.resolve_bucket(volume, bucket)
         self._snapshots().delete_snapshot(volume, bucket, name)
 
+    def rename_snapshot(self, volume: str, bucket: str, name: str,
+                        new_name: str) -> dict:
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        return self.submit(rq.RenameSnapshot(volume, bucket, name,
+                                             new_name))
+
     def snapshot_diff(self, volume: str, bucket: str, from_snapshot: str,
                       to_snapshot=None) -> dict:
         volume, bucket = self.resolve_bucket(volume, bucket)
@@ -735,19 +741,23 @@ class OzoneManager:
                                      fs_paths=legacy))
 
     def set_key_attrs(self, volume: str, bucket: str, key: str,
-                      attrs: dict) -> dict:
+                      attrs: dict, preconds: Optional[dict] = None
+                      ) -> dict:
         """Merge filesystem attributes (owner/group/permission/mtime/
         atime) onto a key, file, or directory (the HttpFS SETOWNER /
         SETPERMISSION / SETTIMES verbs; reference KeyManagerImpl
-        setattr paths). None values delete attributes."""
+        setattr paths). None values delete attributes; `preconds` maps
+        attr -> must-exist bool, checked atomically in the apply (the
+        xattr CREATE/REPLACE flags)."""
         from ozone_tpu.om import fso
 
         volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, key, "WRITE")
         if self._is_fso(self.bucket_info(volume, bucket)):
             return self.submit(fso.SetEntryAttrs(volume, bucket, key,
-                                                 attrs))
-        return self.submit(rq.SetKeyAttrs(volume, bucket, key, attrs))
+                                                 attrs, preconds or {}))
+        return self.submit(rq.SetKeyAttrs(volume, bucket, key, attrs,
+                                          preconds or {}))
 
     def set_bucket_attrs(self, volume: str, bucket: str,
                          attrs: dict) -> dict:

@@ -488,6 +488,34 @@ class DeleteSnapshot(OMRequest):
 
 
 @dataclass
+class RenameSnapshot(OMRequest):
+    """Rename a snapshot's chain entry (OMSnapshotRenameRequest /
+    WebHDFS RENAMESNAPSHOT analog). The materialized rows are keyed by
+    snap_id and the journal marker by the same id, so only the
+    name-keyed metadata row moves — O(1)."""
+
+    volume: str
+    bucket: str
+    name: str
+    new_name: str
+
+    def apply(self, store):
+        if not self.new_name or "/" in self.new_name:
+            raise OMError("INVALID_SNAPSHOT_NAME", repr(self.new_name))
+        mk = snapmeta_key(self.volume, self.bucket, self.name)
+        info = store.get("open_keys", mk)
+        if info is None:
+            raise OMError("SNAPSHOT_NOT_FOUND", self.name)
+        nk = snapmeta_key(self.volume, self.bucket, self.new_name)
+        if store.exists("open_keys", nk):
+            raise OMError("SNAPSHOT_EXISTS", self.new_name)
+        info["name"] = self.new_name
+        store.delete("open_keys", mk)
+        store.put("open_keys", nk, info)
+        return info
+
+
+@dataclass
 class SetQuota(OMRequest):
     """Set space/namespace quota on a volume (bucket="") or bucket
     (ozone sh volume/bucket setquota analog). None leaves a dimension
@@ -782,18 +810,33 @@ class DeleteKey(OMRequest):
         return info
 
 
+def check_attr_preconds(info: dict, preconds: dict) -> None:
+    """XAttr flag semantics, enforced INSIDE the serialized apply
+    (WebHDFS SETXATTR CREATE/REPLACE, REMOVEXATTR existence): value
+    True = the attr must exist, False = it must not. A gateway-side
+    read-then-write check would race concurrent setters."""
+    have = info.get("attrs", {})
+    for name, must_exist in (preconds or {}).items():
+        if must_exist and name not in have:
+            raise OMError("XATTR_NOT_FOUND", name)
+        if not must_exist and name in have:
+            raise OMError("XATTR_EXISTS", name)
+
+
 @dataclass
 class SetKeyAttrs(OMRequest):
     """Merge filesystem attributes (owner/group/permission/mtime/atime)
     into a key or directory-marker row (reference: HttpFS SETOWNER /
     SETPERMISSION / SETTIMES land in KeyManagerImpl setattr paths; OBS
     layout stores them on the key info). A None value deletes the
-    attribute."""
+    attribute. `preconds` maps attr name -> must-exist bool, checked
+    atomically here (xattr CREATE/REPLACE flags)."""
 
     volume: str
     bucket: str
     key: str
     attrs: dict
+    preconds: dict = field(default_factory=dict)
 
     def apply(self, store):
         kk = key_key(self.volume, self.bucket, self.key)
@@ -803,6 +846,7 @@ class SetKeyAttrs(OMRequest):
             info = store.get("keys", kk)
         if info is None:
             raise OMError(KEY_NOT_FOUND, kk)
+        check_attr_preconds(info, self.preconds)
         merged = dict(info.get("attrs", {}))
         for k, v in self.attrs.items():
             if v is None:

@@ -130,7 +130,7 @@ _SH_VERBS = {
     "volume": {"create", "delete", "info", "list", "setquota"},
     "bucket": {"create", "delete", "info", "list", "setquota", "link"},
     "key": {"put", "get", "delete", "info", "list", "rename", "checksum"},
-    "snapshot": {"create", "list", "info", "delete", "diff"},
+    "snapshot": {"create", "list", "info", "delete", "diff", "rename"},
 }
 
 
@@ -205,6 +205,13 @@ def cmd_sh(args) -> int:
                 return 1
             if verb == "create":
                 _emit(oz.om.create_snapshot(vol, bucket, args.name))
+            elif verb == "rename":
+                if not args.to:
+                    print("error: snapshot rename requires --to",
+                          file=sys.stderr)
+                    return 1
+                _emit(oz.om.rename_snapshot(vol, bucket, args.name,
+                                            args.to))
             elif verb == "info":
                 _emit(oz.om.snapshot_info(vol, bucket, args.name))
             elif verb == "delete":

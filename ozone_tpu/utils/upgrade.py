@@ -68,6 +68,7 @@ LATEST_VERSION = max(f.version for f in FEATURES)
 GATED_OM_REQUESTS = {
     "CreateSnapshot": BUCKET_SNAPSHOTS,
     "DeleteSnapshot": BUCKET_SNAPSHOTS,
+    "RenameSnapshot": BUCKET_SNAPSHOTS,
 }
 
 PRE_FINALIZE_ERROR = "NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION"

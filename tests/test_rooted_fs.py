@@ -409,3 +409,84 @@ def test_list_status_page_skips_subtrees(ofs):
                                         start_after="mid-dir", limit=5)
     assert [s.path.rpartition("/")[2] for s in page2] == ["z-file"]
     assert not more2
+
+
+def test_webhdfs_xattrs(hfs):
+    """SETXATTR/GETXATTRS/LISTXATTRS/REMOVEXATTR with the WebHDFS flag
+    and encoding semantics (HttpFSServer.java XATTR cases)."""
+    _req(hfs, "PUT", "/xv/xb", op="MKDIRS")
+    req = urllib.request.Request(
+        _url(hfs, "/xv/xb/f", op="CREATE", data="true"), data=b"x",
+        method="PUT")
+    assert urllib.request.urlopen(req).status == 201
+    assert _req(hfs, "PUT", "/xv/xb/f", op="SETXATTR",
+                **{"xattr.name": "user.color", "xattr.value": "teal",
+                   "flag": "CREATE"}).status == 200
+    # CREATE on an existing name refuses
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(hfs, "PUT", "/xv/xb/f", op="SETXATTR",
+             **{"xattr.name": "user.color", "xattr.value": "x",
+                "flag": "CREATE"})
+    assert ei.value.code == 403
+    # REPLACE works; REPLACE on a missing name refuses
+    assert _req(hfs, "PUT", "/xv/xb/f", op="SETXATTR",
+                **{"xattr.name": "user.color", "xattr.value": "plum",
+                   "flag": "REPLACE"}).status == 200
+    with pytest.raises(urllib.error.HTTPError):
+        _req(hfs, "PUT", "/xv/xb/f", op="SETXATTR",
+             **{"xattr.name": "user.nope", "xattr.value": "x",
+                "flag": "REPLACE"})
+    _req(hfs, "PUT", "/xv/xb/f", op="SETXATTR",
+         **{"xattr.name": "user.size", "xattr.value": "11"})
+    names = json.loads(json.load(_req(
+        hfs, "GET", "/xv/xb/f", op="LISTXATTRS"))["XAttrNames"])
+    assert names == ["user.color", "user.size"]
+    got = json.load(_req(hfs, "GET", "/xv/xb/f", op="GETXATTRS"))["XAttrs"]
+    assert {"name": "user.color", "value": '"plum"'} in got
+    hexed = json.load(_req(hfs, "GET", "/xv/xb/f", op="GETXATTRS",
+                           encoding="hex",
+                           **{"xattr.name": "user.size"}))["XAttrs"]
+    assert hexed == [{"name": "user.size", "value": "0x" + b"11".hex()}]
+    assert _req(hfs, "PUT", "/xv/xb/f", op="REMOVEXATTR",
+                **{"xattr.name": "user.size"}).status == 200
+    names = json.loads(json.load(_req(
+        hfs, "GET", "/xv/xb/f", op="LISTXATTRS"))["XAttrNames"])
+    assert names == ["user.color"]
+
+
+def test_webhdfs_snapshot_verbs_and_quota(hfs):
+    """CREATESNAPSHOT/RENAMESNAPSHOT/GETSNAPSHOTDIFF/DELETESNAPSHOT +
+    GETQUOTAUSAGE/GETTRASHROOT/GETHOMEDIRECTORY over WebHDFS."""
+    _req(hfs, "PUT", "/sv/sb", op="MKDIRS")
+    req = urllib.request.Request(
+        _url(hfs, "/sv/sb/a", op="CREATE", data="true"), data=b"one",
+        method="PUT")
+    assert urllib.request.urlopen(req).status == 201
+    r = json.load(_req(hfs, "PUT", "/sv/sb", op="CREATESNAPSHOT",
+                       snapshotname="base"))
+    assert r["Path"] == "/sv/sb/.snapshot/base"
+    req = urllib.request.Request(
+        _url(hfs, "/sv/sb/b", op="CREATE", data="true"), data=b"two",
+        method="PUT")
+    urllib.request.urlopen(req)
+    assert _req(hfs, "PUT", "/sv/sb", op="RENAMESNAPSHOT",
+                oldsnapshotname="base",
+                snapshotname="first").status == 200
+    d = json.load(_req(hfs, "GET", "/sv/sb", op="GETSNAPSHOTDIFF",
+                       oldsnapshotname="first", snapshotname=""))
+    entries = d["SnapshotDiffReport"]["diffList"]
+    assert {"sourcePath": "b", "type": "CREATE"} in entries
+    assert _req(hfs, "DELETE", "/sv/sb", op="DELETESNAPSHOT",
+                snapshotname="first").status == 200
+    with pytest.raises(urllib.error.HTTPError):
+        _req(hfs, "GET", "/sv/sb", op="GETSNAPSHOTDIFF",
+             oldsnapshotname="first", snapshotname="")
+    q = json.load(_req(hfs, "GET", "/sv/sb", op="GETQUOTAUSAGE"))
+    assert q["QuotaUsage"]["spaceConsumed"] == 6  # "one" + "two"
+    assert q["QuotaUsage"]["fileAndDirectoryCount"] == 2
+    t = json.load(_req(hfs, "GET", "/sv/sb/a", op="GETTRASHROOT",
+                       **{"user.name": "alice"}))
+    assert t["Path"] == "/sv/sb/.Trash/alice"
+    hm = json.load(_req(hfs, "GET", "/", op="GETHOMEDIRECTORY",
+                        **{"user.name": "bob"}))
+    assert hm["Path"] == "/user/bob"

@@ -467,10 +467,13 @@ def test_layout_feature_gating_pre_finalize(tmp_path):
                          clients)
         oz.create_volume("v").create_bucket("b", replication=EC)
 
-        # OM verb: snapshot create refused pre-finalize (over the wire
-        # the OMError code rides the rpc detail as a StorageError)
+        # OM verbs: snapshot create AND rename refused pre-finalize
+        # (over the wire the OMError code rides the rpc detail)
         with pytest.raises((OMError, StorageError)) as ei:
             oz.om.create_snapshot("v", "b", "s1")
+        assert ei.value.code == "NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION"
+        with pytest.raises((OMError, StorageError)) as ei:
+            oz.om.rename_snapshot("v", "b", "s1", "s2")
         assert ei.value.code == "NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION"
 
         # DN verb: streaming write refused pre-finalize
