@@ -8,7 +8,9 @@ cluster-rooted filesystem (gateway/fs.py:RootedOzoneFileSystem):
   GET    OPEN (offset/length), GETFILESTATUS, LISTSTATUS,
          LISTSTATUS_BATCH (paged), GETCONTENTSUMMARY, GETFILECHECKSUM,
          GETXATTRS (text/hex/base64 encodings), LISTXATTRS,
-         GETHOMEDIRECTORY, GETTRASHROOT, GETQUOTAUSAGE, GETSNAPSHOTDIFF
+         GETHOMEDIRECTORY, GETTRASHROOT, GETQUOTAUSAGE, GETSNAPSHOTDIFF,
+         GETACLSTATUS, CHECKACCESS (?fsaction), GETFILEBLOCKLOCATIONS
+         (?offset/?length range filtering)
   PUT    CREATE (two-step 307 redirect per the WebHDFS spec, or direct
          with ?data=true), MKDIRS, RENAME (destination=),
          SETPERMISSION, SETOWNER, SETTIMES, SETXATTR (CREATE/REPLACE
@@ -359,6 +361,106 @@ class HttpFSGateway:
         h._json(200, {
             "XAttrNames": json.dumps(sorted(self._xattrs_of(path)))
         })
+
+    def _op_get_getaclstatus(self, h, path: str, q) -> None:
+        """GETACLSTATUS: the native ACL grants of the key (or bucket at
+        depth 2) rendered in the WebHDFS AclStatus shape. Entry strings
+        follow Hadoop's AclEntry grammar: ACCESS scope has NO prefix,
+        DEFAULT scope is 'default:'; entry types are limited to
+        user/group/other (native WORLD grants map to 'other')."""
+        st = self.fs.get_file_status(path)  # 404 on missing, first
+        vol, bkt, rest = self.fs._resolve(path)
+        om = self.fs.client.om
+        if bkt and rest:
+            acls = om.get_acls("key", vol, bkt, rest)
+        elif bkt:
+            acls = om.get_acls("bucket", vol, bkt)
+        else:
+            acls = om.get_acls("volume", vol)
+        entries = []
+        for g in acls:
+            prefix = "default:" if g.get("scope") == "DEFAULT" else ""
+            gtype = g.get("type", "user").lower()
+            name = g.get("name", "")
+            if gtype not in ("user", "group"):
+                gtype, name = "other", ""  # WORLD and friends
+            # native rights (r/w/l/...) condense to the rwx triad
+            rights = "".join(g.get("rights", []))
+            perm = ("r" if any(c in rights for c in "rl") else "-") + \
+                   ("w" if any(c in rights for c in "wcd") else "-") + "-"
+            entries.append(f"{prefix}{gtype}:{name}:{perm}")
+        fj = _status_json(st)
+        h._json(200, {"AclStatus": {
+            "owner": fj["owner"],
+            "group": fj["group"],
+            "permission": fj["permission"],
+            "stickyBit": False,
+            "entries": entries,
+        }})
+
+    def _op_get_checkaccess(self, h, path: str, q) -> None:
+        """CHECKACCESS (?fsaction=rwx): 200 when the caller holds the
+        asked rights, AccessControlException otherwise."""
+        action = q.get("fsaction", ["r--"])[0]
+        user = q.get("user.name", [None])[0]
+        vol, bkt, rest = self.fs._resolve(path)
+        if not vol:
+            raise OSError(f"no volume in path {path!r}")
+        self.fs.get_file_status(path)  # 404 on missing
+        om = self.fs.client.om
+        try:
+            wanted = []
+            if "r" in action:
+                wanted.append("READ")
+            if "w" in action:
+                wanted.append("WRITE")
+            if "x" in action:
+                wanted.append("LIST")
+            for right in wanted:
+                om.check_access(vol, bkt or None, rest or None, right,
+                                user=user)
+        except (OMError, StorageError) as e:
+            # PERMISSION_DENIED locally; the same code rides the rpc
+            # detail as a StorageError from a remote OM
+            if "PERMISSION_DENIED" not in str(e):
+                raise
+            h._json(*self._exception(403, "AccessControlException",
+                                     str(e)))
+            return
+        h._reply(200)
+
+    def _op_get_getfileblocklocations(self, h, path: str, q) -> None:
+        """GETFILEBLOCKLOCATIONS (?offset=&length=): the key's block
+        groups intersecting the byte range, rendered as BlockLocations
+        (hosts = the group's datanodes; EC groups list every unit
+        holder). Range-aware clients (DistCp splits) pass offset/length
+        per split."""
+        st = self.fs.get_file_status(path)  # 404 on missing, first
+        if st.is_dir:
+            raise OSError(f"not a file path: {path!r}")
+        vol, bkt, rest = self.fs._resolve(path)
+        om = self.fs.client.om
+        info = om.lookup_key(vol, bkt, rest)
+        groups = om.key_block_groups(info)
+        want_off = int(q.get("offset", ["0"])[0])
+        length = q.get("length", [None])[0]
+        want_end = (want_off + int(length)) if length is not None \
+            else float("inf")
+        locs = []
+        offset = 0
+        for g in groups:
+            if offset < want_end and offset + g.length > want_off:
+                hosts = [n for n in g.pipeline.nodes if n]
+                locs.append({
+                    "offset": offset,
+                    "length": g.length,
+                    "hosts": hosts,
+                    "names": hosts,
+                    "topologyPaths": [],
+                    "corrupt": False,
+                })
+            offset += g.length
+        h._json(200, {"BlockLocations": {"BlockLocation": locs}})
 
     def _op_get_getsnapshotdiff(self, h, path: str, q) -> None:
         """GETSNAPSHOTDIFF mapped onto the bucket snapshot diff: CREATE/

@@ -229,6 +229,12 @@ class OmGrpcService:
                         m.get("acls", []),
                     )
                 ),
+                "CheckAccess": self._wrap(
+                    lambda m: self.om.check_access(
+                        m["volume"], m.get("bucket"), m.get("key"),
+                        m["right"], user=m.get("user"),
+                        groups=m.get("groups", ()))
+                ),
                 "GetAcls": self._wrap(
                     lambda m: self.om.get_acls(
                         m["obj_type"], m["volume"], m.get("bucket", ""),
@@ -693,6 +699,12 @@ class GrpcOmClient:
         return self._call("ModifyAcl", obj_type=obj_type, volume=volume,
                           bucket=bucket, path=path, op=op,
                           acls=normalize_acls(acls))["result"]
+
+    def check_access(self, volume, bucket, key, right, user=None,
+                     groups=()):
+        self._call("CheckAccess", volume=volume, bucket=bucket, key=key,
+                   right=right if isinstance(right, str) else right.name,
+                   user=user, groups=list(groups))
 
     def get_acls(self, obj_type, volume, bucket="", path=""):
         return self._call("GetAcls", obj_type=obj_type, volume=volume,

@@ -490,3 +490,58 @@ def test_webhdfs_snapshot_verbs_and_quota(hfs):
     hm = json.load(_req(hfs, "GET", "/", op="GETHOMEDIRECTORY",
                         **{"user.name": "bob"}))
     assert hm["Path"] == "/user/bob"
+
+
+def test_webhdfs_blocklocations_acl_checkaccess(hfs, cluster):
+    """GETFILEBLOCKLOCATIONS (block groups as BlockLocations),
+    GETACLSTATUS (native grants in AclStatus shape), and CHECKACCESS
+    (?fsaction rights probe against the native authorizer)."""
+    _req(hfs, "PUT", "/bv/bb", op="MKDIRS")
+    req = urllib.request.Request(
+        _url(hfs, "/bv/bb/f", op="CREATE", data="true"),
+        data=b"z" * 20_000, method="PUT")
+    assert urllib.request.urlopen(req).status == 201
+    bl = json.load(_req(hfs, "GET", "/bv/bb/f",
+                        op="GETFILEBLOCKLOCATIONS"))
+    locs = bl["BlockLocations"]["BlockLocation"]
+    assert locs and locs[0]["offset"] == 0
+    assert sum(loc["length"] for loc in locs) == 20_000
+    assert len(locs[0]["hosts"]) == 5  # rs-3-2: all unit holders listed
+    # range filtering: a window inside the first group returns it alone
+    bl = json.load(_req(hfs, "GET", "/bv/bb/f",
+                        op="GETFILEBLOCKLOCATIONS", offset=1, length=2))
+    assert len(bl["BlockLocations"]["BlockLocation"]) == 1
+    # a window past EOF returns nothing
+    bl = json.load(_req(hfs, "GET", "/bv/bb/f",
+                        op="GETFILEBLOCKLOCATIONS", offset=20_000,
+                        length=5))
+    assert bl["BlockLocations"]["BlockLocation"] == []
+    # a MISSING path is FileNotFound (404), not a 403 IOException
+    for op in ("GETFILEBLOCKLOCATIONS", "GETACLSTATUS"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(hfs, "GET", "/bv/bb/nope", op=op)
+        assert ei.value.code == 404
+
+    st = json.load(_req(hfs, "GET", "/bv/bb/f", op="GETACLSTATUS"))
+    assert st["AclStatus"]["owner"]
+    # entries follow Hadoop's AclEntry grammar (no 'access:' prefix,
+    # types limited to user/group/other)
+    for e in st["AclStatus"]["entries"]:
+        parts = e.split(":")
+        assert parts[0] in ("default", "user", "group", "other"), e
+
+    # CHECKACCESS: permissive with ACLs off; enforced once enabled
+    assert _req(hfs, "GET", "/bv/bb/f", op="CHECKACCESS",
+                fsaction="rw-").status == 200
+    om = cluster.om
+    om.enable_acls()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(hfs, "GET", "/bv/bb/f", op="CHECKACCESS",
+                 fsaction="-w-", **{"user.name": "mallory"})
+        assert ei.value.code == 403
+        body = json.loads(ei.value.read())
+        assert body["RemoteException"]["exception"] == \
+            "AccessControlException"
+    finally:
+        om.acl_enabled = False
