@@ -299,3 +299,27 @@ def test_fso_set_key_attrs(cluster):
         "vol", "fsb", "p/q/f.txt")["attrs"]
     with pytest.raises(OMError):
         om.set_key_attrs("vol", "fsb", "p/nope", {"owner": "x"})
+
+
+def test_fso_attr_preconds_atomic(cluster):
+    """The xattr CREATE/REPLACE flag preconditions hold on the FSO
+    path too (SetEntryAttrs.preconds, evaluated inside the apply)."""
+    oz = cluster.client()
+    oz.create_volume("xat")
+    cluster.om.create_bucket("xat", "fb", "rs-3-2-4096",
+                             layout="FILE_SYSTEM_OPTIMIZED")
+    b = oz.get_volume("xat").get_bucket("fb")
+    b.write_key("d/f", b"x")
+    om = cluster.om
+    om.set_key_attrs("xat", "fb", "d/f", {"xattr:user.a": "1"},
+                     preconds={"xattr:user.a": False})
+    with pytest.raises(OMError) as ei:
+        om.set_key_attrs("xat", "fb", "d/f", {"xattr:user.a": "2"},
+                         preconds={"xattr:user.a": False})
+    assert ei.value.code == "XATTR_EXISTS"
+    with pytest.raises(OMError) as ei:
+        om.set_key_attrs("xat", "fb", "d/f", {"xattr:user.b": "2"},
+                         preconds={"xattr:user.b": True})
+    assert ei.value.code == "XATTR_NOT_FOUND"
+    om.set_key_attrs("xat", "fb", "d/f", {"xattr:user.a": None},
+                     preconds={"xattr:user.a": True})
