@@ -23,7 +23,7 @@ import threading
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
-from urllib.parse import parse_qs, unquote, urlparse
+from urllib.parse import parse_qs, quote as _url_quote, unquote, urlparse
 
 import numpy as np
 
@@ -66,6 +66,27 @@ def _iso_ts(ts: float) -> str:
     return datetime.datetime.fromtimestamp(
         ts, datetime.timezone.utc
     ).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+
+
+def _opaque_token(key: str) -> str:
+    """V2 continuation tokens are SERVER-issued opaque strings (AWS
+    contract; SDKs never decode them). Ours wrap the resume key, which
+    may contain XML-hostile bytes — base64url with a version prefix
+    keeps the response well-formed for ANY key."""
+    import base64
+
+    return "t1:" + base64.urlsafe_b64encode(key.encode()).decode()
+
+
+def _parse_token(token: str) -> str:
+    import base64
+
+    if token.startswith("t1:"):
+        try:
+            return base64.urlsafe_b64decode(token[3:]).decode()
+        except Exception:  # noqa: BLE001 - malformed: treat as raw
+            return token
+    return token  # raw keys from older clients / start-after reuse
 
 
 def _err(code: str, message: str, status: int) -> tuple[int, bytes]:
@@ -583,31 +604,38 @@ class S3Gateway:
                 truncated = True
                 break
             uploads.append(m)
+        # ?encoding-type=url: same contract as ListObjects — keys,
+        # prefixes and key markers answer URL-encoded
+        enc_url = q.get("encoding-type", [""])[0] == "url"
+        esc = ((lambda v: _url_quote(v, safe="/")) if enc_url
+               else (lambda v: v))
         root = ET.Element("ListMultipartUploadsResult", xmlns=_NS)
         ET.SubElement(root, "Bucket").text = bucket
-        ET.SubElement(root, "KeyMarker").text = key_marker
+        ET.SubElement(root, "KeyMarker").text = esc(key_marker)
         ET.SubElement(root, "UploadIdMarker").text = id_marker
+        if enc_url:
+            ET.SubElement(root, "EncodingType").text = "url"
         if truncated:
             # next markers name the last entity served; a CommonPrefix
             # resumes key-only (uploads inside it were never listed)
             last_key = uploads[-1]["name"] if uploads else ""
             last_cp = common[-1] if common else ""
             if last_cp > last_key:
-                ET.SubElement(root, "NextKeyMarker").text = last_cp
+                ET.SubElement(root, "NextKeyMarker").text = esc(last_cp)
                 ET.SubElement(root, "NextUploadIdMarker").text = ""
             else:
-                ET.SubElement(root, "NextKeyMarker").text = last_key
+                ET.SubElement(root, "NextKeyMarker").text = esc(last_key)
                 ET.SubElement(root, "NextUploadIdMarker").text = (
                     uploads[-1]["upload_id"])
-        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "Prefix").text = esc(prefix)
         if delim:
-            ET.SubElement(root, "Delimiter").text = delim
+            ET.SubElement(root, "Delimiter").text = esc(delim)
         ET.SubElement(root, "MaxUploads").text = str(max_uploads)
         ET.SubElement(root, "IsTruncated").text = (
             "true" if truncated else "false")
         for m in uploads:
             u = ET.SubElement(root, "Upload")
-            ET.SubElement(u, "Key").text = m["name"]
+            ET.SubElement(u, "Key").text = esc(m["name"])
             ET.SubElement(u, "UploadId").text = m["upload_id"]
             owner = ET.SubElement(u, "Owner")
             ET.SubElement(owner, "ID").text = "ozone"
@@ -618,7 +646,7 @@ class S3Gateway:
                 m.get("created", 0.0))
         for cp in common:
             e = ET.SubElement(root, "CommonPrefixes")
-            ET.SubElement(e, "Prefix").text = cp
+            ET.SubElement(e, "Prefix").text = esc(cp)
         h._reply(200, _xml(root), {"Content-Type": "application/xml"})
 
     def _list_objects(self, h, bucket: str, q) -> None:
@@ -641,7 +669,8 @@ class S3Gateway:
         # both resume cursors emit entities in key order, so the
         # group-already-served check below treats them identically
         token = (marker if v1
-                 else q.get("continuation-token", [""])[0])
+                 else _parse_token(
+                     q.get("continuation-token", [""])[0]))
         after = token or q.get("start-after", [""])[0]
         contents: list[dict] = []
         common: list[str] = []
@@ -694,13 +723,22 @@ class S3Gateway:
             next_token = (contents[-1]["name"] if contents else "")
             last_cp = common[-1] if common else ""
             next_token = max(next_token, last_cp)
+        # ?encoding-type=url (boto3 sends it by default): key-derived
+        # strings in the RESPONSE are URL-encoded, so keys containing
+        # XML-hostile characters (newlines, control bytes) survive the
+        # round trip; the EncodingType element tells the SDK to decode
+        enc_url = q.get("encoding-type", [""])[0] == "url"
+        esc = ((lambda s: _url_quote(s, safe="/")) if enc_url
+               else (lambda s: s))
         root = ET.Element("ListBucketResult", xmlns=_NS)
         ET.SubElement(root, "Name").text = bucket
-        ET.SubElement(root, "Prefix").text = prefix
+        ET.SubElement(root, "Prefix").text = esc(prefix)
+        if enc_url:
+            ET.SubElement(root, "EncodingType").text = "url"
         if delim:
-            ET.SubElement(root, "Delimiter").text = delim
+            ET.SubElement(root, "Delimiter").text = esc(delim)
         if v1:
-            ET.SubElement(root, "Marker").text = marker
+            ET.SubElement(root, "Marker").text = esc(marker)
         else:
             ET.SubElement(root, "KeyCount").text = str(
                 len(contents) + len(common))
@@ -708,17 +746,20 @@ class S3Gateway:
         ET.SubElement(root, "IsTruncated").text = (
             "true" if truncated else "false")
         if truncated and next_token:
+            # V1 NextMarker is a KEY (encoding-type applies); the V2
+            # token is opaque and safe for any key bytes
             ET.SubElement(root,
                           "NextMarker" if v1
-                          else "NextContinuationToken").text = next_token
+                          else "NextContinuationToken").text = \
+                esc(next_token) if v1 else _opaque_token(next_token)
         for k in contents:
             c = ET.SubElement(root, "Contents")
-            ET.SubElement(c, "Key").text = k["name"]
+            ET.SubElement(c, "Key").text = esc(k["name"])
             ET.SubElement(c, "Size").text = str(k["size"])
             ET.SubElement(c, "LastModified").text = str(k.get("modified", ""))
         for cp in common:
             e = ET.SubElement(root, "CommonPrefixes")
-            ET.SubElement(e, "Prefix").text = cp
+            ET.SubElement(e, "Prefix").text = esc(cp)
         h._reply(200, _xml(root), {"Content-Type": "application/xml"})
 
     def _multi_delete(self, h, bucket: str) -> None:

@@ -1,6 +1,7 @@
 """S3 gateway + filesystem adapter tests over a MiniOzoneCluster."""
 
 import urllib.error
+import urllib.parse
 import urllib.request
 import xml.etree.ElementTree as ET
 
@@ -687,3 +688,51 @@ def test_s3_list_multipart_uploads_paging(s3):
         with pytest.raises(urllib.error.HTTPError) as ei:
             _req(s3, "GET", f"/lmup?uploads&max-uploads={bad}")
         assert ei.value.code == 400
+
+
+def test_s3_list_encoding_type_url(s3):
+    """?encoding-type=url: response keys/prefixes are URL-encoded and
+    the EncodingType element tells SDKs to decode (boto3 sends this by
+    default; keys with XML-hostile bytes survive)."""
+    _req(s3, "PUT", "/encb")
+    _req(s3, "PUT", "/encb/plain.txt", data=b"a")
+    _req(s3, "PUT", urllib.parse.quote("/encb/dir with space/k+1"),
+         data=b"b")
+    tree = ET.fromstring(_req(
+        s3, "GET", "/encb?list-type=2&encoding-type=url").read())
+    assert tree.findtext("{*}EncodingType") == "url"
+    keys = [e.text for e in tree.iter() if e.tag.endswith("}Key")]
+    assert "plain.txt" in keys
+    assert "dir%20with%20space/k%2B1" in keys
+    # delimiter grouping: the CommonPrefix is encoded too
+    tree = ET.fromstring(_req(
+        s3, "GET",
+        "/encb?list-type=2&encoding-type=url&delimiter=/").read())
+    cps = [e.text for p in tree.iter()
+           if p.tag.endswith("CommonPrefixes")
+           for e in p if e.tag.endswith("Prefix")]
+    assert cps == ["dir%20with%20space/"]
+    # without the param nothing is encoded (older SDKs)
+    tree = ET.fromstring(_req(s3, "GET", "/encb?list-type=2").read())
+    keys = [e.text for e in tree.iter() if e.tag.endswith("}Key")]
+    assert "dir with space/k+1" in keys
+    # V2 continuation tokens are OPAQUE and resume correctly for keys
+    # with any bytes (no raw key text in the token element)
+    tree = ET.fromstring(_req(
+        s3, "GET", "/encb?list-type=2&max-keys=1").read())
+    tok = tree.findtext("{*}NextContinuationToken")
+    assert tok.startswith("t1:")
+    tree = ET.fromstring(_req(
+        s3, "GET",
+        f"/encb?list-type=2&continuation-token={tok}").read())
+    keys2 = [e.text for e in tree.iter() if e.tag.endswith("}Key")]
+    assert keys2 and keys2 != keys[:1]
+    # ListMultipartUploads honors encoding-type too
+    _req(s3, "POST",
+         "/encb/" + urllib.parse.quote("up space") + "?uploads")
+    tree = ET.fromstring(
+        _req(s3, "GET", "/encb?uploads&encoding-type=url").read())
+    assert tree.findtext("{*}EncodingType") == "url"
+    ks = [u.findtext("{*}Key") for u in tree.iter()
+          if u.tag.endswith("}Upload")]
+    assert "up%20space" in ks
