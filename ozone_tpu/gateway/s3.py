@@ -71,22 +71,40 @@ def _iso_ts(ts: float) -> str:
 def _opaque_token(key: str) -> str:
     """V2 continuation tokens are SERVER-issued opaque strings (AWS
     contract; SDKs never decode them). Ours wrap the resume key, which
-    may contain XML-hostile bytes — base64url with a version prefix
-    keeps the response well-formed for ANY key."""
+    may contain XML-hostile bytes — base64url with a version prefix and
+    a CRC32 tag keeps the response well-formed for ANY key and makes the
+    token self-validating (a raw key that happens to look like one can't
+    be misdecoded)."""
     import base64
+    import zlib
 
-    return "t1:" + base64.urlsafe_b64encode(key.encode()).decode()
+    raw = key.encode()
+    tag = zlib.crc32(raw).to_bytes(4, "big")
+    return "t1:" + base64.urlsafe_b64encode(tag + raw).decode()
 
 
 def _parse_token(token: str) -> str:
     import base64
+    import zlib
 
     if token.startswith("t1:"):
         try:
-            return base64.urlsafe_b64decode(token[3:]).decode()
+            blob = base64.urlsafe_b64decode(token[3:])
+            if (len(blob) >= 4
+                    and zlib.crc32(blob[4:]).to_bytes(4, "big") == blob[:4]):
+                return blob[4:].decode()
         except Exception:  # noqa: BLE001 - malformed: treat as raw
-            return token
+            pass
     return token  # raw keys from older clients / start-after reuse
+
+
+def _esc_fn(q: dict):
+    """?encoding-type=url handling shared by every listing verb: returns
+    (enc_url, esc) where esc URL-encodes key-derived response strings so
+    XML-hostile bytes survive the round trip."""
+    enc_url = q.get("encoding-type", [""])[0] == "url"
+    return enc_url, ((lambda s: _url_quote(s, safe="/")) if enc_url
+                     else (lambda s: s))
 
 
 def _err(code: str, message: str, status: int) -> tuple[int, bytes]:
@@ -606,9 +624,7 @@ class S3Gateway:
             uploads.append(m)
         # ?encoding-type=url: same contract as ListObjects — keys,
         # prefixes and key markers answer URL-encoded
-        enc_url = q.get("encoding-type", [""])[0] == "url"
-        esc = ((lambda v: _url_quote(v, safe="/")) if enc_url
-               else (lambda v: v))
+        enc_url, esc = _esc_fn(q)
         root = ET.Element("ListMultipartUploadsResult", xmlns=_NS)
         ET.SubElement(root, "Bucket").text = bucket
         ET.SubElement(root, "KeyMarker").text = esc(key_marker)
@@ -727,9 +743,7 @@ class S3Gateway:
         # strings in the RESPONSE are URL-encoded, so keys containing
         # XML-hostile characters (newlines, control bytes) survive the
         # round trip; the EncodingType element tells the SDK to decode
-        enc_url = q.get("encoding-type", [""])[0] == "url"
-        esc = ((lambda s: _url_quote(s, safe="/")) if enc_url
-               else (lambda s: s))
+        enc_url, esc = _esc_fn(q)
         root = ET.Element("ListBucketResult", xmlns=_NS)
         ET.SubElement(root, "Name").text = bucket
         ET.SubElement(root, "Prefix").text = esc(prefix)

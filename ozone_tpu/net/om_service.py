@@ -296,6 +296,12 @@ class OmGrpcService:
                     lambda m: self.om.cancel_prepare()),
                 "PrepareStatus": self._wrap(
                     lambda m: {"prepared": self.om.prepared}),
+                "GetDelegationToken": self._wrap(
+                    lambda m: self.om.get_delegation_token(m["renewer"])),
+                "RenewDelegationToken": self._wrap(
+                    lambda m: self.om.renew_delegation_token(m["token"])),
+                "CancelDelegationToken": self._wrap(
+                    lambda m: self.om.cancel_delegation_token(m["token"])),
         }
         server.add_service(
             SERVICE, {n: self._gated(fn) for n, fn in methods.items()})
@@ -308,14 +314,27 @@ class OmGrpcService:
 
         return method
 
+    def _identity(self, m: dict) -> tuple:
+        """Caller identity for this request. A presented delegation token
+        AUTHENTICATES the identity (verified signature + live server row,
+        the reference's token-auth path); the plain _user/_groups fields
+        are the trusted-transport identity assertion and are IGNORED when
+        a token is present so a stolen field can't outrank a token."""
+        tok = m.pop("_dtoken", None)
+        user = m.pop("_user", None)
+        groups = m.pop("_groups", ())
+        if tok is not None:
+            row = self.om.verify_delegation_token(tok)  # raises OMError
+            return row["owner"], ()
+        return user, groups
+
     def _wrap(self, fn):
         def method(req: bytes) -> bytes:
             m, _ = wire.unpack(req)
-            user = m.pop("_user", None)
-            groups = m.pop("_groups", ())
             try:
                 # bind the remote caller identity for ACL checks (the
                 # reference carries UGI identity on every OM RPC)
+                user, groups = self._identity(m)
                 with self.om.user_context(user, groups):
                     out = fn(m)
             except OMError as e:
@@ -327,8 +346,8 @@ class OmGrpcService:
     def _open_key(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
         try:
-            with self.om.user_context(m.pop("_user", None),
-                                      m.pop("_groups", ())):
+            user, groups = self._identity(m)
+            with self.om.user_context(user, groups):
                 s = self.om.open_key(
                     m["volume"], m["bucket"], m["key"],
                     m.get("replication"), metadata=m.get("metadata"),
@@ -444,7 +463,7 @@ class GrpcOmClient:
     (OMFailoverProxyProvider analog): calls stick to the known leader,
     follow OM_NOT_LEADER hints, and rotate on connection failure."""
 
-    def __init__(self, address: str, clients=None, tls=None):
+    def __init__(self, address: str, clients=None, tls=None, token=None):
         from ozone_tpu.net.rpc import FailoverChannels
 
         self._pool = FailoverChannels(address, tls=tls)
@@ -454,6 +473,12 @@ class GrpcOmClient:
         self.block_size = 16 * 1024 * 1024
         self.clients = clients  # DatanodeClientFactory for address learning
         self._caller = threading.local()
+        #: delegation token attached to every call — the authenticated
+        #: identity path (jobs present the token instead of _user)
+        self._token = token
+
+    def use_token(self, token) -> None:
+        self._token = token
 
     def user_context(self, user, groups=()):
         """Bind a caller identity to every RPC issued from this thread
@@ -479,6 +504,8 @@ class GrpcOmClient:
         if ident is not None and ident[0] is not None:
             meta.setdefault("_user", ident[0])
             meta.setdefault("_groups", list(ident[1]))
+        if self._token is not None:
+            meta.setdefault("_dtoken", self._token)
         payload = wire.pack(meta)
         last: Exception | None = None
         attempts = max(4, 3 * len(self.addresses))
@@ -682,6 +709,16 @@ class GrpcOmClient:
 
     def revoke_s3_secret(self, access_id):
         self._call("RevokeS3Secret", access_id=access_id)
+
+    # delegation tokens
+    def get_delegation_token(self, renewer):
+        return self._call("GetDelegationToken", renewer=renewer)["result"]
+
+    def renew_delegation_token(self, token):
+        return self._call("RenewDelegationToken", token=token)["result"]
+
+    def cancel_delegation_token(self, token):
+        self._call("CancelDelegationToken", token=token)
 
     def set_bucket_acl(self, volume, bucket, acl):
         self._call("SetBucketAcl", volume=volume, bucket=bucket, acl=acl)

@@ -38,6 +38,10 @@ _TABLES = (
     # multi-tenancy (reference: tenantStateTable, tenantAccessIdTable)
     "tenants",
     "tenant_access",
+    # delegation tokens (reference: dTokenTable + persisted master keys,
+    # OzoneDelegationTokenSecretManager)
+    "delegation_tokens",
+    "dtoken_keys",
     # process-level markers (e.g. the raft applied-index floor) that must
     # flush atomically with the data they describe
     "system",

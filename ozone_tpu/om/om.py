@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 import uuid
 from pathlib import Path
 from typing import Any, Optional
@@ -87,6 +88,11 @@ class OzoneManager:
         from ozone_tpu.utils.kms import KeyProvider
 
         self.kms = KeyProvider(self.store)
+        # delegation-token lifetimes (reference defaults:
+        # dfs.container.token renew-interval 1d, max-lifetime 7d)
+        self.dtoken_renew_interval_s = 24 * 3600.0
+        self.dtoken_max_lifetime_s = 7 * 24 * 3600.0
+        self.dtoken_key_lifetime_s = 30 * 24 * 3600.0
 
     # ----------------------------------------------------------- acl/tenant
     def enable_acls(self, superusers=("root",)) -> None:
@@ -784,6 +790,82 @@ class OzoneManager:
 
     def revoke_s3_secret(self, access_id: str) -> None:
         self.submit(rq.RevokeS3Secret(access_id))
+
+    # ----------------------------------------------------- delegation tokens
+    def get_delegation_token(self, renewer: str,
+                             owner: Optional[str] = None) -> dict:
+        """Issue a signed delegation token for the current caller
+        (OzoneManager.getDelegationToken → OMGetDelegationTokenRequest).
+        Returns the portable token dict (identifier + sig)."""
+        from ozone_tpu.om import dtokens
+        import secrets as _secrets
+
+        user, _ = self.current_user()
+        owner = owner or user or "root"
+        key = dtokens.current_key(self.store)
+        if key is None:
+            self.submit(rq.NewDTokenMasterKey())
+            key = dtokens.current_key(self.store)
+        now = time.time()
+        ident = {
+            "owner": owner,
+            "renewer": renewer,
+            "real_user": user or owner,
+            "issue": round(now, 3),
+            "max_date": round(now + self.dtoken_max_lifetime_s, 3),
+            "token_id": _secrets.token_hex(8),
+            "key_id": key["key_id"],
+        }
+        ident["sig"] = dtokens.sign(bytes.fromhex(key["material"]), ident)
+        expiry = round(min(now + self.dtoken_renew_interval_s,
+                           ident["max_date"]), 3)
+        self.submit(rq.StoreDelegationToken(ident, expiry))
+        return ident
+
+    def renew_delegation_token(self, token: dict) -> float:
+        """Extend the renewable expiry; only the named renewer may renew
+        (the caller identity is checked inside the replicated request).
+        Identity-less callers follow the repo-wide convention that
+        unbound calls are trusted local/in-process callers (the same
+        rule user_context documents) and act as the token's renewer —
+        remote identity assertions are transport-trusted here exactly
+        like _user on every other OM verb; mTLS (utils/ca.py) is the
+        transport authentication layer."""
+        from ozone_tpu.om import dtokens
+
+        try:
+            dtokens.check_signature(self.store, token)
+        except dtokens.DTokenError as e:
+            raise rq.OMError(rq.TOKEN_ERROR, e.msg)
+        user, _ = self.current_user()
+        return self.submit(rq.RenewDelegationToken(
+            str(token["token_id"]), user or str(token["renewer"])))
+
+    def cancel_delegation_token(self, token: dict) -> None:
+        from ozone_tpu.om import dtokens
+
+        try:
+            dtokens.check_signature(self.store, token)
+        except dtokens.DTokenError as e:
+            raise rq.OMError(rq.TOKEN_ERROR, e.msg)
+        user, _ = self.current_user()
+        self.submit(rq.CancelDelegationToken(
+            str(token["token_id"]), user or str(token["owner"])))
+
+    def verify_delegation_token(self, token: dict) -> dict:
+        """Authenticate a presented token: returns the stored row (the
+        authoritative owner/renewer) or raises OMError(TOKEN_ERROR)."""
+        from ozone_tpu.om import dtokens
+
+        try:
+            return dtokens.verify(self.store, token)
+        except dtokens.DTokenError as e:
+            raise rq.OMError(rq.TOKEN_ERROR, e.msg)
+
+    def run_dtoken_cleanup_once(self) -> int:
+        """Purge expired tokens + orphaned master keys (the reference's
+        ExpiredTokenRemover sweep)."""
+        return self.submit(rq.PurgeExpiredDTokens())
 
     def set_bucket_acl(self, volume: str, bucket: str,
                        acl: list[dict]) -> None:

@@ -1211,3 +1211,138 @@ class PurgeDeletedKeys(OMRequest):
     def apply(self, store):
         for k in self.entries:
             store.delete("deleted_keys", k)
+
+
+# ------------------------------------------------------- delegation tokens
+
+TOKEN_ERROR = "TOKEN_ERROR"
+
+
+@dataclass
+class NewDTokenMasterKey(OMRequest):
+    """Install a delegation-token master key (reference: the
+    OzoneDelegationTokenSecretManager rolling its master key and
+    persisting it through OMUpdateDelegationTokenRequest so every HA
+    replica signs/verifies identically). The leader mints material in
+    pre_execute; apply installs it verbatim — deterministic on replicas."""
+
+    key_id: str = ""
+    material: str = ""
+    created: float = 0.0
+    expires: float = 0.0
+    if_absent: bool = True
+
+    def pre_execute(self, om) -> None:
+        import secrets as _secrets
+
+        self.key_id = _secrets.token_hex(8)
+        self.material = _secrets.token_bytes(32).hex()
+        self.created = time.time()
+        self.expires = self.created + om.dtoken_key_lifetime_s
+
+    def apply(self, store):
+        from ozone_tpu.om import dtokens
+
+        if self.if_absent:
+            cur = dtokens.current_key(store, now=self.created)
+            if cur is not None:
+                return cur["key_id"]
+        store.put("dtoken_keys", self.key_id, {
+            "key_id": self.key_id,
+            "material": self.material,
+            "created": self.created,
+            "expires": self.expires,
+        })
+        return self.key_id
+
+
+@dataclass
+class StoreDelegationToken(OMRequest):
+    """Persist an issued token's server-side row (the dTokenTable write
+    in OMGetDelegationTokenRequest.validateAndUpdateCache)."""
+
+    ident: dict = field(default_factory=dict)
+    expiry: float = 0.0
+
+    def apply(self, store):
+        row = dict(self.ident)
+        row.pop("sig", None)
+        row["expiry"] = self.expiry
+        store.put("delegation_tokens", str(self.ident["token_id"]), row)
+        return row
+
+
+@dataclass
+class RenewDelegationToken(OMRequest):
+    """Extend a token's renewable expiry, bounded by its max_date
+    (OMRenewDelegationTokenRequest; only the named renewer may renew)."""
+
+    token_id: str
+    requester: str
+    now: float = 0.0
+    renew_interval_s: float = 86400.0
+
+    def pre_execute(self, om) -> None:
+        self.now = time.time()
+        self.renew_interval_s = om.dtoken_renew_interval_s
+
+    def apply(self, store):
+        row = store.get("delegation_tokens", self.token_id)
+        if row is None:
+            raise OMError(TOKEN_ERROR, "token cancelled or unknown")
+        if self.requester != row["renewer"]:
+            raise OMError(
+                TOKEN_ERROR,
+                f"{self.requester!r} is not the renewer ({row['renewer']!r})")
+        if row["expiry"] < self.now:
+            raise OMError(TOKEN_ERROR, "token expired; cannot renew")
+        row["expiry"] = round(min(self.now + self.renew_interval_s,
+                                  row["max_date"]), 3)
+        store.put("delegation_tokens", self.token_id, row)
+        return row["expiry"]
+
+
+@dataclass
+class CancelDelegationToken(OMRequest):
+    """Invalidate a token (OMCancelDelegationTokenRequest; owner or
+    renewer only)."""
+
+    token_id: str
+    requester: str
+
+    def apply(self, store):
+        row = store.get("delegation_tokens", self.token_id)
+        if row is None:
+            raise OMError(TOKEN_ERROR, "token cancelled or unknown")
+        if self.requester not in (row["owner"], row["renewer"]):
+            raise OMError(
+                TOKEN_ERROR,
+                f"{self.requester!r} is neither owner nor renewer")
+        store.delete("delegation_tokens", self.token_id)
+
+
+@dataclass
+class PurgeExpiredDTokens(OMRequest):
+    """Background sweep: drop tokens past expiry and master keys that are
+    both expired and unreferenced (the reference's ExpiredTokenRemover
+    thread inside OzoneDelegationTokenSecretManager)."""
+
+    now: float = 0.0
+
+    def pre_execute(self, om) -> None:
+        if not self.now:
+            self.now = time.time()
+
+    def apply(self, store):
+        dropped = 0
+        live_keys = set()
+        for tid, row in list(store.iterate("delegation_tokens")):
+            if min(row["expiry"], row["max_date"]) < self.now:
+                store.delete("delegation_tokens", tid)
+                dropped += 1
+            else:
+                live_keys.add(row["key_id"])
+        for kid, row in list(store.iterate("dtoken_keys")):
+            if row["expires"] < self.now and kid not in live_keys:
+                store.delete("dtoken_keys", kid)
+        return dropped

@@ -131,7 +131,62 @@ _SH_VERBS = {
     "bucket": {"create", "delete", "info", "list", "setquota", "link"},
     "key": {"put", "get", "delete", "info", "list", "rename", "checksum"},
     "snapshot": {"create", "list", "info", "delete", "diff", "rename"},
+    "token": {"get", "renew", "cancel", "print"},
 }
+
+
+def _sh_token(args, verb: str) -> int:
+    """`ozone sh token get|renew|cancel|print` (reference shell token
+    verbs over OzoneManager.getDelegationToken/renew/cancel). Tokens are
+    portable JSON files; --token names the file, --renewer the renewer
+    principal on get."""
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    def _read_token():
+        if not args.token:
+            print("error: --token FILE required", file=sys.stderr)
+            return None
+        try:
+            with open(args.token) as f:
+                return json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read token file {args.token}: {e}",
+                  file=sys.stderr)
+            return None
+
+    if verb == "print":
+        tok = _read_token()
+        if tok is None:
+            return 2
+        _emit(tok)
+        return 0
+    om = GrpcOmClient(args.om, tls=_client_tls())
+    if verb == "get":
+        if not args.renewer:
+            print("error: --renewer required", file=sys.stderr)
+            return 2
+        # the token's owner is the local OS user (the reference binds
+        # the Kerberos principal; the CLI analog is the login identity)
+        import getpass
+
+        with om.user_context(getpass.getuser()):
+            tok = om.get_delegation_token(args.renewer)
+        if args.token:
+            with open(args.token, "w") as f:
+                json.dump(tok, f)
+            print(f"token written to {args.token}")
+        else:
+            _emit(tok)
+        return 0
+    tok = _read_token()
+    if tok is None:
+        return 2
+    if verb == "renew":
+        _emit({"expiry": om.renew_delegation_token(tok)})
+    elif verb == "cancel":
+        om.cancel_delegation_token(tok)
+        print("token cancelled")
+    return 0
 
 
 # ---------------------------------------------------------------------- sh
@@ -140,6 +195,12 @@ def cmd_sh(args) -> int:
     if verb not in _SH_VERBS[kind]:
         print(f"error: '{verb}' is not a {kind} verb (expected one of "
               f"{sorted(_SH_VERBS[kind])})", file=sys.stderr)
+        return 2
+    if kind == "token":
+        return _sh_token(args, verb)
+    if not args.path:
+        print(f"error: {kind} {verb} requires a /volume[/bucket[/key]] "
+              f"path", file=sys.stderr)
         return 2
     oz = _client(args)
     parts = _parse_path(args.path)
@@ -856,12 +917,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     sh = sub.add_parser("sh", help="object store shell (ozone sh analog)")
     sh.add_argument("object",
-                    choices=["volume", "bucket", "key", "snapshot"])
+                    choices=["volume", "bucket", "key", "snapshot",
+                             "token"])
     sh.add_argument("verb",
                     choices=["create", "delete", "info", "list", "put",
                              "get", "rename", "checksum", "setquota",
-                             "diff", "link"])
-    sh.add_argument("path", help="/volume[/bucket[/key]]")
+                             "diff", "link", "renew", "cancel", "print"])
+    sh.add_argument("path", nargs="?", default="",
+                    help="/volume[/bucket[/key]] (token verbs take none)")
     sh.add_argument("file", nargs="?", help="local file for key put/get")
     sh.add_argument("--om", default="127.0.0.1:9860")
     sh.add_argument("--replication", default="")
@@ -875,6 +938,10 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("--name", default="",
                     help="snapshot verbs: snapshot name (diff: the "
                          "from-snapshot)")
+    sh.add_argument("--renewer", default="",
+                    help="token get: renewer principal")
+    sh.add_argument("--token", default="",
+                    help="token verbs: token file path")
     sh.add_argument("--quota", default="",
                     help="setquota: space quota (e.g. 10MB; 'clear' "
                          "for unlimited)")
