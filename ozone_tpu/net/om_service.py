@@ -296,6 +296,11 @@ class OmGrpcService:
                     lambda m: self.om.cancel_prepare()),
                 "PrepareStatus": self._wrap(
                     lambda m: {"prepared": self.om.prepared}),
+                "ListOpenFiles": self._wrap(
+                    lambda m: self.om.list_open_files(
+                        m.get("volume", ""), m.get("bucket", ""),
+                        m.get("prefix", ""), m.get("start_after", ""),
+                        m.get("limit", 100))),
                 "GetDelegationToken": self._wrap(
                     lambda m: self.om.get_delegation_token(m["renewer"])),
                 "RenewDelegationToken": self._wrap(
@@ -709,6 +714,12 @@ class GrpcOmClient:
 
     def revoke_s3_secret(self, access_id):
         self._call("RevokeS3Secret", access_id=access_id)
+
+    def list_open_files(self, volume="", bucket="", prefix="",
+                        start_after="", limit=100):
+        return self._call("ListOpenFiles", volume=volume, bucket=bucket,
+                          prefix=prefix, start_after=start_after,
+                          limit=limit)["result"]
 
     # delegation tokens
     def get_delegation_token(self, renewer):

@@ -301,7 +301,7 @@ class OpenKeyCleanupService:
         expired = []
         hsynced = []
         for k, info in self.om.store.iterate("open_keys"):
-            if k.startswith("/.snapmeta/"):
+            if rq.is_snapmeta(k):
                 continue
             if info.get("hsync_client_id"):
                 # a live hsync stream refreshes "modified" on every sync:

@@ -592,6 +592,63 @@ class OzoneManager:
         hsync support in KeyOutputStream / OMKeyCommitRequest isHsync)."""
         self.commit_key(session, groups, size, hsync=True)
 
+    def list_open_files(self, volume: str = "", bucket: str = "",
+                        prefix: str = "", start_after: str = "",
+                        limit: int = 100) -> dict:
+        """Page through open write sessions (reference:
+        OzoneManager.listOpenFiles:3233 over the openKeyTable, surfaced
+        by `ozone admin om list-open-files`): every un-committed open
+        key with its client id, size so far, timestamps and whether an
+        hsync lease holder exists. `start_after` is the previous page's
+        `continuation` value."""
+        if limit is None or limit <= 0:
+            raise rq.OMError(rq.INVALID_REQUEST,
+                             f"limit must be positive, got {limit}")
+        if volume and bucket:
+            volume, bucket = self.resolve_bucket(volume, bucket)
+        # push the scan window into the store: both OBS (key_key) and FSO
+        # (dir_key) open rows share the /volume/bucket/ key prefix, which
+        # also excludes the /.snapmeta/ rows when a bucket is given
+        base = f"/{volume}/{bucket}/" if volume and bucket else ""
+        entries: list[dict] = []
+        truncated = False
+        cursor = start_after
+        while not truncated:
+            chunk = self.store.iterate_range("open_keys", base, cursor,
+                                             limit + 1)
+            for ok, info in chunk:
+                cursor = ok
+                if rq.is_snapmeta(ok):
+                    continue  # snapshot chain metadata rides this table
+                if volume and info.get("volume") != volume:
+                    continue
+                if bucket and info.get("bucket") != bucket:
+                    continue
+                if prefix and not info.get("name", "").startswith(prefix):
+                    continue
+                if len(entries) >= limit:
+                    truncated = True
+                    break
+                entries.append({
+                    "open_key": ok,
+                    "volume": info.get("volume"),
+                    "bucket": info.get("bucket"),
+                    "key": info.get("name"),
+                    "client_id": ok.rsplit("/", 1)[-1],
+                    "size": info.get("size", 0),
+                    "created": info.get("created"),
+                    "modified": info.get("modified"),
+                    "hsync": bool(info.get("hsync_client_id")),
+                })
+            if len(chunk) < limit + 1:
+                break  # scan exhausted
+        return {
+            "open_files": entries,
+            "truncated": truncated,
+            "continuation": (entries[-1]["open_key"]
+                             if truncated and entries else ""),
+        }
+
     def recover_lease(self, volume: str, bucket: str, key: str) -> dict:
         """Seal an abandoned hsynced write and fence its dead writer
         (recoverLease of the ozonefs adapter / OMRecoverLeaseRequest)."""

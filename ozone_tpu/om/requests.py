@@ -389,6 +389,12 @@ def snapmeta_key(volume: str, bucket: str, name: str) -> str:
     return f"/.snapmeta/{volume}/{bucket}/{name}"
 
 
+def is_snapmeta(open_key: str) -> bool:
+    """True for snapshot-chain rows riding the open_keys table — every
+    open-key scan must skip these or report snapshots as open files."""
+    return open_key.startswith("/.snapmeta/")
+
+
 @dataclass
 class CreateSnapshot(OMRequest):
     """Materialize a bucket snapshot (OMSnapshotCreateRequest analog):

@@ -515,11 +515,22 @@ def cmd_admin(args) -> int:
         elif verb == "cancelprepare":
             om.cancel_prepare()
             _emit({"prepared": False})
+        elif verb == "list-open-files":
+            vol = bkt = ""
+            if args.target:
+                parts = _parse_path(args.target)
+                vol = parts[0] if parts else ""
+                bkt = parts[1] if len(parts) > 1 else ""
+            _emit(om.list_open_files(
+                vol, bkt, prefix=args.prefix,
+                start_after=args.start_after,
+                limit=args.limit if args.limit is not None else 100))
         elif verb in (None, "status"):
             _emit(om.prepare_status())
         else:
             return usage(f"unknown om verb {verb!r} "
-                         "(expected prepare|cancelprepare|status)")
+                         "(expected prepare|cancelprepare|status|"
+                         "list-open-files)")
     elif subject == "status":
         _emit(scm.status())
     return 0
@@ -1008,6 +1019,13 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("--threshold", type=float, default=None,
                     help="balancer start: utilization band around the "
                          "cluster average (e.g. 0.1)")
+    ad.add_argument("--prefix", default="",
+                    help="om list-open-files: key-name prefix filter")
+    ad.add_argument("--start-after", default="",
+                    help="om list-open-files: resume after this row "
+                         "(previous page's continuation)")
+    ad.add_argument("--limit", type=int, default=None,
+                    help="om list-open-files: page size")
     ad.add_argument("--max-moves", type=int, default=None,
                     help="balancer start: moves per iteration")
     ad.add_argument("--max-size", type=int, default=None,

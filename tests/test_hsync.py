@@ -248,3 +248,63 @@ def test_cleanup_spares_actively_syncing_writer(cluster):
     h.hsync()
     h.close()
     assert b.read_key("k").size == 4_000
+
+
+def test_list_open_files_pages_and_reflects_lease_state(cluster):
+    """OzoneManager.listOpenFiles analog: open sessions appear with
+    client id + hsync flag, paginate via continuation, and vanish on
+    commit/recover-lease."""
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication="RATIS/THREE")
+    om = cluster.om
+    handles = [b.open_key(f"open{i}") for i in range(5)]
+    handles[0].write(_rng_bytes(3000))
+    handles[0].hsync()
+
+    out = om.list_open_files("v", "b")
+    assert not out["truncated"]
+    by_key = {e["key"]: e for e in out["open_files"]}
+    assert set(by_key) == {f"open{i}" for i in range(5)}
+    assert by_key["open0"]["hsync"] is True
+    assert by_key["open1"]["hsync"] is False
+    assert by_key["open0"]["size"] >= 0
+
+    # pagination: two pages of 3 + 2, stitched by continuation
+    page1 = om.list_open_files("v", "b", limit=3)
+    assert page1["truncated"] and len(page1["open_files"]) == 3
+    page2 = om.list_open_files("v", "b", limit=3,
+                               start_after=page1["continuation"])
+    assert not page2["truncated"]
+    got = [e["open_key"] for e in page1["open_files"] + page2["open_files"]]
+    assert len(got) == 5 and len(set(got)) == 5
+
+    # prefix filter
+    assert len(om.list_open_files("v", "b", prefix="open1")["open_files"]) == 1
+
+    # sessions disappear as they commit / get sealed
+    handles[1].close()
+    h0 = handles[0]
+    om.recover_lease("v", "b", "open0")
+    names = {e["key"] for e in om.list_open_files("v", "b")["open_files"]}
+    assert "open1" not in names
+    assert "open0" not in names  # lease recovery sealed it
+    for h in handles[2:]:
+        h.close()
+    assert om.list_open_files("v", "b")["open_files"] == []
+    del h0
+
+
+def test_list_open_files_excludes_snapshot_metadata(cluster):
+    """Snapshot chain rows ride the open_keys table but are not open
+    files."""
+    oz = cluster.client()
+    b = oz.create_volume("vs").create_bucket("bs", replication="RATIS/THREE")
+    b.write_key("k1", _rng_bytes(1000))
+    om = cluster.om
+    om.create_snapshot("vs", "bs", "snap1")
+    assert om.list_open_files()["open_files"] == []
+
+
+def test_list_open_files_rejects_nonpositive_limit(cluster):
+    with pytest.raises(OMError):
+        cluster.om.list_open_files("v", "b", limit=0)
