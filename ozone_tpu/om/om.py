@@ -607,9 +607,11 @@ class OzoneManager:
         if volume and bucket:
             volume, bucket = self.resolve_bucket(volume, bucket)
         # push the scan window into the store: both OBS (key_key) and FSO
-        # (dir_key) open rows share the /volume/bucket/ key prefix, which
-        # also excludes the /.snapmeta/ rows when a bucket is given
-        base = f"/{volume}/{bucket}/" if volume and bucket else ""
+        # (dir_key) open rows share the /volume[/bucket]/ key prefix,
+        # which also excludes the /.snapmeta/ rows when a volume is given
+        base = ""
+        if volume:
+            base = (f"/{volume}/{bucket}/" if bucket else f"/{volume}/")
         entries: list[dict] = []
         truncated = False
         cursor = start_after

@@ -614,6 +614,15 @@ def cmd_freon(args) -> int:
             _client(args), n_blocks=args.num, threads=args.threads,
             replication=args.replication or "rs-3-2-4096",
         ).summary())
+    elif args.generator == "dnsim":
+        from ozone_tpu.net.scm_service import GrpcScmClient
+
+        scm = GrpcScmClient(args.om, tls=_client_tls())
+        _emit(freon.dnsim(
+            scm, n_datanodes=args.num, n_containers=args.containers,
+            duration_s=args.duration, interval_s=args.interval,
+            threads=args.threads,
+        ).summary())
     elif args.generator == "cmdw":
         _emit(freon.cmdw(args.root or "/tmp/ozone-cmdw", n_chunks=args.num,
                          size=args.size, threads=args.threads).summary())
@@ -1037,7 +1046,8 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["ockg", "ockr", "ockv", "ecrd", "rawcoder", "omkg",
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
                              "dcv", "dsg", "hsg", "dnbp", "ralg",
-                             "fskg", "mpug", "s3kg", "fsg", "sdg"])
+                             "fskg", "mpug", "s3kg", "fsg", "sdg",
+                             "dnsim"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("--warmup", type=int, default=0,
@@ -1056,6 +1066,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="ommg op mix (c/r/u/d/l per char)")
     fr.add_argument("--root", default="",
                     help="local path for cmdw/dbgen")
+    fr.add_argument("--containers", type=int, default=5,
+                    help="dnsim: fabricated containers per simulated "
+                         "datanode")
+    fr.add_argument("--duration", type=float, default=5.0,
+                    help="dnsim: seconds to heartbeat")
+    fr.add_argument("--interval", type=float, default=0.5,
+                    help="dnsim: per-datanode heartbeat interval")
     fr.set_defaults(fn=cmd_freon)
 
     dn = sub.add_parser("datanode", help="run a datanode daemon")

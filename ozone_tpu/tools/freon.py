@@ -36,11 +36,14 @@ class FreonReport:
     elapsed_s: float
     latencies_s: list[float] = field(default_factory=list)
     bytes_processed: int = 0
+    #: generator-specific extra fields merged into summary()
+    extras: dict = field(default_factory=dict)
 
     def summary(self) -> dict:
         lat = sorted(self.latencies_s)
         pct = lambda q: lat[min(len(lat) - 1, int(q * len(lat)))] if lat else 0.0
         return {
+            **self.extras,
             "generator": self.name,
             "ops": self.ops,
             "failures": self.failures,
@@ -378,6 +381,85 @@ def scmtb(client, n_blocks: int = 1000, threads: int = 8,
         return 0
 
     return BaseFreonGenerator("scmtb", n_blocks, threads).run(op)
+
+
+def dnsim(scm, n_datanodes: int = 50, n_containers: int = 5,
+          duration_s: float = 5.0, interval_s: float = 0.5,
+          threads: int = 8, prefix: str = "simdn",
+          fcr_every_rounds: int = 10) -> FreonReport:
+    """Simulated-datanode fleet (freon DatanodeSimulator.java:122
+    analog): registers n virtual datanodes with the SCM over the real
+    register/heartbeat wire protocol, then heartbeats each of them from
+    a thread pool for duration_s, carrying a fabricated full container
+    report on the first beat and every fcr_every_rounds after (the
+    reference's FCR cadence). Nodes register IN_MAINTENANCE so placement
+    never selects them — the reference moves its simulated datanodes to
+    read-only for the same reason — and fabricated container ids live in
+    a high namespace no real allocation reaches, so the replication
+    manager (which walks the container table, not the replica map)
+    ignores them. Measures SCM heartbeat ingest: hb/s + latency
+    percentiles."""
+    ids = [f"{prefix}-{i}" for i in range(n_datanodes)]
+    for i, dn_id in enumerate(ids):
+        scm.register(dn_id, f"sim://{dn_id}", rack=f"/sim-rack-{i % 8}",
+                     capacity_bytes=1 << 40, op_state="IN_MAINTENANCE")
+    base = 50_000_000
+
+    def report_for(i: int) -> list[dict]:
+        return [{
+            "container_id": base + i * n_containers + j,
+            "state": "CLOSED",
+            "replica_index": 0,
+            "block_count": 64,
+            "used_bytes": 4 << 20,
+        } for j in range(n_containers)]
+
+    lock = threading.Lock()
+    lat: list[float] = []
+    counts = {"hb": 0, "fcr": 0, "failures": 0}
+    stop_at = time.time() + duration_s
+
+    def worker(shard: list[int]) -> None:
+        rounds = 0
+        while time.time() < stop_at:
+            round_t0 = time.time()
+            for idx in shard:
+                rep = (report_for(idx)
+                       if rounds % fcr_every_rounds == 0 else None)
+                s = time.perf_counter()
+                try:
+                    scm.heartbeat(ids[idx], container_report=rep,
+                                  used_bytes=(4 << 20) * n_containers)
+                except Exception:
+                    with lock:
+                        counts["failures"] += 1
+                    continue
+                dt = time.perf_counter() - s
+                with lock:
+                    lat.append(dt)
+                    counts["hb"] += 1
+                    if rep is not None:
+                        counts["fcr"] += 1
+            rounds += 1
+            pause = interval_s - (time.time() - round_t0)
+            if pause > 0:
+                time.sleep(pause)
+
+    threads = max(1, threads)
+    shards = [list(range(w, n_datanodes, threads))
+              for w in range(threads)]
+    ts = [threading.Thread(target=worker, args=(s,), daemon=True)
+          for s in shards if s]
+    t0 = time.time()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return FreonReport(
+        "dnsim", ops=counts["hb"], failures=counts["failures"],
+        elapsed_s=time.time() - t0, latencies_s=lat,
+        extras={"datanodes": n_datanodes, "fcrs": counts["fcr"],
+                "containers_per_dn": n_containers})
 
 
 def dbgen(db_path, n_keys: int = 10_000, volume: str = "genvol",

@@ -394,3 +394,33 @@ def test_cli_version_and_getconf(capsys):
     assert cli_main(["getconf"]) == 0
     text = capsys.readouterr().out
     assert "client.checksum.type" in text and "ScmConfig" in text
+
+
+def test_freon_dnsim_simulated_fleet(cluster):
+    """DatanodeSimulator analog: virtual datanodes register + heartbeat
+    over the real wire protocol without polluting placement."""
+    meta, dns = cluster
+    from ozone_tpu.net.scm_service import GrpcScmClient
+    from ozone_tpu.scm.pipeline import ReplicationConfig
+
+    scm_client = GrpcScmClient(meta.address)
+    rep = freon.dnsim(scm_client, n_datanodes=8, n_containers=3,
+                      duration_s=1.2, interval_s=0.2, threads=4,
+                      prefix="simnode")
+    s = rep.summary()
+    assert s["failures"] == 0
+    assert s["ops"] >= 8  # every sim node heartbeated at least once
+    assert s["fcrs"] >= 8  # first beat carries an FCR
+    assert s["datanodes"] == 8
+
+    # all 8 registered, held out of service
+    scm = meta.om.scm
+    for i in range(8):
+        n = scm.nodes.get(f"simnode-{i}")
+        assert n is not None
+        assert n.op_state.value == "IN_MAINTENANCE"
+
+    # placement still lands only on the 5 real datanodes
+    g = scm.allocate_block(ReplicationConfig.parse("rs-3-2-4096"),
+                           8 * 4096)
+    assert all(not n.startswith("simnode") for n in g.pipeline.nodes)
