@@ -285,6 +285,38 @@ class OzoneBucket:
 
         return file_checksum(self.client, self.volume, self.name, key)
 
+    def rewrite_key(self, key: str, replication: str) -> None:
+        """Re-write an existing key's data under a new replication
+        config in place — the Ratis<->EC migration verb (`ozone sh key
+        rewrite`, shell/keys/RewriteKeyHandler.java). Fenced: the commit
+        carries the source's object id and the OM refuses it with
+        KEY_MODIFIED if the key was overwritten while the rewrite ran
+        (the reference's expectedGeneration check), discarding the new
+        blocks instead of clobbering the newer data."""
+        om = self.client.om
+        info = om.lookup_key(self.volume, self.name, key)
+        data = self.read_key_info(info)
+        h = self.open_key(key, replication,
+                          metadata=info.get("metadata"))
+        h._session.expect_object_id = info.get("object_id", "")
+        h.write(data)
+        h.close()
+        # the commit re-inherits bucket-default ACLs; restore the source
+        # key's grants so a replication migration never widens access
+        if info.get("acls"):
+            om.modify_acl("key", self.volume, self.name, key,
+                          op="set", acls=info["acls"])
+
+    def copy_key(self, key: str, dst_bucket: "OzoneBucket",
+                 dst_key: str,
+                 replication: Optional[str] = None) -> None:
+        """Server-side-style key copy (`ozone sh key cp`,
+        shell/keys/CopyKeyHandler.java): read once, write under the
+        destination bucket's (or an explicit) replication config."""
+        info = self.client.om.lookup_key(self.volume, self.name, key)
+        dst_bucket.write_key(dst_key, self.read_key_info(info),
+                             replication=replication)
+
     def delete_key(self, key: str) -> None:
         self.client.om.delete_key(self.volume, self.name, key)
 

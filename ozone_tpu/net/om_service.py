@@ -296,6 +296,9 @@ class OmGrpcService:
                     lambda m: self.om.cancel_prepare()),
                 "PrepareStatus": self._wrap(
                     lambda m: {"prepared": self.om.prepared}),
+                "SetBucketReplication": self._wrap(
+                    lambda m: self.om.set_bucket_replication(
+                        m["volume"], m["bucket"], m["replication"])),
                 "ListOpenFiles": self._wrap(
                     lambda m: self.om.list_open_files(
                         m.get("volume", ""), m.get("bucket", ""),
@@ -425,6 +428,7 @@ class OmGrpcService:
             replication = ReplicationConfig.parse(m["replication"])
             parent_id = m.get("parent_id")
             file_name = m.get("file_name")
+            expect_object_id = m.get("expect_object_id", "")
 
         try:
             self.om.commit_key(_S(), self._groups_from(m["groups"]), m["size"],
@@ -621,6 +625,7 @@ class GrpcOmClient:
             parent_id=getattr(session, "parent_id", None),
             file_name=getattr(session, "file_name", None),
             hsync=hsync,
+            expect_object_id=getattr(session, "expect_object_id", ""),
         )
 
     def hsync_key(self, session, groups, size):
@@ -714,6 +719,10 @@ class GrpcOmClient:
 
     def revoke_s3_secret(self, access_id):
         self._call("RevokeS3Secret", access_id=access_id)
+
+    def set_bucket_replication(self, volume, bucket, replication):
+        return self._call("SetBucketReplication", volume=volume,
+                          bucket=bucket, replication=replication)["result"]
 
     def list_open_files(self, volume="", bucket="", prefix="",
                         start_after="", limit=100):

@@ -245,6 +245,8 @@ class CommitFile(OMRequest):
     block_groups: list[dict] = field(default_factory=list)
     modified: float = 0.0
     hsync: bool = False
+    #: rewrite fence — see CommitKey.expect_object_id
+    expect_object_id: str = ""
 
     def pre_execute(self, om) -> None:
         self.modified = time.time()
@@ -275,6 +277,10 @@ class CommitFile(OMRequest):
             }
         )
         old = store.get("files", fk)
+        from ozone_tpu.om.requests import check_rewrite_fence
+
+        check_rewrite_fence(store, self.expect_object_id, old, open_k,
+                            fk, info, self.modified)
         finalize_commit(store, "files", fk, info, old, self.client_id,
                         self.hsync, self.modified)
         return info

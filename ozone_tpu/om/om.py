@@ -555,6 +555,7 @@ class OzoneManager:
     ) -> None:
         from ozone_tpu.om import fso
 
+        fence = getattr(session, "expect_object_id", "")
         if session.parent_id is not None:
             self.submit(
                 fso.CommitFile(
@@ -566,6 +567,7 @@ class OzoneManager:
                     size,
                     [g.to_json() for g in groups],
                     hsync=hsync,
+                    expect_object_id=fence,
                 )
             )
         else:
@@ -579,6 +581,7 @@ class OzoneManager:
                     [g.to_json() for g in groups],
                     replication=str(session.replication),
                     hsync=hsync,
+                    expect_object_id=fence,
                 )
             )
         self.metrics.counter("keys_hsynced" if hsync
@@ -929,6 +932,13 @@ class OzoneManager:
     def set_bucket_acl(self, volume: str, bucket: str,
                        acl: list[dict]) -> None:
         self.submit(rq.SetBucketAcl(volume, bucket, acl))
+
+    def set_bucket_replication(self, volume: str, bucket: str,
+                               replication: str) -> dict:
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "WRITE")
+        return self.submit(
+            rq.SetBucketReplication(volume, bucket, replication))
 
     def get_bucket_acl(self, volume: str, bucket: str) -> list[dict]:
         return self.bucket_info(volume, bucket).get("acl", [])

@@ -42,6 +42,7 @@ BUCKET_NOT_FOUND = "BUCKET_NOT_FOUND"
 BUCKET_ALREADY_EXISTS = "BUCKET_ALREADY_EXISTS"
 BUCKET_NOT_EMPTY = "BUCKET_NOT_EMPTY"
 KEY_NOT_FOUND = "KEY_NOT_FOUND"
+KEY_MODIFIED = "KEY_MODIFIED"
 DANGLING_LINK = "DANGLING_LINK"
 
 
@@ -333,6 +334,10 @@ class CommitKey(OMRequest):
     bytes_per_checksum: int = 16 * 1024
     modified: float = 0.0
     hsync: bool = False
+    #: rewrite fence (ozone sh key rewrite / OmKeyArgs expectedGeneration):
+    #: commit only if the live key row still carries this object id —
+    #: a concurrent overwrite aborts the rewrite instead of clobbering it
+    expect_object_id: str = ""
 
     def pre_execute(self, om) -> None:
         self.modified = time.time()
@@ -372,9 +377,29 @@ class CommitKey(OMRequest):
                                    markers, self.replication,
                                    self.modified)
         old = store.get("keys", kk)
+        check_rewrite_fence(store, self.expect_object_id, old, open_k,
+                            kk, info, self.modified)
         finalize_commit(store, "keys", kk, info, old, self.client_id,
                         self.hsync, self.modified)
         return info
+
+
+def check_rewrite_fence(store, expect_object_id: str, old, open_k: str,
+                        row_key: str, info: dict,
+                        modified: float) -> None:
+    """Rewrite-fence enforcement shared by the OBS and FSO commits: when
+    the fence is set and the live row no longer carries the expected
+    object id, hand the freshly-written blocks to the deletion chain so
+    they don't leak, then refuse the commit."""
+    if not expect_object_id:
+        return
+    if old is not None and old.get("object_id") == expect_object_id:
+        return
+    store.delete("open_keys", open_k)
+    erase_gdpr_secret(info)
+    store.put("deleted_keys", f"{row_key}:{modified}", info)
+    raise OMError(KEY_MODIFIED,
+                  f"{row_key} changed during rewrite; new data discarded")
 
 
 def snap_prefix(volume: str, bucket: str, snap_id: str) -> str:
@@ -1008,6 +1033,33 @@ class SetBucketAttrs(OMRequest):
             else:
                 merged[key] = v
         b["attrs"] = merged
+        store.put("buckets", k, b)
+        return b
+
+
+@dataclass
+class SetBucketReplication(OMRequest):
+    """Change a bucket's default replication config (ozone sh bucket
+    set-replication-config, shell/bucket/SetReplicationConfigHandler +
+    OMBucketSetPropertyRequest): applies to keys written AFTER the
+    change — existing keys keep their config until rewritten (`key
+    rewrite`)."""
+
+    volume: str
+    bucket: str
+    replication: str
+
+    def pre_execute(self, om) -> None:
+        from ozone_tpu.scm.pipeline import ReplicationConfig
+
+        ReplicationConfig.parse(self.replication)  # raises on nonsense
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", k)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, k)
+        b["replication"] = self.replication
         store.put("buckets", k, b)
         return b
 

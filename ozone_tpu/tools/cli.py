@@ -128,8 +128,10 @@ def _quota_arg(v: str):
 #: verbs valid per sh object; anything else errors instead of no-opping
 _SH_VERBS = {
     "volume": {"create", "delete", "info", "list", "setquota"},
-    "bucket": {"create", "delete", "info", "list", "setquota", "link"},
-    "key": {"put", "get", "delete", "info", "list", "rename", "checksum"},
+    "bucket": {"create", "delete", "info", "list", "setquota", "link",
+               "set-replication"},
+    "key": {"put", "get", "delete", "info", "list", "rename", "checksum",
+            "cat", "cp", "rewrite"},
     "snapshot": {"create", "list", "info", "delete", "diff", "rename"},
     "token": {"get", "renew", "cancel", "print"},
 }
@@ -246,6 +248,15 @@ def cmd_sh(args) -> int:
                 dvol, dbkt = _parse_path(args.to)
                 oz.om.create_bucket_link(vol, bucket, dvol, dbkt)
                 print(f"linked /{dvol}/{dbkt} -> /{vol}/{bucket}")
+            elif verb == "set-replication":
+                if not args.replication:
+                    print("error: set-replication requires "
+                          "--replication", file=sys.stderr)
+                    return 2
+                b = oz.om.set_bucket_replication(vol, bucket,
+                                                 args.replication)
+                _emit({"bucket": f"/{vol}/{bucket}",
+                       "replication": b["replication"]})
     elif kind == "snapshot":
         if verb == "list":
             vol, bucket = parts
@@ -308,6 +319,30 @@ def cmd_sh(args) -> int:
             _emit(b.file_checksum(key))
         elif verb == "rename":
             b.rename_key(key, args.to)
+        elif verb == "cat":
+            sys.stdout.buffer.write(b.read_key(key).tobytes())
+        elif verb == "cp":
+            if not args.to:
+                print("error: cp requires --to /volume/bucket/key",
+                      file=sys.stderr)
+                return 2
+            dparts = _parse_path(args.to)
+            if len(dparts) < 3:
+                print("error: cp --to needs a full /volume/bucket/key "
+                      f"path, got {args.to!r}", file=sys.stderr)
+                return 2
+            dv, db_, *drest = dparts
+            b.copy_key(key, oz.get_volume(dv).get_bucket(db_),
+                       "/".join(drest),
+                       replication=args.replication or None)
+            print(f"copied {args.path} to {args.to}")
+        elif verb == "rewrite":
+            if not args.replication:
+                print("error: rewrite requires --replication",
+                      file=sys.stderr)
+                return 2
+            b.rewrite_key(key, args.replication)
+            print(f"rewrote {args.path} as {args.replication}")
     return 0
 
 
@@ -942,7 +977,9 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("verb",
                     choices=["create", "delete", "info", "list", "put",
                              "get", "rename", "checksum", "setquota",
-                             "diff", "link", "renew", "cancel", "print"])
+                             "diff", "link", "renew", "cancel", "print",
+                             "cat", "cp", "rewrite",
+                             "set-replication"])
     sh.add_argument("path", nargs="?", default="",
                     help="/volume[/bucket[/key]] (token verbs take none)")
     sh.add_argument("file", nargs="?", help="local file for key put/get")

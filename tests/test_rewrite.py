@@ -1,0 +1,153 @@
+"""key rewrite (replication migration) + bucket set-replication.
+
+Mirrors the reference's RewriteKeyHandler / OmKeyArgs expectedGeneration
+flow (shell/keys/RewriteKeyHandler.java) and
+SetReplicationConfigHandler: a key's data is re-written in place under a
+new replication config; a concurrent overwrite trips the fence and the
+rewrite loses (newer data wins, discarded blocks enter the deletion
+chain); a bucket's default replication changes for new keys only.
+"""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.om.requests import OMError
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    c = MiniOzoneCluster(
+        tmp_path,
+        num_datanodes=5,
+        block_size=4 * 4096,
+        container_size=1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+def _rng_bytes(n, seed=0):
+    return np.random.default_rng(seed).integers(0, 256, n, dtype=np.uint8)
+
+
+def test_rewrite_ratis_to_ec_and_back(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication="RATIS/THREE")
+    data = _rng_bytes(60_000)
+    b.write_key("k", data)
+    assert "RATIS" in cluster.om.lookup_key("v", "b", "k")["replication"]
+
+    b.rewrite_key("k", EC)
+    info = cluster.om.lookup_key("v", "b", "k")
+    assert info["replication"] == EC
+    assert np.array_equal(b.read_key("k"), data)
+
+    b.rewrite_key("k", "RATIS/THREE")
+    info = cluster.om.lookup_key("v", "b", "k")
+    assert "RATIS" in info["replication"]
+    assert np.array_equal(b.read_key("k"), data)
+
+
+def test_rewrite_fence_loses_to_concurrent_overwrite(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v2").create_bucket("b", replication="RATIS/THREE")
+    old = _rng_bytes(20_000, seed=1)
+    new = _rng_bytes(25_000, seed=2)
+    b.write_key("k", old)
+    info = cluster.om.lookup_key("v2", "b", "k")
+
+    # a rewrite starts (reads old data, opens a fenced session)...
+    h = b.open_key("k", EC)
+    h._session.expect_object_id = info["object_id"]
+    h.write(old)
+    # ...but an overwrite lands first
+    b.write_key("k", new)
+    with pytest.raises(OMError) as e:
+        h.close()
+    assert e.value.code == "KEY_MODIFIED"
+    # newer data wins, still readable
+    assert np.array_equal(b.read_key("k"), new)
+    # the rewrite's blocks went to the deletion chain, not the key table
+    assert any(k for k, _ in cluster.om.store.iterate("deleted_keys"))
+
+
+def test_rewrite_fence_on_fso_bucket(cluster):
+    oz = cluster.client()
+    vol = oz.create_volume("v3")
+    cluster.om.create_bucket("v3", "fso", "RATIS/THREE",
+                             layout="FILE_SYSTEM_OPTIMIZED")
+    b = vol.get_bucket("fso")
+    data = _rng_bytes(15_000, seed=3)
+    b.write_key("d1/d2/f", data)
+
+    b.rewrite_key("d1/d2/f", EC)
+    info = cluster.om.lookup_key("v3", "fso", "d1/d2/f")
+    assert info["replication"] == EC
+    assert np.array_equal(b.read_key("d1/d2/f"), data)
+
+    # stale fence on FSO path refuses too
+    stale = b.open_key("d1/d2/f", EC)
+    stale._session.expect_object_id = "not-the-object-id"
+    stale.write(data)
+    with pytest.raises(OMError) as e:
+        stale.close()
+    assert e.value.code == "KEY_MODIFIED"
+    assert np.array_equal(b.read_key("d1/d2/f"), data)
+
+
+def test_set_bucket_replication_applies_to_new_keys_only(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v4").create_bucket("b", replication="RATIS/THREE")
+    d1 = _rng_bytes(9_000, seed=4)
+    b.write_key("before", d1)
+
+    out = cluster.om.set_bucket_replication("v4", "b", EC)
+    assert out["replication"] == EC
+    assert cluster.om.bucket_info("v4", "b")["replication"] == EC
+
+    d2 = _rng_bytes(9_000, seed=5)
+    b.write_key("after", d2)
+    assert "RATIS" in cluster.om.lookup_key("v4", "b", "before")["replication"]
+    assert cluster.om.lookup_key("v4", "b", "after")["replication"] == EC
+    assert np.array_equal(b.read_key("before"), d1)
+    assert np.array_equal(b.read_key("after"), d2)
+
+    with pytest.raises(Exception):
+        cluster.om.set_bucket_replication("v4", "b", "bogus-nonsense")
+
+
+def test_copy_key_across_buckets(cluster):
+    oz = cluster.client()
+    v = oz.create_volume("v5")
+    src = v.create_bucket("src", replication="RATIS/THREE")
+    dst = v.create_bucket("dst", replication=EC)
+    data = _rng_bytes(12_000, seed=6)
+    src.write_key("k", data)
+    src.copy_key("k", dst, "k2")
+    assert np.array_equal(dst.read_key("k2"), data)
+    # destination takes its bucket's replication config
+    assert cluster.om.lookup_key("v5", "dst", "k2")["replication"] == EC
+
+
+def test_rewrite_preserves_metadata_and_acls(cluster):
+    oz = cluster.client()
+    b = oz.create_volume("v6").create_bucket("b", replication="RATIS/THREE")
+    data = _rng_bytes(8_000, seed=7)
+    b.write_key("m", data, metadata={"owner-tag": "alice"})
+    cluster.om.modify_acl("key", "v6", "b", "m", op="add",
+                          acls=["user:alice:rw"])
+    before = cluster.om.lookup_key("v6", "b", "m")
+
+    b.rewrite_key("m", EC)
+    after = cluster.om.lookup_key("v6", "b", "m")
+    assert after["replication"] == EC
+    assert after.get("metadata") == {"owner-tag": "alice"}
+    assert any(a.get("name") == "alice" or "alice" in str(a)
+               for a in after.get("acls", [])), after.get("acls")
+    assert np.array_equal(b.read_key("m"), data)
+    del before
