@@ -205,10 +205,11 @@ class OzoneBucket:
     def open_key(
         self, key: str, replication: Optional[str] = None,
         metadata: Optional[dict] = None,
+        acls: Optional[list] = None,
     ) -> KeyWriteHandle:
         om = self.client.om
         session = om.open_key(self.volume, self.name, key, replication,
-                              metadata=metadata)
+                              metadata=metadata, acls=acls)
         return KeyWriteHandle(session, om, self._make_writer(session),
                               dek=self._data_key(session.encryption))
 
@@ -296,16 +297,16 @@ class OzoneBucket:
         om = self.client.om
         info = om.lookup_key(self.volume, self.name, key)
         data = self.read_key_info(info)
+        # metadata and ACLs ride the open session so the fenced commit
+        # lands them atomically — a post-commit ACL restore would leave
+        # bucket-default grants live in the failure window
         h = self.open_key(key, replication,
-                          metadata=info.get("metadata"))
+                          metadata=info.get("metadata"),
+                          acls=info.get("acls"))
         h._session.expect_object_id = info.get("object_id", "")
+        h._session.expect_generation = int(info.get("generation", 0))
         h.write(data)
         h.close()
-        # the commit re-inherits bucket-default ACLs; restore the source
-        # key's grants so a replication migration never widens access
-        if info.get("acls"):
-            om.modify_acl("key", self.volume, self.name, key,
-                          op="set", acls=info["acls"])
 
     def copy_key(self, key: str, dst_bucket: "OzoneBucket",
                  dst_key: str,
@@ -315,7 +316,8 @@ class OzoneBucket:
         destination bucket's (or an explicit) replication config."""
         info = self.client.om.lookup_key(self.volume, self.name, key)
         dst_bucket.write_key(dst_key, self.read_key_info(info),
-                             replication=replication)
+                             replication=replication,
+                             metadata=info.get("metadata"))
 
     def delete_key(self, key: str) -> None:
         self.client.om.delete_key(self.volume, self.name, key)

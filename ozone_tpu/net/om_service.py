@@ -359,6 +359,7 @@ class OmGrpcService:
                 s = self.om.open_key(
                     m["volume"], m["bucket"], m["key"],
                     m.get("replication"), metadata=m.get("metadata"),
+                    acls=m.get("acls"),
                 )
         except OMError as e:
             raise StorageError(e.code, e.msg)
@@ -429,6 +430,7 @@ class OmGrpcService:
             parent_id = m.get("parent_id")
             file_name = m.get("file_name")
             expect_object_id = m.get("expect_object_id", "")
+            expect_generation = m.get("expect_generation", -1)
 
         try:
             self.om.commit_key(_S(), self._groups_from(m["groups"]), m["size"],
@@ -591,9 +593,10 @@ class GrpcOmClient:
 
     # keys
     def open_key(self, volume, bucket, key, replication=None,
-                 metadata=None):
+                 metadata=None, acls=None):
         meta = self._call("OpenKey", volume=volume, bucket=bucket, key=key,
-                          replication=replication, metadata=metadata)
+                          replication=replication, metadata=metadata,
+                          acls=acls)
         self.block_size = meta.get("block_size", self.block_size)
         return RemoteOpenKeySession(volume, bucket, key, meta)
 
@@ -626,6 +629,7 @@ class GrpcOmClient:
             file_name=getattr(session, "file_name", None),
             hsync=hsync,
             expect_object_id=getattr(session, "expect_object_id", ""),
+            expect_generation=getattr(session, "expect_generation", -1),
         )
 
     def hsync_key(self, session, groups, size):

@@ -185,6 +185,8 @@ class OpenFile(OMRequest):
     #: stable identity of this file version (OmKeyInfo objectID) —
     #: rename-carried, overwrite-fresh; snapdiff pairs rows by it
     file_id: str = ""
+    #: explicit ACLs fixed at open — see requests.OpenKey.acls
+    acls: list = field(default_factory=list)
 
     def pre_execute(self, om) -> None:
         self.created = time.time()
@@ -225,6 +227,8 @@ class OpenFile(OMRequest):
         }
         if self.metadata:
             row["metadata"] = dict(self.metadata)
+        if self.acls:
+            row["acls"] = list(self.acls)
         if self.encryption:
             row["encryption"] = dict(self.encryption)
         store.put("open_keys", f"{fk}/{self.client_id}", row)
@@ -247,6 +251,7 @@ class CommitFile(OMRequest):
     hsync: bool = False
     #: rewrite fence — see CommitKey.expect_object_id
     expect_object_id: str = ""
+    expect_generation: int = -1
 
     def pre_execute(self, om) -> None:
         self.modified = time.time()
@@ -280,7 +285,8 @@ class CommitFile(OMRequest):
         from ozone_tpu.om.requests import check_rewrite_fence
 
         check_rewrite_fence(store, self.expect_object_id, old, open_k,
-                            fk, info, self.modified)
+                            fk, info, self.modified,
+                            self.expect_generation)
         finalize_commit(store, "files", fk, info, old, self.client_id,
                         self.hsync, self.modified)
         return info

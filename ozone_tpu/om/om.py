@@ -466,6 +466,7 @@ class OzoneManager:
         key: str,
         replication: Optional[str] = None,
         metadata: Optional[dict] = None,
+        acls: Optional[list] = None,
     ) -> OpenKeySession:
         from ozone_tpu.om import fso
 
@@ -477,7 +478,8 @@ class OzoneManager:
         enc = self._mint_encryption(binfo)
         if self._is_fso(binfo):
             req = fso.OpenFile(volume, bucket, key, client_id, repl,
-                               metadata=metadata or {}, encryption=enc)
+                               metadata=metadata or {}, encryption=enc,
+                               acls=acls or [])
             parent = self.submit(req)
             name = fso.split_path(key)[-1]
             open_k = f"{fso.dir_key(volume, bucket, parent, name)}/{client_id}"
@@ -487,7 +489,7 @@ class OzoneManager:
                 key = rq.normalize_fs_path(key)
             req = rq.OpenKey(volume, bucket, key, client_id, repl,
                              metadata=metadata or {}, fs_paths=legacy,
-                             encryption=enc)
+                             encryption=enc, acls=acls or [])
             self.submit(req)
             open_k = f"{key_key(volume, bucket, key)}/{client_id}"
         info = self.store.get("open_keys", open_k)
@@ -556,6 +558,7 @@ class OzoneManager:
         from ozone_tpu.om import fso
 
         fence = getattr(session, "expect_object_id", "")
+        fence_gen = int(getattr(session, "expect_generation", -1))
         if session.parent_id is not None:
             self.submit(
                 fso.CommitFile(
@@ -568,6 +571,7 @@ class OzoneManager:
                     [g.to_json() for g in groups],
                     hsync=hsync,
                     expect_object_id=fence,
+                    expect_generation=fence_gen,
                 )
             )
         else:
@@ -582,6 +586,7 @@ class OzoneManager:
                     replication=str(session.replication),
                     hsync=hsync,
                     expect_object_id=fence,
+                    expect_generation=fence_gen,
                 )
             )
         self.metrics.counter("keys_hsynced" if hsync

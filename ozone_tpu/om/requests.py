@@ -293,6 +293,11 @@ def finalize_commit(store, table: str, ek: str, info: dict, old,
         int(info.get("size", 0)) - (int(old.get("size", 0)) if old else 0),
         0 if old is not None else 1,
     )
+    # per-commit generation (OmKeyInfo updateID): bumps on EVERY commit
+    # of the row — including hsyncs, which reuse the session's object_id
+    # — so the rewrite fence can detect any intervening commit
+    info["generation"] = (int(old.get("generation", 0)) + 1
+                          if old is not None else 1)
     if hsync:
         info["hsync_client_id"] = client_id
         store.put("open_keys", f"{ek}/{client_id}", info)  # session lives on
@@ -338,6 +343,9 @@ class CommitKey(OMRequest):
     #: commit only if the live key row still carries this object id —
     #: a concurrent overwrite aborts the rewrite instead of clobbering it
     expect_object_id: str = ""
+    #: companion generation fence (-1 = object-id only): catches commits
+    #: that keep the object id, e.g. hsyncs of the same open session
+    expect_generation: int = -1
 
     def pre_execute(self, om) -> None:
         self.modified = time.time()
@@ -378,22 +386,29 @@ class CommitKey(OMRequest):
                                    self.modified)
         old = store.get("keys", kk)
         check_rewrite_fence(store, self.expect_object_id, old, open_k,
-                            kk, info, self.modified)
+                            kk, info, self.modified,
+                            self.expect_generation)
         finalize_commit(store, "keys", kk, info, old, self.client_id,
                         self.hsync, self.modified)
         return info
 
 
 def check_rewrite_fence(store, expect_object_id: str, old, open_k: str,
-                        row_key: str, info: dict,
-                        modified: float) -> None:
+                        row_key: str, info: dict, modified: float,
+                        expect_generation: int = -1) -> None:
     """Rewrite-fence enforcement shared by the OBS and FSO commits: when
     the fence is set and the live row no longer carries the expected
-    object id, hand the freshly-written blocks to the deletion chain so
-    they don't leak, then refuse the commit."""
+    object id AND generation (the per-commit counter finalize_commit
+    bumps — object id alone misses hsync commits of the same session,
+    the reference fences on generation/updateID for the same reason),
+    hand the freshly-written blocks to the deletion chain so they don't
+    leak, then refuse the commit."""
     if not expect_object_id:
         return
-    if old is not None and old.get("object_id") == expect_object_id:
+    if (old is not None
+            and old.get("object_id") == expect_object_id
+            and (expect_generation < 0
+                 or int(old.get("generation", 0)) == expect_generation)):
         return
     store.delete("open_keys", open_k)
     erase_gdpr_secret(info)
@@ -762,6 +777,10 @@ class OpenKey(OMRequest):
     #: carry it unchanged, overwrites mint a fresh one — snapdiff pairs
     #: deleted+added rows by it to report RENAME entries
     key_id: str = ""
+    #: explicit key ACLs fixed at open (OmKeyArgs acls — a rewrite
+    #: carries the source key's grants so the commit can't re-inherit
+    #: broader bucket defaults); empty = inherit defaults at commit
+    acls: list = field(default_factory=list)
 
     def pre_execute(self, om) -> None:
         import uuid
@@ -793,6 +812,8 @@ class OpenKey(OMRequest):
             # user-defined key metadata (reference: OmKeyInfo metadata
             # map carrying e.g. S3 x-amz-meta-* pairs)
             row["metadata"] = dict(self.metadata)
+        if self.acls:
+            row["acls"] = list(self.acls)
         if self.fs_paths:
             row["fs_paths"] = True  # commit materializes parent markers
         if self.encryption:

@@ -856,6 +856,85 @@ def _scan_referenced_blocks(oz) -> set:
     return referenced
 
 
+def _repair_offline(args) -> int:
+    """Offline OM-db surgery (reference: ozone repair's RDBRepair family
+    — repair/om/SnapshotRepair.java re-points snapshot chain links,
+    repair/TransactionInfoRepair.java resets the raft applied marker).
+    Run against a STOPPED OM's db; dry-run unless --apply."""
+    from pathlib import Path
+
+    from ozone_tpu.om.metadata import OMMetadataStore
+    from ozone_tpu.om.requests import snapmeta_key
+
+    if not args.db:
+        print("error: --db OM_DB_PATH required (service must be stopped)",
+              file=sys.stderr)
+        return 2
+    if not Path(args.db).exists():
+        # OMMetadataStore would happily create a fresh empty db at a
+        # typo'd path and "repair" it, reporting success against nothing
+        print(f"error: no OM db at {args.db}", file=sys.stderr)
+        return 2
+    store = OMMetadataStore(Path(args.db))
+    try:
+        if args.tool == "snapshot-chain":
+            if not args.snap_path or not args.name:
+                print("error: snapshot-chain requires --path /vol/bucket "
+                      "and --name SNAPSHOT", file=sys.stderr)
+                return 2
+            vol, bkt = _parse_path(args.snap_path)
+            k = snapmeta_key(vol, bkt, args.name)
+            row = store.get("open_keys", k)
+            if row is None:
+                print(f"error: no snapshot {args.name} in "
+                      f"/{vol}/{bkt}", file=sys.stderr)
+                return 1
+            if args.apply and args.previous is None:
+                print("error: snapshot-chain --apply requires "
+                      "--previous (use 'none' to clear the link)",
+                      file=sys.stderr)
+                return 2
+            newprev = (None if args.previous in (None, "", "none")
+                       else args.previous)
+            if newprev is not None:
+                siblings = {
+                    v["snap_id"]
+                    for _, v in store.iterate(
+                        "open_keys", snapmeta_key(vol, bkt, ""))
+                }
+                if newprev not in siblings:
+                    print(f"error: --previous {newprev} is not a "
+                          f"snapshot id in /{vol}/{bkt} "
+                          f"(have: {sorted(siblings)})", file=sys.stderr)
+                    return 1
+            out = {"snapshot": args.name, "snap_id": row.get("snap_id"),
+                   "previous": row.get("previous"),
+                   "new_previous": newprev, "applied": False}
+            if args.apply:
+                row["previous"] = newprev
+                store.put("open_keys", k, row)
+                store.flush()
+                out["applied"] = True
+            _emit(out)
+        else:  # transaction
+            cur = store.get("system", "raft_applied")
+            out = {"raft_applied": cur,
+                   "new_index": args.index, "applied": False}
+            if args.apply:
+                if args.index is None:
+                    print("error: transaction --apply requires --index",
+                          file=sys.stderr)
+                    return 2
+                store.put("system", "raft_applied",
+                          {"index": int(args.index)})
+                store.flush()
+                out["applied"] = True
+            _emit(out)
+        return 0
+    finally:
+        store.close()
+
+
 def cmd_repair(args) -> int:
     """Repair tools (ozone repair analog). `orphans`: blocks present on
     datanodes but referenced by no key — left behind by failed writes or
@@ -869,6 +948,8 @@ def cmd_repair(args) -> int:
     from ozone_tpu.net.scm_service import GrpcScmClient
     from ozone_tpu.storage.ids import BlockID
 
+    if args.tool in ("snapshot-chain", "transaction"):
+        return _repair_offline(args)
     oz = _client(args)
     if args.tool == "quota":
         if not args.volume:
@@ -1227,12 +1308,29 @@ def build_parser() -> argparse.ArgumentParser:
     au.set_defaults(fn=_cmd_audit)
 
     rp = sub.add_parser("repair", help="repair tools (ozone repair analog)")
-    rp.add_argument("tool", choices=["orphans", "quota"])
+    rp.add_argument("tool", choices=["orphans", "quota", "snapshot-chain",
+                                     "transaction"])
     rp.add_argument("--om", default="127.0.0.1:9860")
     rp.add_argument("--volume", default="",
                     help="quota: volume whose usage counters to rebuild")
     rp.add_argument("--delete", action="store_true",
                     help="reclaim orphaned blocks")
+    rp.add_argument("--db", default="",
+                    help="snapshot-chain/transaction: OM db path "
+                         "(offline; stop the OM first)")
+    rp.add_argument("--path", dest="snap_path", default="",
+                    help="snapshot-chain: /volume/bucket")
+    rp.add_argument("--name", default="",
+                    help="snapshot-chain: snapshot name")
+    rp.add_argument("--previous", default=None,
+                    help="snapshot-chain: new previous snap_id "
+                         "('none' clears the link); required with "
+                         "--apply")
+    rp.add_argument("--index", type=int, default=None,
+                    help="transaction: new raft applied index")
+    rp.add_argument("--apply", action="store_true",
+                    help="snapshot-chain/transaction: write the change "
+                         "(default dry-run)")
     rp.set_defaults(fn=cmd_repair)
 
     dbg = sub.add_parser("debug", help="debug tools (ozone debug analog)")

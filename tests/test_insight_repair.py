@@ -151,3 +151,68 @@ def test_freon_dbgen(tmp_path):
 def test_freon_ommg(cluster):
     rep = freon.ommg(cluster.client(), n_ops=50, threads=4)
     assert rep.failures == 0
+
+
+def test_repair_snapshot_chain_and_transaction_offline(tmp_path):
+    """Offline db surgery (ozone repair snapshot-chain / transaction):
+    dry-run shows state without writing; --apply re-points a snapshot's
+    chain link / resets the raft applied marker."""
+    import json
+
+    from ozone_tpu.om.metadata import OMMetadataStore
+    from ozone_tpu.om.om import OzoneManager
+    from ozone_tpu.om.requests import snapmeta_key
+    from ozone_tpu.scm.scm import StorageContainerManager
+    from ozone_tpu.tools.cli import main as cli_main
+
+    scm = StorageContainerManager(stale_after_s=1e6, dead_after_s=2e6)
+    for i in range(5):
+        scm.register_datanode(f"dn{i}")
+    om = OzoneManager(tmp_path / "om.db", scm)
+    om.create_volume("v")
+    om.create_bucket("v", "b", "rs-3-2-4096")
+    s1 = om.create_snapshot("v", "b", "s1")
+    s2 = om.create_snapshot("v", "b", "s2")
+    om.store.put("system", "raft_applied", {"index": 41})
+    om.store.flush()
+    om.close()
+    db = str(tmp_path / "om.db")
+
+    # dry-run: nothing changes
+    assert cli_main(["repair", "snapshot-chain", "--db", db,
+                     "--path", "/v/b", "--name", "s2",
+                     "--previous", "none"]) == 0
+    st = OMMetadataStore(tmp_path / "om.db")
+    assert st.get("open_keys",
+                  snapmeta_key("v", "b", "s2"))["previous"] == s1["snap_id"]
+    st.close()
+
+    # apply: chain link cleared
+    assert cli_main(["repair", "snapshot-chain", "--db", db,
+                     "--path", "/v/b", "--name", "s2",
+                     "--previous", "none", "--apply"]) == 0
+    st = OMMetadataStore(tmp_path / "om.db")
+    assert st.get("open_keys",
+                  snapmeta_key("v", "b", "s2"))["previous"] is None
+    st.close()
+
+    # re-point at s1 by id; bogus id refused
+    assert cli_main(["repair", "snapshot-chain", "--db", db,
+                     "--path", "/v/b", "--name", "s2",
+                     "--previous", s1["snap_id"], "--apply"]) == 0
+    assert cli_main(["repair", "snapshot-chain", "--db", db,
+                     "--path", "/v/b", "--name", "s2",
+                     "--previous", "bogus", "--apply"]) == 1
+    st = OMMetadataStore(tmp_path / "om.db")
+    assert st.get("open_keys",
+                  snapmeta_key("v", "b", "s2"))["previous"] == s1["snap_id"]
+    st.close()
+
+    # transaction marker: dry-run leaves 41, apply sets 7
+    assert cli_main(["repair", "transaction", "--db", db]) == 0
+    assert cli_main(["repair", "transaction", "--db", db,
+                     "--index", "7", "--apply"]) == 0
+    st = OMMetadataStore(tmp_path / "om.db")
+    assert st.get("system", "raft_applied")["index"] == 7
+    st.close()
+    del s2, json

@@ -151,3 +151,31 @@ def test_rewrite_preserves_metadata_and_acls(cluster):
                for a in after.get("acls", [])), after.get("acls")
     assert np.array_equal(b.read_key("m"), data)
     del before
+
+
+def test_rewrite_fence_catches_hsync_of_same_session(cluster):
+    """Generation fence: an hsync commit keeps the row's object_id, so
+    an object-id-only fence would miss it — the per-commit generation
+    must trip the rewrite (reference fences on updateID)."""
+    oz = cluster.client()
+    b = oz.create_volume("v7").create_bucket("b", replication="RATIS/THREE")
+    data = _rng_bytes(16_000, seed=8)
+    h = b.open_key("k")
+    h.write(data[:8_000])
+    h.hsync()  # key row exists now, object_id = session's
+
+    info = cluster.om.lookup_key("v7", "b", "k")
+    rw = b.open_key("k", EC)
+    rw._session.expect_object_id = info["object_id"]
+    rw._session.expect_generation = int(info["generation"])
+    rw.write(data[:8_000])
+
+    # the live writer hsyncs more data: same object_id, new generation
+    h.write(data[8_000:])
+    h.hsync()
+
+    with pytest.raises(OMError) as e:
+        rw.close()
+    assert e.value.code == "KEY_MODIFIED"
+    h.close()
+    assert np.array_equal(b.read_key("k"), data)
