@@ -566,6 +566,40 @@ def cmd_admin(args) -> int:
             return usage(f"unknown om verb {verb!r} "
                          "(expected prepare|cancelprepare|status|"
                          "list-open-files)")
+    elif subject == "reconfig":
+        # live reconfiguration (ozone admin reconfig analog over the
+        # daemon's /reconfig HTTP endpoint, ReconfigureProtocol.proto)
+        import urllib.request
+        from urllib.parse import quote
+
+        if not args.http:
+            print("error: reconfig requires --http host:port (the "
+                  "daemon's HTTP/metrics port)", file=sys.stderr)
+            return 2
+        if verb in (None, "properties"):
+            url = f"http://{args.http}/reconfig/properties"
+        elif verb == "set":
+            if not args.target or args.value is None:
+                print("error: reconfig set needs a KEY target and "
+                      "--value", file=sys.stderr)
+                return 2
+            url = (f"http://{args.http}/reconfig?key={quote(args.target)}"
+                   f"&value={quote(args.value)}")
+        else:
+            return usage(f"unknown reconfig verb {verb!r} "
+                         "(expected properties|set)")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                body = r.read().decode()
+        except urllib.error.HTTPError as e:
+            print(f"error: {e.code} {e.read().decode()}", file=sys.stderr)
+            return 1
+        except urllib.error.URLError as e:
+            print(f"error: cannot reach {args.http}: {e.reason}",
+                  file=sys.stderr)
+            return 1
+        print(body)
+        return 0
     elif subject == "status":
         _emit(scm.status())
     return 0
@@ -897,11 +931,15 @@ def _repair_offline(args) -> int:
             newprev = (None if args.previous in (None, "", "none")
                        else args.previous)
             if newprev is not None:
+                if newprev == row.get("snap_id"):
+                    print("error: --previous would make the snapshot "
+                          "its own predecessor", file=sys.stderr)
+                    return 1
                 siblings = {
                     v["snap_id"]
                     for _, v in store.iterate(
                         "open_keys", snapmeta_key(vol, bkt, ""))
-                }
+                } - {row.get("snap_id")}
                 if newprev not in siblings:
                     print(f"error: --previous {newprev} is not a "
                           f"snapshot id in /{vol}/{bkt} "
@@ -1133,7 +1171,7 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("subject", choices=[
         "safemode", "datanode", "status", "pipeline", "container",
         "balancer", "replicationmanager", "om", "finalizeupgrade",
-        "upgrade", "ring", "kms", "cert",
+        "upgrade", "ring", "kms", "cert", "reconfig",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"
@@ -1146,6 +1184,10 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("--threshold", type=float, default=None,
                     help="balancer start: utilization band around the "
                          "cluster average (e.g. 0.1)")
+    ad.add_argument("--http", default="",
+                    help="reconfig: daemon HTTP/metrics host:port")
+    ad.add_argument("--value", default=None,
+                    help="reconfig set: new value for the KEY target")
     ad.add_argument("--prefix", default="",
                     help="om list-open-files: key-name prefix filter")
     ad.add_argument("--start-after", default="",

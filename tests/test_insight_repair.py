@@ -216,3 +216,33 @@ def test_repair_snapshot_chain_and_transaction_offline(tmp_path):
     assert st.get("system", "raft_applied")["index"] == 7
     st.close()
     del s2, json
+
+
+def test_admin_reconfig_cli(tmp_path, capsys):
+    """admin reconfig properties/set over the daemon's /reconfig
+    endpoint (ozone admin reconfig analog)."""
+    import json as _json
+
+    from ozone_tpu.net.daemons import ScmOmDaemon
+    from ozone_tpu.tools.cli import main as cli_main
+
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1e6,
+                       dead_after_s=2e6, http_port=0)
+    meta.start()
+    try:
+        http = meta.http.address
+        assert cli_main(["admin", "reconfig", "properties",
+                         "--http", http]) == 0
+        props = _json.loads(capsys.readouterr().out)
+        assert isinstance(props, (list, dict)) and props
+        # pick a registered property and set it
+        name = (props[0]["key"] if isinstance(props, list)
+                else sorted(props)[0])
+        assert cli_main(["admin", "reconfig", "set", name,
+                         "--http", http, "--value", "123"]) == 0
+        out = capsys.readouterr().out
+        assert "error" not in out.lower() or "123" in out
+        # missing --http is a clean usage error
+        assert cli_main(["admin", "reconfig", "properties"]) == 2
+    finally:
+        meta.stop()
