@@ -296,6 +296,14 @@ class OmGrpcService:
                     lambda m: self.om.cancel_prepare()),
                 "PrepareStatus": self._wrap(
                     lambda m: {"prepared": self.om.prepared}),
+                "SnapshotDiffSubmit": self._wrap(
+                    lambda m: self.om.snapshot_diff_submit(
+                        m["volume"], m["bucket"], m["from_snapshot"],
+                        m.get("to_snapshot"))),
+                "SnapshotDiffPage": self._wrap(
+                    lambda m: self.om.snapshot_diff_page(
+                        m["job_id"], m.get("token", ""),
+                        m.get("page_size", 1000))),
                 "SetBucketReplication": self._wrap(
                     lambda m: self.om.set_bucket_replication(
                         m["volume"], m["bucket"], m["replication"])),
@@ -723,6 +731,16 @@ class GrpcOmClient:
 
     def revoke_s3_secret(self, access_id):
         self._call("RevokeS3Secret", access_id=access_id)
+
+    def snapshot_diff_submit(self, volume, bucket, from_snapshot,
+                             to_snapshot=None):
+        return self._call("SnapshotDiffSubmit", volume=volume,
+                          bucket=bucket, from_snapshot=from_snapshot,
+                          to_snapshot=to_snapshot)["result"]
+
+    def snapshot_diff_page(self, job_id, token="", page_size=1000):
+        return self._call("SnapshotDiffPage", job_id=job_id, token=token,
+                          page_size=page_size)["result"]
 
     def set_bucket_replication(self, volume, bucket, replication):
         return self._call("SetBucketReplication", volume=volume,

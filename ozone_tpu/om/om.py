@@ -93,6 +93,10 @@ class OzoneManager:
         self.dtoken_renew_interval_s = 24 * 3600.0
         self.dtoken_max_lifetime_s = 7 * 24 * 3600.0
         self.dtoken_key_lifetime_s = 30 * 24 * 3600.0
+        # paged snapshot-diff jobs (SnapshotDiffManager job model)
+        from ozone_tpu.om.snapshots import SnapshotDiffJobs
+
+        self._diff_jobs = SnapshotDiffJobs(self)
 
     # ----------------------------------------------------------- acl/tenant
     def enable_acls(self, superusers=("root",)) -> None:
@@ -718,6 +722,23 @@ class OzoneManager:
         volume, bucket = self.resolve_bucket(volume, bucket)
         return self._snapshots().snapshot_diff(volume, bucket,
                                                from_snapshot, to_snapshot)
+
+    def snapshot_diff_submit(self, volume: str, bucket: str,
+                             from_snapshot: str,
+                             to_snapshot: Optional[str] = None) -> dict:
+        """Submit (or poll) a paged diff job — SnapshotDiffManager's
+        job model; page results with snapshot_diff_page."""
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "LIST")
+        return self._diff_jobs.submit(volume, bucket, from_snapshot,
+                                      to_snapshot)
+
+    def snapshot_diff_page(self, job_id: str, token: str = "",
+                           page_size: int = 1000) -> dict:
+        out = self._diff_jobs.page(job_id, token, page_size)
+        # the page names keys: same LIST right as the submit path
+        self.check_access(out["volume"], out["bucket"], None, "LIST")
+        return out
 
     def snapshot_keys(self, volume: str, bucket: str, name: str) -> list[dict]:
         volume, bucket = self.resolve_bucket(volume, bucket)

@@ -208,3 +208,163 @@ class SnapshotManager:
         )
         return {"added": added, "deleted": deleted, "modified": modified,
                 "renamed": renamed, "mode": "full"}
+
+
+class SnapshotDiffJobs:
+    """Job-based paged snapshot diff (the SnapshotDiffManager.java:98
+    model: diffs run as jobs — submit returns IN_PROGRESS, polling the
+    same pair returns the job's status, and a DONE job serves its report
+    in pages via an opaque continuation token). Jobs are per-OM-process
+    state, like the reference where diff jobs live beside the leader's
+    local RocksDB; entries are flat DiffReportEntry analogs
+    {op: ADD|DELETE|MODIFY|RENAME, key[, target]} in deterministic
+    order (renames, deletes, modifies, adds)."""
+
+    #: completed jobs kept before oldest-first eviction (reference:
+    #: snapDiffJobTable with a cleanup service)
+    MAX_JOBS = 64
+
+    def __init__(self, om: OzoneManager):
+        self.om = om
+        import threading
+
+        self._lock = threading.Lock()
+        self._by_key: dict[tuple, dict] = {}
+        self._by_name: dict[tuple, dict] = {}
+        self._by_id: dict[str, dict] = {}
+
+    def submit(self, volume: str, bucket: str, from_snapshot: str,
+               to_snapshot: Optional[str] = None) -> dict:
+        import threading
+        import time
+        import uuid
+
+        mgr = self.om._snapshots()
+        name_key = (volume, bucket, from_snapshot, to_snapshot or "")
+        try:
+            # jobs key on snapshot IDs, not names — a deleted-and-
+            # recreated snapshot of the same name is a different diff
+            from_id = mgr.get_snapshot(volume, bucket,
+                                       from_snapshot).snap_id
+            # a diff against live state is only valid for the store
+            # state it ran at: key it by the current txid so later
+            # submits after writes compute a fresh report
+            to_id = (mgr.get_snapshot(volume, bucket,
+                                      to_snapshot).snap_id
+                     if to_snapshot is not None
+                     else f"live@{self.om.store.txid}")
+        except OMError:
+            # a named snapshot is gone — a finished job's report is
+            # already materialized, so keep serving its status rather
+            # than erroring a poll that raced a snapshot delete
+            with self._lock:
+                job = self._by_name.get(name_key)
+            if job is not None:
+                return self._view(job)
+            raise
+        key = (volume, bucket, from_id, to_id)
+        user, groups = self.om.current_user()
+        with self._lock:
+            job = self._by_key.get(key)
+            if job is not None and job["status"] == "FAILED":
+                job = None  # transient failures retry on resubmission
+            if job is None:
+                job = {
+                    "job_id": uuid.uuid4().hex[:16],
+                    "status": "IN_PROGRESS",
+                    "volume": volume,
+                    "bucket": bucket,
+                    "from_snapshot": from_snapshot,
+                    "to_snapshot": to_snapshot,
+                    "created": time.time(),
+                    "error": "",
+                    "total": 0,
+                    "mode": "",
+                    "entries": [],
+                }
+                self._by_key[key] = job
+                self._by_name[name_key] = job
+                self._by_id[job["job_id"]] = job
+                self._evict_locked()
+                threading.Thread(
+                    target=self._run,
+                    args=(job, volume, bucket, from_snapshot,
+                          to_snapshot, user, groups),
+                    name=f"snapdiff-{job['job_id']}", daemon=True,
+                ).start()
+        return self._view(job)
+
+    def _evict_locked(self) -> None:
+        """Oldest-first eviction of finished jobs so the maps stay
+        bounded (entry lists can be large)."""
+        while len(self._by_id) > self.MAX_JOBS:
+            victims = sorted(
+                (j for j in self._by_id.values()
+                 if j["status"] != "IN_PROGRESS"),
+                key=lambda j: j["created"])
+            if not victims:
+                return
+            v = victims[0]
+            self._by_id.pop(v["job_id"], None)
+            for m in (self._by_key, self._by_name):
+                for k in [k for k, j in m.items()
+                          if j["job_id"] == v["job_id"]]:
+                    del m[k]
+
+    @staticmethod
+    def _view(job: dict) -> dict:
+        return {k: job[k] for k in (
+            "job_id", "status", "volume", "bucket", "from_snapshot",
+            "to_snapshot", "created", "error", "total", "mode")}
+
+    def _run(self, job: dict, volume: str, bucket: str,
+             from_snapshot: str, to_snapshot: Optional[str],
+             user=None, groups=()) -> None:
+        try:
+            # re-bind the submitter's identity: this worker thread has
+            # no thread-local context, and an unbound thread would run
+            # ACL checks as the trusted superuser
+            with self.om.user_context(user, groups):
+                out = self.om._snapshots().snapshot_diff(
+                    volume, bucket, from_snapshot, to_snapshot)
+            entries: list[dict] = []
+            for src, dst in out.get("renamed", []):
+                entries.append({"op": "RENAME", "key": src, "target": dst})
+            for n in out.get("deleted", []):
+                entries.append({"op": "DELETE", "key": n})
+            for n in out.get("modified", []):
+                entries.append({"op": "MODIFY", "key": n})
+            for n in out.get("added", []):
+                entries.append({"op": "ADD", "key": n})
+            job["entries"] = entries
+            job["total"] = len(entries)
+            job["mode"] = out.get("mode", "")
+            job["status"] = "DONE"
+        except Exception as e:  # noqa: BLE001 - job surface, not a crash
+            job["error"] = str(e)
+            job["status"] = "FAILED"
+
+    def page(self, job_id: str, token: str = "",
+             page_size: int = 1000) -> dict:
+        from ozone_tpu.om.requests import INVALID_REQUEST
+
+        job = self._by_id.get(job_id)
+        if job is None:
+            raise OMError(INVALID_REQUEST, f"no snapshot-diff job {job_id}")
+        view = self._view(job)
+        if job["status"] != "DONE":
+            return {**view, "entries": [], "next_token": ""}
+        try:
+            off = int(token) if token else 0
+        except ValueError:
+            raise OMError(INVALID_REQUEST, f"bad page token {token!r}")
+        if off < 0:
+            raise OMError(INVALID_REQUEST, f"bad page token {token!r}")
+        try:
+            size = max(1, int(page_size))
+        except (TypeError, ValueError):
+            raise OMError(INVALID_REQUEST,
+                          f"bad page size {page_size!r}")
+        entries = job["entries"][off:off + size]
+        nxt = str(off + size) if off + size < job["total"] else ""
+        return {**view, "entries": entries, "next_token": nxt}

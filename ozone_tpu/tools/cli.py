@@ -267,8 +267,38 @@ def cmd_sh(args) -> int:
                 print("error: snapshot diff requires --name",
                       file=sys.stderr)
                 return 1
-            _emit(oz.om.snapshot_diff(vol, bucket, args.name,
-                                      args.to or None))
+            if args.page_size:
+                # job-based paged flow (SnapshotDiffManager job model):
+                # submit, poll to a terminal state, stream pages
+                import time as _time
+
+                job = oz.om.snapshot_diff_submit(vol, bucket, args.name,
+                                                 args.to or None)
+                deadline = _time.time() + 300
+                while (job["status"] == "IN_PROGRESS"
+                       and _time.time() < deadline):
+                    _time.sleep(0.1)
+                    job = oz.om.snapshot_diff_submit(
+                        vol, bucket, args.name, args.to or None)
+                if job["status"] != "DONE":
+                    _emit(job)
+                    return 1
+                token = ""
+                while True:
+                    page = oz.om.snapshot_diff_page(
+                        job["job_id"], token, args.page_size)
+                    for e in page["entries"]:
+                        print(json.dumps(e))
+                    token = page["next_token"]
+                    if not token:
+                        break
+                print(json.dumps({"job_id": job["job_id"],
+                                  "total": page["total"],
+                                  "mode": page["mode"]}),
+                      file=sys.stderr)
+            else:
+                _emit(oz.om.snapshot_diff(vol, bucket, args.name,
+                                          args.to or None))
         else:
             vol, bucket = parts
             if not args.name:
@@ -1114,6 +1144,9 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("--name", default="",
                     help="snapshot verbs: snapshot name (diff: the "
                          "from-snapshot)")
+    sh.add_argument("--page-size", type=int, default=0,
+                    help="snapshot diff: run as a paged job, streaming "
+                         "entries as JSON lines (0 = one-shot report)")
     sh.add_argument("--renewer", default="",
                     help="token get: renewer principal")
     sh.add_argument("--token", default="",

@@ -663,3 +663,114 @@ def test_layout_gating_across_ha_ring(tmp_path):
                 m.stop()
             except Exception:
                 pass
+
+
+def test_snapshot_diff_paged_jobs(cluster):
+    """Job-based paged diff (SnapshotDiffManager job model): submit
+    returns a job, the same pair reuses it, DONE jobs page exactly
+    through the flattened report, bad jobs/tokens error."""
+    import time as _time
+
+    from ozone_tpu.om.requests import OMError
+
+    def _rng_bytes(n, seed=0):
+        return np.random.default_rng(seed).integers(0, 256, n,
+                                                    dtype=np.uint8)
+
+    oz = cluster.client()
+    b = oz.create_volume("vdj").create_bucket("b", replication=EC)
+    for i in range(7):
+        b.write_key(f"k{i}", _rng_bytes(2000, seed=i))
+    om = cluster.om
+    om.create_snapshot("vdj", "b", "s1")
+    b.delete_key("k0")
+    b.rename_key("k1", "k1-moved")
+    b.write_key("k2", _rng_bytes(2500, seed=99))  # modify
+    b.write_key("k7", _rng_bytes(2000, seed=7))   # add
+    om.create_snapshot("vdj", "b", "s2")
+
+    job = om.snapshot_diff_submit("vdj", "b", "s1", "s2")
+    deadline = _time.time() + 30
+    while job["status"] == "IN_PROGRESS" and _time.time() < deadline:
+        _time.sleep(0.05)
+        job = om.snapshot_diff_submit("vdj", "b", "s1", "s2")
+    assert job["status"] == "DONE"
+    # resubmission reuses the job
+    assert om.snapshot_diff_submit("vdj", "b", "s1", "s2")["job_id"] \
+        == job["job_id"]
+
+    # page through with size 2; pages partition the entries exactly
+    seen, token = [], ""
+    while True:
+        page = om.snapshot_diff_page(job["job_id"], token, 2)
+        assert len(page["entries"]) <= 2
+        seen.extend(page["entries"])
+        token = page["next_token"]
+        if not token:
+            break
+    assert len(seen) == page["total"] == 4
+    ops = {e["op"]: e for e in seen}
+    assert ops["DELETE"]["key"] == "k0"
+    assert ops["RENAME"]["key"] == "k1" and ops["RENAME"]["target"] == "k1-moved"
+    assert ops["MODIFY"]["key"] == "k2"
+    assert ops["ADD"]["key"] == "k7"
+
+    # unknown job / bad token / bogus snapshot
+    with pytest.raises(OMError):
+        om.snapshot_diff_page("nope")
+    with pytest.raises(OMError):
+        om.snapshot_diff_page(job["job_id"], token="xyz")
+    with pytest.raises(OMError):
+        om.snapshot_diff_submit("vdj", "b", "no-such-snap")
+
+
+def test_snapshot_diff_job_staleness_and_retry(cluster):
+    """Jobs key on snapshot IDs: recreate a same-named snapshot and the
+    diff recomputes; delete a source after DONE and polls still serve
+    the finished report; live-state diffs refresh after writes."""
+    import time as _time
+
+    import numpy as np
+
+    def wait(job_fn):
+        job = job_fn()
+        deadline = _time.time() + 30
+        while job["status"] == "IN_PROGRESS" and _time.time() < deadline:
+            _time.sleep(0.05)
+            job = job_fn()
+        assert job["status"] == "DONE", job
+        return job
+
+    oz = cluster.client()
+    b = oz.create_volume("vdj2").create_bucket("b", replication=EC)
+    rng = np.random.default_rng(1)
+    b.write_key("a", rng.integers(0, 256, 1000, dtype=np.uint8))
+    om = cluster.om
+    om.create_snapshot("vdj2", "b", "s1")
+    b.write_key("b1", rng.integers(0, 256, 1000, dtype=np.uint8))
+    om.create_snapshot("vdj2", "b", "s2")
+
+    j1 = wait(lambda: om.snapshot_diff_submit("vdj2", "b", "s1", "s2"))
+    assert j1["total"] == 1
+
+    # recreate s2 after more writes: same name, different snapshot
+    om.delete_snapshot("vdj2", "b", "s2")
+    b.write_key("b2", rng.integers(0, 256, 1000, dtype=np.uint8))
+    om.create_snapshot("vdj2", "b", "s2")
+    j2 = wait(lambda: om.snapshot_diff_submit("vdj2", "b", "s1", "s2"))
+    assert j2["job_id"] != j1["job_id"]
+    assert j2["total"] == 2  # b1 + b2
+
+    # delete the source: the DONE job still serves status + pages
+    om.delete_snapshot("vdj2", "b", "s1")
+    j3 = om.snapshot_diff_submit("vdj2", "b", "s1", "s2")
+    assert j3["job_id"] == j2["job_id"]
+    assert om.snapshot_diff_page(j2["job_id"], "", 10)["total"] == 2
+
+    # live diffs recompute after writes (txid-keyed)
+    om.create_snapshot("vdj2", "b", "s3")
+    l1 = wait(lambda: om.snapshot_diff_submit("vdj2", "b", "s3"))
+    b.write_key("c", rng.integers(0, 256, 1000, dtype=np.uint8))
+    l2 = wait(lambda: om.snapshot_diff_submit("vdj2", "b", "s3"))
+    assert l2["job_id"] != l1["job_id"]
+    assert l2["total"] == l1["total"] + 1
