@@ -48,6 +48,9 @@ class OmGrpcService:
                 "CreateVolume": self._wrap(lambda m: self.om.create_volume(m["volume"])),
                 "DeleteVolume": self._wrap(lambda m: self.om.delete_volume(m["volume"])),
                 "VolumeInfo": self._wrap(lambda m: self.om.volume_info(m["volume"])),
+                "SetVolumeOwner": self._wrap(
+                    lambda m: self.om.set_volume_owner(m["volume"],
+                                                       m["owner"])),
                 "ListVolumes": self._wrap(lambda m: self.om.list_volumes()),
                 "CreateBucket": self._wrap(
                     lambda m: self.om.create_bucket(
@@ -557,6 +560,10 @@ class GrpcOmClient:
 
     def delete_volume(self, volume):
         self._call("DeleteVolume", volume=volume)
+
+    def set_volume_owner(self, volume, owner):
+        return self._call("SetVolumeOwner", volume=volume,
+                          owner=owner)["result"]
 
     def volume_info(self, volume):
         return self._call("VolumeInfo", volume=volume)["result"]

@@ -339,6 +339,18 @@ class OzoneManager:
         self._check_superuser()
         self.submit(rq.DeleteVolume(volume))
 
+    def set_volume_owner(self, volume: str, owner: str) -> dict:
+        """ozone sh volume update --user analog; only the current owner
+        or a superuser may transfer ownership."""
+        user, _ = self.current_user()
+        if self.acl_enabled and user is not None:
+            info = self.volume_info(volume)
+            if user != info.get("owner") and user not in self._superusers:
+                raise rq.OMError(
+                    rq.PERMISSION_DENIED,
+                    f"{user!r} is neither the owner nor a superuser")
+        return self.submit(rq.SetVolumeOwner(volume, owner))
+
     def volume_info(self, volume: str) -> dict:
         v = self.store.get("volumes", volume_key(volume))
         if v is None:

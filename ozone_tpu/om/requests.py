@@ -592,6 +592,28 @@ class SetQuota(OMRequest):
 
 
 @dataclass
+class SetVolumeOwner(OMRequest):
+    """Transfer volume ownership (ozone sh volume update --user,
+    OMVolumeSetOwnerRequest)."""
+
+    volume: str
+    owner: str
+
+    def pre_execute(self, om) -> None:
+        if not self.owner:
+            raise OMError(INVALID_REQUEST, "new owner must be non-empty")
+
+    def apply(self, store):
+        k = volume_key(self.volume)
+        row = store.get("volumes", k)
+        if row is None:
+            raise OMError(VOLUME_NOT_FOUND, self.volume)
+        row["owner"] = self.owner
+        store.put("volumes", k, row)
+        return row
+
+
+@dataclass
 class RepairQuota(OMRequest):
     """Recompute used_bytes/key_count from the key and file tables (the
     OM quota repair service analog): fixes drift after crashes or

@@ -127,7 +127,7 @@ def _quota_arg(v: str):
 
 #: verbs valid per sh object; anything else errors instead of no-opping
 _SH_VERBS = {
-    "volume": {"create", "delete", "info", "list", "setquota"},
+    "volume": {"create", "delete", "info", "list", "setquota", "update"},
     "bucket": {"create", "delete", "info", "list", "setquota", "link",
                "set-replication"},
     "key": {"put", "get", "delete", "info", "list", "rename", "checksum",
@@ -221,6 +221,12 @@ def cmd_sh(args) -> int:
             _emit(oz.om.set_quota(
                 vol, quota_bytes=_quota_arg(args.quota),
                 quota_namespace=args.namespace_quota))
+        elif verb == "update":
+            if not args.user:
+                print("error: volume update requires --user NEWOWNER",
+                      file=sys.stderr)
+                return 2
+            _emit(oz.om.set_volume_owner(vol, args.user))
     elif kind == "bucket":
         if verb == "list":
             (vol,) = parts
@@ -1128,7 +1134,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "get", "rename", "checksum", "setquota",
                              "diff", "link", "renew", "cancel", "print",
                              "cat", "cp", "rewrite",
-                             "set-replication"])
+                             "set-replication", "update"])
     sh.add_argument("path", nargs="?", default="",
                     help="/volume[/bucket[/key]] (token verbs take none)")
     sh.add_argument("file", nargs="?", help="local file for key put/get")
@@ -1144,6 +1150,8 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("--name", default="",
                     help="snapshot verbs: snapshot name (diff: the "
                          "from-snapshot)")
+    sh.add_argument("--user", default="",
+                    help="volume update: new owner principal")
     sh.add_argument("--page-size", type=int, default=0,
                     help="snapshot diff: run as a paged job, streaming "
                          "entries as JSON lines (0 = one-shot report)")

@@ -263,3 +263,19 @@ def test_tenant_requests_replicate_deterministically(tmp_path):
     a = rq.ModifyAcl("bucket", "v", "b", op="add",
                      acls=[OzoneAcl.parse("user:x:r").to_json()])
     assert rq.OMRequest.from_json(a.to_json()) == a
+
+
+def test_volume_owner_transfer(om):
+    """ozone sh volume update --user (OMVolumeSetOwnerRequest): owner or
+    superuser transfers; others denied when ACLs are on."""
+    out = om.set_volume_owner("v1", "owner2")
+    assert out["owner"] == "owner2"
+    om.enable_acls(superusers=("root",))
+    with om.user_context("mallory"):
+        with pytest.raises(rq.OMError):
+            om.set_volume_owner("v1", "mallory")
+    with om.user_context("owner2"):
+        assert om.set_volume_owner("v1", "owner3")["owner"] == "owner3"
+    with om.user_context("root"):
+        assert om.set_volume_owner("v1", "owner4")["owner"] == "owner4"
+    assert om.volume_info("v1")["owner"] == "owner4"
