@@ -216,7 +216,7 @@ class ScmGrpcService:
         "decommission", "recommission", "maintenance",
         "balancer-start", "balancer-stop",
         "safemode-enter", "safemode-exit",
-        "close-container", "finalize-upgrade",
+        "close-container", "close-pipeline", "finalize-upgrade",
     })
 
     def _admin_op(self, req: bytes) -> bytes:

@@ -228,13 +228,16 @@ class StorageContainerManager:
                 self.nodes.queue_command(n.dn_id, {"type": "finalize"})
             return {"scm": state,
                     "datanodes_notified": self.nodes.node_count()}
-        if op == "close-container":
+        def _numeric_id(kind: str) -> int:
             try:
-                cid = int(target)
+                return int(target)
             except (TypeError, ValueError):
                 raise StorageError("INVALID",
-                                   f"container id must be numeric: "
+                                   f"{kind} id must be numeric: "
                                    f"{target!r}")
+
+        if op == "close-container":
+            cid = _numeric_id("container")
             c = self.containers.get_or_none(cid)
             if c is None:
                 raise StorageError("CONTAINER_NOT_FOUND",
@@ -246,6 +249,21 @@ class StorageContainerManager:
                 # replicas; convergence marks it CLOSED
                 self.containers.finalize_container(c.id)
             return {"container": c.id, "state": c.state.value}
+        if op == "close-pipeline":
+            # ozone admin pipeline close <id>: pipelines are 1:1 with
+            # their container here, so closing the pipeline finalizes
+            # the container (writes stop, members drop the raft group)
+            pid = _numeric_id("pipeline")
+            from ozone_tpu.storage.ids import ContainerState
+
+            for c in self.containers.containers():
+                if c.pipeline is not None and c.pipeline.id == pid:
+                    if c.state is ContainerState.OPEN:
+                        self.containers.finalize_container(c.id)
+                    return {"pipeline": pid, "container": c.id,
+                            "state": c.state.value}
+            raise StorageError("PIPELINE_NOT_FOUND",
+                               f"unknown pipeline {target!r}")
         if op == "import-secret-key":
             # token secret-key rotation decision (possibly replicated
             # through the HA ring): install the material on this replica

@@ -487,7 +487,15 @@ def cmd_admin(args) -> int:
             return usage(f"unknown datanode verb {verb!r} (expected "
                          "list|decommission|recommission|maintenance)")
     elif subject == "pipeline":
-        _emit(scm.admin("pipelines"))
+        if verb == "close":
+            if not target:
+                return usage("pipeline close requires a pipeline id")
+            _emit(scm.admin("close-pipeline", target))
+        elif verb in (None, "list"):
+            _emit(scm.admin("pipelines"))
+        else:
+            return usage(f"unknown pipeline verb {verb!r} "
+                         "(expected list|close)")
     elif subject == "upgrade":
         # finalization progress view (`ozone admin scm finalizationstatus`
         # analog): which layout features are live vs gated

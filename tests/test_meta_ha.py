@@ -468,7 +468,17 @@ def test_ring_status_answered_by_any_replica(ha_cluster):
     leaders = set()
     for mid, addr in peers.items():
         scm = GrpcScmClient(addr)
-        st = scm.admin("ring-status")
+        # under full-suite CPU contention a replica can answer
+        # UNAVAILABLE for a beat; ring-status itself is retry-safe
+        st = None
+        for attempt in range(20):
+            try:
+                st = scm.admin("ring-status")
+                break
+            except Exception:
+                if attempt == 19:
+                    raise
+                time.sleep(0.25)
         assert st["replica_id"] == mid
         assert sorted(st["members"]) == sorted(peers)
         assert st["role"] in ("LEADER", "FOLLOWER")

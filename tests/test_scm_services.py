@@ -197,3 +197,35 @@ def test_admin_close_container_op(tmp_path):
     with _p.raises(Exception):
         scm.apply_admin_op("close-container", "999999")
     scm.stop()
+
+
+def test_admin_close_pipeline(tmp_path):
+    """ozone admin pipeline close: finalizes the pipeline's container so
+    writes stop on it."""
+    from ozone_tpu.scm.pipeline import ReplicationConfig
+    from ozone_tpu.scm.scm import StorageContainerManager
+    from ozone_tpu.storage.ids import StorageError
+
+    scm = StorageContainerManager(stale_after_s=1e6, dead_after_s=2e6)
+    for i in range(5):
+        scm.register_datanode(f"dn{i}")
+    g = scm.allocate_block(ReplicationConfig.parse("rs-3-2-4096"),
+                           4 * 4096)
+    pid = g.pipeline.id
+    out = scm.apply_admin_op("close-pipeline", str(pid))
+    assert out["pipeline"] == pid
+    assert out["state"] in ("CLOSING", "CLOSED")
+    # a new allocation lands on a fresh pipeline
+    g2 = scm.allocate_block(ReplicationConfig.parse("rs-3-2-4096"),
+                            4 * 4096)
+    assert g2.pipeline.id != pid
+    try:
+        scm.apply_admin_op("close-pipeline", "999999")
+        assert False, "expected PIPELINE_NOT_FOUND"
+    except StorageError as e:
+        assert e.code == "PIPELINE_NOT_FOUND"
+    try:
+        scm.apply_admin_op("close-pipeline", "abc")
+        assert False, "expected INVALID"
+    except StorageError as e:
+        assert e.code == "INVALID"
