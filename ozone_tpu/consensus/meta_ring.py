@@ -203,6 +203,15 @@ class MetaHARing(RaftSCM):
             raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
         return self.node.change_membership(remove=node_id)
 
+    def ring_transfer(self, node_id: str) -> dict:
+        """Planned leadership hand-off (`ozone admin om transfer
+        --node` / Ratis TransferLeadership analog)."""
+        if not self.node.is_ready_leader:
+            raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+        ok = self.node.transfer_leadership(node_id)
+        return {"transferred": ok, "target": node_id,
+                "leader_hint": self.node.leader_hint}
+
     def ring_status(self) -> dict:
         """This replica's view of the ring (ozone admin om roles /
         scm roles analog): answered by ANY replica — operators ask a

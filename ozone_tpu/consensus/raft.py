@@ -426,6 +426,8 @@ class RaftNode:
         self._waiters: set[int] = set()
         self._results: dict[int, Any] = {}
         self.on_step_down = on_step_down
+        #: leadership hand-off in flight (§3.10): propose() refuses
+        self._transferring = False
         #: index of this term's no-op marker (set on winning an election)
         self._leader_ready_index = 0
 
@@ -597,8 +599,82 @@ class RaftNode:
                 pass
         return dict(new)
 
+    # ------------------------------------------------- leadership transfer
+    def transfer_leadership(self, target: str,
+                            timeout: float = 10.0) -> bool:
+        """Planned hand-off (Raft §3.10, the reference's Ratis
+        TransferLeadership behind `ozone admin om transfer`): catch the
+        target up, then tell it to campaign immediately (timeout_now);
+        its RequestVote carries leadership_transfer=True so voters skip
+        the sticky-leader check that normally protects a live leader.
+        Returns True once this node observes itself deposed."""
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            if self.role != LEADER:
+                raise NotRaftLeaderError(self.node_id, self.leader_hint)
+            if target == self.node_id:
+                return True
+            if target not in self.members:
+                raise ValueError(f"{target!r} is not a ring member")
+            # §3.10: stop accepting client proposals for the duration —
+            # new entries appended mid-hand-off would make the target's
+            # log stale again and the sanctioned election lose
+            self._transferring = True
+        try:
+            caught_up = False
+            while time.monotonic() < deadline:
+                try:
+                    self._replicate_to(target)
+                except Exception:  # noqa: BLE001 - retry to deadline
+                    pass
+                with self._lock:
+                    if self.role != LEADER:
+                        return True  # someone took over already
+                    caught_up = (self.match_index.get(target, 0)
+                                 >= self.storage.last_index)
+                    term = self.storage.term
+                if caught_up:
+                    break
+                time.sleep(0.05)
+            if not caught_up:
+                return False
+            send_failed = False
+            try:
+                resp = self.transport.send(
+                    target, "timeout_now",
+                    {"term": term, "leader_id": self.node_id})
+                if resp.get("ok") is False:
+                    # the target ran its election synchronously and
+                    # lost — no point burning the rest of the deadline
+                    with self._lock:
+                        return self.role != LEADER
+            except Exception:
+                # the RPC may have timed out AFTER delivery (the target
+                # campaigns synchronously inside it) — watch for the
+                # depose rather than declaring failure
+                send_failed = True
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if self.role != LEADER:
+                        return True  # deposed by the hand-off election
+                time.sleep(0.02 if not send_failed else 0.1)
+            return False
+        finally:
+            with self._lock:
+                self._transferring = False
+
+    def handle_timeout_now(self, req: dict) -> dict:
+        """Target side of a leadership transfer: campaign NOW, skipping
+        the pre-vote (the old leader sanctioned this election)."""
+        with self._lock:
+            if (req["term"] < self.storage.term
+                    or self.node_id not in self.members):
+                return {"term": self.storage.term, "ok": False}
+        won = self.start_election(transfer=True)
+        return {"term": self.storage.term, "ok": won}
+
     # ----------------------------------------------------------- elections
-    def start_election(self) -> bool:
+    def start_election(self, transfer: bool = False) -> bool:
         """Run one candidate round; returns True if this node won.
 
         A pre-vote phase (Raft §9.6) runs first: the would-be candidate
@@ -615,28 +691,32 @@ class RaftNode:
         # the election before any unreachable peer's RPC timeout is paid
         order = list(self.peer_ids)
         random.shuffle(order)
-        with self._lock:
-            probe_term = self.storage.term + 1
-            last_index = self.storage.last_index
-            last_term = self.storage.term_at(last_index) or 0
-        pre = 1
-        for pid in order:
-            if pre >= quorum:
-                break
-            try:
-                resp = self.transport.send(pid, "request_vote", {
-                    "term": probe_term,
-                    "candidate_id": self.node_id,
-                    "last_log_index": last_index,
-                    "last_log_term": last_term,
-                    "pre_vote": True,
-                })
-            except Exception:
-                continue
-            if resp.get("granted"):
-                pre += 1
-        if pre < quorum:
-            return False
+        if not transfer:
+            # a transfer-sanctioned election skips the pre-vote: the
+            # old leader vouched for this candidate, and the probe would
+            # fail against peers still in live contact with that leader
+            with self._lock:
+                probe_term = self.storage.term + 1
+                last_index = self.storage.last_index
+                last_term = self.storage.term_at(last_index) or 0
+            pre = 1
+            for pid in order:
+                if pre >= quorum:
+                    break
+                try:
+                    resp = self.transport.send(pid, "request_vote", {
+                        "term": probe_term,
+                        "candidate_id": self.node_id,
+                        "last_log_index": last_index,
+                        "last_log_term": last_term,
+                        "pre_vote": True,
+                    })
+                except Exception:
+                    continue
+                if resp.get("granted"):
+                    pre += 1
+            if pre < quorum:
+                return False
         self.metrics.counter("elections_started").inc()
         with self._lock:
             self.role = CANDIDATE
@@ -656,6 +736,7 @@ class RaftNode:
                     "candidate_id": self.node_id,
                     "last_log_index": last_index,
                     "last_log_term": last_term,
+                    "leadership_transfer": transfer,
                 })
             except Exception:
                 continue
@@ -722,6 +803,10 @@ class RaftNode:
         with self._lock:
             if self.role != LEADER:
                 raise NotRaftLeaderError(self.node_id, self.leader_hint)
+            if getattr(self, "_transferring", False):
+                # mid-hand-off (§3.10): refuse new entries; clients
+                # retry and land on whichever leader the transfer yields
+                raise NotRaftLeaderError(self.node_id, None)
             index = self._propose_locked(data, register_waiter=True)
         deadline = time.monotonic() + timeout
         try:
@@ -916,9 +1001,16 @@ class RaftNode:
                     >= (last_term, last_index)
                 )
                 return {"term": self.storage.term, "granted": granted}
-            if req["term"] > self.storage.term and \
-                    not self._heard_from_leader_recently():
+            if req["term"] > self.storage.term and (
+                    req.get("leadership_transfer")
+                    or not self._heard_from_leader_recently()):
+                # leadership_transfer: the leader itself sanctioned this
+                # election, so the sticky-leader guard must not block it
                 self._step_down(req["term"])
+                if req.get("leadership_transfer"):
+                    # advisory hint: the sanctioned candidate is about
+                    # to be the leader; don't keep pointing at nobody
+                    self.leader_hint = req["candidate_id"]
             granted = False
             if req["term"] == self.storage.term and self.storage.voted_for \
                     in (None, req["candidate_id"]):

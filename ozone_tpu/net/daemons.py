@@ -885,6 +885,8 @@ class ScmOmDaemon:
                     raise StorageError(
                         "INVALID", "ring-add needs id=host:port")
                 return self.ha.ring_add(node_id, address)
+            if op == "ring-transfer":
+                return self.ha.ring_transfer(str(target))
             return self.ha.ring_remove(str(target))
 
         self.scm_service.ring_ops = lambda op, target: self._ha_call(

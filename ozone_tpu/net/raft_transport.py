@@ -28,7 +28,7 @@ log = logging.getLogger(__name__)
 
 SERVICE = "raft"
 _METHODS = ("request_vote", "append_entries", "install_snapshot",
-            "fetch_state")
+            "fetch_state", "timeout_now")
 
 
 class RaftRpcService:

@@ -232,16 +232,20 @@ class ScmGrpcService:
                 raise StorageError("UNSUPPORTED_REQUEST",
                                    "not an HA deployment")
             return wire.pack(self.ring_status())
-        if op in ("ring-add", "ring-remove"):
+        if op in ("ring-add", "ring-remove", "ring-transfer"):
             # membership change IS its own replication (the config
             # entry rides the raft log), so it does not go through the
-            # admin submitter
+            # admin submitter; transfer likewise acts directly on the
+            # leader's raft node
             if self.ring_ops is None:
                 raise StorageError("UNSUPPORTED_REQUEST",
                                    "not an HA deployment")
             if self.gate is not None:
                 self.gate()
-            return wire.pack({"members": self.ring_ops(op, target)})
+            out = self.ring_ops(op, target)
+            if op == "ring-transfer":
+                return wire.pack(out)
+            return wire.pack({"members": out})
         if op in ("cert-list", "cert-revoke"):
             # CA lifecycle ops: answered by the replica hosting the
             # root CA (daemon wires cert_ops when it owns one)

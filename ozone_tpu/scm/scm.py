@@ -206,7 +206,7 @@ class StorageContainerManager:
         serves both the direct (single-node) path and the HA ring's
         replicated apply, so every replica ends in the same state
         (`ozone admin` node/balancer/safemode verbs)."""
-        from ozone_tpu.storage.ids import StorageError
+        from ozone_tpu.storage.ids import ContainerState, StorageError
 
         if op in ("decommission", "recommission", "maintenance"):
             node = self.nodes.get(target) if target else None
@@ -242,7 +242,6 @@ class StorageContainerManager:
             if c is None:
                 raise StorageError("CONTAINER_NOT_FOUND",
                                    f"unknown container {target!r}")
-            from ozone_tpu.storage.ids import ContainerState
 
             if c.state is ContainerState.OPEN:
                 # the normal close flow: CLOSING + close commands to the
@@ -254,8 +253,6 @@ class StorageContainerManager:
             # their container here, so closing the pipeline finalizes
             # the container (writes stop, members drop the raft group)
             pid = _numeric_id("pipeline")
-            from ozone_tpu.storage.ids import ContainerState
-
             for c in self.containers.containers():
                 if c.pipeline is not None and c.pipeline.id == pid:
                     if c.state is ContainerState.OPEN:

@@ -544,13 +544,20 @@ def cmd_admin(args) -> int:
             if not target:
                 return usage("ring remove needs the replica id")
             _emit(scm.admin("ring-remove", target))
+        elif verb == "transfer":
+            # `ozone admin om transfer --node` analog: planned
+            # leadership hand-off to the named replica
+            if not target:
+                return usage("ring transfer needs the target replica id")
+            _emit(scm.admin("ring-transfer", target))
         elif verb in (None, "status", "roles"):
             # `ozone admin om roles` analog: role/term/leader from the
             # replica that answered (any replica, incl. followers)
             _emit(scm.admin("ring-status"))
         else:
             return usage(f"unknown ring verb {verb!r} "
-                         "(expected add <id>=<addr>|remove <id>|status)")
+                         "(expected add <id>=<addr>|remove <id>|"
+                         "transfer <id>|status)")
     elif subject == "cert":
         # CA lifecycle (ozone admin cert list/revoke analog): answered
         # by the replica hosting the cluster CA

@@ -614,3 +614,51 @@ def _swallow(fn):
         fn()
     except Exception:
         pass
+
+
+def test_leadership_transfer(tmp_path):
+    """Raft §3.10 planned hand-off: the target is caught up, told to
+    campaign (timeout_now), and wins despite the sticky-leader guard;
+    the old leader ends a follower and the ring keeps committing."""
+    nodes, states, _ = make_cluster(tmp_path)
+    assert nodes[0].start_election()
+    for i in range(5):
+        nodes[0].propose({"v": i})
+
+    assert nodes[0].transfer_leadership("n1")
+    assert nodes[1].role == "leader"
+    assert nodes[0].role != "leader"
+    # the new leader serves writes; all replicas converge
+    nodes[1].propose({"v": 99})
+    assert states[1][-1] == {"v": 99}
+
+    # transfer to self is a no-op success; unknown target refused
+    assert nodes[1].transfer_leadership("n1")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        nodes[1].transfer_leadership("nope")
+    # non-leader cannot transfer
+    from ozone_tpu.consensus.raft import NotRaftLeaderError
+
+    with _pytest.raises(NotRaftLeaderError):
+        nodes[0].transfer_leadership("n2")
+
+
+def test_leadership_transfer_catches_target_up(tmp_path):
+    """A transfer target behind the log is replicated to before the
+    timeout_now, so the hand-off never elects a stale leader."""
+    nodes, states, transport = make_cluster(tmp_path)
+    assert nodes[0].start_election()
+    nodes[0].propose({"v": 0})
+    # isolate n2, write more, then heal and immediately transfer to it
+    transport.partition("n0", "n2")
+    transport.partition("n1", "n2")
+    for i in range(1, 4):
+        nodes[0].propose({"v": i})
+    transport.heal()
+    assert nodes[0].transfer_leadership("n2")
+    assert nodes[2].role == "leader"
+    # n2 has the full log (transfer waited for catch-up before electing)
+    nodes[2].propose({"v": 4})
+    assert [e["v"] for e in states[2]] == [0, 1, 2, 3, 4]
