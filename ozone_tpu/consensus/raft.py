@@ -616,6 +616,9 @@ class RaftNode:
                 return True
             if target not in self.members:
                 raise ValueError(f"{target!r} is not a ring member")
+            if self._transferring:
+                raise ValueError("a leadership transfer is already "
+                                 "in flight")
             # §3.10: stop accepting client proposals for the duration —
             # new entries appended mid-hand-off would make the target's
             # log stale again and the sanctioned election lose
@@ -803,7 +806,7 @@ class RaftNode:
         with self._lock:
             if self.role != LEADER:
                 raise NotRaftLeaderError(self.node_id, self.leader_hint)
-            if getattr(self, "_transferring", False):
+            if self._transferring:
                 # mid-hand-off (§3.10): refuse new entries; clients
                 # retry and land on whichever leader the transfer yields
                 raise NotRaftLeaderError(self.node_id, None)
@@ -1007,10 +1010,6 @@ class RaftNode:
                 # leadership_transfer: the leader itself sanctioned this
                 # election, so the sticky-leader guard must not block it
                 self._step_down(req["term"])
-                if req.get("leadership_transfer"):
-                    # advisory hint: the sanctioned candidate is about
-                    # to be the leader; don't keep pointing at nobody
-                    self.leader_hint = req["candidate_id"]
             granted = False
             if req["term"] == self.storage.term and self.storage.voted_for \
                     in (None, req["candidate_id"]):
@@ -1025,6 +1024,12 @@ class RaftNode:
                     self._last_heartbeat = time.monotonic()
                     if self._timer_thread:
                         self._election_deadline = self._new_deadline()
+                    if req.get("leadership_transfer"):
+                        # advisory: the sanctioned candidate we just
+                        # voted for is about to lead; only a GRANTED
+                        # vote may move the hint, or a losing candidate
+                        # would misdirect failover clients
+                        self.leader_hint = req["candidate_id"]
             return {"term": self.storage.term, "granted": granted}
 
     def handle_append_entries(self, req: dict) -> dict:

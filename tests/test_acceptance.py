@@ -314,3 +314,93 @@ def test_ha_cluster_subprocesses(tmp_path):
                 p.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+def test_smoke_round3_verbs(live_cluster):
+    """This round's operator surface end-to-end through the CLI:
+    delegation tokens, key rewrite/cat/cp, bucket set-replication,
+    volume owner update, list-open-files, paged snapshot diff, live
+    reconfig, dnsim."""
+    om, tmp = live_cluster
+    _cli(["sh", "volume", "create", "/r3", "--om", om])
+    _cli(["sh", "bucket", "create", "/r3/b", "--om", om,
+          "--replication", "RATIS/THREE"])
+    payload = bytes(np.random.default_rng(11).integers(
+        0, 256, 20_000, dtype=np.uint8))
+    src = tmp / "r3.bin"
+    src.write_bytes(payload)
+    _cli(["sh", "key", "put", "/r3/b/k", str(src), "--om", om])
+
+    # delegation tokens: get -> print -> renew -> cancel -> renew fails
+    tok = tmp / "tok.json"
+    _cli(["sh", "token", "get", "--om", om, "--renewer", "yarn",
+          "--token", str(tok)])
+    assert json.loads(tok.read_text())["renewer"] == "yarn"
+    _cli(["sh", "token", "renew", "--om", om, "--token", str(tok)])
+    _cli(["sh", "token", "cancel", "--om", om, "--token", str(tok)])
+    dead = _cli(["sh", "token", "renew", "--om", om,
+                 "--token", str(tok)], check=False)
+    assert dead.returncode != 0 and "TOKEN_ERROR" in dead.stderr
+
+    # rewrite RATIS -> EC, data intact, cat matches
+    _cli(["sh", "key", "rewrite", "/r3/b/k", "--om", om,
+          "--replication", "rs-3-2-4096"])
+    info = json.loads(
+        _cli(["sh", "key", "info", "/r3/b/k", "--om", om]).stdout)
+    assert info["replication"] == "rs-3-2-4096"
+    # cat streams raw bytes to stdout: run binary-mode
+    cat = subprocess.run(
+        [sys.executable, "-m", "ozone_tpu.tools", "sh", "key", "cat",
+         "/r3/b/k", "--om", om],
+        capture_output=True, timeout=60, check=True, cwd=str(REPO),
+        env=dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu"),
+    )
+    assert cat.stdout == payload
+    out = tmp / "cat.bin"
+    _cli(["sh", "key", "get", "/r3/b/k", str(out), "--om", om])
+    assert out.read_bytes() == payload
+
+    # cp into a second bucket; destination bucket's replication applies
+    _cli(["sh", "bucket", "create", "/r3/b2", "--om", om,
+          "--replication", "rs-3-2-4096"])
+    _cli(["sh", "key", "cp", "/r3/b/k", "--om", om, "--to", "/r3/b2/k2"])
+    got = tmp / "cp.bin"
+    _cli(["sh", "key", "get", "/r3/b2/k2", str(got), "--om", om])
+    assert got.read_bytes() == payload
+
+    # bucket set-replication + volume owner update
+    _cli(["sh", "bucket", "set-replication", "/r3/b", "--om", om,
+          "--replication", "rs-3-2-4096"])
+    binfo = json.loads(
+        _cli(["sh", "bucket", "info", "/r3/b", "--om", om]).stdout)
+    assert binfo["replication"] == "rs-3-2-4096"
+    _cli(["sh", "volume", "update", "/r3", "--om", om, "--user", "alice"])
+    vinfo = json.loads(
+        _cli(["sh", "volume", "info", "/r3", "--om", om]).stdout)
+    assert vinfo["owner"] == "alice"
+
+    # paged snapshot diff as JSON lines
+    _cli(["sh", "snapshot", "create", "/r3/b", "--om", om,
+          "--name", "d1"])
+    _cli(["sh", "key", "delete", "/r3/b/k", "--om", om])
+    _cli(["sh", "snapshot", "create", "/r3/b", "--om", om,
+          "--name", "d2"])
+    paged = _cli(["sh", "snapshot", "diff", "/r3/b", "--om", om,
+                  "--name", "d1", "--to", "d2", "--page-size", "1"])
+    lines = [json.loads(line) for line in paged.stdout.splitlines()]
+    assert {"op": "DELETE", "key": "k"} in lines
+
+    # list-open-files over gRPC (no sessions open right now)
+    lof = json.loads(_cli(["admin", "om", "list-open-files", "/r3/b",
+                           "--om", om]).stdout)
+    assert lof["open_files"] == []
+
+    # dnsim registers simulated nodes without polluting placement
+    rep = json.loads(_cli(["freon", "dnsim", "-n", "4", "--containers",
+                           "2", "--duration", "1", "--interval", "0.3",
+                           "--om", om], timeout=120).stdout)
+    assert rep["failures"] == 0 and rep["datanodes"] == 4
+    nodes = json.loads(_cli(["admin", "datanode", "--om", om]).stdout)
+    sims = [n for n in nodes if n["dn_id"].startswith("simdn")]
+    assert len(sims) == 4
+    assert all(n["op_state"] == "IN_MAINTENANCE" for n in sims)
