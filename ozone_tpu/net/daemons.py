@@ -258,6 +258,10 @@ class DatanodeDaemon:
     def _scan_loop(self) -> None:
         while not self._stop.wait(self.scan_interval):
             try:
+                # disk health first (StorageVolumeChecker cadence): a
+                # failed volume's replicas leave the container set, the
+                # next heartbeat's FCR reports the loss, SCM repairs
+                self.dn.check_volumes()
                 self.scan_once()
             except Exception:
                 log.exception("%s background scan failed", self.dn.id)
@@ -367,6 +371,7 @@ class DatanodeDaemon:
             self.dn.id, container_report=report, used_bytes=used,
             layout_version=self.layout.metadata_version,
             deleted_block_acks=acks,
+            healthy_volumes=self.dn.healthy_volume_count,
         )
         if report is not None:
             # delivered-only bookkeeping: a heartbeat that raised (every

@@ -179,6 +179,7 @@ class ScmGrpcService:
             used_bytes=m.get("used_bytes", 0),
             deleted_block_acks=m.get("deleted_block_acks"),
             layout_version=m.get("layout_version"),
+            healthy_volumes=m.get("healthy_volumes"),
         )
         return wire.pack(
             {
@@ -465,13 +466,15 @@ class GrpcScmClient:
     def heartbeat(self, dn_id: str, container_report=None,
                   used_bytes: int = 0,
                   deleted_block_acks: Optional[list[int]] = None,
-                  layout_version: Optional[int] = None) -> list:
+                  layout_version: Optional[int] = None,
+                  healthy_volumes: Optional[int] = None) -> list:
         responses = self._broadcast("Heartbeat", {
             "dn_id": dn_id,
             "container_report": container_report,
             "used_bytes": used_bytes,
             "deleted_block_acks": deleted_block_acks or [],
             "layout_version": layout_version,
+            "healthy_volumes": healthy_volumes,
         })
         self._merge_security(responses)
         cmds = []

@@ -51,6 +51,10 @@ class NodeInfo:
     state: NodeState = NodeState.HEALTHY
     layout_version: int = -1  # -1: not reported yet
     op_state: NodeOperationalState = NodeOperationalState.IN_SERVICE
+    #: healthy-disk count from heartbeats (-1: not reported). 0 means
+    #: the node is alive but storage-dead — never a placement target
+    #: (the reference's failed-volume / zero-remaining SCMNodeStat case)
+    healthy_volumes: int = -1
 
 
 class NodeManager:

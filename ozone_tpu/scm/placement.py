@@ -30,7 +30,8 @@ class PlacementPolicy:
 
     def _candidates(self, excluded: Sequence[str]) -> list[NodeInfo]:
         ex = set(excluded)
-        return [n for n in self.nodes.healthy_in_service() if n.dn_id not in ex]
+        return [n for n in self.nodes.healthy_in_service()
+                if n.dn_id not in ex and n.healthy_volumes != 0]
 
 
 class RandomPlacement(PlacementPolicy):

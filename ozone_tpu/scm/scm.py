@@ -134,6 +134,7 @@ class StorageContainerManager:
         used_bytes: int = 0,
         deleted_block_acks: Optional[list[int]] = None,
         layout_version: Optional[int] = None,
+        healthy_volumes: Optional[int] = None,
     ) -> list:
         """Process a heartbeat (+optional full container report and block-
         deletion acks); return the commands queued for this datanode."""
@@ -151,10 +152,13 @@ class StorageContainerManager:
                 ):
                     self.containers.mark_closed(c.id)
         self.metrics.counter("heartbeats").inc()
-        if layout_version is not None:
+        if layout_version is not None or healthy_volumes is not None:
             n = self.nodes.get(dn_id)
             if n is not None:
-                n.layout_version = int(layout_version)
+                if layout_version is not None:
+                    n.layout_version = int(layout_version)
+                if healthy_volumes is not None:
+                    n.healthy_volumes = int(healthy_volumes)
         return self.nodes.process_heartbeat(dn_id, used_bytes)
 
     def _on_dead_node(self, dn_id: str) -> None:

@@ -11,6 +11,7 @@ container (ContainerDataYaml analog), and FilePerBlockStore chunk files.
 from __future__ import annotations
 
 import json
+import logging
 import sqlite3
 import threading
 import time
@@ -28,6 +29,8 @@ from ozone_tpu.storage.ids import (
     ContainerState,
     StorageError,
 )
+
+log = logging.getLogger(__name__)
 
 
 class VolumeDB:
@@ -191,10 +194,37 @@ class Container:
 class HddsVolume:
     """One storage volume (disk) holding container directories + a VolumeDB."""
 
+    _PROBE = b"ozone-tpu-disk-check"
+
     def __init__(self, root: Path):
         self.root = Path(root)
         (self.root / "containers").mkdir(parents=True, exist_ok=True)
         self.db = VolumeDB(self.root / "metadata.db")
+        #: a failed disk (StorageVolumeChecker verdict): excluded from
+        #: placement, its replicas dropped from the container set
+        self.failed = False
+
+    def check(self) -> bool:
+        """Disk health probe (the reference's DiskChecker behind
+        StorageVolumeChecker): a tiny write/read/delete round-trip in
+        the volume root. Any OSError — or a readback mismatch, the
+        silent-corruption face of a dying disk — marks the volume
+        failed. A failed verdict is sticky, like the reference's
+        failed-volume set."""
+        if self.failed:
+            return False
+        probe = self.root / ".disk-check"
+        try:
+            probe.write_bytes(self._PROBE)
+            ok = probe.read_bytes() == self._PROBE
+            probe.unlink()
+            if not ok:
+                raise OSError("disk probe readback mismatch")
+            return True
+        except OSError:
+            log.warning("volume %s failed its disk check", self.root)
+            self.failed = True
+            return False
 
     def container_dir(self, container_id: int) -> Path:
         return self.root / "containers" / str(container_id)

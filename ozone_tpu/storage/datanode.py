@@ -15,6 +15,7 @@ containers UNHEALTHY — a natural TPU batch job via the device CRC kernel.
 from __future__ import annotations
 
 import itertools
+import logging
 import threading
 from pathlib import Path
 from typing import Optional
@@ -33,6 +34,8 @@ from ozone_tpu.storage.ids import (
 )
 from ozone_tpu.utils.checksum import Checksum, ChecksumError
 from ozone_tpu.utils.metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
 
 
 class Datanode:
@@ -72,15 +75,49 @@ class Datanode:
                    if c.db is vol.db)
 
     def _choose_volume(self) -> HddsVolume:
-        if len(self.volumes) > 1 and self.volume_policy == "capacity":
+        healthy = [v for v in self.volumes if not v.failed]
+        if not healthy:
+            raise StorageError("IO_EXCEPTION",
+                               f"{self.id}: no healthy volumes left")
+        if len(healthy) > 1 and self.volume_policy == "capacity":
             # one pass over the containers, not one per volume
-            used = {id(v.db): 0 for v in self.volumes}
+            used = {id(v.db): 0 for v in healthy}
             for c in self.containers:
                 k = id(c.db)
                 if k in used:
                     used[k] += c.used_bytes()
-            return min(self.volumes, key=lambda v: used[id(v.db)])
-        return self.volumes[next(self._rr) % len(self.volumes)]
+            return min(healthy, key=lambda v: used[id(v.db)])
+        return healthy[next(self._rr) % len(healthy)]
+
+    def check_volumes(self) -> list[str]:
+        """StorageVolumeChecker sweep: probe every volume; a newly
+        failed volume's container replicas are dropped from the set —
+        the next full report omits them, the SCM's replica accounting
+        sees the loss, and the replication manager repairs elsewhere
+        (the reference's VolumeSet failed-volume flow)."""
+        newly_failed: list[str] = []
+        for vol in self.volumes:
+            if vol.failed or vol.check():
+                continue
+            newly_failed.append(str(vol.root))
+            # sweep under the same lock create_container holds for its
+            # choose->add window: a create that chose this volume before
+            # the verdict has either finished (its container is in the
+            # set and gets dropped here) or has not started choosing
+            # (it will see vol.failed) — no replica can slip through
+            with self._lock:
+                lost = [c for c in self.containers if c.db is vol.db]
+                for c in lost:
+                    self.containers.remove(c.id)
+                self.mutation_count += 1
+            self.metrics.counter("volumes_failed").inc()
+            log.warning("%s: volume %s failed; dropped %d container "
+                        "replicas", self.id, vol.root, len(lost))
+        return newly_failed
+
+    @property
+    def healthy_volume_count(self) -> int:
+        return sum(1 for v in self.volumes if not v.failed)
 
     # -- container verbs --
     def create_container(

@@ -449,3 +449,57 @@ def test_reconstruction_of_encrypted_key(cluster):
     info2["block_groups"] = [g.to_json() for g in g2]
     got = oz2.get_volume("ev").get_bucket("enc").read_key_info(info2)
     assert np.array_equal(got, data)
+
+
+def test_volume_failure_triggers_reconstruction(cluster):
+    """Disk-death flow end-to-end: a datanode volume fails its disk
+    check, the replicas drop out of the next full container report, the
+    SCM's accounting sees the loss, and the replication manager repairs
+    the missing EC unit on another node (the reference's failed-volume
+    -> ICR -> ReplicationManager chain)."""
+    import shutil
+
+    meta, dns = cluster
+    oz = _client(meta)
+    b = oz.create_volume("vvf").create_bucket("b", replication=EC)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, 40_000, dtype=np.uint8)
+    b.write_key("k", data)
+
+    info = oz.om.lookup_key("vvf", "b", "k")
+    groups = oz.om.key_block_groups(info)
+    for g in groups:
+        for dn in dns:
+            if dn.dn.id in g.pipeline.nodes:
+                try:
+                    dn.dn.close_container(g.container_id)
+                except Exception:
+                    pass
+
+    # kill the DISK (not the node) under one data unit
+    victim_id = groups[0].pipeline.nodes[1]
+    victim = next(d for d in dns if d.dn.id == victim_id)
+    vol = victim.dn.volumes[0]
+    shutil.rmtree(vol.root)
+    assert victim.dn.check_volumes() == [str(vol.root)]
+    assert victim.dn.container_report() == []  # all replicas were there
+
+    # the victim node stays alive and heartbeating; repair must come
+    # from the report delta, not a dead-node event
+    _await_replica_rebuild(meta, groups, victim_id)
+
+    _repoint_groups(meta, groups, victim_id)
+    from ozone_tpu.client.ec_reader import ECBlockGroupReader
+    from ozone_tpu.codec.api import CoderOptions
+
+    clients = oz.clients
+    for dn_id, addr in meta.scm_service.addresses.items():
+        if clients.maybe_get(dn_id) is None:
+            clients.register_remote(dn_id, addr)
+    parts = [
+        ECBlockGroupReader(
+            g, CoderOptions.parse(EC), clients, bytes_per_checksum=16 * 1024
+        ).read_all()
+        for g in groups
+    ]
+    assert np.array_equal(np.concatenate(parts)[: data.size], data)

@@ -187,3 +187,50 @@ def test_capacity_volume_chooser(tmp_path):
     roots = {str(rr.containers.get(c).root)[:len(str(rr.volumes[0].root))]
              for c in (10, 11)}
     assert len(roots) == 2
+
+
+def test_volume_failure_drops_replicas_and_placement(tmp_path):
+    """StorageVolumeChecker analog: a failed disk's replicas leave the
+    container set, new containers land on surviving volumes only, and
+    an all-volumes-failed datanode refuses writes."""
+    import shutil
+
+    from ozone_tpu.storage.datanode import Datanode
+
+    dn = Datanode(tmp_path / "dn", "dnv", num_volumes=2)
+    c1 = dn.create_container(1)
+    c2 = dn.create_container(2)
+    # round-robin put them on different volumes
+    assert c1.db is not c2.db
+    assert dn.check_volumes() == []  # both healthy
+
+    # break volume 0: remove its root so the probe fails with ENOENT
+    vol0 = dn.volumes[0]
+    victims = [c for c in (c1, c2) if c.db is vol0.db]
+    shutil.rmtree(vol0.root)
+    failed = dn.check_volumes()
+    assert failed == [str(vol0.root)]
+    assert vol0.failed
+    assert dn.healthy_volume_count == 1
+    # its replicas are gone from the set / the report
+    ids = {c.id for c in dn.list_containers()}
+    assert all(v.id not in ids for v in victims)
+    reported = {r["container_id"] for r in dn.container_report()}
+    assert all(v.id not in reported for v in victims)
+    # sticky verdict, no double-reporting
+    assert dn.check_volumes() == []
+
+    # new containers only ever land on the healthy volume
+    for cid in (10, 11, 12):
+        c = dn.create_container(cid)
+        assert c.db is dn.volumes[1].db
+
+    # all volumes down -> writes refused with IO_EXCEPTION
+    dn.volumes[1].failed = True
+    from ozone_tpu.storage.ids import StorageError
+
+    try:
+        dn.create_container(99)
+        assert False, "expected IO_EXCEPTION"
+    except StorageError as e:
+        assert e.code == "IO_EXCEPTION"
