@@ -1434,7 +1434,12 @@ def build_parser() -> argparse.ArgumentParser:
     dbg = sub.add_parser("debug", help="debug tools (ozone debug analog)")
     dbg.add_argument("tool", choices=["ldb", "chunk-info", "verify-replicas",
                                       "export-container",
-                                      "import-container", "trace"])
+                                      "import-container", "trace",
+                                      "container-list",
+                                      "container-inspect"])
+    dbg.add_argument("--root", default="",
+                     help="container-list/inspect: local datanode root "
+                          "directory (offline)")
     dbg.add_argument("target", nargs="?", default="",
                      help="db path (ldb), /vol/bucket/key, a container "
                           "id (export/import), or a trace id (trace; "
@@ -1573,6 +1578,86 @@ def cmd_debug(args) -> int:
                 print(json.dumps({"key": k, "value": v}, default=str))
         finally:
             store.close()
+        return 0
+
+    if args.tool in ("container-list", "container-inspect"):
+        # offline container explorer against a LOCAL datanode root
+        # (ozone debug container list/info/inspect analog: runs on the
+        # datanode host with the service stopped). STRICTLY read-only:
+        # volumes are opened by their DISCOVERED directories (a root
+        # with vol0+vol2 loads both; nothing is fabricated) and an
+        # inspect scan reports checksum errors without committing the
+        # UNHEALTHY state the online scanner would
+        from ozone_tpu.storage.container import HddsVolume
+        from ozone_tpu.utils.checksum import Checksum, ChecksumError
+
+        if not args.root:
+            print("error: debug container verbs need --root DN_ROOT",
+                  file=sys.stderr)
+            return 2
+        vol_dirs = sorted(p for p in Path(args.root).glob("vol*")
+                          if p.is_dir())
+        if not vol_dirs:
+            print(f"error: no vol* directories under {args.root} — "
+                  "not a datanode root", file=sys.stderr)
+            return 2
+        vols = [HddsVolume(d) for d in vol_dirs]
+        try:
+            containers = sorted(
+                (c for v in vols for c in v.load_containers()),
+                key=lambda c: c.id)
+            if args.tool == "container-list":
+                rows = []
+                for c in containers:
+                    blocks = c.list_blocks()
+                    rows.append({
+                        "id": c.id,
+                        "state": c.state.value,
+                        "replica_index": c.replica_index,
+                        "blocks": len(blocks),
+                        "used_bytes": sum(b.length for b in blocks),
+                        "path": str(c.root),
+                    })
+                _emit(rows)
+            else:  # container-inspect <id>
+                try:
+                    cid = int(args.target)
+                except ValueError:
+                    print(f"error: container id must be numeric, got "
+                          f"{args.target!r}", file=sys.stderr)
+                    return 2
+                c = next((c for c in containers if c.id == cid), None)
+                if c is None:
+                    print(f"error: no container {cid} under "
+                          f"{args.root}", file=sys.stderr)
+                    return 1
+                errors = []
+                blocks = c.list_blocks()
+                for b in blocks:
+                    for ci in b.chunks:
+                        try:
+                            data = c.chunks.read_chunk(b.block_id, ci)
+                            if ci.checksum.checksums:
+                                Checksum().verify(data, ci.checksum)
+                        except (StorageError, ChecksumError) as e:
+                            errors.append(
+                                f"{b.block_id}/{ci.name}: {e}")
+                _emit({
+                    "id": c.id,
+                    "state": c.state.value,
+                    "replica_index": c.replica_index,
+                    "path": str(c.root),
+                    "blocks": [
+                        {"local_id": b.block_id.local_id,
+                         "length": b.length,
+                         "chunks": len(b.chunks)}
+                        for b in blocks
+                    ],
+                    "scan_errors": errors,
+                })
+        finally:
+            for v in vols:
+                v.close()
         return 0
 
     if args.tool != "trace" and not args.target:

@@ -246,3 +246,75 @@ def test_admin_reconfig_cli(tmp_path, capsys):
         assert cli_main(["admin", "reconfig", "properties"]) == 2
     finally:
         meta.stop()
+
+
+def test_debug_container_offline_verbs(tmp_path, capsys):
+    """ozone debug container list/inspect analog: offline exploration
+    of a local datanode root."""
+    import json as _json
+
+    import numpy as np
+
+    from pathlib import Path as pathlib_Path
+
+    from ozone_tpu.storage.datanode import Datanode
+    from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo
+    from ozone_tpu.tools.cli import main as cli_main
+    from ozone_tpu.utils.checksum import Checksum
+
+    root = tmp_path / "dnroot"
+    dn = Datanode(root, "dx", num_volumes=2)
+    data = np.random.default_rng(3).integers(0, 256, 5000, dtype=np.uint8)
+    for cid in (1, 2):
+        dn.create_container(cid)
+        bid = BlockID(cid, 1)
+        info = ChunkInfo("c0", 0, data.size,
+                         Checksum().compute(data))
+        dn.write_chunk(bid, info, data)
+        dn.put_block(BlockData(bid, [info], data.size))
+    dn.close()
+
+    assert cli_main(["debug", "container-list", "--root", str(root)]) == 0
+    rows = _json.loads(capsys.readouterr().out)
+    assert [r["id"] for r in rows] == [1, 2]
+    assert all(r["blocks"] == 1 and r["used_bytes"] == 5000 for r in rows)
+
+    assert cli_main(["debug", "container-inspect", "1",
+                     "--root", str(root)]) == 0
+    out = _json.loads(capsys.readouterr().out)
+    assert out["id"] == 1
+    assert out["blocks"][0]["length"] == 5000
+    assert out["scan_errors"] == []
+
+    assert cli_main(["debug", "container-list", "--root",
+                     str(tmp_path / "nope")]) == 2
+    assert cli_main(["debug", "container-inspect", "abc",
+                     "--root", str(root)]) == 2
+    assert cli_main(["debug", "container-inspect", "777",
+                     "--root", str(root)]) == 1
+    capsys.readouterr()
+
+    # non-contiguous volume names still load (vol1 removed by operator)
+    import shutil
+
+    shutil.rmtree(root / "vol1")
+    (root / "vol3").mkdir()
+    assert cli_main(["debug", "container-list", "--root", str(root)]) == 0
+    rows = _json.loads(capsys.readouterr().out)
+    assert len(rows) >= 1  # vol0's container still listed
+    assert not (root / "vol1").exists()  # read-only: nothing fabricated
+
+    # a corrupt chunk reports scan_errors WITHOUT rewriting state
+    import json as _j
+
+    victim = rows[0]
+    chunk_files = list((pathlib_Path(victim["path"]) / "chunks").glob("*"))
+    chunk_files[0].write_bytes(b"garbage" * 100)
+    desc_before = (pathlib_Path(victim["path"]) / "container.json").read_text()
+    assert cli_main(["debug", "container-inspect", str(victim["id"]),
+                     "--root", str(root)]) == 0
+    out2 = _json.loads(capsys.readouterr().out)
+    assert out2["scan_errors"]
+    desc_after = (pathlib_Path(victim["path"]) / "container.json").read_text()
+    assert desc_before == desc_after  # inspection never commits UNHEALTHY
+    del _j
