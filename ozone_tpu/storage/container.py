@@ -34,11 +34,19 @@ log = logging.getLogger(__name__)
 
 
 class VolumeDB:
-    """Per-volume block-metadata store (schema V3 analog)."""
+    """Per-volume block-metadata store (schema V3 analog). With
+    readonly=True the sqlite file opens in mode=ro and no DDL runs —
+    the offline debug tools can inspect a failing disk remounted
+    read-only without writing a byte."""
 
-    def __init__(self, path: Path):
+    def __init__(self, path: Path, readonly: bool = False):
         self._path = path
         self._lock = threading.Lock()
+        if readonly:
+            self._conn = sqlite3.connect(
+                f"file:{path}?mode=ro", uri=True,
+                check_same_thread=False)
+            return
         self._conn = sqlite3.connect(str(path), check_same_thread=False)
         self._conn.execute(
             "CREATE TABLE IF NOT EXISTS blocks ("
@@ -196,10 +204,11 @@ class HddsVolume:
 
     _PROBE = b"ozone-tpu-disk-check"
 
-    def __init__(self, root: Path):
+    def __init__(self, root: Path, readonly: bool = False):
         self.root = Path(root)
-        (self.root / "containers").mkdir(parents=True, exist_ok=True)
-        self.db = VolumeDB(self.root / "metadata.db")
+        if not readonly:
+            (self.root / "containers").mkdir(parents=True, exist_ok=True)
+        self.db = VolumeDB(self.root / "metadata.db", readonly=readonly)
         #: a failed disk (StorageVolumeChecker verdict): excluded from
         #: placement, its replicas dropped from the container set
         self.failed = False

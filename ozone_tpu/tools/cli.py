@@ -617,6 +617,38 @@ def cmd_admin(args) -> int:
             return usage(f"unknown om verb {verb!r} "
                          "(expected prepare|cancelprepare|status|"
                          "list-open-files)")
+    elif subject == "namespace":
+        # `ozone admin namespace summary <path>` analog: per-directory
+        # du / entity counts from Recon's NSSummary warehouse
+        import urllib.request
+        from urllib.parse import quote
+
+        if not args.http:
+            print("error: namespace summary requires --http host:port "
+                  "(the Recon endpoint)", file=sys.stderr)
+            return 2
+        # `admin namespace summary /vol/bucket/dir` (or the path given
+        # directly as the verb slot — paths always start with /)
+        if verb == "summary":
+            path = target or "/"
+        elif verb is None or verb.startswith("/"):
+            path = verb or "/"
+        else:
+            return usage(f"unknown namespace verb {verb!r} "
+                         "(expected: summary <path>)")
+        url = (f"http://{args.http}/api/nssummary?path="
+               f"{quote(path, safe='/')}")
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                print(r.read().decode())
+        except urllib.error.HTTPError as e:
+            print(f"error: {e.code} {e.read().decode()}", file=sys.stderr)
+            return 1
+        except urllib.error.URLError as e:
+            print(f"error: cannot reach {args.http}: {e.reason}",
+                  file=sys.stderr)
+            return 1
+        return 0
     elif subject == "reconfig":
         # live reconfiguration (ozone admin reconfig analog over the
         # daemon's /reconfig HTTP endpoint, ReconfigureProtocol.proto)
@@ -1227,7 +1259,7 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("subject", choices=[
         "safemode", "datanode", "status", "pipeline", "container",
         "balancer", "replicationmanager", "om", "finalizeupgrade",
-        "upgrade", "ring", "kms", "cert", "reconfig",
+        "upgrade", "ring", "kms", "cert", "reconfig", "namespace",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"
@@ -1601,11 +1633,35 @@ def cmd_debug(args) -> int:
             print(f"error: no vol* directories under {args.root} — "
                   "not a datanode root", file=sys.stderr)
             return 2
-        vols = [HddsVolume(d) for d in vol_dirs]
+        vols = []
+        containers = []
+        load_errors = []
+        for d in vol_dirs:
+            try:
+                v = HddsVolume(d, readonly=True)
+            except Exception as e:  # noqa: BLE001 - forensic tool
+                load_errors.append(f"{d}: cannot open volume db: {e}")
+                continue
+            vols.append(v)
+            # per-container tolerant load: a crash-truncated descriptor
+            # must not hide the node's healthy containers from the
+            # forensic tool (load_containers would abort the volume)
+            from ozone_tpu.storage.container import Container
+
+            cdir = v.root / "containers"
+            if not cdir.is_dir():
+                continue
+            for sub in sorted(cdir.iterdir()):
+                if not (sub / "container.json").exists():
+                    continue
+                try:
+                    containers.append(Container.load(sub, v.db))
+                except Exception as e:  # noqa: BLE001
+                    load_errors.append(f"{sub}: bad descriptor: {e}")
         try:
-            containers = sorted(
-                (c for v in vols for c in v.load_containers()),
-                key=lambda c: c.id)
+            containers.sort(key=lambda c: c.id)
+            for err in load_errors:
+                print(f"warning: {err}", file=sys.stderr)
             if args.tool == "container-list":
                 rows = []
                 for c in containers:

@@ -356,3 +356,46 @@ def test_recon_ui_contract(cluster, tmp_path):
             assert html.count(o) >= html.count(c) - 2  # sanity only
     finally:
         recon.stop()
+
+
+def test_admin_namespace_summary_cli(tmp_path, capsys):
+    """ozone admin namespace summary analog over Recon's NSSummary."""
+    import json as _json
+    import time as _time
+
+    from ozone_tpu.net.daemons import ScmOmDaemon
+    from ozone_tpu.tools.cli import main as cli_main
+
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1e6,
+                       dead_after_s=2e6, recon_port=0,
+                       recon_interval_s=0.2)
+    meta.start()
+    try:
+        om = meta.om
+        om.create_volume("nsv")
+        om.create_bucket("nsv", "b", "rs-3-2-4096")
+        # a zero-byte committed key gives the summary a real row
+        s = om.open_key("nsv", "b", "k0")
+        om.commit_key(s, [], 0)
+        recon_http = meta.recon.address
+        deadline = _time.time() + 15
+        out = None
+        while _time.time() < deadline:
+            rc = cli_main(["admin", "namespace", "summary", "/nsv/b",
+                           "--http", recon_http])
+            raw = capsys.readouterr().out
+            if rc == 0 and raw.strip():
+                d = _json.loads(raw)
+                if d.get("total_files") == 1:
+                    out = d
+                    break
+            _time.sleep(0.3)
+        assert out is not None, "summary never showed the committed key"
+        assert out["total_bytes"] == 0
+        # unknown verb is a usage error; missing --http likewise
+        assert cli_main(["admin", "namespace", "du", "/nsv/b",
+                         "--http", recon_http]) == 2
+        capsys.readouterr()
+        assert cli_main(["admin", "namespace", "summary", "/nsv/b"]) == 2
+    finally:
+        meta.stop()

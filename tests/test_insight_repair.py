@@ -303,6 +303,20 @@ def test_debug_container_offline_verbs(tmp_path, capsys):
     rows = _json.loads(capsys.readouterr().out)
     assert len(rows) >= 1  # vol0's container still listed
     assert not (root / "vol1").exists()  # read-only: nothing fabricated
+    # STRICTLY read-only: the bare vol3 dir gained no fabricated state
+    assert list((root / "vol3").iterdir()) == []
+
+    # a crash-truncated descriptor warns but never hides the healthy
+    # containers
+    bad = root / "vol0" / "containers" / "42"
+    bad.mkdir(parents=True)
+    (bad / "container.json").write_text('{"id": 42, "sta')
+    assert cli_main(["debug", "container-list", "--root", str(root)]) == 0
+    cap = capsys.readouterr()
+    rows2 = _json.loads(cap.out)
+    assert [r["id"] for r in rows2] == [r["id"] for r in rows]
+    assert "bad descriptor" in cap.err
+    shutil.rmtree(bad)
 
     # a corrupt chunk reports scan_errors WITHOUT rewriting state
     import json as _j
