@@ -27,9 +27,10 @@ from ozone_tpu.storage.ids import (
 class FilePerBlockStore:
     """Chunks of a block live in one file `<chunks_dir>/<local_id>.block`."""
 
-    def __init__(self, chunks_dir: Path):
+    def __init__(self, chunks_dir: Path, readonly: bool = False):
         self.chunks_dir = Path(chunks_dir)
-        self.chunks_dir.mkdir(parents=True, exist_ok=True)
+        if not readonly:
+            self.chunks_dir.mkdir(parents=True, exist_ok=True)
 
     def block_path(self, block_id: BlockID) -> Path:
         return self.chunks_dir / f"{block_id.local_id}.block"

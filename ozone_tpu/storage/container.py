@@ -114,6 +114,7 @@ class Container:
         db: VolumeDB,
         state: ContainerState = ContainerState.OPEN,
         replica_index: int = 0,
+        readonly: bool = False,
     ):
         self.id = container_id
         self.root = Path(root)
@@ -121,7 +122,8 @@ class Container:
         self.state = state
         self.replica_index = replica_index
         self.created_at = time.time()
-        self.chunks = FilePerBlockStore(self.root / "chunks")
+        self.chunks = FilePerBlockStore(self.root / "chunks",
+                                        readonly=readonly)
         self._lock = threading.RLock()
 
     # -- descriptor (ContainerDataYaml analog) --
@@ -141,7 +143,8 @@ class Container:
         )
 
     @classmethod
-    def load(cls, root: Path, db: VolumeDB) -> "Container":
+    def load(cls, root: Path, db: VolumeDB,
+             readonly: bool = False) -> "Container":
         d = json.loads((Path(root) / "container.json").read_text())
         c = cls(
             int(d["id"]),
@@ -149,6 +152,7 @@ class Container:
             db,
             ContainerState(d["state"]),
             int(d.get("replica_index", 0)),
+            readonly=readonly,
         )
         c.created_at = d.get("created_at", c.created_at)
         return c
@@ -209,6 +213,7 @@ class HddsVolume:
         if not readonly:
             (self.root / "containers").mkdir(parents=True, exist_ok=True)
         self.db = VolumeDB(self.root / "metadata.db", readonly=readonly)
+        self.readonly = readonly
         #: a failed disk (StorageVolumeChecker verdict): excluded from
         #: placement, its replicas dropped from the container set
         self.failed = False
@@ -238,10 +243,26 @@ class HddsVolume:
     def container_dir(self, container_id: int) -> Path:
         return self.root / "containers" / str(container_id)
 
-    def load_containers(self) -> Iterator[Container]:
-        for d in sorted((self.root / "containers").iterdir()):
-            if (d / "container.json").exists():
-                yield Container.load(d, self.db)
+    def load_containers(self, on_error=None) -> Iterator[Container]:
+        """Yield this volume's containers. With `on_error` set, a
+        container that fails to load (crash-truncated descriptor, bad
+        permissions) is reported through the callback and skipped
+        instead of aborting the iteration — the forensic-tool contract;
+        without it, errors propagate (a serving datanode must not
+        silently drop replicas)."""
+        cdir = self.root / "containers"
+        if not cdir.is_dir():
+            return
+        for d in sorted(cdir.iterdir()):
+            if not (d / "container.json").exists():
+                continue
+            if on_error is None:
+                yield Container.load(d, self.db, readonly=self.readonly)
+                continue
+            try:
+                yield Container.load(d, self.db, readonly=self.readonly)
+            except Exception as e:  # noqa: BLE001 - reported, not fatal
+                on_error(f"{d}: bad descriptor: {e}")
 
     def close(self) -> None:
         self.db.close()

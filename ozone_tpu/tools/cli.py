@@ -826,6 +826,101 @@ def cmd_datanode(args) -> int:
     return _serve(d.stop)
 
 
+def cmd_cluster(args) -> int:
+    """One-command local cluster (the reference's docker-compose
+    ozone/ cluster analog): spawns a scm-om subprocess and N datanode
+    subprocesses under one supervisor, waits until healthy, prints the
+    endpoints, serves until SIGTERM/Ctrl-C, then tears every child
+    down. For demos and smoke runs, not production layout."""
+    import os
+    import signal
+    import subprocess
+    import tempfile
+    import time as _time
+
+    root = Path(args.root or tempfile.mkdtemp(prefix="ozone-cluster-"))
+    root.mkdir(parents=True, exist_ok=True)
+    env = dict(os.environ, PYTHONPATH=str(Path(__file__).resolve()
+                                          .parents[2]))
+    procs: list = []
+
+    def spawn(argv, log_name):
+        logf = open(root / log_name, "w")
+        p = subprocess.Popen(
+            [sys.executable, "-m", "ozone_tpu.tools", *argv],
+            stdout=logf, stderr=subprocess.STDOUT, env=env)
+        procs.append(p)
+        return p
+
+    def teardown():
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    meta_args = ["scm-om", "--db", str(root / "om.db"),
+                 "--port", str(args.port)]
+    if args.http_port:
+        meta_args += ["--http-port", str(args.http_port)]
+    if args.recon_port:
+        meta_args += ["--recon-port", str(args.recon_port)]
+    spawn(meta_args, "scm-om.log")
+    om = f"127.0.0.1:{args.port}"
+
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    scm = GrpcScmClient(om)
+    try:
+        deadline = _time.time() + 60
+        up = False
+        while _time.time() < deadline:
+            try:
+                scm.status()
+                up = True
+                break
+            except Exception:
+                _time.sleep(0.5)
+        if not up:
+            teardown()
+            print(f"error: metadata server did not come up (see "
+                  f"{root}/scm-om.log)", file=sys.stderr)
+            return 1
+        for i in range(args.datanodes):
+            spawn(["datanode", "--root", str(root / f"dn{i}"),
+                   "--scm", om, "--id", f"dn{i}"], f"dn{i}.log")
+        deadline = _time.time() + 60
+        registered = False
+        while _time.time() < deadline:
+            try:
+                st = scm.status()
+                if len(st.get("nodes", [])) >= args.datanodes:
+                    registered = True
+                    break
+            except Exception:
+                pass
+            _time.sleep(0.5)
+        if not registered:
+            teardown()
+            print(f"error: datanodes did not register (see "
+                  f"{root}/dn*.log)", file=sys.stderr)
+            return 1
+    except BaseException:
+        teardown()
+        raise
+    finally:
+        scm.close()
+    print(f"cluster up: om={om} datanodes={args.datanodes} "
+          f"root={root}")
+    print(f"try: ozone-tpu sh volume create /v --om {om}")
+    # _serve's own finally runs teardown; teardown is idempotent so a
+    # second call on an exception path is safe but not needed here
+    return _serve(teardown)
+
+
 def cmd_scm_om(args) -> int:
     import logging
 
@@ -1379,6 +1474,17 @@ def build_parser() -> argparse.ArgumentParser:
     s3.add_argument("--om", default="127.0.0.1:9860")
     s3.set_defaults(fn=cmd_s3)
 
+    cl = sub.add_parser("cluster",
+                        help="one-command local demo cluster "
+                             "(compose analog): scm-om + N datanodes")
+    cl.add_argument("--datanodes", type=int, default=5)
+    cl.add_argument("--port", type=int, default=9860)
+    cl.add_argument("--root", default="",
+                    help="data directory (default: a fresh tmp dir)")
+    cl.add_argument("--http-port", type=int, default=None)
+    cl.add_argument("--recon-port", type=int, default=None)
+    cl.set_defaults(fn=cmd_cluster)
+
     so = sub.add_parser("scm-om", help="run the SCM+OM metadata server")
     so.add_argument("--db", required=True)
     so.add_argument("--port", type=int, default=9860)
@@ -1643,21 +1749,8 @@ def cmd_debug(args) -> int:
                 load_errors.append(f"{d}: cannot open volume db: {e}")
                 continue
             vols.append(v)
-            # per-container tolerant load: a crash-truncated descriptor
-            # must not hide the node's healthy containers from the
-            # forensic tool (load_containers would abort the volume)
-            from ozone_tpu.storage.container import Container
-
-            cdir = v.root / "containers"
-            if not cdir.is_dir():
-                continue
-            for sub in sorted(cdir.iterdir()):
-                if not (sub / "container.json").exists():
-                    continue
-                try:
-                    containers.append(Container.load(sub, v.db))
-                except Exception as e:  # noqa: BLE001
-                    load_errors.append(f"{sub}: bad descriptor: {e}")
+            containers.extend(
+                v.load_containers(on_error=load_errors.append))
         try:
             containers.sort(key=lambda c: c.id)
             for err in load_errors:

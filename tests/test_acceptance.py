@@ -404,3 +404,51 @@ def test_smoke_round3_verbs(live_cluster):
     sims = [n for n in nodes if n["dn_id"].startswith("simdn")]
     assert len(sims) == 4
     assert all(n["op_state"] == "IN_MAINTENANCE" for n in sims)
+
+
+def test_cluster_launcher_supervises_and_tears_down(tmp_path):
+    """`ozone-tpu cluster`: the one-command compose-cluster analog
+    spawns scm-om + datanodes, serves traffic, and SIGTERM reaps every
+    child."""
+    env = dict(os.environ, PYTHONPATH=str(REPO), JAX_PLATFORMS="cpu")
+    port = _free_port()
+    sup = subprocess.Popen(
+        [sys.executable, "-m", "ozone_tpu.tools", "cluster",
+         "--datanodes", "2", "--port", str(port),
+         "--root", str(tmp_path / "cl")],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=str(REPO), env=env,
+    )
+    om = f"127.0.0.1:{port}"
+    try:
+        deadline = time.time() + 60
+        ready = False
+        while time.time() < deadline:
+            try:
+                out = _cli(["admin", "datanode", "--om", om],
+                           timeout=10).stdout
+                if len(json.loads(out)) == 2:
+                    ready = True
+                    break
+            except (subprocess.CalledProcessError,
+                    subprocess.TimeoutExpired):
+                pass
+            time.sleep(0.5)
+        assert ready, "cluster launcher never became healthy"
+        _cli(["sh", "volume", "create", "/clv", "--om", om])
+    finally:
+        sup.send_signal(signal.SIGTERM)
+        try:
+            sup.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            sup.kill()
+    # all children reaped: the om port stops answering
+    deadline = time.time() + 15
+    gone = False
+    while time.time() < deadline:
+        r = _cli(["admin", "status", "--om", om], check=False, timeout=10)
+        if r.returncode != 0:
+            gone = True
+            break
+        time.sleep(0.5)
+    assert gone, "children survived supervisor teardown"

@@ -318,6 +318,20 @@ def test_debug_container_offline_verbs(tmp_path, capsys):
     assert "bad descriptor" in cap.err
     shutil.rmtree(bad)
 
+    # a container missing its chunks/ dir (crash before first write)
+    # still lists, and the read-only path does NOT fabricate chunks/
+    chunkless = root / "vol0" / "containers" / "43"
+    chunkless.mkdir(parents=True)
+    import json as _jj
+
+    (chunkless / "container.json").write_text(_jj.dumps(
+        {"id": 43, "state": "OPEN", "replica_index": 0,
+         "created_at": 0}))
+    assert cli_main(["debug", "container-list", "--root", str(root)]) == 0
+    rows3 = _json.loads(capsys.readouterr().out)
+    assert 43 in [r["id"] for r in rows3]
+    assert not (chunkless / "chunks").exists()
+
     # a corrupt chunk reports scan_errors WITHOUT rewriting state
     import json as _j
 
