@@ -49,27 +49,32 @@ def remaining() -> float:
     return _DEADLINE - time.time()
 
 
-def emit_line(timed_out: bool = False) -> None:
+def emit_line(timed_out: bool = False, error: str = "") -> None:
     # exactly-one-JSON-line contract: the watchdog and the normal exit
-    # path race near the deadline; whoever gets here first wins
+    # path race near the deadline; whoever gets here first wins. The
+    # print stays INSIDE the lock: were it outside, the watchdog's
+    # os._exit could fire between the winner claiming the flag and
+    # actually printing, yielding zero lines
     global _EMITTED
     with _EMIT_LOCK:
         if _EMITTED:
             return
         _EMITTED = True
-    baseline = 12.0  # GiB/s/chip north-star (BASELINE.md config #2)
-    line = {
-        "metric": "rs-6-3-1mib-fused-encode-crc32c",
-        "value": round(_STATE["value"], 3),
-        "unit": "GiB/s/chip",
-        "vs_baseline": round(_STATE["value"] / baseline, 4),
-        "spread_pct": round(_STATE["spread_pct"], 1),
-    }
-    if _STATE["sustained"] is not None:
-        line["sustained_60s_gib_s"] = round(_STATE["sustained"], 3)
-    if timed_out:
-        line["timed_out"] = True
-    print(json.dumps(line), flush=True)
+        baseline = 12.0  # GiB/s/chip north-star (BASELINE.md config #2)
+        line = {
+            "metric": "rs-6-3-1mib-fused-encode-crc32c",
+            "value": round(_STATE["value"], 3),
+            "unit": "GiB/s/chip",
+            "vs_baseline": round(_STATE["value"] / baseline, 4),
+            "spread_pct": round(_STATE["spread_pct"], 1),
+        }
+        if _STATE["sustained"] is not None:
+            line["sustained_60s_gib_s"] = round(_STATE["sustained"], 3)
+        if timed_out:
+            line["timed_out"] = True
+        if error:
+            line["error"] = error
+        print(json.dumps(line), flush=True)
 
 
 def start_watchdog() -> None:
@@ -93,8 +98,6 @@ def probe_devices(timeout_s: float = 120.0):
     """Fail fast if the TPU backend is unreachable: the first backend
     call against a dead axon tunnel blocks forever, which would hang the
     whole bench run instead of erroring."""
-    import threading
-
     out: list = []
 
     def attempt():
@@ -392,10 +395,8 @@ def bench_cpp_fused(cell: int = 1024 * 1024) -> float:
 def main() -> None:
     start_watchdog()
     probe_devices()
-    enc = bench_fused_encode()
+    enc = bench_fused_encode()  # record=True keeps _STATE current
     value = enc["median"]
-    _STATE["value"] = value
-    _STATE["spread_pct"] = enc["spread_pct"]
     log(f"fused RS(6,3) encode+CRC32C: median {value:.2f} GiB/s/chip "
         f"(range {enc['min']:.2f}-{enc['best']:.2f})")
 
@@ -458,7 +459,9 @@ def main() -> None:
 if __name__ == "__main__":
     try:
         main()
+    except SystemExit:
+        raise  # deliberate exits (probe failure) keep their code
     except BaseException as e:  # noqa: BLE001 - the line must ship
         log(f"bench failed: {e!r}")
-        emit_line(timed_out=False)
+        emit_line(error=repr(e))
         sys.exit(0 if _STATE["value"] > 0 else 2)
