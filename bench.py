@@ -110,6 +110,7 @@ def probe_devices(timeout_s: float = 120.0):
     t.join(timeout=timeout_s)
     if not out:
         log(f"device backend unreachable after {timeout_s}s; aborting")
+        emit_line(error="device backend unreachable")
         sys.exit(2)
     log(f"devices: {out[0]}")
 

@@ -940,6 +940,7 @@ class ScmOmDaemon:
         # services on the Ratis leader only); the SCM scan rides the same
         # loop in HA mode so it obeys the same leadership gate.
         self._om_bg_stop = threading.Event()
+        self._om_bg_ticks = 0
 
         def _om_services():
             while not self._om_bg_stop.wait(self._bg_interval):
@@ -962,6 +963,14 @@ class ScmOmDaemon:
                         self.scm.run_background_once()
                     self.om.run_dir_deleting_service_once()
                     self.om.run_key_deleting_service_once()
+                    # slow-cadence sweeps (reference OpenKeyCleanupService
+                    # / MultipartUploadCleanupService / ExpiredTokenRemover
+                    # run on multi-minute schedules): every ~60 ticks
+                    self._om_bg_ticks += 1
+                    if self._om_bg_ticks % 60 == 0:
+                        self.om.run_open_key_cleanup_once()
+                        self.om.run_mpu_cleanup_once()
+                        self.om.run_dtoken_cleanup_once()
                     now = time.monotonic()
                     if self.recon is not None and \
                             now - self._recon_last >= self._recon_interval:

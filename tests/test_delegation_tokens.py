@@ -222,3 +222,28 @@ def test_canonical_signature_stability():
     a = dtokens.canonical(ident)
     b = dtokens.canonical(dict(ident, sig="x", junk="y"))
     assert a == b
+
+
+def test_daemon_background_sweeps_expired_tokens(tmp_path):
+    """The daemon's slow-cadence background pass purges expired tokens
+    and stale open sessions (ExpiredTokenRemover / OpenKeyCleanupService
+    scheduling)."""
+    from ozone_tpu.net.daemons import ScmOmDaemon
+
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1e6,
+                       dead_after_s=2e6, background_interval_s=0.02)
+    meta.start()
+    try:
+        om = meta.om
+        om.dtoken_renew_interval_s = 0.05
+        om.dtoken_max_lifetime_s = 0.05
+        tok = om.get_delegation_token("yarn")
+        time.sleep(0.2)  # expired now; sweep fires every ~60 ticks
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if om.store.get("delegation_tokens", tok["token_id"]) is None:
+                break
+            time.sleep(0.1)
+        assert om.store.get("delegation_tokens", tok["token_id"]) is None
+    finally:
+        meta.stop()
