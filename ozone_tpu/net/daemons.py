@@ -225,7 +225,8 @@ class DatanodeDaemon:
         self.trace_exporter.start()
         self._rejoin_pipelines()
         self.scm.register(self.dn.id, self.address, rack=self.rack,
-                          op_state=self._op_state)
+                          op_state=self._op_state,
+                          capacity_bytes=self._capacity_bytes())
         self._sync_security()
         self._hb = threading.Thread(
             target=self._heartbeat_loop, name=f"hb-{self.dn.id}", daemon=True
@@ -349,6 +350,29 @@ class DatanodeDaemon:
             ignore_errors=True,
         )
 
+    def _capacity_bytes(self) -> int:
+        """Filesystem capacity across healthy volumes (the reference's
+        StorageLocationReport capacity from df) — feeds the SCM node
+        table's usage columns and the capacity placement policy."""
+        import shutil
+
+        total = 0
+        seen_devices = set()
+        for v in self.dn.volumes:
+            if v.failed:
+                continue
+            try:
+                dev = v.root.stat().st_dev
+                if dev in seen_devices:
+                    # vol dirs sharing one filesystem (the common dev/
+                    # test layout) must not multiply-count the disk
+                    continue
+                seen_devices.add(dev)
+                total += shutil.disk_usage(v.root).total
+            except OSError:
+                pass
+        return total
+
     def heartbeat_once(self) -> None:
         # full container reports only on change or every
         # full_report_every_s (the reference's ICR-on-change +
@@ -426,7 +450,8 @@ class DatanodeDaemon:
                 self._replicate(cmd)
             elif isinstance(cmd, dict) and cmd.get("type") == "register":
                 self.scm.register(self.dn.id, self.address, rack=self.rack,
-                                  op_state=self._op_state)
+                                  op_state=self._op_state,
+                                  capacity_bytes=self._capacity_bytes())
             elif isinstance(cmd, dict) and cmd.get("type") == "set-op-state":
                 self._set_op_state(cmd.get("op_state"))
             elif isinstance(cmd, dict) and cmd.get("type") == "join-pipeline":
@@ -958,6 +983,9 @@ class ScmOmDaemon:
                         log.exception("raft log compaction failed")
                 if self.ha is not None and not self.ha.is_leader:
                     continue
+                # tick first: a persistently failing fast service must
+                # not starve the slow-cadence sweeps below
+                self._om_bg_ticks += 1
                 try:
                     if self.ha is not None:
                         self.scm.run_background_once()
@@ -966,7 +994,6 @@ class ScmOmDaemon:
                     # slow-cadence sweeps (reference OpenKeyCleanupService
                     # / MultipartUploadCleanupService / ExpiredTokenRemover
                     # run on multi-minute schedules): every ~60 ticks
-                    self._om_bg_ticks += 1
                     if self._om_bg_ticks % 60 == 0:
                         self.om.run_open_key_cleanup_once()
                         self.om.run_mpu_cleanup_once()

@@ -348,6 +348,14 @@ class ScmGrpcService:
                         "rack": n.rack,
                         "state": n.state.value,
                         "op_state": n.op_state.value,
+                        # usage columns (ozone admin datanode usageinfo):
+                        "capacity_bytes": n.capacity_bytes,
+                        "used_bytes": n.used_bytes,
+                        "used_pct": round(
+                            100.0 * n.used_bytes / n.capacity_bytes, 2)
+                        if n.capacity_bytes else None,
+                        "healthy_volumes": n.healthy_volumes,
+                        "layout_version": n.layout_version,
                     }
                     for n in self.scm.nodes.nodes()
                 ],

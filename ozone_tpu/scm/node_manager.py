@@ -106,7 +106,15 @@ class NodeManager:
                 self._commands.setdefault(dn_id, [])
                 is_new = True
             else:
-                self._nodes[dn_id].last_heartbeat = self.clock()
+                n = self._nodes[dn_id]
+                n.last_heartbeat = self.clock()
+                # re-registration refreshes what the node reports (the
+                # reference re-reads StorageLocationReport): a restart
+                # after disk loss/resize must not leave stale capacity
+                # feeding the usage columns and capacity placement
+                if capacity_bytes:
+                    n.capacity_bytes = capacity_bytes
+                n.rack = rack
         if is_new:
             self.events.publish(NEW_NODE, dn_id)
 

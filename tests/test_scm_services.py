@@ -229,3 +229,39 @@ def test_admin_close_pipeline(tmp_path):
         assert False, "expected INVALID"
     except StorageError as e:
         assert e.code == "INVALID"
+
+
+def test_status_reports_node_usage_columns(tmp_path):
+    """admin datanode/status usage columns (ozone admin datanode
+    usageinfo analog): capacity from the daemon's df, used bytes and
+    healthy-volume count from heartbeats."""
+    import time as _time
+
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1e6,
+                       dead_after_s=2e6)
+    meta.start()
+    d = DatanodeDaemon(tmp_path / "dn0", "dn0", meta.address,
+                       heartbeat_interval_s=0.1)
+    d.start()
+    try:
+        deadline = _time.time() + 10
+        row = None
+        while _time.time() < deadline:
+            nodes = GrpcScmClient(meta.address).status()["nodes"]
+            if (nodes and nodes[0].get("capacity_bytes", 0) > 0
+                    and nodes[0].get("healthy_volumes", -1) >= 1):
+                row = nodes[0]
+                break
+            _time.sleep(0.2)
+        assert row is not None, "capacity never reported"
+        assert row["dn_id"] == "dn0"
+        assert row["capacity_bytes"] > 0
+        assert row["used_pct"] is not None
+        assert row["healthy_volumes"] >= 1
+        assert row["layout_version"] >= 0
+    finally:
+        d.stop()
+        meta.stop()
