@@ -537,6 +537,26 @@ class S3Gateway:
         if method == "GET" and "uploads" in q:
             self._list_uploads(h, bucket, q)
             return
+        # subresources the store does not implement answer the AWS way
+        # (501 NotImplemented, like the reference's unsupported-feature
+        # responses) instead of falling through to bucket create/list —
+        # a silent 200 would make `aws s3api put-bucket-lifecycle`
+        # look like it took effect
+        for sub in ("lifecycle", "policy", "website", "cors",
+                    "replication", "encryption", "accelerate",
+                    "requestPayment", "logging", "notification",
+                    "inventory", "analytics", "metrics", "intelligent-tiering",
+                    "ownershipControls", "publicAccessBlock"):
+            if sub in q:
+                if method in ("PUT", "POST", "DELETE"):
+                    # drain BEFORE any raising call, or an early 404
+                    # leaves body bytes on a keep-alive socket
+                    h._body()
+                om.bucket_info(self._vol, bucket)  # NoSuchBucket -> 404
+                h._reply(*_err(
+                    "NotImplemented",
+                    f"bucket {sub} is not supported", 501))
+                return
         if method == "PUT":
             try:
                 om.create_bucket(self._vol, bucket, self.replication)

@@ -112,8 +112,11 @@ class NodeManager:
                 # reference re-reads StorageLocationReport): a restart
                 # after disk loss/resize must not leave stale capacity
                 # feeding the usage columns and capacity placement
-                if capacity_bytes:
-                    n.capacity_bytes = capacity_bytes
+                # 0 is a real report (all disks gone/unreadable), not
+                # an omission: register() callers that don't track
+                # capacity pass the default 0 only at CREATE time, and
+                # a restart after disk loss must not keep stale numbers
+                n.capacity_bytes = capacity_bytes
                 n.rack = rack
         if is_new:
             self.events.publish(NEW_NODE, dn_id)

@@ -188,3 +188,84 @@ def test_dn_raft_chaos_pipeline_member_restarts(tmp_path, seed):
         for d in dns.values():
             d.stop()
         meta.stop()
+
+
+@pytest.mark.parametrize("seed", [23])
+def test_leadership_transfers_under_write_load(tmp_path, seed):
+    """Planned hand-offs interleaved with writes: every ACKED write
+    survives repeated `ring transfer` round-robin across the replicas,
+    and the ring always converges back to one leader."""
+    from ozone_tpu.net.scm_service import GrpcScmClient
+
+    rng = random.Random(seed)
+    ports = _free_ports(N_META)
+    peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(N_META)}
+    metas = {}
+    dns = []
+    stop = threading.Event()
+    acked: list[str] = []
+    write_errors: list[Exception] = []
+    try:
+        for i in range(N_META):
+            d = _make_meta(tmp_path, i, peers)
+            d.start()
+            metas[f"m{i}"] = d
+        _await_leader(metas)
+        scm_addrs = ",".join(peers.values())
+        for i in range(5):
+            d = DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}", scm_addrs,
+                               heartbeat_interval_s=0.15)
+            d.start()
+            dns.append(d)
+        oz = _client(peers)
+        oz.create_volume("v")
+        bucket = oz.get_volume("v").create_bucket(
+            "b", replication="rs-3-2-4096")
+        payload = np.random.default_rng(seed).integers(
+            0, 256, 40_000, dtype=np.uint8).tobytes()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                key = f"k{i}"
+                try:
+                    bucket.write_key(key, payload)
+                    acked.append(key)
+                except StorageError:
+                    pass  # mid-transfer refusals retry as new keys
+                except Exception as e:  # noqa: BLE001
+                    write_errors.append(e)
+                i += 1
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        transfers = 0
+        deadline = time.time() + 12
+        while time.time() < deadline:
+            leader = _await_leader(metas, timeout=15.0)
+            target = rng.choice([m for m in peers if m != leader])
+            scm = GrpcScmClient(peers[leader])
+            try:
+                out = scm.admin("ring-transfer", target)
+                if out.get("transferred"):
+                    transfers += 1
+            except StorageError:
+                pass  # leadership raced; next loop re-resolves
+            finally:
+                scm.close()
+            time.sleep(1.0)
+        stop.set()
+        t.join(timeout=30)
+        assert transfers >= 3, f"only {transfers} transfers completed"
+        assert not write_errors, write_errors[:3]
+        assert len(acked) > 0
+        _await_leader(metas, timeout=15.0)
+        # EVERY acked write is readable after all the hand-offs
+        for key in acked:
+            assert bucket.read_key(key).tobytes() == payload, key
+    finally:
+        stop.set()
+        for d in dns:
+            d.stop()
+        for d in metas.values():
+            d.stop()

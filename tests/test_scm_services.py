@@ -249,8 +249,9 @@ def test_status_reports_node_usage_columns(tmp_path):
     try:
         deadline = _time.time() + 10
         row = None
+        scm_c = GrpcScmClient(meta.address)
         while _time.time() < deadline:
-            nodes = GrpcScmClient(meta.address).status()["nodes"]
+            nodes = scm_c.status()["nodes"]
             if (nodes and nodes[0].get("capacity_bytes", 0) > 0
                     and nodes[0].get("healthy_volumes", -1) >= 1):
                 row = nodes[0]
@@ -263,5 +264,6 @@ def test_status_reports_node_usage_columns(tmp_path):
         assert row["healthy_volumes"] >= 1
         assert row["layout_version"] >= 0
     finally:
+        scm_c.close()
         d.stop()
         meta.stop()
