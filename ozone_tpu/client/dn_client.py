@@ -105,10 +105,12 @@ class DatanodeClient(Protocol):
     def close_container(self, container_id: int) -> None: ...
     def delete_container(self, container_id: int, force: bool = False) -> None: ...
     def write_chunk(self, block_id: BlockID, info: ChunkInfo, data,
-                    sync: bool = False) -> None: ...
+                    sync: bool = False,
+                    writer: Optional[str] = None) -> None: ...
     def read_chunk(self, block_id: BlockID, info: ChunkInfo,
                    verify: bool = False) -> np.ndarray: ...
-    def put_block(self, block: BlockData, sync: bool = False) -> None: ...
+    def put_block(self, block: BlockData, sync: bool = False,
+                  writer: Optional[str] = None) -> None: ...
     def get_block(self, block_id: BlockID) -> BlockData: ...
     def list_blocks(self, container_id: int) -> list[BlockData]: ...
     def get_committed_block_length(self, block_id: BlockID) -> int: ...
@@ -152,14 +154,14 @@ class LocalDatanodeClient:
     def delete_container(self, container_id, force=False):
         self.dn.delete_container(container_id, force)
 
-    def write_chunk(self, block_id, info, data, sync=False):
-        self.dn.write_chunk(block_id, info, data, sync)
+    def write_chunk(self, block_id, info, data, sync=False, writer=None):
+        self.dn.write_chunk(block_id, info, data, sync, writer=writer)
 
     def read_chunk(self, block_id, info, verify=False):
         return self.dn.read_chunk(block_id, info, verify)
 
-    def put_block(self, block, sync=False):
-        self.dn.put_block(block, sync)
+    def put_block(self, block, sync=False, writer=None):
+        self.dn.put_block(block, sync, writer=writer)
 
     def get_block(self, block_id):
         return self.dn.get_block(block_id)

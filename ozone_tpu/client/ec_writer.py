@@ -21,6 +21,7 @@ axis), with per-chunk checksums coming back from the same pass.
 from __future__ import annotations
 
 import logging
+import uuid
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -223,6 +224,11 @@ class ECKeyWriter:
         self._groups: list[BlockGroup] = []
         self._group: Optional[BlockGroup] = None
         self._group_chunks: list[list[ChunkInfo]] = []  # per unit
+        # datanode write-fence identity (one per logical key write):
+        # every unit stream of this writer carries it, so a duplicate
+        # (container, local_id) from another key can never interleave
+        # with ours on the datanode (Container.bind_writer)
+        self._writer_id = uuid.uuid4().hex
         self._containers_created = False
         self._excluded: list[str] = []
         self._excluded_containers: list[int] = []
@@ -371,7 +377,8 @@ class ECKeyWriter:
             )
             try:
                 self.clients.get(group.pipeline.nodes[u]).write_chunk(
-                    group.block_id, info, cell_data[:length]
+                    group.block_id, info, cell_data[:length],
+                    writer=self._writer_id,
                 )
                 return u, info, None
             except (StorageError, KeyError, OSError) as e:
@@ -421,7 +428,8 @@ class ECKeyWriter:
         def put_unit(entry):
             dn_id, bd = entry
             try:
-                self.clients.get(dn_id).put_block(bd)
+                self.clients.get(dn_id).put_block(
+                    bd, writer=self._writer_id)
                 return None
             except (StorageError, KeyError, OSError) as e:
                 return dn_id, e

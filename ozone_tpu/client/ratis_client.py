@@ -190,7 +190,8 @@ class RatisKeyWriter(ReplicatedKeyWriter):
             **tok,
         })
         bd = BlockData(group.block_id, [*self._chunks, info])
-        out = x.submit({"verb": "put_block", "block": bd.to_json(), **tok})
+        out = x.submit({"verb": "put_block", "block": bd.to_json(),
+                        "writer": self._writer_id, **tok})
         self._last_index = int(out.get("index", 0))
 
     def _finalize_group(self) -> None:

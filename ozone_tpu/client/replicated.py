@@ -14,6 +14,7 @@ nearest-replica reads.
 from __future__ import annotations
 
 import logging
+import uuid
 from typing import Callable, Optional
 
 import numpy as np
@@ -62,6 +63,9 @@ class ReplicatedKeyWriter:
         #: (reference ExcludeList container ids)
         self._excluded_containers: list[int] = []
         self._closed = False
+        # datanode write-fence identity (Container.bind_writer): one per
+        # logical key write, shared by the chunk fan-out and putBlock
+        self._writer_id = uuid.uuid4().hex
 
     def write(self, data) -> None:
         if self._closed:
@@ -109,7 +113,7 @@ class ReplicatedKeyWriter:
         fan-out putBlock here; the Raft path orders this via the leader."""
         bd = BlockData(group.block_id, [*self._chunks, info])
         for dn_id in group.pipeline.nodes:
-            self.clients.get(dn_id).put_block(bd)
+            self.clients.get(dn_id).put_block(bd, writer=self._writer_id)
 
     def _flush_chunk(self) -> None:
         if self._buf_fill == 0:
@@ -143,7 +147,9 @@ class ReplicatedKeyWriter:
             err: Optional[Exception] = None
             for dn_id in group.pipeline.nodes:
                 try:
-                    self.clients.get(dn_id).write_chunk(group.block_id, info, data)
+                    self.clients.get(dn_id).write_chunk(
+                        group.block_id, info, data,
+                        writer=self._writer_id)
                 except StorageError as e:
                     err = e
                     if e.code == "INVALID_CONTAINER_STATE":

@@ -1024,12 +1024,16 @@ class RaftNode:
                     self._last_heartbeat = time.monotonic()
                     if self._timer_thread:
                         self._election_deadline = self._new_deadline()
-                    if req.get("leadership_transfer"):
-                        # advisory: the sanctioned candidate we just
-                        # voted for is about to lead; only a GRANTED
-                        # vote may move the hint, or a losing candidate
-                        # would misdirect failover clients
-                        self.leader_hint = req["candidate_id"]
+                    if req.get("leadership_transfer") and \
+                            self.leader_hint != req["candidate_id"]:
+                        # the old leader sanctioned this election and is
+                        # abdicating, so our current hint is going stale —
+                        # but the candidate has NOT won yet (a competing
+                        # higher term may still beat it), so advertising
+                        # it could misdirect failover clients for a full
+                        # heartbeat. Clear the hint; the real winner's
+                        # first append_entries sets it authoritatively.
+                        self.leader_hint = None
             return {"term": self.storage.term, "granted": granted}
 
     def handle_append_entries(self, req: dict) -> dict:

@@ -8,10 +8,16 @@ Buckets live in the designated "s3v" volume like the reference's S3
 volume mapping. Multipart uploads store parts as hidden keys and stitch
 them on complete (the reference tracks parts in OM's multipartInfo table).
 
-Auth: requests are accepted without signature validation (the reference
-forwards AWS V4 signatures to the OM for validation — hook point kept in
-_authenticate), suitable for the in-framework gateway; the wire protocol
-(paths, query verbs, XML bodies, ETags) follows S3.
+Auth (_authenticate, enforced when require_auth=True): full AWS SigV4
+verification against the OM's s3-secret table — header-auth and
+presigned-URL query-auth, including aws-chunked payload signatures
+(STREAMING-AWS4-HMAC-SHA256-PAYLOAD chunk-by-chunk) — the role the
+reference's AWSSignatureProcessor + OM S3 secret validation play.
+Anonymous access is allowed only where a public bucket ACL grants it
+(see _authorize_anonymous: GET/HEAD under a bucket ACL exposing READ,
+never mutations) or when require_auth=False (in-framework/test mode,
+where requests without credentials run as the gateway identity). The
+wire protocol (paths, query verbs, XML bodies, ETags) follows S3.
 """
 
 from __future__ import annotations
@@ -80,19 +86,35 @@ def _opaque_token(key: str) -> str:
 
     raw = key.encode()
     tag = zlib.crc32(raw).to_bytes(4, "big")
-    return "t1:" + base64.urlsafe_b64encode(tag + raw).decode()
+    return "t2:" + base64.urlsafe_b64encode(tag + raw).decode()
 
 
 def _parse_token(token: str) -> str:
     import base64
     import zlib
 
-    if token.startswith("t1:"):
+    if token.startswith("t2:"):
+        # current format: CRC32 tag + key
         try:
             blob = base64.urlsafe_b64decode(token[3:])
             if (len(blob) >= 4
                     and zlib.crc32(blob[4:]).to_bytes(4, "big") == blob[:4]):
                 return blob[4:].decode()
+        except Exception:  # noqa: BLE001 - malformed: treat as raw
+            pass
+    elif token.startswith("t1:"):
+        # legacy in-flight tokens: the t1 prefix shipped in TWO shapes
+        # (tag-less, then CRC-tagged in place — the in-place change is
+        # why the current format is t2). Try the tagged shape first
+        # (what the immediately-previous release emitted), then the
+        # original tag-less decode; an upgraded gateway mis-parsing an
+        # old token would silently resume a listing from a wrong key.
+        try:
+            blob = base64.urlsafe_b64decode(token[3:])
+            if (len(blob) >= 4
+                    and zlib.crc32(blob[4:]).to_bytes(4, "big") == blob[:4]):
+                return blob[4:].decode()
+            return blob.decode()
         except Exception:  # noqa: BLE001 - malformed: treat as raw
             pass
     return token  # raw keys from older clients / start-after reuse

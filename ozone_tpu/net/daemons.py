@@ -411,8 +411,30 @@ class DatanodeDaemon:
         while not self._stop.wait(self.heartbeat_interval):
             try:
                 self.heartbeat_once()
+                self._drain_scan_requests()
             except Exception:
                 log.exception("%s heartbeat failed", self.dn.id)
+
+    def _drain_scan_requests(self) -> None:
+        """On-demand verification scans (OnDemandContainerDataScanner
+        trigger-on-error analog): a write-fence violation or read error
+        queued the container; scrub it as soon as it is writer-free (an
+        OPEN replica's in-flight chunks would read torn, so those stay
+        queued until the container closes)."""
+        from ozone_tpu.storage.scrubber import SCANNABLE_STATES
+
+        for cid in self.dn.pop_scan_requests():
+            try:
+                c = self.dn.get_container(cid)
+            except StorageError:
+                continue  # deleted since the trigger
+            if c.state not in SCANNABLE_STATES:
+                self.dn.request_scan(cid)  # not writer-free yet: retry
+                continue
+            errs = self._scrubber.scrub_container(self.dn, cid)
+            if errs:
+                log.warning("%s: on-demand scan of container %d found: %s",
+                            self.dn.id, cid, errs[:4])
 
     def _learn_addresses(self, addresses: dict[str, str]) -> None:
         for dn_id, addr in addresses.items():

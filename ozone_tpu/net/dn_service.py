@@ -149,7 +149,8 @@ class DatanodeGrpcService:
                 )
                 self.dn.write_chunk(
                     block_id, info,
-                    np.frombuffer(part, dtype=np.uint8), sync=sync)
+                    np.frombuffer(part, dtype=np.uint8), sync=sync,
+                    writer=header.get("writer"))
                 chunks.append(info)
                 offset += len(part)
 
@@ -158,7 +159,7 @@ class DatanodeGrpcService:
             flush(final=False)
         flush(final=True)
         bd = BlockData(block_id, chunks)
-        self.dn.put_block(bd, sync=sync)
+        self.dn.put_block(bd, sync=sync, writer=header.get("writer"))
         return wire.pack({"block": bd.to_json()})
 
     def _create_container(self, req: bytes) -> bytes:
@@ -191,6 +192,7 @@ class DatanodeGrpcService:
             ChunkInfo.from_json(m["chunk"]),
             wire.payload_array(payload),
             sync=m.get("sync", False),
+            writer=m.get("writer"),
         )
         return wire.pack({})
 
@@ -247,7 +249,8 @@ class DatanodeGrpcService:
         m, _ = wire.unpack(req)
         bd = BlockData.from_json(m["block"])
         self._require_block(m, "WRITE", bd.block_id)
-        self.dn.put_block(bd, sync=m.get("sync", False))
+        self.dn.put_block(bd, sync=m.get("sync", False),
+                          writer=m.get("writer"))
         return wire.pack({})
 
     def _get_block(self, req: bytes) -> bytes:
@@ -327,23 +330,23 @@ class GrpcDatanodeClient:
                                        "force": force,
                                        **self._ctok(container_id)})
 
-    def write_chunk(self, block_id, info, data, sync=False):
+    def write_chunk(self, block_id, info, data, sync=False,
+                    writer=None):
         arr = np.asarray(
             np.frombuffer(data, dtype=np.uint8)
             if isinstance(data, (bytes, bytearray))
             else data,
             dtype=np.uint8,
         )
-        self._call(
-            "WriteChunk",
-            {
-                "block_id": block_id.to_json(),
-                "chunk": info.to_json(),
-                "sync": sync,
-                **self._btok(block_id),
-            },
-            arr,
-        )
+        m = {
+            "block_id": block_id.to_json(),
+            "chunk": info.to_json(),
+            "sync": sync,
+            **self._btok(block_id),
+        }
+        if writer is not None:
+            m["writer"] = writer
+        self._call("WriteChunk", m, arr)
 
     def read_chunk(self, block_id, info, verify=False):
         _, payload = self._call(
@@ -357,9 +360,12 @@ class GrpcDatanodeClient:
         )
         return wire.payload_array(payload).copy()
 
-    def put_block(self, block, sync=False):
-        self._call("PutBlock", {"block": block.to_json(), "sync": sync,
-                                **self._btok(block.block_id)})
+    def put_block(self, block, sync=False, writer=None):
+        m = {"block": block.to_json(), "sync": sync,
+             **self._btok(block.block_id)}
+        if writer is not None:
+            m["writer"] = writer
+        self._call("PutBlock", m)
 
     def get_block(self, block_id):
         m, _ = self._call("GetBlock", {"block_id": block_id.to_json(),

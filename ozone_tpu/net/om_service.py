@@ -338,14 +338,17 @@ class OmGrpcService:
         AUTHENTICATES the identity (verified signature + live server row,
         the reference's token-auth path); the plain _user/_groups fields
         are the trusted-transport identity assertion and are IGNORED when
-        a token is present so a stolen field can't outrank a token."""
+        a token is present so a stolen field can't outrank a token. The
+        third element records token-authentication so the OM can refuse
+        GetDelegationToken to token-authenticated callers (a holder
+        minting fresh tokens forever would defeat max_date)."""
         tok = m.pop("_dtoken", None)
         user = m.pop("_user", None)
         groups = m.pop("_groups", ())
         if tok is not None:
             row = self.om.verify_delegation_token(tok)  # raises OMError
-            return row["owner"], ()
-        return user, groups
+            return row["owner"], (), True
+        return user, groups, False
 
     def _wrap(self, fn):
         def method(req: bytes) -> bytes:
@@ -353,8 +356,9 @@ class OmGrpcService:
             try:
                 # bind the remote caller identity for ACL checks (the
                 # reference carries UGI identity on every OM RPC)
-                user, groups = self._identity(m)
-                with self.om.user_context(user, groups):
+                user, groups, via_token = self._identity(m)
+                with self.om.user_context(user, groups,
+                                          via_token=via_token):
                     out = fn(m)
             except OMError as e:
                 raise StorageError(e.code, e.msg)
@@ -365,8 +369,8 @@ class OmGrpcService:
     def _open_key(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
         try:
-            user, groups = self._identity(m)
-            with self.om.user_context(user, groups):
+            user, groups, via_token = self._identity(m)
+            with self.om.user_context(user, groups, via_token=via_token):
                 s = self.om.open_key(
                     m["volume"], m["bucket"], m["key"],
                     m.get("replication"), metadata=m.get("metadata"),

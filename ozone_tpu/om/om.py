@@ -106,17 +106,20 @@ class OzoneManager:
         self._authorizer = NativeAuthorizer(self.store, superusers)
         self.acl_enabled = True
 
-    def user_context(self, user: Optional[str], groups=()):
+    def user_context(self, user: Optional[str], groups=(),
+                     via_token: bool = False):
         """Context manager binding the caller identity for ACL checks on
         this thread (gateways and the OM RPC service wrap each request;
         unbound calls run as the local superuser, like the reference's
-        in-process trusted callers)."""
+        in-process trusted callers). ``via_token`` records that the
+        identity was authenticated BY a delegation token — such callers
+        must not mint further tokens (see get_delegation_token)."""
         import contextlib
 
         @contextlib.contextmanager
         def _ctx():
             prev = getattr(self._caller, "identity", None)
-            self._caller.identity = (user, tuple(groups))
+            self._caller.identity = (user, tuple(groups), bool(via_token))
             try:
                 yield
             finally:
@@ -126,7 +129,17 @@ class OzoneManager:
 
     def current_user(self) -> tuple[Optional[str], tuple]:
         ident = getattr(self._caller, "identity", None)
-        return ident if ident else (None, ())
+        return (ident[0], ident[1]) if ident else (None, ())
+
+    def caller_token_authenticated(self) -> bool:
+        ident = getattr(self._caller, "identity", None)
+        return bool(ident and len(ident) > 2 and ident[2])
+
+    def caller_identity_bound(self) -> bool:
+        """True when a transport layer bound ANY identity for this call
+        (even an anonymous one) — distinguishes remote RPCs from
+        genuinely in-process trusted callers."""
+        return getattr(self._caller, "identity", None) is not None
 
     def check_access(self, volume: str, bucket: Optional[str],
                      key: Optional[str], right,
@@ -900,6 +913,15 @@ class OzoneManager:
         from ozone_tpu.om import dtokens
         import secrets as _secrets
 
+        if self.caller_token_authenticated():
+            # a token holder chaining fresh tokens would defeat max_date:
+            # the reference refuses issuing a delegation token to a
+            # caller that authenticated WITH one (Hadoop
+            # AbstractDelegationTokenSecretManager)
+            raise rq.OMError(
+                rq.TOKEN_ERROR,
+                "delegation token cannot be issued to a caller "
+                "authenticated by a delegation token")
         user, _ = self.current_user()
         owner = owner or user or "root"
         key = dtokens.current_key(self.store)
@@ -925,12 +947,12 @@ class OzoneManager:
     def renew_delegation_token(self, token: dict) -> float:
         """Extend the renewable expiry; only the named renewer may renew
         (the caller identity is checked inside the replicated request).
-        Identity-less callers follow the repo-wide convention that
-        unbound calls are trusted local/in-process callers (the same
-        rule user_context documents) and act as the token's renewer —
-        remote identity assertions are transport-trusted here exactly
-        like _user on every other OM verb; mTLS (utils/ca.py) is the
-        transport authentication layer."""
+        The renewer-substitution fallback is restricted to genuinely
+        in-process callers (no transport identity bound at all): a
+        remote RPC that reached us WITHOUT an authenticated identity is
+        refused instead of silently acting as the token's renewer —
+        otherwise any anonymous holder of the token file could renew to
+        max_date (advisor finding, round 3)."""
         from ozone_tpu.om import dtokens
 
         try:
@@ -938,6 +960,11 @@ class OzoneManager:
         except dtokens.DTokenError as e:
             raise rq.OMError(rq.TOKEN_ERROR, e.msg)
         user, _ = self.current_user()
+        if user is None and self.caller_identity_bound():
+            raise rq.OMError(
+                rq.TOKEN_ERROR,
+                "renewing a delegation token requires an authenticated "
+                "caller identity")
         return self.submit(rq.RenewDelegationToken(
             str(token["token_id"]), user or str(token["renewer"])))
 
@@ -949,6 +976,12 @@ class OzoneManager:
         except dtokens.DTokenError as e:
             raise rq.OMError(rq.TOKEN_ERROR, e.msg)
         user, _ = self.current_user()
+        if user is None and self.caller_identity_bound():
+            # same rule as renew: anonymous remote callers cannot cancel
+            raise rq.OMError(
+                rq.TOKEN_ERROR,
+                "cancelling a delegation token requires an "
+                "authenticated caller identity")
         self.submit(rq.CancelDelegationToken(
             str(token["token_id"]), user or str(token["owner"])))
 

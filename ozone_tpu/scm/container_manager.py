@@ -71,6 +71,16 @@ class ContainerManager:
         self._pipelines: dict[int, Pipeline] = {}
         self._next_cid = 1
         self._next_lid = 1
+        # replicated pipeline-id floor (only used in HA mode; standalone
+        # pipelines draw from the process-local allocator)
+        self._next_pid = 1
+        # SCM-HA commit-first id source (scm/sequence_id.py): when set,
+        # container/block/pipeline ids are issued ONLY from ranges the
+        # ring already committed (SequenceIdGenerator.java:52-84), so a
+        # leadership hand-off can never re-issue an id this leader
+        # exposed. When None (standalone / single process) the legacy
+        # persisted counters are the source.
+        self.id_source = None
         # open writable containers by replication-scheme string
         self._writable: dict[str, list[int]] = {}
         self._lock = threading.RLock()
@@ -151,6 +161,10 @@ class ContainerManager:
                 self._writable.setdefault(str(repl), []).append(info.id)
         self._next_cid = state["next_container_id"]
         self._next_lid = state["next_local_id"]
+        self._next_pid = max(
+            int(state.get("pipeline_floor", 1)),
+            max((p.id for p in self._pipelines.values()), default=0) + 1,
+        )
         self._node_op_states = dict(state.get("node_op_states", {}))
         self._service_states = dict(state.get("service_states", {}))
 
@@ -229,6 +243,7 @@ class ContainerManager:
                     self._row(c) for c in self._containers.values()
                 ],
                 "counters": [self._next_cid, self._next_lid],
+                "pipeline_floor": self._next_pid,
                 "service_states": {
                     k: dict(v) for k, v in self._service_states.items()
                 },
@@ -263,6 +278,12 @@ class ContainerManager:
         with self._lock:
             self._next_cid = max(self._next_cid, int(snap["counters"][0]))
             self._next_lid = max(self._next_lid, int(snap["counters"][1]))
+            self._next_pid = max(
+                self._next_pid,
+                int(snap.get("pipeline_floor", 1)),
+                max((p.id for p in self._pipelines.values()), default=0)
+                + 1,
+            )
 
     # --------------------------------------------------------------- queries
     def get(self, container_id: int) -> ContainerInfo:
@@ -278,11 +299,73 @@ class ContainerManager:
         return list(self._pipelines.values())
 
     # --------------------------------------------------------------- alloc
+    def peek_id_floor(self, kind: str) -> int:
+        """Current committed floor for an id kind — the leader reads it
+        to compose an absolute range-reservation record."""
+        with self._lock:
+            return {"container": self._next_cid,
+                    "block": self._next_lid,
+                    "pipeline": self._next_pid}[kind]
+
+    def reserve_id_range(self, kind: str, lo: int, hi: int):
+        """Deterministic apply of a commit-first range reservation
+        (SequenceIdGenerator.java allocateBatch analog). The record
+        carries ABSOLUTE bounds so re-apply (log replay over an
+        already-persisted store) is idempotent and every replica
+        converges on the identical floor. A stale record (lo below the
+        floor — the proposer raced an earlier reservation) is REJECTED
+        by returning None, deterministically on every replica; the live
+        proposer re-reads the floor and retries. NEVER emits a
+        mutation-listener record — the reservation IS the replicated
+        record."""
+        lo, hi = int(lo), int(hi)
+        if hi <= lo:
+            raise ValueError(f"bad reservation [{lo}, {hi})")
+        with self._lock:
+            if kind == "container":
+                if lo < self._next_cid:
+                    return None
+                self._next_cid = hi
+            elif kind == "block":
+                if lo < self._next_lid:
+                    return None
+                self._next_lid = hi
+            elif kind == "pipeline":
+                if lo < self._next_pid:
+                    return None
+                self._next_pid = hi
+            else:
+                raise ValueError(f"unknown id kind {kind!r}")
+            if self._db is not None:
+                self._db.save_counters(
+                    (self._next_cid, self._next_lid),
+                    pipeline_floor=self._next_pid,
+                )
+            return [lo, hi]
+
+    def _issue_block_id(self) -> int:
+        if self.id_source is not None:
+            # commit-first: may block on a ring round-trip; called
+            # OUTSIDE the container lock (apply needs that lock)
+            return self.id_source.next("block")
+        with self._lock:
+            lid = self._next_lid
+            self._next_lid += 1
+            return lid
+
     def _create_pipeline(
-        self, replication: ReplicationConfig, excluded: list[str]
+        self, replication: ReplicationConfig, excluded: list[str],
+        pipeline_id: int | None = None,
     ) -> Pipeline:
         chosen = self.placement.choose(replication.required_nodes, excluded)
-        p = Pipeline(replication, [n.dn_id for n in chosen])
+        kw = {"id": pipeline_id} if pipeline_id is not None else {}
+        p = Pipeline(replication, [n.dn_id for n in chosen], **kw)
+        if pipeline_id is not None:
+            # keep the process-local allocator ahead of ring-issued ids
+            # so locally-constructed pipelines can't collide
+            from ozone_tpu.scm.pipeline import _pipeline_ids
+
+            _pipeline_ids.advance_past(pipeline_id)
         self._pipelines[p.id] = p
         if self.on_pipeline_created is not None:
             try:
@@ -292,11 +375,15 @@ class ContainerManager:
         return p
 
     def _allocate_container(
-        self, replication: ReplicationConfig, excluded: list[str]
+        self, replication: ReplicationConfig, excluded: list[str],
+        container_id: int | None = None, pipeline_id: int | None = None,
     ) -> ContainerInfo:
-        pipe = self._create_pipeline(replication, excluded)
-        c = ContainerInfo(self._next_cid, replication, pipe)
-        self._next_cid += 1
+        pipe = self._create_pipeline(replication, excluded,
+                                     pipeline_id=pipeline_id)
+        if container_id is None:
+            container_id = self._next_cid
+            self._next_cid += 1
+        c = ContainerInfo(container_id, replication, pipe)
         self._containers[c.id] = c
         # no _persist here: allocate_block always persists the final row
         # (used_bytes + issued local id) right after
@@ -313,46 +400,70 @@ class ContainerManager:
         a new block id in it (allocateBlock -> WritableContainerFactory).
         `excluded_containers` mirrors the reference ExcludeList's
         container ids: a client that just saw CONTAINER_CLOSED must not
-        be handed the same container back before its report lands."""
+        be handed the same container back before its report lands.
+
+        HA mode (id_source set): every id is drawn from a quorum-
+        committed range BEFORE it is exposed — the reference's
+        commit-first SequenceIdGenerator model (BlockManagerImpl.java:188
+        consumes batches reserved through Raft), which makes duplicate
+        (container, local_id) issuance across a leadership hand-off
+        impossible by construction. Reservations happen OUTSIDE the
+        container lock: the ring's apply path takes that lock, so a
+        holder must never wait on a commit."""
         excluded = excluded or []
         excluded_containers = set(excluded_containers or ())
-        with self._lock:
-            key = str(replication)
-            pool = self._writable.setdefault(key, [])
-            for cid in list(pool):
-                c = self._containers.get(cid)
-                if c is None or c.state is not ContainerState.OPEN:
-                    pool.remove(cid)
-                    continue
-                if cid in excluded_containers:
-                    continue
-                if any(n in excluded for n in c.pipeline.nodes):
-                    continue
-                if c.used_bytes + block_size > self.container_size:
-                    # full: close it (reference closes via close-threshold)
-                    self.finalize_container(cid)
-                    pool.remove(cid)
-                    continue
-                c.used_bytes += block_size
-                lid = self._next_lid
-                self._next_lid += 1
-                self._persist(c)
-                return BlockGroup(
-                    container_id=cid,
-                    local_id=lid,
-                    pipeline=c.pipeline,
-                )
-            c = self._allocate_container(replication, excluded)
-            pool.append(c.id)
-            c.used_bytes += block_size
-            lid = self._next_lid
-            self._next_lid += 1
-            self._persist(c)
-            return BlockGroup(
-                container_id=c.id,
-                local_id=lid,
-                pipeline=c.pipeline,
-            )
+        lid = self._issue_block_id()
+        new_ids: Optional[tuple[int, int]] = None  # (cid, pid) pre-issued
+        while True:
+            with self._lock:
+                key = str(replication)
+                pool = self._writable.setdefault(key, [])
+                for cid in list(pool):
+                    c = self._containers.get(cid)
+                    if c is None or c.state is not ContainerState.OPEN:
+                        pool.remove(cid)
+                        continue
+                    if cid in excluded_containers:
+                        continue
+                    if any(n in excluded for n in c.pipeline.nodes):
+                        continue
+                    if c.used_bytes + block_size > self.container_size:
+                        # full: close it (reference closes via
+                        # close-threshold)
+                        self.finalize_container(cid)
+                        pool.remove(cid)
+                        continue
+                    c.used_bytes += block_size
+                    self._persist(c)
+                    if new_ids is not None and self.id_source is not None:
+                        # speculative ids unused: back to the free list
+                        # (never exposed, still unique-by-construction)
+                        self.id_source.release("container", new_ids[0])
+                        self.id_source.release("pipeline", new_ids[1])
+                    return BlockGroup(
+                        container_id=cid,
+                        local_id=lid,
+                        pipeline=c.pipeline,
+                    )
+                if self.id_source is None:
+                    c = self._allocate_container(replication, excluded)
+                elif new_ids is not None:
+                    c = self._allocate_container(
+                        replication, excluded,
+                        container_id=new_ids[0], pipeline_id=new_ids[1])
+                else:
+                    c = None  # need ids: reserve outside the lock, retry
+                if c is not None:
+                    pool.append(c.id)
+                    c.used_bytes += block_size
+                    self._persist(c)
+                    return BlockGroup(
+                        container_id=c.id,
+                        local_id=lid,
+                        pipeline=c.pipeline,
+                    )
+            new_ids = (self.id_source.next("container"),
+                       self.id_source.next("pipeline"))
 
     # --------------------------------------------------------------- lifecycle
     def _close_pipeline(self, c: ContainerInfo) -> None:

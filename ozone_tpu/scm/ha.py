@@ -259,10 +259,51 @@ class RaftSCM:
             on_step_down=self._on_step_down,
         )
         scm.containers.mutation_listener = self._on_mutation
+        # commit-first id issuance (SequenceIdGenerator.java:52-84): the
+        # container manager draws container/block/pipeline ids only from
+        # ranges this ring already committed; a hand-off invalidates the
+        # local batch, so two terms can never issue the same id — the
+        # round-3 acked-data corruption (KNOWN_ISSUES.md) is impossible
+        # by construction
+        from ozone_tpu.scm.sequence_id import SequenceIdGenerator
+
+        self.ids = SequenceIdGenerator(self._reserve_ids)
+        scm.containers.id_source = self.ids
         self._dispatcher = threading.Thread(
             target=self._dispatch_loop, daemon=True,
             name=f"scm-ha-dispatch-{scm_id}")
         self._dispatcher.start()
+
+    def _reserve_ids(self, kind: str, count: int) -> tuple[int, int]:
+        """Propose an absolute range reservation and wait for the quorum
+        commit; the applied result is the reserved [lo, hi). The
+        is_ready_leader gate matters: a just-elected leader that has not
+        applied the committed prefix could read a stale floor and compose
+        a range overlapping one already exposed — readiness (plus the
+        deterministic apply-side rejection) closes that window. Raises
+        NotRaftLeaderError when this node cannot commit — the caller's
+        allocation fails WITHOUT exposing any id, and the client retries
+        on the real leader."""
+        from ozone_tpu.consensus.raft import NotRaftLeaderError
+
+        for _ in range(8):
+            if not self.node.is_ready_leader:
+                raise NotRaftLeaderError(self.scm_id, self.node.leader_hint)
+            lo = self.scm.containers.peek_id_floor(kind)
+            result = self.node.propose(
+                {"seq_reserve": {"kind": kind, "lo": lo,
+                                 "hi": lo + int(count)}},
+                timeout=self.ack_timeout_s,
+            )
+            if isinstance(result, Exception):
+                raise result
+            if result is not None:
+                lo, hi = result
+                return int(lo), int(hi)
+            # stale floor (an earlier in-log reservation intervened):
+            # re-read and retry
+        raise TimeoutError(
+            f"id reservation for {kind!r} kept racing the floor")
 
     # ------------------------------------------------------------- leader
     def _on_mutation(self, row: dict, counters: tuple[int, int]) -> None:
@@ -337,20 +378,30 @@ class RaftSCM:
                 with self._ack_cv:
                     self._needs_resync = False
                     self._inflight.clear()
+                # state replaced wholesale: any leftover local batch is
+                # from a leadership the quorum moved past
+                self.ids.invalidate()
                 log.info("scm %s resynced from leader %s", self.scm_id, hint)
         except Exception as e:
             log.debug("scm %s resync attempt failed: %s", self.scm_id, e)
 
     def _on_step_down(self) -> None:
         """Raft callback (node lock held — flags only): unreplicated local
-        effects mean divergence; resync from the new leader."""
+        effects mean divergence; resync from the new leader. The id
+        batches die with the leadership (invalidateBatch analog) — their
+        unissued tails are burned, never re-reserved."""
+        self.ids.invalidate()
         with self._ack_cv:
             if self._inflight or not self._queue.empty():
                 self._needs_resync = True
             self._ack_cv.notify_all()
 
     # ------------------------------------------------------------- apply
-    def _apply(self, data: dict) -> None:
+    def _apply(self, data: dict):
+        if "seq_reserve" in data:
+            r = data["seq_reserve"]
+            return self.scm.containers.reserve_id_range(
+                r["kind"], int(r["lo"]), int(r["hi"]))
         rec_id = data.get("id")
         if rec_id is not None:
             with self._ack_cv:

@@ -54,6 +54,9 @@ class ScmStore:
             ops = self._conn.execute(
                 "SELECT v FROM meta WHERE k='node_op_states'"
             ).fetchone()
+            pidf = self._conn.execute(
+                "SELECT v FROM meta WHERE k='pipeline_floor'"
+            ).fetchone()
         counters = json.loads(meta[0]) if meta else [1, 1]
         with self._lock:
             svc = self._conn.execute(
@@ -63,6 +66,7 @@ class ScmStore:
             "containers": [json.loads(r[0]) for r in rows],
             "next_container_id": counters[0],
             "next_local_id": counters[1],
+            "pipeline_floor": json.loads(pidf[0]) if pidf else 1,
             "node_op_states": json.loads(ops[0]) if ops else {},
             "service_states": json.loads(svc[0]) if svc else {},
         }
@@ -91,6 +95,26 @@ class ScmStore:
                 "INSERT OR REPLACE INTO meta VALUES ('service_states', ?)",
                 (json.dumps(states),),
             )
+            self._conn.commit()
+
+    def save_counters(self, counters: tuple[int, int],
+                      pipeline_floor: int | None = None) -> None:
+        """Durably raise the id floors WITHOUT a container row — the
+        commit-first range reservations (SequenceIdGenerator analog,
+        server-scm ha/SequenceIdGenerator.java:52-84) persist their
+        raised floor the moment the record applies, so a restart can
+        never re-issue an id from a range already handed to a leader."""
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta VALUES ('counters', ?)",
+                (json.dumps(list(counters)),),
+            )
+            if pipeline_floor is not None:
+                self._conn.execute(
+                    "INSERT OR REPLACE INTO meta VALUES "
+                    "('pipeline_floor', ?)",
+                    (json.dumps(int(pipeline_floor)),),
+                )
             self._conn.commit()
 
     def save_node_op_state(self, dn_id: str, state: str) -> None:

@@ -20,6 +20,7 @@ from typing import Iterator, Optional
 
 from ozone_tpu.storage.chunk_store import FilePerBlockStore
 from ozone_tpu.storage.ids import (
+    BLOCK_WRITE_CONFLICT,
     CONTAINER_EXISTS,
     CONTAINER_NOT_FOUND,
     INVALID_CONTAINER_STATE,
@@ -125,6 +126,14 @@ class Container:
         self.chunks = FilePerBlockStore(self.root / "chunks",
                                         readonly=readonly)
         self._lock = threading.RLock()
+        # write fence (ChunkUtils.validateChunkForOverwrite analog,
+        # keyvalue/helpers/ChunkUtils.java:285-312): first identified
+        # writer to touch a block file owns it until the block is
+        # deleted; a DIFFERENT writer's stream is refused instead of
+        # interleaving two keys' bytes in one chunk file. In-memory by
+        # design — the commit-first SCM allocator is the primary
+        # guarantee, this is defense in depth within one process life.
+        self._block_writers: dict[int, str] = {}
 
     # -- descriptor (ContainerDataYaml analog) --
     def _descriptor_path(self) -> Path:
@@ -180,11 +189,34 @@ class Container:
                 )
             self.state = ContainerState.CLOSED
             self.save_descriptor()
+            # no more writes can land: reclaim the fence map
+            self._block_writers.clear()
 
     def mark_unhealthy(self) -> None:
         with self._lock:
             self.state = ContainerState.UNHEALTHY
             self.save_descriptor()
+
+    def bind_writer(self, block_id: BlockID, writer: Optional[str]) -> None:
+        """Enforce single-writer ownership of a block file. Anonymous
+        callers (writer=None: repair/replication/offline tools) bypass
+        the fence — every client write path supplies an identity."""
+        if writer is None:
+            return
+        with self._lock:
+            cur = self._block_writers.get(block_id.local_id)
+            if cur is None:
+                self._block_writers[block_id.local_id] = writer
+            elif cur != writer:
+                raise StorageError(
+                    BLOCK_WRITE_CONFLICT,
+                    f"{block_id} is being written by {cur!r}; refusing "
+                    f"interleaved stream from {writer!r}",
+                )
+
+    def release_writer(self, block_id: BlockID) -> None:
+        with self._lock:
+            self._block_writers.pop(block_id.local_id, None)
 
     # -- block ops --
     def put_block(self, block: BlockData) -> None:

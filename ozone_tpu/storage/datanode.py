@@ -60,6 +60,7 @@ class Datanode:
         self.metrics = MetricsRegistry(f"datanode.{dn_id}")
         self._rr = itertools.count()
         self._lock = threading.Lock()
+        self._scan_requests: set[int] = set()
         for vol in self.volumes:
             for c in vol.load_containers():
                 self.containers.add(c)
@@ -172,13 +173,40 @@ class Datanode:
 
     # -- chunk/block verbs --
     def write_chunk(
-        self, block_id: BlockID, info: ChunkInfo, data, sync: bool = False
+        self, block_id: BlockID, info: ChunkInfo, data, sync: bool = False,
+        writer: Optional[str] = None,
     ) -> None:
         c = self.containers.get(block_id.container_id)
         c.require_writable()
+        self._fence(c, block_id, writer)
         c.chunks.write_chunk(block_id, info, data, sync=sync)
         self.mutation_count += 1
         self.metrics.counter("bytes_written").inc(info.length)
+
+    def _fence(self, container, block_id: BlockID,
+               writer: Optional[str]) -> None:
+        """Single-writer block fence (validateChunkForOverwrite analog).
+        A violation means SOMEONE attempted a duplicate-id write — the
+        refusal protects the first writer's bytes, and the container
+        gets an on-demand verification scan (the reference's
+        OnDemandContainerDataScanner trigger-on-error pattern)."""
+        try:
+            container.bind_writer(block_id, writer)
+        except StorageError:
+            self.metrics.counter("write_fence_violations").inc()
+            self.request_scan(container.id)
+            raise
+
+    # -- on-demand scan queue (drained by the daemon's scanner loop) --
+    def request_scan(self, container_id: int) -> None:
+        with self._lock:
+            self._scan_requests.add(int(container_id))
+
+    def pop_scan_requests(self) -> list[int]:
+        with self._lock:
+            out = sorted(self._scan_requests)
+            self._scan_requests.clear()
+            return out
 
     def read_chunk(
         self, block_id: BlockID, info: ChunkInfo, verify: bool = False
@@ -195,9 +223,13 @@ class Datanode:
         self.metrics.counter("bytes_read").inc(info.length)
         return data
 
-    def put_block(self, block: BlockData, sync: bool = False) -> None:
+    def put_block(self, block: BlockData, sync: bool = False,
+                  writer: Optional[str] = None) -> None:
         c = self.containers.get(block.block_id.container_id)
         c.require_writable()
+        # same fence as the data path: a foreign writer must not commit
+        # its chunk list over a block another writer owns
+        self._fence(c, block.block_id, writer)
         if sync:
             c.chunks.fsync_block(block.block_id)
         block.committed = True
@@ -218,6 +250,7 @@ class Datanode:
         c = self.containers.get(block_id.container_id)
         c.db.delete_block(block_id)
         c.chunks.delete_block(block_id)
+        c.release_writer(block_id)
         self.mutation_count += 1
 
     # -- scanners --

@@ -130,6 +130,12 @@ CLOSED_CONTAINER_IO = "CLOSED_CONTAINER_IO"
 INVALID_CONTAINER_STATE = "INVALID_CONTAINER_STATE"
 IO_EXCEPTION = "IO_EXCEPTION"
 INVALID_WRITE_SIZE = "INVALID_WRITE_SIZE"
+# a second writer tried to stream into a block file another writer owns
+# (ChunkUtils.validateChunkForOverwrite analog, ChunkUtils.java:285-312):
+# defense in depth under the commit-first SCM allocator — a duplicate
+# (container, local_id) can no longer be ISSUED, and even if one were,
+# the datanode refuses to interleave two writers' bytes
+BLOCK_WRITE_CONFLICT = "BLOCK_WRITE_CONFLICT"
 # refused block/container capability token (BlockTokenVerifier.java);
 # shared by the gRPC datapath and the Ratis submit surface
 BLOCK_TOKEN_VERIFICATION_FAILED = "BLOCK_TOKEN_VERIFICATION_FAILED"

@@ -68,7 +68,8 @@ class ContainerStateMachine:
             return self._apply_write_chunk(data)
         if verb == "put_block":
             block = BlockData.from_json(data["block"])
-            self.dn.put_block(block, sync=bool(data.get("sync", False)))
+            self.dn.put_block(block, sync=bool(data.get("sync", False)),
+                              writer=data.get("writer"))
             return {"ok": True, "committed_length": block.length}
         if verb == "close_container":
             self.dn.close_container(int(data["container_id"]))

@@ -183,11 +183,18 @@ def _sh_token(args, verb: str) -> int:
     tok = _read_token()
     if tok is None:
         return 2
-    if verb == "renew":
-        _emit({"expiry": om.renew_delegation_token(tok)})
-    elif verb == "cancel":
-        om.cancel_delegation_token(tok)
-        print("token cancelled")
+    # renew/cancel require an authenticated caller (the OM refuses
+    # anonymous remote renewals — an unauthenticated holder of the token
+    # file must not be able to extend or revoke it); the CLI's identity
+    # is the login user, same convention as `get`
+    import getpass
+
+    with om.user_context(getpass.getuser()):
+        if verb == "renew":
+            _emit({"expiry": om.renew_delegation_token(tok)})
+        elif verb == "cancel":
+            om.cancel_delegation_token(tok)
+            print("token cancelled")
     return 0
 
 

@@ -176,6 +176,21 @@ def test_token_authenticates_remote_caller(tmp_path):
             bad.volume_info("v1")
         assert e.value.code == "TOKEN_ERROR"
 
+        # a token-authenticated caller must NOT mint fresh tokens — a
+        # holder chaining tokens forever would defeat the max_date hard
+        # lifetime (Hadoop AbstractDelegationTokenSecretManager refuses
+        # exactly this)
+        with pytest.raises(StorageError) as e:
+            c.get_delegation_token("yarn")
+        assert e.value.code == "TOKEN_ERROR"
+
+        # anonymous remote renew/cancel is refused: possession of the
+        # token file alone must not extend or revoke it
+        anon2 = GrpcOmClient(meta.address)
+        with anon2.user_context(None):
+            with pytest.raises(StorageError):
+                anon2.renew_delegation_token(tok)
+
         # remote renew/cancel round-trip
         yarn = GrpcOmClient(meta.address)
         with yarn.user_context("yarn"):
@@ -197,6 +212,8 @@ def test_cli_token_verbs(tmp_path, capsys):
                        dead_after_s=2e6)
     meta.start()
     try:
+        import getpass
+
         tf = tmp_path / "tok.json"
         assert main(["sh", "token", "get", "--om", meta.address,
                      "--renewer", "yarn", "--token", str(tf)]) == 0
@@ -205,12 +222,22 @@ def test_cli_token_verbs(tmp_path, capsys):
         assert main(["sh", "token", "print", "--token", str(tf)]) == 0
         out = capsys.readouterr().out
         assert "yarn" in out
+        # renew/cancel act as the login user: only a token naming that
+        # user as renewer may be renewed (anonymous remote renewal is
+        # refused by the OM since round 4)
         assert main(["sh", "token", "renew", "--om", meta.address,
-                     "--token", str(tf)]) == 0
+                     "--token", str(tf)]) != 0
+        me = getpass.getuser()
+        tf2 = tmp_path / "tok2.json"
+        assert main(["sh", "token", "get", "--om", meta.address,
+                     "--renewer", me, "--token", str(tf2)]) == 0
+        tok2 = json.loads(tf2.read_text())
+        assert main(["sh", "token", "renew", "--om", meta.address,
+                     "--token", str(tf2)]) == 0
         assert main(["sh", "token", "cancel", "--om", meta.address,
-                     "--token", str(tf)]) == 0
+                     "--token", str(tf2)]) == 0
         assert meta.om.store.get(
-            "delegation_tokens", tok["token_id"]) is None
+            "delegation_tokens", tok2["token_id"]) is None
     finally:
         meta.stop()
 

@@ -10,6 +10,8 @@ all-replica watch watermarks, and leader failover mid-stream.
 
 import threading
 
+import itertools
+
 import numpy as np
 import pytest
 
@@ -104,13 +106,20 @@ def ring(tmp_path):
         dn.close()
 
 
+#: module-global so successive write_key calls never re-issue a local
+#: id — the datanode write fence (Container.bind_writer) now refuses a
+#: second writer streaming into an existing block file, which is exactly
+#: what a per-call counter restarting at 1 would do
+_alloc_count = itertools.count(1)
+
+
 def write_key(dns, xceivers, pipeline, payload, **kw):
     clients = DatanodeClientFactory()
     ratis = RatisClientFactory()
     for dn, xc in zip(dns, xceivers):
         clients.register_local(dn)
         ratis.register_local(xc, dn.id)
-    alloc_count = iter(range(1, 100))
+    alloc_count = _alloc_count
 
     def allocate_group(excluded):
         assert not set(pipeline.nodes) & set(excluded), \

@@ -185,11 +185,11 @@ class FlakyClient(LocalDatanodeClient):
         super().__init__(dn)
         self.n_failures = n_failures
 
-    def write_chunk(self, block_id, info, data, sync=False):
+    def write_chunk(self, block_id, info, data, sync=False, writer=None):
         if self.n_failures > 0:
             self.n_failures -= 1
             raise StorageError("IO_EXCEPTION", "injected failure")
-        return super().write_chunk(block_id, info, data, sync)
+        return super().write_chunk(block_id, info, data, sync, writer=writer)
 
 
 def test_write_failure_rolls_to_new_group(cluster):
@@ -233,12 +233,12 @@ class FlakyPutBlockClient(LocalDatanodeClient):
         self.fail_call = fail_call
         self.calls = 0
 
-    def put_block(self, block, sync=False):
+    def put_block(self, block, sync=False, writer=None):
         me = self.calls
         self.calls += 1
         if me == self.fail_call:
             raise StorageError("IO_EXCEPTION", "injected putBlock failure")
-        return super().put_block(block, sync)
+        return super().put_block(block, sync, writer=writer)
 
 
 def test_putblock_failure_rolls_back_survivor_commits(cluster):

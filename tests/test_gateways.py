@@ -721,12 +721,30 @@ def test_s3_list_encoding_type_url(s3):
     tree = ET.fromstring(_req(
         s3, "GET", "/encb?list-type=2&max-keys=1").read())
     tok = tree.findtext("{*}NextContinuationToken")
-    assert tok.startswith("t1:")
+    assert tok.startswith("t2:")
     tree = ET.fromstring(_req(
         s3, "GET",
         f"/encb?list-type=2&continuation-token={tok}").read())
     keys2 = [e.text for e in tree.iter() if e.tag.endswith("}Key")]
     assert keys2 and keys2 != keys[:1]
+    # an in-flight LEGACY t1 token (pre-CRC format) still resumes at the
+    # same key — the format bump to t2 exists so upgrades don't break
+    # paginated listings mid-flight
+    import base64
+
+    from ozone_tpu.gateway.s3 import _parse_token
+
+    resumed = _parse_token(tok)
+    legacy = "t1:" + base64.urlsafe_b64encode(resumed.encode()).decode()
+    assert _parse_token(legacy) == resumed
+    # ...and the CRC-tagged t1 generation (the shape the immediately
+    # previous release emitted) decodes too
+    import zlib
+
+    tagged = "t1:" + base64.urlsafe_b64encode(
+        zlib.crc32(resumed.encode()).to_bytes(4, "big")
+        + resumed.encode()).decode()
+    assert _parse_token(tagged) == resumed
     # ListMultipartUploads honors encoding-type too
     _req(s3, "POST",
          "/encb/" + urllib.parse.quote("up space") + "?uploads")

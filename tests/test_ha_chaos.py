@@ -264,11 +264,13 @@ def test_leadership_transfers_under_write_load(tmp_path, seed):
         assert not write_errors, write_errors[:3]
         assert len(acked) > 0
         # EVERY acked write is readable after all the hand-offs (the
-        # await below also asserts the ring converged to one leader); on a
-        # mismatch the assertion carries a diagnosis (first bad offset +
-        # where the foreign bytes appear in the payload, which
-        # distinguishes a cell permutation from true corruption) and the
-        # key's block-group layout
+        # await below also asserts the ring converged to one leader).
+        # HARD assertion: the round-3 duplicate-allocation corruption is
+        # fixed by commit-first id issuance (scm/sequence_id.py) + the
+        # datanode write fence (Container.bind_writer) — any mismatch
+        # here is a regression, reported with the full fingerprint
+        # (first bad offset, where the foreign bytes appear in the
+        # payload, re-read stability, block-group layout)
         leader = _await_leader(metas, timeout=15.0)
         oz_om = metas[leader].om
         for key in acked:
@@ -281,15 +283,10 @@ def test_leadership_transfers_under_write_load(tmp_path, seed):
                 src = payload.find(probe)
                 info = oz_om.lookup_key("v", "b", key)
                 again = bucket.read_key(key).tobytes()
-                # the diagnosed duplicate-allocation corruption is a
-                # KNOWN ISSUE (KNOWN_ISSUES.md); record the full
-                # fingerprint but don't fail the suite for it — every
-                # OTHER assertion in this test stays hard
-                pytest.xfail(
-                    f"KNOWN ISSUE duplicate block allocation across "
-                    f"hand-off: {key} mismatch at {idx} "
-                    f"(lens {len(got)}/{len(payload)}), foreign bytes "
-                    f"at payload[{src}]; "
+                raise AssertionError(
+                    f"acked key corrupted across hand-off: {key} "
+                    f"mismatch at {idx} (lens {len(got)}/{len(payload)}),"
+                    f" foreign bytes at payload[{src}]; "
                     f"reread_same_wrong={again == got}; groups="
                     f"{[(g['container_id'], g['local_id'], g['nodes']) for g in info['block_groups']]}")
     finally:

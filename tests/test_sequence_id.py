@@ -1,0 +1,236 @@
+"""Commit-first id issuance + datanode write fence.
+
+The round-3 corruption (KNOWN_ISSUES.md): block allocation exposed ids
+before the decision record committed, so a leadership hand-off could
+re-issue the same (container, local_id) and interleave two keys' bytes.
+These tests pin both halves of the fix:
+
+- SCM side: ids come only from quorum-committed ranges (the reference's
+  SequenceIdGenerator batch model, server-scm
+  ha/SequenceIdGenerator.java:52-84), so ids exposed by a deposed leader
+  — even ones whose container rows never replicated — are never
+  re-issued by any later term.
+- DN side: a block file is owned by its first identified writer
+  (ChunkUtils.validateChunkForOverwrite analog, ChunkUtils.java:285-312);
+  a second writer's stream or commit is refused.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from ozone_tpu.consensus.raft import InProcessTransport
+from ozone_tpu.scm.ha import RaftSCM, SCMFailoverProxy
+from ozone_tpu.scm.pipeline import ReplicationConfig
+from ozone_tpu.scm.scm import StorageContainerManager
+from ozone_tpu.scm.sequence_id import SequenceIdGenerator
+from ozone_tpu.storage.datanode import Datanode
+from ozone_tpu.storage.ids import (
+    BlockData,
+    BlockID,
+    ChunkInfo,
+    StorageError,
+)
+
+
+# --------------------------------------------------------------- generator
+def test_generator_batches_and_reuses_released_ids():
+    calls = []
+
+    def reserve(kind, count):
+        lo = 100 * (len(calls) + 1)
+        calls.append((kind, count))
+        return lo, lo + count
+
+    g = SequenceIdGenerator(reserve, batch_sizes={"block": 4})
+    ids = [g.next("block") for _ in range(4)]
+    assert ids == [100, 101, 102, 103]
+    assert calls == [("block", 4)]
+    g.release("block", 103)  # never exposed: may be reused locally
+    assert g.next("block") == 103
+    assert g.next("block") == 200  # batch exhausted -> second reservation
+    assert len(calls) == 2
+
+
+def test_generator_invalidate_burns_batch():
+    floors = [0]
+
+    def reserve(kind, count):
+        lo = floors[0]
+        floors[0] += count
+        return lo, lo + count
+
+    g = SequenceIdGenerator(reserve, batch_sizes={"block": 10})
+    assert g.next("block") == 0
+    g.invalidate()  # leadership changed: tail 1..9 is burned
+    assert g.next("block") == 10
+
+
+def test_generator_concurrent_next_unique():
+    lock = threading.Lock()
+    floors = [0]
+
+    def reserve(kind, count):
+        with lock:
+            lo = floors[0]
+            floors[0] += count
+            return lo, lo + count
+
+    g = SequenceIdGenerator(reserve, batch_sizes={"block": 8})
+    out: list[int] = []
+    out_lock = threading.Lock()
+
+    def worker():
+        mine = [g.next("block") for _ in range(50)]
+        with out_lock:
+            out.extend(mine)
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(out) == 300
+    assert len(set(out)) == 300, "duplicate ids issued concurrently"
+
+
+# ----------------------------------------------------- reservation apply
+def test_reserve_id_range_idempotent_and_stale_rejected():
+    scm = _mk_scm()
+    cm = scm.containers
+    floor = cm.peek_id_floor("block")
+    assert cm.reserve_id_range("block", floor, floor + 10) == [
+        floor, floor + 10]
+    # replay of the same record is a deterministic no-op
+    assert cm.reserve_id_range("block", floor, floor + 10) is None
+    assert cm.peek_id_floor("block") == floor + 10
+    # a stale proposer (raced an earlier reservation) is rejected too
+    assert cm.reserve_id_range("block", floor + 5, floor + 20) is None
+    assert cm.peek_id_floor("block") == floor + 10
+
+
+# --------------------------------------------------------------- ring
+def _mk_scm(n_dn=5):
+    scm = StorageContainerManager(min_datanodes=1, placement_seed=11)
+    for i in range(n_dn):
+        scm.register_datanode(f"dn{i}", rack=f"/rack{i % 3}",
+                              capacity_bytes=10**12)
+        scm.heartbeat(f"dn{i}", container_report=[])
+    return scm
+
+
+def test_handoff_never_reissues_exposed_ids(tmp_path):
+    """The exact round-3 corruption shape: a leader EXPOSES an allocation
+    whose container row never replicates (partitioned before commit);
+    the next leader must still issue disjoint (container, local_id) AND
+    pipeline ids, because the id ranges themselves were committed before
+    any id left the leader."""
+    transport = InProcessTransport()
+    ids = ["scm0", "scm1", "scm2"]
+    reps = [
+        RaftSCM(_mk_scm(), tmp_path / nid, nid, ids, transport=transport,
+                ack_timeout_s=1.0)
+        for nid in ids
+    ]
+    reps[0].node.start_election()
+    proxy = SCMFailoverProxy(reps)
+    repl = ReplicationConfig.parse("rs-3-2-1024k")
+
+    # one committed allocation primes the leader's id batches
+    first = proxy.submit("allocate_block", repl, 1 << 20)
+    reps[0].node.tick()
+
+    # partition the leader: its batches are already committed, so local
+    # allocation still succeeds and EXPOSES ids — but the container row
+    # records can never commit (the abandoned-client window). Excluding
+    # the committed container forces BRAND-NEW container + pipeline ids
+    # whose rows the quorum will never see.
+    transport.partition("scm0", "scm1")
+    transport.partition("scm0", "scm2")
+    pre = {c.id for c in reps[0].scm.containers.containers()}
+    exposed = [
+        reps[0].scm.allocate_block(repl, 1 << 20,
+                                   excluded_containers=list(pre))
+        for _ in range(3)
+    ]
+    exposed_pairs = {(g.container_id, g.local_id) for g in exposed}
+    exposed_pipelines = {g.pipeline.id for g in exposed
+                         if g.container_id not in pre}
+
+    # the majority elects scm1 and serves new allocations
+    assert reps[1].node.start_election()
+    later = [proxy.submit("allocate_block", repl, 1 << 20)
+             for _ in range(40)]
+    later_pairs = {(g.container_id, g.local_id) for g in later}
+    later_pipelines = {g.pipeline.id for g in later}
+
+    assert not (exposed_pairs & later_pairs), (
+        "hand-off re-issued exposed (container, local_id) pairs: "
+        f"{exposed_pairs & later_pairs}")
+    assert first.local_id not in {g.local_id for g in later}
+    assert not (exposed_pipelines & later_pipelines), (
+        "hand-off re-issued exposed pipeline ids")
+    transport.heal()
+    for r in reps:
+        r.stop()
+
+
+def test_block_ids_unique_across_repeated_transfers(tmp_path):
+    """Round-robin hand-offs with allocations in every term: the full
+    issued-id history stays duplicate-free."""
+    transport = InProcessTransport()
+    ids = ["scm0", "scm1", "scm2"]
+    reps = [
+        RaftSCM(_mk_scm(), tmp_path / nid, nid, ids, transport=transport,
+                ack_timeout_s=2.0)
+        for nid in ids
+    ]
+    reps[0].node.start_election()
+    proxy = SCMFailoverProxy(reps)
+    repl = ReplicationConfig.parse("rs-3-2-1024k")
+    seen: set[tuple[int, int]] = set()
+    for round_ in range(6):
+        leader = reps[round_ % 3]
+        if not leader.node.is_leader:
+            assert leader.node.start_election()
+        for _ in range(10):
+            g = proxy.submit("allocate_block", repl, 1 << 20)
+            pair = (g.container_id, g.local_id)
+            assert pair not in seen, f"duplicate {pair} in round {round_}"
+            seen.add(pair)
+    for r in reps:
+        r.stop()
+
+
+# --------------------------------------------------------------- DN fence
+def test_write_fence_refuses_second_writer(tmp_path):
+    dn = Datanode(tmp_path, "dn0")
+    dn.create_container(1)
+    bid = BlockID(1, 1)
+    payload = np.arange(64, dtype=np.uint8)
+    info = ChunkInfo("c0", 0, 64)
+    dn.write_chunk(bid, info, payload, writer="key-A")
+    # same writer: appends fine (hsync-style continuation too)
+    dn.write_chunk(bid, ChunkInfo("c1", 64, 64), payload, writer="key-A")
+    # a different writer's stream into the same block file is refused
+    with pytest.raises(StorageError) as ei:
+        dn.write_chunk(bid, ChunkInfo("c0", 0, 64),
+                       np.zeros(64, dtype=np.uint8), writer="key-B")
+    assert ei.value.code == "BLOCK_WRITE_CONFLICT"
+    # ... and so is a foreign commit over the owned block
+    with pytest.raises(StorageError):
+        dn.put_block(BlockData(bid, [info]), writer="key-B")
+    # the violation queued an on-demand verification scan
+    assert dn.pop_scan_requests() == [1]
+    # owner commits fine; original bytes intact
+    dn.put_block(BlockData(bid, [info, ChunkInfo("c1", 64, 64)]),
+                 writer="key-A")
+    got = dn.read_chunk(bid, ChunkInfo("c0", 0, 64))
+    assert np.array_equal(got, payload)
+    # anonymous maintenance traffic (repair/replication) bypasses
+    dn.write_chunk(bid, ChunkInfo("c2", 128, 64), payload)
+    # deleting the block releases ownership
+    dn.delete_block(bid)
+    dn.write_chunk(bid, info, payload, writer="key-B")
+    dn.close()
