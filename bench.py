@@ -40,7 +40,8 @@ BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "540"))
 _DEADLINE = time.time() + BUDGET_S
 #: progressively updated by the measurement loops; the watchdog and the
 #: normal exit path both read it
-_STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None}
+_STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None,
+                "sharded": None}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
@@ -70,6 +71,8 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
         }
         if _STATE["sustained"] is not None:
             line["sustained_60s_gib_s"] = round(_STATE["sustained"], 3)
+        if _STATE["sharded"] is not None:
+            line["sharded_1dev_gib_s"] = round(_STATE["sharded"], 3)
         if timed_out:
             line["timed_out"] = True
         if error:
@@ -407,6 +410,18 @@ def main() -> None:
             return False
         return True
 
+    # sharded-pipeline FIRST among the secondaries (round-3 verdict: it
+    # is the only driver-captured evidence the mesh path costs nothing —
+    # BENCH_r03 shed it for lack of 60s while lower-value benches had
+    # already spent the budget)
+    if budget_for("sharded bench", 60):
+        try:
+            sh = bench_sharded_pipeline()
+            _STATE["sharded"] = sh["median"]
+            log(f"sharded-pipeline DP encode (1-device mesh): median "
+                f"{sh['median']:.2f} GiB/s/chip — config #5 per-chip rate")
+        except Exception as e:
+            log(f"sharded bench failed: {e}")
     if budget_for("sustained bench", 150):
         try:
             sustained = bench_sustained(
@@ -431,13 +446,6 @@ def main() -> None:
                 f"(range {re['min']:.2f}-{re['best']:.2f})")
         except Exception as e:
             log(f"re-encode bench failed: {e}")
-    if budget_for("sharded bench", 60):
-        try:
-            sh = bench_sharded_pipeline()
-            log(f"sharded-pipeline DP encode (1-device mesh): median "
-                f"{sh['median']:.2f} GiB/s/chip — config #5 per-chip rate")
-        except Exception as e:
-            log(f"sharded bench failed: {e}")
     if budget_for("cpp baseline", 30):
         try:
             isal = bench_cpp_fused()

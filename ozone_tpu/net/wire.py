@@ -21,10 +21,16 @@ _LEN = struct.Struct("!I")
 
 def pack(meta: dict[str, Any], payload: Optional[bytes | np.ndarray] = None) -> bytes:
     h = json.dumps(meta, separators=(",", ":")).encode()
-    body = b"" if payload is None else (
-        payload.tobytes() if isinstance(payload, np.ndarray) else bytes(payload)
-    )
-    return _LEN.pack(len(h)) + h + body
+    if payload is None:
+        return _LEN.pack(len(h)) + h
+    if isinstance(payload, np.ndarray):
+        # zero-copy into the join for the hot shape (contiguous uint8);
+        # tobytes() would pay a full extra copy per chunk
+        body = (memoryview(payload) if payload.dtype == np.uint8
+                and payload.flags.c_contiguous else payload.tobytes())
+    else:
+        body = payload  # bytes/bytearray/memoryview join without copy
+    return b"".join((_LEN.pack(len(h)), h, body))
 
 
 def unpack(buf: bytes) -> tuple[dict[str, Any], memoryview]:

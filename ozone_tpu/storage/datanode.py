@@ -158,6 +158,7 @@ class Datanode:
                 CLOSED_CONTAINER_IO, f"container {container_id} is OPEN"
             )
         c.db.delete_container(container_id)
+        c.chunks.close()  # release cached block-file descriptors
         for b in list(c.chunks.chunks_dir.glob("*.block")):
             b.unlink()
         if c.root.exists():
@@ -295,5 +296,7 @@ class Datanode:
         ]
 
     def close(self) -> None:
+        for c in self.containers:
+            c.chunks.close()
         for v in self.volumes:
             v.close()

@@ -333,9 +333,13 @@ def test_smoke_round3_verbs(live_cluster):
 
     # delegation tokens: get -> print -> renew -> cancel -> renew fails
     tok = tmp / "tok.json"
-    _cli(["sh", "token", "get", "--om", om, "--renewer", "yarn",
-          "--token", str(tok)])
-    assert json.loads(tok.read_text())["renewer"] == "yarn"
+    # renewer must be the CLI's login identity: anonymous remote renew
+    # is refused since round 4, and the CLI renews as the login user
+    import getpass
+
+    _cli(["sh", "token", "get", "--om", om, "--renewer",
+          getpass.getuser(), "--token", str(tok)])
+    assert json.loads(tok.read_text())["renewer"] == getpass.getuser()
     _cli(["sh", "token", "renew", "--om", om, "--token", str(tok)])
     _cli(["sh", "token", "cancel", "--om", om, "--token", str(tok)])
     dead = _cli(["sh", "token", "renew", "--om", om,

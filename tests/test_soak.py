@@ -153,9 +153,27 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
         while time.time() < t_end:
             action = rng.choice(
                 ["meta_restart", "dn_restart", "partition", "heal",
-                 "disk_fault", "disk_clear", "breathe"])
+                 "disk_fault", "disk_clear", "ring_transfer", "breathe"])
             try:
-                if action == "meta_restart":
+                if action == "ring_transfer":
+                    # planned leadership hand-off under full write load —
+                    # the round-3 corruption window; exercised every soak
+                    # run now that commit-first ids + the write fence
+                    # guarantee hand-off safety
+                    from ozone_tpu.net.scm_service import GrpcScmClient
+
+                    try:
+                        leader = _await_leader(metas, timeout=10.0)
+                        target = rng.choice(
+                            [m for m in metas if m != leader])
+                        scm = GrpcScmClient(peers[leader])
+                        try:
+                            scm.admin("ring-transfer", target)
+                        finally:
+                            scm.close()
+                    except (StorageError, AssertionError, OSError):
+                        pass  # leadership raced / mid-restart: fine
+                elif action == "meta_restart":
                     victim = rng.choice(sorted(metas))
                     idx = int(victim[1:])
                     metas.pop(victim).stop()
