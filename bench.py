@@ -36,6 +36,11 @@ def log(*a):
 #: line, so a watchdog thread emits the best value measured so far and
 #: hard-exits if the budget runs out while a device call is blocked
 #: (a wedged transfer can't be interrupted from Python).
+#: the headline benches measure the DEVICE kernel itself — pin the fused
+#: backend so the round-4 adaptive link probe (which steers degraded-link
+#: CLIENTS to the native twin) can never flip what this file measures
+os.environ.setdefault("OZONE_TPU_FUSED_BACKEND", "jax")
+
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "540"))
 _DEADLINE = time.time() + BUDGET_S
 #: progressively updated by the measurement loops; the watchdog and the

@@ -13,8 +13,10 @@ reconstruction: recover erased units and checksum them in one dispatch.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from functools import lru_cache
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -118,13 +120,120 @@ def _fused_encode_cached(options: CoderOptions, checksum: ChecksumType, bpc: int
     return fn
 
 
-def _prefer_host_coder() -> bool:
-    """True when jax's default backend is the CPU: XLA's GF(2)
-    bit-matmul formulation is an MXU shape — on plain CPUs the native
-    AVX2 nibble-shuffle coder + SSE4.2 CRC is an order of magnitude
-    faster, so hosts without an accelerator (gateways, CPU clients,
-    CPU datanodes) take the native path. Overridable with
-    OZONE_TPU_FUSED_BACKEND=jax|native."""
+def _measure_link(size: int = 4 * 2**20) -> tuple[float, float]:
+    """One-shot (h2d, d2h) bandwidth sample in MiB/s for the default
+    device. Small buffer + one warmup keeps the probe ~sub-second even
+    on a badly degraded link (8 MiB/s tunnel: ~0.5 s)."""
+    import time
+
+    dev = jax.devices()[0]
+    host = np.zeros(size, dtype=np.uint8)
+    # the d2h leg must read a COMPUTED array: device_put results keep a
+    # host-side copy, so np.asarray on one measures a memcpy, not the
+    # link. A trivial jitted add forces real device residency (one tiny
+    # compile, amortized into the warmup).
+    bump = jax.jit(lambda x: x + 1)
+    warm = bump(jax.device_put(np.zeros(1 << 16, dtype=np.uint8), dev))
+    np.asarray(warm)
+    t0 = time.perf_counter()
+    on_dev = jax.device_put(host, dev)
+    on_dev.block_until_ready()
+    h2d = size / 2**20 / max(time.perf_counter() - t0, 1e-9)
+    on_dev = bump(on_dev)
+    on_dev.block_until_ready()
+    t0 = time.perf_counter()
+    np.asarray(on_dev)
+    d2h = size / 2**20 / max(time.perf_counter() - t0, 1e-9)
+    return h2d, d2h
+
+
+def _native_rate_sample(options: CoderOptions) -> float:
+    """MiB/s of the native fused twin on a small batch (0 when the
+    native library is unavailable). Encode throughput also proxies the
+    decoder (same GF-multiply cost per output byte, same CRC slicer)."""
+    import time
+
+    k, cell = options.data_units, min(options.cell_size, 256 * 1024)
+    small = CoderOptions(k, options.parity_units, options.codec,
+                         cell_size=cell)
+    fn = _native_fused_encoder(small, ChecksumType.CRC32C,
+                               effective_bpc(cell, 16 * 1024))
+    if fn is None:
+        return 0.0
+    data = np.zeros((4, k, cell), dtype=np.uint8)
+    fn(data)  # warm tables
+    t0 = time.perf_counter()
+    fn(data)
+    return data.nbytes / 2**20 / max(time.perf_counter() - t0, 1e-9)
+
+
+def _native_lib_available() -> bool:
+    """Cheap availability check so the ~1 s device-link probe is skipped
+    when there is no native twin to fall back to anyway."""
+    try:
+        from ozone_tpu.codec.cpp_coder import _require_lib
+
+        _require_lib()
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+_PROBE_LOCK = threading.Lock()
+_PROBE_CACHE: dict = {}
+
+
+def _link_beats_native(options: CoderOptions,
+                       out_ratio: Optional[float] = None) -> bool:
+    """Measured-bandwidth backend choice (the adaptive analog of the
+    reference's native-first fallback chain,
+    erasurecode rawcoder/util/CodecUtil.createRawEncoderWithFallback:
+    55-82): an accelerator behind a degraded link (e.g. this rig's axon
+    tunnel) can never feed stripes faster than the native AVX2 twin
+    encodes them outright, so probe once per process and pick the path
+    an operator would actually see win. The e2e ceiling of the device
+    path is transfer-bound: inputs go H2D once and `out_ratio` of that
+    volume comes back D2H (encode: parity, p/k; decode: the recovered
+    units, e/valid). Single-flight under a lock: concurrent writer
+    threads must not each pay (or skew) the probe."""
+    if not _native_lib_available():
+        return True  # nothing to fall back to: device path, no probe
+    if out_ratio is None:
+        out_ratio = options.parity_units / max(options.data_units, 1)
+    key = (options, round(out_ratio, 4))
+    hit = _PROBE_CACHE.get(key)  # lock-free fast path (GIL-atomic read):
+    if hit is not None:          # hot reconstruction threads must not
+        return hit               # serialize on a mutex for a cached bool
+    with _PROBE_LOCK:
+        if "link" not in _PROBE_CACHE:
+            try:
+                _PROBE_CACHE["link"] = _measure_link()
+            except Exception:  # noqa: BLE001 - probe failed: keep the
+                _PROBE_CACHE["link"] = None  # static round-3 choice
+        link = _PROBE_CACHE["link"]
+        if link is None:
+            return True  # device path (never worse than round 3)
+        if key not in _PROBE_CACHE:
+            rate_key = ("native_rate", options)
+            if rate_key not in _PROBE_CACHE:  # depends on options only,
+                _PROBE_CACHE[rate_key] = _native_rate_sample(options)
+            h2d, d2h = link                   # not on the transfer shape
+            ceiling = 1.0 / (1.0 / max(h2d, 1e-9)
+                             + out_ratio / max(d2h, 1e-9))
+            _PROBE_CACHE[key] = ceiling > _PROBE_CACHE[rate_key]
+        return _PROBE_CACHE[key]
+
+
+def _prefer_host_coder(options: Optional[CoderOptions] = None,
+                       out_ratio: Optional[float] = None) -> bool:
+    """True when the fused pass should run on the host: the jax backend
+    is CPU (XLA's GF(2) bit-matmul formulation is an MXU shape — on
+    plain CPUs the native AVX2 nibble-shuffle coder + SSE4.2 CRC is an
+    order of magnitude faster), or an accelerator exists but a one-time
+    bandwidth probe shows its host link is too degraded to beat the
+    native twin end-to-end. Overridable with
+    OZONE_TPU_FUSED_BACKEND=jax|native; OZONE_TPU_LINK_PROBE=0 disables
+    the probe (accelerator always wins when present)."""
     import os
 
     forced = os.environ.get("OZONE_TPU_FUSED_BACKEND", "")
@@ -133,9 +242,14 @@ def _prefer_host_coder() -> bool:
     if forced == "native":
         return True
     try:
-        return jax.default_backend() == "cpu"
+        if jax.default_backend() == "cpu":
+            return True
     except Exception:  # noqa: BLE001 - no backend at all
         return True
+    if options is None or \
+            os.environ.get("OZONE_TPU_LINK_PROBE", "1") == "0":
+        return False
+    return not _link_beats_native(options, out_ratio)
 
 
 def _native_crc_slices(units: np.ndarray, bpc: int) -> np.ndarray:
@@ -184,8 +298,9 @@ def make_fused_encoder(spec: FusedSpec):
     """fn(data uint8 [B, k, C]) -> (parity [B, p, C],
     crcs uint32 [B, k+p, C // bpc]). C must divide by bytes_per_checksum.
     Jitted on accelerator backends; the native AVX2+CRC twin on CPU-only
-    hosts (same registry jax>cpp priority the codec SPI uses)."""
-    if _prefer_host_coder():
+    hosts (same registry jax>cpp priority the codec SPI uses) or when
+    the link probe shows the accelerator can't be fed fast enough."""
+    if _prefer_host_coder(spec.options):
         fn = _native_fused_encoder(spec.options, spec.checksum,
                                    spec.bytes_per_checksum)
         if fn is not None:
@@ -249,8 +364,11 @@ def make_fused_decoder(spec: FusedSpec, valid: list[int], erased: list[int]):
     """fn(valid_units uint8 [B, k, C]) -> (recovered [B, e, C],
     crcs uint32 [B, e, C // bpc]). valid lists the unit indexes of the rows
     supplied, erased the unit indexes to reconstruct. Jitted on
-    accelerator backends; native AVX2+CRC twin on CPU-only hosts."""
-    if _prefer_host_coder():
+    accelerator backends; native AVX2+CRC twin on CPU-only hosts. The
+    link probe uses the decode transfer shape (valid units H2D, erased
+    units D2H), not the encoder's p/k."""
+    if _prefer_host_coder(spec.options,
+                          out_ratio=len(erased) / max(len(valid), 1)):
         fn = _native_fused_decoder(
             spec.options, spec.checksum, spec.bytes_per_checksum,
             tuple(valid), tuple(erased))

@@ -155,6 +155,8 @@ void forget_fd(int fd) {
 typedef int (*open_fn)(const char*, int, ...);
 typedef ssize_t (*write_fn)(int, const void*, size_t);
 typedef ssize_t (*read_fn)(int, void*, size_t);
+typedef ssize_t (*pwrite_fn)(int, const void*, size_t, off_t);
+typedef ssize_t (*pread_fn)(int, void*, size_t, off_t);
 typedef int (*fsync_fn)(int);
 typedef int (*close_fn)(int);
 typedef int (*rename_fn)(const char*, const char*);
@@ -212,6 +214,56 @@ ssize_t write(int fd, const void* buf, size_t count) {
     }
   }
   return real(fd, buf, count);
+}
+
+// positional IO shares the write/read rule vocabulary: the datanode's
+// chunk store writes through cached fds with pwrite/pread (round 4), and
+// a corrupt/fail/delay rule must hit that path exactly like write/read
+static ssize_t pwrite_with_rules(pwrite_fn real, int fd, const void* buf,
+                                 size_t count, off_t off) {
+  std::string p = fd_path(fd);
+  if (!p.empty()) {
+    Rule r = match("write", p.c_str());
+    if (r.action == "fail") { errno = r.param; return -1; }
+    if (r.action == "delay") do_delay(r.param);
+    if (r.action == "corrupt" && count > 0) {
+      std::vector<char> copy((const char*)buf, (const char*)buf + count);
+      copy[0] ^= 0x01;  // single bit flip: checksums must catch it
+      return real(fd, copy.data(), count, off);
+    }
+  }
+  return real(fd, buf, count, off);
+}
+
+ssize_t pwrite(int fd, const void* buf, size_t count, off_t off) {
+  static pwrite_fn real = (pwrite_fn)dlsym(RTLD_NEXT, "pwrite");
+  return pwrite_with_rules(real, fd, buf, count, off);
+}
+
+ssize_t pwrite64(int fd, const void* buf, size_t count, off_t off) {
+  static pwrite_fn real = (pwrite_fn)dlsym(RTLD_NEXT, "pwrite64");
+  return pwrite_with_rules(real, fd, buf, count, off);
+}
+
+static ssize_t pread_with_rules(pread_fn real, int fd, void* buf,
+                                size_t count, off_t off) {
+  std::string p = fd_path(fd);
+  if (!p.empty()) {
+    Rule r = match("read", p.c_str());
+    if (r.action == "fail") { errno = r.param; return -1; }
+    if (r.action == "delay") do_delay(r.param);
+  }
+  return real(fd, buf, count, off);
+}
+
+ssize_t pread(int fd, void* buf, size_t count, off_t off) {
+  static pread_fn real = (pread_fn)dlsym(RTLD_NEXT, "pread");
+  return pread_with_rules(real, fd, buf, count, off);
+}
+
+ssize_t pread64(int fd, void* buf, size_t count, off_t off) {
+  static pread_fn real = (pread_fn)dlsym(RTLD_NEXT, "pread64");
+  return pread_with_rules(real, fd, buf, count, off);
 }
 
 ssize_t read(int fd, void* buf, size_t count) {

@@ -123,3 +123,54 @@ def test_decoder_input_validation():
     inputs = [None, None, None, units[3], units[4]]
     with pytest.raises(ValueError):
         dec.decode(inputs, [0, 1, 2])  # only 2 available
+
+
+def test_adaptive_backend_probe(monkeypatch):
+    """Round-4 adaptive selection (CodecUtil.createRawEncoderWithFallback
+    analog): with an accelerator present, a measured-bandwidth probe
+    steers degraded-link clients to the native twin and healthy-link
+    clients to the device path."""
+    from ozone_tpu.codec import fused
+
+    opts = CoderOptions(6, 3, "rs", cell_size=4096)
+    monkeypatch.delenv("OZONE_TPU_FUSED_BACKEND", raising=False)
+    monkeypatch.setenv("OZONE_TPU_LINK_PROBE", "1")
+    monkeypatch.setattr(fused.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(fused, "_native_lib_available", lambda: True)
+    monkeypatch.setattr(fused, "_native_rate_sample", lambda o: 1400.0)
+
+    try:
+        fused._PROBE_CACHE.clear()
+        # tunnel-degraded link (this rig: h2d 23 MiB/s on a bad day):
+        # the native twin wins
+        monkeypatch.setattr(fused, "_measure_link", lambda: (12.0, 10.0))
+        assert fused._prefer_host_coder(opts) is True
+
+        fused._PROBE_CACHE.clear()
+        # healthy PCIe-class link: the device path wins
+        monkeypatch.setattr(fused, "_measure_link",
+                            lambda: (8000.0, 8000.0))
+        assert fused._prefer_host_coder(opts) is False
+        # decode transfer shape gets its own verdict (e/valid, not p/k)
+        assert fused._prefer_host_coder(opts, out_ratio=1 / 6) is False
+
+        fused._PROBE_CACHE.clear()
+        # probe failure falls back to the device path (never worse than
+        # round 3's static choice)
+        def boom():
+            raise RuntimeError("no device")
+        monkeypatch.setattr(fused, "_measure_link", boom)
+        assert fused._prefer_host_coder(opts) is False
+
+        fused._PROBE_CACHE.clear()
+        # no native twin to fall back to: device path without probing
+        monkeypatch.setattr(fused, "_native_lib_available", lambda: False)
+        assert fused._prefer_host_coder(opts) is False
+
+        # env force still wins over everything
+        monkeypatch.setenv("OZONE_TPU_FUSED_BACKEND", "native")
+        assert fused._prefer_host_coder(opts) is True
+        monkeypatch.setenv("OZONE_TPU_FUSED_BACKEND", "jax")
+        assert fused._prefer_host_coder(opts) is False
+    finally:
+        fused._PROBE_CACHE.clear()
