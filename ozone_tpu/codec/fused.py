@@ -181,6 +181,30 @@ def _native_lib_available() -> bool:
 
 _PROBE_LOCK = threading.Lock()
 _PROBE_CACHE: dict = {}
+_PROBE_WALL_S = 10.0
+
+
+def _probe_link_guarded():
+    """_measure_link under a watchdog thread: an axon-tunnel transfer
+    can wedge uninterruptibly mid-call (the same failure bench.py's
+    watchdog guards against), and the wedged case — the most degraded
+    link of all — must strand one daemon thread, not every coder thread
+    queued behind _PROBE_LOCK. Returns (h2d, d2h); None on probe error
+    (keep the static round-3 device choice); "wedged" on timeout (the
+    device path would hang too, so the native twin is the only usable
+    coder)."""
+    box: list = []
+
+    def run():
+        try:
+            box.append(_measure_link())
+        except Exception:  # noqa: BLE001
+            box.append(None)
+
+    t = threading.Thread(target=run, daemon=True, name="link-probe")
+    t.start()
+    t.join(_PROBE_WALL_S)
+    return box[0] if box else "wedged"
 
 
 def _link_beats_native(options: CoderOptions,
@@ -196,22 +220,28 @@ def _link_beats_native(options: CoderOptions,
     volume comes back D2H (encode: parity, p/k; decode: the recovered
     units, e/valid). Single-flight under a lock: concurrent writer
     threads must not each pay (or skew) the probe."""
-    if not _native_lib_available():
-        return True  # nothing to fall back to: device path, no probe
     if out_ratio is None:
         out_ratio = options.parity_units / max(options.data_units, 1)
     key = (options, round(out_ratio, 4))
     hit = _PROBE_CACHE.get(key)  # lock-free fast path (GIL-atomic read):
     if hit is not None:          # hot reconstruction threads must not
         return hit               # serialize on a mutex for a cached bool
+    avail = _PROBE_CACHE.get("native_avail")  # cache the bool too: the
+    if avail is None:                         # loader takes a mutex even
+        avail = _native_lib_available()       # when already loaded
+        _PROBE_CACHE["native_avail"] = avail
+    if not avail:
+        _PROBE_CACHE[key] = True
+        return True  # nothing to fall back to: device path, no probe
     with _PROBE_LOCK:
         if "link" not in _PROBE_CACHE:
-            try:
-                _PROBE_CACHE["link"] = _measure_link()
-            except Exception:  # noqa: BLE001 - probe failed: keep the
-                _PROBE_CACHE["link"] = None  # static round-3 choice
+            _PROBE_CACHE["link"] = _probe_link_guarded()
         link = _PROBE_CACHE["link"]
+        if link == "wedged":
+            _PROBE_CACHE[key] = False  # dead device link: host twin
+            return False
         if link is None:
+            _PROBE_CACHE[key] = True
             return True  # device path (never worse than round 3)
         if key not in _PROBE_CACHE:
             rate_key = ("native_rate", options)
@@ -225,13 +255,16 @@ def _link_beats_native(options: CoderOptions,
 
 
 def _prefer_host_coder(options: Optional[CoderOptions] = None,
-                       out_ratio: Optional[float] = None) -> bool:
+                       out_ratio: Optional[float] = None,
+                       checksum: Optional[ChecksumType] = None) -> bool:
     """True when the fused pass should run on the host: the jax backend
     is CPU (XLA's GF(2) bit-matmul formulation is an MXU shape — on
     plain CPUs the native AVX2 nibble-shuffle coder + SSE4.2 CRC is an
     order of magnitude faster), or an accelerator exists but a one-time
     bandwidth probe shows its host link is too degraded to beat the
-    native twin end-to-end. Overridable with
+    native twin end-to-end. The native twin only exists for CRC32C, so
+    a spec with any other checksum skips the probe — the device path is
+    the only fused path that can serve it. Overridable with
     OZONE_TPU_FUSED_BACKEND=jax|native; OZONE_TPU_LINK_PROBE=0 disables
     the probe (accelerator always wins when present)."""
     import os
@@ -247,7 +280,8 @@ def _prefer_host_coder(options: Optional[CoderOptions] = None,
     except Exception:  # noqa: BLE001 - no backend at all
         return True
     if options is None or \
-            os.environ.get("OZONE_TPU_LINK_PROBE", "1") == "0":
+            (checksum is not None and checksum is not ChecksumType.CRC32C) \
+            or os.environ.get("OZONE_TPU_LINK_PROBE", "1") == "0":
         return False
     return not _link_beats_native(options, out_ratio)
 
@@ -300,7 +334,7 @@ def make_fused_encoder(spec: FusedSpec):
     Jitted on accelerator backends; the native AVX2+CRC twin on CPU-only
     hosts (same registry jax>cpp priority the codec SPI uses) or when
     the link probe shows the accelerator can't be fed fast enough."""
-    if _prefer_host_coder(spec.options):
+    if _prefer_host_coder(spec.options, checksum=spec.checksum):
         fn = _native_fused_encoder(spec.options, spec.checksum,
                                    spec.bytes_per_checksum)
         if fn is not None:
@@ -368,7 +402,8 @@ def make_fused_decoder(spec: FusedSpec, valid: list[int], erased: list[int]):
     link probe uses the decode transfer shape (valid units H2D, erased
     units D2H), not the encoder's p/k."""
     if _prefer_host_coder(spec.options,
-                          out_ratio=len(erased) / max(len(valid), 1)):
+                          out_ratio=len(erased) / max(len(valid), 1),
+                          checksum=spec.checksum):
         fn = _native_fused_decoder(
             spec.options, spec.checksum, spec.bytes_per_checksum,
             tuple(valid), tuple(erased))

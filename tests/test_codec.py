@@ -7,11 +7,14 @@ cross-backend bit-compatibility (numpy vs jax, the analog of the reference's
 Java vs ISA-L interop guarantee, RSRawEncoder.java:25-28).
 """
 
+import time
+
 import numpy as np
 import pytest
 
 from ozone_tpu.codec import CoderOptions, create_decoder, create_encoder
 from ozone_tpu.codec.registry import CodecRegistry
+from ozone_tpu.utils.checksum import ChecksumType
 
 SCHEMAS = [("rs", 3, 2), ("rs", 6, 3), ("rs", 10, 4), ("xor", 4, 1)]
 BACKENDS = ["numpy", "jax"]
@@ -161,6 +164,36 @@ def test_adaptive_backend_probe(monkeypatch):
             raise RuntimeError("no device")
         monkeypatch.setattr(fused, "_measure_link", boom)
         assert fused._prefer_host_coder(opts) is False
+
+        # cached verdict is truly lock-free: neither the loader nor the
+        # probe may run again once the key is in the cache (flag-based
+        # sentinels — a raising sentinel in _measure_link would be
+        # swallowed by the watchdog thread and read as "probe failed")
+        called: list = []
+        monkeypatch.setattr(fused, "_native_lib_available",
+                            lambda: called.append("lib") or True)
+        monkeypatch.setattr(fused, "_measure_link",
+                            lambda: called.append("probe") or (1.0, 1.0))
+        assert fused._prefer_host_coder(opts) is False
+        assert not called
+
+        fused._PROBE_CACHE.clear()
+        # non-CRC32C spec: no native twin exists for it — device path,
+        # and the ~1 s probe is never paid
+        assert fused._prefer_host_coder(
+            opts, checksum=ChecksumType.CRC32) is False
+        assert "probe" not in called
+        monkeypatch.setattr(fused, "_native_lib_available", lambda: True)
+
+        fused._PROBE_CACHE.clear()
+        # wedged tunnel (uninterruptible device transfer): the watchdog
+        # times the probe out instead of deadlocking every coder thread,
+        # and steers to the native twin — the device path would hang too
+        monkeypatch.setattr(fused, "_measure_link",
+                            lambda: time.sleep(2.5))
+        monkeypatch.setattr(fused, "_PROBE_WALL_S", 0.2)
+        assert fused._prefer_host_coder(opts) is True
+        monkeypatch.setattr(fused, "_PROBE_WALL_S", 10.0)
 
         fused._PROBE_CACHE.clear()
         # no native twin to fall back to: device path without probing
