@@ -19,6 +19,43 @@ from ozone_tpu.storage.datanode import Datanode
 from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo, ContainerState
 
 
+def batch_unsupported(e: Exception) -> bool:
+    """True when `e` means the peer cannot serve the batched
+    WriteChunksCommit/ReadChunks verbs (pre-finalize layout, or a server
+    or duck-typed client without them): callers downgrade to per-chunk
+    verbs — the reference's allDataNodesSupportPiggybacking downgrade
+    (BlockOutputStream.java:228-234)."""
+    from ozone_tpu.storage.ids import StorageError
+    from ozone_tpu.utils.upgrade import PRE_FINALIZE_ERROR
+
+    return isinstance(e, StorageError) and (
+        e.code == PRE_FINALIZE_ERROR
+        or (e.code == "IO_EXCEPTION" and "UNIMPLEMENTED" in e.msg))
+
+
+def write_unit_batched(client, block_id: "BlockID", pairs,
+                       commit: "BlockData",
+                       writer: Optional[str] = None) -> None:
+    """Land one unit's chunks + block commit: a single WriteChunksCommit
+    stream when the peer serves it (one transport round trip for the
+    whole unit), per-chunk verbs otherwise. Shared by the reconstruction
+    coordinator and the re-encode flow; the key writers keep their own
+    downgrade state machines."""
+    from ozone_tpu.storage.ids import StorageError
+
+    fn = getattr(client, "write_chunks_commit", None)
+    if fn is not None:
+        try:
+            fn(block_id, pairs, commit=commit, writer=writer)
+            return
+        except StorageError as e:
+            if not batch_unsupported(e):
+                raise
+    for info, data in pairs:
+        client.write_chunk(block_id, info, data, writer=writer)
+    client.put_block(commit, writer=writer)
+
+
 class TokenStore:
     """Client-side cache of OM/SCM-granted block and container tokens.
 
@@ -109,8 +146,14 @@ class DatanodeClient(Protocol):
                     writer: Optional[str] = None) -> None: ...
     def read_chunk(self, block_id: BlockID, info: ChunkInfo,
                    verify: bool = False) -> np.ndarray: ...
+    def read_chunks(self, block_id: BlockID, infos,
+                    verify: bool = False) -> list[np.ndarray]: ...
     def put_block(self, block: BlockData, sync: bool = False,
                   writer: Optional[str] = None) -> None: ...
+    def write_chunks_commit(self, block_id: BlockID, chunks,
+                            commit: Optional[BlockData] = None,
+                            sync: bool = False,
+                            writer: Optional[str] = None) -> None: ...
     def get_block(self, block_id: BlockID) -> BlockData: ...
     def list_blocks(self, container_id: int) -> list[BlockData]: ...
     def get_committed_block_length(self, block_id: BlockID) -> int: ...
@@ -160,8 +203,24 @@ class LocalDatanodeClient:
     def read_chunk(self, block_id, info, verify=False):
         return self.dn.read_chunk(block_id, info, verify)
 
+    def read_chunks(self, block_id, infos, verify=False):
+        # instance verb per chunk so test subclasses injecting read
+        # faults cover the batched path too
+        return [self.read_chunk(block_id, i, verify) for i in infos]
+
     def put_block(self, block, sync=False, writer=None):
         self.dn.put_block(block, sync, writer=writer)
+
+    def write_chunks_commit(self, block_id, chunks, commit=None,
+                            sync=False, writer=None):
+        """In-process twin of the batched stream verb: same write-then-
+        commit order and all-chunks-before-commit semantics, no
+        transport to save. Routes through the instance verbs so test
+        subclasses injecting chunk/commit faults cover this path too."""
+        for info, data in chunks:
+            self.write_chunk(block_id, info, data, sync, writer=writer)
+        if commit is not None:
+            self.put_block(commit, sync, writer=writer)
 
     def get_block(self, block_id):
         return self.dn.get_block(block_id)

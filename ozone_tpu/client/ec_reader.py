@@ -74,6 +74,13 @@ class ECBlockGroupReader:
         self.spec = FusedSpec(options, checksum, bytes_per_checksum)
         self._block_meta: dict[int, Optional[BlockData]] = {}
         self._read_pool = None  # lazy; see _recover_cells_once
+        #: (unit, stripe) -> full-cell array, filled by _prefetch_unit's
+        #: batched ReadChunks and consumed (popped) by _read_cell
+        self._cell_cache: dict[tuple[int, int], np.ndarray] = {}
+        import os
+
+        self._batch_reads = os.environ.get(
+            "OZONE_TPU_BATCH_READS", "1") != "0"
         # units that failed a read/verify; excluded like missing replicas
         # (reference ECBlockInputStream setFailed + proxy failover)
         self._failed: set[int] = set()
@@ -105,6 +112,9 @@ class ECBlockGroupReader:
 
     def _read_cell(self, u: int, stripe: int) -> np.ndarray:
         """Read unit u's cell of `stripe`, zero-padded to full cell size."""
+        cached = self._cell_cache.pop((u, stripe), None)
+        if cached is not None:
+            return cached
         bd = self._unit_block(u)
         out = np.zeros(self.cell, dtype=np.uint8)
         if bd is None:
@@ -120,38 +130,95 @@ class ECBlockGroupReader:
         out[: data.size] = data
         return out
 
+    def _prefetch_unit(self, u: int, stripes: Sequence[int]) -> None:
+        """Batch-read unit u's cells for `stripes` in ONE ReadChunks
+        RPC (the read twin of the batched write path: transport round
+        trip per unit, not per cell) into the cell cache. Best-effort —
+        any error (including a server without the verb) simply leaves
+        the cells to the per-chunk path, which surfaces precise
+        per-cell failures."""
+        if not self._batch_reads:
+            return
+        bd = self._unit_block(u)
+        if bd is None:
+            return
+        by_offset = {c.offset: c for c in bd.chunks}
+        wanted = [
+            (s, by_offset[s * self.cell])
+            for s in stripes
+            if (u, s) not in self._cell_cache
+            and s * self.cell in by_offset
+        ]
+        if len(wanted) < 2:
+            return  # nothing saved over the per-chunk path
+        try:
+            client = self.clients.get(self.group.pipeline.nodes[u])
+            fn = getattr(client, "read_chunks", None)
+            if fn is None:
+                return
+            datas = fn(self.group.block_id, [i for _, i in wanted],
+                       verify=self.verify)
+        except (StorageError, KeyError, OSError) as e:
+            log.debug("batched read of unit %d failed (%s); per-chunk "
+                      "path will retry", u, e)
+            return
+        for (s, _info), data in zip(wanted, datas):
+            out = np.zeros(self.cell, dtype=np.uint8)
+            out[: data.size] = data
+            self._cell_cache[(u, s)] = out
+
     # ---------------------------------------------------------------- normal
     def read_all(self) -> np.ndarray:
         """Whole-group read, preferring plain data-block reads and falling
         back to reconstruction for missing/corrupt units. Units that fail
         mid-read are marked failed and excluded on retry, up to p times."""
-        for _ in range(self.p + 1):
-            avail = set(self.available_units())
-            missing_data = [u for u in range(self.k) if u not in avail]
-            try:
-                if not missing_data:
-                    return self._read_data_path()
-                return self._read_reconstructed()
-            except _UnitReadError as e:
-                log.warning(
-                    "unit %d failed (%s); excluding and retrying", e.unit, e.cause
-                )
-                self._failed.add(e.unit)
-        raise InsufficientLocationsError(
-            f"read failed; failed units {sorted(self._failed)}"
-        )
+        try:
+            for _ in range(self.p + 1):
+                avail = set(self.available_units())
+                missing_data = [u for u in range(self.k) if u not in avail]
+                try:
+                    if not missing_data:
+                        return self._read_data_path()
+                    return self._read_reconstructed()
+                except _UnitReadError as e:
+                    log.warning(
+                        "unit %d failed (%s); excluding and retrying",
+                        e.unit, e.cause
+                    )
+                    self._failed.add(e.unit)
+            raise InsufficientLocationsError(
+                f"read failed; failed units {sorted(self._failed)}"
+            )
+        finally:
+            self._close_pool()
+
+    def _close_pool(self) -> None:
+        """Reap the reader threads: readers are per-group-read objects
+        with no close() in their contract, so each public entry point
+        reaps its own pool instead of leaving k threads to the GC."""
+        pool, self._read_pool = self._read_pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def _read_data_path(self) -> np.ndarray:
         out = np.empty(self.group.length, dtype=np.uint8)
         pos = 0
-        for s in range(self.num_stripes):
-            for i in range(self.k):
-                if pos >= self.group.length:
-                    break
-                take = min(self.cell, self.group.length - pos)
-                cell = self._read_cell_checked(i, s)
-                out[pos : pos + take] = cell[:take]
-                pos += take
+        window = 8  # stripes prefetched per unit per RPC (bounds memory)
+        for w0 in range(0, self.num_stripes, window):
+            stripes = range(w0, min(w0 + window, self.num_stripes))
+            if self._batch_reads:
+                # one batched RPC per unit, all k units concurrently
+                list(self._ensure_pool().map(
+                    lambda u: self._prefetch_unit(u, stripes),
+                    range(self.k)))
+            for s in stripes:
+                for i in range(self.k):
+                    if pos >= self.group.length:
+                        break
+                    take = min(self.cell, self.group.length - pos)
+                    cell = self._read_cell_checked(i, s)
+                    out[pos : pos + take] = cell[:take]
+                    pos += take
         return out
 
     def _read_cell_checked(self, u: int, stripe: int) -> np.ndarray:
@@ -159,6 +226,14 @@ class ECBlockGroupReader:
             return self._read_cell(u, stripe)
         except (StorageError, KeyError, OSError) as e:
             raise _UnitReadError(u, e)
+
+    def _ensure_pool(self):
+        if self._read_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._read_pool = ThreadPoolExecutor(
+                max_workers=self.k, thread_name_prefix="ec-read")
+        return self._read_pool
 
     # ------------------------------------------------------------- degraded
     def _choose_valid(self, erased: Sequence[int]) -> list[int]:
@@ -195,19 +270,22 @@ class ECBlockGroupReader:
         """recover_cells plus the per-slice device CRCs of the recovered
         cells [num_stripes, len(targets), cell // bpc] — reconstruction
         writes reuse them so recovered data is never re-checksummed on host."""
-        for _ in range(self.p + 1):
-            try:
-                return self._recover_cells_once(targets, stripes)
-            except _UnitReadError as e:
-                log.warning(
-                    "unit %d failed during recovery (%s); excluding",
-                    e.unit,
-                    e.cause,
-                )
-                self._failed.add(e.unit)
-        raise InsufficientLocationsError(
-            f"recovery failed; failed units {sorted(self._failed)}"
-        )
+        try:
+            for _ in range(self.p + 1):
+                try:
+                    return self._recover_cells_once(targets, stripes)
+                except _UnitReadError as e:
+                    log.warning(
+                        "unit %d failed during recovery (%s); excluding",
+                        e.unit,
+                        e.cause,
+                    )
+                    self._failed.add(e.unit)
+            raise InsufficientLocationsError(
+                f"recovery failed; failed units {sorted(self._failed)}"
+            )
+        finally:
+            self._close_pool()
 
     def _recover_cells_once(
         self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None
@@ -218,6 +296,9 @@ class ECBlockGroupReader:
 
         def fill_unit(vi_u):
             vi, u = vi_u
+            # one batched ReadChunks for the unit's whole column first;
+            # cells it couldn't serve fall back to per-chunk reads
+            self._prefetch_unit(u, stripes)
             for bi, s in enumerate(stripes):
                 batch[bi, vi] = self._read_cell_checked(u, s)
 
@@ -227,12 +308,7 @@ class ECBlockGroupReader:
         # parallel stream readers in
         # ECBlockReconstructedStripeInputStream). Pool cached on the
         # reader: recovery retries up to p+1 times per block group.
-        if self._read_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            self._read_pool = ThreadPoolExecutor(
-                max_workers=self.k, thread_name_prefix="ec-read")
-        list(self._read_pool.map(fill_unit, enumerate(valid)))
+        list(self._ensure_pool().map(fill_unit, enumerate(valid)))
         if self.mesh is not None:
             return self._decode_on_mesh(batch, valid, list(targets))
         fn = make_fused_decoder(self.spec, valid, list(targets))

@@ -28,7 +28,10 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.dn_client import (
+    DatanodeClientFactory,
+    batch_unsupported,
+)
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.fused import FusedSpec, effective_bpc, make_fused_encoder
 from ozone_tpu.scm.pipeline import Pipeline
@@ -109,6 +112,17 @@ class StripeWriteError(Exception):
         super().__init__(f"stripe write failed on {failed_nodes}: {cause}")
         self.failed_nodes = failed_nodes
         self.cause = cause
+
+
+class _StreamUnsupported(Exception):
+    """A pipeline member refused WriteChunksCommit (pre-finalize layout
+    or a server without the verb): the writer falls back to per-stripe
+    RPCs, the reference's allDataNodesSupportPiggybacking downgrade
+    (BlockOutputStream.java:228-234)."""
+
+
+#: shared downgrade classifier (dn_client.batch_unsupported)
+_batch_unsupported = batch_unsupported
 
 
 def call_allocate(allocate_group, excluded, excluded_containers):
@@ -201,6 +215,7 @@ class ECKeyWriter:
         bytes_per_checksum: int = 16 * 1024,
         stripe_batch: int = 8,
         max_retries: int = 3,
+        batched_rpc: Optional[bool] = None,
     ):
         self.opts = options
         self.k, self.p, self.cell = (
@@ -229,6 +244,15 @@ class ECKeyWriter:
         # (container, local_id) from another key can never interleave
         # with ours on the datanode (Container.bind_writer)
         self._writer_id = uuid.uuid4().hex
+        # batched WriteChunksCommit streams (one RPC per unit per run)
+        # unless disabled; flips off permanently when a member refuses
+        # the verb (mixed-version cluster)
+        if batched_rpc is None:
+            import os
+
+            batched_rpc = os.environ.get(
+                "OZONE_TPU_BATCH_WRITES", "1") != "0"
+        self._stream_writes = batched_rpc
         self._containers_created = False
         self._excluded: list[str] = []
         self._excluded_containers: list[int] = []
@@ -310,29 +334,183 @@ class ECKeyWriter:
             self._write_batch(*prev)
 
     def _write_batch(self, stripes, parity_dev, crcs_dev) -> None:
-        """Write one encoded batch stripe-by-stripe (commit order defines
-        the ack watermark, as in flushStripeFromQueue:526)."""
+        """Write one encoded batch. The batched-RPC path writes each run
+        of stripes bound for one group as ONE WriteChunksCommit stream
+        per unit — all the run's chunk frames plus the piggybacked
+        putBlock, so the transport round trip is paid once per run
+        instead of twice per stripe (docs/PERF.md per-layer table: the
+        round trip dominates). Ack watermark and rollback move to run
+        granularity, still finer than the reference's block-granular
+        streaming mode. Falls back to the per-stripe path (commit order
+        defines the ack watermark, as in flushStripeFromQueue:526) when
+        a member lacks the verb."""
         parity = np.asarray(parity_dev)
         crcs = np.asarray(crcs_dev)  # [B, k+p, S] uint32
 
-        for b, stripe in enumerate(stripes):
+        b = 0
+        while b < len(stripes):
+            if not self._stream_writes:
+                stripe = stripes[b]
+                for attempt in range(self.max_retries + 1):
+                    try:
+                        self._write_stripe(stripe, parity[b], crcs[b])
+                        break
+                    except StripeWriteError as e:
+                        log.warning(
+                            "stripe %d failed (attempt %d): %s",
+                            stripe.index,
+                            attempt,
+                            e,
+                        )
+                        if attempt == self.max_retries:
+                            raise
+                        self._excluded.extend(e.failed_nodes)
+                        # finalize the group at its committed length; the
+                        # failed stripe replays into a fresh group
+                        self._finalize_group()
+                b += 1
+                continue
+            # batched path: the longest run fitting the current group
+            if self._group is not None and \
+                    self._stripe_in_group >= self.stripes_per_group:
+                self._finalize_group()
             for attempt in range(self.max_retries + 1):
                 try:
-                    self._write_stripe(stripe, parity[b], crcs[b])
+                    self._ensure_group()
+                    n = min(len(stripes) - b,
+                            self.stripes_per_group - self._stripe_in_group)
+                    self._write_stripe_run(
+                        stripes[b:b + n], parity[b:b + n], crcs[b:b + n])
+                    b += n
+                    break
+                except _StreamUnsupported:
+                    # mixed-version member: the run rolled back cleanly;
+                    # replay it per-stripe from here on
+                    self._stream_writes = False
                     break
                 except StripeWriteError as e:
-                    log.warning(
-                        "stripe %d failed (attempt %d): %s",
-                        stripe.index,
-                        attempt,
-                        e,
-                    )
+                    log.warning("stripe run at %d failed (attempt %d): %s",
+                                b, attempt, e)
                     if attempt == self.max_retries:
                         raise
                     self._excluded.extend(e.failed_nodes)
-                    # finalize the group at its committed length; the failed
-                    # stripe replays into a freshly allocated group
                     self._finalize_group()
+
+    def _write_stripe_run(self, run, parity, crcs) -> None:
+        """Write `run` (stripes fitting the current group) as ONE
+        WriteChunksCommit stream per unit: every stripe's cell as a
+        chunk frame, the run's final putBlock piggybacked. On failure,
+        survivors (whose streams committed the run-end record) roll
+        back to the pre-run record — the same no-unacked-bytes
+        invariant as the per-stripe path — and the run replays into a
+        fresh group."""
+        group = self._group
+        for j, s in enumerate(run):
+            s.index = self._stripe_in_group + j
+        pre_chunks = [list(c) for c in self._group_chunks]
+        pre_len = group.length
+        len_after = pre_len + sum(sum(s.lengths) for s in run)
+
+        unit_chunks: list[list[tuple[ChunkInfo, np.ndarray]]] = [
+            [] for _ in range(self.k + self.p)]
+        for j, stripe in enumerate(run):
+            for u in range(self.k + self.p):
+                is_data = u < self.k
+                length = stripe.lengths[u] if is_data else self.cell
+                if length == 0:
+                    continue
+                cell_data = (stripe.data[u] if is_data
+                             else parity[j][u - self.k])
+                info = ChunkInfo(
+                    name=f"{group.block_id}_chunk_{stripe.index}",
+                    offset=stripe.index * self.cell,
+                    length=length,
+                    checksum=self._chunk_checksum(
+                        crcs[j][u], length, cell_data),
+                )
+                unit_chunks[u].append((info, cell_data[:length]))
+
+        def write_unit(u: int):
+            new = unit_chunks[u]
+            if not new and not pre_chunks[u]:
+                return u, None  # nothing written, nothing to re-commit
+            bd = BlockData(
+                group.block_id,
+                pre_chunks[u] + [info for info, _ in new],
+                block_group_length=len_after,
+            )
+            try:
+                client = self.clients.get(group.pipeline.nodes[u])
+                if new:
+                    fn = getattr(client, "write_chunks_commit", None)
+                    if fn is None:  # duck-typed client without the verb
+                        return u, StorageError(
+                            "IO_EXCEPTION",
+                            "UNIMPLEMENTED: client lacks write_chunks_commit")
+                    fn(group.block_id, new, commit=bd,
+                       writer=self._writer_id)
+                else:
+                    # zero new bytes on this unit (short final stripes):
+                    # just advance its committed group length
+                    client.put_block(bd, writer=self._writer_id)
+                return u, None
+            except (StorageError, KeyError, OSError) as e:
+                return u, e
+
+        failed: list[str] = []
+        closed = unsupported = False
+        cause: Optional[Exception] = None
+        ok_units: list[int] = []
+        for u, err in self._ensure_pool().map(write_unit,
+                                              range(self.k + self.p)):
+            if err is None:
+                ok_units.append(u)
+            elif _batch_unsupported(err):
+                unsupported = True
+                cause = err
+            elif isinstance(err, StorageError) \
+                    and err.code == "INVALID_CONTAINER_STATE":
+                # container closed under us: reallocation signal, not a
+                # node fault (same classification as the per-stripe path)
+                closed = True
+                cause = err
+                self._excluded_containers.append(group.container_id)
+            else:
+                failed.append(group.pipeline.nodes[u])
+                cause = err
+        if not failed and not closed and not unsupported:
+            for u in range(self.k + self.p):
+                self._group_chunks[u] = pre_chunks[u] + [
+                    info for info, _ in unit_chunks[u]]
+            group.length = len_after
+            self._stripe_in_group += len(run)
+            return
+
+        # units whose stream succeeded committed len_after: roll them
+        # back to the pre-run record (best-effort, like the per-stripe
+        # rollback — a unit with no prior record stays orphaned in a
+        # group that finalizes below its data, exactly as there)
+        def roll(entry):
+            dn_id, bd = entry
+            try:
+                self.clients.get(dn_id).put_block(bd, writer=self._writer_id)
+                return None
+            except (StorageError, KeyError, OSError) as e:
+                return dn_id, e
+
+        rollbacks = [
+            (group.pipeline.nodes[u],
+             BlockData(group.block_id, pre_chunks[u],
+                       block_group_length=pre_len))
+            for u in ok_units if pre_chunks[u]
+        ]
+        for res in self._ensure_pool().map(roll, rollbacks):
+            if res is not None:
+                log.warning("putBlock rollback failed on %s: %s",
+                            res[0], res[1])
+        if unsupported:
+            raise _StreamUnsupported()
+        raise StripeWriteError(failed, cause)
 
     def _chunk_checksum(
         self, device_crcs: np.ndarray, length: int, cell_data: np.ndarray

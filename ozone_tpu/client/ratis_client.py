@@ -121,6 +121,10 @@ class RatisKeyWriter(ReplicatedKeyWriter):
     the leader, and block finalization waits for the commit watermark.
     """
 
+    #: commits MUST ride the Raft ring, not a per-member piggyback —
+    #: the ring orders them and the watch watermark tracks them
+    _combined_commit = False
+
     def __init__(self, allocate_group, clients: DatanodeClientFactory,
                  ratis_clients: RatisClientFactory,
                  watch_timeout_s: float = 10.0, **kw):

@@ -117,6 +117,7 @@ def re_encode_xor_key_to_rs(
     straight to the freshly allocated group with the device-computed
     CRCs (reference analog: XORRawDecoder.decode + RSRawEncoder.encode
     inside the container-service conversion flow)."""
+    from ozone_tpu.client.dn_client import write_unit_batched
     from ozone_tpu.client.ec_writer import (
         block_lengths,
         create_group_containers,
@@ -198,7 +199,7 @@ def re_encode_xor_key_to_rs(
             else:
                 cells = out[:, 1 + (u - k)]
             dn = clients.get(ng.pipeline.nodes[u])
-            chunks = []
+            pairs = []
             for s in range(stripes):
                 chunk_len = max(0, min(cell, lengths[u] - s * cell))
                 if chunk_len == 0:
@@ -215,10 +216,12 @@ def re_encode_xor_key_to_rs(
                     length=chunk_len,
                     checksum=cs,
                 )
-                dn.write_chunk(ng.block_id, ci, cells[s, :chunk_len])
-                chunks.append(ci)
-            dn.put_block(BlockData(
-                ng.block_id, chunks, block_group_length=g.length))
+                pairs.append((ci, cells[s, :chunk_len]))
+            commit = BlockData(ng.block_id, [i for i, _ in pairs],
+                               block_group_length=g.length)
+            # one batched stream per unit when the target serves it
+            # (WriteChunksCommit), per-chunk verbs otherwise
+            write_unit_batched(dn, ng.block_id, pairs, commit)
         ng.length = g.length
         new_groups.append(ng)
         total += g.length

@@ -23,6 +23,7 @@ from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import (
     BlockGroup,
     StripeWriteError,
+    _batch_unsupported,
     call_allocate,
     create_group_containers,
 )
@@ -35,6 +36,12 @@ log = logging.getLogger(__name__)
 class ReplicatedKeyWriter:
     """Writes a key as replicated blocks: chunks fanned to every pipeline
     node, putBlock commit per block."""
+
+    #: combine each member's chunk write and block commit into ONE
+    #: WriteChunksCommit RPC (the reference's PutBlock piggybacking,
+    #: BlockOutputStream.allowPutBlockPiggybacking). Subclasses that
+    #: order commits through a different path (the Raft ring) disable it.
+    _combined_commit = True
 
     def __init__(
         self,
@@ -142,43 +149,114 @@ class ReplicatedKeyWriter:
                 length=int(data.size),
                 checksum=self.checksum.compute(data),
             )
-            failed: list[str] = []
-            closed = False
-            err: Optional[Exception] = None
-            for dn_id in group.pipeline.nodes:
-                try:
-                    self.clients.get(dn_id).write_chunk(
-                        group.block_id, info, data,
-                        writer=self._writer_id)
-                except StorageError as e:
-                    err = e
-                    if e.code == "INVALID_CONTAINER_STATE":
-                        # container closed under us: healthy node,
-                        # reallocate without blacklisting anyone — but
-                        # never accept the same container again
-                        closed = True
-                        self._excluded_containers.append(
-                            group.container_id)
-                    else:
-                        failed.append(dn_id)
-                except (KeyError, OSError) as e:
-                    failed.append(dn_id)
-                    err = e
-            if not closed and self._data_phase_ok(group, failed):
-                try:
-                    self._commit_chunk(group, info)
-                    self._chunks.append(info)
-                    group.length += data.size
-                    return
-                except (StorageError, KeyError, OSError) as e:
-                    err = e
-                    failed = []  # commit failure: no node to exclude
+            ok, failed, closed, err = self._write_and_commit(
+                group, info, data)
+            if ok:
+                self._chunks.append(info)
+                group.length += data.size
+                return
             log.warning("chunk write failed on %s: %s", failed or "commit",
                         err)
             self._excluded.extend(failed)
             self._finalize_group()
             if attempt == self.max_retries:
                 raise StorageError("IO_EXCEPTION", f"write failed: {err}")
+
+    def _write_and_commit(self, group: BlockGroup, info: ChunkInfo,
+                          data) -> tuple:
+        """Data fan-out + block commit for one chunk: ONE combined
+        WriteChunksCommit RPC per member when every member serves the
+        verb; the split write_chunk/commit phases otherwise (and for
+        subclasses whose commit is ordered elsewhere). Returns
+        (ok, failed_nodes, container_closed, error)."""
+        if self._combined_commit:
+            out = self._combined_write(group, info, data)
+            if out is not None:
+                return out
+            # a member lacks the verb: downgrade for the rest of this
+            # writer. Members that already took the combined call this
+            # attempt simply see a same-writer chunk re-write + the same
+            # putBlock again — both idempotent — on the split replay.
+            self._combined_commit = False
+        failed: list[str] = []
+        closed = False
+        err: Optional[Exception] = None
+        for dn_id in group.pipeline.nodes:
+            try:
+                self.clients.get(dn_id).write_chunk(
+                    group.block_id, info, data,
+                    writer=self._writer_id)
+            except StorageError as e:
+                err = e
+                if e.code == "INVALID_CONTAINER_STATE":
+                    # container closed under us: healthy node,
+                    # reallocate without blacklisting anyone — but
+                    # never accept the same container again
+                    closed = True
+                    self._excluded_containers.append(
+                        group.container_id)
+                else:
+                    failed.append(dn_id)
+            except (KeyError, OSError) as e:
+                failed.append(dn_id)
+                err = e
+        if not closed and self._data_phase_ok(group, failed):
+            try:
+                self._commit_chunk(group, info)
+                return True, [], False, None
+            except (StorageError, KeyError, OSError) as e:
+                return False, [], False, e  # commit failure: no node
+        return False, failed, closed, err  # to exclude
+
+    def _combined_write(self, group: BlockGroup, info: ChunkInfo,
+                        data) -> Optional[tuple]:
+        """Combined fan-out: chunk frame + piggybacked putBlock per
+        member. None when any member lacks the verb (caller downgrades
+        to the split phases). On a partial failure the members that
+        already took the combined call committed a record including the
+        unacked chunk — they roll back to the pre-chunk record (the
+        split path never commits until every member has the data, and
+        replicas must not disagree on committed length; same invariant
+        as the EC run rollback)."""
+        failed: list[str] = []
+        ok_nodes: list[str] = []
+        closed = False
+        err: Optional[Exception] = None
+        bd = BlockData(group.block_id, [*self._chunks, info])
+        for dn_id in group.pipeline.nodes:
+            try:
+                client = self.clients.get(dn_id)
+                fn = getattr(client, "write_chunks_commit", None)
+                if fn is None:
+                    return None
+                fn(group.block_id, [(info, data)], commit=bd,
+                   writer=self._writer_id)
+                ok_nodes.append(dn_id)
+            except StorageError as e:
+                if _batch_unsupported(e):
+                    return None
+                err = e
+                if e.code == "INVALID_CONTAINER_STATE":
+                    closed = True
+                    self._excluded_containers.append(group.container_id)
+                else:
+                    failed.append(dn_id)
+            except (KeyError, OSError) as e:
+                failed.append(dn_id)
+                err = e
+        ok = not failed and not closed
+        if not ok and ok_nodes and self._chunks:
+            # best-effort, like the EC rollback; a member with no prior
+            # record keeps its orphan in a group that finalizes below it
+            prev = BlockData(group.block_id, list(self._chunks))
+            for dn_id in ok_nodes:
+                try:
+                    self.clients.get(dn_id).put_block(
+                        prev, writer=self._writer_id)
+                except (StorageError, KeyError, OSError) as e:
+                    log.warning("putBlock rollback failed on %s: %s",
+                                dn_id, e)
+        return ok, failed, closed, err
 
     def _data_phase_ok(self, group: BlockGroup, failed: list[str]) -> bool:
         """Whether the chunk fan-out suffices to commit. Plain replication
@@ -231,6 +309,10 @@ class ReplicatedKeyReader:
         if getattr(clients, "tokens", None) is not None:
             clients.tokens.put_group(group)  # READ tokens from the lookup
         self.verify = verify
+        import os
+
+        self._batch_reads = os.environ.get(
+            "OZONE_TPU_BATCH_READS", "1") != "0"
 
     def read_all(self) -> np.ndarray:
         last: Optional[Exception] = None
@@ -244,10 +326,25 @@ class ReplicatedKeyReader:
             try:
                 client = self.clients.get(dn_id)
                 bd = client.get_block(self.group.block_id)
-                parts = [
-                    client.read_chunk(self.group.block_id, info, self.verify)
-                    for info in bd.chunks
-                ]
+                # one batched ReadChunks round trip when the replica
+                # serves it; per-chunk reads otherwise
+                fn = (getattr(client, "read_chunks", None)
+                      if len(bd.chunks) > 1 and self._batch_reads
+                      else None)
+                if fn is not None:
+                    try:
+                        parts = fn(self.group.block_id, bd.chunks,
+                                   self.verify)
+                    except StorageError as e:
+                        if not _batch_unsupported(e):
+                            raise
+                        fn = None
+                if fn is None:
+                    parts = [
+                        client.read_chunk(self.group.block_id, info,
+                                          self.verify)
+                        for info in bd.chunks
+                    ]
                 out = (
                     np.concatenate(parts) if parts else np.zeros(0, np.uint8)
                 )

@@ -62,10 +62,12 @@ class DatanodeGrpcService:
             },
             stream_methods={
                 "StreamWriteBlock": self._stream_write_block,
+                "WriteChunksCommit": self._write_chunks_commit,
                 "ImportContainer": self._import_container,
             },
             server_stream_methods={
                 "ExportContainer": self._export_container,
+                "ReadChunks": self._read_chunks,
             },
         )
 
@@ -162,6 +164,56 @@ class DatanodeGrpcService:
         self.dn.put_block(bd, sync=sync, writer=header.get("writer"))
         return wire.pack({"block": bd.to_json()})
 
+    def _write_chunks_commit(self, frames) -> bytes:
+        """Batched chunk writes with a piggybacked block commit in ONE
+        client-streaming RPC (the reference's PutBlock piggybacking —
+        BlockOutputStream.allowPutBlockPiggybacking:151,228-234 /
+        KeyValueHandler.java:899 — generalized to any number of chunks
+        per message): frame 0 is the wire-packed header {block_id,
+        writer?, sync?, token?, commit?: BlockData json}; every following
+        frame is wire.pack({chunk: ChunkInfo json}, payload). Unlike
+        StreamWriteBlock the CLIENT computes checksums and chunk
+        boundaries (the EC writer's device-CRC'd cells land untouched);
+        the commit applies only after every chunk landed, so a failure
+        anywhere aborts the stream before the block record moves."""
+        if self.layout is not None:
+            from ozone_tpu.utils.upgrade import (
+                PRE_FINALIZE_ERROR,
+                RATIS_STREAMING_WRITE,
+            )
+
+            if not self.layout.is_allowed(RATIS_STREAMING_WRITE):
+                raise StorageError(
+                    PRE_FINALIZE_ERROR,
+                    f"WriteChunksCommit needs layout feature "
+                    f"{RATIS_STREAMING_WRITE.name} "
+                    f"(v{RATIS_STREAMING_WRITE.version}); datanode is at "
+                    f"layout {self.layout.metadata_version}")
+        it = iter(frames)
+        header, _ = wire.unpack(next(it))
+        block_id = BlockID.from_json(header["block_id"])
+        self._require_block(header, "WRITE", block_id)
+        sync = bool(header.get("sync", False))
+        writer = header.get("writer")
+        for frame in it:
+            m, payload = wire.unpack(frame)
+            self.dn.write_chunk(
+                block_id,
+                ChunkInfo.from_json(m["chunk"]),
+                wire.payload_array(payload),
+                sync=sync,
+                writer=writer,
+            )
+        commit = header.get("commit")
+        if commit is not None:
+            bd = BlockData.from_json(commit)
+            if bd.block_id != block_id:
+                raise StorageError(
+                    "INVALID_ARGUMENT",
+                    f"commit names {bd.block_id}, stream wrote {block_id}")
+            self.dn.put_block(bd, sync=sync, writer=writer)
+        return wire.pack({})
+
     def _create_container(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
         self._require_container(m, m["container_id"])
@@ -244,6 +296,22 @@ class DatanodeGrpcService:
             verify=m.get("verify", False),
         )
         return wire.pack({}, data)
+
+    def _read_chunks(self, req: bytes):
+        """Server-streamed batch read: one request naming any number of
+        chunks of a block, one payload frame back per chunk in request
+        order (the read-side twin of WriteChunksCommit — the transport
+        round trip is paid once per batch, not per chunk). Purely a
+        protocol addition: clients fall back to per-chunk ReadChunk
+        against servers without it, so no layout gate is needed."""
+        m, _ = wire.unpack(req)
+        block_id = BlockID.from_json(m["block_id"])
+        self._require_block(m, "READ", block_id)
+        verify = m.get("verify", False)
+        for ch in m["chunks"]:
+            data = self.dn.read_chunk(
+                block_id, ChunkInfo.from_json(ch), verify=verify)
+            yield wire.pack({}, data)
 
     def _put_block(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
@@ -360,6 +428,29 @@ class GrpcDatanodeClient:
         )
         return wire.payload_array(payload).copy()
 
+    def read_chunks(self, block_id, infos, verify=False):
+        """Batch read: one server-streamed RPC returns every chunk in
+        `infos` (request order). The read-side twin of
+        write_chunks_commit."""
+        frames = self._ch.call_server_stream(
+            SERVICE, "ReadChunks",
+            wire.pack({
+                "block_id": block_id.to_json(),
+                "chunks": [i.to_json() for i in infos],
+                "verify": verify,
+                **self._btok(block_id),
+            }),
+        )
+        out = []
+        for f in frames:
+            _, payload = wire.unpack(f)
+            out.append(wire.payload_array(payload).copy())
+        if len(out) != len(infos):
+            raise StorageError(
+                "IO_EXCEPTION",
+                f"ReadChunks returned {len(out)}/{len(infos)} frames")
+        return out
+
     def put_block(self, block, sync=False, writer=None):
         m = {"block": block.to_json(), "sync": sync,
              **self._btok(block.block_id)}
@@ -446,6 +537,33 @@ class GrpcDatanodeClient:
         resp = self._ch.call_streaming(SERVICE, "StreamWriteBlock", frames())
         m, _ = wire.unpack(resp)
         return BlockData.from_json(m["block"])
+
+    def write_chunks_commit(self, block_id, chunks, commit=None,
+                            sync=False, writer=None):
+        """Write `chunks` ([(ChunkInfo, payload array)]) and optionally
+        commit `commit` (a BlockData) in ONE round trip: the PutBlock-
+        piggybacking analog, batched. One ack covers the whole batch —
+        the transport-dominant per-chunk round trip (docs/PERF.md
+        per-layer table) collapses to one per batch."""
+        meta = {"block_id": block_id.to_json(), "sync": sync,
+                **self._btok(block_id)}
+        if writer is not None:
+            meta["writer"] = writer
+        if commit is not None:
+            meta["commit"] = commit.to_json()
+
+        def frames():
+            yield wire.pack(meta)
+            for info, data in chunks:
+                arr = np.asarray(
+                    np.frombuffer(data, dtype=np.uint8)
+                    if isinstance(data, (bytes, bytearray))
+                    else data,
+                    dtype=np.uint8,
+                )
+                yield wire.pack({"chunk": info.to_json()}, arr)
+
+        self._ch.call_streaming(SERVICE, "WriteChunksCommit", frames())
 
     def echo(self, data: bytes = b"ping") -> bytes:
         return self._ch.call(SERVICE, "Echo", data)

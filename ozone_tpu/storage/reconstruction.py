@@ -194,7 +194,7 @@ class ECReconstructionCoordinator:
             u = idx - 1
             dn = self.clients.get(cmd.targets[idx])
             unit_len = lengths[u]
-            chunks: list[ChunkInfo] = []
+            pairs: list[tuple[ChunkInfo, object]] = []
             for s in range(reader.num_stripes):
                 chunk_len = max(0, min(cell, unit_len - s * cell))
                 if chunk_len == 0:
@@ -217,14 +217,17 @@ class ECReconstructionCoordinator:
                     length=chunk_len,
                     checksum=cs,
                 )
-                dn.write_chunk(group.block_id, info, data)
-                chunks.append(info)
-            dn.put_block(
-                BlockData(
-                    group.block_id, chunks, block_group_length=group.length
-                )
+                pairs.append((info, data))
+            commit = BlockData(
+                group.block_id, [i for i, _ in pairs],
+                block_group_length=group.length,
             )
+            # one batched stream per rebuilt unit when the target serves
+            # it, per-chunk verbs against older/pre-finalize targets
+            from ozone_tpu.client.dn_client import write_unit_batched
+
+            write_unit_batched(dn, group.block_id, pairs, commit)
             self.metrics.counter("blocks_reconstructed").inc()
             self.metrics.counter("bytes_reconstructed").inc(
-                sum(c.length for c in chunks)
+                sum(i.length for i, _ in pairs)
             )

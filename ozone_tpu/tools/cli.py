@@ -794,7 +794,7 @@ def cmd_freon(args) -> int:
         root = args.root or tempfile.mkdtemp(prefix="ozone-ralg-")
         _emit(freon.ralg(root, n_entries=args.num, size=args.size,
                          threads=args.threads).summary())
-    elif args.generator in ("dcg", "dcv", "dsg", "dnbp"):
+    elif args.generator in ("dcg", "dcb", "dcv", "dsg", "dnbp"):
         oz = _client(args)
         dn_ids = list(oz.clients.known_ids())
         if not dn_ids:
@@ -805,8 +805,8 @@ def cmd_freon(args) -> int:
             _emit(freon.dnbp(oz.clients, dn_ids, args.num,
                              threads=args.threads).summary())
             return 0
-        gen = {"dcg": freon.dcg, "dcv": freon.dcv, "dsg": freon.dsg}[
-            args.generator]
+        gen = {"dcg": freon.dcg, "dcb": freon.dcb, "dcv": freon.dcv,
+               "dsg": freon.dsg}[args.generator]
         _emit(gen(oz.clients, dn_ids, args.num, size=args.size,
                   threads=args.threads).summary())
     return 0
@@ -1395,7 +1395,7 @@ def build_parser() -> argparse.ArgumentParser:
     fr.add_argument("generator",
                     choices=["ockg", "ockr", "ockv", "ecrd", "rawcoder", "omkg",
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
-                             "dcv", "dsg", "hsg", "dnbp", "ralg",
+                             "dcb", "dcv", "dsg", "hsg", "dnbp", "ralg",
                              "fskg", "mpug", "s3kg", "fsg", "sdg",
                              "dnsim"])
     fr.add_argument("-n", "--num", type=int, default=100)

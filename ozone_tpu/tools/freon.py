@@ -261,6 +261,41 @@ def dcg(
     return BaseFreonGenerator("dcg", n_chunks, threads).run(op)
 
 
+def dcb(
+    clients,
+    dn_ids: list[str],
+    n_blocks: int = 20,
+    size: int = 1024 * 1024,
+    batch: int = 8,
+    threads: int = 4,
+    container_id: int = 30_000_000,
+) -> FreonReport:
+    """Batched chunk generator: `batch` client-checksummed chunks + the
+    piggybacked putBlock per ONE WriteChunksCommit stream — the raw-path
+    isolation of round 4's batched write verb (dcg pays a transport
+    round trip per chunk; this pays one per block)."""
+    from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    rng = np.random.default_rng(3)
+    payload = rng.integers(0, 256, size, dtype=np.uint8)
+    cs = Checksum(ChecksumType.CRC32C, 16 * 1024).compute(payload)
+    _ensure_container(clients, dn_ids, container_id)
+
+    def op(i: int) -> int:
+        dn = dn_ids[i % len(dn_ids)]
+        bid = BlockID(container_id, i + 1)
+        pairs = [
+            (ChunkInfo(f"{bid}_chunk_{j}", j * size, size, cs), payload)
+            for j in range(batch)
+        ]
+        clients.get(dn).write_chunks_commit(
+            bid, pairs, commit=BlockData(bid, [c for c, _ in pairs]))
+        return size * batch
+
+    return BaseFreonGenerator("dcb", n_blocks, threads).run(op)
+
+
 def dsg(
     clients,
     dn_ids: list[str],

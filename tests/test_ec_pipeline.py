@@ -241,23 +241,10 @@ class FlakyPutBlockClient(LocalDatanodeClient):
         return super().put_block(block, sync, writer=writer)
 
 
-def test_putblock_failure_rolls_back_survivor_commits(cluster):
-    """A putBlock failure mid-stripe must not leave OTHER datanodes
-    committed at the inflated group length: the concurrently dispatched
-    putBlocks are rolled back to the pre-stripe watermark, so datanode
-    metadata (which offline reconstruction trusts) never reports bytes
-    the client did not ack."""
-    cluster.clients._local["dn0"] = FlakyPutBlockClient(
-        cluster.dns[0], fail_call=1)  # stripe 0 commits; stripe 1 fails
-    rng = np.random.default_rng(13)
-    # two stripes: stripe 0 commits, stripe 1's putBlock fails on dn0
-    # and replays into a fresh group after rollover
-    data = rng.integers(0, 256, 2 * 3 * CELL, dtype=np.uint8)
-    groups = _write_key(cluster, data)
-    got = _read_key(cluster, groups)
-    assert np.array_equal(got, data)
-    # the rolled-over first group is finalized at its committed length;
-    # EVERY datanode holding it must agree (no inflated survivor)
+def _assert_no_inflated_survivors(cluster, groups):
+    """Every datanode holding a finalized group must agree on its
+    committed length (datanode metadata is what offline reconstruction
+    trusts — no unit may report bytes the client never acked)."""
     first = cluster.allocated[0]
     if first.length and first is not groups[-1]:
         for u, dn_id in enumerate(first.pipeline.nodes):
@@ -269,3 +256,159 @@ def test_putblock_failure_rolls_back_survivor_commits(cluster):
             assert bd.block_group_length == first.length, \
                 f"unit {u} on {dn_id} reports inflated group length " \
                 f"{bd.block_group_length} != {first.length}"
+
+
+def test_putblock_failure_rolls_back_survivor_commits(cluster):
+    """Per-stripe path: a putBlock failure mid-stripe must not leave
+    OTHER datanodes committed at the inflated group length — the
+    concurrently dispatched putBlocks roll back to the pre-stripe
+    watermark."""
+    cluster.clients._local["dn0"] = FlakyPutBlockClient(
+        cluster.dns[0], fail_call=1)  # stripe 0 commits; stripe 1 fails
+    rng = np.random.default_rng(13)
+    # two stripes: stripe 0 commits, stripe 1's putBlock fails on dn0
+    # and replays into a fresh group after rollover
+    data = rng.integers(0, 256, 2 * 3 * CELL, dtype=np.uint8)
+    groups = _write_key(cluster, data, batched_rpc=False)
+    got = _read_key(cluster, groups)
+    assert np.array_equal(got, data)
+    _assert_no_inflated_survivors(cluster, groups)
+
+
+def test_batched_run_commit_failure_rolls_back_survivors(cluster):
+    """Batched-RPC path: the run's piggybacked commit fails on one
+    unit while the other units' streams committed the run-end record —
+    survivors must roll back to the pre-run watermark and the run
+    replays into a fresh group."""
+    cluster.clients._local["dn0"] = FlakyPutBlockClient(
+        cluster.dns[0], fail_call=0)  # the run's only commit fails
+    rng = np.random.default_rng(17)
+    data = rng.integers(0, 256, 2 * 3 * CELL, dtype=np.uint8)
+    groups = _write_key(cluster, data)
+    got = _read_key(cluster, groups)
+    assert np.array_equal(got, data)
+    _assert_no_inflated_survivors(cluster, groups)
+
+
+class _NoStreamClient(LocalDatanodeClient):
+    """A member without the WriteChunksCommit verb (pre-finalize layout
+    / older server): refuses the batch, serves the per-chunk verbs."""
+
+    calls = 0
+
+    def write_chunks_commit(self, block_id, chunks, commit=None,
+                            sync=False, writer=None):
+        _NoStreamClient.calls += 1
+        raise StorageError("NOT_SUPPORTED_OPERATION_PRIOR_FINALIZATION",
+                           "WriteChunksCommit needs layout feature")
+
+
+class _FlakyCombinedClient(LocalDatanodeClient):
+    """Fails combined chunk+commit call number `fail_call` (0-based)."""
+
+    def __init__(self, dn, fail_call=1):
+        super().__init__(dn)
+        self.fail_call = fail_call
+        self.calls = 0
+
+    def write_chunks_commit(self, block_id, chunks, commit=None,
+                            sync=False, writer=None):
+        me = self.calls
+        self.calls += 1
+        if me == self.fail_call:
+            raise StorageError("IO_EXCEPTION", "injected combined failure")
+        return super().write_chunks_commit(block_id, chunks, commit,
+                                           sync, writer)
+
+
+def test_replicated_combined_partial_failure_rolls_back_survivors(cluster):
+    """A member failing the combined chunk+commit call must not leave
+    the OTHER members committed with the unacked chunk (the split path
+    never commits until every member took the data; replicas must not
+    disagree on committed length)."""
+    from ozone_tpu.client.replicated import (
+        ReplicatedKeyReader,
+        ReplicatedKeyWriter,
+    )
+
+    cluster.clients._local["dn2"] = _FlakyCombinedClient(
+        cluster.dns[2], fail_call=1)  # chunk 0 lands; chunk 1 fails
+
+    def allocate(excluded, ec=()):
+        g = cluster.allocate(excluded)
+        g.pipeline.nodes = g.pipeline.nodes[:3]
+        return g
+
+    w = ReplicatedKeyWriter(allocate, cluster.clients,
+                            block_size=8 * CELL, chunk_size=CELL)
+    rng = np.random.default_rng(29)
+    data = rng.integers(0, 256, 2 * CELL, dtype=np.uint8)
+    w.write(data)
+    groups = w.close()
+    got = np.concatenate(
+        [ReplicatedKeyReader(g, cluster.clients).read_all()
+         for g in groups])
+    assert np.array_equal(got, data)
+    # the first group finalized at chunk 0 only; the survivors that
+    # took chunk 1's combined call must have rolled back to one chunk
+    first = cluster.allocated[0]
+    assert first.length == CELL
+    for dn_id in first.pipeline.nodes[:2]:
+        dn = next(d for d in cluster.dns if d.id == dn_id)
+        bd = dn.get_block(first.block_id)
+        assert len(bd.chunks) == 1, \
+            f"{dn_id} kept the unacked chunk after rollback"
+
+
+def test_replicated_writer_combined_commit_downgrade(cluster):
+    """The replicated writer's combined chunk+commit fan-out downgrades
+    to split phases when a member lacks the verb, with byte-exact data
+    and no member excluded."""
+    from ozone_tpu.client.replicated import (
+        ReplicatedKeyReader,
+        ReplicatedKeyWriter,
+    )
+
+    _NoStreamClient.calls = 0
+    cluster.clients._local["dn1"] = _NoStreamClient(cluster.dns[1])
+
+    def allocate(excluded, ec=()):
+        g = cluster.allocate(excluded)
+        g.pipeline.nodes = g.pipeline.nodes[:3]  # THREE-replica pipeline
+        return g
+
+    w = ReplicatedKeyWriter(allocate, cluster.clients,
+                            block_size=8 * CELL, chunk_size=CELL)
+    rng = np.random.default_rng(23)
+    data = rng.integers(0, 256, 5 * CELL + 11, dtype=np.uint8)
+    w.write(data)
+    groups = w.close()
+    assert w._combined_commit is False
+    assert _NoStreamClient.calls == 1  # probed once, never again
+    assert w._excluded == []
+    assert sum(g.length for g in groups) == data.size
+    got = np.concatenate(
+        [ReplicatedKeyReader(g, cluster.clients).read_all()
+         for g in groups])
+    assert np.array_equal(got, data)
+
+
+def test_mixed_version_member_falls_back_to_per_stripe(cluster):
+    """One pipeline member refusing the batched verb downgrades the
+    writer to per-stripe RPCs for the rest of the write (the
+    allDataNodesSupportPiggybacking downgrade) — with a clean rollback,
+    no reallocation, and byte-exact data."""
+    _NoStreamClient.calls = 0
+    cluster.clients._local["dn1"] = _NoStreamClient(cluster.dns[1])
+    rng = np.random.default_rng(19)
+    data = rng.integers(0, 256, 6 * 3 * CELL + 7, dtype=np.uint8)
+    w = cluster.writer()
+    w.write(data)
+    groups = w.close()
+    assert w._stream_writes is False
+    assert _NoStreamClient.calls == 1  # probed once, never again
+    assert sum(g.length for g in groups) == data.size
+    got = _read_key(cluster, groups)
+    assert np.array_equal(got, data)
+    # the downgrade is not a node failure: nobody was excluded
+    assert w._excluded == []

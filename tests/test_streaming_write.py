@@ -56,6 +56,105 @@ def test_stream_write_block_roundtrip(dn):
         c.close()
 
 
+def test_write_chunks_commit_roundtrip(dn):
+    """Round-4 batched chunk writes + piggybacked commit (the
+    PutBlock-piggybacking analog, BlockOutputStream.java:151): the
+    CLIENT's checksums and chunk boundaries land untouched, one RPC
+    commits the whole batch."""
+    from ozone_tpu.storage.ids import BlockData, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum
+
+    c = GrpcDatanodeClient("dn0", dn.address)
+    try:
+        c.create_container(9, replica_index=1)
+        bid = BlockID(9, 1)
+        rng = np.random.default_rng(1)
+        cksum = Checksum(ChecksumType.CRC32C, 4096)
+        chunks, off = [], 0
+        for i in range(4):
+            data = rng.integers(0, 256, 8192, dtype=np.uint8)
+            chunks.append((ChunkInfo(f"{bid}_chunk_{i}", off, data.size,
+                                     checksum=cksum.compute(data)), data))
+            off += data.size
+        commit = BlockData(bid, [i for i, _ in chunks])
+        c.write_chunks_commit(bid, chunks, commit=commit, writer="w1")
+        got = np.concatenate([c.read_chunk(bid, i, verify=True)
+                              for i, _ in chunks])
+        assert np.array_equal(
+            got, np.concatenate([d for _, d in chunks]))
+        assert c.get_committed_block_length(bid) == off
+    finally:
+        c.close()
+
+
+def test_write_chunks_commit_mismatch_and_fence(dn):
+    from ozone_tpu.storage.ids import BlockData, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum
+
+    c = GrpcDatanodeClient("dn0", dn.address)
+    try:
+        c.create_container(10, replica_index=1)
+        bid = BlockID(10, 1)
+        data = np.arange(4096, dtype=np.uint8)
+        info = ChunkInfo(f"{bid}_chunk_0", 0, data.size,
+                         checksum=Checksum(ChecksumType.CRC32C,
+                                           4096).compute(data))
+        # a commit naming a DIFFERENT block than the stream wrote is
+        # refused before the block record moves
+        with pytest.raises(StorageError) as ei:
+            c.write_chunks_commit(
+                bid, [(info, data)],
+                commit=BlockData(BlockID(10, 99), [info]), writer="w1")
+        assert ei.value.code == "INVALID_ARGUMENT"
+        # chunk 0 DID land (write-then-commit order); w1 owns the block
+        c.write_chunks_commit(bid, [(info, data)], writer="w1")
+        # the datanode write fence holds on the streamed path: a second
+        # writer cannot stream into w1's uncommitted block
+        with pytest.raises(StorageError) as ei:
+            c.write_chunks_commit(bid, [(info, data)], writer="w2")
+        assert ei.value.code == "BLOCK_WRITE_CONFLICT"
+    finally:
+        c.close()
+
+
+def test_read_chunks_batched(dn):
+    """The read-side twin: one server-streamed RPC returns every
+    requested chunk in order, with verification."""
+    from ozone_tpu.storage.ids import BlockData, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum
+
+    c = GrpcDatanodeClient("dn0", dn.address)
+    try:
+        c.create_container(11, replica_index=1)
+        bid = BlockID(11, 1)
+        rng = np.random.default_rng(2)
+        cksum = Checksum(ChecksumType.CRC32C, 4096)
+        chunks, off = [], 0
+        for i in range(5):
+            data = rng.integers(0, 256, 8192, dtype=np.uint8)
+            chunks.append((ChunkInfo(f"{bid}_chunk_{i}", off, data.size,
+                                     checksum=cksum.compute(data)), data))
+            off += data.size
+        c.write_chunks_commit(
+            bid, chunks, commit=BlockData(bid, [i for i, _ in chunks]))
+        # batched read returns request order — ask for a subset, reversed
+        wanted = [chunks[3][0], chunks[0][0], chunks[4][0]]
+        got = c.read_chunks(bid, wanted, verify=True)
+        assert len(got) == 3
+        for info, arr in zip(wanted, got):
+            src = next(d for i, d in chunks if i.name == info.name)
+            assert np.array_equal(arr, src)
+        # corrupt-on-disk surfaces through the stream as a StorageError
+        path = dn.dn.get_container(11).chunks.block_path(bid)
+        raw = bytearray(path.read_bytes())
+        raw[5] ^= 0xFF
+        path.write_bytes(bytes(raw))
+        with pytest.raises(StorageError):
+            c.read_chunks(bid, [i for i, _ in chunks], verify=True)
+    finally:
+        c.close()
+
+
 def test_stream_write_empty_and_errors(dn):
     c = GrpcDatanodeClient("dn0", dn.address)
     try:
