@@ -172,25 +172,7 @@ class ECBlockGroupReader:
         """Whole-group read, preferring plain data-block reads and falling
         back to reconstruction for missing/corrupt units. Units that fail
         mid-read are marked failed and excluded on retry, up to p times."""
-        try:
-            for _ in range(self.p + 1):
-                avail = set(self.available_units())
-                missing_data = [u for u in range(self.k) if u not in avail]
-                try:
-                    if not missing_data:
-                        return self._read_data_path()
-                    return self._read_reconstructed()
-                except _UnitReadError as e:
-                    log.warning(
-                        "unit %d failed (%s); excluding and retrying",
-                        e.unit, e.cause
-                    )
-                    self._failed.add(e.unit)
-            raise InsufficientLocationsError(
-                f"read failed; failed units {sorted(self._failed)}"
-            )
-        finally:
-            self._close_pool()
+        return self.read(0, self.group.length)
 
     def _close_pool(self) -> None:
         """Reap the reader threads: readers are per-group-read objects
@@ -200,26 +182,59 @@ class ECBlockGroupReader:
         if pool is not None:
             pool.shutdown(wait=False)
 
-    def _read_data_path(self) -> np.ndarray:
-        out = np.empty(self.group.length, dtype=np.uint8)
-        pos = 0
+    def _read_range_into(self, out: np.ndarray, offset: int, length: int,
+                         missing_data: list[int]) -> None:
+        """Fill `out` with user bytes [offset, offset+length): only the
+        cells intersecting the range move over the wire, and on degraded
+        groups only the covering stripes are reconstructed."""
+        row = self.k * self.cell
+        s0 = offset // row
+        s1 = (offset + length - 1) // row
+        # reconstruct ONLY the stripes where a missing unit's cell
+        # actually intersects the range — a ranged read that never
+        # touches the missing unit costs no recovery at all
+        need_rec = [
+            s for s in range(s0, s1 + 1)
+            if any(max(offset, s * row + u * self.cell)
+                   < min(offset + length, s * row + (u + 1) * self.cell)
+                   for u in missing_data)
+        ]
+        rec = (self.recover_cells(missing_data, need_rec)
+               if need_rec else None)
+        rec_pos = {s: i for i, s in enumerate(need_rec)}
         window = 8  # stripes prefetched per unit per RPC (bounds memory)
-        for w0 in range(0, self.num_stripes, window):
-            stripes = range(w0, min(w0 + window, self.num_stripes))
+        for w0 in range(s0, s1 + 1, window):
+            stripes = range(w0, min(w0 + window, s1 + 1))
             if self._batch_reads:
-                # one batched RPC per unit, all k units concurrently
-                list(self._ensure_pool().map(
-                    lambda u: self._prefetch_unit(u, stripes),
-                    range(self.k)))
+                # one batched RPC per needed unit, concurrently; a unit
+                # is needed only where the range touches its cells
+                needed: dict[int, list[int]] = {}
+                for s in stripes:
+                    for i in range(self.k):
+                        if i in missing_data:
+                            continue
+                        cell_start = s * row + i * self.cell
+                        if (max(offset, cell_start)
+                                < min(offset + length,
+                                      cell_start + self.cell)):
+                            needed.setdefault(i, []).append(s)
+                if needed:
+                    list(self._ensure_pool().map(
+                        lambda kv: self._prefetch_unit(kv[0], kv[1]),
+                        needed.items()))
             for s in stripes:
                 for i in range(self.k):
-                    if pos >= self.group.length:
-                        break
-                    take = min(self.cell, self.group.length - pos)
-                    cell = self._read_cell_checked(i, s)
-                    out[pos : pos + take] = cell[:take]
-                    pos += take
-        return out
+                    cell_start = s * row + i * self.cell
+                    a = max(offset, cell_start)
+                    b = min(offset + length, cell_start + self.cell)
+                    if a >= b:
+                        continue
+                    if i in missing_data:
+                        cell = rec[rec_pos[s], missing_data.index(i)]
+                    else:
+                        cell = self._read_cell_checked(i, s)
+                    out[a - offset : b - offset] = \
+                        cell[a - cell_start : b - cell_start]
 
     def _read_cell_checked(self, u: int, stripe: int) -> np.ndarray:
         try:
@@ -335,32 +350,38 @@ class ECBlockGroupReader:
         rec, crcs = fn(padded)
         return np.asarray(rec)[:orig], np.asarray(crcs)[:orig]
 
-    def _read_reconstructed(self) -> np.ndarray:
-        avail = set(self.available_units())
-        erased_data = [u for u in range(self.k) if u not in avail]
-        rec = self.recover_cells(erased_data) if erased_data else None
-        out = np.empty(self.group.length, dtype=np.uint8)
-        pos = 0
-        for s in range(self.num_stripes):
-            for i in range(self.k):
-                if pos >= self.group.length:
-                    break
-                take = min(self.cell, self.group.length - pos)
-                if i in erased_data:
-                    cell = rec[s, erased_data.index(i)]
-                else:
-                    cell = self._read_cell_checked(i, s)
-                out[pos : pos + take] = cell[:take]
-                pos += take
-        return out
-
     # ---------------------------------------------------------------- ranged
     def read(self, offset: int, length: int) -> np.ndarray:
-        """Range read in user-byte space (simple first cut: whole-group read
-        then slice; cell-granular range planning is a later optimization)."""
-        if offset < 0 or offset + length > self.group.length:
+        """Cell-granular range read in user-byte space: only the stripes
+        covering [offset, offset+length) are fetched, and on degraded
+        groups only those stripes are reconstructed (the reference's
+        ECBlockInputStream positioned reads, not whole-block reads).
+        Units that fail mid-read are excluded and retried, up to p
+        times."""
+        if offset < 0 or length < 0 or \
+                offset + length > self.group.length:
             raise ValueError("range out of bounds")
-        return self.read_all()[offset : offset + length]
+        out = np.empty(length, dtype=np.uint8)
+        if length == 0:
+            return out
+        try:
+            for _ in range(self.p + 1):
+                avail = set(self.available_units())
+                missing_data = [u for u in range(self.k) if u not in avail]
+                try:
+                    self._read_range_into(out, offset, length, missing_data)
+                    return out
+                except _UnitReadError as e:
+                    log.warning(
+                        "unit %d failed (%s); excluding and retrying",
+                        e.unit, e.cause
+                    )
+                    self._failed.add(e.unit)
+            raise InsufficientLocationsError(
+                f"read failed; failed units {sorted(self._failed)}"
+            )
+        finally:
+            self._close_pool()
 
 
 def unit_true_lengths(group: BlockGroup, options: CoderOptions) -> list[int]:

@@ -219,64 +219,96 @@ class OzoneBucket:
         with self.open_key(key, replication, metadata=metadata) as h:
             h.write(data)
 
-    def read_key(self, key: str) -> np.ndarray:
+    def lookup_key_info(self, key: str) -> dict:
+        """Key info lookup with `.snapshot/<name>/<key>` routing (the
+        path convention the reference FS exposes) — shared by whole and
+        positioned reads so snapshot paths work on both."""
         om = self.client.om
         if key.startswith(".snapshot/"):
-            # snapshot-scoped read via the path convention the reference
-            # FS exposes: .snapshot/<name>/<key>
             parts = key.split("/", 2)
             if len(parts) != 3 or not parts[2]:
                 from ozone_tpu.om.requests import OMError
 
                 raise OMError("KEY_NOT_FOUND",
                               f"no key component in {key}")
-            info = om.snapshot_lookup_key(self.volume, self.name,
+            return om.snapshot_lookup_key(self.volume, self.name,
                                           parts[1], parts[2])
-        else:
-            info = om.lookup_key(self.volume, self.name, key)
-        return self.read_key_info(info)
+        return om.lookup_key(self.volume, self.name, key)
+
+    def read_key(self, key: str) -> np.ndarray:
+        return self.read_key_info(self.lookup_key_info(key))
 
     def read_key_info(self, info: dict) -> np.ndarray:
         """Read a key's bytes from already-fetched key info — callers
         that looked the key up for other reasons (metadata headers,
         checksum type) avoid a second OM round-trip."""
+        return self.read_key_info_range(info, 0, int(info["size"]))
+
+    def read_key_range(self, key: str, offset: int,
+                       length: int) -> np.ndarray:
+        """Positioned read of [offset, offset+length) in key space."""
+        return self.read_key_info_range(self.lookup_key_info(key),
+                                        offset, length)
+
+    def read_key_info_range(self, info: dict, offset: int,
+                            length: int) -> np.ndarray:
+        """Positioned read: only the block groups — and within them only
+        the cells/chunks — covering [offset, offset+length) move over
+        the wire; TDE streams decrypt by seeking the CTR keystream to
+        the range offset (the reference's KeyInputStream.seek +
+        CryptoInputStream positioned-read path)."""
         om = self.client.om
+        size = int(info["size"])
+        if offset < 0 or length < 0 or offset + length > size:
+            raise ValueError(f"range [{offset},{offset + length}) out of "
+                             f"bounds for size {size}")
         groups = om.key_block_groups(info)
         parts: list[np.ndarray] = []
+        pos = 0  # current group's start offset in key space
         for g in groups:
-            if g.pipeline.replication.type is ReplicationType.EC:
-                reader = ECBlockGroupReader(
-                    g,
-                    g.pipeline.replication.ec,
-                    self.client.clients,
-                    checksum=ChecksumType(info.get("checksum_type", "CRC32C")),
-                    bytes_per_checksum=info.get("bytes_per_checksum", 16 * 1024),
-                )
-                parts.append(reader.read_all())
-            else:
-                parts.append(
-                    ReplicatedKeyReader(g, self.client.clients).read_all()
-                )
+            a = max(offset, pos)
+            b = min(offset + length, pos + g.length)
+            if a < b:
+                if g.pipeline.replication.type is ReplicationType.EC:
+                    reader = ECBlockGroupReader(
+                        g,
+                        g.pipeline.replication.ec,
+                        self.client.clients,
+                        checksum=ChecksumType(
+                            info.get("checksum_type", "CRC32C")),
+                        bytes_per_checksum=info.get(
+                            "bytes_per_checksum", 16 * 1024),
+                    )
+                else:
+                    reader = ReplicatedKeyReader(g, self.client.clients)
+                parts.append(reader.read(a - pos, b - a))
+            pos += g.length
         out = np.concatenate(parts) if parts else np.zeros(0, np.uint8)
-        assert out.size == info["size"], (out.size, info["size"])
+        assert out.size == length, (out.size, length)
         enc = info.get("encryption", {})
-        if enc:
+        if enc and length:
             from ozone_tpu.utils.kms import ctr_crypt
 
             dek = self._data_key(enc)
             if "enc_parts" in info:
                 # multipart: each part was encrypted independently with
-                # its own IV at offset 0
-                segs, pos = [], 0
+                # its own IV at offset 0 — decrypt each covered slice at
+                # its part-relative offset
+                segs, ppos = [], 0
                 for p in info["enc_parts"]:
                     n = int(p["size"])
-                    segs.append(ctr_crypt(out[pos:pos + n], dek,
-                                          bytes.fromhex(p["iv"])))
-                    pos += n
+                    a = max(offset, ppos)
+                    b = min(offset + length, ppos + n)
+                    if a < b:
+                        segs.append(ctr_crypt(
+                            out[a - offset:b - offset], dek,
+                            bytes.fromhex(p["iv"]), offset=a - ppos))
+                    ppos += n
                 out = (np.concatenate(segs) if segs
                        else np.zeros(0, np.uint8))
             else:
-                out = ctr_crypt(out, dek, bytes.fromhex(enc["iv"]))
+                out = ctr_crypt(out, dek, bytes.fromhex(enc["iv"]),
+                                offset=offset)
         return out
 
     def file_checksum(self, key: str) -> dict:

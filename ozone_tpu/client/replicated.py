@@ -315,6 +315,18 @@ class ReplicatedKeyReader:
             "OZONE_TPU_BATCH_READS", "1") != "0"
 
     def read_all(self) -> np.ndarray:
+        return self.read(0, self.group.length)
+
+    def read(self, offset: int, length: int) -> np.ndarray:
+        """Chunk-granular range read with replica failover: only the
+        chunks overlapping [offset, offset+length) move over the wire
+        (one batched ReadChunks round trip per replica when it serves
+        the verb)."""
+        if offset < 0 or length < 0 or \
+                offset + length > self.group.length:
+            raise ValueError("range out of bounds")
+        if length == 0:
+            return np.zeros(0, np.uint8)
         last: Optional[Exception] = None
         # topology-nearest replica first (XceiverClientGrpc reads via
         # sortDatanodes order in the reference); farther replicas remain
@@ -326,14 +338,15 @@ class ReplicatedKeyReader:
             try:
                 client = self.clients.get(dn_id)
                 bd = client.get_block(self.group.block_id)
-                # one batched ReadChunks round trip when the replica
-                # serves it; per-chunk reads otherwise
+                wanted = [c for c in bd.chunks
+                          if c.offset < offset + length
+                          and c.offset + c.length > offset]
                 fn = (getattr(client, "read_chunks", None)
-                      if len(bd.chunks) > 1 and self._batch_reads
+                      if len(wanted) > 1 and self._batch_reads
                       else None)
                 if fn is not None:
                     try:
-                        parts = fn(self.group.block_id, bd.chunks,
+                        parts = fn(self.group.block_id, wanted,
                                    self.verify)
                     except StorageError as e:
                         if not _batch_unsupported(e):
@@ -343,12 +356,25 @@ class ReplicatedKeyReader:
                     parts = [
                         client.read_chunk(self.group.block_id, info,
                                           self.verify)
-                        for info in bd.chunks
+                        for info in wanted
                     ]
-                out = (
-                    np.concatenate(parts) if parts else np.zeros(0, np.uint8)
-                )
-                return out[: self.group.length]
+                out = np.zeros(length, dtype=np.uint8)
+                covered = 0
+                for info, data in zip(wanted, parts):
+                    a = max(offset, info.offset)
+                    b = min(offset + length, info.offset + len(data))
+                    if a < b:
+                        out[a - offset : b - offset] = \
+                            data[a - info.offset : b - info.offset]
+                        covered += b - a
+                if covered != length:
+                    # a stale/short replica (missing or truncated
+                    # chunks) must FAIL OVER, not read back zeros
+                    raise StorageError(
+                        "NO_SUCH_BLOCK",
+                        f"replica {dn_id} covers {covered}/{length} "
+                        f"bytes of [{offset},{offset + length})")
+                return out
             except (StorageError, KeyError, OSError) as e:
                 log.warning("replica %s failed: %s; trying next", dn_id, e)
                 last = e

@@ -111,6 +111,19 @@ class OzoneFileSystem:
     def open(self, path: str) -> OzoneFile:
         return OzoneFile(self.bucket.read_key(self._norm(path)))
 
+    def read_range(self, path: str, offset: int = 0,
+                   length=None) -> bytes:
+        """Positioned read without materializing the whole file (the
+        WebHDFS OPEN ?offset/?length fast path): only the covering
+        cells/chunks move."""
+        # lookup_key_info routes .snapshot/<name>/<key> paths too
+        info = self.bucket.lookup_key_info(self._norm(path))
+        size = int(info["size"])
+        offset = min(max(0, offset), size)
+        n = (size - offset if length is None
+             else max(0, min(int(length), size - offset)))
+        return self.bucket.read_key_info_range(info, offset, n).tobytes()
+
     def recover_lease(self, path: str) -> bool:
         """Seal an abandoned hsynced write and fence the dead writer
         (BasicOzoneClientAdapterImpl.recoverLease analog)."""
@@ -373,6 +386,13 @@ class RootedOzoneFileSystem:
         if not (vol and bkt and rest):
             raise IsADirectoryError(path)
         return self._bucket_fs(vol, bkt).open(rest)
+
+    def read_range(self, path: str, offset: int = 0,
+                   length=None) -> bytes:
+        vol, bkt, rest = self._resolve(path)
+        if not (vol and bkt and rest):
+            raise IsADirectoryError(path)
+        return self._bucket_fs(vol, bkt).read_range(rest, offset, length)
 
     def recover_lease(self, path: str) -> bool:
         vol, bkt, rest = self._resolve(path)

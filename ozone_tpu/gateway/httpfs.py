@@ -202,11 +202,12 @@ class HttpFSGateway:
 
     # ----------------------------------------------------------------- GET
     def _op_get_open(self, h, path: str, q) -> None:
-        f = self.fs.open(path)
         offset = int(q.get("offset", ["0"])[0])
-        f.seek(offset)
         length = q.get("length", [None])[0]
-        data = f.read(int(length)) if length is not None else f.read()
+        # positioned read: only the covering cells/chunks move (the
+        # whole-file materialization is gone from the OPEN path)
+        data = self.fs.read_range(
+            path, offset, int(length) if length is not None else None)
         h._reply(200, data, content_type="application/octet-stream")
 
     def _op_get_getfilestatus(self, h, path: str, q) -> None:

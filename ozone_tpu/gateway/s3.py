@@ -1084,29 +1084,36 @@ class S3Gateway:
     def _get_object(self, h, bucket: str, key: str) -> None:
         # one lookup serves metadata headers AND the block list
         info = self.client.om.lookup_key(self._vol, bucket, key)
-        data = self._bucket_handle(bucket).read_key_info(info).tobytes()
+        bh = self._bucket_handle(bucket)
         meta = self._meta_headers_from(info)
+        size = int(info["size"])
         rng = h.headers.get("Range")
         if rng and rng.startswith("bytes="):
+            # ranged GET reads ONLY the covering cells/chunks (round-4
+            # positioned reads), not the whole key
             lo_s, _, hi_s = rng[6:].partition("-")
             if not lo_s:  # suffix form bytes=-N: the LAST N bytes
                 n = int(hi_s)
-                lo = max(0, len(data) - n)
-                hi = len(data) - 1
+                lo = max(0, size - n)
+                hi = size - 1
             else:
                 lo = int(lo_s)
-                hi = int(hi_s) if hi_s else len(data) - 1
-            part = data[lo : hi + 1]
+                hi = int(hi_s) if hi_s else size - 1
+            hi = min(hi, size - 1)
+            n = max(0, hi - lo + 1) if lo <= hi and lo < size else 0
+            part = (bh.read_key_info_range(info, lo, n).tobytes()
+                    if n else b"")
             h._reply(
                 206,
                 part,
                 {
                     "Content-Type": "application/octet-stream",
-                    "Content-Range": f"bytes {lo}-{hi}/{len(data)}",
+                    "Content-Range": f"bytes {lo}-{hi}/{size}",
                     **meta,
                 },
             )
         else:
+            data = bh.read_key_info(info).tobytes()
             h._reply(200, data,
                      {"Content-Type": "application/octet-stream", **meta})
 

@@ -54,8 +54,10 @@ def live_cluster(tmp_path_factory):
         )  # the child holds its own duplicated descriptor
     procs.append(meta)
     om = f"127.0.0.1:{port}"
-    # wait for the metadata server
-    deadline = time.time() + 30
+    # wait for the metadata server (generous: each status poll is a
+    # full CLI process whose jax import costs seconds under suite load;
+    # the loop exits as soon as the server answers)
+    deadline = time.time() + 90
     while time.time() < deadline:
         try:
             _cli(["admin", "status", "--om", om], timeout=10)
@@ -73,8 +75,8 @@ def live_cluster(tmp_path_factory):
             cwd=str(REPO), env=env,
         )
         procs.append(p)
-    # wait for registrations
-    deadline = time.time() + 30
+    # wait for registrations (same contention headroom as above)
+    deadline = time.time() + 90
     while time.time() < deadline:
         out = _cli(["admin", "datanode", "--om", om]).stdout
         if len(json.loads(out)) == 5:
@@ -233,7 +235,7 @@ def test_ha_cluster_subprocesses(tmp_path):
     try:
         for mid in peers:
             start_meta(mid)
-        deadline = time.time() + 45
+        deadline = time.time() + 90
         while time.time() < deadline:
             try:
                 _cli(["admin", "status", "--om", oms], timeout=10)

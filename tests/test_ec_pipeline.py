@@ -146,6 +146,115 @@ def test_degraded_read_single_and_double_loss(cluster):
         assert np.array_equal(got, data), f"n_kill={n_kill}"
 
 
+def test_ranged_reads_match_slices(cluster):
+    """Cell-granular positioned reads (round 4): every awkward range
+    equals the slice of a full read, on healthy AND degraded groups
+    (where only the covering stripes may be reconstructed)."""
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, 9 * CELL + 123, dtype=np.uint8)
+    groups = _write_key(cluster, data)
+    g = groups[0]
+    cases = [(0, 1), (CELL - 1, 2), (0, g.length), (g.length - 1, 1),
+             (CELL // 2, 3 * CELL), (2 * CELL + 7, CELL + 100),
+             (g.length, 0)]
+    for off, ln in cases:
+        got = cluster.reader(g).read(off, ln)
+        assert np.array_equal(got, data[off:off + ln]), (off, ln)
+    with pytest.raises(ValueError):
+        cluster.reader(g).read(0, g.length + 1)
+    with pytest.raises(ValueError):
+        cluster.reader(g).read(-1, 1)
+    # degrade: drop one data unit and one parity unit
+    for u in (1, 4):
+        dn = next(d for d in cluster.dns if d.id == g.pipeline.nodes[u])
+        dn.delete_block(g.block_id)
+    for off, ln in cases:
+        got = cluster.reader(g).read(off, ln)
+        assert np.array_equal(got, data[off:off + ln]), \
+            f"degraded range ({off},{ln})"
+
+
+def test_replicated_ranged_read(cluster):
+    from ozone_tpu.client.replicated import (
+        ReplicatedKeyReader,
+        ReplicatedKeyWriter,
+    )
+
+    def allocate(excluded, ec=()):
+        g = cluster.allocate(excluded)
+        g.pipeline.nodes = g.pipeline.nodes[:3]
+        return g
+
+    w = ReplicatedKeyWriter(allocate, cluster.clients,
+                            block_size=16 * CELL, chunk_size=CELL)
+    rng = np.random.default_rng(37)
+    data = rng.integers(0, 256, 5 * CELL + 19, dtype=np.uint8)
+    w.write(data)
+    (g,) = w.close()
+    for off, ln in [(0, 1), (CELL - 1, 2), (0, g.length),
+                    (g.length - 1, 1), (2 * CELL + 5, 2 * CELL),
+                    (g.length, 0)]:
+        got = ReplicatedKeyReader(g, cluster.clients).read(off, ln)
+        assert np.array_equal(got, data[off:off + ln]), (off, ln)
+    with pytest.raises(ValueError):
+        ReplicatedKeyReader(g, cluster.clients).read(1, g.length)
+
+
+def test_ranged_read_off_missing_unit_needs_no_recovery(cluster):
+    """A ranged read that never touches the missing unit must not pay a
+    reconstruction: recover_cells is forbidden for the duration."""
+    rng = np.random.default_rng(41)
+    data = rng.integers(0, 256, 3 * CELL, dtype=np.uint8)  # one stripe
+    groups = _write_key(cluster, data)
+    g = groups[0]
+    dn = next(d for d in cluster.dns if d.id == g.pipeline.nodes[2])
+    dn.delete_block(g.block_id)  # data unit 2 gone
+    r = cluster.reader(g)
+
+    def boom(*a, **kw):
+        raise AssertionError("range off the missing unit must not "
+                             "trigger recovery")
+    r.recover_cells = boom
+    # bytes [0, 2*CELL) live on units 0 and 1 only
+    got = r.read(CELL // 2, CELL)
+    assert np.array_equal(got, data[CELL // 2 : CELL // 2 + CELL])
+    # and a range ON the missing unit still reconstructs (fresh reader)
+    got = cluster.reader(g).read(2 * CELL + 5, 100)
+    assert np.array_equal(got, data[2 * CELL + 5 : 2 * CELL + 105])
+
+
+def test_short_replica_fails_over_not_zero_fill(cluster):
+    """A replica missing its tail chunk must fail over to the next
+    replica, never serve zero-filled bytes (stale-replica safety)."""
+    from ozone_tpu.client.replicated import (
+        ReplicatedKeyReader,
+        ReplicatedKeyWriter,
+    )
+    from ozone_tpu.storage.ids import BlockData
+
+    def allocate(excluded, ec=()):
+        g = cluster.allocate(excluded)
+        g.pipeline.nodes = g.pipeline.nodes[:3]
+        return g
+
+    w = ReplicatedKeyWriter(allocate, cluster.clients,
+                            block_size=8 * CELL, chunk_size=CELL)
+    rng = np.random.default_rng(43)
+    data = rng.integers(0, 256, 3 * CELL, dtype=np.uint8)
+    w.write(data)
+    (g,) = w.close()
+    # truncate the FIRST replica's record to 2 chunks (a datanode that
+    # died before the last commit; re-written record, chunk file stays)
+    dn0 = next(d for d in cluster.dns if d.id == g.pipeline.nodes[0])
+    bd = dn0.get_block(g.block_id)
+    dn0.put_block(BlockData(g.block_id, bd.chunks[:2]))
+    # whole and tail ranged reads must come from a healthy replica
+    got = ReplicatedKeyReader(g, cluster.clients).read_all()
+    assert np.array_equal(got, data)
+    got = ReplicatedKeyReader(g, cluster.clients).read(2 * CELL + 1, 100)
+    assert np.array_equal(got, data[2 * CELL + 1 : 2 * CELL + 101])
+
+
 def test_too_many_losses_raises(cluster):
     rng = np.random.default_rng(1)
     data = rng.integers(0, 256, 4 * CELL, dtype=np.uint8)

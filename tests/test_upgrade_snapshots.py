@@ -151,6 +151,15 @@ def test_snapshot_surface_over_grpc_and_dot_snapshot_reads(tmp_path):
         assert np.array_equal(b.read_key("k"), v2)
         # snapshot-scoped read returns the pre-mutation bytes
         assert np.array_equal(b.read_key(".snapshot/s1/k"), v1)
+        # positioned snapshot reads route the same way (round 4 — the
+        # WebHDFS OPEN fast path reads snapshots through read_range)
+        assert np.array_equal(b.read_key_range(".snapshot/s1/k", 100, 57),
+                              v1[100:157])
+        from ozone_tpu.gateway.fs import OzoneFileSystem
+
+        fs = OzoneFileSystem(b)
+        assert fs.read_range(".snapshot/s1/k", 8_000, None) == \
+            v1[8_000:].tobytes()
         names = [s["name"] for s in oz.om.list_snapshots("v", "b")]
         assert names == ["s1"]
         diff = oz.om.snapshot_diff("v", "b", "s1")
