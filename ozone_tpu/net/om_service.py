@@ -136,10 +136,13 @@ class OmGrpcService:
                 ),
                 "SnapshotLookupKey": self._wrap(
                     lambda m: self.om.snapshot_lookup_key(
-                        m["volume"], m["bucket"], m["name"], m["key"])
+                        m["volume"], m["bucket"], m["name"], m["key"]),
+                    with_addresses=True,
                 ),
                 "LookupKey": self._wrap(
-                    lambda m: self.om.lookup_key(m["volume"], m["bucket"], m["key"])
+                    lambda m: self.om.lookup_key(
+                        m["volume"], m["bucket"], m["key"]),
+                    with_addresses=True,
                 ),
                 "ListKeys": self._wrap(
                     lambda m: self.om.list_keys(
@@ -350,7 +353,7 @@ class OmGrpcService:
             return row["owner"], (), True
         return user, groups, False
 
-    def _wrap(self, fn):
+    def _wrap(self, fn, with_addresses: bool = False):
         def method(req: bytes) -> bytes:
             m, _ = wire.unpack(req)
             try:
@@ -362,7 +365,25 @@ class OmGrpcService:
                     out = fn(m)
             except OMError as e:
                 raise StorageError(e.code, e.msg)
-            return wire.pack({"result": out})
+            resp = {"result": out}
+            if with_addresses:
+                # located reads: the reference's OmKeyLocationInfo
+                # carries DatanodeDetails for the key's pipelines only,
+                # so a reader that never wrote (a gateway, a fresh
+                # client) can resolve those nodes without a prior SCM
+                # round trip — and a metadata-only lookup (dir marker,
+                # zero block groups) stays O(1), not O(cluster)
+                nodes = {n for g in (out or {}).get("block_groups", [])
+                         for n in g.get("nodes", [])}
+                if nodes:
+                    book = self.addresses_provider()
+                    resp["addresses"] = {
+                        n: book[n] for n in nodes if n in book}
+                    if self.locations_provider:
+                        locs = self.locations_provider()
+                        resp["locations"] = {
+                            n: locs[n] for n in nodes if n in locs}
+            return wire.pack(resp)
 
         return method
 
@@ -627,12 +648,8 @@ class GrpcOmClient:
             excluded=excluded or [],
             excluded_containers=list(excluded_containers or ()),
         )
-        g = m["group"]
-        if self.clients is not None:
-            for dn_id, addr in m.get("addresses", {}).items():
-                self.clients.update_remote(dn_id, addr)
-            self.clients.learn_locations(m.get("locations", {}))
-        return BlockGroup.from_json(g)
+        self._learn_from(m)
+        return BlockGroup.from_json(m["group"])
 
     def commit_key(self, session, groups, size, hsync=False):
         self._call(
@@ -697,14 +714,25 @@ class GrpcOmClient:
         return self._call("SnapshotKeys", volume=volume, bucket=bucket,
                           name=name)["result"]
 
+    def _learn_from(self, m: dict):
+        """Adopt the address book riding a located response (lookups
+        and allocations both carry the OmKeyLocationInfo
+        DatanodeDetails analog) so this client can read keys it never
+        wrote. Returns the response's result payload, if any."""
+        if self.clients is not None:
+            for dn_id, addr in m.get("addresses", {}).items():
+                self.clients.update_remote(dn_id, addr)
+            self.clients.learn_locations(m.get("locations", {}))
+        return m.get("result")
+
     def snapshot_lookup_key(self, volume, bucket, name, key):
-        return self._call("SnapshotLookupKey", volume=volume,
-                          bucket=bucket, name=name, key=key)["result"]
+        return self._learn_from(self._call(
+            "SnapshotLookupKey", volume=volume,
+            bucket=bucket, name=name, key=key))
 
     def lookup_key(self, volume, bucket, key):
-        return self._call("LookupKey", volume=volume, bucket=bucket, key=key)[
-            "result"
-        ]
+        return self._learn_from(self._call(
+            "LookupKey", volume=volume, bucket=bucket, key=key))
 
     def key_block_groups(self, info):
         out = [BlockGroup.from_json(g) for g in info["block_groups"]]

@@ -347,7 +347,15 @@ def cmd_sh(args) -> int:
                         args.replication if args.replication else None)
             print(f"wrote {len(data)} bytes to {args.path}")
         elif verb == "get":
-            data = b.read_key(key)
+            if args.offset or args.length is not None:
+                info = b.lookup_key_info(key)
+                size = int(info["size"])
+                off = min(max(0, args.offset), size)
+                ln = (size - off if args.length is None
+                      else max(0, min(args.length, size - off)))
+                data = b.read_key_info_range(info, off, ln)
+            else:
+                data = b.read_key(key)
             out = Path(args.file) if args.file else None
             if out:
                 out.write_bytes(data.tobytes())
@@ -1292,6 +1300,10 @@ def build_parser() -> argparse.ArgumentParser:
     sh.add_argument("--to", default="", help="rename target")
     sh.add_argument("--prefix", default="",
                     help="key list: name prefix filter")
+    sh.add_argument("--offset", type=int, default=0,
+                    help="key get: positioned read start byte")
+    sh.add_argument("--length", type=int, default=None,
+                    help="key get: positioned read byte count")
     sh.add_argument("--start-after", default="",
                     help="key list: resume after this key (paging)")
     sh.add_argument("--limit", type=int, default=None,

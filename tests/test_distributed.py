@@ -96,6 +96,27 @@ def test_ec_key_over_grpc(cluster):
 
 
 
+def test_fresh_client_reads_via_located_lookup(cluster):
+    """A client (or gateway) that never wrote and never fetched the SCM
+    topology must still read: key lookups carry the datanode address
+    book (the OmKeyLocationInfo DatanodeDetails analog)."""
+    meta, dns = cluster
+    writer = _client(meta)
+    b = writer.create_volume("lv").create_bucket("lb", replication=EC)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, 40_000, dtype=np.uint8)
+    b.write_key("k", data)
+
+    reader = _client(meta)  # fresh factory: EMPTY address book
+    rb = reader.get_volume("lv").get_bucket("lb")
+    assert np.array_equal(rb.read_key("k"), data)
+    # positioned read on another fresh client
+    reader2 = _client(meta)
+    got = reader2.get_volume("lv").get_bucket("lb").read_key_range(
+        "k", 10_000, 5_000)
+    assert np.array_equal(got, data[10_000:15_000])
+
+
 def _await_replica_rebuild(meta, groups, victim_id,
                            timeout_s: float = 20.0) -> None:
     """Wait until every group's full replica-index set exists off the

@@ -117,6 +117,38 @@ def test_s3_multipart_abort(s3):
     assert ei.value.code == 404
 
 
+def test_lazy_file_handle_windows(cluster):
+    """open() is lazy (round 4): one metadata lookup, bytes fetched in
+    positioned readahead windows — a seek never materializes the
+    skipped range, and sequential reads coalesce into few fetches."""
+    from ozone_tpu.gateway.fs import OzoneFile
+
+    oz = cluster.client()
+    b = oz.create_volume("lzv").create_bucket("lzb", replication=EC)
+    fs = OzoneFileSystem(b)
+    rng = np.random.default_rng(7)
+    data = bytes(rng.integers(0, 256, 64_000, dtype=np.uint8))
+    fs.create("/big", data)
+
+    calls: list[tuple[int, int]] = []
+    real = type(b).read_key_info_range
+
+    def spy(self, info, off, ln):
+        calls.append((off, ln))
+        return real(self, info, off, ln)
+
+    import unittest.mock as mock
+
+    with mock.patch.object(type(b), "read_key_info_range", spy), \
+            mock.patch.object(OzoneFile, "_READAHEAD", 16_000):
+        with fs.open("/big") as f:
+            assert f.read(10) == data[:10]       # fetch window 1
+            assert f.read(100) == data[10:110]   # served from buffer
+            f.seek(60_000)                       # skip most of the file
+            assert f.read() == data[60_000:]     # fetch tail only
+    assert calls == [(0, 16_000), (60_000, 4_000)]
+
+
 def test_s3_errors(s3):
     with pytest.raises(urllib.error.HTTPError) as ei:
         _req(s3, "GET", "/nosuchbucket?list-type=2")
