@@ -310,6 +310,42 @@ class ScmGrpcService:
 
             health = ReconScmView(scm).container_health()
             out = {k: len(v) for k, v in health.items()}
+        elif op == "container-info":
+            c = scm.containers.get_or_none(int(target))
+            if c is None:
+                raise StorageError("CONTAINER_NOT_FOUND",
+                                   f"no container {target}")
+            out = {
+                "id": c.id,
+                "state": c.state.value,
+                "replication": str(c.replication),
+                "pipeline": c.pipeline.id if c.pipeline else None,
+                "nodes": c.pipeline.nodes if c.pipeline else [],
+                "used_bytes": c.used_bytes,
+                "replicas": [
+                    {"dn_id": r.dn_id, "state": r.state,
+                     "replica_index": r.replica_index,
+                     "block_count": r.block_count,
+                     "used_bytes": r.used_bytes}
+                    for r in list(c.replicas.values())
+                ],
+            }
+        elif op == "container-report":
+            # ReplicationManagerReport analog (admin container report):
+            # container-state census + replication-health census in one
+            # view (tools/.../container/ReportSubcommand.java)
+            from collections import Counter
+
+            from ozone_tpu.recon.recon import ReconScmView
+
+            states = Counter(
+                c.state.value for c in scm.containers.containers())
+            health = ReconScmView(scm).container_health()
+            out = {
+                "containers_total": sum(states.values()),
+                "states": dict(states),
+                "health": {k: len(v) for k, v in health.items()},
+            }
         else:
             raise StorageError("UNSUPPORTED_REQUEST", f"admin op {op!r}")
         return wire.pack(out)

@@ -525,11 +525,18 @@ def cmd_admin(args) -> int:
             if not target:
                 return usage("container close requires a container id")
             _emit(scm.admin("close-container", target))
+        elif verb == "info":
+            if not target:
+                return usage("container info requires a container id")
+            _emit(scm.admin("container-info", target))
+        elif verb == "report":
+            # ReplicationManagerReport analog: state + health census
+            _emit(scm.admin("container-report"))
         elif verb in (None, "list"):
             _emit(scm.list_containers())
         else:
             return usage(f"unknown container verb {verb!r} "
-                         "(expected list|close <id>)")
+                         "(expected list|info <id>|report|close <id>)")
     elif subject == "balancer":
         if verb not in (None, "status", "start", "stop"):
             return usage(f"unknown balancer verb {verb!r} "

@@ -131,6 +131,20 @@ def test_cli_admin_operator_verbs(cluster, capsys):
                      "--om", om]) == 0
     assert json.loads(capsys.readouterr().out)["op_state"] == "IN_SERVICE"
 
+    # container census + single-container detail (ReportSubcommand /
+    # InfoSubcommand analogs)
+    assert cli_main(["admin", "container", "report", "--om", om]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert {"containers_total", "states", "health"} <= set(rep)
+    assert rep["containers_total"] >= 1
+    assert cli_main(["admin", "container", "list", "--om", om]) == 0
+    cid = str(json.loads(capsys.readouterr().out)[0]["id"])
+    assert cli_main(["admin", "container", "info", cid, "--om", om]) == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["id"] == int(cid) and "replicas" in info
+    assert cli_main(["admin", "container", "info", "999999",
+                     "--om", om]) == 1  # unknown id: clean error
+
 
 def test_cli_om_prepare_quiesces_writes(cluster, capsys):
     """`admin om prepare` flushes and rejects writes until
