@@ -164,6 +164,12 @@ def test_ranged_reads_match_slices(cluster):
         cluster.reader(g).read(0, g.length + 1)
     with pytest.raises(ValueError):
         cluster.reader(g).read(-1, 1)
+    # randomized sweep: any (offset, length) equals the slice
+    for _ in range(20):
+        off = int(rng.integers(0, g.length))
+        ln = int(rng.integers(0, g.length - off + 1))
+        got = cluster.reader(g).read(off, ln)
+        assert np.array_equal(got, data[off:off + ln]), (off, ln)
     # degrade: drop one data unit and one parity unit
     for u in (1, 4):
         dn = next(d for d in cluster.dns if d.id == g.pipeline.nodes[u])
@@ -172,6 +178,12 @@ def test_ranged_reads_match_slices(cluster):
         got = cluster.reader(g).read(off, ln)
         assert np.array_equal(got, data[off:off + ln]), \
             f"degraded range ({off},{ln})"
+    for _ in range(20):
+        off = int(rng.integers(0, g.length))
+        ln = int(rng.integers(0, g.length - off + 1))
+        got = cluster.reader(g).read(off, ln)
+        assert np.array_equal(got, data[off:off + ln]), \
+            f"degraded random range ({off},{ln})"
 
 
 def test_replicated_ranged_read(cluster):
