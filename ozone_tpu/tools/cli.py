@@ -1385,7 +1385,8 @@ def build_parser() -> argparse.ArgumentParser:
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"
                          "recommission|maintenance <id>; balancer: "
-                         "start|stop|status")
+                         "start|stop|status; container: "
+                         "list|info <id>|report|close <id>")
     ad.add_argument("target", nargs="?", default=None,
                     help="datanode id for decommission/recommission/"
                          "maintenance")
