@@ -195,6 +195,8 @@ class DatanodeGrpcService:
         self._require_block(header, "WRITE", block_id)
         sync = bool(header.get("sync", False))
         writer = header.get("writer")
+        self.dn.metrics.counter("batched_write_streams").inc()
+        n_chunks = 0
         for frame in it:
             m, payload = wire.unpack(frame)
             self.dn.write_chunk(
@@ -204,6 +206,8 @@ class DatanodeGrpcService:
                 sync=sync,
                 writer=writer,
             )
+            n_chunks += 1
+        self.dn.metrics.counter("batched_write_chunks").inc(n_chunks)
         commit = header.get("commit")
         if commit is not None:
             bd = BlockData.from_json(commit)
@@ -308,6 +312,9 @@ class DatanodeGrpcService:
         block_id = BlockID.from_json(m["block_id"])
         self._require_block(m, "READ", block_id)
         verify = m.get("verify", False)
+        self.dn.metrics.counter("batched_read_streams").inc()
+        self.dn.metrics.counter("batched_read_chunks").inc(
+            len(m["chunks"]))
         for ch in m["chunks"]:
             data = self.dn.read_chunk(
                 block_id, ChunkInfo.from_json(ch), verify=verify)

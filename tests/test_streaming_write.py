@@ -83,6 +83,9 @@ def test_write_chunks_commit_roundtrip(dn):
         assert np.array_equal(
             got, np.concatenate([d for _, d in chunks]))
         assert c.get_committed_block_length(bid) == off
+        snap = dn.dn.metrics.snapshot()
+        assert snap["batched_write_streams"] >= 1
+        assert snap["batched_write_chunks"] >= 4
     finally:
         c.close()
 
