@@ -725,6 +725,11 @@ def cmd_freon(args) -> int:
     elif args.generator == "ockr":
         oz = _client(args)
         _emit(freon.ockr(oz, args.num, threads=args.threads).summary())
+    elif args.generator == "ockrr":
+        oz = _client(args)
+        _emit(freon.ockrr(oz, args.num, size=args.size,
+                          threads=args.threads,
+                          n_keys=args.keys).summary())
     elif args.generator == "ockv":
         oz = _client(args)
         _emit(freon.ockv(oz, n_keys=args.num, size=args.size,
@@ -1413,13 +1418,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     fr = sub.add_parser("freon", help="load generators")
     fr.add_argument("generator",
-                    choices=["ockg", "ockr", "ockv", "ecrd", "rawcoder", "omkg",
+                    choices=["ockg", "ockr", "ockrr", "ockv", "ecrd",
+                             "rawcoder", "omkg",
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
                              "dcb", "dcv", "dsg", "hsg", "dnbp", "ralg",
                              "fskg", "mpug", "s3kg", "fsg", "sdg",
                              "dnsim"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
+    fr.add_argument("--keys", type=int, default=1,
+                    help="ockrr: size of the key pool to range-read over")
     fr.add_argument("--warmup", type=int, default=0,
                     help="unmeasured warm-up keys before the clock "
                     "(absorbs the first-dispatch XLA compile)")

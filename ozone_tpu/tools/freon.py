@@ -221,6 +221,31 @@ def ockr(client, n_keys: int, threads: int = 4, volume: str = "freon-vol",
     return BaseFreonGenerator("ockr", n_keys, threads).run(op)
 
 
+def ockrr(client, n_reads: int, threads: int = 4, size: int = 65536,
+          volume: str = "freon-vol", bucket: str = "freon-bucket",
+          prefix: str = "key", n_keys: int = 0) -> FreonReport:
+    """Random ranged-read generator over ockg output: each op reads
+    `size` bytes at a random offset of a random key through the
+    positioned path (round 4 — only the covering cells move). `n_keys`
+    bounds the key pool (0 = probe with key 0's size and assume `n_reads`
+    keys are NOT required; the pool is keys 0..max(1, n_keys)-1)."""
+    b = client.get_volume(volume).get_bucket(bucket)
+    rng = np.random.default_rng(4)
+    pool = max(1, n_keys)
+    # one metadata probe sizes the keys (ockg writes equal sizes)
+    key_size = int(b.lookup_key_info(f"{prefix}-0")["size"])
+    span = max(1, key_size - size + 1)
+
+    def op(i: int) -> int:
+        key = f"{prefix}-{int(rng.integers(0, pool))}"
+        off = int(rng.integers(0, span))
+        ln = min(size, key_size - off)
+        data = b.read_key_range(key, off, ln)
+        return int(data.size)
+
+    return BaseFreonGenerator("ockrr", n_reads, threads).run(op)
+
+
 def _ensure_container(clients, dn_ids: list[str], container_id: int) -> None:
     """Idempotently create the bench container on every target datanode."""
     from ozone_tpu.storage.ids import StorageError

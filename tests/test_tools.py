@@ -45,6 +45,10 @@ def test_freon_ockg_and_read(cluster):
     assert s["ops_per_s"] > 0
     rep2 = freon.ockr(oz, 12, threads=3)
     assert rep2.summary()["failures"] == 0
+    # ranged-read generator over the same keys (positioned path)
+    rep3 = freon.ockrr(oz, 20, threads=3, size=1500, n_keys=12)
+    s3 = rep3.summary()
+    assert s3["ops"] == 20 and s3["failures"] == 0
 
 
 def test_freon_rawcoder_matrix():
