@@ -16,6 +16,14 @@ TPU-first divergence: the reference encodes one stripe at a time per
 client thread; here complete stripes accumulate in a queue and are encoded
 (+ CRC'd) in ONE fused device dispatch per batch (vmap over the stripe
 axis), with per-chunk checksums coming back from the same pass.
+
+Transport (round 4): each encoded run of stripes bound for one group
+travels as ONE WriteChunksCommit stream per unit — all the run's chunk
+frames plus the piggybacked putBlock (the PutBlock-piggybacking analog,
+BlockOutputStream.allowPutBlockPiggybacking generalized to N chunks) —
+so the round trip is paid once per run, not twice per stripe. Ack
+watermark and rollback are then run-granular; members that refuse the
+verb downgrade the writer to the per-stripe path mid-write.
 """
 
 from __future__ import annotations
