@@ -96,6 +96,12 @@ def test_s3_multipart_upload(s3):
     assert r.status == 200
     got = _req(s3, "GET", "/b1/big").read()
     assert got == b"".join(parts)
+    # ranged GET across the part boundary rides the positioned path
+    whole = b"".join(parts)
+    r = _req(s3, "GET", "/b1/big",
+             headers={"Range": "bytes=8500-9500"})
+    assert r.status == 206
+    assert r.read() == whole[8500:9501]
     # upload state cleaned up at the OM
     with pytest.raises(urllib.error.HTTPError) as ei:
         _req(s3, "GET", f"/b1/big?uploadId={upload_id}")
