@@ -19,11 +19,13 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.dn_client import (
+    DatanodeClientFactory,
+    batch_unsupported as _batch_unsupported,
+)
 from ozone_tpu.client.ec_writer import (
     BlockGroup,
     StripeWriteError,
-    _batch_unsupported,
     call_allocate,
     create_group_containers,
 )

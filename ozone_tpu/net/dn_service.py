@@ -96,6 +96,24 @@ class DatanodeGrpcService:
         except TokenError as e:
             raise StorageError(BLOCK_TOKEN_VERIFICATION_FAILED, str(e))
 
+    def _require_streaming_layout(self, verb: str) -> None:
+        """Layout gate shared by the streaming-write verbs (the DN side
+        of RequestFeatureValidator gating)."""
+        if self.layout is None:
+            return
+        from ozone_tpu.utils.upgrade import (
+            PRE_FINALIZE_ERROR,
+            RATIS_STREAMING_WRITE,
+        )
+
+        if not self.layout.is_allowed(RATIS_STREAMING_WRITE):
+            raise StorageError(
+                PRE_FINALIZE_ERROR,
+                f"{verb} needs layout feature "
+                f"{RATIS_STREAMING_WRITE.name} "
+                f"(v{RATIS_STREAMING_WRITE.version}); datanode is at "
+                f"layout {self.layout.metadata_version}")
+
     def _stream_write_block(self, frames) -> bytes:
         """Streaming block write (the Ratis DataStream / StreamInit path:
         KeyValueHandler.java:273, client BlockDataStreamOutput): frame 0 is
@@ -106,19 +124,7 @@ class DatanodeGrpcService:
         the response is the committed BlockData."""
         from ozone_tpu.utils.checksum import Checksum, ChecksumType
 
-        if self.layout is not None:
-            from ozone_tpu.utils.upgrade import (
-                PRE_FINALIZE_ERROR,
-                RATIS_STREAMING_WRITE,
-            )
-
-            if not self.layout.is_allowed(RATIS_STREAMING_WRITE):
-                raise StorageError(
-                    PRE_FINALIZE_ERROR,
-                    f"StreamWriteBlock needs layout feature "
-                    f"{RATIS_STREAMING_WRITE.name} "
-                    f"(v{RATIS_STREAMING_WRITE.version}); datanode is at "
-                    f"layout {self.layout.metadata_version}")
+        self._require_streaming_layout("StreamWriteBlock")
         it = iter(frames)
         header, _ = wire.unpack(next(it))
         block_id = BlockID.from_json(header["block_id"])
@@ -176,19 +182,7 @@ class DatanodeGrpcService:
         boundaries (the EC writer's device-CRC'd cells land untouched);
         the commit applies only after every chunk landed, so a failure
         anywhere aborts the stream before the block record moves."""
-        if self.layout is not None:
-            from ozone_tpu.utils.upgrade import (
-                PRE_FINALIZE_ERROR,
-                RATIS_STREAMING_WRITE,
-            )
-
-            if not self.layout.is_allowed(RATIS_STREAMING_WRITE):
-                raise StorageError(
-                    PRE_FINALIZE_ERROR,
-                    f"WriteChunksCommit needs layout feature "
-                    f"{RATIS_STREAMING_WRITE.name} "
-                    f"(v{RATIS_STREAMING_WRITE.version}); datanode is at "
-                    f"layout {self.layout.metadata_version}")
+        self._require_streaming_layout("WriteChunksCommit")
         it = iter(frames)
         header, _ = wire.unpack(next(it))
         block_id = BlockID.from_json(header["block_id"])

@@ -24,7 +24,10 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass
 
-from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.dn_client import (
+    DatanodeClientFactory,
+    write_unit_batched,
+)
 from ozone_tpu.client.ec_reader import ECBlockGroupReader, unit_true_lengths
 from ozone_tpu.client.ec_writer import BlockGroup
 from ozone_tpu.codec.api import CoderOptions
@@ -224,8 +227,6 @@ class ECReconstructionCoordinator:
             )
             # one batched stream per rebuilt unit when the target serves
             # it, per-chunk verbs against older/pre-finalize targets
-            from ozone_tpu.client.dn_client import write_unit_batched
-
             write_unit_batched(dn, group.block_id, pairs, commit)
             self.metrics.counter("blocks_reconstructed").inc()
             self.metrics.counter("bytes_reconstructed").inc(

@@ -235,12 +235,14 @@ def ockrr(client, n_reads: int, threads: int = 4, size: int = 65536,
     # one metadata probe sizes the keys (ockg writes equal sizes)
     key_size = int(b.lookup_key_info(f"{prefix}-0")["size"])
     span = max(1, key_size - size + 1)
+    # pre-drawn schedule: worker threads must not share a Generator
+    keys = rng.integers(0, pool, size=n_reads)
+    offs = rng.integers(0, span, size=n_reads)
 
     def op(i: int) -> int:
-        key = f"{prefix}-{int(rng.integers(0, pool))}"
-        off = int(rng.integers(0, span))
+        off = int(offs[i])
         ln = min(size, key_size - off)
-        data = b.read_key_range(key, off, ln)
+        data = b.read_key_range(f"{prefix}-{int(keys[i])}", off, ln)
         return int(data.size)
 
     return BaseFreonGenerator("ockrr", n_reads, threads).run(op)
