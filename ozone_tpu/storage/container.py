@@ -34,12 +34,32 @@ from ozone_tpu.storage.ids import (
 log = logging.getLogger(__name__)
 
 
+def _guard_sqlite(fn):
+    """Surface a failing disk as StorageError(IO_EXCEPTION) instead of a
+    raw sqlite3 error (the reference maps RocksDB failures to
+    StorageContainerException): daemon RPC guards, the writers'
+    exclude-and-reallocate handlers, and the volume-failure sweep all
+    key off StorageError, and in-process callers (minicluster, embedded
+    use) must see the same contract as the wire."""
+    import functools
+
+    @functools.wraps(fn)
+    def inner(*a, **kw):
+        try:
+            return fn(*a, **kw)
+        except sqlite3.Error as e:
+            raise StorageError("IO_EXCEPTION", f"container db: {e}")
+
+    return inner
+
+
 class VolumeDB:
     """Per-volume block-metadata store (schema V3 analog). With
     readonly=True the sqlite file opens in mode=ro and no DDL runs —
     the offline debug tools can inspect a failing disk remounted
     read-only without writing a byte."""
 
+    @_guard_sqlite
     def __init__(self, path: Path, readonly: bool = False):
         self._path = path
         self._lock = threading.Lock()
@@ -57,6 +77,7 @@ class VolumeDB:
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.commit()
 
+    @_guard_sqlite
     def put_block(self, block: BlockData) -> None:
         with self._lock:
             self._conn.execute(
@@ -69,6 +90,7 @@ class VolumeDB:
             )
             self._conn.commit()
 
+    @_guard_sqlite
     def get_block(self, block_id: BlockID) -> Optional[BlockData]:
         with self._lock:
             row = self._conn.execute(
@@ -77,6 +99,7 @@ class VolumeDB:
             ).fetchone()
         return BlockData.from_json(json.loads(row[0])) if row else None
 
+    @_guard_sqlite
     def list_blocks(self, container_id: int) -> list[BlockData]:
         with self._lock:
             rows = self._conn.execute(
@@ -85,6 +108,7 @@ class VolumeDB:
             ).fetchall()
         return [BlockData.from_json(json.loads(r[0])) for r in rows]
 
+    @_guard_sqlite
     def delete_block(self, block_id: BlockID) -> None:
         with self._lock:
             self._conn.execute(
@@ -93,6 +117,7 @@ class VolumeDB:
             )
             self._conn.commit()
 
+    @_guard_sqlite
     def delete_container(self, container_id: int) -> None:
         with self._lock:
             self._conn.execute(

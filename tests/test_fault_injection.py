@@ -99,6 +99,69 @@ except StorageError as e:
     assert "CORRUPTION_DETECTED" in r.stdout, r.stdout + r.stderr
 
 
+def test_batched_ec_write_survives_pwrite_faults(tmp_path):
+    """Round-4 batched write path under REAL syscall faults: one
+    datanode whose chunk pwrites fail with EIO is excluded mid-write
+    (run rollback + fresh group) and the key lands byte-exact on the
+    healthy members."""
+    fi = FaultInjector(tmp_path)  # rules start empty: datanodes (and
+    bad_root = tmp_path / "dn0"   # their volume DBs) must boot healthy
+    code = f"""
+import itertools
+import os
+import time
+import numpy as np
+from pathlib import Path
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.ec_reader import ECBlockGroupReader
+from ozone_tpu.client.ec_writer import BlockGroup, ECKeyWriter
+from ozone_tpu.codec.api import CoderOptions
+from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+from ozone_tpu.storage.datanode import Datanode
+
+root = Path({str(tmp_path)!r})
+opts = CoderOptions(3, 2, "rs", cell_size=4096)
+dns = [Datanode(root / f"dn{{i}}", dn_id=f"dn{{i}}") for i in range(6)]
+clients = DatanodeClientFactory()
+for d in dns:
+    clients.register_local(d)
+cid, lid = itertools.count(1), itertools.count(1)
+
+# datanodes are up: NOW fail dn0's disk (live rules reload; the shim
+# compares whole-second mtimes, so bump well past the current one)
+rules = Path({str(fi.rules_path)!r})
+rules.write_text(f"pwrite {{root / 'dn0'}} fail EIO\\n"
+                 f"write {{root / 'dn0'}} fail EIO\\n")
+st = rules.stat()
+os.utime(rules, (st.st_atime, int(st.st_mtime) + 2))
+time.sleep(1.3)  # the shim's reload check is 1s-granular
+
+def allocate(excluded, ec=()):
+    nodes = [d.id for d in dns if d.id not in excluded][:5]
+    assert len(nodes) == 5, nodes
+    return BlockGroup(container_id=next(cid), local_id=next(lid),
+                      pipeline=Pipeline(ReplicationConfig.from_ec(opts),
+                                        nodes))
+
+w = ECKeyWriter(opts, allocate, clients, block_size=4 * 4096,
+                bytes_per_checksum=1024, stripe_batch=3)
+data = np.random.default_rng(0).integers(0, 256, 5 * 4096,
+                                         dtype=np.uint8)
+w.write(data)
+groups = w.close()
+assert "dn0" in w._excluded, w._excluded
+assert all("dn0" not in g.pipeline.nodes for g in groups)
+parts = [ECBlockGroupReader(g, opts, clients,
+                            bytes_per_checksum=1024).read_all()
+         for g in groups]
+got = np.concatenate(parts)
+assert np.array_equal(got, data), "data mismatch"
+print("FAULT_EXCLUDED_OK")
+"""
+    r = _run_py(code, {**fi.env(), "PYTHONPATH": os.getcwd()})
+    assert "FAULT_EXCLUDED_OK" in r.stdout, r.stdout + r.stderr
+
+
 def test_delay(tmp_path):
     fi = FaultInjector(tmp_path)
     target = tmp_path / "slow"
