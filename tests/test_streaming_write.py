@@ -158,6 +158,70 @@ def test_read_chunks_batched(dn):
         c.close()
 
 
+def test_write_unit_batched_fallback_classification():
+    """The shared helper downgrades to per-chunk verbs ONLY on
+    unsupported-verb errors; real faults propagate untouched."""
+    from ozone_tpu.client.dn_client import (
+        batch_unsupported,
+        write_unit_batched,
+    )
+    from ozone_tpu.storage.ids import BlockData, ChunkInfo
+    from ozone_tpu.utils.upgrade import PRE_FINALIZE_ERROR
+
+    bid = BlockID(1, 1)
+    info = ChunkInfo("c0", 0, 4)
+    commit = BlockData(bid, [info])
+
+    class Peer:
+        def __init__(self, err=None):
+            self.err = err
+            self.calls = []
+
+        def write_chunks_commit(self, *a, **kw):
+            self.calls.append("batched")
+            if self.err is not None:
+                raise self.err
+
+        def write_chunk(self, *a, **kw):
+            self.calls.append("chunk")
+
+        def put_block(self, *a, **kw):
+            self.calls.append("put")
+
+    # healthy peer: one batched call, no fallback
+    p = Peer()
+    write_unit_batched(p, bid, [(info, b"data")], commit)
+    assert p.calls == ["batched"]
+    # pre-finalize refusal: per-chunk replay
+    p = Peer(StorageError(PRE_FINALIZE_ERROR, "gated"))
+    write_unit_batched(p, bid, [(info, b"data")], commit)
+    assert p.calls == ["batched", "chunk", "put"]
+    # server without the verb (UNIMPLEMENTED detail): same replay
+    p = Peer(StorageError("IO_EXCEPTION", "StatusCode.UNIMPLEMENTED"))
+    write_unit_batched(p, bid, [(info, b"data")], commit)
+    assert p.calls == ["batched", "chunk", "put"]
+    # a REAL fault must propagate, never silently retried per-chunk
+    p = Peer(StorageError("IO_EXCEPTION", "disk on fire"))
+    with pytest.raises(StorageError):
+        write_unit_batched(p, bid, [(info, b"data")], commit)
+    assert p.calls == ["batched"]
+    # duck-typed client without the verb at all: straight per-chunk
+    class Bare:
+        calls: list = []
+
+        def write_chunk(self, *a, **kw):
+            Bare.calls.append("chunk")
+
+        def put_block(self, *a, **kw):
+            Bare.calls.append("put")
+
+    write_unit_batched(Bare(), bid, [(info, b"data")], commit)
+    assert Bare.calls == ["chunk", "put"]
+    # classifier sanity
+    assert not batch_unsupported(ValueError("x"))
+    assert not batch_unsupported(StorageError("UNAVAILABLE", "down"))
+
+
 def test_stream_write_empty_and_errors(dn):
     c = GrpcDatanodeClient("dn0", dn.address)
     try:
