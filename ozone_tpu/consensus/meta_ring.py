@@ -65,6 +65,15 @@ class MetaHARing(RaftSCM):
         idx = self.node.last_applied + 1
         if idx <= self._applied_floor:
             return None  # already durably applied before the restart
+        # atomic: this entry's mutations AND its raft_applied marker
+        # land in the same durable batch — a crash can neither tear a
+        # multi-row apply (lost-rename class) nor persist a marker
+        # ahead of its entry's rows (replay would skip a half-applied
+        # entry forever)
+        with self.om.store.atomic():
+            return self._apply_entry(data, idx)
+
+    def _apply_entry(self, data: dict, idx: int) -> Any:
         if "om" in data:
             if self.om.prepared:
                 # deterministic by log position: every entry after the

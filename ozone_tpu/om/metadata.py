@@ -11,6 +11,7 @@ waits on storage.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import sqlite3
 import threading
@@ -84,8 +85,34 @@ class OMMetadataStore:
         self._flush_cv = threading.Condition()
         self._flushed_txid = 0
         self._flushing = False
+        # atomic() nesting depth: >0 defers the flush_every auto-flush
+        self._defer = 0
 
     # ------------------------------------------------------------------ CRUD
+    @contextlib.contextmanager
+    def atomic(self):
+        """One request's mutations land in ONE durable batch: the
+        flush_every auto-flush is deferred inside the block, so a
+        multi-row apply (rename's delete+put, a multipart commit) can
+        never be SPLIT across sqlite commits by the batch boundary — a
+        crash between the halves would tear the request (a renamed key
+        readable under NEITHER name, and replay cannot redo it because
+        the re-apply deterministically fails KEY_NOT_FOUND). The
+        reference gets this from the RocksDB double buffer: one batch
+        per transaction (OzoneManagerDoubleBuffer.flushTransactions).
+        Explicit flush()/flush_group() calls still flush — they commit
+        whole batches, which is exactly the guarantee."""
+        with self._lock:
+            self._defer += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._defer -= 1
+                if not self._defer and \
+                        len(self._dirty) >= self.flush_every:
+                    self._flush_locked()
+
     def put(self, table: str, key: str, value: dict,
             journal: bool = True) -> None:
         """`journal=False` skips the update journal (NOT durability):
@@ -98,7 +125,7 @@ class OMMetadataStore:
             self._txid += 1
             if journal:
                 self._journal(table, key, value)
-            if len(self._dirty) >= self.flush_every:
+            if not self._defer and len(self._dirty) >= self.flush_every:
                 self._flush_locked()
 
     def delete(self, table: str, key: str, journal: bool = True) -> None:
@@ -108,7 +135,7 @@ class OMMetadataStore:
             self._txid += 1
             if journal:
                 self._journal(table, key, None)
-            if len(self._dirty) >= self.flush_every:
+            if not self._defer and len(self._dirty) >= self.flush_every:
                 self._flush_locked()
 
     def _journal(self, table: str, key: str, value: Optional[dict]) -> None:

@@ -327,7 +327,10 @@ class OzoneManager:
                         "OM is prepared for upgrade; writes are rejected "
                         "until cancelprepare")
                 try:
-                    result = request.apply(self.store)
+                    # atomic: one request's rows are never split across
+                    # durable batches (metadata.OMMetadataStore.atomic)
+                    with self.store.atomic():
+                        result = request.apply(self.store)
                 except rq.OMError as e:
                     self.audit.log(request.audit_action, vars(request),
                                    ok=False, error=e.code)

@@ -107,6 +107,46 @@ def test_follower_gap_catch_up(tmp_path):
     assert f1.om.bucket_info("v", "b")["name"] == "b"
 
 
+def test_atomic_apply_never_tears_across_flush_boundary(tmp_path):
+    """A multi-row request (rename = delete+put) must land in ONE
+    durable batch: with flush_every=1 and no atomic(), the delete would
+    commit alone, and a crash before the put loses the key under BOTH
+    names — the round-4 soak's lost-rename failure. atomic() defers the
+    auto-flush so the disk only ever shows both-or-neither."""
+    from ozone_tpu.om.metadata import OMMetadataStore, key_key
+
+    db = tmp_path / "atomic.db"
+    store = OMMetadataStore(db, flush_every=1)
+    src, dst = key_key("v", "b", "k"), key_key("v", "b", "k2")
+    store.put("keys", src, {"name": "k", "size": 1})
+    store.flush()
+
+    flushes: list[int] = []
+    orig = store._flush_locked
+
+    def counting_flush():
+        flushes.append(1)
+        orig()
+
+    store._flush_locked = counting_flush
+    with store.atomic():
+        rq.RenameKey("v", "b", "k", "k2").apply(store)
+        assert flushes == [], "a commit escaped mid-request"
+        # simulated crash INSIDE the request: the disk image must still
+        # hold the ORIGINAL row (both-or-neither, never neither)
+        crash = OMMetadataStore(db, flush_every=100)
+        assert crash.get("keys", src) is not None
+        assert crash.get("keys", dst) is None
+        crash.close()
+    assert len(flushes) == 1  # one batch carried both rows
+    store.flush()
+    after = OMMetadataStore(db, flush_every=100)
+    assert after.get("keys", src) is None
+    assert after.get("keys", dst) is not None
+    after.close()
+    store.close()
+
+
 def test_flush_group_commit_batches_and_propagates(tmp_path):
     """Group commit (OzoneManagerDoubleBuffer.flushTransactions:293
     analog): concurrent appliers share sqlite commits, everything acked
