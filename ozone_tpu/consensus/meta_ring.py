@@ -125,6 +125,16 @@ class MetaHARing(RaftSCM):
             # the cached flag so a snapshot-installed replica agrees with
             # its peers on prepared state
             self.om.reload_prepared()
+            # CRITICAL: the replay floor must be re-derived from the
+            # RESTORED store, not kept from the pre-restore sqlite. At
+            # restart the node restores its last COMPACTION snapshot —
+            # usually OLDER than the sqlite state — and replays the log
+            # forward; a floor captured before the revert would skip
+            # every entry between the snapshot point and the old floor,
+            # silently LOSING that whole window of acked writes (the
+            # soak's contiguous-range key loss, round 4)
+            row = self.om.store.get("system", "raft_applied")
+            self._applied_floor = int(row["index"]) if row else 0
         if "scm" in snap:
             self.scm.containers.install_snapshot(snap["scm"])
 

@@ -70,6 +70,67 @@ def _client(peers):
     return OzoneClient(om, clients, ratis_clients=ratis)
 
 
+def test_restart_with_compacted_log_keeps_post_snapshot_writes(ha_cluster):
+    """A replica restarting with a LOCAL compaction snapshot must not
+    lose the window between the snapshot point and its sqlite state: the
+    restart restores the (older) snapshot, and the replay floor must
+    follow the store down — a floor captured from the pre-restore sqlite
+    would skip replay of the reverted window, silently losing a
+    contiguous range of ACKED keys (the round-4 soak failure)."""
+    metas, dns, peers, tmp_path = ha_cluster
+    oz = _client(peers)
+    oz.create_volume("v")
+    b = oz.get_volume("v").create_bucket("b", replication=EC)
+    payload = np.random.default_rng(3).integers(
+        0, 256, 5_000, dtype=np.uint8).tobytes()
+    for i in range(5):
+        b.write_key(f"pre-{i}", payload)
+
+    leader_id = _await_leader(metas)
+    victim_id = next(m for m in metas if m != leader_id)
+    victim = metas[victim_id]
+    # wait for the victim to apply the pre-keys, then compact ITS log
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        names = {k["name"] for k in victim.om.list_keys("v", "b")}
+        if names >= {f"pre-{i}" for i in range(5)}:
+            break
+        time.sleep(0.1)
+    import dataclasses
+
+    victim.ha.node.config = dataclasses.replace(
+        victim.ha.node.config, snapshot_trailing=0)
+    victim.ha.node.take_snapshot()
+    assert victim.ha.node.storage.snapshot_index > 0
+
+    # acked writes PAST the victim's snapshot point
+    for i in range(5):
+        b.write_key(f"post-{i}", payload)
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        names = {k["name"] for k in victim.om.list_keys("v", "b")}
+        if names >= {f"post-{i}" for i in range(5)}:
+            break
+        time.sleep(0.1)
+
+    # restart the victim on the same dirs: restore + log replay must
+    # reproduce EVERY acked key, including the post-snapshot window
+    victim.stop()
+    revived = _make_meta(tmp_path, int(victim_id[1:]), peers)
+    revived.start()
+    metas[victim_id] = revived
+    expect = ({f"pre-{i}" for i in range(5)}
+              | {f"post-{i}" for i in range(5)})
+    deadline = time.monotonic() + 15.0
+    names: set = set()
+    while time.monotonic() < deadline:
+        names = {k["name"] for k in revived.om.list_keys("v", "b")}
+        if names >= expect:
+            break
+        time.sleep(0.2)
+    assert names >= expect, f"lost after restart: {expect - names}"
+
+
 def test_ha_write_read_failover_and_rejoin(ha_cluster):
     metas, dns, peers, tmp_path = ha_cluster
     oz = _client(peers)
