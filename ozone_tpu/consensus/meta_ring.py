@@ -110,7 +110,27 @@ class MetaHARing(RaftSCM):
             result = super()._apply(data)
         self._applied_floor = idx
         self.om.store.put("system", "raft_applied", {"index": idx})
+        if idx % 256 == 0:
+            # replica-divergence canary: every replica logs a
+            # deterministic digest of its OM keys table at the same log
+            # positions — a silent state divergence (KNOWN_ISSUES'
+            # residual chaos loss) becomes a grep-able first-mismatch
+            # window instead of a needle found hours later
+            log.info("state-digest node=%s idx=%d keys=%s",
+                     self.scm_id, idx, self._keys_digest())
         return result
+
+    def _keys_digest(self) -> str:
+        """Deterministic digest of the keys table (rows are replicated
+        verbatim, so equal state digests equal across replicas)."""
+        import hashlib
+        import json as _json
+
+        h = hashlib.md5()
+        for k, v in sorted(self.om.store.iterate("keys")):
+            h.update(k.encode())
+            h.update(_json.dumps(v, sort_keys=True).encode())
+        return h.hexdigest()[:16]
 
     def _snapshot_all(self) -> dict:
         return {
