@@ -1141,10 +1141,24 @@ class RaftNode:
         if not resp.get("ok"):
             return False
         with self._lock:
+            applied = resp["applied"]
+            if applied < self.storage.snapshot_index:
+                # the leader hasn't applied past our compaction point
+                # yet: older state could not be replayed forward from
+                # the local log — retry on a later tick
+                return False
             if self.restore_fn is not None:
                 self.restore_fn(resp["data"])
-            self.last_applied = max(self.last_applied, resp["applied"])
-            self.commit_index = max(self.commit_index, resp["applied"])
+            # the restored state IS the state at `applied`: move the
+            # apply position to EXACTLY that point — even BACKWARD.
+            # Entries this node applied while the fetch was in flight
+            # were just reverted by the restore; keeping the old
+            # position would skip their re-apply and silently lose
+            # their effects on this replica alone (the single-replica
+            # divergence window the soak's digest canary catches).
+            self.last_applied = applied
+            self.commit_index = max(self.commit_index, applied)
+            self._apply_committed()  # replay the reverted tail now
         return True
 
     # ----------------------------------------------------------- maintenance

@@ -259,6 +259,38 @@ def _mk_scm(n_dn=5):
     return scm
 
 
+def test_fetch_state_reapplies_entries_reverted_by_a_stale_snapshot(
+        tmp_path):
+    """fetch_state resync: if the fetched state lags the local apply
+    position (entries applied while the RPC was in flight), the restore
+    reverts their effects — the apply position must follow the state
+    DOWN and replay them from the local log, or this replica silently
+    diverges by exactly that window (the soak's single-replica key
+    loss; digest canary window (2048, 2304] in the captured run)."""
+    nodes, states, transport = make_cluster(tmp_path)
+    n0 = nodes[0]
+    assert n0.start_election()
+    for v in ["a", "b", "c", "d", "e"]:
+        n0.propose(v)
+    assert states[0] == ["a", "b", "c", "d", "e"]
+
+    # a stale fetch_state response: the "leader's" state as of entry 3
+    # (noop + a + b), while THIS node has applied through entry 6
+    stale = {"ok": True, "term": n0.storage.term,
+             "applied": 3, "data": states[0][:2]}
+    orig_send = transport.send
+    transport.send = lambda peer, verb, req: (
+        stale if verb == "fetch_state" else orig_send(peer, verb, req))
+    try:
+        assert n0.fetch_state_from("n1")
+    finally:
+        transport.send = orig_send
+    # the reverted tail replayed from the local log: state converged
+    # back to the full sequence and the position followed
+    assert states[0] == ["a", "b", "c", "d", "e"]
+    assert n0.last_applied == 6  # noop + 5 entries
+
+
 def test_raft_scm_deposed_leader_resyncs(tmp_path):
     """A minority-partitioned SCM leader whose local allocation never
     reached quorum must discard the phantom container when it rejoins
