@@ -225,6 +225,31 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
         _await_leader(metas, timeout=30)
         time.sleep(2.0)  # let heartbeats re-register restarted nodes
 
+        # 0. replica-state convergence: once every replica reaches the
+        # same applied position, their keys-table digests must be equal
+        # — this catches a SILENT divergence even when the sampled keys
+        # below happen to live on healthy replicas (the round-4
+        # single-replica loss class)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            positions = {m: d.ha.node.last_applied
+                         for m, d in metas.items()}
+            if len(set(positions.values())) == 1:
+                digests = {m: d.ha._keys_digest()
+                           for m, d in metas.items()}
+                if len(set(digests.values())) == 1:
+                    break
+                # positions equal but digests differ: give in-flight
+                # flushes a beat, then re-check (a true divergence
+                # stays diverged and fails below)
+            time.sleep(0.5)
+        else:
+            positions = {m: d.ha.node.last_applied
+                         for m, d in metas.items()}
+            digests = {m: d.ha._keys_digest() for m, d in metas.items()}
+            assert len(set(digests.values())) == 1, \
+                f"replica state diverged: {digests} at {positions}"
+
         # 1. every acked write reads back byte-exact. EVENTUALLY-
         # consistent like the reference chaos asserts: a replica the
         # chaos poisoned (UNHEALTHY after injected EIO/corruption) may
