@@ -230,12 +230,21 @@ class ReplicatedKeyWriter:
                 client = self.clients.get(dn_id)
                 fn = getattr(client, "write_chunks_commit", None)
                 if fn is None:
+                    # downgrade: members that already took the combined
+                    # call committed a record including the unacked
+                    # chunk — roll them back before the split replay, or
+                    # a replay that then fails (node down, new group)
+                    # leaves them durably committed above the finalized
+                    # length (the inflated-survivor state the EC
+                    # rollback tests forbid)
+                    self._rollback_combined(group, ok_nodes)
                     return None
                 fn(group.block_id, [(info, data)], commit=bd,
                    writer=self._writer_id)
                 ok_nodes.append(dn_id)
             except StorageError as e:
                 if _batch_unsupported(e):
+                    self._rollback_combined(group, ok_nodes)
                     return None
                 err = e
                 if e.code == "INVALID_CONTAINER_STATE":
@@ -247,18 +256,25 @@ class ReplicatedKeyWriter:
                 failed.append(dn_id)
                 err = e
         ok = not failed and not closed
-        if not ok and ok_nodes and self._chunks:
-            # best-effort, like the EC rollback; a member with no prior
-            # record keeps its orphan in a group that finalizes below it
-            prev = BlockData(group.block_id, list(self._chunks))
-            for dn_id in ok_nodes:
-                try:
-                    self.clients.get(dn_id).put_block(
-                        prev, writer=self._writer_id)
-                except (StorageError, KeyError, OSError) as e:
-                    log.warning("putBlock rollback failed on %s: %s",
-                                dn_id, e)
+        if not ok:
+            self._rollback_combined(group, ok_nodes)
         return ok, failed, closed, err
+
+    def _rollback_combined(self, group: BlockGroup,
+                           ok_nodes: list[str]) -> None:
+        """Best-effort return of combined-call members to the pre-chunk
+        record, like the EC rollback; a member with no prior record
+        keeps its orphan in a group that finalizes below it."""
+        if not ok_nodes or not self._chunks:
+            return
+        prev = BlockData(group.block_id, list(self._chunks))
+        for dn_id in ok_nodes:
+            try:
+                self.clients.get(dn_id).put_block(
+                    prev, writer=self._writer_id)
+            except (StorageError, KeyError, OSError) as e:
+                log.warning("putBlock rollback failed on %s: %s",
+                            dn_id, e)
 
     def _data_phase_ok(self, group: BlockGroup, failed: list[str]) -> bool:
         """Whether the chunk fan-out suffices to commit. Plain replication

@@ -1088,21 +1088,42 @@ class S3Gateway:
         meta = self._meta_headers_from(info)
         size = int(info["size"])
         rng = h.headers.get("Range")
+        ranged = False
+        lo = hi = 0
         if rng and rng.startswith("bytes="):
-            # ranged GET reads ONLY the covering cells/chunks (round-4
-            # positioned reads), not the whole key
             lo_s, _, hi_s = rng[6:].partition("-")
             if not lo_s:  # suffix form bytes=-N: the LAST N bytes
                 n = int(hi_s)
                 lo = max(0, size - n)
                 hi = size - 1
+                ranged = True
             else:
                 lo = int(lo_s)
-                hi = int(hi_s) if hi_s else size - 1
+                if hi_s and int(hi_s) < lo:
+                    # client-sent inverted range-spec: RFC 9110
+                    # §14.1.1 says the Range header is invalid and
+                    # MUST be ignored (full 200 body), matching real
+                    # S3 — not a 416
+                    ranged = False
+                else:
+                    hi = int(hi_s) if hi_s else size - 1
+                    ranged = True
+            if ranged and lo >= size:
+                # unsatisfiable range: 416 with the star form, never a
+                # 206 whose Content-Range would carry hi < lo (S3 /
+                # RFC 9110 §14.4 semantics)
+                status, body = _err(
+                    "InvalidRange",
+                    "The requested range is not satisfiable", 416)
+                h._reply(status, body,
+                         {"Content-Range": f"bytes */{size}"})
+                return
+        if ranged:
+            # ranged GET reads ONLY the covering cells/chunks (round-4
+            # positioned reads), not the whole key
             hi = min(hi, size - 1)
-            n = max(0, hi - lo + 1) if lo <= hi and lo < size else 0
-            part = (bh.read_key_info_range(info, lo, n).tobytes()
-                    if n else b"")
+            part = bh.read_key_info_range(info, lo,
+                                          hi - lo + 1).tobytes()
             h._reply(
                 206,
                 part,

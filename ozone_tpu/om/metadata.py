@@ -48,6 +48,24 @@ _TABLES = (
     "system",
 )
 
+#: tables with a maintained rolling state digest (the replica-divergence
+#: canary reads it O(1) instead of rescanning the table per sample)
+_DIGEST_TABLES = ("keys",)
+
+
+def _row_hash(key: str, value: dict) -> int:
+    return _row_hash_json(key, json.dumps(value, sort_keys=True))
+
+
+def _row_hash_json(key: str, dumped: str) -> int:
+    import hashlib
+
+    h = hashlib.md5()
+    h.update(key.encode())
+    h.update(b"\0")
+    h.update(dumped.encode())
+    return int.from_bytes(h.digest(), "big")
+
 
 class OMMetadataStore:
     def __init__(self, db_path: Path, flush_every: int = 64):
@@ -87,6 +105,61 @@ class OMMetadataStore:
         self._flushing = False
         # atomic() nesting depth: >0 defers the flush_every auto-flush
         self._defer = 0
+        # rolling per-table digests (XOR of per-row hashes): O(1) to
+        # read, O(1) to maintain per mutation — the divergence canary
+        # must not pay an O(table) rescan inside the serialized apply
+        # path (round-4 advisor finding). Persisted in `system` within
+        # the same sqlite commit as the rows it describes, so a reopened
+        # store trusts the row; absent (pre-upgrade dbs) -> one
+        # recompute scan at open.
+        self._digests: dict[str, int] = {}
+        # hash of each UNFLUSHED digested row as it was digested, keyed
+        # (table, key); 0 = digested as absent. The old-row hash must
+        # never be recomputed from the write-back cache: callers mutate
+        # fetched dicts in place before put(), so the cached "old" dict
+        # can alias the new value and the XOR would cancel.
+        self._digest_hashes: dict[tuple[str, str], int] = {}
+        for t in _DIGEST_TABLES:
+            row = self._conn.execute(
+                "SELECT v FROM system WHERE k=?", (f"__digest_{t}",)
+            ).fetchone()
+            if row is not None:
+                self._digests[t] = int(json.loads(row[0])["xor"], 16)
+            else:
+                self._digests[t] = self._scan_digest(t)
+
+    def _scan_digest(self, table: str) -> int:
+        d = 0
+        for k, v in self._conn.execute(f"SELECT k, v FROM {table}"):
+            d ^= _row_hash(k, json.loads(v))
+        return d
+
+    def table_digest(self, table: str) -> str:
+        """Deterministic state digest of a digested table (equal states
+        -> equal digests across replicas; XOR of row hashes, so the
+        value is independent of mutation order)."""
+        with self._lock:
+            return f"{self._digests[table]:032x}"
+
+    def _digest_mutate(self, table: str, key: str,
+                       dumped: Optional[str]) -> None:
+        """Caller holds self._lock; `dumped` is the canonical dump of
+        the new value (None = delete). The old-row hash comes from the
+        unflushed-hash map or a direct sqlite point read — NEVER from
+        the write-back cache, whose dicts alias values callers mutate
+        in place before put() (the XOR would cancel and the digest
+        silently diverge from the table)."""
+        if table not in self._digests:
+            return
+        hk = (table, key)
+        old = self._digest_hashes.get(hk)
+        if old is None:
+            row = self._conn.execute(
+                f"SELECT v FROM {table} WHERE k=?", (key,)).fetchone()
+            old = _row_hash(key, json.loads(row[0])) if row else 0
+        new = _row_hash_json(key, dumped) if dumped is not None else 0
+        self._digests[table] ^= old ^ new
+        self._digest_hashes[hk] = new
 
     # ------------------------------------------------------------------ CRUD
     @contextlib.contextmanager
@@ -119,9 +192,14 @@ class OMMetadataStore:
         bulk derived writes — snapshot materialization copies O(bucket)
         rows — would otherwise evict the live-mutation history that
         WAL-delta consumers (Recon, incremental snapdiff) depend on."""
+        # serialize at put time: the flushed row is then byte-identical
+        # to what was digested even if the caller keeps mutating the
+        # dict after put() (the cache serves the live dict either way)
+        dumped = json.dumps(value, sort_keys=True)
         with self._lock:
+            self._digest_mutate(table, key, dumped)
             self._cache[table][key] = value
-            self._dirty.append((table, key, value))
+            self._dirty.append((table, key, dumped))
             self._txid += 1
             if journal:
                 self._journal(table, key, value)
@@ -130,6 +208,7 @@ class OMMetadataStore:
 
     def delete(self, table: str, key: str, journal: bool = True) -> None:
         with self._lock:
+            self._digest_mutate(table, key, None)
             self._cache[table][key] = None
             self._dirty.append((table, key, None))
             self._txid += 1
@@ -290,19 +369,29 @@ class OMMetadataStore:
             return
         batch, self._dirty = self._dirty, []
         cur = self._conn.cursor()
-        for table, key, value in batch:
-            if value is None:
+        for table, key, dumped in batch:
+            if dumped is None:
                 cur.execute(f"DELETE FROM {table} WHERE k=?", (key,))
             else:
                 cur.execute(
                     f"INSERT OR REPLACE INTO {table} VALUES (?, ?)",
-                    (key, json.dumps(value)),
+                    (key, dumped),
                 )
+        # digest rows ride the same commit as the rows they describe, so
+        # a crash can never leave them disagreeing with the table
+        for t, d in self._digests.items():
+            cur.execute(
+                "INSERT OR REPLACE INTO system VALUES (?, ?)",
+                (f"__digest_{t}", json.dumps({"xor": f"{d:032x}"})),
+            )
         self._conn.commit()
         # cache entries are now durable; drop them so memory stays bounded
         flushed = {(t, k) for t, k, _ in batch}
         for t, k in flushed:
             self._cache[t].pop(k, None)
+            # flushed rows are re-hashable from sqlite (they now hold
+            # exactly the dump that was digested)
+            self._digest_hashes.pop((t, k), None)
 
     # --------------------------------------------------------------- snapshot
     def export_state(self) -> dict:
@@ -322,6 +411,7 @@ class OMMetadataStore:
         with self._lock:
             self._dirty.clear()
             self._updates.clear()
+            self._digest_hashes.clear()
             # shipped markers would index the SENDER's journal, not ours
             self.snapshot_markers.clear()
             cur = self._conn.cursor()
@@ -335,6 +425,14 @@ class OMMetadataStore:
                     )
             self._conn.commit()
             self._txid = max(self._txid, int(state.get("txid", 0)))
+            # the shipped system table carries the sender's digest rows
+            # for exactly the tables just installed; absent (older
+            # sender) -> recompute from the installed rows
+            shipped = state["tables"].get("system", {})
+            for t in self._digests:
+                row = shipped.get(f"__digest_{t}")
+                self._digests[t] = (int(row["xor"], 16) if row
+                                    else self._scan_digest(t))
 
     @property
     def txid(self) -> int:

@@ -413,7 +413,8 @@ class ContainerManager:
         excluded = excluded or []
         excluded_containers = set(excluded_containers or ())
         lid = self._issue_block_id()
-        new_ids: Optional[tuple[int, int]] = None  # (cid, pid) pre-issued
+        # (cid, pid, issue-epoch) pre-issued outside the container lock
+        new_ids: Optional[tuple[int, int, int]] = None
         while True:
             with self._lock:
                 key = str(replication)
@@ -437,9 +438,13 @@ class ContainerManager:
                     self._persist(c)
                     if new_ids is not None and self.id_source is not None:
                         # speculative ids unused: back to the free list
-                        # (never exposed, still unique-by-construction)
-                        self.id_source.release("container", new_ids[0])
-                        self.id_source.release("pipeline", new_ids[1])
+                        # (never exposed, still unique-by-construction).
+                        # The issue-time epoch makes the return a no-op
+                        # when a step-down burned the batch meanwhile.
+                        self.id_source.release("container", new_ids[0],
+                                               epoch=new_ids[2])
+                        self.id_source.release("pipeline", new_ids[1],
+                                               epoch=new_ids[2])
                     return BlockGroup(
                         container_id=cid,
                         local_id=lid,
@@ -462,8 +467,9 @@ class ContainerManager:
                         local_id=lid,
                         pipeline=c.pipeline,
                     )
+            ep = self.id_source.epoch
             new_ids = (self.id_source.next("container"),
-                       self.id_source.next("pipeline"))
+                       self.id_source.next("pipeline"), ep)
 
     # --------------------------------------------------------------- lifecycle
     def _close_pipeline(self, c: ContainerInfo) -> None:

@@ -87,13 +87,27 @@ class SequenceIdGenerator:
                     # can ever reserve below the raised floor) but this
                     # node may no longer be entitled to serve
 
-    def release(self, kind: str, id_: int) -> None:
+    @property
+    def epoch(self) -> int:
+        """Invalidation epoch; capture BEFORE next() to hand release()
+        a token proving the id predates no step-down."""
+        with self._lock:
+            return self._epoch
+
+    def release(self, kind: str, id_: int, epoch: int | None = None) -> None:
         """Return a never-exposed id for reuse. Only ids obtained from
         next() may be released, and at most once — they re-enter the
         local free list, which is still unique-by-construction because
         no other node can ever reserve below this range's committed
-        ceiling."""
+        ceiling. `epoch` (captured via .epoch before the matching
+        next()) keeps the documented burn contract exact: if the
+        generator was invalidated since, the id belongs to a burned
+        batch and is dropped instead of re-entering the fresh free
+        list (a deposed-then-re-elected leader must not issue from a
+        batch its step-down burned)."""
         with self._lock:
+            if epoch is not None and epoch != self._epoch:
+                return
             self._free.setdefault(kind, []).append(id_)
 
     def invalidate(self) -> None:

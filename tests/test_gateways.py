@@ -65,6 +65,20 @@ def test_s3_object_put_get_range_delete(s3):
     r = _req(s3, "GET", "/b1/dir/obj1", headers={"Range": "bytes=100-199"})
     assert r.status == 206
     assert r.read() == payload[100:200]
+    # unsatisfiable range: 416 + star Content-Range, never a 206 whose
+    # header would carry hi < lo (S3 / RFC 9110 semantics)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _req(s3, "GET", "/b1/dir/obj1",
+             headers={"Range": "bytes=30000-"})
+    assert ei.value.code == 416
+    assert ei.value.headers["Content-Range"] == "bytes */30000"
+    assert b"InvalidRange" in ei.value.read()
+    # syntactically inverted spec: header ignored, full 200 body
+    # (RFC 9110 §14.1.1 / real-S3 behavior), not 416
+    r = _req(s3, "GET", "/b1/dir/obj1",
+             headers={"Range": "bytes=200-100"})
+    assert r.status == 200
+    assert r.read() == payload
     # list
     r = _req(s3, "GET", "/b1?list-type=2&prefix=dir/")
     tree = ET.fromstring(r.read())

@@ -67,6 +67,31 @@ def test_generator_invalidate_burns_batch():
     assert g.next("block") == 10
 
 
+def test_generator_release_after_invalidate_dropped():
+    """A speculative id released after a step-down belongs to a burned
+    batch: it must NOT re-enter the fresh free list (the documented
+    'unissued tails are burned, never re-issued' contract)."""
+    floors = [0]
+
+    def reserve(kind, count):
+        lo = floors[0]
+        floors[0] += count
+        return lo, lo + count
+
+    g = SequenceIdGenerator(reserve, batch_sizes={"block": 10})
+    ep = g.epoch
+    got = g.next("block")
+    assert got == 0
+    g.invalidate()  # step-down: batch 0..9 burned
+    g.release("block", got, epoch=ep)  # stale: dropped, not re-listed
+    assert g.next("block") == 10
+    # a release in the CURRENT epoch still reuses
+    ep2 = g.epoch
+    nxt = g.next("block")
+    g.release("block", nxt, epoch=ep2)
+    assert g.next("block") == nxt
+
+
 def test_generator_concurrent_next_unique():
     lock = threading.Lock()
     floors = [0]
