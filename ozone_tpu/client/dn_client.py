@@ -333,10 +333,13 @@ class DatanodeClientFactory:
                 return c
             addr = self._addresses.get(dn_id)
             if addr is not None:
-                from ozone_tpu.net.dn_service import GrpcDatanodeClient
+                # native-datapath-aware client: hot verbs ride the C++
+                # listener when the server advertises one, gRPC
+                # otherwise (and always for the control plane)
+                from ozone_tpu.client.native_dn import NativeDatanodeClient
 
-                c = GrpcDatanodeClient(dn_id, addr, tokens=self.tokens,
-                                       tls=self.tls)
+                c = NativeDatanodeClient(dn_id, addr, tokens=self.tokens,
+                                         tls=self.tls)
                 self._remote[dn_id] = c
                 return c
         return None

@@ -1,0 +1,353 @@
+"""Datanode client that rides the native datapath for the hot verbs.
+
+Extends GrpcDatanodeClient: control-plane verbs stay on gRPC; the bulk
+verbs (write_chunks_commit / write_chunk / read_chunks / read_chunk) go
+over the datanode's native C++ listener (native/datapath.cpp) when the
+server advertises one — discovered once per client via the
+GetDatapathInfo gRPC verb, the ``XceiverClientSpi`` transport-choice
+analog. Any discovery or connect failure disables the native path for
+this client and falls back to gRPC silently (the reference's
+native-transport probe-and-fallback posture); mid-stream failures
+surface as StorageError exactly like gRPC errors so the writers'
+exclude/retry machinery is transport-agnostic.
+
+Chaos parity: every native call honors net/partition.py rules keyed by
+the datanode's gRPC ADDRESS (the partition vocabulary's node identity),
+so injected partitions and delays cover both transports at once.
+
+Wire framing (must match datapath.cpp): frame = u32 len | u8 tag |
+body, little-endian. Checksums ride as big-endian-decoded u32 values
+(utils/checksum stores 4-byte big-endian CRC words).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ozone_tpu.net.dn_service import GrpcDatanodeClient
+from ozone_tpu.storage.ids import StorageError
+
+_T_WHDR, _T_CHUNK, _T_END = 0x01, 0x02, 0x03
+_T_RHDR, _T_RCHUNK = 0x05, 0x06
+_T_STATUS, _T_DATA = 0x81, 0x82
+
+_FRAME = struct.Struct("<IB")
+_CHUNK_HDR = struct.Struct("<QI")
+_RCHUNK_HDR = struct.Struct("<QIBII")
+
+#: sockets kept per client; EC fan-out drives one unit stream per DN so
+#: per-DN concurrency is low
+_POOL_CAP = 4
+
+
+def _enabled() -> bool:
+    return os.environ.get("OZONE_TPU_NATIVE_DATAPATH", "1") != "0"
+
+
+class _Conn:
+    def __init__(self, host: str, port: int):
+        self.sock = socket.create_connection((host, port), timeout=120.0)
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # deep buffers: on shared-core rigs every buffer-full forces a
+        # client<->server context switch mid-chunk
+        for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
+            try:
+                self.sock.setsockopt(socket.SOL_SOCKET, opt, 8 * 1024 * 1024)
+            except OSError:
+                pass
+
+    def send_frame(self, tag: int, body) -> None:
+        self.sock.sendall(_FRAME.pack(len(body), tag))
+        if len(body):
+            self.sock.sendall(body)
+
+    def send_frames(self, frames: list[tuple[int, object]]) -> None:
+        """One sendall for the metadata-heavy prefix of a request —
+        headers and small frames coalesce; big payloads go raw."""
+        parts: list[bytes | memoryview] = []
+        for tag, body in frames:
+            parts.append(_FRAME.pack(len(body), tag))
+            if len(body):
+                parts.append(body)
+        self.sock.sendall(b"".join(
+            bytes(p) if isinstance(p, memoryview) else p for p in parts))
+
+    def recv_exact(self, n: int) -> bytes:
+        buf = bytearray(n)
+        view = memoryview(buf)
+        got = 0
+        while got < n:
+            r = self.sock.recv_into(view[got:], n - got)
+            if r == 0:
+                raise ConnectionError("native datapath peer closed")
+            got += r
+        return bytes(buf)
+
+    def recv_frame(self) -> tuple[int, bytes]:
+        n, tag = _FRAME.unpack(self.recv_exact(5))
+        return tag, (self.recv_exact(n) if n else b"")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class NativeDatanodeClient(GrpcDatanodeClient):
+    def __init__(self, dn_id: str, address: str, tokens=None, tls=None):
+        super().__init__(dn_id, address, tokens=tokens, tls=tls)
+        #: gRPC address — the node identity partition rules key on
+        self.address = address
+        # native path needs a plaintext side channel; mTLS clusters stay
+        # on the (authenticated) gRPC transport
+        self._np_enabled = _enabled() and tls is None
+        self._np_port: Optional[int] = None
+        self._np_probed = False
+        self._np_lock = threading.Lock()
+        self._pool: list[_Conn] = []
+        self._host = address.rsplit(":", 1)[0]
+
+    # ------------------------------------------------------------ discovery
+    def _native_port(self) -> Optional[int]:
+        if not self._np_enabled:
+            return None
+        with self._np_lock:
+            if self._np_probed:
+                return self._np_port
+            self._np_probed = True
+            try:
+                m, _ = self._call("GetDatapathInfo", {})
+                self._np_port = m.get("port")
+            except (StorageError, OSError):
+                # older server without the verb, or unreachable: the
+                # caller's normal gRPC path surfaces real errors
+                self._np_port = None
+            return self._np_port
+
+    def _disable_native(self) -> None:
+        with self._np_lock:
+            self._np_port = None
+            for c in self._pool:
+                c.close()
+            self._pool.clear()
+
+    # ------------------------------------------------------------ transport
+    def _checkout(self, port: int) -> _Conn:
+        with self._np_lock:
+            if self._pool:
+                return self._pool.pop()
+        return _Conn(self._host, port)
+
+    def _checkin(self, conn: _Conn) -> None:
+        with self._np_lock:
+            if len(self._pool) < _POOL_CAP and self._np_port is not None:
+                self._pool.append(conn)
+                return
+        conn.close()
+
+    def _check_partition(self, verb: str) -> None:
+        """Same chaos vocabulary as RpcChannel: rules key on the gRPC
+        address (and verb), so a blocked or slowed datanode behaves
+        identically on BOTH transports."""
+        from ozone_tpu.net import partition
+
+        drop, d = partition.consult(self.address, verb, None)
+        if drop:
+            raise StorageError(
+                "UNAVAILABLE",
+                f"native datapath to {self.address}: injected partition")
+        if d > 0:
+            import time
+
+            time.sleep(d)
+
+    def _status(self, conn: _Conn, body: bytes) -> None:
+        m = json.loads(body) if body else {}
+        err = m.get("error")
+        if err:
+            raise StorageError(err.get("code", "IO_EXCEPTION"),
+                               err.get("message", ""))
+
+    # ------------------------------------------------------------ write path
+    def write_chunks_commit(self, block_id, chunks, commit=None,
+                            sync=False, writer=None):
+        port = self._native_port()
+        if port is None:
+            return super().write_chunks_commit(
+                block_id, chunks, commit=commit, sync=sync, writer=writer)
+        self._check_partition("WriteChunksCommit")
+        meta = {"op": "write", "block_id": block_id.to_json(),
+                "sync": bool(sync), **self._btok(block_id)}
+        if writer is not None:
+            meta["writer"] = writer
+        if commit is not None:
+            meta["commit"] = commit.to_json()
+        hdr = json.dumps(meta, separators=(",", ":")).encode()
+        try:
+            conn = self._checkout(port)
+        except OSError:
+            # listener gone (older daemon restarted in place): fall back
+            self._disable_native()
+            return super().write_chunks_commit(
+                block_id, chunks, commit=commit, sync=sync, writer=writer)
+        try:
+            conn.send_frame(_T_WHDR, hdr)
+            for info, data in chunks:
+                view = _payload_view(data)
+                if len(view) != info.length:
+                    raise StorageError(
+                        "INVALID_WRITE_SIZE",
+                        f"chunk {info.name}: data {len(view)} != "
+                        f"declared {info.length}")
+                # one gathered syscall per chunk: frame prefix + binary
+                # chunk header + the payload zero-copy from its buffer
+                _send_iov(conn.sock,
+                          _FRAME.pack(12 + info.length, _T_CHUNK)
+                          + _CHUNK_HDR.pack(info.offset, info.length),
+                          view)
+            conn.send_frame(_T_END, bytes([1 if sync else 0]))
+            tag, body = conn.recv_frame()
+            if tag != _T_STATUS:
+                raise ConnectionError(f"unexpected frame tag {tag:#x}")
+            self._status(conn, body)
+        except (OSError, ConnectionError) as e:
+            conn.close()
+            raise StorageError(
+                "UNAVAILABLE",
+                f"native datapath to {self.address}: {e}") from e
+        except StorageError:
+            self._checkin(conn)
+            raise
+        else:
+            self._checkin(conn)
+
+    def write_chunk(self, block_id, info, data, sync=False, writer=None):
+        if self._native_port() is None:
+            return super().write_chunk(block_id, info, data, sync=sync,
+                                       writer=writer)
+        from ozone_tpu.utils.upgrade import PRE_FINALIZE_ERROR
+
+        try:
+            return self.write_chunks_commit(
+                block_id, [(info, data)], commit=None, sync=sync,
+                writer=writer)
+        except StorageError as e:
+            if e.code == PRE_FINALIZE_ERROR:
+                # native writes are the layout-gated batched verb; the
+                # plain WriteChunk gRPC verb predates the gate
+                return super().write_chunk(block_id, info, data,
+                                           sync=sync, writer=writer)
+            raise
+
+    # ------------------------------------------------------------- read path
+    def read_chunks(self, block_id, infos, verify=False):
+        port = self._native_port()
+        if port is None or (verify and not _natively_verifiable(infos)):
+            return super().read_chunks(block_id, infos, verify=verify)
+        self._check_partition("ReadChunks")
+        meta = {"op": "read", "block_id": block_id.to_json(),
+                **self._btok(block_id)}
+        hdr = json.dumps(meta, separators=(",", ":")).encode()
+        try:
+            conn = self._checkout(port)
+        except OSError:
+            self._disable_native()
+            return super().read_chunks(block_id, infos, verify=verify)
+        try:
+            frames: list[tuple[int, object]] = [(_T_RHDR, hdr)]
+            for info in infos:
+                frames.append((_T_RCHUNK, _rchunk_body(info, verify)))
+            frames.append((_T_END, b""))
+            conn.send_frames(frames)
+            out = []
+            for _ in infos:
+                tag, body = conn.recv_frame()
+                if tag == _T_STATUS:
+                    self._status(conn, body)  # raises
+                    raise ConnectionError("short native read stream")
+                if tag != _T_DATA:
+                    raise ConnectionError(f"unexpected frame tag {tag:#x}")
+                out.append(np.frombuffer(body, dtype=np.uint8))
+            tag, body = conn.recv_frame()
+            if tag != _T_STATUS:
+                raise ConnectionError(f"unexpected frame tag {tag:#x}")
+            self._status(conn, body)
+        except (OSError, ConnectionError) as e:
+            conn.close()
+            raise StorageError(
+                "UNAVAILABLE",
+                f"native datapath to {self.address}: {e}") from e
+        except StorageError:
+            # a mid-stream server error leaves this connection's framing
+            # state unknown: don't pool it
+            conn.close()
+            raise
+        else:
+            self._checkin(conn)
+        return out
+
+    def read_chunk(self, block_id, info, verify=False):
+        if self._native_port() is None or (
+                verify and not _natively_verifiable([info])):
+            return super().read_chunk(block_id, info, verify=verify)
+        return self.read_chunks(block_id, [info], verify=verify)[0]
+
+    def close(self):
+        with self._np_lock:
+            for c in self._pool:
+                c.close()
+            self._pool.clear()
+        super().close()
+
+
+def _send_iov(sock: socket.socket, hdr: bytes, payload: memoryview) -> None:
+    sent = sock.sendmsg([hdr, payload])
+    total = len(hdr) + len(payload)
+    while sent < total:
+        if sent < len(hdr):
+            sent += sock.sendmsg([memoryview(hdr)[sent:], payload])
+        else:
+            sent += sock.send(payload[sent - len(hdr):])
+
+
+def _payload_view(data) -> memoryview:
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return memoryview(data).cast("B")
+    arr = np.asarray(data)
+    if arr.dtype != np.uint8 or not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    return memoryview(arr.reshape(-1))
+
+
+def _natively_verifiable(infos) -> bool:
+    """The native side verifies CRC32C only; other checksum types fall
+    back to the gRPC read path for verification parity."""
+    from ozone_tpu.utils.checksum import ChecksumType
+
+    return all(
+        i.checksum.type in (ChecksumType.CRC32C, ChecksumType.NONE)
+        or not i.checksum.checksums
+        for i in infos)
+
+
+def _rchunk_body(info, verify: bool) -> bytes:
+    cks = info.checksum
+    crcs: list[int] = []
+    vtype = 0
+    if verify and cks.checksums:
+        from ozone_tpu.utils.checksum import ChecksumType
+
+        if cks.type is ChecksumType.CRC32C:
+            vtype = 1
+            crcs = [int.from_bytes(c, "big") for c in cks.checksums]
+    return _RCHUNK_HDR.pack(info.offset, info.length, vtype,
+                            cks.bytes_per_checksum if vtype else 0,
+                            len(crcs)) + struct.pack(f"<{len(crcs)}I", *crcs)

@@ -1,0 +1,546 @@
+// Native chunk-datapath sidecar: the C++-grade hot path for the
+// datanode's bulk verbs (WriteChunksCommit / ReadChunks / WriteChunk /
+// ReadChunk analogs).
+//
+// Role analog of the reference datanode's Netty native-epoll gRPC
+// transport + mapped-channel chunk IO (container-service
+// transport/server/GrpcXceiverService.java:42, keyvalue/helpers/
+// ChunkUtils.java:109-156): the reference moves chunk bytes through
+// native code end-to-end; a Python gRPC stack pays ~65% of every
+// WriteChunk round trip in interpreter-driven transport (docs/PERF.md
+// per-layer table). This sidecar owns frame parse -> pwrite/pread ->
+// CRC32C verify -> fsync on its own TCP listener inside the datanode
+// process; Python keeps the control plane (token verification, write
+// fences, layout gates, block commits) via three callbacks that are
+// invoked once per STREAM, not per chunk.
+//
+// Wire protocol (all little-endian; both ends are in this repo):
+//   frame := u32 body_len | u8 tag | body
+//   client->server tags:
+//     0x01 WHDR   body = opaque JSON header (passed to the auth
+//                 callback verbatim; C++ never parses JSON)
+//     0x05 RHDR   body = opaque JSON header (read stream)
+//     0x02 CHUNK  body = u64 offset | u32 length | payload
+//     0x06 RCHUNK body = u64 offset | u32 length | u8 vtype |
+//                 u32 bytes_per_crc | u32 n_crcs | u32 crcs[n]
+//                 (vtype: 0 = no verify, 1 = CRC32C)
+//     0x03 END    body = u8 sync  (write: fsync before the commit)
+//   server->client tags:
+//     0x81 STATUS body = JSON: {} on success, {"error":{code,message}}
+//     0x82 DATA   body = one requested chunk's bytes (read streams,
+//                 request order)
+//
+// Python callbacks (ctypes; the wrapper acquires the GIL):
+//   auth(hdr, len, is_write, out, cap) -> n:
+//     out = u8 ok | body; ok=1 -> body is the absolute block-file
+//     path (container resolved, token verified, fence bound);
+//     ok=0 -> body is an error JSON forwarded to the client.
+//   done(hdr, len, is_write, bytes, chunks, out, cap) -> n:
+//     stream finished; Python applies the piggybacked block commit
+//     (put_block) and metrics. Same out convention (ok=1 body empty).
+//   fail(hdr, len): a read-side CRC32C verification failed; Python
+//     marks the container unhealthy (OnDemandContainerDataScanner
+//     trigger analog).
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#if defined(__SSE4_2__)
+#include <nmmintrin.h>
+#endif
+
+namespace {
+
+// ----------------------------------------------------------------- crc32c
+// Castagnoli CRC with init/xorout 0xFFFFFFFF, matching
+// utils/checksum.crc32c (values compared against the stored big-endian
+// u32s the client decodes for us).
+uint32_t crc32c_sw_table[256];
+std::once_flag crc_once;
+
+void crc32c_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+    crc32c_sw_table[i] = c;
+  }
+}
+
+uint32_t crc32c(const uint8_t* p, size_t n) {
+  uint32_t s = 0xFFFFFFFFu;
+#if defined(__SSE4_2__)
+  while (n >= 8) {
+    uint64_t v;
+    memcpy(&v, p, 8);
+    s = (uint32_t)_mm_crc32_u64(s, v);
+    p += 8;
+    n -= 8;
+  }
+  while (n) {
+    s = _mm_crc32_u8(s, *p++);
+    n--;
+  }
+#else
+  std::call_once(crc_once, crc32c_init);
+  while (n) {
+    s = (s >> 8) ^ crc32c_sw_table[(s ^ *p++) & 0xFF];
+    n--;
+  }
+#endif
+  return s ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------- callbacks
+typedef int32_t (*dp_auth_cb)(const uint8_t*, uint32_t, int32_t, uint8_t*,
+                              uint32_t);
+typedef int32_t (*dp_done_cb)(const uint8_t*, uint32_t, int32_t, uint64_t,
+                              uint32_t, uint8_t*, uint32_t);
+typedef void (*dp_fail_cb)(const uint8_t*, uint32_t);
+
+constexpr uint8_t T_WHDR = 0x01, T_CHUNK = 0x02, T_END = 0x03, T_RHDR = 0x05,
+                  T_RCHUNK = 0x06, T_STATUS = 0x81, T_DATA = 0x82;
+
+constexpr uint32_t MAX_FRAME = 256u * 1024 * 1024;
+constexpr uint32_t CB_OUT_CAP = 64u * 1024;
+
+// grow-only byte buffer without value-initialization: vector::resize
+// zero-fills on every grow, which costs a 1 MiB memset per chunk when
+// frames alternate between tiny (END/status) and payload-sized
+struct Buf {
+  uint8_t* p = nullptr;
+  size_t len = 0, cap = 0;
+  ~Buf() { free(p); }
+  void resize(size_t n) {
+    if (n > cap) {
+      size_t want = cap ? cap : 4096;
+      while (want < n) want *= 2;
+      p = (uint8_t*)realloc(p, want);
+      cap = want;
+    }
+    len = n;
+  }
+  uint8_t* data() { return p; }
+  const uint8_t* data() const { return p; }
+  size_t size() const { return len; }
+  bool empty() const { return len == 0; }
+  uint8_t operator[](size_t i) const { return p[i]; }
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  dp_auth_cb auth = nullptr;
+  dp_done_cb done = nullptr;
+  dp_fail_cb fail = nullptr;
+  std::atomic<bool> stop{false};
+  std::atomic<int> active{0};
+  std::mutex conn_mu;
+  std::set<int> conns;
+  std::thread acceptor;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = (uint8_t*)buf;
+  while (n) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = (const uint8_t*)buf;
+  while (n) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool send_frame(int fd, uint8_t tag, const void* body, uint32_t n) {
+  uint8_t hdr[5];
+  memcpy(hdr, &n, 4);
+  hdr[4] = tag;
+  struct iovec iov[2] = {{hdr, 5}, {(void*)body, n}};
+  size_t total = 5 + n;
+  while (total) {
+    ssize_t r = writev(fd, iov, n ? 2 : 1);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    total -= (size_t)r;
+    // advance iovecs
+    size_t adv = (size_t)r;
+    for (auto& v : iov) {
+      size_t take = adv < v.iov_len ? adv : v.iov_len;
+      v.iov_base = (uint8_t*)v.iov_base + take;
+      v.iov_len -= take;
+      adv -= take;
+      if (!adv) break;
+    }
+  }
+  return true;
+}
+
+bool read_frame(int fd, uint8_t* tag, Buf& body) {
+  uint8_t hdr[5];
+  if (!read_full(fd, hdr, 5)) return false;
+  uint32_t n;
+  memcpy(&n, hdr, 4);
+  if (n > MAX_FRAME) return false;
+  *tag = hdr[4];
+  body.resize(n);
+  if (n && !read_full(fd, body.data(), n)) return false;
+  return true;
+}
+
+// minimal error JSON built in C (messages are plain ASCII we format)
+std::string err_json(const char* code, const std::string& msg) {
+  std::string out = "{\"error\":{\"code\":\"";
+  out += code;
+  out += "\",\"message\":\"";
+  for (char c : msg) {
+    if (c == '"' || c == '\\') out += '\\';
+    if ((unsigned char)c >= 0x20) out += c;
+  }
+  out += "\"}}";
+  return out;
+}
+
+bool send_status(int fd, const std::string& json) {
+  return send_frame(fd, T_STATUS, json.data(), (uint32_t)json.size());
+}
+
+// drain client frames until END (keeps the connection consistent after
+// an early error)
+bool drain_to_end(int fd, Buf& scratch) {
+  uint8_t tag;
+  do {
+    if (!read_frame(fd, &tag, scratch)) return false;
+  } while (tag != T_END);
+  return true;
+}
+
+// run a Python callback with the u8-ok|body out convention.
+// ok_body gets the body; returns: 1 ok, 0 refused, -1 callback broke
+int run_cb_auth(Server* s, const Buf& hdr, int is_write,
+                std::string* ok_body) {
+  uint8_t out[CB_OUT_CAP];  // stack: no per-call zeroing
+  int32_t n = s->auth(hdr.data(), (uint32_t)hdr.size(), is_write, out,
+                      CB_OUT_CAP);
+  if (n < 1 || (uint32_t)n > CB_OUT_CAP) return -1;
+  ok_body->assign((const char*)out + 1, (size_t)n - 1);
+  return out[0] == 1 ? 1 : 0;
+}
+
+int run_cb_done(Server* s, const Buf& hdr, int is_write,
+                uint64_t bytes, uint32_t chunks, std::string* body) {
+  uint8_t out[CB_OUT_CAP];  // stack: no per-call zeroing
+  int32_t n = s->done(hdr.data(), (uint32_t)hdr.size(), is_write, bytes,
+                      chunks, out, CB_OUT_CAP);
+  if (n < 1 || (uint32_t)n > CB_OUT_CAP) return -1;
+  body->assign((const char*)out + 1, (size_t)n - 1);
+  return out[0] == 1 ? 1 : 0;
+}
+
+// ------------------------------------------------------------ write path
+bool handle_write(Server* s, int fd, const Buf& hdr,
+                  Buf& scratch) {
+  std::string body;
+  int ok = run_cb_auth(s, hdr, 1, &body);
+  if (ok <= 0) {
+    if (!drain_to_end(fd, scratch)) return false;
+    return send_status(fd, ok == 0 ? body
+                                   : err_json("IO_EXCEPTION",
+                                              "datapath auth callback failed"));
+  }
+  int file_fd = open(body.c_str(), O_RDWR | O_CREAT | O_CLOEXEC, 0644);
+  std::string err;
+  if (file_fd < 0)
+    err = err_json("IO_EXCEPTION",
+                   "open " + body + ": " + strerror(errno));
+  uint64_t total = 0;
+  uint32_t chunks = 0;
+  bool sync = false;
+  uint8_t tag;
+  for (;;) {
+    if (!read_frame(fd, &tag, scratch)) {
+      if (file_fd >= 0) close(file_fd);
+      return false;
+    }
+    if (tag == T_END) {
+      if (!scratch.empty()) sync = scratch[0] != 0;
+      break;
+    }
+    if (tag != T_CHUNK || scratch.size() < 12) {
+      if (file_fd >= 0) close(file_fd);
+      return false;  // protocol error: drop the connection
+    }
+    if (!err.empty()) continue;  // already failed: drain remaining
+    uint64_t off;
+    uint32_t len;
+    memcpy(&off, scratch.data(), 8);
+    memcpy(&len, scratch.data() + 8, 4);
+    if (scratch.size() != 12 + (size_t)len) {
+      if (file_fd >= 0) close(file_fd);
+      return false;
+    }
+    const uint8_t* p = scratch.data() + 12;
+    size_t left = len;
+    uint64_t at = off;
+    while (left) {
+      ssize_t w = pwrite(file_fd, p, left, (off_t)at);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        err = err_json("IO_EXCEPTION",
+                       "pwrite: " + std::string(strerror(errno)));
+        break;
+      }
+      p += w;
+      at += (uint64_t)w;
+      left -= (size_t)w;
+    }
+    if (err.empty()) {
+      total += len;
+      chunks++;
+    }
+  }
+  if (err.empty() && sync && file_fd >= 0 && fsync(file_fd) != 0)
+    err = err_json("IO_EXCEPTION",
+                   "fsync: " + std::string(strerror(errno)));
+  if (file_fd >= 0) close(file_fd);
+  if (!err.empty()) return send_status(fd, err);
+  std::string done_body;
+  int d = run_cb_done(s, hdr, 1, total, chunks, &done_body);
+  if (d < 0)
+    return send_status(
+        fd, err_json("IO_EXCEPTION", "datapath commit callback failed"));
+  return send_status(fd, d == 1 ? std::string("{}") : done_body);
+}
+
+// ------------------------------------------------------------- read path
+struct ReadReq {
+  uint64_t off;
+  uint32_t len;
+  uint8_t vtype;
+  uint32_t bpc;
+  std::vector<uint32_t> crcs;
+};
+
+bool handle_read(Server* s, int fd, const Buf& hdr,
+                 Buf& scratch) {
+  std::string body;
+  int ok = run_cb_auth(s, hdr, 0, &body);
+  std::vector<ReadReq> reqs;
+  uint8_t tag;
+  for (;;) {  // collect requests first (client pipelines them + END)
+    if (!read_frame(fd, &tag, scratch)) return false;
+    if (tag == T_END) break;
+    if (tag != T_RCHUNK || scratch.size() < 21) return false;
+    ReadReq r;
+    memcpy(&r.off, scratch.data(), 8);
+    memcpy(&r.len, scratch.data() + 8, 4);
+    r.vtype = scratch[12];
+    memcpy(&r.bpc, scratch.data() + 13, 4);
+    uint32_t n;
+    memcpy(&n, scratch.data() + 17, 4);
+    if (scratch.size() != 21 + 4 * (size_t)n || n > (1u << 20)) return false;
+    r.crcs.resize(n);
+    if (n) memcpy(r.crcs.data(), scratch.data() + 21, 4 * (size_t)n);
+    reqs.push_back(std::move(r));
+  }
+  if (ok <= 0)
+    return send_status(fd, ok == 0 ? body
+                                   : err_json("IO_EXCEPTION",
+                                              "datapath auth callback failed"));
+  int file_fd = open(body.c_str(), O_RDONLY | O_CLOEXEC);
+  if (file_fd < 0)
+    return send_status(
+        fd, err_json("IO_EXCEPTION", "open " + body + ": " + strerror(errno)));
+  Buf buf;
+  uint64_t total = 0;
+  for (auto& r : reqs) {
+    buf.resize(r.len);
+    size_t got = 0;
+    while (got < r.len) {
+      ssize_t rd = pread(file_fd, buf.data() + got, r.len - got,
+                         (off_t)(r.off + got));
+      if (rd < 0) {
+        if (errno == EINTR) continue;
+        close(file_fd);
+        return send_status(
+            fd, err_json("IO_EXCEPTION",
+                         "pread: " + std::string(strerror(errno))));
+      }
+      if (rd == 0) break;  // short: zero-fill tail (store semantics)
+      got += (size_t)rd;
+    }
+    if (got < r.len) memset(buf.data() + got, 0, r.len - got);
+    if (r.vtype == 1 && !r.crcs.empty()) {
+      uint32_t bpc = r.bpc ? r.bpc : r.len;
+      size_t slice = 0;
+      for (uint32_t o = 0; o < r.len && slice < r.crcs.size();
+           o += bpc, slice++) {
+        uint32_t n = (r.len - o) < bpc ? (r.len - o) : bpc;
+        if (crc32c(buf.data() + o, n) != r.crcs[slice]) {
+          close(file_fd);
+          s->fail(hdr.data(), (uint32_t)hdr.size());
+          char msg[96];
+          snprintf(msg, sizeof msg, "checksum mismatch at slice %zu", slice);
+          return send_status(fd, err_json("CHECKSUM_MISMATCH", msg));
+        }
+      }
+    }
+    if (!send_frame(fd, T_DATA, buf.data(), r.len)) {
+      close(file_fd);
+      return false;
+    }
+    total += r.len;
+  }
+  close(file_fd);
+  std::string done_body;
+  int d = run_cb_done(s, hdr, 0, total, (uint32_t)reqs.size(), &done_body);
+  if (d < 0)
+    return send_status(
+        fd, err_json("IO_EXCEPTION", "datapath done callback failed"));
+  return send_status(fd, d == 1 ? std::string("{}") : done_body);
+}
+
+void conn_loop(Server* s, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  // deep buffers: on shared-core rigs every buffer-full forces a
+  // client<->server context switch mid-chunk
+  int bufsz = 8 * 1024 * 1024;
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &bufsz, sizeof bufsz);
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &bufsz, sizeof bufsz);
+  Buf hdr, scratch;
+  for (;;) {
+    uint8_t tag;
+    if (!read_frame(fd, &tag, hdr)) break;
+    bool ok;
+    if (tag == T_WHDR)
+      ok = handle_write(s, fd, hdr, scratch);
+    else if (tag == T_RHDR)
+      ok = handle_read(s, fd, hdr, scratch);
+    else
+      break;
+    if (!ok || s->stop.load()) break;
+  }
+  close(fd);
+  {
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    s->conns.erase(fd);
+  }
+  s->active--;
+}
+
+void accept_loop(Server* s) {
+  for (;;) {
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;  // listener closed: shutting down
+    }
+    if (s->stop.load()) {
+      close(fd);
+      break;
+    }
+    {
+      std::lock_guard<std::mutex> g(s->conn_mu);
+      s->conns.insert(fd);
+    }
+    s->active++;
+    std::thread(conn_loop, s, fd).detach();
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dp_start(const char* host, int port, dp_auth_cb auth, dp_done_cb done,
+               dp_fail_cb fail) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    close(fd);
+    return nullptr;
+  }
+  if (bind(fd, (sockaddr*)&addr, sizeof addr) != 0 || listen(fd, 64) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  Server* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->auth = auth;
+  s->done = done;
+  s->fail = fail;
+  s->acceptor = std::thread(accept_loop, s);
+  return s;
+}
+
+int dp_port(void* h) { return h ? ((Server*)h)->port : -1; }
+
+// Stop accepting, sever live connections, and wait (bounded) for the
+// in-flight handlers — their Python callbacks must finish before the
+// caller tears down interpreter state.
+void dp_stop(void* h) {
+  if (!h) return;
+  Server* s = (Server*)h;
+  s->stop.store(true);
+  shutdown(s->listen_fd, SHUT_RDWR);
+  close(s->listen_fd);
+  {
+    std::lock_guard<std::mutex> g(s->conn_mu);
+    for (int fd : s->conns) shutdown(fd, SHUT_RDWR);
+  }
+  if (s->acceptor.joinable()) s->acceptor.join();
+  for (int i = 0; i < 200 && s->active.load() > 0; i++)
+    usleep(10 * 1000);
+  // leak the Server if a handler is wedged: a use-after-free in a
+  // detached thread is worse than 200 bytes at process exit
+  if (s->active.load() == 0) delete s;
+}
+
+uint32_t dp_crc32c(const void* p, int64_t n) {
+  return crc32c((const uint8_t*)p, (size_t)n);
+}
+
+}  // extern "C"

@@ -55,6 +55,7 @@ class DatanodeDaemon:
         enrollment_secret: str | None = None,
         num_volumes: int = 1,
         volume_policy: str = "round-robin",
+        replication_bandwidth_mbps: float | None = None,
     ):
         self.dn = Datanode(Path(root), dn_id=dn_id,
                            num_volumes=num_volumes,
@@ -119,9 +120,36 @@ class DatanodeDaemon:
         self.layout = LayoutVersionManager(Path(root) /
                                            "layout_version.json")
         self.finalizer = UpgradeFinalizer(self.layout)
-        self.service = DatanodeGrpcService(self.dn, self.server,
-                                           verifier=self.verifier,
-                                           layout=self.layout)
+        # native C++ datapath sidecar for the bulk verbs (insecure
+        # clusters; mTLS clusters keep the authenticated gRPC channel).
+        # A missing toolchain just leaves gRPC serving everything.
+        self.datapath = None
+        import os as _os
+
+        if self.tls is None and _os.environ.get(
+                "OZONE_TPU_NATIVE_DATAPATH", "1") != "0":
+            from ozone_tpu.storage.fast_datapath import DatapathSidecar
+
+            sc = DatapathSidecar(self.dn, verifier=self.verifier,
+                                 layout=self.layout, host=host)
+            if sc.start() is not None:
+                self.datapath = sc
+        self.service = DatanodeGrpcService(
+            self.dn, self.server, verifier=self.verifier,
+            layout=self.layout,
+            datapath_port=lambda: (self.datapath.port
+                                   if self.datapath else None))
+        # per-DN replication bandwidth cap (ReplicationSupervisor limit
+        # analog): paces BOTH the pull loop below and served export
+        # streams; None = unlimited
+        self.replication_throttle = None
+        if replication_bandwidth_mbps:
+            from ozone_tpu.utils.throttle import Throttle
+
+            self.replication_throttle = Throttle(
+                replication_bandwidth_mbps * 1024 * 1024,
+                metrics=self.dn.metrics)
+            self.service.throttle = self.replication_throttle
         # datanode raft pipelines (XceiverServerRatis analog): raft RPCs
         # and the client Submit/Watch surface ride the same RpcServer
         from ozone_tpu.net.raft_transport import RaftRpcService
@@ -509,6 +537,11 @@ class DatanodeDaemon:
                 raise
         for bd in blocks:
             for info in bd.chunks:
+                # the bandwidth cap bites BEFORE each pull so repair
+                # traffic paces itself rather than bursting then
+                # stalling foreground IO
+                if self.replication_throttle is not None:
+                    self.replication_throttle.take(info.length)
                 self.dn.write_chunk(
                     bd.block_id, info, src.read_chunk(bd.block_id, info)
                 )
@@ -527,6 +560,8 @@ class DatanodeDaemon:
         if self._scanner:
             self._scanner.join(timeout=5)
         self.xceiver_ratis.stop()
+        if self.datapath is not None:
+            self.datapath.stop()
         self.server.stop()
         self.scm.close()
         self.clients.close()

@@ -38,16 +38,25 @@ class DatanodeGrpcService:
     BLOCK_TOKEN_VERIFICATION_FAILED without executing the verb."""
 
     def __init__(self, dn: Datanode, server: RpcServer, verifier=None,
-                 layout=None):
+                 layout=None, datapath_port=None):
         self.dn = dn
         self.verifier = verifier
         #: LayoutVersionManager of the hosting daemon — verbs introduced
         #: by a layout feature are refused until the datanode finalizes
         #: (the DN side of RequestFeatureValidator-style gating)
         self.layout = layout
+        #: callable() -> native datapath port or None: clients discover
+        #: the C++ hot-path listener through this verb and fall back to
+        #: the gRPC verbs when absent (client/native_dn.py)
+        self.datapath_port = datapath_port
+        #: optional utils.throttle.Throttle pacing replication transfers
+        #: served by this node (ReplicationSupervisor bandwidth limits
+        #: analog); the hosting daemon installs it
+        self.throttle = None
         server.add_service(
             SERVICE,
             {
+                "GetDatapathInfo": self._datapath_info,
                 "CreateContainer": self._create_container,
                 "CloseContainer": self._close_container,
                 "DeleteContainer": self._delete_container,
@@ -212,6 +221,10 @@ class DatanodeGrpcService:
             self.dn.put_block(bd, sync=sync, writer=writer)
         return wire.pack({})
 
+    def _datapath_info(self, req: bytes) -> bytes:
+        port = self.datapath_port() if self.datapath_port else None
+        return wire.pack({"port": port})
+
     def _create_container(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
         self._require_container(m, m["container_id"])
@@ -250,19 +263,32 @@ class DatanodeGrpcService:
         """Packed container tarball streamed in frames (the reference's
         GrpcReplicationService download stream: replication/
         GrpcReplicationService.java:51): framing keeps each gRPC message
-        bounded. Note: the tarball currently materializes in memory at
-        both ends, so practical container size is bounded by RAM; the
-        state guard and failure cleanup live in container_packer, shared
-        with the in-process client."""
-        from ozone_tpu.storage.container_packer import export_container
+        bounded. Compression negotiates per transfer from the client's
+        `accept` list (CopyContainerCompression analog; legacy clients
+        send only the gzip bool). The daemon's replication throttle, if
+        configured, paces the frames. Note: the tarball currently
+        materializes in memory at both ends, so practical container
+        size is bounded by RAM; the state guard and failure cleanup
+        live in container_packer, shared with the in-process client."""
+        from ozone_tpu.storage.container_packer import (
+            export_container,
+            negotiate_codec,
+        )
 
         m, _ = wire.unpack(req)
         self._require_container(m, m["container_id"])
         c = self.dn.get_container(int(m["container_id"]))
-        data = export_container(c, compress=bool(m.get("compress", True)))
+        if "accept" in m:
+            codec = negotiate_codec(m["accept"])
+        else:
+            codec = "gzip" if m.get("compress", True) else "none"
+        data = export_container(c, compression=codec)
         frame = 4 * 1024 * 1024
-        yield wire.pack({"container_id": c.id, "size": len(data)})
+        yield wire.pack({"container_id": c.id, "size": len(data),
+                         "compression": codec})
         for off in range(0, len(data), frame):
+            if self.throttle is not None:
+                self.throttle.take(min(frame, len(data) - off))
             yield data[off:off + frame]
 
     def _import_container(self, frames) -> bytes:
@@ -472,15 +498,23 @@ class GrpcDatanodeClient:
     def export_container(self, container_id: int,
                          compress: bool = True) -> bytes:
         """Download the packed container tarball, streamed in frames
-        (replication-download / operator-backup path)."""
+        (replication-download / operator-backup path). Offers this
+        interpreter's full codec matrix; the server picks
+        (CopyContainerCompression negotiation) and import sniffs the
+        frame magic, so the name never needs plumbing."""
+        from ozone_tpu.storage.container_packer import available_codecs
+
+        accept = (list(available_codecs()) if compress
+                  else ["none"])
         frames = self._ch.call_server_stream(
             SERVICE, "ExportContainer",
             wire.pack({"container_id": container_id,
                        "compress": compress,
+                       "accept": accept,
                        **self._ctok(container_id)}),
         )
         head = next(iter_frames := iter(frames))
-        wire.unpack(head)  # header frame: {container_id, size}
+        wire.unpack(head)  # header: {container_id, size, compression}
         return b"".join(bytes(f) for f in iter_frames)
 
     def import_container(self, data: bytes,
@@ -501,7 +535,28 @@ class GrpcDatanodeClient:
             for off in range(0, len(data), frame):
                 yield data[off:off + frame]
 
-        out = self._ch.call_streaming(SERVICE, "ImportContainer", gen())
+        try:
+            out = self._ch.call_streaming(SERVICE, "ImportContainer", gen())
+        except StorageError as e:
+            from ozone_tpu.storage.container_packer import (
+                UNSUPPORTED_COMPRESSION,
+                compress_blob,
+                sniff_decompress,
+            )
+
+            if e.code != UNSUPPORTED_COMPRESSION:
+                raise
+            # the peer lacks this tarball's codec: recompress with the
+            # wire-default gzip (every node serves it) and retry once
+            data = compress_blob("gzip", sniff_decompress(data))
+
+            def gen2():
+                yield wire.pack(meta)
+                for off in range(0, len(data), frame):
+                    yield data[off:off + frame]
+
+            out = self._ch.call_streaming(SERVICE, "ImportContainer",
+                                          gen2())
         m, _ = wire.unpack(out)
         return int(m["container_id"])
 
