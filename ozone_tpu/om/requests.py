@@ -614,32 +614,42 @@ class SetVolumeOwner(OMRequest):
 
 
 @dataclass
-class RepairQuota(OMRequest):
-    """Recompute used_bytes/key_count from the key and file tables (the
-    OM quota repair service analog): fixes drift after crashes or
-    upgrades from pre-quota layouts."""
+class ApplyQuotaRepair(OMRequest):
+    """Apply PRE-COMPUTED per-bucket usage deltas (the OM quota-repair
+    service's replicated half). The O(all keys) recount runs OUTSIDE
+    the apply lock as a paged background scan
+    (``OzoneManager.repair_quota``, QuotaRepairTask analog); this apply
+    touches one row per bucket plus the volume row, so a repair of a
+    billion-key namespace never stalls the ring's writers. Deltas (not
+    absolutes) keep live traffic honest: a key committed after its page
+    was scanned keeps its own increment — the delta fixes only the
+    pre-existing drift the scan measured."""
 
     volume: str
+    #: bucket_key -> [d_used_bytes, d_key_count]
+    deltas: dict = None  # type: ignore[assignment]
 
     def apply(self, store):
         vk = volume_key(self.volume)
         vrow = store.get("volumes", vk)
         if vrow is None:
             raise OMError(VOLUME_NOT_FOUND, self.volume)
-        vtotal = vkeys = 0
         out = {}
-        for bk, brow in list(store.iterate("buckets", f"/{self.volume}/")):
-            used = keys = 0
-            for table in ("keys", "files"):
-                for _, info in store.iterate(table, f"{bk}/"):
-                    used += int(info.get("size", 0))
-                    keys += 1
-            brow["used_bytes"] = used
-            brow["key_count"] = keys
+        for bk, (d_used, d_keys) in (self.deltas or {}).items():
+            brow = store.get("buckets", bk)
+            if brow is None:
+                continue  # bucket deleted between scan and apply
+            brow["used_bytes"] = int(brow.get("used_bytes", 0)) + int(d_used)
+            brow["key_count"] = int(brow.get("key_count", 0)) + int(d_keys)
             store.put("buckets", bk, brow)
-            vtotal += used
-            vkeys += keys
-            out[bk] = {"used_bytes": used, "key_count": keys}
+            out[bk] = {"used_bytes": brow["used_bytes"],
+                       "key_count": brow["key_count"]}
+        # volume totals re-derive from the adjusted bucket rows:
+        # O(#buckets), never O(keys)
+        vtotal = vkeys = 0
+        for _, brow in store.iterate("buckets", f"/{self.volume}/"):
+            vtotal += int(brow.get("used_bytes", 0))
+            vkeys += int(brow.get("key_count", 0))
         vrow["used_bytes"] = vtotal
         vrow["key_count"] = vkeys
         store.put("volumes", vk, vrow)

@@ -138,9 +138,8 @@ def test_legacy_quota_counts_markers(cluster):
     b = oz.get_volume("lv").get_bucket("qb")
     b.write_key("a/b/f", np.zeros(64, np.uint8))
     assert cluster.om.bucket_info("lv", "qb")["key_count"] == 3
-    # RepairQuota's recount agrees with live accounting
-    from ozone_tpu.om import requests as rq
-    repaired = cluster.om.submit(rq.RepairQuota("lv"))
+    # the paged repair's recount agrees with live accounting
+    repaired = cluster.om.repair_quota("lv")
     assert repaired["buckets"]["/lv/qb"]["key_count"] == 3
     # deleting a marker and the file settles back to agreement
     b.delete_key("a/b/f")
