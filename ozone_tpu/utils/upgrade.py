@@ -81,31 +81,60 @@ class FinalizationState(Enum):
 
 
 class LayoutVersionManager:
-    """Per-service persisted layout version + feature gating."""
+    """Per-service persisted layout version + feature gating.
+
+    Downgrade contract (the reference's non-rolling upgrade promise,
+    BasicUpgradeFinalizer.java:55 + Nonrolling-Upgrade.md): a component
+    may restart at an OLDER software version any time BEFORE the
+    operator finalizes — pre-finalize, new-format features were gated,
+    so the on-disk state is old-format by construction. Only a store
+    whose version was reached by an explicit finalize refuses older
+    software. A pre-finalize downgrade runs CLAMPED to the older
+    software's version in memory; the persisted file is untouched, so
+    re-upgrading restores the stored version.
+    """
 
     def __init__(self, version_file: Path,
                  software_version: int = LATEST_VERSION):
         self.path = Path(version_file)
         self.software_version = software_version
+        #: version the store actually records (>= metadata_version
+        #: while running downgraded)
+        self.persisted_version = software_version
+        self.finalized_marker = False
         if self.path.exists():
-            self.metadata_version = json.loads(self.path.read_text())[
-                "layout_version"
-            ]
+            data = json.loads(self.path.read_text())
+            self.persisted_version = data["layout_version"]
+            # files from before this marker existed were written by
+            # fresh installs (never explicitly finalized) -> downgradable
+            self.finalized_marker = bool(data.get("finalized", False))
+            self.metadata_version = self.persisted_version
         else:
             # fresh install starts at the software version (reference
             # behavior: new clusters don't need finalization)
             self.metadata_version = software_version
             self._persist()
         if self.metadata_version > software_version:
-            raise RuntimeError(
-                f"metadata layout {self.metadata_version} is newer than "
-                f"software {software_version}; downgrade not supported"
-            )
+            if self.finalized_marker:
+                raise RuntimeError(
+                    f"metadata layout {self.metadata_version} was "
+                    f"FINALIZED past software {software_version}; "
+                    f"post-finalize downgrade not supported"
+                )
+            log.warning(
+                "pre-finalize downgrade: store records layout %d, "
+                "software is %d — running clamped to %d (persisted "
+                "version kept for re-upgrade)",
+                self.persisted_version, software_version,
+                software_version)
+            self.metadata_version = software_version
 
     def _persist(self) -> None:
         self.path.parent.mkdir(parents=True, exist_ok=True)
+        self.persisted_version = self.metadata_version
         self.path.write_text(
-            json.dumps({"layout_version": self.metadata_version})
+            json.dumps({"layout_version": self.metadata_version,
+                        "finalized": self.finalized_marker})
         )
 
     def is_allowed(self, feature: LayoutFeature) -> bool:
@@ -139,6 +168,10 @@ class UpgradeFinalizer:
         m = self.manager
         if not m.needs_finalization():
             return FinalizationState.ALREADY_FINALIZED
+        # finalization is the operator's point of no return: from here
+        # on, older software is refused (the downgrade window closes —
+        # BasicUpgradeFinalizer contract)
+        m.finalized_marker = True
         for f in sorted(FEATURES, key=lambda f: f.version):
             if m.metadata_version < f.version <= m.software_version:
                 for action in self._actions.get(f.version, ()):

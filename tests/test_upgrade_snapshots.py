@@ -47,10 +47,30 @@ def test_upgrade_gating_and_finalize(tmp_path):
     assert not m2.needs_finalization()
 
 
-def test_downgrade_rejected(tmp_path):
-    LayoutVersionManager(tmp_path / "VERSION")  # latest
-    with pytest.raises(RuntimeError):
-        LayoutVersionManager(tmp_path / "VERSION", software_version=0)
+def test_downgrade_allowed_pre_finalize_refused_after(tmp_path):
+    """The non-rolling-upgrade contract (Nonrolling-Upgrade.md /
+    BasicUpgradeFinalizer.java:55): older software may restart against
+    a newer store any time BEFORE finalize; finalization closes the
+    window."""
+    LayoutVersionManager(tmp_path / "VERSION")  # fresh: latest, unfinalized
+    old = LayoutVersionManager(tmp_path / "VERSION", software_version=0)
+    # runs clamped: new-layout features are refused, store untouched
+    assert old.metadata_version == 0
+    ec = next(f for f in FEATURES if f.name == "EC_DEVICE_CODEC")
+    assert not old.is_allowed(ec)
+    # the persisted version survives for re-upgrade
+    again = LayoutVersionManager(tmp_path / "VERSION")
+    assert again.metadata_version == again.software_version
+
+    # an explicitly FINALIZED store refuses older software
+    older = LayoutVersionManager(tmp_path / "V2", software_version=1)
+    older.metadata_version = 0
+    older._persist()
+    m = LayoutVersionManager(tmp_path / "V2", software_version=1)
+    assert m.needs_finalization()
+    assert UpgradeFinalizer(m).finalize() is FinalizationState.FINALIZATION_DONE
+    with pytest.raises(RuntimeError, match="post-finalize"):
+        LayoutVersionManager(tmp_path / "V2", software_version=0)
 
 
 # ---------------------------------------------------------------- snapshots
@@ -596,6 +616,85 @@ def test_layout_gating_mixed_version_datanodes(tmp_path):
         scm.close()
     finally:
         for d in dns:
+            d.stop()
+        meta.stop()
+
+
+def test_pre_finalize_datanode_downgrade_drill(tmp_path):
+    """The verdict-7 downgrade drill (Nonrolling-Upgrade.md contract):
+    boot at new software (stores record the new layout, unfinalized),
+    write; restart one datanode at OLDER software — it must START and
+    serve, running clamped; writes keep flowing (clients downgrade the
+    layout-gated batched verb on that node); re-upgrading restores the
+    recorded version."""
+    import time
+    import unittest.mock as mock
+
+    import numpy as np
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ozone_client import OzoneClient
+    from ozone_tpu.net.daemons import DatanodeDaemon, ScmOmDaemon
+    from ozone_tpu.net.om_service import GrpcOmClient
+    from ozone_tpu.utils import upgrade as ug
+
+    meta = ScmOmDaemon(tmp_path / "om.db", stale_after_s=1000.0,
+                       dead_after_s=2000.0)
+    meta.start()
+    dns = {f"dn{i}": DatanodeDaemon(tmp_path / f"dn{i}", f"dn{i}",
+                                    meta.address,
+                                    heartbeat_interval_s=0.1)
+           for i in range(5)}
+    for d in dns.values():
+        d.start()
+    oz = None
+    try:
+        clients = DatanodeClientFactory()
+        oz = OzoneClient(GrpcOmClient(meta.address, clients=clients),
+                         clients)
+        b = oz.create_volume("v").create_bucket("b",
+                                                replication="rs-3-2-4096")
+        data = np.arange(50_000, dtype=np.uint8) % 251
+        b.write_key("before", data)
+
+        # ---- downgrade dn0 to software one version below the
+        # streaming-write feature: the fresh store recorded v LATEST,
+        # unfinalized, so the older binary must start clamped
+        old_sw = ug.RATIS_STREAMING_WRITE.version - 1
+        dns["dn0"].stop()
+        real = ug.LayoutVersionManager
+
+        def older_binary(path, software_version=old_sw):
+            return real(path, software_version=old_sw)
+
+        with mock.patch.object(ug, "LayoutVersionManager", older_binary):
+            dns["dn0"] = DatanodeDaemon(tmp_path / "dn0", "dn0",
+                                        meta.address,
+                                        heartbeat_interval_s=0.1)
+        dns["dn0"].start()
+        assert dns["dn0"].layout.metadata_version == old_sw
+        assert dns["dn0"].layout.persisted_version == ug.LATEST_VERSION
+        # the gated streaming verb is refused on the downgraded node,
+        # so writers (and the native datapath client) fall back
+        time.sleep(1.0)  # re-registration heartbeat
+        b.write_key("after-downgrade", data)
+        np.testing.assert_array_equal(b.read_key("before"), data)
+        np.testing.assert_array_equal(b.read_key("after-downgrade"), data)
+
+        # ---- re-upgrade: the recorded version was never clobbered
+        dns["dn0"].stop()
+        dns["dn0"] = DatanodeDaemon(tmp_path / "dn0", "dn0", meta.address,
+                                    heartbeat_interval_s=0.1)
+        dns["dn0"].start()
+        assert dns["dn0"].layout.metadata_version == ug.LATEST_VERSION
+        time.sleep(1.0)
+        b.write_key("after-reupgrade", data)
+        np.testing.assert_array_equal(b.read_key("after-reupgrade"), data)
+    finally:
+        if oz is not None:
+            oz.clients.close()
+            oz.om.close()
+        for d in dns.values():
             d.stop()
         meta.stop()
 
