@@ -3,8 +3,14 @@
 Mirror of the reference's TarContainerPacker (container-service
 keyvalue/TarContainerPacker.java, used by the DN->DN replication stream
 GrpcReplicationService.java:51: a container replica travels as one packed
-archive of descriptor + block metadata + chunk files), with optional gzip
-compression (CopyContainerCompression analog).
+archive of descriptor + block metadata + chunk files), with a negotiated
+compression matrix (CopyContainerCompression.java analog: the reference
+offers no_compression/gzip/lz4/snappy/zstd; here every codec importable
+in this interpreter is offered — zstd and lz4 when their modules exist,
+gzip and none always). Import never needs the name on the wire: each
+codec's frame magic identifies it, so mixed-version peers interoperate
+by construction; a peer that RECEIVES a codec it cannot decompress
+raises UNSUPPORTED_COMPRESSION and the sender retries with gzip.
 """
 
 from __future__ import annotations
@@ -18,13 +24,109 @@ from ozone_tpu.storage.container import Container
 from ozone_tpu.storage.datanode import Datanode
 from ozone_tpu.storage.ids import BlockData, ContainerState, StorageError
 
+UNSUPPORTED_COMPRESSION = "UNSUPPORTED_COMPRESSION"
 
-def export_container(container: Container, compress: bool = False) -> bytes:
+_ZSTD_MAGIC = b"\x28\xb5\x2f\xfd"
+_LZ4_MAGIC = b"\x04\x22\x4d\x18"
+_GZIP_MAGIC = b"\x1f\x8b"
+
+#: preference order when negotiating (reference default is no_compression;
+#: operators pick — we prefer the best available ratio/speed codec)
+CODEC_PREFERENCE = ("zstd", "lz4", "gzip", "none")
+
+
+def _zstd():
+    try:
+        import zstandard
+
+        return zstandard
+    except ImportError:
+        return None
+
+
+def _lz4():
+    try:
+        import lz4.frame
+
+        return lz4.frame
+    except ImportError:
+        return None
+
+
+def available_codecs() -> tuple[str, ...]:
+    """Codecs THIS interpreter can both compress and decompress."""
+    out = []
+    if _zstd() is not None:
+        out.append("zstd")
+    if _lz4() is not None:
+        out.append("lz4")
+    out.extend(["gzip", "none"])
+    return tuple(out)
+
+
+def negotiate_codec(accept) -> str:
+    """First mutually-available codec in preference order; `accept` is
+    the peer's offered list (missing/empty -> gzip, the pre-matrix wire
+    default)."""
+    accept = [a for a in (accept or []) if a]
+    if not accept:
+        return "gzip"
+    ours = set(available_codecs())
+    for name in CODEC_PREFERENCE:
+        if name in ours and name in accept:
+            return name
+    return "none" if "none" in accept else "gzip"
+
+
+def compress_blob(name: str, data: bytes) -> bytes:
+    if name == "none":
+        return data
+    if name == "gzip":
+        import gzip
+
+        return gzip.compress(data, compresslevel=1)
+    if name == "zstd":
+        z = _zstd()
+        if z is None:
+            raise StorageError(UNSUPPORTED_COMPRESSION, "zstd unavailable")
+        return z.ZstdCompressor().compress(data)
+    if name == "lz4":
+        l4 = _lz4()
+        if l4 is None:
+            raise StorageError(UNSUPPORTED_COMPRESSION, "lz4 unavailable")
+        return l4.compress(data)
+    raise StorageError(UNSUPPORTED_COMPRESSION, f"unknown codec {name}")
+
+
+def sniff_decompress(data: bytes) -> bytes:
+    """Identify the codec by frame magic and decompress; plain tar (or
+    gzip, which tarfile handles natively) passes through."""
+    if data[:4] == _ZSTD_MAGIC:
+        z = _zstd()
+        if z is None:
+            raise StorageError(
+                UNSUPPORTED_COMPRESSION,
+                "peer sent zstd; this node cannot decompress it")
+        return z.ZstdDecompressor().decompress(
+            data, max_output_size=2 ** 32)
+    if data[:4] == _LZ4_MAGIC:
+        l4 = _lz4()
+        if l4 is None:
+            raise StorageError(
+                UNSUPPORTED_COMPRESSION,
+                "peer sent lz4; this node cannot decompress it")
+        return l4.decompress(data)
+    return data  # plain tar or gzip (tarfile r:* handles gzip)
+
+
+def export_container(container: Container, compress: bool = False,
+                     compression: Optional[str] = None) -> bytes:
     """Pack a container replica: descriptor, block metadata, chunk files.
 
-    Only writer-free replicas export — an OPEN container mid-write would
-    snapshot torn chunks (the guard lives HERE so every transport shares
-    it)."""
+    `compression` names a codec from the matrix (zstd/lz4/gzip/none);
+    the legacy `compress` bool means gzip. Only writer-free replicas
+    export — an OPEN container mid-write would snapshot torn chunks
+    (the guard lives HERE so every transport shares it)."""
     from ozone_tpu.storage.ids import (
         INVALID_CONTAINER_STATE,
         ContainerState,
@@ -38,8 +140,12 @@ def export_container(container: Container, compress: bool = False) -> bytes:
             f"container {container.id} is {container.state.value}; only "
             "closed replicas export (close it first)",
         )
+    codec = compression if compression is not None else (
+        "gzip" if compress else "none")
     buf = io.BytesIO()
-    mode = "w:gz" if compress else "w"
+    # gzip keeps the tarfile-native framing (old peers read it); the
+    # matrix codecs wrap a plain tar
+    mode = "w:gz" if codec == "gzip" else "w"
     with tarfile.open(fileobj=buf, mode=mode) as tar:
         desc = json.dumps(
             {
@@ -60,7 +166,10 @@ def export_container(container: Container, compress: bool = False) -> bytes:
 
         for f in sorted(container.chunks.chunks_dir.glob("*.block")):
             tar.add(str(f), arcname=f"chunks/{f.name}")
-    return buf.getvalue()
+    out = buf.getvalue()
+    if codec in ("none", "gzip"):
+        return out
+    return compress_blob(codec, out)
 
 
 def import_container(dn: Datanode, data: bytes,
@@ -73,7 +182,7 @@ def import_container(dn: Datanode, data: bytes,
     created; a pre-existing replica raising CONTAINER_EXISTS is never
     touched — so the import can be retried (the reference's cleanup of
     RECOVERING containers on reconstruction failure)."""
-    buf = io.BytesIO(data)
+    buf = io.BytesIO(sniff_decompress(data))
     created: Optional[Container] = None
     try:
         with tarfile.open(fileobj=buf, mode="r:*") as tar:

@@ -847,6 +847,7 @@ def cmd_datanode(args) -> int:
         enrollment_secret=args.enrollment_secret or None,
         num_volumes=args.volumes,
         volume_policy=args.volume_policy,
+        replication_bandwidth_mbps=args.replication_bandwidth_mbps,
     )
     d.start()
     print(f"datanode {dn_id} serving on {d.address}, scm={args.scm}")
@@ -1468,6 +1469,11 @@ def build_parser() -> argparse.ArgumentParser:
     dn.add_argument("--scan-interval", type=float, default=300.0,
                     help="seconds between background container scrubs "
                          "(0 disables)")
+    dn.add_argument("--replication-bandwidth-mbps", type=float,
+                    default=None,
+                    help="cap container-replication traffic this node "
+                         "pulls/serves (MiB/s; ReplicationSupervisor "
+                         "limit analog; default unlimited)")
     dn.add_argument("--ca", default="",
                     help="SCM cert-enrollment address (host:port) — "
                          "enroll and serve/dial everything over mTLS")

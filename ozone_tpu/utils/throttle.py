@@ -1,0 +1,56 @@
+"""Token-bucket bandwidth throttle for background transfers.
+
+Role analog of the reference's replication bandwidth limits
+(ReplicationSupervisor / ReplicationServer per-datanode limits,
+ReplicationConfig's replication.outofservice.limit family): container
+replication and repair traffic must not starve foreground client IO on
+shared disks/links. One Throttle instance paces all replication work a
+datanode does; `take(n)` blocks until `n` bytes of budget accumulate.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class Throttle:
+    def __init__(self, bytes_per_s: float, burst_s: float = 0.25,
+                 metrics=None):
+        if bytes_per_s <= 0:
+            raise ValueError("bytes_per_s must be positive")
+        self.rate = float(bytes_per_s)
+        self.burst = self.rate * burst_s
+        self._tokens = self.burst
+        self._t = time.monotonic()
+        self._lock = threading.Lock()
+        #: MetricsRegistry hook: records throttled sleep milliseconds
+        #: and paced bytes so operators can SEE the cap biting
+        self.metrics = metrics
+
+    def take(self, n: int) -> float:
+        """Consume `n` bytes of budget, sleeping as needed; returns the
+        seconds slept. Requests larger than the burst window are paid
+        across multiple refills (never refused)."""
+        slept = 0.0
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            self._tokens -= n
+            if self._tokens < 0:
+                wait = -self._tokens / self.rate
+                # sleep INSIDE the lock: the bucket models one shared
+                # link, so concurrent transfers must queue behind the
+                # deficit rather than all overdraw at once
+                time.sleep(wait)
+                slept = wait
+                self._t = time.monotonic()
+                self._tokens = 0.0
+        if self.metrics is not None and slept > 0:
+            self.metrics.counter("replication_throttle_ms").inc(
+                int(slept * 1000))
+        if self.metrics is not None:
+            self.metrics.counter("replication_throttled_bytes").inc(n)
+        return slept
