@@ -21,11 +21,22 @@ insight RPC service, so a test (or operator drill) can cut links between
 live daemons remotely: cutting both directions of a link means one
 Partition call to each endpoint's process, mirroring how blockade
 programs netfilter in each container.
+
+Round 5 adds VERB-level rules (the byteman analog — the reference
+injects latency/failures at method boundaries via dev-support/byteman/
+*.btm scripts like ratis-no-flush.btm): a rule names (dst, verb, owner,
+delay_s, drop_pct, count) and fires only on matching RPC methods, so a
+slow-follower or drop-one-verb interleaving is reproducible without
+LD_PRELOAD. `count`-limited rules with drop_pct=100 give fully
+deterministic "fail the first N calls" semantics.
 """
 
 from __future__ import annotations
 
+import random
 import threading
+from dataclasses import dataclass, field
+from typing import Optional
 
 _lock = threading.Lock()
 _blocked: set[tuple[str, str]] = set()
@@ -33,6 +44,105 @@ _delayed: dict[tuple[str, str], float] = {}
 
 #: wildcard owner: matches calls from every channel in the process
 ANY = "*"
+
+
+@dataclass
+class Rule:
+    """One verb-scoped injection rule (byteman .btm analog)."""
+
+    id: int
+    dst: str = ANY  # peer address, or ANY
+    verb: str = ANY  # RPC method name ("AppendEntries"), or ANY
+    owner: str = ANY  # channel owner tag, or ANY
+    delay_s: float = 0.0
+    drop_pct: float = 0.0  # 0..100
+    #: fire at most this many times, then auto-expire (None = forever);
+    #: with drop_pct=100 this is the deterministic fail-first-N shape
+    count: Optional[int] = None
+    _rng: random.Random = field(default_factory=lambda: random.Random(7))
+
+    def matches(self, dst: str, verb: Optional[str],
+                owner: Optional[str]) -> bool:
+        if self.dst != ANY and self.dst != dst:
+            return False
+        if self.verb != ANY:
+            if verb is None:
+                return False
+            name = verb.rsplit("/", 1)[-1]
+            if name != self.verb:
+                return False
+        if self.owner != ANY and self.owner != owner:
+            return False
+        return True
+
+
+_rules: dict[int, Rule] = {}
+_next_rule_id = [1]
+
+
+def add_rule(dst: str = ANY, verb: str = ANY, owner: str = ANY,
+             delay_s: float = 0.0, drop_pct: float = 0.0,
+             count: Optional[int] = None, seed: int = 7) -> int:
+    """Install a verb-scoped rule; returns its id for remove_rule."""
+    with _lock:
+        rid = _next_rule_id[0]
+        _next_rule_id[0] += 1
+        _rules[rid] = Rule(rid, dst, verb, owner, float(delay_s),
+                           float(drop_pct), count,
+                           random.Random(seed))
+        return rid
+
+
+def remove_rule(rule_id: int) -> None:
+    with _lock:
+        _rules.pop(rule_id, None)
+
+
+def rules() -> list[dict]:
+    with _lock:
+        return [
+            {"id": r.id, "dst": r.dst, "verb": r.verb, "owner": r.owner,
+             "delay_s": r.delay_s, "drop_pct": r.drop_pct,
+             "count": r.count}
+            for r in _rules.values()
+        ]
+
+
+def consult(dst: str, verb: Optional[str] = None,
+            owner: Optional[str] = None) -> tuple[bool, float]:
+    """One-stop decision for an outbound call: (drop?, delay_seconds).
+    Folds the legacy address tables with the verb rules; decrements
+    count-limited rules as they fire."""
+    with _lock:
+        if not _blocked and not _delayed and not _rules:
+            return False, 0.0
+        if (ANY, dst) in _blocked or (
+                owner is not None and (owner, dst) in _blocked):
+            return True, 0.0
+        d = _delayed.get((ANY, dst), 0.0)
+        if owner is not None:
+            d = max(d, _delayed.get((owner, dst), 0.0))
+        drop = False
+        expired = []
+        for r in _rules.values():
+            if not r.matches(dst, verb, owner):
+                continue
+            fired = False
+            if r.drop_pct > 0 and (
+                    r.drop_pct >= 100
+                    or r._rng.uniform(0, 100) < r.drop_pct):
+                drop = True
+                fired = True
+            if r.delay_s > 0:
+                d = max(d, r.delay_s)
+                fired = True
+            if fired and r.count is not None:
+                r.count -= 1
+                if r.count <= 0:
+                    expired.append(r.id)
+        for rid in expired:
+            _rules.pop(rid, None)
+        return drop, d
 
 
 def block(dst: str, owner: str = ANY) -> None:

@@ -264,12 +264,14 @@ class RpcChannel:
                          timeout: Optional[float] = None) -> None:
         from ozone_tpu.net import partition
 
-        if partition.is_blocked(self.address, self.owner):
+        # one consult covers address partitions AND verb-level rules
+        # (the byteman-analog method-boundary injection)
+        drop, d = partition.consult(self.address, key, self.owner)
+        if drop:
             raise StorageError(
                 "UNAVAILABLE",
                 f"rpc {key} to {self.address}: injected network partition",
             )
-        d = partition.delay_for(self.address, self.owner)
         if d > 0:
             import time as _time
 
