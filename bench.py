@@ -46,7 +46,7 @@ _DEADLINE = time.time() + BUDGET_S
 #: progressively updated by the measurement loops; the watchdog and the
 #: normal exit path both read it
 _STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None,
-                "sharded": None}
+                "sharded": None, "decode": None, "decode_spread": None}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
@@ -78,6 +78,9 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
             line["sustained_60s_gib_s"] = round(_STATE["sustained"], 3)
         if _STATE["sharded"] is not None:
             line["sharded_1dev_gib_s"] = round(_STATE["sharded"], 3)
+        if _STATE["decode"] is not None:
+            line["decode_gib_s"] = round(_STATE["decode"], 3)
+            line["decode_spread_pct"] = round(_STATE["decode_spread"], 1)
         if timed_out:
             line["timed_out"] = True
         if error:
@@ -198,7 +201,10 @@ def bench_fused_encode(batch: int = 128, cell: int = 1024 * 1024,
 
 
 def bench_fused_decode(batch: int = 48, cell: int = 1024 * 1024,
-                       iters: int = 8) -> float:
+                       iters: int = 8, rounds: int = 5) -> dict:
+    """BASELINE config #3 with the same median-of-rounds treatment as
+    encode (round-4 verdict: a single-shot decode number has unknown
+    variance — one cold round could read as a regression)."""
     import jax
 
     from ozone_tpu.codec.api import CoderOptions
@@ -215,14 +221,8 @@ def bench_fused_decode(batch: int = 48, cell: int = 1024 * 1024,
         rng.integers(0, 256, (batch, 10, cell), dtype=np.uint8)
     )
     gib = batch * 10 * cell / 2**30
-    for _ in range(2):
-        outs = [fn(data) for _ in range(max(4, iters // 4))]
-        jax.device_get(jax.tree.map(lambda o: o[(0,) * (o.ndim - 1)], outs[-1]))
-    t0 = time.time()
-    outs = [fn(data) for _ in range(iters)]
-    jax.device_get(jax.tree.map(lambda o: o[(0,) * (o.ndim - 1)], outs[-1]))
-    dt = (time.time() - t0) / iters
-    return gib / dt
+    return _run_rounds(fn, data, gib, iters, rounds, warmups=2,
+                       label="decode")
 
 
 def bench_xor_reencode(batch: int = 128, cell: int = 1024 * 1024,
@@ -436,11 +436,15 @@ def main() -> None:
                 f"GiB/s/chip (overall {sustained['overall']:.2f})")
         except Exception as e:
             log(f"sustained bench failed: {e}")
-    if budget_for("decode bench", 60):
+    if budget_for("decode bench", 90):
         try:
             dec = bench_fused_decode()
-            log(f"fused RS(10,4) 2-erasure decode+CRC32C: "
-                f"{dec:.2f} GiB/s/chip")
+            _STATE["decode"] = dec["median"]
+            _STATE["decode_spread"] = dec["spread_pct"]
+            log(f"fused RS(10,4) 2-erasure decode+CRC32C: median "
+                f"{dec['median']:.2f} GiB/s/chip "
+                f"(range {dec['min']:.2f}-{dec['best']:.2f}, "
+                f"spread {dec['spread_pct']:.0f}%)")
         except Exception as e:  # secondary metrics: never the headline
             log(f"decode bench failed: {e}")
     if budget_for("re-encode bench", 60):
