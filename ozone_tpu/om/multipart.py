@@ -204,6 +204,9 @@ class CompleteMultipartUpload(rq.OMRequest):
             raise rq.OMError(INVALID_PART, "no parts listed")
         kk = key_key(self.volume, self.bucket, self.key)
         old = store.get("keys", kk)
+        # before ANY mutation of the aliased old row (_release_blocks
+        # erases its GDPR secret in place)
+        rq.preserve_preimage(store, self.volume, self.bucket, kk)
         markers = (rq.missing_parent_markers(store, self.volume,
                                              self.bucket, self.key)
                    if self.fs_paths else [])

@@ -288,6 +288,9 @@ def finalize_commit(store, table: str, ek: str, info: dict, old,
     them). Quota is enforced before any mutation: the space delta is the
     new size minus whatever the previous version already charged."""
     _, vol, bkt = ek.split("/", 3)[:3]
+    if table == "keys":
+        # COW snapshots: capture the pre-overwrite image first
+        preserve_preimage(store, vol, bkt, ek)
     check_and_charge_quota(
         store, vol, bkt,
         int(info.get("size", 0)) - (int(old.get("size", 0)) if old else 0),
@@ -429,6 +432,79 @@ def snapmeta_key(volume: str, bucket: str, name: str) -> str:
     return f"/.snapmeta/{volume}/{bucket}/{name}"
 
 
+#: overlay row meaning "this key did NOT exist when the snapshot was
+#: taken" (a key created after a COW snapshot must not leak into its
+#: reads through the live-table fallthrough)
+ABSENT = {"__absent__": True}
+
+
+def is_absent_marker(row: Optional[dict]) -> bool:
+    return bool(row) and row.get("__absent__") is True
+
+
+def bucket_snapshots(store, volume: str, bucket: str) -> list[dict]:
+    """This bucket's snapshot chain, oldest first."""
+    out = [v for _, v in store.iterate(
+        "open_keys", f"/.snapmeta/{volume}/{bucket}/")]
+    out.sort(key=lambda v: v["created"])
+    return out
+
+
+def newest_snapshot(store, volume: str, bucket: str) -> Optional[dict]:
+    """Single-pass newest-snapshot fetch for the mutation hot path (no
+    sort; buckets without snapshots pay one empty indexed scan)."""
+    newest = None
+    for _, v in store.iterate("open_keys",
+                              f"/.snapmeta/{volume}/{bucket}/"):
+        if newest is None or v["created"] > newest["created"]:
+            newest = v
+    return newest
+
+
+def preserve_preimage(store, volume: str, bucket: str,
+                      full_key: str) -> None:
+    """Copy-on-write first-write preservation (round 5; the reference
+    gets snapshot isolation from O(1) RocksDB checkpoints — here the
+    LIVE table stays authoritative and each COW snapshot accumulates
+    only the PRE-IMAGES of rows mutated while it was newest). Call
+    BEFORE mutating or deleting the live row `full_key`: if the
+    bucket's newest snapshot is a COW snapshot that has no overlay
+    entry for this key yet, the current live value (or an ABSENT
+    marker) is recorded there. O(1) per mutation; snapshot creation is
+    O(#snapshots) instead of O(bucket).
+
+    Reads then resolve value-at-S as: the OLDEST overlay entry among
+    snapshots >= S, else the live row — sound because a missing overlay
+    entry in a snapshot's reign proves the key was not mutated during
+    it. FSO buckets keep materialize-at-create (their overlay would
+    need path re-derivation under O(1) directory renames) and
+    pre-upgrade materialized snapshots read exactly as before: a COW
+    snapshot is always newer than every materialized one in its chain,
+    so the walk never crosses modes. Per-mutation cost: one scan of the
+    bucket's snapmeta prefix (O(#snapshots), one empty indexed query
+    for snapshot-less buckets) plus, when a COW snapshot is newest, a
+    point read and at most one overlay write."""
+    newest = newest_snapshot(store, volume, bucket)
+    if newest is None or not newest.get("cow"):
+        return
+    base = bucket_key(volume, bucket) + "/"
+    rel = full_key[len(base):]
+    ok = f"{snap_prefix(volume, bucket, newest['snap_id'])}/{rel}"
+    if store.get("keys", ok) is not None:
+        return  # pre-image already captured for this snapshot
+    old = store.get("keys", full_key)
+    if old is not None:
+        import json as _json
+
+        # deep copy via the storage codec: the fetched dict aliases the
+        # live cache row, which the calling apply mutates next
+        old = _json.loads(_json.dumps(old))
+    # journal=False like materialization: derived rows must not evict
+    # the live-mutation history incremental snapdiff reads
+    store.put("keys", ok, old if old is not None else dict(ABSENT),
+              journal=False)
+
+
 def is_snapmeta(open_key: str) -> bool:
     """True for snapshot-chain rows riding the open_keys table — every
     open-key scan must skip these or report snapshots as open files."""
@@ -437,10 +513,18 @@ def is_snapmeta(open_key: str) -> bool:
 
 @dataclass
 class CreateSnapshot(OMRequest):
-    """Materialize a bucket snapshot (OMSnapshotCreateRequest analog):
-    the bucket's live key rows are copied under the snapshot prefix and
-    chained to the previous snapshot. Runs through the replicated log so
-    HA replicas hold identical snapshot state."""
+    """Bucket snapshot (OMSnapshotCreateRequest analog), chained to the
+    previous snapshot; runs through the replicated log so HA replicas
+    hold identical snapshot state.
+
+    OBS/LEGACY buckets take a COPY-ON-WRITE snapshot (round 5): apply
+    writes only the chain metadata — O(#snapshots), the role the
+    reference's O(1) RocksDB checkpoint plays — and the overlay fills
+    lazily as ``preserve_preimage`` captures the pre-image of each
+    first mutation while this snapshot is newest. FSO buckets keep
+    materialize-at-create: their file rows are keyed by parent id and
+    full paths go stale under the O(1) directory reparent, so the
+    path-keyed rows must be derived while the tree still matches."""
 
     volume: str
     bucket: str
@@ -460,7 +544,8 @@ class CreateSnapshot(OMRequest):
             # the snapmeta key space: a slash or empty name would make
             # the snapshot unaddressable
             raise OMError("INVALID_SNAPSHOT_NAME", repr(self.name))
-        if not store.exists("buckets", bucket_key(self.volume, self.bucket)):
+        brow = store.get("buckets", bucket_key(self.volume, self.bucket))
+        if brow is None:
             raise OMError(BUCKET_NOT_FOUND, f"{self.volume}/{self.bucket}")
         meta_key = snapmeta_key(self.volume, self.bucket, self.name)
         if store.exists("open_keys", meta_key):
@@ -472,29 +557,6 @@ class CreateSnapshot(OMRequest):
         ):
             if v["created"] > prev_created:
                 prev, prev_created = v["snap_id"], v["created"]
-        base = bucket_key(self.volume, self.bucket) + "/"
-        prefix = snap_prefix(self.volume, self.bucket, self.snap_id)
-        # journal=False: materialization is O(bucket) of DERIVED rows —
-        # journaling them would evict the live-mutation history that the
-        # incremental snapdiff (and Recon's delta tail) reads
-        for k, v in list(store.iterate("keys", base)):
-            if k.startswith("/.snap"):
-                continue
-            store.put("keys", f"{prefix}/{k[len(base):]}", v,
-                      journal=False)
-        # FSO buckets keep file rows in the "files" table keyed by parent
-        # id; full paths must be DERIVED by tree walk — the stored "name"
-        # is the path at creation time and goes stale when an ancestor
-        # directory is renamed (the O(1) reparent never touches
-        # descendants). Snapshot rows are materialized path-keyed so all
-        # snapshot reads/diffs work identically across layouts.
-        from ozone_tpu.om.fso import walk_files_paged
-
-        for v in walk_files_paged(store, self.volume, self.bucket):
-            row = {k2: v[k2] for k2 in v
-                   if k2 not in ("type", "path")}
-            store.put("keys", f"{prefix}/{v['name']}", row,
-                      journal=False)
         info = {
             "volume": self.volume,
             "bucket": self.bucket,
@@ -503,6 +565,20 @@ class CreateSnapshot(OMRequest):
             "created": self.created,
             "previous": prev,
         }
+        if brow.get("layout") == "FILE_SYSTEM_OPTIMIZED":
+            # materialize path-keyed rows by tree walk (see class doc)
+            from ozone_tpu.om.fso import walk_files_paged
+
+            prefix = snap_prefix(self.volume, self.bucket, self.snap_id)
+            for v in walk_files_paged(store, self.volume, self.bucket):
+                row = {k2: v[k2] for k2 in v
+                       if k2 not in ("type", "path")}
+                # journal=False: O(bucket) DERIVED rows must not evict
+                # the live-mutation history incremental snapdiff reads
+                store.put("keys", f"{prefix}/{v['name']}", row,
+                          journal=False)
+        else:
+            info["cow"] = True
         store.put("open_keys", meta_key, info)
         # local journal position of this snapshot: lets snapdiff walk
         # only the updates BETWEEN two snapshots instead of listing the
@@ -514,7 +590,14 @@ class CreateSnapshot(OMRequest):
 
 @dataclass
 class DeleteSnapshot(OMRequest):
-    """Drop a snapshot's materialized rows and chain entry."""
+    """Drop a snapshot's rows and chain entry. A COW snapshot first
+    merges its overlay DOWN into the adjacent OLDER snapshot (the
+    reference's snapshot-deletion deep-clean moves deleted-key state
+    the same direction): an entry preserved here may be the truth for
+    reads at older snapshots whose reigns saw no mutation of that key.
+    O(overlay) — proportional to changes, never the namespace. Entries
+    never merge into a MATERIALIZED older snapshot: its row set is
+    already complete for its moment."""
 
     volume: str
     bucket: str
@@ -526,6 +609,19 @@ class DeleteSnapshot(OMRequest):
         if info is None:
             raise OMError("SNAPSHOT_NOT_FOUND", self.name)
         prefix = snap_prefix(self.volume, self.bucket, info["snap_id"])
+        if info.get("cow"):
+            snaps = bucket_snapshots(store, self.volume, self.bucket)
+            idx = next(i for i, s in enumerate(snaps)
+                       if s["snap_id"] == info["snap_id"])
+            older = snaps[idx - 1] if idx > 0 else None
+            if older is not None and older.get("cow"):
+                op = snap_prefix(self.volume, self.bucket,
+                                 older["snap_id"])
+                for k, v in list(store.iterate("keys", prefix + "/")):
+                    rel = k[len(prefix) + 1:]
+                    if store.get("keys", f"{op}/{rel}") is None:
+                        store.put("keys", f"{op}/{rel}", v,
+                                  journal=False)
         for k, _ in list(store.iterate("keys", prefix)):
             store.delete("keys", k, journal=False)
         store.delete("open_keys", meta_key)
@@ -694,6 +790,8 @@ class RecoverLease(OMRequest):
         for s in sessions:
             store.delete("open_keys", s)
         if cur is not None:
+            if table == "keys":
+                preserve_preimage(store, self.volume, self.bucket, ek)
             if cur.pop("hsync_client_id", None) is not None:
                 cur["modified"] = self.modified
                 store.put(table, ek, cur)
@@ -752,6 +850,8 @@ def put_parent_markers(store, volume: str, bucket: str,
     enforcement, delete accounting (DeleteKey charges -1 per marker),
     and RepairQuota's recount all agree."""
     for marker in markers:
+        preserve_preimage(store, volume, bucket,
+                          key_key(volume, bucket, marker))
         store.put("keys", key_key(volume, bucket, marker), {
             "volume": volume,
             "bucket": bucket,
@@ -881,6 +981,7 @@ class DeleteKey(OMRequest):
         info = store.get("keys", kk)
         if info is None:
             raise OMError(KEY_NOT_FOUND, kk)
+        preserve_preimage(store, self.volume, self.bucket, kk)
         store.delete("keys", kk)
         # deleting a live hsync stream: fence its writer before the blocks
         # hit the purge chain, or its commit would resurrect purged blocks
@@ -930,6 +1031,7 @@ class SetKeyAttrs(OMRequest):
             info = store.get("keys", kk)
         if info is None:
             raise OMError(KEY_NOT_FOUND, kk)
+        preserve_preimage(store, self.volume, self.bucket, kk)
         check_attr_preconds(info, self.preconds)
         merged = dict(info.get("attrs", {}))
         for k, v in self.attrs.items():
@@ -974,6 +1076,10 @@ class RenameKey(OMRequest):
                                    markers,
                                    info.get("replication", ""),
                                    self.ts or time.time())
+        # both ends change: the source row disappears and the
+        # destination row is created/overwritten
+        preserve_preimage(store, self.volume, self.bucket, src)
+        preserve_preimage(store, self.volume, self.bucket, dst)
         info["name"] = self.new_key
         store.delete("keys", src)
         store.put("keys", dst, info)
@@ -1192,6 +1298,8 @@ class ModifyAcl(OMRequest):
                      "buckets": BUCKET_NOT_FOUND,
                      "keys": KEY_NOT_FOUND,
                      "files": KEY_NOT_FOUND}[table], k)
+        elif table == "keys":
+            preserve_preimage(store, self.volume, self.bucket, k)
         existing = row.get("acls", [])
         changed = False
         if self.op == "set":

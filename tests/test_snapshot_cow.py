@@ -1,0 +1,211 @@
+"""Copy-on-write snapshots (round 5).
+
+OBS/LEGACY snapshot creation is O(#snapshots) — the role the
+reference's O(1) RocksDB checkpoint plays — with pre-images captured
+lazily on first mutation (``requests.preserve_preimage``). These tests
+pin the COW algebra: first-write preservation, absent markers, chained
+multi-snapshot reads, delete-time merge-down, and interop with
+pre-upgrade materialized snapshots."""
+
+import numpy as np
+import pytest
+
+from ozone_tpu.om import requests as rq
+from ozone_tpu.om.om import OzoneManager
+from ozone_tpu.om.snapshots import SnapshotManager
+from ozone_tpu.scm.scm import StorageContainerManager
+
+EC = "rs-3-2-4096"
+
+
+@pytest.fixture
+def om(tmp_path):
+    scm = StorageContainerManager(stale_after_s=1e6, dead_after_s=2e6)
+    for i in range(5):
+        scm.register_datanode(f"dn{i}")
+    om = OzoneManager(tmp_path / "om.db", scm)
+    om.create_volume("v")
+    om.create_bucket("v", "b", EC)
+    yield om
+    om.close()
+
+
+def _commit(om, key, size=10):
+    s = om.open_key("v", "b", key)
+    om.commit_key(s, [], size)
+
+
+def _overlay_rows(om, snap_id):
+    p = rq.snap_prefix("v", "b", snap_id) + "/"
+    return dict(om.store.iterate("keys", p))
+
+
+def test_create_is_o_snapshots_not_o_bucket(om):
+    for i in range(50):
+        _commit(om, f"k{i}")
+    info = om.create_snapshot("v", "b", "s1")
+    assert info["cow"] is True
+    # nothing materialized: the overlay starts EMPTY
+    assert _overlay_rows(om, info["snap_id"]) == {}
+    # yet the snapshot reads the full namespace through the live table
+    sm = SnapshotManager(om)
+    assert len(sm.list_keys("v", "b", "s1")) == 50
+    assert sm.lookup_key("v", "b", "s1", "k7")["name"] == "k7"
+
+
+def test_overwrite_preserves_first_image_only(om):
+    _commit(om, "k", size=10)
+    info = om.create_snapshot("v", "b", "s1")
+    _commit(om, "k", size=20)  # first mutation: pre-image captured
+    _commit(om, "k", size=30)  # second: overlay already holds the truth
+    sm = SnapshotManager(om)
+    assert sm.lookup_key("v", "b", "s1", "k")["size"] == 10
+    assert om.lookup_key("v", "b", "k")["size"] == 30
+    rows = _overlay_rows(om, info["snap_id"])
+    assert len(rows) == 1  # one pre-image, not one per write
+
+
+def test_new_key_after_snapshot_gets_absent_marker(om):
+    _commit(om, "old")
+    om.create_snapshot("v", "b", "s1")
+    _commit(om, "born-later")
+    sm = SnapshotManager(om)
+    names = {k["name"] for k in sm.list_keys("v", "b", "s1")}
+    assert names == {"old"}
+    with pytest.raises(rq.OMError):
+        sm.lookup_key("v", "b", "s1", "born-later")
+    # live sees it, of course
+    assert om.lookup_key("v", "b", "born-later")
+
+
+def test_delete_and_rename_preserve(om):
+    _commit(om, "gone", size=5)
+    _commit(om, "moved", size=6)
+    om.create_snapshot("v", "b", "s1")
+    om.delete_key("v", "b", "gone")
+    om.rename_key("v", "b", "moved", "now-here")
+    sm = SnapshotManager(om)
+    assert sm.lookup_key("v", "b", "s1", "gone")["size"] == 5
+    assert sm.lookup_key("v", "b", "s1", "moved")["size"] == 6
+    with pytest.raises(rq.OMError):
+        sm.lookup_key("v", "b", "s1", "now-here")
+    diff = sm.snapshot_diff("v", "b", "s1")
+    assert diff["deleted"] == ["gone"]
+    assert diff["renamed"] == [["moved", "now-here"]]
+
+
+def test_chained_snapshots_resolve_oldest_overlay(om):
+    _commit(om, "k", size=1)
+    om.create_snapshot("v", "b", "s1")
+    _commit(om, "k", size=2)
+    om.create_snapshot("v", "b", "s2")
+    _commit(om, "k", size=3)
+    om.create_snapshot("v", "b", "s3")
+    # never mutated after s3: falls through to live
+    sm = SnapshotManager(om)
+    assert sm.lookup_key("v", "b", "s1", "k")["size"] == 1
+    assert sm.lookup_key("v", "b", "s2", "k")["size"] == 2
+    assert sm.lookup_key("v", "b", "s3", "k")["size"] == 3
+    assert om.lookup_key("v", "b", "k")["size"] == 3
+
+
+def test_delete_snapshot_merges_down(om):
+    _commit(om, "k", size=1)
+    _commit(om, "stay", size=7)
+    om.create_snapshot("v", "b", "s1")
+    om.create_snapshot("v", "b", "s2")
+    _commit(om, "k", size=2)  # pre-image lands in s2 (newest)
+    # deleting s2 must hand its pre-image DOWN to s1, whose reign saw
+    # no mutation of k
+    om.delete_snapshot("v", "b", "s2")
+    sm = SnapshotManager(om)
+    assert sm.lookup_key("v", "b", "s1", "k")["size"] == 1
+    assert sm.lookup_key("v", "b", "s1", "stay")["size"] == 7
+    # deleting the only/oldest snapshot drops its overlay entirely
+    om.delete_snapshot("v", "b", "s1")
+    assert om.list_snapshots("v", "b") == []
+    leftovers = [k for k, _ in om.store.iterate("keys", "/.snapshot/")]
+    assert leftovers == []
+
+
+def test_delete_snapshot_does_not_clobber_older_entry(om):
+    _commit(om, "k", size=1)
+    om.create_snapshot("v", "b", "s1")
+    _commit(om, "k", size=2)  # s1 overlay: pre-image size=1
+    om.create_snapshot("v", "b", "s2")
+    _commit(om, "k", size=3)  # s2 overlay: pre-image size=2
+    om.delete_snapshot("v", "b", "s2")
+    sm = SnapshotManager(om)
+    # s1's own pre-image must win over the merged-down s2 entry
+    assert sm.lookup_key("v", "b", "s1", "k")["size"] == 1
+
+
+def test_attrs_and_acl_mutations_preserve(om):
+    _commit(om, "k")
+    om.create_snapshot("v", "b", "s1")
+    om.set_key_attrs("v", "b", "k", {"owner": "root"})
+    sm = SnapshotManager(om)
+    assert "owner" not in sm.lookup_key(
+        "v", "b", "s1", "k").get("attrs", {})
+    assert om.lookup_key("v", "b", "k")["attrs"]["owner"] == "root"
+
+
+def test_mixed_materialized_and_cow_chain(om):
+    """Pre-upgrade stores hold materialized snapshots; new snapshots
+    are COW and always newer. Reads of each mode must stay exact."""
+    _commit(om, "k", size=1)
+    # fabricate a MATERIALIZED snapshot the way round-4 code built them
+    import time as _t
+    import uuid as _uuid
+
+    sid = _uuid.uuid4().hex[:12]
+    store = om.store
+    base = "/v/b/"
+    for k, v in list(store.iterate("keys", base)):
+        store.put("keys",
+                  f"{rq.snap_prefix('v', 'b', sid)}/{k[len(base):]}", v,
+                  journal=False)
+    store.put("open_keys", rq.snapmeta_key("v", "b", "mat"), {
+        "volume": "v", "bucket": "b", "name": "mat", "snap_id": sid,
+        "created": _t.time() - 10, "previous": None,
+    })
+    _commit(om, "k", size=2)
+    info2 = om.create_snapshot("v", "b", "cow")  # COW, newer
+    assert info2["cow"] is True
+    _commit(om, "k", size=3)
+    _commit(om, "post-mat")
+    sm = SnapshotManager(om)
+    # the materialized snapshot is self-contained: k=1, no post rows
+    assert sm.lookup_key("v", "b", "mat", "k")["size"] == 1
+    assert {x["name"] for x in sm.list_keys("v", "b", "mat")} == {"k"}
+    with pytest.raises(rq.OMError):
+        sm.lookup_key("v", "b", "mat", "post-mat")
+    # the COW snapshot resolves through its overlay
+    assert sm.lookup_key("v", "b", "cow", "k")["size"] == 2
+    # deleting the COW snapshot must NOT pollute the materialized one
+    om.delete_snapshot("v", "b", "cow")
+    assert {x["name"] for x in sm.list_keys("v", "b", "mat")} == {"k"}
+    assert sm.lookup_key("v", "b", "mat", "k")["size"] == 1
+
+
+def test_overlay_diff_vs_live_and_between_snapshots(om):
+    for i in range(5):
+        _commit(om, f"k{i}", size=1)
+    om.create_snapshot("v", "b", "s1")
+    om.delete_key("v", "b", "k0")
+    _commit(om, "k1", size=9)
+    _commit(om, "new1")
+    om.create_snapshot("v", "b", "s2")
+    _commit(om, "after-s2")
+    sm = SnapshotManager(om)
+    # wipe the journal: force the overlay path specifically
+    om.store._updates.clear()
+    om.store.snapshot_markers.clear()
+    d = sm.snapshot_diff("v", "b", "s1", "s2")
+    assert d["mode"] == "overlay"
+    assert d["deleted"] == ["k0"]
+    assert d["modified"] == ["k1"]
+    assert d["added"] == ["new1"]
+    d_live = sm.snapshot_diff("v", "b", "s1")
+    assert d_live["mode"] == "overlay"
+    assert set(d_live["added"]) == {"new1", "after-s2"}
