@@ -108,6 +108,8 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
         r_payload = np.random.default_rng(seed + 1).integers(
             0, 256, 20_000, dtype=np.uint8).tobytes()
 
+        from ozone_tpu.client.ec_writer import StripeWriteError
+
         def writer(bucket, payload, acked, prefix):
             n = 0
             while not stop.is_set():
@@ -115,8 +117,12 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
                 try:
                     bucket.write_key(key, payload)
                     acked.append(key)
-                except (StorageError, OSError):
-                    pass  # un-acked: no durability claim
+                except (StorageError, StripeWriteError, OSError):
+                    # un-acked: no durability claim. StripeWriteError is
+                    # the EC writer's retries-exhausted surface — an
+                    # expected outcome while the chaos holds enough
+                    # nodes down, not a bug signal
+                    pass
                 except Exception as e:  # noqa: BLE001
                     hard_errors.append(e)
                     return
@@ -328,14 +334,18 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
             if key in rename_intents:
                 names.append(rename_intents[key])
             last = None
-            for attempt in range(4):
+            # deadline, not attempt-count: a poisoned replica's repair
+            # is a full reconstruction on one shared core — under suite
+            # load that legitimately exceeds a few polls
+            t_end = time.monotonic() + 30.0
+            while time.monotonic() < t_end:
                 for name in names:
                     try:
                         got = oz.get_volume("v").get_bucket(
                             bucket_name).read_key(name).tobytes()
                         assert got == want, f"{name}: wrong bytes"
                         return
-                    except (StorageError, OSError) as e:
+                    except (StorageError, StripeWriteError, OSError) as e:
                         last = e
                 time.sleep(2.0)
             raise AssertionError(f"{bucket_name}/{key} unreadable "
