@@ -124,14 +124,19 @@ def _ensure_parents(
         if d is None:
             if store.exists("files", dk):
                 raise OMError(conflict_code, dk)
+            from ozone_tpu.om.requests import preserve_fso_preimage
+
             d = {
                 "object_id": new_ids[i],
                 "name": name,
                 "parent_id": parent,
                 "created": created,
             }
+            idk = id_key(volume, bucket, d["object_id"])
+            preserve_fso_preimage(store, volume, bucket, "dirs", dk)
+            preserve_fso_preimage(store, volume, bucket, "dir_ids", idk)
             store.put("dirs", dk, d)
-            store.put("dir_ids", id_key(volume, bucket, d["object_id"]),
+            store.put("dir_ids", idk,
                       {"parent_id": parent, "name": name})
         parent = d["object_id"]
     return parent
@@ -310,6 +315,10 @@ class DeleteFile(OMRequest):
             if store.exists("dirs", fk):
                 raise OMError(NOT_A_FILE, f"{fk} is a directory")
             raise OMError(KEY_NOT_FOUND, fk)
+        from ozone_tpu.om.requests import preserve_fso_preimage
+
+        preserve_fso_preimage(store, self.volume, self.bucket,
+                              "files", fk)
         store.delete("files", fk)
         # fence a live hsync stream before purging its blocks
         stale_writer = info.get("hsync_client_id")
@@ -355,9 +364,14 @@ class DeleteDirectory(OMRequest):
         )
         if has_children and not self.recursive:
             raise OMError(DIRECTORY_NOT_EMPTY, dk)
+        from ozone_tpu.om.requests import preserve_fso_preimage
+
+        idk = id_key(self.volume, self.bucket, d["object_id"])
+        preserve_fso_preimage(store, self.volume, self.bucket, "dirs", dk)
+        preserve_fso_preimage(store, self.volume, self.bucket,
+                              "dir_ids", idk)
         store.delete("dirs", dk)
-        store.delete("dir_ids", id_key(self.volume, self.bucket,
-                                       d["object_id"]))
+        store.delete("dir_ids", idk)
         store.put(
             "deleted_dirs",
             f"/{self.volume}/{self.bucket}/{d['object_id']}:{self.ts}",
@@ -389,6 +403,9 @@ class SetEntryAttrs(OMRequest):
         info = store.get(table, ek)
         if info is None:
             raise OMError(KEY_NOT_FOUND, ek)
+        from ozone_tpu.om.requests import preserve_fso_preimage
+
+        preserve_fso_preimage(store, self.volume, self.bucket, table, ek)
         check_attr_preconds(info, self.preconds)
         merged = dict(info.get("attrs", {}))
         for k, v in self.attrs.items():
@@ -436,16 +453,32 @@ class RenameEntry(OMRequest):
                     raise OMError(NOT_A_DIRECTORY,
                                   f"cannot move {sk} into its own subtree")
                 p = _parent_of(store, self.volume, self.bucket, p)
+            from ozone_tpu.om.requests import (
+                newest_snapshot,
+                preserve_fso_preimage,
+            )
+
+            idk = id_key(self.volume, self.bucket, d["object_id"])
+            nw = newest_snapshot(store, self.volume, self.bucket)
+            preserve_fso_preimage(store, self.volume, self.bucket,
+                                  "dirs", sk, newest=nw)
+            preserve_fso_preimage(store, self.volume, self.bucket,
+                                  "dirs", dk, newest=nw)
+            preserve_fso_preimage(store, self.volume, self.bucket,
+                                  "dir_ids", idk, newest=nw)
             d.update(name=dst_name, parent_id=dst_parent, modified=self.ts)
             store.delete("dirs", sk)
             store.put("dirs", dk, d)
-            store.put("dir_ids",
-                      id_key(self.volume, self.bucket, d["object_id"]),
+            store.put("dir_ids", idk,
                       {"parent_id": dst_parent, "name": dst_name})
             return d
         f = store.get("files", sk)
         if f is None:
             raise OMError(KEY_NOT_FOUND, sk)
+        from ozone_tpu.om.requests import preserve_fso_preimage
+
+        preserve_fso_preimage(store, self.volume, self.bucket, "files", sk)
+        preserve_fso_preimage(store, self.volume, self.bucket, "files", dk)
         f.update(file_name=dst_name, parent_id=dst_parent, modified=self.ts)
         store.delete("files", sk)
         store.put("files", dk, f)
@@ -472,11 +505,29 @@ class PurgeDirectories(OMRequest):
     dir_moves: list[list] = field(default_factory=list)  # [deleted_dirs key, info]
 
     def apply(self, store):
-        from ozone_tpu.om.requests import check_and_charge_quota
+        from ozone_tpu.om.requests import (
+            check_and_charge_quota,
+            erase_gdpr_secret,
+        )
 
-        from ozone_tpu.om.requests import erase_gdpr_secret
+        from ozone_tpu.om.requests import (
+            newest_snapshot,
+            preserve_fso_preimage,
+        )
+
+        # one snapmeta scan per bucket for the whole batch
+        newest_cache: dict = {}
+
+        def _newest(vol0, bkt0):
+            key = (vol0, bkt0)
+            if key not in newest_cache:
+                newest_cache[key] = newest_snapshot(store, vol0, bkt0)
+            return newest_cache[key]
 
         for fk, info, ts in self.file_moves:
+            _, vol0, bkt0 = fk.split("/", 3)[:3]
+            preserve_fso_preimage(store, vol0, bkt0, "files", fk,
+                                  newest=_newest(vol0, bkt0))
             store.delete("files", fk)
             erase_gdpr_secret(info)
             store.put("deleted_keys", f"{fk}:{ts}", info)
@@ -484,10 +535,15 @@ class PurgeDirectories(OMRequest):
             check_and_charge_quota(store, vol, bkt,
                                    -int(info.get("size", 0)), -1)
         for dk, info in self.dir_moves:
+            idk = id_key(info["volume"], info["bucket"],
+                         info["object_id"])
+            nw = _newest(info["volume"], info["bucket"])
+            preserve_fso_preimage(store, info["volume"], info["bucket"],
+                                  "dirs", dk, newest=nw)
+            preserve_fso_preimage(store, info["volume"], info["bucket"],
+                                  "dir_ids", idk, newest=nw)
             store.delete("dirs", dk)
-            store.delete("dir_ids",
-                         id_key(info["volume"], info["bucket"],
-                                info["object_id"]))
+            store.delete("dir_ids", idk)
             store.put("deleted_dirs", dk_suffix(dk, info), info)
         for k in self.drops:
             # re-check emptiness at apply time: a file committed between the

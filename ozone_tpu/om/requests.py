@@ -288,9 +288,11 @@ def finalize_commit(store, table: str, ek: str, info: dict, old,
     them). Quota is enforced before any mutation: the space delta is the
     new size minus whatever the previous version already charged."""
     _, vol, bkt = ek.split("/", 3)[:3]
+    # COW snapshots: capture the pre-overwrite image first
     if table == "keys":
-        # COW snapshots: capture the pre-overwrite image first
         preserve_preimage(store, vol, bkt, ek)
+    elif table == "files":
+        preserve_fso_preimage(store, vol, bkt, "files", ek)
     check_and_charge_quota(
         store, vol, bkt,
         int(info.get("size", 0)) - (int(old.get("size", 0)) if old else 0),
@@ -437,6 +439,10 @@ def snapmeta_key(volume: str, bucket: str, name: str) -> str:
 #: reads through the live-table fallthrough)
 ABSENT = {"__absent__": True}
 
+#: sentinel distinguishing "resolve the newest snapshot yourself" from
+#: an explicitly-passed None (= bucket has no snapshots)
+_UNRESOLVED = object()
+
 
 def is_absent_marker(row: Optional[dict]) -> bool:
     return bool(row) and row.get("__absent__") is True
@@ -476,23 +482,41 @@ def preserve_preimage(store, volume: str, bucket: str,
     Reads then resolve value-at-S as: the OLDEST overlay entry among
     snapshots >= S, else the live row — sound because a missing overlay
     entry in a snapshot's reign proves the key was not mutated during
-    it. FSO buckets keep materialize-at-create (their overlay would
-    need path re-derivation under O(1) directory renames) and
-    pre-upgrade materialized snapshots read exactly as before: a COW
-    snapshot is always newer than every materialized one in its chain,
-    so the walk never crosses modes. Per-mutation cost: one scan of the
-    bucket's snapmeta prefix (O(#snapshots), one empty indexed query
-    for snapshot-less buckets) plus, when a COW snapshot is newest, a
-    point read and at most one overlay write."""
-    newest = newest_snapshot(store, volume, bucket)
+    it. Pre-upgrade materialized snapshots read exactly as before: a
+    COW snapshot is always newer than every materialized one in its
+    chain, so the walk never crosses modes. Per-mutation cost: one scan
+    of the bucket's snapmeta prefix (O(#snapshots), one empty indexed
+    query for snapshot-less buckets) plus, when a COW snapshot is
+    newest, a point read and at most one overlay write."""
+    base = bucket_key(volume, bucket) + "/"
+    _preserve_row(store, volume, bucket, "keys", full_key,
+                  full_key[len(base):])
+
+
+def preserve_fso_preimage(store, volume: str, bucket: str, table: str,
+                          storage_key: str, newest=_UNRESOLVED) -> None:
+    """COW preservation for FSO rows (dirs / files / dir_ids): the same
+    first-write algebra as the OBS path, but the overlay key carries
+    the TABLE and the id-keyed storage key (``#table#key``) — FSO paths
+    are not stable under the O(1) directory reparent, so snapshot reads
+    re-derive them by walking the directory tree AS OF the snapshot
+    through ``snapshots.SnapshotStoreView``. Applies touching many rows
+    resolve ``newest`` (newest_snapshot) once and pass it in, keeping
+    one snapmeta scan per request."""
+    _preserve_row(store, volume, bucket, table, storage_key,
+                  f"#{table}#{storage_key}", newest=newest)
+
+
+def _preserve_row(store, volume: str, bucket: str, table: str,
+                  storage_key: str, rel: str, newest=_UNRESOLVED) -> None:
+    if newest is _UNRESOLVED:
+        newest = newest_snapshot(store, volume, bucket)
     if newest is None or not newest.get("cow"):
         return
-    base = bucket_key(volume, bucket) + "/"
-    rel = full_key[len(base):]
     ok = f"{snap_prefix(volume, bucket, newest['snap_id'])}/{rel}"
     if store.get("keys", ok) is not None:
         return  # pre-image already captured for this snapshot
-    old = store.get("keys", full_key)
+    old = store.get(table, storage_key)
     if old is not None:
         import json as _json
 
@@ -517,14 +541,16 @@ class CreateSnapshot(OMRequest):
     previous snapshot; runs through the replicated log so HA replicas
     hold identical snapshot state.
 
-    OBS/LEGACY buckets take a COPY-ON-WRITE snapshot (round 5): apply
-    writes only the chain metadata — O(#snapshots), the role the
-    reference's O(1) RocksDB checkpoint plays — and the overlay fills
-    lazily as ``preserve_preimage`` captures the pre-image of each
-    first mutation while this snapshot is newest. FSO buckets keep
-    materialize-at-create: their file rows are keyed by parent id and
-    full paths go stale under the O(1) directory reparent, so the
-    path-keyed rows must be derived while the tree still matches."""
+    Every snapshot is COPY-ON-WRITE (round 5): apply writes only the
+    chain metadata — O(#snapshots), the role the reference's O(1)
+    RocksDB checkpoint plays — and the overlay fills lazily as
+    ``preserve_preimage`` / ``preserve_fso_preimage`` capture the
+    pre-image of each first mutation while this snapshot is newest.
+    OBS/LEGACY overlays are path-keyed; FSO overlays are id-keyed
+    (``#table#key`` over dirs/files/dir_ids, since paths go stale
+    under the O(1) directory reparent) and FSO snapshot reads walk the
+    directory tree as-of-snapshot through
+    ``snapshots.SnapshotStoreView``."""
 
     volume: str
     bucket: str
@@ -565,20 +591,11 @@ class CreateSnapshot(OMRequest):
             "created": self.created,
             "previous": prev,
         }
+        info["cow"] = True
         if brow.get("layout") == "FILE_SYSTEM_OPTIMIZED":
-            # materialize path-keyed rows by tree walk (see class doc)
-            from ozone_tpu.om.fso import walk_files_paged
-
-            prefix = snap_prefix(self.volume, self.bucket, self.snap_id)
-            for v in walk_files_paged(store, self.volume, self.bucket):
-                row = {k2: v[k2] for k2 in v
-                       if k2 not in ("type", "path")}
-                # journal=False: O(bucket) DERIVED rows must not evict
-                # the live-mutation history incremental snapdiff reads
-                store.put("keys", f"{prefix}/{v['name']}", row,
-                          journal=False)
-        else:
-            info["cow"] = True
+            # FSO overlays are id-keyed (#table#key) and reads walk the
+            # directory tree as-of-snapshot via SnapshotStoreView
+            info["fso"] = True
         store.put("open_keys", meta_key, info)
         # local journal position of this snapshot: lets snapdiff walk
         # only the updates BETWEEN two snapshots instead of listing the
@@ -792,6 +809,9 @@ class RecoverLease(OMRequest):
         if cur is not None:
             if table == "keys":
                 preserve_preimage(store, self.volume, self.bucket, ek)
+            else:
+                preserve_fso_preimage(store, self.volume, self.bucket,
+                                      "files", ek)
             if cur.pop("hsync_client_id", None) is not None:
                 cur["modified"] = self.modified
                 store.put(table, ek, cur)
@@ -1300,6 +1320,9 @@ class ModifyAcl(OMRequest):
                      "files": KEY_NOT_FOUND}[table], k)
         elif table == "keys":
             preserve_preimage(store, self.volume, self.bucket, k)
+        elif table == "files":
+            preserve_fso_preimage(store, self.volume, self.bucket,
+                                  "files", k)
         existing = row.get("acls", [])
         changed = False
         if self.op == "set":

@@ -41,11 +41,86 @@ class SnapshotInfo:
     previous: Optional[str] = None  # snapshot chain link
     #: round 5: True = copy-on-write snapshot (overlay holds only
     #: pre-images of rows mutated while it was newest); False =
-    #: materialized-at-create (FSO buckets, pre-upgrade snapshots)
+    #: materialized-at-create (pre-upgrade snapshots)
     cow: bool = False
+    #: COW over the FSO tables: reads walk the directory tree as-of-
+    #: snapshot through SnapshotStoreView instead of path-keyed rows
+    fso: bool = False
 
     def to_json(self) -> dict:
         return self.__dict__.copy()
+
+
+class SnapshotStoreView:
+    """Read-only store facade serving the FSO tables (dirs / files /
+    dir_ids) AS OF a COW snapshot: each get resolves to the oldest
+    overlay entry among the chain ``snaps`` (this snapshot to newest,
+    oldest first), else the live row — the same first-write-wins
+    algebra the OBS path uses, applied per table with ``#table#key``
+    overlay rows. All other tables pass through to the live store, so
+    fso.py's read machinery (resolve, get_status, list_status,
+    walk_files_paged) runs unchanged against a point-in-time tree —
+    including paths as they were BEFORE later directory renames, which
+    the old materialize-at-create design could only freeze."""
+
+    _COW_TABLES = ("dirs", "files", "dir_ids")
+
+    def __init__(self, store, volume: str, bucket: str,
+                 snaps: list[dict]):
+        self._store = store
+        self._volume = volume
+        self._bucket = bucket
+        self._snaps = snaps
+
+    def _okey(self, snap_id: str, table: str, key: str) -> str:
+        return (f"{_snap_prefix(self._volume, self._bucket, snap_id)}"
+                f"/#{table}#{key}")
+
+    def get(self, table: str, key: str):
+        if table not in self._COW_TABLES:
+            return self._store.get(table, key)
+        from ozone_tpu.om.requests import is_absent_marker
+
+        for s in self._snaps:
+            v = self._store.get("keys",
+                                self._okey(s["snap_id"], table, key))
+            if v is not None:
+                return None if is_absent_marker(v) else v
+        return self._store.get(table, key)
+
+    def exists(self, table: str, key: str) -> bool:
+        return self.get(table, key) is not None
+
+    def iterate_range(self, table: str, prefix: str = "",
+                      start_after: str = "", limit=None):
+        if table not in self._COW_TABLES:
+            return self._store.iterate_range(table, prefix, start_after,
+                                             limit)
+        from ozone_tpu.om.requests import is_absent_marker
+
+        merged: dict[str, dict] = {}
+        floor = start_after or ""
+        for s in self._snaps:
+            op = self._okey(s["snap_id"], table, prefix)
+            head = len(self._okey(s["snap_id"], table, ""))
+            for k, v in self._store.iterate("keys", op):
+                if k[head:] > floor:
+                    merged.setdefault(k[head:], v)
+        # overlays are O(changes); the LIVE scan is the one that must
+        # stay windowed for walk_files_paged's paging to hold. Overlay
+        # entries can both HIDE live rows (absent markers) and ADD rows
+        # the window didn't count, so over-fetch by the overlay size.
+        live_limit = None if limit is None else limit + len(merged)
+        for k, v in self._store.iterate_range(table, prefix,
+                                              start_after=floor,
+                                              limit=live_limit):
+            merged.setdefault(k, v)
+        out = [(k, merged[k]) for k in sorted(merged)
+               if not is_absent_marker(merged[k])]
+        return out[:limit] if limit is not None else out
+
+    def iterate(self, table: str, prefix: str = ""):
+        yield from self.iterate_range(table, prefix)
 
 
 class SnapshotManager:
@@ -121,11 +196,30 @@ class SnapshotManager:
                 return None if is_absent_marker(v) else v
         return store.get("keys", f"/{volume}/{bucket}/{key}")
 
+    def _fso_view(self, volume: str, bucket: str,
+                  info: "SnapshotInfo") -> SnapshotStoreView:
+        return SnapshotStoreView(
+            self.om.store, volume, bucket,
+            self._chain_from(volume, bucket, info.snap_id))
+
+    @staticmethod
+    def _fso_row(entry: dict) -> dict:
+        """walk/list entries -> the snapshot row shape (path-named,
+        tree metadata stripped) the materialized design stored."""
+        return {k: v for k, v in entry.items() if k not in ("type",
+                                                            "path")}
+
     def list_keys(self, volume: str, bucket: str, name: str) -> list[dict]:
         from ozone_tpu.om.requests import is_absent_marker
 
         info = self.get_snapshot(volume, bucket, name)
         store = self.om.store
+        if info.cow and info.fso:
+            from ozone_tpu.om import fso
+
+            view = self._fso_view(volume, bucket, info)
+            return [self._fso_row(e)
+                    for e in fso.walk_files_paged(view, volume, bucket)]
         if not info.cow:
             prefix = _snap_prefix(volume, bucket, info.snap_id) + "/"
             return [v for _, v in store.iterate("keys", prefix)]
@@ -145,6 +239,15 @@ class SnapshotManager:
 
     def lookup_key(self, volume: str, bucket: str, name: str, key: str) -> dict:
         info = self.get_snapshot(volume, bucket, name)
+        if info.cow and info.fso:
+            from ozone_tpu.om import fso
+
+            view = self._fso_view(volume, bucket, info)
+            try:
+                st = fso.lookup_file(view, volume, bucket, key)
+            except OMError:
+                raise OMError("KEY_NOT_FOUND", f"{key}@snapshot:{name}")
+            return self._fso_row(st)
         v = self._value_at(volume, bucket, info, key)
         if v is None:
             raise OMError("KEY_NOT_FOUND", f"{key}@snapshot:{name}")
@@ -237,7 +340,9 @@ class SnapshotManager:
         snapshot's reign. O(changes) even when the journal no longer
         reaches back (the incremental path's restart/retention gap).
         Requires `old` (and everything after it) to be COW."""
-        if not old_info.cow:
+        if not old_info.cow or old_info.fso:
+            # FSO overlays are id-keyed; the full-listing comparison
+            # (over tree-at-snapshot listings) derives their paths
             return None
         if new_info is not None and new_info.created < old_info.created:
             return None  # reversed pair: the full comparison handles it

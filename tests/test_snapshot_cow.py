@@ -209,3 +209,92 @@ def test_overlay_diff_vs_live_and_between_snapshots(om):
     d_live = sm.snapshot_diff("v", "b", "s1")
     assert d_live["mode"] == "overlay"
     assert set(d_live["added"]) == {"new1", "after-s2"}
+
+
+# ------------------------------------------------------------- FSO COW
+@pytest.fixture
+def fso_om(tmp_path):
+    scm = StorageContainerManager(stale_after_s=1e6, dead_after_s=2e6)
+    for i in range(5):
+        scm.register_datanode(f"dn{i}")
+    om = OzoneManager(tmp_path / "om.db", scm)
+    om.create_volume("v")
+    om.create_bucket("v", "f", EC, layout="FILE_SYSTEM_OPTIMIZED")
+    yield om
+    om.close()
+
+
+def _commit_file(om, path, size=10):
+    s = om.open_key("v", "f", path)
+    om.commit_key(s, [], size)
+
+
+def test_fso_create_is_o_snapshots(fso_om):
+    om = fso_om
+    for i in range(30):
+        _commit_file(om, f"d{i % 3}/x{i}")
+    info = om.create_snapshot("v", "f", "s1")
+    assert info["cow"] is True and info["fso"] is True
+    # nothing materialized at create
+    assert _overlay_rows(om, info["snap_id"]) == {}
+    sm = SnapshotManager(om)
+    assert len(sm.list_keys("v", "f", "s1")) == 30
+    assert sm.lookup_key("v", "f", "s1", "d1/x1")["size"] == 10
+
+
+def test_fso_snapshot_survives_directory_rename(fso_om):
+    """The property the old design could only FREEZE: paths at the
+    snapshot stay correct even after an O(1) directory reparent,
+    because reads walk the directory tree AS OF the snapshot."""
+    om = fso_om
+    _commit_file(om, "proj/deep/a", size=5)
+    _commit_file(om, "proj/deep/b", size=6)
+    om.rename_key("v", "f", "proj", "renamed")
+    om.create_snapshot("v", "f", "s1")
+    om.rename_key("v", "f", "renamed", "moved-again")
+    sm = SnapshotManager(om)
+    names = {k["name"] for k in sm.list_keys("v", "f", "s1")}
+    assert names == {"renamed/deep/a", "renamed/deep/b"}
+    assert sm.lookup_key("v", "f", "s1", "renamed/deep/a")["size"] == 5
+    with pytest.raises(rq.OMError):
+        sm.lookup_key("v", "f", "s1", "moved-again/deep/a")
+    # live sees the new paths
+    live = {k["name"] for k in om.list_keys("v", "f")}
+    assert live == {"moved-again/deep/a", "moved-again/deep/b"}
+    # diff pairs the whole subtree as RENAMEs by object id
+    d = sm.snapshot_diff("v", "f", "s1")
+    assert sorted(d["renamed"]) == [
+        ["renamed/deep/a", "moved-again/deep/a"],
+        ["renamed/deep/b", "moved-again/deep/b"],
+    ]
+
+
+def test_fso_delete_and_new_files_after_snapshot(fso_om):
+    om = fso_om
+    _commit_file(om, "dir/old", size=3)
+    om.create_snapshot("v", "f", "s1")
+    om.delete_key("v", "f", "dir/old")
+    _commit_file(om, "dir/new", size=4)
+    sm = SnapshotManager(om)
+    names = {k["name"] for k in sm.list_keys("v", "f", "s1")}
+    assert names == {"dir/old"}
+    assert sm.lookup_key("v", "f", "s1", "dir/old")["size"] == 3
+    with pytest.raises(rq.OMError):
+        sm.lookup_key("v", "f", "s1", "dir/new")
+    d = sm.snapshot_diff("v", "f", "s1")
+    assert d["deleted"] == ["dir/old"]
+    assert d["added"] == ["dir/new"]
+
+
+def test_fso_chained_snapshots_and_delete_merge(fso_om):
+    om = fso_om
+    _commit_file(om, "a/k", size=1)
+    om.create_snapshot("v", "f", "s1")
+    om.create_snapshot("v", "f", "s2")
+    _commit_file(om, "a/k", size=2)  # pre-image lands in s2
+    om.delete_snapshot("v", "f", "s2")  # merges down into s1
+    sm = SnapshotManager(om)
+    assert sm.lookup_key("v", "f", "s1", "a/k")["size"] == 1
+    om.delete_snapshot("v", "f", "s1")
+    leftovers = [k for k, _ in om.store.iterate("keys", "/.snapshot/")]
+    assert leftovers == []
