@@ -75,6 +75,13 @@ class VolumeDB:
             " PRIMARY KEY (container_id, local_id))"
         )
         self._conn.execute("PRAGMA journal_mode=WAL")
+        # WAL + NORMAL: block-metadata commits stop paying an fsync per
+        # putBlock — the reference datanode's container DB writes with
+        # RocksDB default WriteOptions (sync=false) the same way. WAL
+        # keeps every committed txn across a PROCESS crash (the chaos
+        # suite's kill -9); only an OS/power crash can drop the tail,
+        # where the SCM's replica accounting repairs from peers.
+        self._conn.execute("PRAGMA synchronous=NORMAL")
         self._conn.commit()
 
     @_guard_sqlite
