@@ -46,7 +46,8 @@ _DEADLINE = time.time() + BUDGET_S
 #: progressively updated by the measurement loops; the watchdog and the
 #: normal exit path both read it
 _STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None,
-                "sharded": None, "decode": None, "decode_spread": None}
+                "sharded": None, "decode": None, "decode_spread": None,
+                "decode_sustained": None, "decode_churn": None}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
@@ -81,6 +82,11 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
         if _STATE["decode"] is not None:
             line["decode_gib_s"] = round(_STATE["decode"], 3)
             line["decode_spread_pct"] = round(_STATE["decode_spread"], 1)
+        if _STATE["decode_sustained"] is not None:
+            line["decode_sustained_gib_s"] = round(
+                _STATE["decode_sustained"], 3)
+        if _STATE["decode_churn"] is not None:
+            line["decode_churn_gib_s"] = round(_STATE["decode_churn"], 3)
         if timed_out:
             line["timed_out"] = True
         if error:
@@ -201,10 +207,12 @@ def bench_fused_encode(batch: int = 128, cell: int = 1024 * 1024,
 
 
 def bench_fused_decode(batch: int = 48, cell: int = 1024 * 1024,
-                       iters: int = 8, rounds: int = 5) -> dict:
+                       iters: int = 8, rounds: int = 6) -> dict:
     """BASELINE config #3 with the same median-of-rounds treatment as
     encode (round-4 verdict: a single-shot decode number has unknown
-    variance — one cold round could read as a regression)."""
+    variance — one cold round could read as a regression). 3 warmups
+    like encode: BENCH_r05 showed 21% decode spread with 2, and the
+    dipping rounds were the early ones (chip still ramping clock)."""
     import jax
 
     from ozone_tpu.codec.api import CoderOptions
@@ -221,8 +229,97 @@ def bench_fused_decode(batch: int = 48, cell: int = 1024 * 1024,
         rng.integers(0, 256, (batch, 10, cell), dtype=np.uint8)
     )
     gib = batch * 10 * cell / 2**30
-    return _run_rounds(fn, data, gib, iters, rounds, warmups=2,
+    return _run_rounds(fn, data, gib, iters, rounds, warmups=3,
                        label="decode")
+
+
+def bench_decode_churn(batch: int = 16, cell: int = 1024 * 1024,
+                       patterns: int = 12, rounds: int = 4) -> dict:
+    """Pattern-churn decode: every dispatch uses a DIFFERENT erasure
+    pattern of RS(10,4), the multi-unit-failure read profile. With the
+    old per-(valid, erased) jit cache each new pattern compiled a fresh
+    executable (seconds of stall mid-read — the cliff this bench exists
+    to expose); the persistent decode-plan cache serves all patterns
+    from ONE compiled program, so churn throughput should match the
+    fixed-pattern decode rate."""
+    import itertools
+    import statistics
+
+    import jax
+
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.codec.fused import (
+        FusedSpec,
+        decode_jit_cache_size,
+        make_fused_decoder,
+    )
+    from ozone_tpu.utils.checksum import ChecksumType
+
+    opts = CoderOptions(10, 4, "rs", cell_size=cell)
+    spec = FusedSpec(opts, ChecksumType.CRC32C, bytes_per_checksum=16 * 1024)
+    pats = list(itertools.combinations(range(14), 2))[:patterns]
+    rng = np.random.default_rng(6)
+    data = jax.device_put(
+        rng.integers(0, 256, (batch, 10, cell), dtype=np.uint8))
+    gib = batch * 10 * cell / 2**30
+
+    def one_round():
+        # keep only the newest dispatch's outputs live: retaining all
+        # patterns' [B, e, C] results would hold hundreds of MiB of HBM
+        # and skew the measurement with allocator pressure
+        out = None
+        for erased in pats:
+            valid = [u for u in range(14) if u not in erased][:10]
+            fn = make_fused_decoder(spec, valid, list(erased))
+            out = fn(data)
+        jax.device_get(jax.tree.map(
+            lambda o: o[(0,) * (o.ndim - 1)], out))
+
+    jits0 = decode_jit_cache_size()
+    one_round()  # warm: first pattern compiles the ONE shared program
+    rates = []
+    for r in range(rounds):
+        if rates and remaining() < 30:
+            log(f"  decode-churn: stopping after {len(rates)} rounds "
+                "(budget)")
+            break
+        t0 = time.time()
+        one_round()
+        dt = (time.time() - t0) / len(pats)
+        rates.append(gib / dt)
+        log(f"  decode-churn round {r}: {dt*1e3:.2f} ms/pattern-dispatch "
+            f"-> {gib/dt:.2f} GiB/s")
+    med = statistics.median(rates)
+    compiles = decode_jit_cache_size() - jits0
+    log(f"  decode-churn: median {med:.2f} GiB/s over {len(pats)} "
+        f"patterns/round, {compiles} compiled program(s) total")
+    return {"median": med, "best": max(rates), "min": min(rates),
+            "spread_pct": 100.0 * (max(rates) - min(rates)) / med,
+            "compiles": compiles}
+
+
+def bench_decode_sustained(seconds: float = 60.0, batch: int = 48,
+                           cell: int = 1024 * 1024, iters: int = 8) -> dict:
+    """Sustained decode proof (the read/repair twin of bench_sustained):
+    run the fused RS(10,4) 2-erasure decode continuously for `seconds`
+    and report steady-state throughput — reconstruction of a whole
+    container group is minutes of sustained decode, not short bursts."""
+    import jax
+
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.codec.fused import FusedSpec, make_fused_decoder
+    from ozone_tpu.utils.checksum import ChecksumType
+
+    opts = CoderOptions(10, 4, "rs", cell_size=cell)
+    spec = FusedSpec(opts, ChecksumType.CRC32C, bytes_per_checksum=16 * 1024)
+    valid = list(range(2, 12))
+    fn = make_fused_decoder(spec, valid, erased=[0, 1])
+    rng = np.random.default_rng(8)
+    data = jax.device_put(
+        rng.integers(0, 256, (batch, 10, cell), dtype=np.uint8))
+    gib = batch * 10 * cell / 2**30
+    return _run_sustained(fn, data, gib, seconds, iters,
+                          label="decode sustained")
 
 
 def bench_xor_reencode(batch: int = 128, cell: int = 1024 * 1024,
@@ -305,6 +402,16 @@ def bench_sustained(seconds: float = 60.0, batch: int = 128,
     data = jax.device_put(
         rng.integers(0, 256, (batch, 6, cell), dtype=np.uint8))
     gib = batch * 6 * cell / 2**30
+    return _run_sustained(fn, data, gib, seconds, iters, label="sustained")
+
+
+def _run_sustained(fn, data, gib: float, seconds: float, iters: int,
+                   label: str) -> dict:
+    """Shared sustained-load measurement loop (encode and decode flavors):
+    warm/ramp, then run continuously for `seconds`, reporting the overall
+    rate, the second-half steady state and the worst inter-mark window."""
+    import jax
+
     # compile + first ramp
     outs = [fn(data) for _ in range(4)]
     jax.block_until_ready(outs[-1])
@@ -334,7 +441,7 @@ def bench_sustained(seconds: float = 60.0, batch: int = 128,
         "worst_window": min(lows) if lows else overall,
         "windows": len(marks),
     }
-    log(f"  sustained {total_s:.0f}s: overall {overall:.2f} GiB/s, "
+    log(f"  {label} {total_s:.0f}s: overall {overall:.2f} GiB/s, "
         f"steady-state (2nd half) {steady:.2f}, worst window "
         f"{out['worst_window']:.2f} over {len(marks)} windows")
     return out
@@ -427,15 +534,9 @@ def main() -> None:
                 f"{sh['median']:.2f} GiB/s/chip — config #5 per-chip rate")
         except Exception as e:
             log(f"sharded bench failed: {e}")
-    if budget_for("sustained bench", 150):
-        try:
-            sustained = bench_sustained(
-                seconds=min(60.0, max(20.0, remaining() - 90)))
-            _STATE["sustained"] = sustained["steady"]
-            log(f"sustained steady-state: {sustained['steady']:.2f} "
-                f"GiB/s/chip (overall {sustained['overall']:.2f})")
-        except Exception as e:
-            log(f"sustained bench failed: {e}")
+    # decode family next (this PR's hot path): the burst decode median,
+    # the pattern-churn cliff probe, and the sustained-60s decode number
+    # all feed the driver's JSON trajectory from this round on
     if budget_for("decode bench", 90):
         try:
             dec = bench_fused_decode()
@@ -447,6 +548,33 @@ def main() -> None:
                 f"spread {dec['spread_pct']:.0f}%)")
         except Exception as e:  # secondary metrics: never the headline
             log(f"decode bench failed: {e}")
+    if budget_for("decode-churn bench", 60):
+        try:
+            churn = bench_decode_churn()
+            _STATE["decode_churn"] = churn["median"]
+            log(f"pattern-churn decode (fresh erasure pattern per "
+                f"dispatch): median {churn['median']:.2f} GiB/s/chip, "
+                f"{churn['compiles']} compile(s)")
+        except Exception as e:
+            log(f"decode-churn bench failed: {e}")
+    if budget_for("decode sustained bench", 120):
+        try:
+            dsus = bench_decode_sustained(
+                seconds=min(60.0, max(20.0, remaining() - 60)))
+            _STATE["decode_sustained"] = dsus["steady"]
+            log(f"decode sustained steady-state: {dsus['steady']:.2f} "
+                f"GiB/s/chip (overall {dsus['overall']:.2f})")
+        except Exception as e:
+            log(f"decode sustained bench failed: {e}")
+    if budget_for("sustained bench", 150):
+        try:
+            sustained = bench_sustained(
+                seconds=min(60.0, max(20.0, remaining() - 90)))
+            _STATE["sustained"] = sustained["steady"]
+            log(f"sustained steady-state: {sustained['steady']:.2f} "
+                f"GiB/s/chip (overall {sustained['overall']:.2f})")
+        except Exception as e:
+            log(f"sustained bench failed: {e}")
     if budget_for("re-encode bench", 60):
         try:
             re = bench_xor_reencode()

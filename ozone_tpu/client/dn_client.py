@@ -56,6 +56,64 @@ def write_unit_batched(client, block_id: "BlockID", pairs,
     client.put_block(commit, writer=writer)
 
 
+def write_unit_stream(client, block_id: "BlockID", pairs,
+                      writer: Optional[str] = None) -> None:
+    """Land one BATCH of a unit's chunks with no commit: the streaming
+    half of write_unit_batched used by the pipelined reconstruction and
+    re-encode flows — batch N's chunks go out while batch N+1 decodes on
+    device, and the single put_block commit follows once every batch has
+    landed (same all-chunks-before-commit order). Unlike the one-shot
+    write_unit_batched this is called once per stripe window, so the
+    downgrade is remembered on the client — one failed probe per peer,
+    not one per window."""
+    from ozone_tpu.storage.ids import StorageError
+
+    fn = getattr(client, "write_chunks_commit", None)
+    if fn is not None and not getattr(client, "_stream_downgraded", False):
+        try:
+            fn(block_id, pairs, commit=None, writer=writer)
+            return
+        except StorageError as e:
+            if not batch_unsupported(e):
+                raise
+            client._stream_downgraded = True
+    for info, data in pairs:
+        client.write_chunk(block_id, info, data, writer=writer)
+
+
+def build_chunk_pairs(block_id: "BlockID", stripes, cells, crcs,
+                      unit_len: int, cell: int, bpc: int, checksum,
+                      host_checksum) -> list[tuple["ChunkInfo", object]]:
+    """(ChunkInfo, data) pairs for one unit's cells of the given stripe
+    indexes — cells [len(stripes), cell], crcs [len(stripes), S] device
+    CRCs (size 0 to force host checksums). Full cells reuse the
+    device-computed CRCs so repaired data is never re-checksummed on
+    host; the tail chunk (or a non-dividing bpc) falls back to the host
+    checksummer. Shared by the pipelined reconstruction and re-encode
+    emit loops so the CRC-eligibility rule and chunk naming cannot
+    diverge between the two repair paths."""
+    from ozone_tpu.utils.checksum import ChecksumData
+
+    pairs: list[tuple[ChunkInfo, object]] = []
+    for bi, s in enumerate(stripes):
+        chunk_len = max(0, min(cell, unit_len - s * cell))
+        if chunk_len == 0:
+            continue
+        data = cells[bi, :chunk_len]
+        if chunk_len == cell and cell % bpc == 0 and crcs.size:
+            cs = ChecksumData(checksum, bpc, tuple(
+                int(v).to_bytes(4, "big") for v in crcs[bi].tolist()))
+        else:
+            cs = host_checksum.compute(data)
+        pairs.append((ChunkInfo(
+            name=f"{block_id}_chunk_{s}",
+            offset=s * cell,
+            length=chunk_len,
+            checksum=cs,
+        ), data))
+    return pairs
+
+
 class TokenStore:
     """Client-side cache of OM/SCM-granted block and container tokens.
 

@@ -23,6 +23,11 @@ from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import BlockGroup, block_lengths
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.fused import FusedSpec, make_fused_decoder
+from ozone_tpu.codec.pipeline import (
+    DeviceBatchPipeline,
+    batched,
+    decode_batch_size,
+)
 from ozone_tpu.storage.ids import BlockData, ChunkInfo, StorageError
 from ozone_tpu.utils.checksum import ChecksumType
 
@@ -73,7 +78,7 @@ class ECBlockGroupReader:
         self.verify = verify
         self.spec = FusedSpec(options, checksum, bytes_per_checksum)
         self._block_meta: dict[int, Optional[BlockData]] = {}
-        self._read_pool = None  # lazy; see _recover_cells_once
+        self._read_pool = None  # lazy; see _recover_batches_once
         #: (unit, stripe) -> full-cell array, filled by _prefetch_unit's
         #: batched ReadChunks and consumed (popped) by _read_cell
         self._cell_cache: dict[tuple[int, int], np.ndarray] = {}
@@ -81,6 +86,11 @@ class ECBlockGroupReader:
 
         self._batch_reads = os.environ.get(
             "OZONE_TPU_BATCH_READS", "1") != "0"
+        #: stripes per decode dispatch; recovery runs these through a
+        #: depth-1 device pipeline (survivor fetch of batch N+1 overlaps
+        #: device decode + D2H of batch N — the writer's _flush_queue
+        #: structure mirrored onto the read path)
+        self._decode_batch = decode_batch_size()
         # units that failed a read/verify; excluded like missing replicas
         # (reference ECBlockInputStream setFailed + proxy failover)
         self._failed: set[int] = set()
@@ -285,10 +295,38 @@ class ECBlockGroupReader:
         """recover_cells plus the per-slice device CRCs of the recovered
         cells [num_stripes, len(targets), cell // bpc] — reconstruction
         writes reuse them so recovered data is never re-checksummed on host."""
+        stripes = list(
+            stripes if stripes is not None else range(self.num_stripes))
+        pos = {s: i for i, s in enumerate(stripes)}
+        rec = np.zeros((len(stripes), len(targets), self.cell),
+                       dtype=np.uint8)
+        crcs: Optional[np.ndarray] = None
+        for sb, (r, c) in self.recover_cells_iter(targets, stripes):
+            if crcs is None:
+                crcs = np.zeros(
+                    (len(stripes), len(targets)) + c.shape[2:], c.dtype)
+            for bi, s in enumerate(sb):
+                rec[pos[s]] = r[bi]
+                crcs[pos[s]] = c[bi]
+        if crcs is None:  # zero stripes requested
+            crcs = np.zeros((0, len(targets), 0), np.uint32)
+        return rec, crcs
+
+    def recover_cells_iter(
+        self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None
+    ):
+        """Streaming recovery: yields (stripe_batch, (rec, crcs)) per
+        decode batch — rec [b, len(targets), cell], crcs [b, len(targets),
+        cell // bpc] — so consumers (offline reconstruction) write one
+        batch's recovered chunks while the device decodes the next. On a
+        unit failure mid-stream the whole recovery restarts with the unit
+        excluded and ALL batches are re-yielded; consumers must treat
+        stripe indexes as overwrite keys (chunk writes are idempotent)."""
         try:
             for _ in range(self.p + 1):
                 try:
-                    return self._recover_cells_once(targets, stripes)
+                    yield from self._recover_batches_once(targets, stripes)
+                    return
                 except _UnitReadError as e:
                     log.warning(
                         "unit %d failed during recovery (%s); excluding",
@@ -302,53 +340,71 @@ class ECBlockGroupReader:
         finally:
             self._close_pool()
 
-    def _recover_cells_once(
+    def _recover_batches_once(
         self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None
-    ) -> np.ndarray:
-        stripes = list(stripes if stripes is not None else range(self.num_stripes))
+    ):
+        """One recovery attempt as a depth-1 device pipeline: survivor
+        reads of batch N+1 run while batch N decodes on device and its
+        results pull to host (the writer's _flush_queue overlap mirrored
+        onto the read path). One device dispatch per stripe batch — not
+        per stripe — with the per-pattern plan coming from the
+        persistent decode-plan cache."""
+        stripes = list(
+            stripes if stripes is not None else range(self.num_stripes))
         valid = self._choose_valid(list(targets))
-        batch = np.zeros((len(stripes), self.k, self.cell), dtype=np.uint8)
+        fn = (self._mesh_decode_fn(valid, list(targets))
+              if self.mesh is not None
+              else make_fused_decoder(self.spec, valid, list(targets)))
+        pipe = DeviceBatchPipeline(fn)
+        pool = self._ensure_pool()
+        for sb in batched(stripes, self._decode_batch):
+            batch = np.zeros((len(sb), self.k, self.cell), dtype=np.uint8)
 
-        def fill_unit(vi_u):
-            vi, u = vi_u
-            # one batched ReadChunks for the unit's whole column first;
-            # cells it couldn't serve fall back to per-chunk reads
-            self._prefetch_unit(u, stripes)
-            for bi, s in enumerate(stripes):
-                batch[bi, vi] = self._read_cell_checked(u, s)
+            def fill_unit(vi_u):
+                vi, u = vi_u
+                # one batched ReadChunks for the unit's cells of this
+                # batch first; cells it couldn't serve fall back to
+                # per-chunk reads
+                self._prefetch_unit(u, sb)
+                for bi, s in enumerate(sb):
+                    batch[bi, vi] = self._read_cell_checked(u, s)
 
-        # one reader thread per survivor unit: the k unit streams come
-        # off k DIFFERENT datanodes, so the read fan-in costs the
-        # slowest node, not the sum (the reference reads survivors with
-        # parallel stream readers in
-        # ECBlockReconstructedStripeInputStream). Pool cached on the
-        # reader: recovery retries up to p+1 times per block group.
-        list(self._ensure_pool().map(fill_unit, enumerate(valid)))
-        if self.mesh is not None:
-            return self._decode_on_mesh(batch, valid, list(targets))
-        fn = make_fused_decoder(self.spec, valid, list(targets))
-        rec, crcs = fn(batch)
-        return np.asarray(rec), np.asarray(crcs)
+            # one reader thread per survivor unit: the k unit streams
+            # come off k DIFFERENT datanodes, so the read fan-in costs
+            # the slowest node, not the sum (the reference reads
+            # survivors with parallel stream readers in
+            # ECBlockReconstructedStripeInputStream). Pool cached on the
+            # reader: recovery retries up to p+1 times per block group.
+            list(pool.map(fill_unit, enumerate(valid)))
+            out = pipe.submit(batch, sb)
+            if out is not None:
+                yield out
+        out = pipe.drain()
+        if out is not None:
+            yield out
 
-    def _decode_on_mesh(
-        self, batch: np.ndarray, valid: list[int], targets: list[int]
-    ) -> tuple[np.ndarray, np.ndarray]:
+    def _mesh_decode_fn(self, valid: list[int], targets: list[int]):
         """Multi-chip decode (ECReconstructionCoordinator.java:146 run on
         a device mesh instead of one device): DP shards the stripe batch;
         the SP ring shards SURVIVORS (one group per chip — the layout
-        where each chip fronts one source datanode's bytes)."""
+        where each chip fronts one source datanode's bytes). Returns a
+        device-array fn pluggable into the decode pipeline."""
         from ozone_tpu.parallel import sharded
 
         if self.use_ring:
-            fn = sharded.make_ring_decoder(
+            return sharded.make_ring_decoder(
                 self.spec, valid, targets, self.mesh)
-            rec, crcs = fn(batch)
-            return np.asarray(rec), np.asarray(crcs)
-        fn = sharded.make_sharded_decoder(
+        inner = sharded.make_sharded_decoder(
             self.spec, valid, targets, self.mesh)
-        padded, orig = sharded.pad_batch(batch, self.mesh.devices.size)
-        rec, crcs = fn(padded)
-        return np.asarray(rec)[:orig], np.asarray(crcs)[:orig]
+        n = self.mesh.devices.size
+
+        def fn(batch: np.ndarray):
+            padded, orig = sharded.pad_batch(batch, n)
+            rec, crcs = inner(padded)
+            # lazy device slices: the pipeline pulls them to host later
+            return rec[:orig], crcs[:orig]
+
+        return fn
 
     # ---------------------------------------------------------------- ranged
     def read(self, offset: int, length: int) -> np.ndarray:

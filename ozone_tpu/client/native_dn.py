@@ -191,6 +191,19 @@ class NativeDatanodeClient(GrpcDatanodeClient):
         if commit is not None:
             meta["commit"] = commit.to_json()
         hdr = json.dumps(meta, separators=(",", ":")).encode()
+        # validate every chunk length BEFORE any frame leaves: a
+        # mid-stream local raise (after WHDR+CHUNK frames, no END) would
+        # leave the connection's framing desynchronized — the server
+        # still in its chunk loop — so it could never be pooled again
+        views = []
+        for info, data in chunks:
+            view = _payload_view(data)
+            if len(view) != info.length:
+                raise StorageError(
+                    "INVALID_WRITE_SIZE",
+                    f"chunk {info.name}: data {len(view)} != "
+                    f"declared {info.length}")
+            views.append(view)
         try:
             conn = self._checkout(port)
         except OSError:
@@ -198,15 +211,10 @@ class NativeDatanodeClient(GrpcDatanodeClient):
             self._disable_native()
             return super().write_chunks_commit(
                 block_id, chunks, commit=commit, sync=sync, writer=writer)
+        completed = False  # STATUS received: framing is in lockstep
         try:
             conn.send_frame(_T_WHDR, hdr)
-            for info, data in chunks:
-                view = _payload_view(data)
-                if len(view) != info.length:
-                    raise StorageError(
-                        "INVALID_WRITE_SIZE",
-                        f"chunk {info.name}: data {len(view)} != "
-                        f"declared {info.length}")
+            for (info, _data), view in zip(chunks, views):
                 # one gathered syscall per chunk: frame prefix + binary
                 # chunk header + the payload zero-copy from its buffer
                 _send_iov(conn.sock,
@@ -217,6 +225,7 @@ class NativeDatanodeClient(GrpcDatanodeClient):
             tag, body = conn.recv_frame()
             if tag != _T_STATUS:
                 raise ConnectionError(f"unexpected frame tag {tag:#x}")
+            completed = True
             self._status(conn, body)
         except (OSError, ConnectionError) as e:
             conn.close()
@@ -224,7 +233,15 @@ class NativeDatanodeClient(GrpcDatanodeClient):
                 "UNAVAILABLE",
                 f"native datapath to {self.address}: {e}") from e
         except StorageError:
-            self._checkin(conn)
+            if completed:
+                # server-reported error after a full request/STATUS
+                # exchange: the stream is in lockstep, safe to pool
+                self._checkin(conn)
+            else:
+                # locally-raised mid-stream: framing state unknown —
+                # pooling it would surface a spurious UNAVAILABLE on
+                # the next checkout (same rule as the read path)
+                conn.close()
             raise
         else:
             self._checkin(conn)

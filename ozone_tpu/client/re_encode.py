@@ -84,21 +84,30 @@ def re_encode_key_to_ec(
     return om.lookup_key(volume, bucket, key)
 
 
-def _read_unit_cells(clients, group, unit, stripes, cell):
-    """One unit's cells as [stripes, cell] zero-padded, or None if the
-    replica is unreachable/missing."""
+def _unit_source(clients, group, unit, cell):
+    """(client, {stripe: ChunkInfo}) of one unit's replica, or None if
+    the replica is unreachable/missing. The block record is fetched and
+    indexed by stripe once per group; cell reads then happen per stripe
+    window (_read_unit_window) so the re-encode pipeline can overlap
+    them with the device pass."""
     dn_id = group.pipeline.nodes[unit]
     try:
         client = clients.get(dn_id)
         bd = client.get_block(group.block_id)
     except Exception:  # noqa: BLE001 - any failure = unit unavailable
         return None
-    out = np.zeros((stripes, cell), dtype=np.uint8)
-    for info in bd.chunks:
-        s = info.offset // cell
-        if s < stripes:
+    return client, {info.offset // cell: info for info in bd.chunks}
+
+
+def _read_unit_window(group, source, s0: int, n: int, cell: int):
+    """One unit's cells for stripes [s0, s0+n) as [n, cell] zero-padded."""
+    client, by_stripe = source
+    out = np.zeros((n, cell), dtype=np.uint8)
+    for s in range(s0, s0 + n):
+        info = by_stripe.get(s)
+        if info is not None:
             data = client.read_chunk(group.block_id, info)
-            out[s, : info.length] = data[: info.length]
+            out[s - s0, : info.length] = data[: info.length]
     return out
 
 
@@ -117,7 +126,10 @@ def re_encode_xor_key_to_rs(
     straight to the freshly allocated group with the device-computed
     CRCs (reference analog: XORRawDecoder.decode + RSRawEncoder.encode
     inside the container-service conversion flow)."""
-    from ozone_tpu.client.dn_client import write_unit_batched
+    from ozone_tpu.client.dn_client import (
+        build_chunk_pairs,
+        write_unit_stream,
+    )
     from ozone_tpu.client.ec_writer import (
         block_lengths,
         create_group_containers,
@@ -125,10 +137,15 @@ def re_encode_xor_key_to_rs(
     from ozone_tpu.codec.fused import (
         FusedSpec,
         effective_bpc,
+        make_fused_encoder,
         make_fused_reencoder,
         reencode_layout_crcs,
     )
-    from ozone_tpu.utils.checksum import Checksum, ChecksumData
+    from ozone_tpu.codec.pipeline import (
+        DeviceBatchPipeline,
+        decode_batch_size,
+    )
+    from ozone_tpu.utils.checksum import Checksum
 
     info = om.lookup_key(volume, bucket, key)
     old_groups = om.key_block_groups(info)
@@ -152,76 +169,99 @@ def re_encode_xor_key_to_rs(
     session = om.open_key(volume, bucket, key, replication=ec)
     new_groups = []
     total = 0
+    window = decode_batch_size()
     for g in old_groups:
         stripes = -(-g.length // (k * cell))
-        # read the k input slots: data units where alive, the XOR parity
-        # in the lost unit's slot (or in slot 0 when nothing is lost —
-        # same IO volume, one uniform device program)
-        units = [
-            _read_unit_cells(clients, g, u, stripes, cell) for u in range(k)
-        ]
-        missing = [u for u, x in enumerate(units) if x is None]
+        # locate the k input slots: data units where alive, the XOR
+        # parity in the lost unit's slot (or in slot 0 when nothing is
+        # lost — same IO volume, one uniform device program)
+        sources = [_unit_source(clients, g, u, cell) for u in range(k)]
+        missing = [u for u, x in enumerate(sources) if x is None]
         if len(missing) > 1:
             raise StorageError(
                 "INSUFFICIENT_LOCATIONS",
                 f"group {g.block_id}: {len(missing)} data units lost, "
                 f"XOR(1) tolerates one")
         lost = missing[0] if missing else 0
-        parity_cells = _read_unit_cells(clients, g, k, stripes, cell)
-        if parity_cells is None:
-            if missing:
-                raise StorageError(
-                    "INSUFFICIENT_LOCATIONS",
-                    f"group {g.block_id}: data unit {lost} AND the XOR "
-                    f"parity are gone")
-            # no loss at all: slot 0 keeps its data; the device recovery
-            # output is discarded in favor of the original unit below
-            parity_cells = units[0]
-        units[lost] = parity_cells
-        batch = np.stack(units, axis=1)  # [S, k, C]
-
-        # the recovered slot is correct in BOTH cases: with a loss it is
-        # the decode; without one it equals the original unit 0 (XOR of
-        # parity and units 1..k-1), so writing it doubles as a parity
-        # consistency check
-        fn = make_fused_reencoder(spec, lost=lost)
-        out, ucrcs, ocrcs = (np.asarray(x) for x in fn(batch))
-        crcs = reencode_layout_crcs(ucrcs, ocrcs, lost)
-
+        parity_src = _unit_source(clients, g, k, cell)
+        parity_ok = parity_src is not None
+        if parity_ok:
+            sources[lost] = parity_src
+        elif missing:
+            raise StorageError(
+                "INSUFFICIENT_LOCATIONS",
+                f"group {g.block_id}: data unit {lost} AND the XOR "
+                f"parity are gone")
+        # With the XOR parity in slot `lost`, the reencoder's recovery
+        # column is correct in BOTH cases: with a loss it is the decode;
+        # without one it equals the original unit 0 (XOR of parity and
+        # units 1..k-1), so writing it doubles as a parity consistency
+        # check. When the parity replica itself is gone (and nothing
+        # else is), every slot holds original data and the reencoder's
+        # decode matrix would fold slot `lost` into the WRONG vector
+        # (XOR of all data = the parity) — both for the recovered column
+        # and for the RS parity computed from it — so that case runs the
+        # plain fused encode over the k data units instead.
+        fn = (make_fused_reencoder(spec, lost=lost) if parity_ok
+              else make_fused_encoder(spec))
         ng = om.allocate_block(session)
         create_group_containers(clients, ng, replica_indexed=True)
         lengths = block_lengths(g.length, k, cell) + [
             stripes * cell
         ] * p
-        for u in range(k + p):
-            if u < k:
-                cells = out[:, 0] if u == lost else batch[:, u]
+        unit_infos: list[list[ChunkInfo]] = [[] for _ in range(k + p)]
+
+        def emit(ctx, results):
+            """Write one window's RS layout to the new group — runs
+            while the NEXT window reads + re-encodes on device."""
+            s0, n, batch = ctx
+            if parity_ok:
+                out, ucrcs, ocrcs = results
+                crcs = reencode_layout_crcs(ucrcs, ocrcs, lost)
+
+                def unit_cells(u):
+                    if u < k:
+                        return out[:, 0] if u == lost else batch[:, u]
+                    return out[:, 1 + (u - k)]
             else:
-                cells = out[:, 1 + (u - k)]
-            dn = clients.get(ng.pipeline.nodes[u])
-            pairs = []
-            for s in range(stripes):
-                chunk_len = max(0, min(cell, lengths[u] - s * cell))
-                if chunk_len == 0:
-                    continue
-                if chunk_len == cell and cell % bpc == 0 and crcs.size:
-                    cs = ChecksumData(ctype, bpc, tuple(
-                        int(v).to_bytes(4, "big")
-                        for v in crcs[s, u].tolist()))
-                else:
-                    cs = host_checksum.compute(cells[s, :chunk_len])
-                ci = ChunkInfo(
-                    name=f"{ng.block_id}_chunk_{s}",
-                    offset=s * cell,
-                    length=chunk_len,
-                    checksum=cs,
-                )
-                pairs.append((ci, cells[s, :chunk_len]))
-            commit = BlockData(ng.block_id, [i for i, _ in pairs],
-                               block_group_length=g.length)
-            # one batched stream per unit when the target serves it
-            # (WriteChunksCommit), per-chunk verbs otherwise
-            write_unit_batched(dn, ng.block_id, pairs, commit)
+                # plain encode: data columns pass through, the device
+                # produced the parity and the full k+p EC-layout CRCs
+                parity_cells, crcs = results
+
+                def unit_cells(u):
+                    return batch[:, u] if u < k else parity_cells[:, u - k]
+            for u in range(k + p):
+                pairs = build_chunk_pairs(
+                    ng.block_id, range(s0, s0 + n), unit_cells(u),
+                    crcs[:, u], lengths[u], cell, bpc, ctype,
+                    host_checksum)
+                if pairs:
+                    # one batched stream per unit per window when the
+                    # target serves it (WriteChunksCommit), per-chunk
+                    # verbs otherwise
+                    write_unit_stream(clients.get(ng.pipeline.nodes[u]),
+                                      ng.block_id, pairs)
+                    unit_infos[u].extend(i for i, _ in pairs)
+
+        # depth-1 pipeline over stripe windows: the ec_writer's
+        # _flush_queue structure on the conversion path — target writes
+        # of window N overlap the device pass + D2H of window N+1
+        pipe = DeviceBatchPipeline(fn)
+        for s0 in range(0, stripes, window):
+            n = min(window, stripes - s0)
+            batch = np.stack(
+                [_read_unit_window(g, src, s0, n, cell) for src in sources],
+                axis=1)  # [n, k, C]
+            done = pipe.submit(batch, (s0, n, batch))
+            if done is not None:
+                emit(*done)
+        done = pipe.drain()
+        if done is not None:
+            emit(*done)
+
+        for u in range(k + p):
+            clients.get(ng.pipeline.nodes[u]).put_block(BlockData(
+                ng.block_id, unit_infos[u], block_group_length=g.length))
         ng.length = g.length
         new_groups.append(ng)
         total += g.length

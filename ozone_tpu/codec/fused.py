@@ -13,6 +13,7 @@ reconstruction: recover erased units and checksum them in one dispatch.
 
 from __future__ import annotations
 
+import functools
 import threading
 from dataclasses import dataclass
 from functools import lru_cache
@@ -343,34 +344,71 @@ def make_fused_encoder(spec: FusedSpec):
                                 spec.bytes_per_checksum)
 
 
-@lru_cache(maxsize=64)
-def _fused_decode_cached(
-    options: CoderOptions,
-    checksum: ChecksumType,
-    bpc: int,
-    valid: tuple,
-    erased: tuple,
-):
-    dm = _decode_matrix(options, list(valid), list(erased))
-    a = jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
+@functools.partial(jax.jit, static_argnames=("zeros_crc",))
+def _decode_apply_jit(valid_units: jax.Array, a_bits: jax.Array,
+                      k_planes: jax.Array, zeros_crc: int):
+    """One decode+CRC executable for EVERY erasure pattern: the recovery
+    matrix arrives as a traced argument (the jax_coder._gf_apply_jit
+    treatment applied to the fused pass), so jit caches per SHAPE
+    (batch, erasure count, cell, bpc) — pattern churn during multi-unit
+    failures swaps the tiny device matrix, never the compiled program.
+    The old per-(valid, erased) lru_cache of jitted closures evicted
+    whole executables under churn and recompiled mid-read (the measured
+    21% decode spread in BENCH_r05)."""
+    rec = gf_apply(valid_units, a_bits)  # [B, e, C]
+    crcs = crc_device.crc_slices(rec, k_planes, zeros_crc)
+    return rec, crcs
+
+
+@jax.jit
+def _decode_apply_nocrc_jit(valid_units: jax.Array, a_bits: jax.Array):
+    rec = gf_apply(valid_units, a_bits)  # [B, e, C]
+    return rec, jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
+
+
+def decode_jit_cache_size() -> int:
+    """Compiled fused-decode executables currently cached. The
+    pattern-churn tests/bench probe this to assert that a NEW erasure
+    pattern of an already-seen shape costs zero recompiles."""
+    return int(_decode_apply_jit._cache_size()
+               + _decode_apply_nocrc_jit._cache_size())
+
+
+@lru_cache(maxsize=8)
+def crc_plan_cached(checksum: ChecksumType, bpc: int):
+    """(device CRC constant table | None, initial CRC) for one
+    (checksum, bpc) — pattern-INDEPENDENT, so every decode plan of a
+    config shares ONE device copy instead of re-deriving and re-storing
+    the table per erasure pattern."""
     if checksum in _POLY:
-        k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
-        k_dev = jnp.asarray(k_np)
-    else:
-        k_dev, zeros_crc = None, 0
-
-    @jax.jit
-    def fn(valid_units: jax.Array):
-        rec = gf_apply(valid_units, a)  # [B, e, C]
-        if k_dev is None:
-            return rec, jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
-        crcs = crc_device.crc_slices(rec, k_dev, zeros_crc)
-        return rec, crcs
-
-    return fn
+        k_np, zeros_crc = crc_device.crc_constants_planemajor(
+            bpc, _POLY[checksum])
+        return jnp.asarray(k_np), zeros_crc
+    return None, 0
 
 
-@lru_cache(maxsize=64)
+@lru_cache(maxsize=512)
+def _decode_plan_cached(options: CoderOptions, valid: tuple, erased: tuple):
+    """Persistent decode plan for one (valid, erased) pattern: the
+    device-resident bit-expanded recovery matrix. Cheap to build (a
+    k x k GF inversion and one small device_put), so the cache can be
+    generously sized — the expensive jitted executable lives in
+    _decode_apply_jit and is shared across all patterns."""
+    dm = _decode_matrix(options, list(valid), list(erased))
+    return jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
+
+
+def _fused_decode_plan(options: CoderOptions, checksum: ChecksumType,
+                       bpc: int, valid: tuple, erased: tuple):
+    a = _decode_plan_cached(options, valid, erased)
+    k_dev, zeros_crc = crc_plan_cached(checksum, bpc)
+    if k_dev is None:
+        return lambda valid_units: _decode_apply_nocrc_jit(valid_units, a)
+    return lambda valid_units: _decode_apply_jit(
+        valid_units, a, k_dev, zeros_crc)
+
+
+@lru_cache(maxsize=512)
 def _native_fused_decoder(options: CoderOptions, checksum: ChecksumType,
                           bpc: int, valid: tuple, erased: tuple):
     if checksum is not ChecksumType.CRC32C:
@@ -400,7 +438,9 @@ def make_fused_decoder(spec: FusedSpec, valid: list[int], erased: list[int]):
     supplied, erased the unit indexes to reconstruct. Jitted on
     accelerator backends; native AVX2+CRC twin on CPU-only hosts. The
     link probe uses the decode transfer shape (valid units H2D, erased
-    units D2H), not the encoder's p/k."""
+    units D2H), not the encoder's p/k. Device plans come from the
+    persistent decode-plan cache: one compiled program per SHAPE serves
+    every erasure pattern (see _decode_apply_jit)."""
     if _prefer_host_coder(spec.options,
                           out_ratio=len(erased) / max(len(valid), 1),
                           checksum=spec.checksum):
@@ -409,7 +449,7 @@ def make_fused_decoder(spec: FusedSpec, valid: list[int], erased: list[int]):
             tuple(valid), tuple(erased))
         if fn is not None:
             return fn
-    return _fused_decode_cached(
+    return _fused_decode_plan(
         spec.options, spec.checksum, spec.bytes_per_checksum,
         tuple(valid), tuple(erased),
     )

@@ -1,0 +1,88 @@
+"""Depth-1 device batch pipeline: the decode twin of the writer's
+in-flight encode batch.
+
+`ec_writer._flush_queue` keeps ONE encoded batch in flight so network
+writes of batch N overlap the device encode + device->host pull of batch
+N+1. This module extracts that structure so the READ/repair side — the
+degraded client read (`client/ec_reader`), offline reconstruction
+(`storage/reconstruction`) and the XOR->RS re-encode (`client/re_encode`)
+— drives the same overlap: unit fetch / target writes of one batch run
+under the device decode+CRC and D2H pull of the next.
+
+Works with any fused fn returning a device array or tuple of them (the
+native host twin returns numpy; then submit() degrades to synchronous
+calls with zero overhead, which is correct — there is nothing to
+overlap on the host path).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+#: stripes per decode dispatch, and therefore the pipeline's granularity:
+#: device work + D2H of one batch overlaps host fetch/writes of the next.
+#: 8 matches the writer's stripe_batch default — with the default
+#: 16-stripes-per-group geometry a whole-group repair runs as two
+#: overlapped batches; larger values amortize dispatch cost at the price
+#: of pipeline memory (two batches of [B, k, cell] live at once).
+DEFAULT_DECODE_BATCH = 8
+
+
+def decode_batch_size(default: int = DEFAULT_DECODE_BATCH) -> int:
+    """The decode batch-depth knob (OZONE_TPU_DECODE_BATCH)."""
+    try:
+        n = int(os.environ.get("OZONE_TPU_DECODE_BATCH", default))
+    except ValueError:
+        return default
+    return max(1, n)
+
+
+def _start_d2h(out: Any) -> None:
+    # eager D2H where the backend supports it: the pull runs under the
+    # caller's host work on the previous batch (same trick as
+    # ec_writer._flush_queue)
+    try:
+        out.copy_to_host_async()
+    except (AttributeError, RuntimeError):
+        pass
+
+
+class DeviceBatchPipeline:
+    """One device batch in flight. submit(batch) dispatches fn(batch)
+    asynchronously and returns the PREVIOUS batch's host results (or
+    None on the first call); drain() returns the last in-flight batch.
+    `ctx` rides along untouched so callers can tag batches (stripe
+    indexes, group ids) without threading state."""
+
+    def __init__(self, fn: Callable[[np.ndarray], Any]):
+        self._fn = fn
+        self._pending: Optional[tuple] = None
+
+    def submit(self, batch: np.ndarray, ctx: Any = None) -> Optional[tuple]:
+        outs = self._fn(batch)  # async dispatch on device backends
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        for a in outs:
+            _start_d2h(a)
+        prev, self._pending = self._pending, (ctx, outs)
+        return self._to_host(prev)
+
+    def drain(self) -> Optional[tuple]:
+        prev, self._pending = self._pending, None
+        return self._to_host(prev)
+
+    @staticmethod
+    def _to_host(entry: Optional[tuple]) -> Optional[tuple]:
+        if entry is None:
+            return None
+        ctx, outs = entry
+        return ctx, tuple(np.asarray(a) for a in outs)
+
+
+def batched(seq, n: int):
+    """Yield contiguous slices of `seq` of at most n items."""
+    for i in range(0, len(seq), n):
+        yield seq[i:i + n]

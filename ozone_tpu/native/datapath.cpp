@@ -127,14 +127,20 @@ struct Buf {
   uint8_t* p = nullptr;
   size_t len = 0, cap = 0;
   ~Buf() { free(p); }
-  void resize(size_t n) {
+  // false on allocation failure: the old block stays valid (realloc's
+  // nullptr return must not overwrite p — that leaked the block and
+  // crashed the next memcpy); callers fail the frame/connection instead
+  bool resize(size_t n) {
     if (n > cap) {
       size_t want = cap ? cap : 4096;
       while (want < n) want *= 2;
-      p = (uint8_t*)realloc(p, want);
+      uint8_t* np = (uint8_t*)realloc(p, want);
+      if (!np) return false;
+      p = np;
       cap = want;
     }
     len = n;
+    return true;
   }
   uint8_t* data() { return p; }
   const uint8_t* data() const { return p; }
@@ -217,7 +223,7 @@ bool read_frame(int fd, uint8_t* tag, Buf& body) {
   memcpy(&n, hdr, 4);
   if (n > MAX_FRAME) return false;
   *tag = hdr[4];
-  body.resize(n);
+  if (!body.resize(n)) return false;  // OOM: drop the connection
   if (n && !read_full(fd, body.data(), n)) return false;
   return true;
 }
@@ -388,7 +394,11 @@ bool handle_read(Server* s, int fd, const Buf& hdr,
   Buf buf;
   uint64_t total = 0;
   for (auto& r : reqs) {
-    buf.resize(r.len);
+    if (!buf.resize(r.len)) {  // OOM: fail the stream, keep the process
+      close(file_fd);
+      return send_status(
+          fd, err_json("IO_EXCEPTION", "read buffer allocation failed"));
+    }
     size_t got = 0;
     while (got < r.len) {
       ssize_t rd = pread(file_fd, buf.data() + got, r.len - got,
@@ -455,11 +465,15 @@ void conn_loop(Server* s, int fd) {
       break;
     if (!ok || s->stop.load()) break;
   }
-  close(fd);
+  // erase BEFORE close: dp_stop snapshots s->conns under the lock and
+  // shutdown()s each fd — closing first lets the kernel reuse the fd
+  // number (a fresh connection or block file) inside that window, and
+  // dp_stop would shut down the wrong descriptor
   {
     std::lock_guard<std::mutex> g(s->conn_mu);
     s->conns.erase(fd);
   }
+  close(fd);
   s->active--;
 }
 

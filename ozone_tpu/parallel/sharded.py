@@ -44,10 +44,23 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # jax >= 0.6 top-level export
+    from jax import shard_map
+except ImportError:  # older jax: experimental namespace, same semantics
+    from jax.experimental.shard_map import shard_map
+
+import inspect
+
+#: the replication-checker toggle was renamed check_rep -> check_vma
+#: across jax versions; resolve the name this jax actually accepts
+_CHECK_KW = ("check_vma"
+             if "check_vma" in inspect.signature(shard_map).parameters
+             else "check_rep")
+
 from ozone_tpu.codec import crc_device, rs_math
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.bitlin import expand_coding_matrix
-from ozone_tpu.codec.fused import FusedSpec, _POLY
+from ozone_tpu.codec.fused import FusedSpec, _POLY, crc_plan_cached
 from ozone_tpu.codec.jax_coder import (
     _gf_dot,
     bits_to_bytes,
@@ -147,56 +160,69 @@ def make_sharded_fused_encoder(spec: FusedSpec, mesh: Mesh, axis: str = "dn"):
     )
 
 
-@lru_cache(maxsize=64)
-def _sharded_decoder_cached(
-    options: CoderOptions,
-    checksum: ChecksumType,
-    bpc: int,
-    valid: tuple,
-    erased: tuple,
-    mesh: Mesh,
-    axis: str,
-):
-    dm = rs_math.decode_matrix(
-        options.data_units, options.parity_units, list(erased), list(valid)
-    )
-    a = jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
-    if checksum in _POLY:
-        k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
-        k_dev = jnp.asarray(k_np)
-    else:
-        k_dev, zeros_crc = None, 0
+@lru_cache(maxsize=16)
+def _sharded_decode_apply_cached(mesh: Mesh, axis: str, with_crc: bool,
+                                 zeros_crc: int):
+    """One sharded decode+CRC executable per (mesh, shape): the recovery
+    matrix and CRC constants arrive as traced, mesh-replicated arguments
+    (the fused._decode_apply_jit treatment with explicit shardings), so
+    erasure-pattern churn during multi-unit failures never recompiles
+    the SPMD program — only the tiny replicated matrix changes."""
     batch_sharding = NamedSharding(mesh, P(axis))
+    replicated = NamedSharding(mesh, P())
 
-    def fn(valid_units):
+    if not with_crc:
+        def fn_nocrc(valid_units, a):
+            rec = gf_apply(valid_units, a)
+            return rec, jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
+
+        return jax.jit(
+            fn_nocrc,
+            in_shardings=(batch_sharding, replicated),
+            out_shardings=(batch_sharding, batch_sharding),
+        )
+
+    def fn(valid_units, a, k_dev):
         rec = gf_apply(valid_units, a)
-        if k_dev is None:
-            crcs = jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
-        else:
-            crcs = crc_device.crc_slices(rec, k_dev, zeros_crc)
+        crcs = crc_device.crc_slices(rec, k_dev, zeros_crc)
         return rec, crcs
 
     return jax.jit(
         fn,
-        in_shardings=batch_sharding,
+        in_shardings=(batch_sharding, replicated, replicated),
         out_shardings=(batch_sharding, batch_sharding),
     )
+
+
+@lru_cache(maxsize=512)
+def _sharded_decode_plan_cached(
+    options: CoderOptions, valid: tuple, erased: tuple,
+):
+    """Per-pattern decode matrix for the sharded path; cheap host work,
+    shared executable above, CRC constants shared via
+    fused.crc_plan_cached."""
+    dm = rs_math.decode_matrix(
+        options.data_units, options.parity_units, list(erased), list(valid)
+    )
+    return jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
 
 
 def make_sharded_decoder(
     spec: FusedSpec, valid: list[int], erased: list[int], mesh: Mesh,
     axis: str = "dn",
 ):
-    """Stripe-parallel fused decode+CRC (multi-chip reconstruction path)."""
-    return _sharded_decoder_cached(
-        spec.options,
-        spec.checksum,
-        spec.bytes_per_checksum,
-        tuple(valid),
-        tuple(erased),
-        mesh,
-        axis,
-    )
+    """Stripe-parallel fused decode+CRC (multi-chip reconstruction path).
+    Pattern-count-proof like the single-chip path: one compiled SPMD
+    program per shape serves every (valid, erased) pattern."""
+    a = _sharded_decode_plan_cached(
+        spec.options, tuple(valid), tuple(erased))
+    k_dev, zeros_crc = crc_plan_cached(spec.checksum,
+                                       spec.bytes_per_checksum)
+    apply_fn = _sharded_decode_apply_cached(
+        mesh, axis, k_dev is not None, zeros_crc)
+    if k_dev is None:
+        return lambda valid_units: apply_fn(valid_units, a)
+    return lambda valid_units: apply_fn(valid_units, a, k_dev)
 
 
 # --------------------------------------------------------------------- TP
@@ -208,8 +234,6 @@ def _tp_encoder_cached(options: CoderOptions, mesh: Mesh, axis: str):
         raise ValueError(f"TP encode requires k % mesh == 0, got {k} % {n}")
     a_np = expand_coding_matrix(rs_math.parity_matrix(k, p))  # [k*8, p*8]
     a = jnp.asarray(a_np, dtype=jnp.int8)
-
-    from jax import shard_map
 
     @partial(
         shard_map,
@@ -240,19 +264,15 @@ def make_tp_encoder(options: CoderOptions, mesh: Mesh, axis: str = "dn"):
 
 
 # ------------------------------------------------------------------- ring
-@lru_cache(maxsize=64)
-def _ring_decoder_cached(
-    options: CoderOptions,
-    checksum: ChecksumType,
-    bpc: int,
-    valid: tuple,
-    erased: tuple,
-    mesh: Mesh,
-    axis: str,
+@lru_cache(maxsize=512)
+def _ring_decode_plan_cached(
+    options: CoderOptions, valid: tuple, erased: tuple, n: int,
 ):
+    """Per-pattern ring plan: the decode matrix zero-padded to the
+    mesh's survivor slots. Cheap host work; the compiled SPMD program
+    lives in _ring_apply_cached and serves every pattern of a shape."""
     k = len(valid)
     e = len(erased)
-    n = mesh.devices.size
     upc = -(-k // n)  # units per chip, survivors zero-padded to upc * n
     dm = rs_math.decode_matrix(
         options.data_units, options.parity_units, list(erased), list(valid)
@@ -264,15 +284,17 @@ def _ring_decoder_cached(
         a_np = np.concatenate(
             [a_np, np.zeros(((upc * n - k) * 8, e * 8), dtype=a_np.dtype)]
         )
-    a = jnp.asarray(a_np, dtype=jnp.int8)
-    if checksum in _POLY:
-        k_np, zeros_crc = crc_device.crc_constants_planemajor(bpc, _POLY[checksum])
-        k_dev = jnp.asarray(k_np)
-    else:
-        k_dev, zeros_crc = None, 0
+    return jnp.asarray(a_np, dtype=jnp.int8), upc
 
-    from jax import shard_map
 
+@lru_cache(maxsize=16)
+def _ring_apply_cached(mesh: Mesh, axis: str, with_crc: bool,
+                       zeros_crc: int):
+    """One ring-decode executable per (mesh, shape): like the DP path,
+    the padded recovery matrix arrives as a traced argument (sharded
+    over survivors), so erasure-pattern churn never recompiles the
+    SPMD ring program."""
+    n = mesh.devices.size
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     @partial(
@@ -280,15 +302,17 @@ def _ring_decoder_cached(
         mesh=mesh,
         in_specs=(P(None, axis, None), P(axis, None)),
         out_specs=P(None, None, None),
-        # check_vma=False: the output IS replicated, but only by a
-        # dynamic argument — after n-1 ppermute hops every chip has
-        # XOR-accumulated all n partials (each hop k adds the partial
-        # that originated k chips upstream), so all chips hold the same
-        # XOR-of-all-partials. The static replication checker cannot
-        # prove properties that depend on the permutation completing a
-        # cycle; the dryrun asserts cross-device equality of this
-        # output at runtime (__graft_entry__.dryrun_multichip).
-        check_vma=False,
+        # replication checker off (check_vma / legacy check_rep — the
+        # name this jax accepts, resolved at import): the output IS
+        # replicated, but only by a dynamic argument — after n-1
+        # ppermute hops every chip has XOR-accumulated all n partials
+        # (each hop k adds the partial that originated k chips
+        # upstream), so all chips hold the same XOR-of-all-partials.
+        # The static replication checker cannot prove properties that
+        # depend on the permutation completing a cycle; the dryrun
+        # asserts cross-device equality of this output at runtime
+        # (__graft_entry__.dryrun_multichip).
+        **{_CHECK_KW: False},
     )
     def ring_decode(units_local, a_local):
         # units_local [B, upc, C] uint8; a_local [upc*8, e*8] int8
@@ -306,19 +330,43 @@ def _ring_decoder_cached(
 
     batch_sharding = NamedSharding(mesh, P(axis))
 
-    def inner(valid_units):
+    if not with_crc:
+        def inner_nocrc(valid_units, a):
+            rec = ring_decode(valid_units, a)
+            return rec, jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
+
+        return jax.jit(inner_nocrc)
+
+    def inner(valid_units, a, k_dev):
         rec = ring_decode(valid_units, a)
-        if k_dev is None:
-            crcs = jnp.zeros(rec.shape[:2] + (0,), jnp.uint32)
-        else:
-            # the ring output is replicated; shard the CRC pass over the
-            # stripe batch so the checksum work spreads over the mesh
-            # instead of running n-fold redundantly
-            rec_sh = jax.lax.with_sharding_constraint(rec, batch_sharding)
-            crcs = crc_device.crc_slices(rec_sh, k_dev, zeros_crc)
+        # the ring output is replicated; shard the CRC pass over the
+        # stripe batch so the checksum work spreads over the mesh
+        # instead of running n-fold redundantly
+        rec_sh = jax.lax.with_sharding_constraint(rec, batch_sharding)
+        crcs = crc_device.crc_slices(rec_sh, k_dev, zeros_crc)
         return rec, crcs
 
-    jitted = jax.jit(inner)
+    return jax.jit(inner)
+
+
+def make_ring_decoder(
+    spec: FusedSpec, valid: list[int], erased: list[int], mesh: Mesh,
+    axis: str = "dn",
+):
+    """Survivor-sharded ring reconstruction: fn(valid_units [B, k, C]) ->
+    (recovered [B, e, C], crcs). The k survivor units are sharded over the
+    mesh (zero-padded to a multiple of its size); packed-byte partial
+    parities XOR-combine around a ppermute ring. The multi-datanode
+    reconstruction layout of BASELINE config #5: each chip ingests one
+    survivor datanode's bytes, no chip ever holds the whole stripe.
+    Pattern-count-proof like the DP path: the padded decode matrix is a
+    per-pattern plan fed to ONE compiled ring program per shape."""
+    n = mesh.devices.size
+    a, upc = _ring_decode_plan_cached(
+        spec.options, tuple(valid), tuple(erased), n)
+    k_dev, zeros_crc = crc_plan_cached(spec.checksum,
+                                       spec.bytes_per_checksum)
+    apply_fn = _ring_apply_cached(mesh, axis, k_dev is not None, zeros_crc)
 
     def fn(valid_units):
         b, kk, c = valid_units.shape
@@ -333,27 +381,7 @@ def _ring_decoder_cached(
             pad = jnp.zeros((b, upc * n - kk, c), dtype=valid_units.dtype)
             valid_units = jnp.concatenate(
                 [jnp.asarray(valid_units), pad], axis=1)
-        return jitted(valid_units)
+        return (apply_fn(valid_units, a) if k_dev is None
+                else apply_fn(valid_units, a, k_dev))
 
     return fn
-
-
-def make_ring_decoder(
-    spec: FusedSpec, valid: list[int], erased: list[int], mesh: Mesh,
-    axis: str = "dn",
-):
-    """Survivor-sharded ring reconstruction: fn(valid_units [B, k, C]) ->
-    (recovered [B, e, C], crcs). The k survivor units are sharded over the
-    mesh (zero-padded to a multiple of its size); packed-byte partial
-    parities XOR-combine around a ppermute ring. The multi-datanode
-    reconstruction layout of BASELINE config #5: each chip ingests one
-    survivor datanode's bytes, no chip ever holds the whole stripe."""
-    return _ring_decoder_cached(
-        spec.options,
-        spec.checksum,
-        spec.bytes_per_checksum,
-        tuple(valid),
-        tuple(erased),
-        mesh,
-        axis,
-    )

@@ -10,8 +10,10 @@ executing datanode
   1. lists blocks on the source nodes,
   2. creates RECOVERING containers on the targets,
   3. per block: recovers the missing units' cells from any k survivors
-     (ECBlockReconstructedStripeInputStream.recoverChunks analog — here one
-     batched device decode per block) and streams them to the targets,
+     (ECBlockReconstructedStripeInputStream.recoverChunks analog — here a
+     depth-1 pipeline of batched device decodes: batch N's recovered
+     chunks stream to the targets while batch N+1 reads survivors and
+     decodes on device),
   4. putBlock + closeContainer on the targets,
   5. on any failure deletes the RECOVERING containers (:193-220).
 
@@ -26,7 +28,8 @@ from dataclasses import dataclass
 
 from ozone_tpu.client.dn_client import (
     DatanodeClientFactory,
-    write_unit_batched,
+    build_chunk_pairs,
+    write_unit_stream,
 )
 from ozone_tpu.client.ec_reader import ECBlockGroupReader, unit_true_lengths
 from ozone_tpu.client.ec_writer import BlockGroup
@@ -39,7 +42,7 @@ from ozone_tpu.storage.ids import (
     ContainerState,
     StorageError,
 )
-from ozone_tpu.utils.checksum import Checksum, ChecksumData, ChecksumType
+from ozone_tpu.utils.checksum import Checksum, ChecksumType
 from ozone_tpu.utils.metrics import MetricsRegistry
 
 log = logging.getLogger(__name__)
@@ -189,46 +192,41 @@ class ECReconstructionCoordinator:
             use_ring=self.use_ring,
         )
         target_units = [idx - 1 for idx in targets]  # 0-based unit indexes
-        cells, crcs = reader.recover_cells_with_crcs(target_units)
         lengths = unit_true_lengths(group, opts)
         host_checksum = Checksum(self.checksum, bpc)
 
+        # Streaming repair through the reader's depth-1 decode pipeline:
+        # batch N's recovered chunks land on the targets while batch N+1
+        # reads survivors and decodes on device (one device dispatch per
+        # stripe batch). Chunk records are keyed by stripe so a
+        # mid-stream recovery restart simply overwrites — the single
+        # put_block commit per target below runs only after every batch
+        # landed (same all-chunks-before-commit order as before).
+        written: list[dict[int, ChunkInfo]] = [{} for _ in targets]
+        for sb, (cells, crcs) in reader.recover_cells_iter(target_units):
+            for ti, idx in enumerate(targets):
+                u = idx - 1
+                pairs = build_chunk_pairs(
+                    group.block_id, sb, cells[:, ti], crcs[:, ti],
+                    lengths[u], cell, bpc, self.checksum, host_checksum)
+                for info, _ in pairs:
+                    written[ti][info.offset // cell] = info
+                if pairs:
+                    # one batched stream per rebuilt unit per batch when
+                    # the target serves it, per-chunk verbs against
+                    # older/pre-finalize targets
+                    write_unit_stream(
+                        self.clients.get(cmd.targets[idx]),
+                        group.block_id, pairs)
+
         for ti, idx in enumerate(targets):
-            u = idx - 1
             dn = self.clients.get(cmd.targets[idx])
-            unit_len = lengths[u]
-            pairs: list[tuple[ChunkInfo, object]] = []
-            for s in range(reader.num_stripes):
-                chunk_len = max(0, min(cell, unit_len - s * cell))
-                if chunk_len == 0:
-                    continue
-                data = cells[s, ti, :chunk_len]
-                if chunk_len == cell and cell % bpc == 0 and crcs.size:
-                    cs = ChecksumData(
-                        self.checksum,
-                        bpc,
-                        tuple(
-                            int(v).to_bytes(4, "big")
-                            for v in crcs[s, ti].tolist()
-                        ),
-                    )
-                else:
-                    cs = host_checksum.compute(data)
-                info = ChunkInfo(
-                    name=f"{group.block_id}_chunk_{s}",
-                    offset=s * cell,
-                    length=chunk_len,
-                    checksum=cs,
-                )
-                pairs.append((info, data))
-            commit = BlockData(
-                group.block_id, [i for i, _ in pairs],
+            infos = [written[ti][s] for s in sorted(written[ti])]
+            dn.put_block(BlockData(
+                group.block_id, infos,
                 block_group_length=group.length,
-            )
-            # one batched stream per rebuilt unit when the target serves
-            # it, per-chunk verbs against older/pre-finalize targets
-            write_unit_batched(dn, group.block_id, pairs, commit)
+            ))
             self.metrics.counter("blocks_reconstructed").inc()
             self.metrics.counter("bytes_reconstructed").inc(
-                sum(i.length for i, _ in pairs)
+                sum(i.length for i in infos)
             )

@@ -88,6 +88,42 @@ def test_fused_xor_to_rs_reencode_with_lost_unit(cluster):
     assert np.array_equal(b.read_key("k"), data)
 
 
+def test_xor_to_rs_reencode_with_lost_parity(cluster):
+    """Conversion with the XOR PARITY replica gone but every data unit
+    alive: the group must convert via the plain fused encode — the
+    reencoder's decode matrix would fold slot 0 into XOR-of-all-data
+    (= the parity) and silently write THAT as data unit 0, with the RS
+    parity computed over the same wrong column."""
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b", replication="xor-3-1-4096")
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, 70_000, dtype=np.uint8)
+    b.write_key("k", data)
+    info = oz.om.lookup_key("v", "b", "k")
+
+    for g in info["block_groups"]:
+        victim = g["nodes"][3]  # the XOR parity unit of xor-3-1
+        dn = next(d for d in cluster.datanodes if d.id == victim)
+        dn.delete_container(int(g["container_id"]), force=True)
+
+    new_info = re_encode_key_to_ec(
+        cluster.om, cluster.clients, "v", "b", "k", ec="rs-3-2-4096"
+    )
+    assert new_info["replication"] == "rs-3-2-4096"
+    assert np.array_equal(b.read_key("k"), data)
+    # the fresh RS parity must be real: lose two units and re-read
+    from ozone_tpu.storage.ids import StorageError
+
+    g2 = new_info["block_groups"][0]
+    for node in g2["nodes"][:2]:
+        d2 = next(d for d in cluster.datanodes if d.id == node)
+        try:
+            d2.delete_container(int(g2["container_id"]), force=True)
+        except StorageError:
+            pass
+    assert np.array_equal(b.read_key("k"), data)
+
+
 def test_freon_omkg_and_dcv(cluster):
     oz = cluster.client()
     rep = freon.omkg(oz, n_keys=20, threads=4)
