@@ -47,7 +47,8 @@ _DEADLINE = time.time() + BUDGET_S
 #: normal exit path both read it
 _STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None,
                 "sharded": None, "decode": None, "decode_spread": None,
-                "decode_sustained": None, "decode_churn": None}
+                "decode_sustained": None, "decode_churn": None,
+                "degraded_straggler": None}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
@@ -87,6 +88,9 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
                 _STATE["decode_sustained"], 3)
         if _STATE["decode_churn"] is not None:
             line["decode_churn_gib_s"] = round(_STATE["decode_churn"], 3)
+        if _STATE["degraded_straggler"] is not None:
+            line["degraded_straggler_gib_s"] = round(
+                _STATE["degraded_straggler"], 3)
         if timed_out:
             line["timed_out"] = True
         if error:
@@ -447,6 +451,113 @@ def _run_sustained(fn, data, gib: float, seconds: float, iters: int,
     return out
 
 
+def bench_degraded_straggler(size_mib: int = 48,
+                             straggle_s: float = 2.0) -> dict:
+    """End-to-end straggler-tolerance probe (the resilience layer's
+    acceptance metric): a degraded RS(6,3) read over in-process
+    datanodes with ONE surviving peer delayed `straggle_s` per read —
+    orders of magnitude past any P95 the health registry has learned.
+    The hedged recovery path must drop the straggler for the spare
+    parity unit and decode through the batched pipeline, so the
+    degraded read's throughput stays near the healthy degraded rate
+    instead of collapsing to one straggle window per stripe batch.
+    Reports GiB/s of user data for the straggler read (client-side
+    wall clock: local chunk IO + device decode + hedge overhead)."""
+    import shutil
+    import tempfile
+    import time as _time
+    from pathlib import Path
+
+    from ozone_tpu.client import resilience
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.client.ec_reader import ECBlockGroupReader
+    from ozone_tpu.client.ec_writer import BlockGroup, ECKeyWriter
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+    from ozone_tpu.storage.datanode import Datanode
+
+    cell = 1024 * 1024
+    opts = CoderOptions(6, 3, "rs", cell_size=cell)
+    tmp = Path(tempfile.mkdtemp(prefix="ozone-bench-straggler-"))
+
+    class _Slow:
+        def __init__(self, inner, delay_s):
+            self._inner, self.delay_s = inner, delay_s
+            self.dn_id = inner.dn_id
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def read_chunk(self, *a, **kw):
+            _time.sleep(self.delay_s)
+            return self._inner.read_chunk(*a, **kw)
+
+        def read_chunks(self, *a, **kw):
+            _time.sleep(self.delay_s)
+            return self._inner.read_chunks(*a, **kw)
+
+    dns = [Datanode(tmp / f"dn{i}", dn_id=f"dn{i}") for i in range(10)]
+    try:
+        clients = DatanodeClientFactory()
+        for dn in dns:
+            clients.register_local(dn)
+        group_holder: list[BlockGroup] = []
+
+        def allocate(excluded):
+            nodes = [d.id for d in dns if d.id not in excluded][:9]
+            g = BlockGroup(
+                container_id=1, local_id=1,
+                pipeline=Pipeline(ReplicationConfig.from_ec(opts), nodes))
+            group_holder.append(g)
+            return g
+
+        rng = np.random.default_rng(11)
+        data = rng.integers(0, 256, size_mib * 1024 * 1024,
+                            dtype=np.uint8)
+        w = ECKeyWriter(opts, allocate, clients,
+                        block_size=max(16, size_mib) * 1024 * 1024)
+        w.write(data)
+        w.close()
+        g = group_holder[0]
+
+        def degraded_read() -> tuple[float, np.ndarray]:
+            t0 = _time.time()
+            got = ECBlockGroupReader(g, opts, clients).read_all()
+            return _time.time() - t0, got
+
+        # degrade unit 0, then a healthy-path yardstick (also compiles
+        # the decode program so the straggler run measures the hedge)
+        dns[0].delete_container(g.container_id, force=True)
+        healthy_s, got = degraded_read()
+        assert np.array_equal(got, data), "degraded read corrupt"
+        # straggle survivor unit 1: every read verb stalls straggle_s
+        victim = g.pipeline.nodes[1]
+        clients._local[victim] = _Slow(clients.get(victim), straggle_s)
+        fired0 = resilience.METRICS.counter("hedges_fired").value
+        strag_s, got = degraded_read()
+        assert np.array_equal(got, data), "hedged read corrupt"
+        fired = resilience.METRICS.counter("hedges_fired").value - fired0
+        gib = size_mib / 1024
+        out = {
+            "healthy_gib_s": gib / healthy_s,
+            "straggler_gib_s": gib / strag_s,
+            "hedges_fired": fired,
+            "slowdown_x": strag_s / healthy_s,
+        }
+        log(f"  degraded read healthy {gib / healthy_s:.2f} GiB/s "
+            f"({healthy_s * 1e3:.0f} ms); with {straggle_s:.1f}s "
+            f"straggler {gib / strag_s:.2f} GiB/s ({strag_s * 1e3:.0f} ms, "
+            f"{fired} hedge(s) fired, {out['slowdown_x']:.2f}x)")
+        return out
+    finally:
+        for dn in dns:
+            try:
+                dn.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_cpu_reference(cell: int = 1024 * 1024) -> float:
     """Config #1: in-process numpy RawErasureEncoder.encode() RS(3,2)."""
     from ozone_tpu.codec import create_encoder
@@ -575,6 +686,16 @@ def main() -> None:
                 f"GiB/s/chip (overall {sustained['overall']:.2f})")
         except Exception as e:
             log(f"sustained bench failed: {e}")
+    if budget_for("degraded-straggler bench", 60):
+        try:
+            ds = bench_degraded_straggler()
+            _STATE["degraded_straggler"] = ds["straggler_gib_s"]
+            log(f"degraded+straggler EC read: "
+                f"{ds['straggler_gib_s']:.2f} GiB/s "
+                f"({ds['hedges_fired']} hedge(s), "
+                f"{ds['slowdown_x']:.2f}x vs healthy degraded)")
+        except Exception as e:
+            log(f"degraded-straggler bench failed: {e}")
     if budget_for("re-encode bench", 60):
         try:
             re = bench_xor_reencode()

@@ -307,6 +307,13 @@ class DatanodeClientFactory:
         #: readers register OM-granted tokens here, datanode daemons
         #: install a self-issuer for reconstruction traffic
         self.tokens = TokenStore()
+        #: per-datanode health (EWMA latency + circuit breaker), shared
+        #: by every reader/writer built over this factory so one
+        #: client's observed straggler steers every other client's
+        #: survivor choice and reallocation (client/resilience.py)
+        from ozone_tpu.client.resilience import HealthRegistry
+
+        self.health = HealthRegistry()
         #: TlsMaterial presented by every remote client (mTLS clusters);
         #: None = plaintext channels
         self.tls = None

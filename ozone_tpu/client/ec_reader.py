@@ -10,6 +10,14 @@ reconstruction (recoverChunks:103-113).
 
 TPU-first: degraded reads batch every needed stripe of the group into one
 device decode dispatch instead of decoding stripe-by-stripe.
+
+Straggler tolerance (client/resilience.py): survivor choice skips
+breaker-open peers, every read feeds the per-peer latency EWMA, and a
+cell fetch that exceeds the peer's P95 (or OZONE_TPU_HEDGE_MS) is
+hedged — the normal path races the fetch against a decode-from-parity
+of the same cell, the recovery path drops the straggling survivor and
+replans the batched decode around a spare — first result wins, the
+loser's bytes are discarded.
 """
 
 from __future__ import annotations
@@ -19,6 +27,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import BlockGroup, block_lengths
 from ozone_tpu.codec.api import CoderOptions
@@ -45,6 +54,18 @@ class _UnitReadError(Exception):
         super().__init__(f"unit {unit}: {cause}")
         self.unit = unit
         self.cause = cause
+
+
+class _StragglerHedge(Exception):
+    """Internal: survivor unit(s) exceeded their hedge delay while a
+    spare peer could take their place — the retry loop excludes them
+    and replans the batched decode (decode-from-parity fall-through).
+    Not an error: the straggler's in-flight reads are abandoned, their
+    eventual results discarded."""
+
+    def __init__(self, units: list[int]):
+        super().__init__(f"straggling units {units}: hedging to spares")
+        self.units = units
 
 
 class ECBlockGroupReader:
@@ -94,6 +115,14 @@ class ECBlockGroupReader:
         # units that failed a read/verify; excluded like missing replicas
         # (reference ECBlockInputStream setFailed + proxy failover)
         self._failed: set[int] = set()
+        #: shared per-peer health (EWMA latency, circuit breaker) —
+        #: factory-wide when the factory carries one, process-default
+        #: otherwise, so every reader sees every client's observations
+        self._health = getattr(clients, "health", None) \
+            or resilience.default_registry()
+        #: operation deadline captured at the public entry points and
+        #: re-activated on reader-pool worker threads
+        self._deadline: Optional[resilience.Deadline] = None
 
     # ---------------------------------------------------------------- helpers
     @property
@@ -105,10 +134,16 @@ class ECBlockGroupReader:
         if u not in self._block_meta:
             dn_id = self.group.pipeline.nodes[u]
             try:
-                self._block_meta[u] = self.clients.get(dn_id).get_block(
-                    self.group.block_id
-                )
+                self._block_meta[u] = self._health.observe(
+                    dn_id, self.clients.get(dn_id).get_block,
+                    self.group.block_id)
             except (StorageError, KeyError, OSError) as e:
+                if isinstance(e, StorageError) \
+                        and e.code == resilience.DEADLINE_EXCEEDED:
+                    # the OPERATION's budget expired, the peer may be
+                    # fine: fail fast instead of reading as "every unit
+                    # unreachable" (a false InsufficientLocations)
+                    raise
                 log.debug("unit %d unavailable: %s", u, e)
                 self._block_meta[u] = None
         return self._block_meta[u]
@@ -125,6 +160,22 @@ class ECBlockGroupReader:
         cached = self._cell_cache.pop((u, stripe), None)
         if cached is not None:
             return cached
+        return self._fetch_cell(u, stripe)
+
+    def _peek_cell(self, u: int, stripe: int) -> np.ndarray:
+        """_read_cell that PEEKS the prefetch cache instead of popping:
+        the decode-from-parity hedge branch must not consume entries
+        the main loop still owns. A fresh fetch is ADDED to the cache
+        (win or lose — cells are immutable), so consecutive hedged
+        cells of a window never re-fetch the same survivor cells."""
+        cached = self._cell_cache.get((u, stripe))
+        if cached is not None:
+            return cached
+        out = self._fetch_cell(u, stripe)
+        self._cell_cache.setdefault((u, stripe), out)
+        return out
+
+    def _fetch_cell(self, u: int, stripe: int) -> np.ndarray:
         bd = self._unit_block(u)
         out = np.zeros(self.cell, dtype=np.uint8)
         if bd is None:
@@ -134,9 +185,9 @@ class ECBlockGroupReader:
         if info is None:
             return out  # cell has no data (short final stripe)
         dn_id = self.group.pipeline.nodes[u]
-        data = self.clients.get(dn_id).read_chunk(
-            self.group.block_id, info, verify=self.verify
-        )
+        data = self._health.observe(
+            dn_id, self.clients.get(dn_id).read_chunk,
+            self.group.block_id, info, verify=self.verify)
         out[: data.size] = data
         return out
 
@@ -161,14 +212,19 @@ class ECBlockGroupReader:
         ]
         if len(wanted) < 2:
             return  # nothing saved over the per-chunk path
+        dn_id = self.group.pipeline.nodes[u]
         try:
-            client = self.clients.get(self.group.pipeline.nodes[u])
+            client = self.clients.get(dn_id)
             fn = getattr(client, "read_chunks", None)
             if fn is None:
                 return
-            datas = fn(self.group.block_id, [i for _, i in wanted],
-                       verify=self.verify)
+            datas = self._health.observe(
+                dn_id, fn, self.group.block_id,
+                [i for _, i in wanted], verify=self.verify)
         except (StorageError, KeyError, OSError) as e:
+            if isinstance(e, StorageError) \
+                    and e.code == resilience.DEADLINE_EXCEEDED:
+                raise
             log.debug("batched read of unit %d failed (%s); per-chunk "
                       "path will retry", u, e)
             return
@@ -209,7 +265,12 @@ class ECBlockGroupReader:
                    < min(offset + length, s * row + (u + 1) * self.cell)
                    for u in missing_data)
         ]
-        rec = (self.recover_cells(missing_data, need_rec)
+        # exclude_stragglers=False: a straggling survivor propagates to
+        # read()'s retry loop, which folds it into missing_data so the
+        # NEXT attempt reconstructs every missing unit in one batched
+        # decode instead of recovering twice
+        rec = (self.recover_cells(missing_data, need_rec,
+                                  exclude_stragglers=False)
                if need_rec else None)
         rec_pos = {s: i for i, s in enumerate(need_rec)}
         window = 8  # stripes prefetched per unit per RPC (bounds memory)
@@ -221,7 +282,7 @@ class ECBlockGroupReader:
                 needed: dict[int, list[int]] = {}
                 for s in stripes:
                     for i in range(self.k):
-                        if i in missing_data:
+                        if i in missing_data or i in self._failed:
                             continue
                         cell_start = s * row + i * self.cell
                         if (max(offset, cell_start)
@@ -229,9 +290,7 @@ class ECBlockGroupReader:
                                       cell_start + self.cell)):
                             needed.setdefault(i, []).append(s)
                 if needed:
-                    list(self._ensure_pool().map(
-                        lambda kv: self._prefetch_unit(kv[0], kv[1]),
-                        needed.items()))
+                    self._prefetch_bounded(needed)
             for s in stripes:
                 for i in range(self.k):
                     cell_start = s * row + i * self.cell
@@ -242,7 +301,7 @@ class ECBlockGroupReader:
                     if i in missing_data:
                         cell = rec[rec_pos[s], missing_data.index(i)]
                     else:
-                        cell = self._read_cell_checked(i, s)
+                        cell = self._read_cell_hedged(i, s)
                     out[a - offset : b - offset] = \
                         cell[a - cell_start : b - cell_start]
 
@@ -250,7 +309,34 @@ class ECBlockGroupReader:
         try:
             return self._read_cell(u, stripe)
         except (StorageError, KeyError, OSError) as e:
+            if isinstance(e, StorageError) \
+                    and e.code == resilience.DEADLINE_EXCEEDED:
+                raise  # spent budget is the op's verdict, not the unit's
             raise _UnitReadError(u, e)
+
+    def _prefetch_bounded(self, needed: dict[int, list[int]]) -> None:
+        """Concurrent per-unit batched prefetch, bounded by the hedge
+        delay: a straggling peer's prefetch is ABANDONED (it finishes
+        on the orphaned pool; whatever it delivers still lands in the
+        cell cache) instead of stalling the window behind it — the
+        cells it failed to deliver take the hedged per-cell path."""
+        pool = self._ensure_pool()
+        futs = [self._submit_act(pool, self._prefetch_unit, u, ss)
+                for u, ss in needed.items()]
+        nodes = self.group.pipeline.nodes
+        # the batched RPC moves up to `window` cells: scale the one-RPC
+        # hedge delay by the deepest request so healthy bulk prefetches
+        # are never cut short
+        depth = max(len(ss) for ss in needed.values())
+        delay = max(1, depth) * max(
+            self._health.hedge_delay_s(nodes[u]) for u in needed)
+        from concurrent.futures import wait as fwait
+
+        _done, pending = fwait(set(futs),
+                               timeout=resilience.op_timeout(
+                                   delay, "prefetch"))
+        if pending:
+            self._abandon_pool()
 
     def _ensure_pool(self):
         if self._read_pool is None:
@@ -260,6 +346,138 @@ class ECBlockGroupReader:
                 max_workers=self.k, thread_name_prefix="ec-read")
         return self._read_pool
 
+    def _abandon_pool(self) -> None:
+        """Walk away from a pool with straggling reads still on it: the
+        losers finish on the orphaned pool and their results are
+        discarded; the next attempt gets fresh workers instead of
+        queueing behind the stragglers. (Same teardown as _close_pool —
+        the distinct name marks intent at the call sites.)"""
+        self._close_pool()
+
+    def _submit_act(self, pool, fn, *args):
+        """Submit with the operation deadline re-activated on the worker
+        (contextvars don't cross executor threads)."""
+        d = self._deadline
+
+        def run():
+            with resilience.activate(d):
+                return fn(*args)
+
+        return pool.submit(run)
+
+    # ---------------------------------------------------------------- hedging
+    def _read_cell_hedged(self, u: int, stripe: int) -> np.ndarray:
+        """Data-cell read racing the owning peer against decode-from-
+        parity: the primary fetch runs immediately; once it exceeds the
+        peer's hedge delay (P95 latency EWMA, floored by
+        OZONE_TPU_HEDGE_MS) and enough other units are alive to decode
+        without it, a single-stripe decode of the same cell fires —
+        first result wins, the loser's bytes are discarded (the
+        tail-at-scale hedged request, generalized to EC where the
+        'other replica' is the code itself)."""
+        if u in self._failed:
+            # excluded earlier in this read (straggler/failure during
+            # recovery): fail fast so the outer retry reconstructs it
+            # instead of re-paying the straggler's latency per cell
+            raise _UnitReadError(u, StorageError(
+                "UNAVAILABLE", f"unit {u} excluded earlier in this read"))
+        if (u, stripe) in self._cell_cache:
+            return self._read_cell(u, stripe)
+        if len(self.available_units()) <= self.k:
+            # no spare capacity to decode around u: wait the peer out
+            return self._read_cell_checked(u, stripe)
+        node = self.group.pipeline.nodes[u]
+        try:
+            win = resilience.HedgeGroup().run(
+                lambda: self._read_cell_checked(u, stripe),
+                [lambda: self._decode_cell_from_parity(u, stripe)],
+                delay_s=self._health.hedge_delay_s(node),
+                deadline=self._deadline)
+        except _UnitReadError:
+            raise
+        except (StorageError, KeyError, OSError,
+                InsufficientLocationsError) as e:
+            if isinstance(e, StorageError) \
+                    and e.code == resilience.DEADLINE_EXCEEDED:
+                raise  # fail-fast budget expiry, not a unit failure
+            # both branches failed: surface as the unit's failure so the
+            # outer retry loop excludes it like any other read error
+            raise _UnitReadError(u, e)
+        if win.index > 0:
+            # the decode beat the peer: treat it as a straggler like the
+            # recovery path does — exclude the unit so the NEXT cell
+            # replans the whole read into one batched reconstruction
+            # instead of re-paying a hedge window (or, once the loser's
+            # slow success trains the EWMA, the peer's full latency)
+            # per remaining cell
+            self._failed.add(u)
+        return win.value
+
+    def _decode_cell_from_parity(self, u: int, stripe: int) -> np.ndarray:
+        """The hedge branch: reconstruct unit u's cell of `stripe` from
+        k healthy other units through the batched decode pipeline's
+        plan cache (one compiled program per erasure pattern). Peeks
+        the prefetch cache and mutates no reader state, so a losing
+        decode leaves no trace."""
+        others = [x for x in self.available_units() if x != u]
+        nodes = self.group.pipeline.nodes
+        order = {dn: i for i, dn in enumerate(
+            self._health.preferred([nodes[x] for x in others]))}
+        valid = sorted(sorted(
+            others, key=lambda x: order.get(nodes[x], len(order)))[: self.k])
+        if len(valid) < self.k:
+            raise InsufficientLocationsError(
+                f"hedge decode needs {self.k} units, reachable: {valid}")
+        fn = make_fused_decoder(self.spec, valid, [u])
+        batch = np.zeros((1, self.k, self.cell), dtype=np.uint8)
+        for vi, x in enumerate(valid):
+            batch[0, vi] = self._peek_cell(x, stripe)
+        rec, _crcs = fn(batch)
+        return np.asarray(rec)[0, 0]
+
+    def _fanout_survivors(self, pool, fill_unit, valid: list[int],
+                          depth: int) -> None:
+        """Run the per-survivor batch reads concurrently, watching for
+        stragglers: a unit still pending past its hedge delay while a
+        spare survivor is alive is dropped (_StragglerHedge) and the
+        batched decode replans around it — hedging into the decode
+        pipeline instead of waiting the straggler out. Without a spare
+        the read must wait (the straggler is the k-th survivor)."""
+        from concurrent.futures import wait as fwait
+
+        nodes = self.group.pipeline.nodes
+        futs = {self._submit_act(pool, fill_unit, (vi, u)): u
+                for vi, u in enumerate(valid)}
+        # each stream moves up to `depth` cells (one batched prefetch
+        # RPC plus cache-miss fallbacks): scale the one-RPC hedge delay
+        # by the batch depth like _prefetch_bounded, or a healthy bulk
+        # transfer on a thin link reads as a straggler
+        delay = (1 + depth) * max(self._health.hedge_delay_s(nodes[u])
+                                  for u in valid)
+        delay = resilience.op_timeout(delay, "recover_cells")
+        done, pending = fwait(set(futs), timeout=delay)
+        if pending:
+            spares = [x for x in self.available_units()
+                      if x not in valid and self._health.usable(nodes[x])]
+            # we can only replan around as many slow survivors as there
+            # are spares to take their place; the rest must be waited
+            # out (excluding them would sink below k reachable units)
+            stragglers = sorted(futs[f] for f in pending)[: len(spares)]
+            if stragglers:
+                resilience.METRICS.counter("hedges_fired").inc()
+                log.warning(
+                    "survivor unit(s) %s straggling past %.3fs; hedging "
+                    "into decode via spare unit(s) %s",
+                    stragglers, delay, spares)
+                self._abandon_pool()
+                for f in done:
+                    f.result()  # a real error beats a straggler signal
+                raise _StragglerHedge(stragglers)
+            done2, _ = fwait(set(pending))
+            done = set(done) | done2
+        for f in done:
+            f.result()  # propagate _UnitReadError from the workers
+
     # ------------------------------------------------------------- degraded
     def _choose_valid(self, erased: Sequence[int]) -> list[int]:
         avail = [u for u in self.available_units() if u not in erased]
@@ -267,6 +485,15 @@ class ECBlockGroupReader:
             raise InsufficientLocationsError(
                 f"need {self.k} units, reachable: {avail}, erased: {list(erased)}"
             )
+        nodes = self.group.pipeline.nodes
+        if len(avail) > self.k:
+            # breaker consult (non-claiming — candidates that end up
+            # sliced out by topology must not consume half-open
+            # probes): a peer mid-outage is routed around while spares
+            # exist, never excluded when it IS the k-th survivor
+            usable = [u for u in avail if self._health.usable(nodes[u])]
+            if len(usable) >= self.k:
+                avail = usable
         if len(avail) > self.k and \
                 getattr(self.clients, "nearest_first", None) is not None:
             # more survivors than needed: read the k topology-nearest
@@ -282,15 +509,18 @@ class ECBlockGroupReader:
         return avail[: self.k]
 
     def recover_cells(
-        self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None
+        self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None,
+        exclude_stragglers: bool = True,
     ) -> np.ndarray:
         """Reconstruct full cells of `targets` units for the given stripes
         (default: all). Returns uint8 [num_stripes, len(targets), cell].
         The recoverChunks analog driving offline reconstruction."""
-        return self.recover_cells_with_crcs(targets, stripes)[0]
+        return self.recover_cells_with_crcs(
+            targets, stripes, exclude_stragglers=exclude_stragglers)[0]
 
     def recover_cells_with_crcs(
-        self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None
+        self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None,
+        exclude_stragglers: bool = True,
     ) -> tuple[np.ndarray, np.ndarray]:
         """recover_cells plus the per-slice device CRCs of the recovered
         cells [num_stripes, len(targets), cell // bpc] — reconstruction
@@ -301,7 +531,8 @@ class ECBlockGroupReader:
         rec = np.zeros((len(stripes), len(targets), self.cell),
                        dtype=np.uint8)
         crcs: Optional[np.ndarray] = None
-        for sb, (r, c) in self.recover_cells_iter(targets, stripes):
+        for sb, (r, c) in self.recover_cells_iter(
+                targets, stripes, exclude_stragglers=exclude_stragglers):
             if crcs is None:
                 crcs = np.zeros(
                     (len(stripes), len(targets)) + c.shape[2:], c.dtype)
@@ -313,7 +544,8 @@ class ECBlockGroupReader:
         return rec, crcs
 
     def recover_cells_iter(
-        self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None
+        self, targets: Sequence[int], stripes: Optional[Sequence[int]] = None,
+        exclude_stragglers: bool = True,
     ):
         """Streaming recovery: yields (stripe_batch, (rec, crcs)) per
         decode batch — rec [b, len(targets), cell], crcs [b, len(targets),
@@ -322,8 +554,14 @@ class ECBlockGroupReader:
         unit failure mid-stream the whole recovery restarts with the unit
         excluded and ALL batches are re-yielded; consumers must treat
         stripe indexes as overwrite keys (chunk writes are idempotent)."""
+        # refresh per call: a reader reused across operations must not
+        # re-activate a PREVIOUS operation's (possibly expired) budget
+        self._deadline = resilience.current()
         try:
-            for _ in range(self.p + 1):
+            # p hard failures plus straggler hedges can both consume
+            # attempts; hedges are cheap (detected in one hedge window)
+            # so they get their own allowance on top of the p+1 budget
+            for _ in range(2 * self.p + 1):
                 try:
                     yield from self._recover_batches_once(targets, stripes)
                     return
@@ -334,6 +572,21 @@ class ECBlockGroupReader:
                         e.cause,
                     )
                     self._failed.add(e.unit)
+                except _StragglerHedge as e:
+                    # not a failure: the slow survivors are dropped and
+                    # the decode replans around spares; their abandoned
+                    # reads resolve (and are discarded) in the background.
+                    # Counted as a REPLAN, not a hedge win — hedges_won
+                    # is reserved for a hedge future actually beating
+                    # its primary (HedgeGroup), and the replanned decode
+                    # hasn't succeeded yet at this point.
+                    resilience.METRICS.counter("straggler_replans").inc()
+                    self._failed.update(e.units)
+                    if not exclude_stragglers:
+                        # the CALLER replans (read() folds the straggler
+                        # into missing_data and reconstructs everything
+                        # in one batched pass instead of two)
+                        raise
             raise InsufficientLocationsError(
                 f"recovery failed; failed units {sorted(self._failed)}"
             )
@@ -373,9 +626,10 @@ class ECBlockGroupReader:
             # come off k DIFFERENT datanodes, so the read fan-in costs
             # the slowest node, not the sum (the reference reads
             # survivors with parallel stream readers in
-            # ECBlockReconstructedStripeInputStream). Pool cached on the
-            # reader: recovery retries up to p+1 times per block group.
-            list(pool.map(fill_unit, enumerate(valid)))
+            # ECBlockReconstructedStripeInputStream) — and a survivor
+            # still pending past its hedge delay is dropped for a spare
+            # instead of stalling the whole batch behind it.
+            self._fanout_survivors(pool, fill_unit, valid, len(sb))
             out = pipe.submit(batch, sb)
             if out is not None:
                 yield out
@@ -420,8 +674,14 @@ class ECBlockGroupReader:
         out = np.empty(length, dtype=np.uint8)
         if length == 0:
             return out
+        # refresh per call (see recover_cells_iter): never re-activate a
+        # previous operation's expired budget on a reused reader
+        self._deadline = resilience.current()
         try:
-            for _ in range(self.p + 1):
+            # p hard failures plus straggler hedges both consume
+            # attempts (hedges are detected within one hedge window,
+            # so the extra allowance is cheap)
+            for _ in range(2 * self.p + 1):
                 avail = set(self.available_units())
                 missing_data = [u for u in range(self.k) if u not in avail]
                 try:
@@ -433,6 +693,11 @@ class ECBlockGroupReader:
                         e.unit, e.cause
                     )
                     self._failed.add(e.unit)
+                except _StragglerHedge:
+                    # units already excluded + counted by the recovery
+                    # layer: the retry reconstructs them (and anything
+                    # already missing) in one batched decode pass
+                    pass
             raise InsufficientLocationsError(
                 f"read failed; failed units {sorted(self._failed)}"
             )

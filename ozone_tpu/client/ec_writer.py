@@ -36,6 +36,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import (
     DatanodeClientFactory,
     batch_unsupported,
@@ -153,10 +154,13 @@ def create_group_containers(clients, group: "BlockGroup",
     """Create the group's container on every pipeline member, collecting
     unreachable members into one StripeWriteError so writer retry paths
     exclude them and reallocate (shared by the EC and replicated
-    writers; a dead member must not kill the whole write)."""
+    writers; a dead member must not kill the whole write). Outcomes
+    feed the shared peer-health registry: an unreachable member here
+    trips its breaker just like a failed chunk write."""
     tokens = getattr(clients, "tokens", None)
     if tokens is not None:
         tokens.put_group(group)  # capability tokens rode the allocation
+    health = getattr(clients, "health", None)
     failed: list[str] = []
     cause: Optional[Exception] = None
     for i, dn_id in enumerate(group.pipeline.nodes):
@@ -171,9 +175,13 @@ def create_group_containers(clients, group: "BlockGroup",
             if e.code != "CONTAINER_EXISTS":
                 failed.append(dn_id)
                 cause = e
+                if health is not None and resilience.is_transport_fault(e):
+                    health.failure(dn_id)
         except (KeyError, OSError) as e:
             failed.append(dn_id)
             cause = e
+            if health is not None:
+                health.failure(dn_id)
     if failed:
         raise StripeWriteError(failed, cause)
 
@@ -264,6 +272,13 @@ class ECKeyWriter:
         self._containers_created = False
         self._excluded: list[str] = []
         self._excluded_containers: list[int] = []
+        #: shared per-peer health: write outcomes feed the same EWMA +
+        #: breaker the readers consult, and reallocation skips
+        #: breaker-open peers up front (no retry attempt burned)
+        self._health = getattr(clients, "health", None) \
+            or resilience.default_registry()
+        #: operation deadline, re-activated on RPC-pool worker threads
+        self._deadline: Optional[resilience.Deadline] = resilience.current()
 
         self._buf = np.zeros((self.k, self.cell), dtype=np.uint8)
         self._cell_idx = 0
@@ -285,6 +300,9 @@ class ECKeyWriter:
     def write(self, data) -> None:
         if self._closed:
             raise ValueError("writer is closed")
+        d = resilience.current()
+        if d is not None:
+            self._deadline = d  # freshest ambient budget wins
         arr = np.asarray(
             np.frombuffer(data, dtype=np.uint8)
             if isinstance(data, (bytes, bytearray))
@@ -447,29 +465,34 @@ class ECKeyWriter:
                 pre_chunks[u] + [info for info, _ in new],
                 block_group_length=len_after,
             )
+            dn_id = group.pipeline.nodes[u]
             try:
-                client = self.clients.get(group.pipeline.nodes[u])
+                client = self.clients.get(dn_id)
                 if new:
                     fn = getattr(client, "write_chunks_commit", None)
                     if fn is None:  # duck-typed client without the verb
                         return u, StorageError(
                             "IO_EXCEPTION",
                             "UNIMPLEMENTED: client lacks write_chunks_commit")
-                    fn(group.block_id, new, commit=bd,
-                       writer=self._writer_id)
+                    self._observed(dn_id, fn, group.block_id, new,
+                                   commit=bd, writer=self._writer_id)
                 else:
                     # zero new bytes on this unit (short final stripes):
                     # just advance its committed group length
-                    client.put_block(bd, writer=self._writer_id)
+                    self._observed(dn_id, client.put_block, bd,
+                                   writer=self._writer_id)
                 return u, None
             except (StorageError, KeyError, OSError) as e:
+                if isinstance(e, StorageError) \
+                        and e.code == resilience.DEADLINE_EXCEEDED:
+                    raise  # op budget spent: abort, don't exclude peers
                 return u, e
 
         failed: list[str] = []
         closed = unsupported = False
         cause: Optional[Exception] = None
         ok_units: list[int] = []
-        for u, err in self._ensure_pool().map(write_unit,
+        for u, err in self._ensure_pool().map(self._act(write_unit),
                                               range(self.k + self.p)):
             if err is None:
                 ok_units.append(u)
@@ -512,7 +535,7 @@ class ECKeyWriter:
                        block_group_length=pre_len))
             for u in ok_units if pre_chunks[u]
         ]
-        for res in self._ensure_pool().map(roll, rollbacks):
+        for res in self._ensure_pool().map(self._act(roll), rollbacks):
             if res is not None:
                 log.warning("putBlock rollback failed on %s: %s",
                             res[0], res[1])
@@ -561,19 +584,24 @@ class ECKeyWriter:
                 length=length,
                 checksum=self._chunk_checksum(crcs[u], length, cell_data),
             )
+            dn_id = group.pipeline.nodes[u]
             try:
-                self.clients.get(group.pipeline.nodes[u]).write_chunk(
+                self._observed(
+                    dn_id, self.clients.get(dn_id).write_chunk,
                     group.block_id, info, cell_data[:length],
                     writer=self._writer_id,
                 )
                 return u, info, None
             except (StorageError, KeyError, OSError) as e:
+                if isinstance(e, StorageError) \
+                        and e.code == resilience.DEADLINE_EXCEEDED:
+                    raise  # op budget spent: abort, don't exclude peers
                 return u, None, e
 
         # all k+p unit streams in parallel: gRPC releases the GIL, so
         # the stripe costs the slowest node's RPC, not the sum of nine
         for u, info, err in self._ensure_pool().map(
-                write_unit, range(self.k + self.p)):
+                self._act(write_unit), range(self.k + self.p)):
             if info is not None:
                 new_chunks[u] = info
             elif err is not None:
@@ -614,13 +642,13 @@ class ECKeyWriter:
         def put_unit(entry):
             dn_id, bd = entry
             try:
-                self.clients.get(dn_id).put_block(
-                    bd, writer=self._writer_id)
+                self._observed(dn_id, self.clients.get(dn_id).put_block,
+                               bd, writer=self._writer_id)
                 return None
             except (StorageError, KeyError, OSError) as e:
                 return dn_id, e
 
-        errors = [r for r in self._ensure_pool().map(put_unit, puts)
+        errors = [r for r in self._ensure_pool().map(self._act(put_unit), puts)
                   if r is not None]
         if errors:
             all_closed = all(
@@ -659,7 +687,7 @@ class ECKeyWriter:
                 rollbacks.append((dn_id, BlockData(
                     group.block_id, prev_chunks,
                     block_group_length=group.length)))
-            for res in self._ensure_pool().map(put_unit, rollbacks):
+            for res in self._ensure_pool().map(self._act(put_unit), rollbacks):
                 if res is not None:
                     log.warning("putBlock rollback failed on %s: %s",
                                 res[0], res[1])
@@ -679,12 +707,57 @@ class ECKeyWriter:
                 thread_name_prefix="ec-writer")
         return self._rpc_pool
 
+    def _act(self, fn):
+        """Wrap a pool callable so the operation deadline is ambient on
+        the worker thread (RPC timeouts below derive from it)."""
+        d = self._deadline
+        if d is None:
+            return fn
+
+        def wrapped(*a):
+            with resilience.activate(d):
+                return fn(*a)
+
+        return wrapped
+
+    def _observed(self, dn_id: str, fn, *a, **kw):
+        """Health-recording RPC: one shared classification
+        (resilience.is_transport_fault — which already exempts the
+        batch-unsupported UNIMPLEMENTED downgrade and application
+        outcomes like a closed container) so the writer can never move
+        a peer's breaker differently than the read paths do."""
+        return self._health.observe(dn_id, fn, *a, **kw)
+
     # ------------------------------------------------------------------ groups
     def _ensure_group(self) -> BlockGroup:
         if self._group is None:
-            self._group = call_allocate(
-                self.allocate_group, list(self._excluded),
-                tuple(self._excluded_containers))
+            excluded = list(self._excluded)
+            # breaker consult at allocation: a peer mid-outage is
+            # excluded up front, so the reallocation can never land on
+            # it and burn a retry attempt discovering the outage with a
+            # failed stripe write (transient — a recovered peer leaves
+            # this list the moment its half-open probe succeeds)
+            extra = [dn for dn in self._health.open_peers()
+                     if dn not in excluded]
+            try:
+                self._group = call_allocate(
+                    self.allocate_group, excluded + extra,
+                    tuple(self._excluded_containers))
+            except Exception as e:  # noqa: BLE001 - advisory exclusion
+                if not extra or (isinstance(e, StorageError)
+                                 and e.code == resilience.DEADLINE_EXCEEDED):
+                    raise  # spent budget: no second doomed allocation
+                # the breaker-extended exclusion starved placement
+                # (small cluster / wide outage): the breaker is
+                # ADVISORY — retry with only the hard excludes and let
+                # the write discover which peers actually answer
+                log.warning(
+                    "allocation with breaker-open peers %s excluded "
+                    "failed (%s); retrying without the advisory "
+                    "exclusions", extra, e)
+                self._group = call_allocate(
+                    self.allocate_group, excluded,
+                    tuple(self._excluded_containers))
             self._group_chunks = [[] for _ in range(self.k + self.p)]
             self._create_containers(self._group)
         return self._group
@@ -723,6 +796,9 @@ class ECKeyWriter:
         committed block groups in key order."""
         if self._closed:
             return self._groups
+        d = resilience.current()
+        if d is not None:
+            self._deadline = d  # freshest ambient budget wins
         try:
             # partial stripe: pad for parity, write true lengths
             if self._cell_idx > 0 or self._cell_off > 0:

@@ -31,6 +31,7 @@ from typing import Optional
 
 import numpy as np
 
+from ozone_tpu.client import resilience
 from ozone_tpu.net.dn_service import GrpcDatanodeClient
 from ozone_tpu.storage.ids import StorageError
 
@@ -51,9 +52,33 @@ def _enabled() -> bool:
     return os.environ.get("OZONE_TPU_NATIVE_DATAPATH", "1") != "0"
 
 
+def _connect_timeout_s() -> float:
+    """Connect budget (env-overridable); the operation deadline caps it
+    further in _Conn via resilience.op_timeout."""
+    try:
+        return float(os.environ.get("OZONE_TPU_CONNECT_TIMEOUT_S", "")
+                     or 20.0)
+    except ValueError:
+        return 20.0
+
+
+def _io_timeout_s() -> float:
+    """Per-request socket read/write budget when no operation deadline
+    is ambient (replaces the old hardcoded 120 s create_connection
+    timeout that doubled as the forever-IO timeout)."""
+    try:
+        return float(os.environ.get("OZONE_TPU_IO_TIMEOUT_S", "") or 120.0)
+    except ValueError:
+        return 120.0
+
+
 class _Conn:
     def __init__(self, host: str, port: int):
-        self.sock = socket.create_connection((host, port), timeout=120.0)
+        # deadline-derived connect timeout: a spent budget raises
+        # DEADLINE_EXCEEDED here instead of queueing a doomed connect
+        self.sock = socket.create_connection(
+            (host, port),
+            timeout=resilience.op_timeout(_connect_timeout_s(), "connect"))
         self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # deep buffers: on shared-core rigs every buffer-full forces a
         # client<->server context switch mid-chunk
@@ -62,6 +87,12 @@ class _Conn:
                 self.sock.setsockopt(socket.SOL_SOCKET, opt, 8 * 1024 * 1024)
             except OSError:
                 pass
+
+    def arm(self, verb: str) -> None:
+        """Per-request IO timeout: pooled-connection REUSE re-derives it
+        from the remaining operation deadline, so a request issued with
+        2 s of budget left cannot block the full default IO timeout."""
+        self.sock.settimeout(resilience.op_timeout(_io_timeout_s(), verb))
 
     def send_frame(self, tag: int, body) -> None:
         self.sock.sendall(_FRAME.pack(len(body), tag))
@@ -167,7 +198,8 @@ class NativeDatanodeClient(GrpcDatanodeClient):
         if d > 0:
             import time
 
-            time.sleep(d)
+            # injected chaos latency, not a retry sleep
+            time.sleep(d)  # resilience-lint: allow
 
     def _status(self, conn: _Conn, body: bytes) -> None:
         m = json.loads(body) if body else {}
@@ -213,6 +245,7 @@ class NativeDatanodeClient(GrpcDatanodeClient):
                 block_id, chunks, commit=commit, sync=sync, writer=writer)
         completed = False  # STATUS received: framing is in lockstep
         try:
+            conn.arm("WriteChunksCommit")
             conn.send_frame(_T_WHDR, hdr)
             for (info, _data), view in zip(chunks, views):
                 # one gathered syscall per chunk: frame prefix + binary
@@ -279,6 +312,7 @@ class NativeDatanodeClient(GrpcDatanodeClient):
             self._disable_native()
             return super().read_chunks(block_id, infos, verify=verify)
         try:
+            conn.arm("ReadChunks")
             frames: list[tuple[int, object]] = [(_T_RHDR, hdr)]
             for info in infos:
                 frames.append((_T_RCHUNK, _rchunk_body(info, verify)))

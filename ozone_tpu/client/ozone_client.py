@@ -13,6 +13,7 @@ from typing import Optional
 
 import numpy as np
 
+from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_reader import ECBlockGroupReader
 from ozone_tpu.client.ec_writer import BlockGroup, ECKeyWriter
@@ -216,8 +217,12 @@ class OzoneBucket:
     def write_key(self, key: str, data,
                   replication: Optional[str] = None,
                   metadata: Optional[dict] = None) -> None:
-        with self.open_key(key, replication, metadata=metadata) as h:
-            h.write(data)
+        # key-write operation boundary: ONE deadline (operator opt-in,
+        # OZONE_TPU_OP_DEADLINE_S) spans open, every stripe/chunk RPC
+        # and the commit — each hop times out on the remaining budget
+        with resilience.start("key_write"):
+            with self.open_key(key, replication, metadata=metadata) as h:
+                h.write(data)
 
     def lookup_key_info(self, key: str) -> dict:
         """Key info lookup with `.snapshot/<name>/<key>` routing (the
@@ -262,6 +267,11 @@ class OzoneBucket:
         if offset < 0 or length < 0 or offset + length > size:
             raise ValueError(f"range [{offset},{offset + length}) out of "
                              f"bounds for size {size}")
+        with resilience.start("key_read"):
+            return self._read_groups_range(om, info, offset, length)
+
+    def _read_groups_range(self, om, info: dict, offset: int,
+                           length: int) -> np.ndarray:
         groups = om.key_block_groups(info)
         parts: list[np.ndarray] = []
         pos = 0  # current group's start offset in key space

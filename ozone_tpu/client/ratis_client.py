@@ -17,9 +17,9 @@ the leader so every replica applies the same ordered history.
 from __future__ import annotations
 
 import logging
-import time
 from typing import Optional
 
+from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import BlockGroup, StripeWriteError
 from ozone_tpu.client.replicated import ReplicatedKeyWriter
@@ -39,6 +39,14 @@ class XceiverClientRatis:
         self.clients = ratis_clients
         self.max_attempts = max_attempts
         self.retry_interval_s = retry_interval_s
+        # capped exponential + FULL jitter between failover sweeps: the
+        # old fixed `interval * min(attempt+1, 4)` ladder synchronized
+        # every client that failed together onto the same retry ticks,
+        # thundering-herding each fresh leader after an election
+        self.retry_policy = resilience.RetryPolicy(
+            base_s=retry_interval_s,
+            cap_s=max(retry_interval_s, min(5.0, retry_interval_s * 16)),
+            max_attempts=max_attempts)
         self._leader: Optional[str] = None
         #: sticky watch degrade: once a follower proves dead, later
         #: watches skip straight to MAJORITY instead of re-paying the
@@ -83,7 +91,14 @@ class XceiverClientRatis:
                         raise  # deterministic application error
                 except (KeyError, OSError, ConnectionError) as e:
                     last = e
-            time.sleep(self.retry_interval_s * min(attempt + 1, 4))
+            if attempt < self.max_attempts - 1 and \
+                    not self.retry_policy.sleep(attempt):
+                # the operation deadline cannot cover another sweep:
+                # surface the fail-fast DEADLINE_EXCEEDED (never the
+                # transport-shaped IO_EXCEPTION below, which breakers
+                # and callers would read as a peer fault)
+                resilience.check_deadline("ratis_retry")
+                break
         raise StorageError(
             "IO_EXCEPTION",
             f"no reachable leader for pipeline {self.pipeline.id}: {last}")

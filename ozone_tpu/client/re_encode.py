@@ -12,10 +12,12 @@ go through the SCM deletion chain.
 
 from __future__ import annotations
 
+import functools
 import logging
 
 import numpy as np
 
+from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import ECKeyWriter
 from ozone_tpu.client.replicated import ReplicatedKeyReader
@@ -33,6 +35,20 @@ from ozone_tpu.utils.checksum import ChecksumType
 log = logging.getLogger(__name__)
 
 
+def _op_boundary(op: str):
+    """Operation-boundary decorator: one Deadline covers the whole
+    conversion (source reads, device passes, target writes, commit);
+    nested hops derive their timeouts from it (client/resilience.py)."""
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **kw):
+            with resilience.start(op):
+                return fn(*a, **kw)
+        return wrapped
+    return deco
+
+
+@_op_boundary("re_encode")
 def re_encode_key_to_ec(
     om: OzoneManager,
     clients: DatanodeClientFactory,
@@ -89,28 +105,38 @@ def _unit_source(clients, group, unit, cell):
     the replica is unreachable/missing. The block record is fetched and
     indexed by stripe once per group; cell reads then happen per stripe
     window (_read_unit_window) so the re-encode pipeline can overlap
-    them with the device pass."""
+    them with the device pass. Outcomes feed the shared peer-health
+    registry (an unreachable source trips toward its breaker)."""
     dn_id = group.pipeline.nodes[unit]
+    health = getattr(clients, "health", None)
     try:
         client = clients.get(dn_id)
         bd = client.get_block(group.block_id)
     except Exception:  # noqa: BLE001 - any failure = unit unavailable
+        if health is not None:
+            health.failure(dn_id)
         return None
     return client, {info.offset // cell: info for info in bd.chunks}
 
 
-def _read_unit_window(group, source, s0: int, n: int, cell: int):
+def _read_unit_window(group, source, s0: int, n: int, cell: int,
+                      health=None):
     """One unit's cells for stripes [s0, s0+n) as [n, cell] zero-padded."""
     client, by_stripe = source
     out = np.zeros((n, cell), dtype=np.uint8)
     for s in range(s0, s0 + n):
         info = by_stripe.get(s)
         if info is not None:
-            data = client.read_chunk(group.block_id, info)
+            if health is not None:
+                data = health.observe(client.dn_id, client.read_chunk,
+                                      group.block_id, info)
+            else:
+                data = client.read_chunk(group.block_id, info)
             out[s - s0, : info.length] = data[: info.length]
     return out
 
 
+@_op_boundary("re_encode")
 def re_encode_xor_key_to_rs(
     om: OzoneManager,
     clients: DatanodeClientFactory,
@@ -247,10 +273,13 @@ def re_encode_xor_key_to_rs(
         # _flush_queue structure on the conversion path — target writes
         # of window N overlap the device pass + D2H of window N+1
         pipe = DeviceBatchPipeline(fn)
+        health = getattr(clients, "health", None)
         for s0 in range(0, stripes, window):
+            resilience.check_deadline("re_encode_window")
             n = min(window, stripes - s0)
             batch = np.stack(
-                [_read_unit_window(g, src, s0, n, cell) for src in sources],
+                [_read_unit_window(g, src, s0, n, cell, health=health)
+                 for src in sources],
                 axis=1)  # [n, k, C]
             done = pipe.submit(batch, (s0, n, batch))
             if done is not None:

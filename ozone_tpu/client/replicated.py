@@ -318,7 +318,13 @@ class ReplicatedKeyWriter:
 
 
 class ReplicatedKeyReader:
-    """Reads replicated blocks with replica failover."""
+    """Reads replicated blocks with replica failover AND hedging: the
+    nearest replica is read first; once it exceeds its P95 latency EWMA
+    (or the OZONE_TPU_HEDGE_MS floor) the SAME read fires at the next
+    replica — first result wins, the loser's bytes are discarded
+    (client/resilience.py HedgeGroup; the reference's hedged-read
+    posture over sortDatanodes order). Breaker-open replicas are moved
+    to the back of the chain instead of being dialed first."""
 
     def __init__(self, group: BlockGroup, clients: DatanodeClientFactory,
                  verify: bool = True):
@@ -329,71 +335,97 @@ class ReplicatedKeyReader:
         self.verify = verify
         import os
 
+        from ozone_tpu.client import resilience
+
         self._batch_reads = os.environ.get(
             "OZONE_TPU_BATCH_READS", "1") != "0"
+        self._health = getattr(clients, "health", None) \
+            or resilience.default_registry()
 
     def read_all(self) -> np.ndarray:
         return self.read(0, self.group.length)
 
     def read(self, offset: int, length: int) -> np.ndarray:
-        """Chunk-granular range read with replica failover: only the
-        chunks overlapping [offset, offset+length) move over the wire
-        (one batched ReadChunks round trip per replica when it serves
-        the verb)."""
+        """Chunk-granular range read with hedged replica failover: only
+        the chunks overlapping [offset, offset+length) move over the
+        wire (one batched ReadChunks round trip per replica when it
+        serves the verb)."""
+        from ozone_tpu.client import resilience
+
         if offset < 0 or length < 0 or \
                 offset + length > self.group.length:
             raise ValueError("range out of bounds")
         if length == 0:
             return np.zeros(0, np.uint8)
-        last: Optional[Exception] = None
         # topology-nearest replica first (XceiverClientGrpc reads via
         # sortDatanodes order in the reference); farther replicas remain
-        # the failover chain
+        # the hedge/failover chain. Breaker-refusing replicas drop to
+        # the back (stable within each class).
         nodes = self.group.pipeline.nodes
         if getattr(self.clients, "nearest_first", None) is not None:
             nodes = self.clients.nearest_first(nodes)
-        for dn_id in nodes:
+        # non-claiming check: ordering must not consume half-open probes
+        nodes = sorted(nodes, key=lambda dn: not self._health.usable(dn))
+
+        def read_from(dn_id):
+            return self._health.observe(
+                dn_id, self._read_replica, dn_id, offset, length)
+
+        try:
+            win = resilience.HedgeGroup().run(
+                lambda: read_from(nodes[0]),
+                [(lambda dn: lambda: read_from(dn))(dn)
+                 for dn in nodes[1:]],
+                delay_s=self._health.hedge_delay_s(nodes[0]))
+            return win.value
+        except (StorageError, KeyError, OSError) as e:
+            if isinstance(e, StorageError) \
+                    and e.code == resilience.DEADLINE_EXCEEDED:
+                # the operation budget expired, the replicas may be
+                # fine: surface the fail-fast signal, never a
+                # missing-block verdict
+                raise
+            raise StorageError("NO_SUCH_BLOCK",
+                               f"all replicas failed: {e}")
+
+    def _read_replica(self, dn_id: str, offset: int,
+                      length: int) -> np.ndarray:
+        """One replica's attempt at the whole range; raises on any
+        shortfall so the hedge/failover chain moves on."""
+        client = self.clients.get(dn_id)
+        bd = client.get_block(self.group.block_id)
+        wanted = [c for c in bd.chunks
+                  if c.offset < offset + length
+                  and c.offset + c.length > offset]
+        fn = (getattr(client, "read_chunks", None)
+              if len(wanted) > 1 and self._batch_reads
+              else None)
+        if fn is not None:
             try:
-                client = self.clients.get(dn_id)
-                bd = client.get_block(self.group.block_id)
-                wanted = [c for c in bd.chunks
-                          if c.offset < offset + length
-                          and c.offset + c.length > offset]
-                fn = (getattr(client, "read_chunks", None)
-                      if len(wanted) > 1 and self._batch_reads
-                      else None)
-                if fn is not None:
-                    try:
-                        parts = fn(self.group.block_id, wanted,
-                                   self.verify)
-                    except StorageError as e:
-                        if not _batch_unsupported(e):
-                            raise
-                        fn = None
-                if fn is None:
-                    parts = [
-                        client.read_chunk(self.group.block_id, info,
-                                          self.verify)
-                        for info in wanted
-                    ]
-                out = np.zeros(length, dtype=np.uint8)
-                covered = 0
-                for info, data in zip(wanted, parts):
-                    a = max(offset, info.offset)
-                    b = min(offset + length, info.offset + len(data))
-                    if a < b:
-                        out[a - offset : b - offset] = \
-                            data[a - info.offset : b - info.offset]
-                        covered += b - a
-                if covered != length:
-                    # a stale/short replica (missing or truncated
-                    # chunks) must FAIL OVER, not read back zeros
-                    raise StorageError(
-                        "NO_SUCH_BLOCK",
-                        f"replica {dn_id} covers {covered}/{length} "
-                        f"bytes of [{offset},{offset + length})")
-                return out
-            except (StorageError, KeyError, OSError) as e:
-                log.warning("replica %s failed: %s; trying next", dn_id, e)
-                last = e
-        raise StorageError("NO_SUCH_BLOCK", f"all replicas failed: {last}")
+                parts = fn(self.group.block_id, wanted, self.verify)
+            except StorageError as e:
+                if not _batch_unsupported(e):
+                    raise
+                fn = None
+        if fn is None:
+            parts = [
+                client.read_chunk(self.group.block_id, info, self.verify)
+                for info in wanted
+            ]
+        out = np.zeros(length, dtype=np.uint8)
+        covered = 0
+        for info, data in zip(wanted, parts):
+            a = max(offset, info.offset)
+            b = min(offset + length, info.offset + len(data))
+            if a < b:
+                out[a - offset : b - offset] = \
+                    data[a - info.offset : b - info.offset]
+                covered += b - a
+        if covered != length:
+            # a stale/short replica (missing or truncated chunks) must
+            # FAIL OVER, not read back zeros
+            raise StorageError(
+                "NO_SUCH_BLOCK",
+                f"replica {dn_id} covers {covered}/{length} "
+                f"bytes of [{offset},{offset + length})")
+        return out

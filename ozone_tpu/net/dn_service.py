@@ -382,14 +382,29 @@ class GrpcDatanodeClient:
     encodedToken; absent tokens simply aren't attached (insecure
     clusters ignore them)."""
 
+    #: per-verb default RPC timeouts, all capped by the ambient
+    #: operation deadline (client/resilience.op_timeout): a caller with
+    #: 2 s of budget left issues 2 s RPCs, not 30 s ones
+    _UNARY_TIMEOUT_S = 30.0
+    _STREAM_TIMEOUT_S = 120.0
+    _BULK_STREAM_TIMEOUT_S = 300.0
+
     def __init__(self, dn_id: str, address: str, tokens=None, tls=None):
         self.dn_id = dn_id
         self.tokens = tokens
         self._ch = RpcChannel(address, tls=tls)
 
+    @staticmethod
+    def _timeout(default: float, verb: str) -> float:
+        from ozone_tpu.client.resilience import op_timeout
+
+        return op_timeout(default, verb)
+
     def _call(self, method: str, meta: dict,
               payload: Optional[np.ndarray] = None) -> tuple[dict, memoryview]:
-        resp = self._ch.call(SERVICE, method, wire.pack(meta, payload))
+        resp = self._ch.call(
+            SERVICE, method, wire.pack(meta, payload),
+            timeout=self._timeout(self._UNARY_TIMEOUT_S, method))
         return wire.unpack(resp)
 
     def _btok(self, block_id: BlockID) -> dict:
@@ -467,6 +482,8 @@ class GrpcDatanodeClient:
                 "verify": verify,
                 **self._btok(block_id),
             }),
+            timeout=self._timeout(self._BULK_STREAM_TIMEOUT_S,
+                                  "ReadChunks"),
         )
         out = []
         for f in frames:
@@ -512,6 +529,8 @@ class GrpcDatanodeClient:
                        "compress": compress,
                        "accept": accept,
                        **self._ctok(container_id)}),
+            timeout=self._timeout(self._BULK_STREAM_TIMEOUT_S,
+                                  "ExportContainer"),
         )
         head = next(iter_frames := iter(frames))
         wire.unpack(head)  # header: {container_id, size, compression}
@@ -536,7 +555,10 @@ class GrpcDatanodeClient:
                 yield data[off:off + frame]
 
         try:
-            out = self._ch.call_streaming(SERVICE, "ImportContainer", gen())
+            out = self._ch.call_streaming(
+                SERVICE, "ImportContainer", gen(),
+                timeout=self._timeout(self._BULK_STREAM_TIMEOUT_S,
+                                      "ImportContainer"))
         except StorageError as e:
             from ozone_tpu.storage.container_packer import (
                 UNSUPPORTED_COMPRESSION,
@@ -555,8 +577,10 @@ class GrpcDatanodeClient:
                 for off in range(0, len(data), frame):
                     yield data[off:off + frame]
 
-            out = self._ch.call_streaming(SERVICE, "ImportContainer",
-                                          gen2())
+            out = self._ch.call_streaming(
+                SERVICE, "ImportContainer", gen2(),
+                timeout=self._timeout(self._BULK_STREAM_TIMEOUT_S,
+                                      "ImportContainer"))
         m, _ = wire.unpack(out)
         return int(m["container_id"])
 
@@ -590,7 +614,10 @@ class GrpcDatanodeClient:
             for f in data_frames:
                 yield bytes(f)
 
-        resp = self._ch.call_streaming(SERVICE, "StreamWriteBlock", frames())
+        resp = self._ch.call_streaming(
+            SERVICE, "StreamWriteBlock", frames(),
+            timeout=self._timeout(self._STREAM_TIMEOUT_S,
+                                  "StreamWriteBlock"))
         m, _ = wire.unpack(resp)
         return BlockData.from_json(m["block"])
 
@@ -619,7 +646,10 @@ class GrpcDatanodeClient:
                 )
                 yield wire.pack({"chunk": info.to_json()}, arr)
 
-        self._ch.call_streaming(SERVICE, "WriteChunksCommit", frames())
+        self._ch.call_streaming(
+            SERVICE, "WriteChunksCommit", frames(),
+            timeout=self._timeout(self._STREAM_TIMEOUT_S,
+                                  "WriteChunksCommit"))
 
     def echo(self, data: bytes = b"ping") -> bytes:
         return self._ch.call(SERVICE, "Echo", data)

@@ -26,6 +26,7 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass
 
+from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import (
     DatanodeClientFactory,
     build_chunk_pairs,
@@ -86,8 +87,21 @@ class ECReconstructionCoordinator:
         self.mesh = mesh
         self.use_ring = use_ring
         self.metrics = MetricsRegistry("ec.reconstruction")
+        #: shared peer health: source selection skips breaker-open
+        #: peers while alternatives exist, and the reader's survivor
+        #: choice/straggler hedging below rides the same registry
+        self.health = getattr(clients, "health", None) \
+            or resilience.default_registry()
 
     def reconstruct_container_group(self, cmd: ReconstructionCommand) -> None:
+        # reconstruction-job boundary: one deadline (operator opt-in via
+        # OZONE_TPU_OP_DEADLINE_S) covers listing, every block's
+        # recover+write chain, and the target close/cleanup
+        with resilience.start("reconstruction"):
+            self._reconstruct_container_group(cmd)
+
+    def _reconstruct_container_group(self,
+                                     cmd: ReconstructionCommand) -> None:
         opts = cmd.replication
         n = opts.all_units
         targets = sorted(cmd.targets)
@@ -144,10 +158,15 @@ class ECReconstructionCoordinator:
 
     def _list_blocks(self, cmd: ReconstructionCommand) -> list[BlockData]:
         last_err: Exception | None = None
-        for idx in sorted(cmd.sources):
-            dn = cmd.sources[idx]
+        # health-ordered: breaker-allowing, fastest-EWMA sources first;
+        # a tripped source is still LAST-resort dialed rather than
+        # failing the job when it is the only replica left
+        for dn in self.health.preferred(
+                [cmd.sources[idx] for idx in sorted(cmd.sources)]):
             try:
-                return self.clients.get(dn).list_blocks(cmd.container_id)
+                return self.health.observe(
+                    dn, self.clients.get(dn).list_blocks,
+                    cmd.container_id)
             except (StorageError, KeyError, OSError) as e:
                 last_err = e
         raise StorageError(

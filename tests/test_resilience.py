@@ -1,0 +1,480 @@
+"""Resilience layer: deadlines, jittered retries, breakers, hedging.
+
+Covers the unified straggler-tolerance layer (client/resilience.py) at
+three altitudes: the primitives themselves, their wiring into the EC
+read/write paths over in-process datanodes with injected stragglers
+(the net/partition + FaultInjector delay-rule analog, injected at the
+client wrapper so no toolchain or subprocess is needed), and the
+acceptance property — a degraded EC read with one survivor delayed
+10x+ its P95 completes near the healthy-path time with a hedge fired
+and zero errors surfaced.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ozone_tpu.client import resilience
+from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.client.ec_reader import ECBlockGroupReader
+from ozone_tpu.client.ratis_client import XceiverClientRatis
+from ozone_tpu.storage.ids import StorageError
+from ozone_tpu.utils.metrics import prometheus_text
+from tests.test_ec_pipeline import CELL, MiniEC, _write_key
+
+
+# ------------------------------------------------------------- primitives
+def test_deadline_scope_inherit_and_timeout():
+    assert resilience.current() is None
+    assert resilience.op_timeout(30.0) == 30.0
+    with resilience.start("op", 5.0) as d:
+        assert resilience.current() is d
+        assert 0.0 < d.remaining() <= 5.0
+        assert resilience.op_timeout(30.0) <= 5.0
+        assert resilience.op_timeout(1.0) <= 1.0
+        # nested boundary inherits the OUTER budget (minted once)
+        with resilience.start("inner", 9999.0) as d2:
+            assert d2 is d
+    assert resilience.current() is None
+
+
+def test_deadline_unbounded_installs_nothing(monkeypatch):
+    monkeypatch.delenv("OZONE_TPU_OP_DEADLINE_S", raising=False)
+    with resilience.start("op") as d:
+        assert d is None
+        assert resilience.current() is None
+
+
+def test_deadline_env_default(monkeypatch):
+    monkeypatch.setenv("OZONE_TPU_OP_DEADLINE_S", "2.5")
+    with resilience.start("op") as d:
+        assert d is not None and 0.0 < d.remaining() <= 2.5
+
+
+def test_deadline_expiry_raises_and_counts():
+    before = resilience.METRICS.counter("deadline_exceeded").value
+    with resilience.start("op", 0.01):
+        time.sleep(0.03)
+        with pytest.raises(StorageError) as ei:
+            resilience.op_timeout(30.0, "ReadChunks")
+    assert ei.value.code == resilience.DEADLINE_EXCEEDED
+    assert resilience.METRICS.counter("deadline_exceeded").value > before
+
+
+def test_deadline_crosses_worker_threads():
+    out = {}
+
+    def worker(d):
+        with resilience.activate(d):
+            out["t"] = resilience.op_timeout(30.0)
+
+    with resilience.start("op", 5.0) as d:
+        t = threading.Thread(target=worker, args=(d,))
+        t.start()
+        t.join()
+    assert out["t"] <= 5.0
+
+
+def test_retry_policy_full_jitter_and_cap():
+    p = resilience.RetryPolicy(base_s=0.1, cap_s=0.4, max_attempts=8)
+    rng = random.Random(7)
+    draws = [p.backoff_s(a, rng) for a in range(8) for _ in range(50)]
+    assert all(0.0 <= d <= 0.4 for d in draws)
+    # full jitter: late attempts draw from [0, cap], not a fixed ladder
+    late = [p.backoff_s(7, rng) for _ in range(200)]
+    assert max(late) > 0.3 and min(late) < 0.1
+    assert len({round(d, 6) for d in late}) > 100  # actually jittered
+
+
+def test_retry_sleep_respects_deadline():
+    p = resilience.RetryPolicy(base_s=5.0, cap_s=5.0)
+    with resilience.start("op", 0.05):
+        t0 = time.monotonic()
+        ok = p.sleep(3)
+        assert time.monotonic() - t0 < 1.0  # clipped, not 5 s
+        assert not ok  # budget spent: caller must stop retrying
+
+
+def test_ratis_retry_jitter_stops_on_deadline():
+    class _Empty:
+        def maybe_get(self, dn_id):
+            return None
+
+    from ozone_tpu.scm.pipeline import Pipeline, ReplicationConfig
+
+    pl = Pipeline(ReplicationConfig.parse("RATIS/THREE"),
+                  ["a", "b", "c"])
+    x = XceiverClientRatis(pl, _Empty(), max_attempts=50,
+                           retry_interval_s=5.0)
+    with resilience.start("op", 0.1):
+        t0 = time.monotonic()
+        with pytest.raises(StorageError):
+            x.submit({"verb": "noop"})
+        # 50 attempts x 5 s base would be minutes; the deadline stops
+        # the sweep almost immediately
+        assert time.monotonic() - t0 < 2.0
+
+
+# ---------------------------------------------------------------- breaker
+def test_breaker_lifecycle_open_halfopen_close():
+    h = resilience.HealthRegistry(open_after=3, reset_s=0.15)
+    for _ in range(2):
+        h.failure("dn")
+    assert h.allow("dn")  # still closed below the threshold
+    h.failure("dn")
+    assert h.is_open("dn") and not h.allow("dn")
+    assert h.open_peers() == ["dn"]
+    time.sleep(0.2)
+    assert h.allow("dn")      # half-open: exactly one probe
+    assert not h.allow("dn")  # second caller keeps routing around
+    h.success("dn", 0.01)     # probe succeeded
+    assert h.allow("dn") and not h.open_peers()
+
+
+def test_breaker_reopen_on_failed_probe():
+    h = resilience.HealthRegistry(open_after=2, reset_s=0.1)
+    h.failure("dn"), h.failure("dn")
+    time.sleep(0.15)
+    assert h.allow("dn")  # the probe
+    h.failure("dn")       # probe failed -> OPEN again, fresh cooldown
+    assert not h.allow("dn")
+    time.sleep(0.15)
+    assert h.allow("dn")  # next window probes again
+
+
+def test_preferred_orders_by_breaker_then_latency():
+    h = resilience.HealthRegistry(open_after=1, reset_s=60.0)
+    h.success("fast", 0.01)
+    h.success("slow", 0.5)
+    h.failure("dead")
+    assert h.preferred(["dead", "slow", "fast"]) == \
+        ["fast", "slow", "dead"]
+
+
+# ---------------------------------------------------------------- hedging
+def test_hedge_race_both_complete_one_result_consumed():
+    """Satellite: both the primary and the hedge complete — exactly one
+    result is consumed, the loser's bytes are discarded, and the
+    loser's 'connection' is returned to its pool (clean-reusable), the
+    native_dn desync rule generalized."""
+    pool: list[str] = ["conn-a", "conn-b"]
+    pool_lock = threading.Lock()
+    finished: list[str] = []
+    done = threading.Event()
+
+    def make(name, delay, payload):
+        def fn():
+            with pool_lock:
+                conn = pool.pop()
+            try:
+                time.sleep(delay)
+                return payload
+            finally:
+                # the callable's own hygiene: a completed exchange
+                # returns its pooled conn (native_dn checkin analog)
+                with pool_lock:
+                    pool.append(conn)
+                finished.append(name)
+                if len(finished) == 2:
+                    done.set()
+        return fn
+
+    fired0 = resilience.METRICS.counter("hedges_fired").value
+    won0 = resilience.METRICS.counter("hedges_won").value
+    win = resilience.HedgeGroup().run(
+        make("primary", 0.4, b"primary-bytes"),
+        [make("hedge", 0.0, b"hedge-bytes")],
+        delay_s=0.05)
+    assert win.value == b"hedge-bytes" and win.index == 1
+    assert resilience.METRICS.counter("hedges_fired").value == fired0 + 1
+    assert resilience.METRICS.counter("hedges_won").value == won0 + 1
+    # the loser completes in the background; its bytes were discarded
+    # and its conn checked back in — the pool is fully reusable
+    assert done.wait(timeout=2.0)
+    with pool_lock:
+        assert sorted(pool) == ["conn-a", "conn-b"]
+    assert sorted(finished) == ["hedge", "primary"]
+
+
+def test_hedge_failed_primary_fires_hedge_immediately():
+    def boom():
+        raise OSError("primary down")
+
+    t0 = time.monotonic()
+    win = resilience.HedgeGroup().run(boom, [lambda: 42], delay_s=5.0)
+    assert win.value == 42
+    assert time.monotonic() - t0 < 1.0  # did not wait the full delay
+
+
+def test_hedge_all_branches_fail_raises_last():
+    with pytest.raises(KeyError):
+        resilience.HedgeGroup().run(
+            lambda: (_ for _ in ()).throw(OSError("a")),
+            [lambda: (_ for _ in ()).throw(KeyError("b"))],
+            delay_s=0.01)
+
+
+# ------------------------------------------------- datapath integration
+class _SlowClient:
+    """Straggler injection at the client boundary: the in-process
+    equivalent of a net/partition delay rule or a FaultInjector
+    read-delay on the peer's disk — every read verb stalls delay_s."""
+
+    def __init__(self, inner, delay_s: float):
+        self._inner = inner
+        self.delay_s = delay_s
+        self.dn_id = inner.dn_id
+        self.read_calls = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def read_chunk(self, *a, **kw):
+        self.read_calls += 1
+        time.sleep(self.delay_s)
+        return self._inner.read_chunk(*a, **kw)
+
+    def read_chunks(self, *a, **kw):
+        self.read_calls += 1
+        time.sleep(self.delay_s)
+        return self._inner.read_chunks(*a, **kw)
+
+
+class _FlakyClient:
+    """Fail-the-first-N reads wrapper (the FaultInjector EIO /
+    partition drop_pct=100,count=N shape at the client boundary)."""
+
+    def __init__(self, inner, fail_first: int):
+        self._inner = inner
+        self.dn_id = inner.dn_id
+        self.remaining = fail_first
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _maybe_fail(self):
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise StorageError("UNAVAILABLE", "injected fault")
+
+    def read_chunk(self, *a, **kw):
+        self._maybe_fail()
+        return self._inner.read_chunk(*a, **kw)
+
+    def read_chunks(self, *a, **kw):
+        self._maybe_fail()
+        return self._inner.read_chunks(*a, **kw)
+
+    def get_block(self, *a, **kw):
+        self._maybe_fail()
+        return self._inner.get_block(*a, **kw)
+
+
+#: injected straggle per read verb — far above any P95 the registry
+#: learns from local reads, and generous enough that a hedged read
+#: under full-suite CPU contention (one-core rig) still finishes first
+STRAGGLE_S = 2.5
+
+
+def test_degraded_read_with_straggler_hedges_to_spare(tmp_path):
+    """Acceptance: one survivor delayed >= 10x P95 — the degraded read
+    hedges into the batched decode pipeline (straggler dropped for the
+    spare parity unit) and completes near healthy-path time with zero
+    errors surfaced."""
+    c = MiniEC(tmp_path, n_dn=6)
+    try:
+        rng = np.random.default_rng(0)
+        data = rng.integers(0, 256, 6 * 3 * CELL + 777, dtype=np.uint8)
+        groups = _write_key(c, data)
+        g = groups[0]
+        # degrade: wipe unit 0's replica
+        dn0 = next(d for d in c.dns if d.id == g.pipeline.nodes[0])
+        dn0.delete_container(g.container_id, force=True)
+
+        # healthy-path degraded read (no straggler) as the yardstick
+        t0 = time.monotonic()
+        healthy = c.reader(g).read_all()
+        healthy_s = time.monotonic() - t0
+
+        # inject the straggler on survivor unit 1 (>= 10x any P95 the
+        # registry has learned; local reads are sub-millisecond), and
+        # reset the health registry so hedge delays sit at the floor —
+        # write-time EWMA samples inflated by suite-load contention
+        # must not push the hedge window past the injected straggle
+        victim = g.pipeline.nodes[1]
+        slow = _SlowClient(c.clients.get(victim), STRAGGLE_S)
+        c.clients._local[victim] = slow
+        c.clients.health = resilience.HealthRegistry()
+
+        fired0 = resilience.METRICS.counter("hedges_fired").value
+        t0 = time.monotonic()
+        got = c.reader(g).read_all()
+        elapsed = time.monotonic() - t0
+
+        start = sum(gg.length for gg in groups[: groups.index(g)])
+        assert np.array_equal(got, data[start: start + g.length])
+        assert np.array_equal(healthy, got)
+        assert resilience.METRICS.counter("hedges_fired").value > fired0
+        # near healthy-path: far below the injected straggle, and
+        # within the 2x-healthy acceptance envelope (generous absolute
+        # floor for CI jitter on a loaded box)
+        assert elapsed < max(2 * healthy_s + 0.8, 1.5), \
+            f"straggler not hedged: {elapsed:.2f}s vs healthy {healthy_s:.2f}s"
+        assert elapsed < STRAGGLE_S
+    finally:
+        c.close()
+
+
+def test_normal_read_with_straggler_decodes_from_parity(tmp_path):
+    """A NON-degraded read with one slow data peer: the first cache-miss
+    cell's hedge races the fetch against decode-from-parity and wins;
+    the straggler is then excluded so the rest of its cells reconstruct
+    in one batched pass instead of re-paying a hedge window each."""
+    c = MiniEC(tmp_path, n_dn=6)
+    try:
+        rng = np.random.default_rng(1)
+        data = rng.integers(0, 256, 4 * 3 * CELL, dtype=np.uint8)
+        groups = _write_key(c, data)
+        g = groups[0]
+        # pre-compile the single-stripe decode program ([1, k, cell]
+        # shape) so the timed race below measures the hedge against the
+        # straggler, not XLA compile time on a contended CI core
+        c.reader(g).recover_cells([2], [0])
+        victim = g.pipeline.nodes[2]
+        c.clients._local[victim] = _SlowClient(
+            c.clients.get(victim), STRAGGLE_S)
+        # cold registry: hedge delays at the floor (see degraded test)
+        c.clients.health = resilience.HealthRegistry()
+
+        won0 = resilience.METRICS.counter("hedges_won").value
+        t0 = time.monotonic()
+        got = c.reader(g).read_all()
+        elapsed = time.monotonic() - t0
+        assert np.array_equal(got, data[: g.length])
+        assert resilience.METRICS.counter("hedges_won").value > won0
+        assert elapsed < STRAGGLE_S
+    finally:
+        c.close()
+
+
+def test_breaker_lifecycle_under_injected_faults(tmp_path):
+    """Satellite: breaker opens after N injected failures, the
+    half-open probe recovers the peer, and an open-breaker peer is
+    skipped by the EC writer's reallocation WITHOUT burning a retry
+    attempt."""
+    c = MiniEC(tmp_path, n_dn=6)
+    try:
+        c.clients.health = resilience.HealthRegistry(
+            open_after=2, reset_s=0.2)
+        h = c.clients.health
+        rng = np.random.default_rng(2)
+        data = rng.integers(0, 256, 4 * 3 * CELL, dtype=np.uint8)
+        groups = _write_key(c, data)
+        g = groups[0]
+        victim = g.pipeline.nodes[1]
+        flaky = _FlakyClient(c.clients.get(victim), fail_first=2)
+        c.clients._local[victim] = flaky
+
+        # each degraded read consumes one injected fault (the reader
+        # excludes the peer after its FIRST failure and reconstructs,
+        # so both reads still succeed byte-exact); two consecutive
+        # failures trip the breaker
+        for _ in range(2):
+            got = c.reader(g).read_all()
+            assert np.array_equal(got, data[: g.length])
+        assert h.is_open(victim)
+
+        # open-breaker peer is excluded AT ALLOCATION (no retry burned)
+        seen_excluded: list[list[str]] = []
+        orig_allocate = c.allocate
+
+        def spy_allocate(excluded):
+            seen_excluded.append(list(excluded))
+            return orig_allocate(excluded)
+
+        c.allocate = spy_allocate
+        w = c.writer()
+        w.write(rng.integers(0, 256, 3 * CELL, dtype=np.uint8))
+        new_groups = w.close()
+        assert all(victim in ex for ex in seen_excluded)
+        assert all(victim not in ng.pipeline.nodes
+                   for ng in new_groups)
+
+        # half-open probe recovers the peer (faults exhausted)
+        time.sleep(0.25)
+        h.observe(victim, flaky.get_block, g.block_id)  # the probe
+        assert not h.is_open(victim)
+        assert h.allow(victim)
+        got = c.reader(g).read_all()  # peer serves traffic again
+        assert np.array_equal(got, data[: g.length])
+    finally:
+        c.close()
+
+
+def test_expired_deadline_surfaces_deadline_exceeded(tmp_path):
+    """A spent operation budget must surface as DEADLINE_EXCEEDED, not
+    be swallowed by availability catch-alls and re-read as 'every unit
+    unreachable' (a false InsufficientLocations verdict)."""
+    c = MiniEC(tmp_path, n_dn=6)
+    try:
+        rng = np.random.default_rng(5)
+        data = rng.integers(0, 256, 4 * 3 * CELL, dtype=np.uint8)
+        groups = _write_key(c, data)
+        with resilience.start("op", 30.0) as d:
+            d.t_end = time.monotonic() - 1.0  # force-expire
+            with pytest.raises(StorageError) as ei:
+                c.reader(groups[0]).read_all()
+        assert ei.value.code == resilience.DEADLINE_EXCEEDED
+    finally:
+        c.close()
+
+
+def test_resilience_metrics_in_prometheus_text():
+    resilience.METRICS.counter("hedges_fired").inc(0)
+    resilience.METRICS.counter("breaker_opened").inc(0)
+    resilience.METRICS.counter("deadline_exceeded").inc(0)
+    text = prometheus_text()
+    for m in ("client_resilience_hedges_fired",
+              "client_resilience_breaker_opened",
+              "client_resilience_deadline_exceeded"):
+        assert m in text, m
+
+
+def test_native_dn_connect_timeout_is_deadline_derived(monkeypatch):
+    """Satellite: the hardcoded 120 s create_connection timeout is gone
+    — the connect timeout derives from env + remaining deadline, and a
+    spent budget refuses the connect outright."""
+    from ozone_tpu.client import native_dn
+
+    seen = {}
+
+    def fake_create_connection(addr, timeout=None):
+        seen["timeout"] = timeout
+        raise OSError("not actually connecting")
+
+    monkeypatch.setattr(native_dn.socket, "create_connection",
+                        fake_create_connection)
+    with pytest.raises(OSError):
+        native_dn._Conn("127.0.0.1", 1)
+    assert seen["timeout"] == pytest.approx(20.0)  # env default
+
+    monkeypatch.setenv("OZONE_TPU_CONNECT_TIMEOUT_S", "7.5")
+    with pytest.raises(OSError):
+        native_dn._Conn("127.0.0.1", 1)
+    assert seen["timeout"] == pytest.approx(7.5)
+
+    with resilience.start("op", 2.0):
+        with pytest.raises(OSError):
+            native_dn._Conn("127.0.0.1", 1)
+        assert seen["timeout"] <= 2.0
+        time.sleep(0.01)
+        with resilience.start("inner") as d:
+            d.t_end = time.monotonic() - 1  # force-expire
+            with pytest.raises(StorageError) as ei:
+                native_dn._Conn("127.0.0.1", 1)
+            assert ei.value.code == resilience.DEADLINE_EXCEEDED

@@ -405,6 +405,44 @@ def test_freon_fsg_and_sdg(cluster):
     assert rep2.summary()["failures"] == 0
 
 
+def test_resilience_lint_no_hardcoded_timeouts_or_retry_sleeps():
+    """Repo lint: straggler tolerance lives in client/resilience.py —
+    a NEW hardcoded socket timeout (the old native_dn 120 s literal
+    class of bug) or a bare time.sleep retry loop in the client layer
+    bypasses deadlines/jitter and fails this test. Deliberate
+    exceptions (injected chaos latency) carry a
+    `# resilience-lint: allow` marker."""
+    import re
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parent.parent / "ozone_tpu"
+    # NB: `.*` (not `[^)]*`) so the pattern crosses the address tuple's
+    # closing paren in `create_connection((host, port), timeout=120.0)`
+    pat_timeout = re.compile(
+        r"(create_connection\(.*timeout\s*=\s*\d"
+        r"|\.settimeout\(\s*\d)")
+    pat_sleep = re.compile(r"\btime\.sleep\(")
+    offenders: list[str] = []
+    for p in sorted(root.rglob("*.py")):
+        if p.name == "resilience.py":
+            continue
+        rel = p.relative_to(root.parent)
+        in_client = p.parent.name == "client"
+        for i, line in enumerate(p.read_text().splitlines(), 1):
+            if "resilience-lint: allow" in line:
+                continue
+            if pat_timeout.search(line):
+                offenders.append(
+                    f"{rel}:{i}: hardcoded socket timeout — derive it "
+                    f"from resilience.op_timeout()")
+            if in_client and pat_sleep.search(line):
+                offenders.append(
+                    f"{rel}:{i}: bare time.sleep in the client layer — "
+                    f"retry/backoff sleeps must ride "
+                    f"resilience.RetryPolicy")
+    assert not offenders, "\n".join(offenders)
+
+
 def test_cli_version_and_getconf(capsys):
     assert cli_main(["version"]) == 0
     out = json.loads(capsys.readouterr().out)
