@@ -559,12 +559,19 @@ class S3Gateway:
         if method == "GET" and "uploads" in q:
             self._list_uploads(h, bucket, q)
             return
+        if "lifecycle" in q:
+            # Put/Get/DeleteBucketLifecycleConfiguration, backed by the
+            # OM's replicated bucket metadata + the lifecycle sweeper
+            # (lifecycle/policy.py) — a deliberate extension beyond
+            # Apache Ozone 1.5, which answers 501 here
+            self._bucket_lifecycle_op(h, method, bucket)
+            return
         # subresources the store does not implement answer the AWS way
         # (501 NotImplemented, like the reference's unsupported-feature
         # responses) instead of falling through to bucket create/list —
-        # a silent 200 would make `aws s3api put-bucket-lifecycle`
+        # a silent 200 would make `aws s3api put-bucket-policy`
         # look like it took effect
-        for sub in ("lifecycle", "policy", "website", "cors",
+        for sub in ("policy", "website", "cors",
                     "replication", "encryption", "accelerate",
                     "requestPayment", "logging", "notification",
                     "inventory", "analytics", "metrics", "intelligent-tiering",
@@ -597,6 +604,60 @@ class S3Gateway:
         elif method == "HEAD":
             om.bucket_info(self._vol, bucket)
             h._reply(200)
+        else:
+            h._reply(*_err("MethodNotAllowed", method, 405))
+
+    def _bucket_lifecycle_op(self, h, method: str, bucket: str) -> None:
+        """?lifecycle subresource: PUT parses the AWS
+        LifecycleConfiguration XML into the internal rule model (warm
+        storage classes map to this gateway's EC scheme), GET renders
+        the stored rules back, DELETE clears them. Rules persist in OM
+        bucket metadata; the background sweeper enforces them."""
+        from ozone_tpu.lifecycle.policy import (
+            LifecycleError,
+            rules_from_s3_xml,
+            rules_to_s3_xml,
+        )
+
+        from ozone_tpu.scm.pipeline import (
+            ReplicationConfig,
+            ReplicationType,
+        )
+
+        # warm storage classes map to this gateway's scheme when it IS
+        # an RS scheme; a replicated-default gateway tiers to the
+        # cluster-default EC layout
+        try:
+            conf = ReplicationConfig.parse(self.replication)
+            default = (self.replication
+                       if conf.type is ReplicationType.EC
+                       and conf.ec.codec == "rs" else "rs-6-3-1024k")
+        except ValueError:
+            default = "rs-6-3-1024k"
+        om = self.client.om
+        if method in ("PUT", "POST", "DELETE"):
+            body = h._body()  # drain before any raising call
+        if method == "PUT":
+            try:
+                rules = rules_from_s3_xml(body, default_target=default)
+            except LifecycleError as e:
+                h._reply(*_err("MalformedXML", str(e), 400))
+                return
+            om.set_bucket_lifecycle(self._vol, bucket, rules)
+            h._reply(200)
+        elif method == "GET":
+            rules = om.get_bucket_lifecycle(self._vol, bucket)
+            if not rules:
+                om.bucket_info(self._vol, bucket)  # NoSuchBucket -> 404
+                h._reply(*_err(
+                    "NoSuchLifecycleConfiguration",
+                    "The lifecycle configuration does not exist", 404))
+                return
+            h._reply(200, rules_to_s3_xml(rules),
+                     {"Content-Type": "application/xml"})
+        elif method == "DELETE":
+            om.delete_bucket_lifecycle(self._vol, bucket)
+            h._reply(204)
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
 

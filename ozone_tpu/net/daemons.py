@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from pathlib import Path
@@ -786,6 +787,43 @@ class ScmOmDaemon:
             addresses_provider=lambda: dict(self.scm_service.addresses),
             locations_provider=self.scm_service.node_locations,
         )
+        # lifecycle sweeper (lifecycle/service.py): leader-singleton on
+        # the metadata ring, term-fenced with the ring's raft term; its
+        # datanode clients resolve lazily from heartbeat-learned
+        # addresses. OZONE_TPU_LIFECYCLE_MBPS throttles source reads so
+        # tiering never starves foreground traffic.
+        from ozone_tpu.lifecycle.service import LifecycleService
+
+        self._lifecycle_clients = None
+        lc_throttle = None
+        from ozone_tpu.utils.config import env_float
+
+        mbps = env_float("OZONE_TPU_LIFECYCLE_MBPS", 0.0)
+        if mbps > 0:
+            from ozone_tpu.utils.throttle import Throttle
+
+            lc_throttle = Throttle(mbps * 1024 * 1024,
+                                   metrics=self.om.metrics)
+        lc_deadline = env_float("OZONE_TPU_LIFECYCLE_DEADLINE_S",
+                                30.0)
+        self.lifecycle = LifecycleService(
+            self.om,
+            clients_fn=self._lifecycle_client_factory,
+            term_fn=lambda: (self.ha.node.storage.term
+                             if self.ha is not None else 0),
+            leader_fn=lambda: (self.ha.is_ready
+                               if self.ha is not None else True),
+            throttle=lc_throttle,
+            # tighter default than the standalone service's 300 s: the
+            # daemon's sweep shares the OM background loop with key
+            # deletion AND raft log compaction — a long sweep stalling
+            # compaction lets the log grow without bound (the cursor
+            # makes short bounded sweeps equivalent anyway)
+            sweep_deadline_s=lc_deadline,
+            alloc_barrier=lambda: (self.ha._await_records()
+                                   if self.ha is not None else None),
+        )
+        self.om.lifecycle = self.lifecycle
         # ---- metadata HA: one raft ring for OM + SCM state ----
         # (the reference's OM-HA + SCM-HA Ratis rings; co-located here,
         # so one ring and one leader for both roles)
@@ -990,6 +1028,22 @@ class ScmOmDaemon:
         self.scm_service.ring_provider = \
             lambda: [a for a in self._ha_peers.values() if a]
 
+    def _lifecycle_client_factory(self) -> DatanodeClientFactory:
+        """Datanode clients for the lifecycle executor, refreshed from
+        heartbeat-learned addresses before each sweep (daemons learn
+        datanodes after construction, so resolution must be lazy)."""
+        if self._lifecycle_clients is None:
+            f = DatanodeClientFactory()
+            f.tls = self.tls
+            if self.om.token_issuer is not None:
+                f.tokens.issuer = self.om.token_issuer
+            self._lifecycle_clients = f
+        for dn_id, addr in dict(self.scm_service.addresses).items():
+            # update, not register: re-registering an unchanged address
+            # would drop the pooled connection every sweep
+            self._lifecycle_clients.update_remote(dn_id, addr)
+        return self._lifecycle_clients
+
     def _leader_gate(self) -> None:
         # ready-leader, not just leader: a freshly elected leader must
         # apply the prior terms' committed entries (its no-op marker)
@@ -1023,6 +1077,13 @@ class ScmOmDaemon:
         # loop in HA mode so it obeys the same leadership gate.
         self._om_bg_stop = threading.Event()
         self._om_bg_ticks = 0
+        # lifecycle sweep cadence (seconds between sweep starts);
+        # OZONE_TPU_LIFECYCLE_PERIOD_S overrides
+        from ozone_tpu.utils.config import env_float
+
+        self._lc_period = env_float("OZONE_TPU_LIFECYCLE_PERIOD_S",
+                                    60.0)
+        self._lc_last = time.monotonic()
 
         def _om_services():
             while not self._om_bg_stop.wait(self._bg_interval):
@@ -1055,6 +1116,16 @@ class ScmOmDaemon:
                         self.om.run_open_key_cleanup_once()
                         self.om.run_mpu_cleanup_once()
                         self.om.run_dtoken_cleanup_once()
+                    # lifecycle sweep: leader-gated + term-fenced
+                    # internally; no-rule clusters scan nothing. Gated
+                    # by wall time, not ticks — test configs run this
+                    # loop at sub-second intervals, and sweeping every
+                    # few seconds would let background tiering compete
+                    # with foreground IO for the leader
+                    now_m = time.monotonic()
+                    if now_m - self._lc_last >= self._lc_period:
+                        self._lc_last = now_m
+                        self.lifecycle.run_once()
                     now = time.monotonic()
                     if self.recon is not None and \
                             now - self._recon_last >= self._recon_interval:

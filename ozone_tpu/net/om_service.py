@@ -318,6 +318,22 @@ class OmGrpcService:
                         m.get("volume", ""), m.get("bucket", ""),
                         m.get("prefix", ""), m.get("start_after", ""),
                         m.get("limit", 100))),
+                # bucket lifecycle (tiering extension; no reference
+                # analog — Apache Ozone 1.5 has no bucket lifecycle)
+                "SetBucketLifecycle": self._wrap(
+                    lambda m: self.om.set_bucket_lifecycle(
+                        m["volume"], m["bucket"], m["rules"])),
+                "GetBucketLifecycle": self._wrap(
+                    lambda m: self.om.get_bucket_lifecycle(
+                        m["volume"], m["bucket"])),
+                "DeleteBucketLifecycle": self._wrap(
+                    lambda m: self.om.delete_bucket_lifecycle(
+                        m["volume"], m["bucket"])),
+                "LifecycleStatus": self._wrap(
+                    lambda m: self.om.lifecycle_status()),
+                "LifecycleRunNow": self._wrap(
+                    lambda m: self.om.run_lifecycle_once(
+                        m.get("max_keys"))),
                 "GetDelegationToken": self._wrap(
                     lambda m: self.om.get_delegation_token(m["renewer"])),
                 "RenewDelegationToken": self._wrap(
@@ -567,11 +583,15 @@ class GrpcOmClient:
                 if e.code == "OM_NOT_LEADER":
                     # msg carries the leader address when known
                     self._pool.follow_hint(e.msg)
-                elif e.code == "UNAVAILABLE" and len(self.addresses) > 1:
-                    # replica unreachable: rotate. Server-side errors
+                elif e.code == "UNAVAILABLE":
+                    # replica unreachable: drop its (possibly wedged)
+                    # channel and rotate. Server-side errors
                     # (IO_EXCEPTION and application codes) surface —
                     # blind retry would re-execute non-idempotent writes
                     # and mask the real failure
+                    self._pool.invalidate(addr)
+                    if len(self.addresses) == 1:
+                        raise
                     self._pool.rotate()
                 else:
                     raise
@@ -784,6 +804,24 @@ class GrpcOmClient:
     def set_bucket_replication(self, volume, bucket, replication):
         return self._call("SetBucketReplication", volume=volume,
                           bucket=bucket, replication=replication)["result"]
+
+    # bucket lifecycle (tiering extension)
+    def set_bucket_lifecycle(self, volume, bucket, rules):
+        return self._call("SetBucketLifecycle", volume=volume,
+                          bucket=bucket, rules=rules)["result"]
+
+    def get_bucket_lifecycle(self, volume, bucket):
+        return self._call("GetBucketLifecycle", volume=volume,
+                          bucket=bucket)["result"]
+
+    def delete_bucket_lifecycle(self, volume, bucket):
+        self._call("DeleteBucketLifecycle", volume=volume, bucket=bucket)
+
+    def lifecycle_status(self):
+        return self._call("LifecycleStatus")["result"]
+
+    def run_lifecycle_once(self, max_keys=None):
+        return self._call("LifecycleRunNow", max_keys=max_keys)["result"]
 
     def list_open_files(self, volume="", bucket="", prefix="",
                         start_after="", limit=100):

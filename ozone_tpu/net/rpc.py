@@ -226,6 +226,16 @@ class RpcChannel:
         options = [
             ("grpc.max_send_message_length", 128 * 1024 * 1024),
             ("grpc.max_receive_message_length", 128 * 1024 * 1024),
+            # bounded reconnect backoff: a channel dialed BEFORE its
+            # server binds (launcher supervisors, HA rings booting,
+            # fail-fast polls against a jax-importing daemon) must not
+            # back off past the caller's whole readiness window — the
+            # grpc default doubles toward 120 s, which made "poll until
+            # the subprocess answers" loops miss servers that had been
+            # up for a minute (the acceptance launcher flake)
+            ("grpc.initial_reconnect_backoff_ms", 250),
+            ("grpc.min_reconnect_backoff_ms", 250),
+            ("grpc.max_reconnect_backoff_ms", 2000),
         ]
         if tls is not None:
             # daemons dial by IP:port while certs carry role + localhost
@@ -238,11 +248,21 @@ class RpcChannel:
         else:
             self._channel = grpc.insecure_channel(address, options=options)
         self._calls: dict[str, Callable] = {}
+        #: True once ANY call on this channel succeeded; a channel that
+        #: never connected is the wedge-prone kind FailoverChannels
+        #: .invalidate drops, while a once-healthy channel rides grpc's
+        #: own reconnection through transient failures
+        self.ever_connected = False
 
     def _map_rpc_error(self, key: str, e: grpc.RpcError):
         detail = e.details() or ""
         try:
             d = json.loads(detail)
+            # a JSON-detail error was PRODUCED BY THE SERVER: the
+            # connection works (a follower answering OM_NOT_LEADER
+            # forever must not look "never connected" to
+            # FailoverChannels.invalidate)
+            self.ever_connected = True
             return StorageError(d.get("code", "IO_EXCEPTION"),
                                 d.get("message", detail))
         except (ValueError, KeyError):
@@ -336,12 +356,15 @@ class RpcChannel:
             self._calls[key] = fn
         try:
             if not self.traced:
-                return fn(request, timeout=timeout)
-            tracer = Tracer.instance()
-            with tracer.span(f"client:{key}", address=self.address):
-                ctx = tracer.inject()
-                metadata = (("x-trace-id", ctx),) if ctx else None
-                return fn(request, timeout=timeout, metadata=metadata)
+                out = fn(request, timeout=timeout)
+            else:
+                tracer = Tracer.instance()
+                with tracer.span(f"client:{key}", address=self.address):
+                    ctx = tracer.inject()
+                    metadata = (("x-trace-id", ctx),) if ctx else None
+                    out = fn(request, timeout=timeout, metadata=metadata)
+            self.ever_connected = True
+            return out
         except grpc.RpcError as e:
             raise self._map_rpc_error(key, e) from e
 
@@ -397,6 +420,33 @@ class FailoverChannels:
     def rotate(self) -> None:
         with self._lock:
             self._idx = (self._idx + 1) % len(self.addresses)
+
+    def invalidate(self, addr: str) -> None:
+        """Drop AND close the cached channel for an UNREACHABLE
+        replica: a channel dialed before its server ever bound can
+        wedge in permanent TRANSIENT_FAILURE (fail-fast calls starving
+        the subchannel's reconnect — observed against daemons whose jax
+        import delays the bind by tens of seconds); recreating it on
+        the next attempt reconnects instantly, which is what makes
+        poll-until-up supervisor loops converge. Closing (not parking)
+        is safe here: the channel is unreachable, so a concurrent
+        in-flight RPC on it can only be waiting to fail — the close
+        surfaces that as a clean rotate-and-retry, and parking one
+        channel per poll tick would leak sockets for the whole wait.
+
+        Only NEVER-connected channels are dropped: a once-healthy
+        channel hitting a transient failure (a partition, a restart)
+        recovers through grpc's own reconnection, and recreating it per
+        failed call would churn sockets for the whole outage."""
+        with self._lock:
+            ch = self._chs.get(addr)
+            if ch is None or ch.ever_connected:
+                return
+            del self._chs[addr]
+        try:
+            ch.close()
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
 
     def reconcile(self, ring: list) -> None:
         """Adopt a server-shipped membership as the address list (online

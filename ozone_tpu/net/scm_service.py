@@ -458,7 +458,12 @@ class GrpcScmClient:
                 last = e
                 if e.code == "SCM_NOT_LEADER":
                     self._pool.follow_hint(e.msg)
-                elif e.code == "UNAVAILABLE" and len(self.addresses) > 1:
+                elif e.code == "UNAVAILABLE":
+                    # drop the (possibly wedged) channel so the next
+                    # attempt redials — see FailoverChannels.invalidate
+                    self._pool.invalidate(addr)
+                    if len(self.addresses) == 1:
+                        raise
                     self._pool.rotate()
                 else:
                     raise
@@ -473,18 +478,21 @@ class GrpcScmClient:
         blackholed replica must cost one timeout in parallel, not one
         per replica per heartbeat."""
         payload = wire.pack(meta)
-        if len(self.addresses) == 1:
-            addr, ch = self._pool.channel(self.addresses[0])
-            m, _ = wire.unpack(ch.call(SERVICE, method, payload,
-                                       timeout=timeout))
-            return [m]
-        from concurrent.futures import ThreadPoolExecutor
 
         def one(addr):
             _, ch = self._pool.channel(addr)
-            m, _ = wire.unpack(ch.call(SERVICE, method, payload,
-                                       timeout=timeout))
+            try:
+                m, _ = wire.unpack(ch.call(SERVICE, method, payload,
+                                           timeout=timeout))
+            except StorageError as e:
+                if e.code == "UNAVAILABLE":
+                    self._pool.invalidate(addr)  # redial next beat
+                raise
             return m
+
+        if len(self.addresses) == 1:
+            return [one(self.addresses[0])]
+        from concurrent.futures import ThreadPoolExecutor
 
         out, last = [], None
         with ThreadPoolExecutor(max_workers=len(self.addresses)) as ex:

@@ -97,6 +97,10 @@ class OzoneManager:
         from ozone_tpu.om.snapshots import SnapshotDiffJobs
 
         self._diff_jobs = SnapshotDiffJobs(self)
+        # lifecycle sweeper (lifecycle/service.py): installed by the
+        # daemon under HA (term-fenced on the ring); lazily built with
+        # defaults by run_lifecycle_once on standalone OMs
+        self.lifecycle = None
 
     # ----------------------------------------------------------- acl/tenant
     def enable_acls(self, superusers=("root",)) -> None:
@@ -1041,6 +1045,51 @@ class OzoneManager:
 
     def get_bucket_acl(self, volume: str, bucket: str) -> list[dict]:
         return self.bucket_info(volume, bucket).get("acl", [])
+
+    # ----------------------------------------------------- bucket lifecycle
+    def set_bucket_lifecycle(self, volume: str, bucket: str,
+                             rules: list[dict]) -> dict:
+        """Install per-bucket lifecycle rules (S3
+        PutBucketLifecycleConfiguration analog): prefix + age_days +
+        action (TRANSITION_TO_EC(scheme) | EXPIRE), persisted in bucket
+        metadata through the replicated ring (lifecycle/policy.py)."""
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "WRITE")
+        return self.submit(rq.SetBucketLifecycle(volume, bucket, rules))
+
+    def get_bucket_lifecycle(self, volume: str, bucket: str) -> list[dict]:
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        return self.bucket_info(volume, bucket).get("lifecycle", [])
+
+    def delete_bucket_lifecycle(self, volume: str, bucket: str) -> None:
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "WRITE")
+        self.submit(rq.DeleteBucketLifecycle(volume, bucket))
+
+    def lifecycle_status(self) -> dict:
+        """Sweeper state (fencing term, cursor, last stats) + live
+        counters — the `lifecycle status` CLI / Recon panel view."""
+        from ozone_tpu.utils.metrics import get_registry
+
+        row = self.store.get("system", "lifecycle_state") or {}
+        reg = get_registry("lifecycle")
+        return {
+            "term": row.get("term"),
+            "cursor": row.get("cursor") or {},
+            "stats": row.get("stats") or {},
+            "in_progress": bool(row.get("cursor")),
+            "metrics": reg.snapshot() if reg is not None else {},
+        }
+
+    def run_lifecycle_once(self, max_keys: Optional[int] = None) -> dict:
+        """Trigger one lifecycle sweep (the `lifecycle run-now` verb).
+        Uses the daemon-installed service when present (term-fenced on
+        the HA ring); standalone OMs get a local default service."""
+        if getattr(self, "lifecycle", None) is None:
+            from ozone_tpu.lifecycle.service import LifecycleService
+
+            self.lifecycle = LifecycleService(self, clients=self.clients)
+        return self.lifecycle.run_once(max_keys=max_keys)
 
     # ----------------------------------------------------- multipart upload
     def initiate_multipart_upload(

@@ -986,12 +986,17 @@ def erase_gdpr_secret(info: dict) -> None:
 @dataclass
 class DeleteKey(OMRequest):
     """Move a key to the deleted table for async purge (OMKeyDeleteRequest +
-    KeyDeletingService pattern)."""
+    KeyDeletingService pattern). `expect_object_id` ("" = unfenced) makes
+    the delete conditional on the live row still being the scanned
+    version — the lifecycle sweeper's TTL expiration uses it so a user
+    overwrite racing the sweep always wins (same contract as the
+    transition path's rewrite fence)."""
 
     volume: str
     bucket: str
     key: str
     ts: float = 0.0
+    expect_object_id: str = ""
 
     def pre_execute(self, om) -> None:
         self.ts = time.time()
@@ -1001,6 +1006,10 @@ class DeleteKey(OMRequest):
         info = store.get("keys", kk)
         if info is None:
             raise OMError(KEY_NOT_FOUND, kk)
+        if self.expect_object_id and \
+                info.get("object_id") != self.expect_object_id:
+            raise OMError(KEY_MODIFIED,
+                          f"{kk} overwritten since the expiry scan")
         preserve_preimage(store, self.volume, self.bucket, kk)
         store.delete("keys", kk)
         # deleting a live hsync stream: fence its writer before the blocks
@@ -1441,6 +1450,107 @@ class RevokeUserAccessId(OMRequest):
             raise OMError(ACCESS_ID_NOT_FOUND, self.access_id)
         store.delete("tenant_access", self.access_id)
         store.delete("s3_secrets", self.access_id)
+
+
+LIFECYCLE_FENCED = "LIFECYCLE_FENCED"
+NO_SUCH_LIFECYCLE = "NO_SUCH_LIFECYCLE"
+
+
+@dataclass
+class SetBucketLifecycle(OMRequest):
+    """Install a bucket's lifecycle rules (the S3
+    PutBucketLifecycleConfiguration analog; Apache Ozone 1.5 has no
+    bucket lifecycle — this is the tiering extension's policy store).
+    Rules ride the bucket row, so they replicate through the metadata
+    ring and survive failover like every other bucket property."""
+
+    volume: str
+    bucket: str
+    rules: list = field(default_factory=list)
+
+    def pre_execute(self, om) -> None:
+        from ozone_tpu.lifecycle.policy import (
+            LifecycleError,
+            validate_rules,
+        )
+
+        try:
+            self.rules = validate_rules(self.rules)
+        except LifecycleError as e:
+            raise OMError(INVALID_REQUEST, str(e))
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", k)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, k)
+        if b.get("layout") == "FILE_SYSTEM_OPTIMIZED":
+            # the sweeper evaluates prefix rules over the flat key scan;
+            # FSO namespaces are id-keyed, so accepting rules here would
+            # configure a silent no-op (deterministic rejection instead)
+            raise OMError(
+                INVALID_REQUEST,
+                "lifecycle rules are not supported on "
+                "FILE_SYSTEM_OPTIMIZED buckets (docs/OPERATIONS.md)")
+        b["lifecycle"] = list(self.rules)
+        store.put("buckets", k, b)
+        return b
+
+
+@dataclass
+class DeleteBucketLifecycle(OMRequest):
+    volume: str
+    bucket: str
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", k)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, k)
+        b.pop("lifecycle", None)
+        store.put("buckets", k, b)
+        return b
+
+
+@dataclass
+class LifecycleCheckpoint(OMRequest):
+    """Lifecycle sweeper state: fencing term + resumable scan cursor,
+    committed through the ring so a restarted or failed-over sweeper
+    resumes exactly where the last durable checkpoint left off.
+
+    Term fencing (the scm/sequence_id.py commit-first treatment applied
+    to a background service): a `fence` checkpoint claims the sweeper
+    role for `term` and is rejected if a HIGHER term already claimed
+    it; a plain checkpoint is rejected unless its term IS the fenced
+    term. Every replica applies the same deterministic rejection, so a
+    deposed lifecycle leader's late cursor commits can never regress or
+    double-apply the scan — kill -9 of the leader mid-sweep loses at
+    most one un-checkpointed page, which re-scans idempotently."""
+
+    term: int
+    cursor: dict = field(default_factory=dict)
+    stats: dict = field(default_factory=dict)
+    fence: bool = False
+
+    def apply(self, store):
+        row = store.get("system", "lifecycle_state") or {"term": -1}
+        fenced = int(row.get("term", -1))
+        if self.fence:
+            if int(self.term) < fenced:
+                raise OMError(
+                    LIFECYCLE_FENCED,
+                    f"fence term {self.term} < current {fenced}")
+            row["term"] = int(self.term)
+        else:
+            if int(self.term) != fenced:
+                raise OMError(
+                    LIFECYCLE_FENCED,
+                    f"checkpoint term {self.term} != fenced {fenced}")
+            row["cursor"] = dict(self.cursor)
+            if self.stats:
+                row["stats"] = dict(self.stats)
+        store.put("system", "lifecycle_state", row)
+        return dict(row)
 
 
 @dataclass

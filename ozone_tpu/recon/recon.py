@@ -722,6 +722,9 @@ class ReconServer:
                         recon.insights.open_keys,
                     "/api/insights/deleted_keys":
                         recon.insights.deleted_keys,
+                    # lifecycle sweeper panel: fencing term, cursor,
+                    # last-sweep stats + live tiering counters
+                    "/api/lifecycle": recon.lifecycle_view,
                 }
                 fn = routes.get(path)
                 if fn is not None:
@@ -753,6 +756,20 @@ class ReconServer:
         with self._scan_lock:
             self._scan_cache[key] = (time.monotonic(), val)
         return val
+
+    def lifecycle_view(self) -> dict:
+        """Lifecycle sweeper status + per-bucket rule census for the
+        dashboard panel (tiering is the main background consumer of
+        device cycles, so operators watch it next to container
+        health)."""
+        out = self.tasks.om.lifecycle_status()
+        buckets = []
+        for bk, brow in self.tasks.om.store.iterate("buckets"):
+            rules = brow.get("lifecycle") or []
+            if rules:
+                buckets.append({"bucket": bk, "rules": rules})
+        out["buckets"] = buckets
+        return out
 
     def api_summary(self) -> dict:
         health = self.scm_view.container_health()

@@ -191,6 +191,16 @@ RECON_INDEX_HTML = """<!doctype html>
     </table>
   </details>
 
+  <h2>Lifecycle tiering</h2>
+  <div class="sub">hot&rarr;warm sweeper (replicated&rarr;EC on device
+    + TTL expiry): fencing term, sweep cursor, and live counters</div>
+  <div class="tiles" id="lifecycle-tiles"></div>
+  <table id="lifecycle-rules">
+    <thead><tr><th>bucket</th><th>rule</th><th>prefix</th>
+      <th>age (days)</th><th>action</th></tr></thead>
+    <tbody></tbody>
+  </table>
+
   <h2>Container &rarr; keys</h2>
   <div class="sub">which keys reference a container (the reference's
     ContainerKeyMapper view) &mdash; enter a container id</div>
@@ -324,6 +334,24 @@ async function refresh() {
                 `<td>${esc(r.blocks)}</td><td>${esc(r.pending_s ?? "")}` +
                 `</td></tr>`).join("") ||
       '<tr><td colspan="4">purge chain empty</td></tr>';
+    const lc = await (await fetch("/api/lifecycle")).json();
+    const lm = lc.metrics || {};
+    document.getElementById("lifecycle-tiles").innerHTML = [
+      tile("sweeper", lc.in_progress ? "sweeping"
+                                     : (lc.term == null ? "idle (never "
+                                        + "run)" : "idle")),
+      tile("keys scanned", lm.keys_scanned ?? 0),
+      tile("transitions", lm.transitions ?? 0),
+      tile("bytes tiered", fmtBytes(lm.bytes_tiered ?? 0)),
+      tile("expirations", lm.expirations ?? 0),
+      tile("leader fences", lm.leader_fences ?? 0),
+    ].join("");
+    document.querySelector("#lifecycle-rules tbody").innerHTML =
+      (lc.buckets || []).flatMap(b => (b.rules || []).map(r =>
+        `<tr><td>${esc(b.bucket)}</td><td>${esc(r.id)}</td>` +
+        `<td>${esc(r.prefix)}</td><td>${esc(r.age_days)}</td>` +
+        `<td>${esc(r.action)}</td></tr>`)).join("") ||
+      '<tr><td colspan="5">no lifecycle rules configured</td></tr>';
     const uh = await (await fetch("/api/containers/unhealthy")).json();
     document.querySelector("#unhealthy tbody").innerHTML = uh
       .map(r => `<tr><td>${esc(r.container)}</td>` +

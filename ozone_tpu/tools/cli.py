@@ -770,6 +770,13 @@ def cmd_freon(args) -> int:
             args.endpoint, n_keys=args.num, size=args.size,
             threads=args.threads, validate=args.validate,
         ).summary())
+    elif args.generator == "lcg":
+        oz = _client(args)
+        _emit(freon.lcg(
+            oz, n_keys=args.num, size=args.size, threads=args.threads,
+            replication=args.replication or "RATIS/THREE",
+            target=args.target,
+        ).summary())
     elif args.generator == "hsg":
         oz = _client(args)
         _emit(freon.hsg(
@@ -1252,6 +1259,61 @@ def cmd_repair(args) -> int:
     return 0
 
 
+def cmd_lifecycle(args) -> int:
+    """Bucket lifecycle admin (`lifecycle set/get/clear/run-now/status`):
+    age-based hot->warm tiering rules (replicated -> EC on device) and
+    TTL expiry, enforced by the leader-singleton sweeper. A deliberate
+    extension beyond Apache Ozone 1.5 (docs/PARITY.md)."""
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    def usage(msg: str) -> int:
+        print(f"error: {msg}", file=sys.stderr)
+        return 2
+
+    om = GrpcOmClient(args.om, tls=_client_tls())
+    verb = args.verb
+    if verb in ("run-now", "status"):
+        if verb == "run-now":
+            _emit(om.run_lifecycle_once(args.max_keys))
+        else:
+            _emit(om.lifecycle_status())
+        return 0
+    if not args.path:
+        return usage(f"lifecycle {verb} needs a /volume/bucket path")
+    parts = _parse_path(args.path)
+    if len(parts) != 2:
+        return usage(f"expected /volume/bucket, got {args.path!r}")
+    vol, bucket = parts
+    if verb == "get":
+        _emit(om.get_bucket_lifecycle(vol, bucket))
+    elif verb == "clear":
+        om.delete_bucket_lifecycle(vol, bucket)
+        print(f"lifecycle cleared on /{vol}/{bucket}")
+    elif verb == "set":
+        action = {"transition": "TRANSITION_TO_EC",
+                  "expire": "EXPIRE"}.get(args.action)
+        if action is None:
+            return usage(f"unknown action {args.action!r} "
+                         "(expected transition|expire)")
+        rules = (om.get_bucket_lifecycle(vol, bucket)
+                 if args.append else [])
+        rule = {
+            "id": args.id or f"rule-{len(rules)}",
+            "prefix": args.prefix,
+            "age_days": args.age_days,
+            "action": action,
+            "enabled": True,
+        }
+        if action == "TRANSITION_TO_EC":
+            rule["target"] = args.target
+        rules = [*rules, rule]
+        _emit(om.set_bucket_lifecycle(vol, bucket,
+                                      rules).get("lifecycle", []))
+    else:
+        return usage(f"unknown lifecycle verb {verb!r}")
+    return 0
+
+
 def cmd_version(args) -> int:
     """`ozone version` analog: framework + runtime stack versions.
     Must ALWAYS succeed — device discovery initializes the JAX backend,
@@ -1417,6 +1479,31 @@ def build_parser() -> argparse.ArgumentParser:
                     help="balancer start: bytes moved per iteration")
     ad.set_defaults(fn=cmd_admin)
 
+    lc = sub.add_parser("lifecycle",
+                        help="bucket lifecycle: age-based tiering "
+                             "(replicated->EC) + TTL expiry")
+    lc.add_argument("verb", choices=["set", "get", "clear", "run-now",
+                                     "status"])
+    lc.add_argument("path", nargs="?", default="",
+                    help="/volume/bucket (set/get/clear)")
+    lc.add_argument("--om", default="127.0.0.1:9860")
+    lc.add_argument("--prefix", default="",
+                    help="set: key-name prefix filter")
+    lc.add_argument("--age-days", type=float, default=0.0,
+                    help="set: minimum age before the action applies")
+    lc.add_argument("--action", default="transition",
+                    help="set: transition (replicated->EC) or expire")
+    lc.add_argument("--target", default="rs-6-3-1024k",
+                    help="set: EC scheme for transition rules")
+    lc.add_argument("--id", default="",
+                    help="set: rule id (default rule-<n>)")
+    lc.add_argument("--append", action="store_true",
+                    help="set: append to existing rules instead of "
+                         "replacing them")
+    lc.add_argument("--max-keys", type=int, default=None,
+                    help="run-now: bound the sweep's scan")
+    lc.set_defaults(fn=cmd_lifecycle)
+
     fr = sub.add_parser("freon", help="load generators")
     fr.add_argument("generator",
                     choices=["ockg", "ockr", "ockrr", "ockv", "ecrd",
@@ -1424,7 +1511,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
                              "dcb", "dcv", "dsg", "hsg", "dnbp", "ralg",
                              "fskg", "mpug", "s3kg", "fsg", "sdg",
-                             "dnsim"])
+                             "dnsim", "lcg"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("--keys", type=int, default=1,
@@ -1443,6 +1530,8 @@ def build_parser() -> argparse.ArgumentParser:
     fr.add_argument("--batch", type=int, default=8)
     fr.add_argument("--mix", default="crudl",
                     help="ommg op mix (c/r/u/d/l per char)")
+    fr.add_argument("--target", default="rs-3-2-4096",
+                    help="lcg: EC scheme the lifecycle rule tiers to")
     fr.add_argument("--root", default="",
                     help="local path for cmdw/dbgen")
     fr.add_argument("--containers", type=int, default=5,

@@ -209,6 +209,62 @@ def hsg(
     return BaseFreonGenerator("hsg", n_keys, threads).run(op)
 
 
+def lcg(client, n_keys: int = 20, size: int = 10 * 1024,
+        threads: int = 4, volume: str = "freon-vol",
+        bucket: str = "freon-tier", replication: str = "RATIS/THREE",
+        target: str = "rs-3-2-4096", prefix: str = "tier",
+        age_days: float = 0.0) -> FreonReport:
+    """Lifecycle-churn workload (write -> age -> sweep -> verify): the
+    soak/CI probe for the tiering subsystem. Writes `n_keys` replicated
+    keys under an age-based TRANSITION_TO_EC rule, triggers a sweep
+    (`lifecycle run-now`), then verifies every key reads back
+    byte-exact AND erasure-coded. The timer covers the writes; the
+    sweep/verify outcome rides the report extras (`transitioned`,
+    `verify_failures`)."""
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket, replication)
+    except Exception:
+        pass
+    client.om.set_bucket_lifecycle(volume, bucket, [{
+        "id": "freon-tier", "prefix": prefix, "age_days": age_days,
+        "action": "TRANSITION_TO_EC", "target": target,
+    }])
+    b = client.get_volume(volume).get_bucket(bucket)
+
+    def op(i: int) -> int:
+        b.write_key(f"{prefix}-{i}", _det_payload(size, seed=i),
+                    replication)
+        return size
+
+    rep = BaseFreonGenerator("lcg", n_keys, threads).run(op)
+    sweep = client.om.run_lifecycle_once()
+    verify_failures = 0
+    ec_count = 0
+    for i in range(n_keys):
+        try:
+            info = client.om.lookup_key(volume, bucket, f"{prefix}-{i}")
+            got = b.read_key_info(info)
+            if not np.array_equal(got, _det_payload(size, seed=i)):
+                verify_failures += 1
+                continue
+            if str(info.get("replication", "")).startswith("rs-"):
+                ec_count += 1
+        except Exception:
+            verify_failures += 1
+    rep.extras.update({
+        "transitioned": sweep.get("transitioned", 0),
+        "ec_keys": ec_count,
+        "verify_failures": verify_failures,
+        "sweep_bytes": sweep.get("bytes", 0),
+        "sweep_dispatches": sweep.get("dispatches", 0),
+    })
+    return rep
+
+
 def ockr(client, n_keys: int, threads: int = 4, volume: str = "freon-vol",
          bucket: str = "freon-bucket", prefix: str = "key") -> FreonReport:
     """Key read generator (validation pass over ockg output)."""

@@ -46,6 +46,28 @@ def parse_size(v) -> int:
     return int(float(m.group(1)) * _SIZE_MULT[m.group(2).lower()])
 
 
+def env_float(name: str, default: float) -> float:
+    """Float env knob with a safe fallback: unset, empty, or junk
+    values fall back to `default` instead of crashing a daemon (the
+    lifecycle tuning knobs and friends all parse through here so the
+    error handling cannot drift between copies)."""
+    import os
+
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        import os
+
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 def parse_duration(v) -> float:
     if isinstance(v, (int, float)):
         return float(v)

@@ -125,21 +125,28 @@ def _sanitize(s: str) -> str:
 
 
 def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
-    """Prometheus exposition text for one or all registries."""
+    """Prometheus exposition text for one or all registries. Every
+    metric renders a # HELP and # TYPE pair (the exposition-format
+    contract scrapers and the golden test check) with a stable
+    `<registry>_<name>` identifier."""
     regs = [registry] if registry else list(_all_registries.values())
     lines: list[str] = []
     for r in regs:
         base = _sanitize(r.name)
         for k, c in r._counters.items():
             m = f"{base}_{_sanitize(k)}"
+            lines.append(f"# HELP {m} counter {k} of registry {r.name}")
             lines.append(f"# TYPE {m} counter")
             lines.append(f"{m} {c.value}")
         for k, g in r._gauges.items():
             m = f"{base}_{_sanitize(k)}"
+            lines.append(f"# HELP {m} gauge {k} of registry {r.name}")
             lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {g.value}")
         for k, t in r._timers.items():
             m = f"{base}_{_sanitize(k)}"
+            lines.append(f"# HELP {m}_seconds latency summary {k} of "
+                         f"registry {r.name}")
             lines.append(f"# TYPE {m}_seconds summary")
             lines.append(f"{m}_seconds_count {t.count}")
             lines.append(f"{m}_seconds_sum {t.total}")

@@ -18,3 +18,38 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from tier-1 runs")
+    config.addinivalue_line(
+        "markers",
+        "serial: subprocess-heavy suites that fork jax-importing "
+        "children; serialized behind a cross-process file lock so "
+        "parallel runners cannot starve their spawn deadlines")
+
+
+@pytest.fixture(autouse=True)
+def _serialize_marked(request):
+    """Cross-process exclusive lock for @pytest.mark.serial tests: the
+    subprocess launcher / secure-HA acceptance suites fork whole
+    process trees whose jax imports take tens of seconds on a loaded
+    one-core rig — two such suites overlapping (xdist, parallel CI
+    shards) starve each other's spawn deadlines (CHANGES.md PR 2)."""
+    if request.node.get_closest_marker("serial") is None:
+        yield
+        return
+    import fcntl
+    import tempfile
+    from pathlib import Path
+
+    path = Path(tempfile.gettempdir()) / "ozone_tpu_serial_tests.lock"
+    with open(path, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)

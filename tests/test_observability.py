@@ -67,6 +67,65 @@ def test_recon_endpoints(cluster):
         recon.stop()
 
 
+def test_prometheus_text_golden_every_registry_renders():
+    """Golden contract for the /prom surface: EVERY registered registry
+    renders each metric with a # HELP + # TYPE pair and a stable
+    sanitized name — including the lifecycle.* counters and the
+    client.resilience counters scrape dashboards already key on. A
+    rename or a dropped help/type line breaks operator dashboards
+    silently, so this test pins the exposition shape itself."""
+    import re
+
+    # import-effects register the registries this test pins
+    import ozone_tpu.client.resilience  # noqa: F401
+    import ozone_tpu.lifecycle.service as lc_service
+    from ozone_tpu.utils import metrics as m
+
+    # touch the documented counter sets so a fresh process renders them
+    # (registries materialize counters on first use)
+    for name in ("keys_scanned", "transitions", "bytes_tiered",
+                 "expirations", "leader_fences"):
+        lc_service.METRICS.counter(name).inc(0)
+    lc_service.METRICS.timer("sweep_seconds").update(0.0)
+    from ozone_tpu.client.resilience import METRICS as RES
+
+    RES.counter("deadline_exceeded").inc(0)
+    RES.counter("hedges_fired").inc(0)
+    text = m.prometheus_text()
+    lines = text.splitlines()
+    name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+    seen_metrics = set()
+    for i, line in enumerate(lines):
+        if not line.startswith("# TYPE "):
+            continue
+        _, _, metric, mtype = line.split(" ")
+        assert mtype in ("counter", "gauge", "summary"), line
+        assert name_re.match(metric), f"unstable metric name {metric!r}"
+        # the HELP line immediately precedes its TYPE line
+        assert lines[i - 1].startswith(f"# HELP {metric} "), \
+            f"missing HELP for {metric}"
+        # and a sample for the metric follows before the next family
+        nxt = lines[i + 1]
+        assert nxt.startswith(metric), f"no sample after TYPE {metric}"
+        seen_metrics.add(metric)
+    # every registered registry contributed at least its known metrics
+    for reg_name, reg in list(m._all_registries.items()):
+        base = reg_name.replace(".", "_").replace("-", "_")
+        for k in reg._counters:
+            want = f"{base}_{k.replace('.', '_').replace('-', '_')}"
+            assert want in seen_metrics, f"{reg_name}: missing {want}"
+    # the documented lifecycle + resilience families specifically
+    for want in ("lifecycle_keys_scanned", "lifecycle_transitions",
+                 "lifecycle_bytes_tiered", "lifecycle_expirations",
+                 "lifecycle_leader_fences", "lifecycle_sweep_seconds",
+                 "client_resilience_deadline_exceeded",
+                 "client_resilience_hedges_fired"):
+        stem = want.removesuffix("_seconds")
+        assert any(s.startswith(stem) for s in seen_metrics), want
+    assert "# TYPE client_resilience_deadline_exceeded counter" in text
+    assert "# HELP client_resilience_hedges_fired " in text
+
+
 def test_tracing_spans_nest_and_propagate():
     t = Tracer.instance()
     with t.span("outer") as outer:

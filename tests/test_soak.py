@@ -24,6 +24,16 @@ historical seeds' chaos schedules stay byte-identical) keeps at most
 one datanode link artificially slow at a time via partition.delay —
 the straggler shape the client resilience layer (hedges, health EWMA,
 breakers) must absorb while every acked write stays durable.
+
+PR 4 enables the lifecycle sweeper for the whole run: a `tier` bucket
+holds keys under an age-0 replicated->EC rule, and every metadata
+daemon's own background sweeper (leader-singleton, term-fenced, 4 s
+budget via OZONE_TPU_LIFECYCLE_DEADLINE_S) transitions them WHILE the
+chaos kills leaders, partitions links and injects stragglers; a
+post-heal run-now pass finishes what the chaos interrupted, and
+invariant 1 extends to the tiered bucket (acked keys byte-exact
+whether replicated, transitioned, or abandoned mid-transition, with
+at least one transition landed by end state).
 CI runs the default seed list below; a long nightly sweep is
 `OZONE_TPU_SOAK_SEEDS=1,2,3,... OZONE_TPU_SOAK_S=120 pytest
 tests/test_soak.py` (any seed count, longer chaos window).
@@ -74,7 +84,13 @@ def _start_injected_dn(tmp_path, dn_id, scm_addrs):
 
 
 @pytest.mark.parametrize("seed", SEEDS)
-def test_soak_all_instruments_under_load(tmp_path, seed):
+def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
+    # the sweeper must coexist with the chaos on a couple of shared
+    # cores: tight per-sweep budget + a source-read throttle (the same
+    # knobs operators use so tiering never starves foreground IO)
+    monkeypatch.setenv("OZONE_TPU_LIFECYCLE_DEADLINE_S", "4")
+    monkeypatch.setenv("OZONE_TPU_LIFECYCLE_MBPS", "8")
+    monkeypatch.setenv("OZONE_TPU_LIFECYCLE_PERIOD_S", "20")
     rng = random.Random(seed)
     ports = _free_ports(N_META)
     peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(N_META)}
@@ -86,6 +102,7 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
     acked_ec: list[str] = []
     acked_ratis: list[str] = []
     acked_s3: list[str] = []
+    acked_tier: list[str] = []
     hard_errors: list[Exception] = []
     snapshots_made: list[str] = []
     rename_intents: dict[str, str] = {}
@@ -106,10 +123,56 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
                                                   scm_addrs)
 
         oz = _client(peers)
-        oz.create_volume("v")
+
+        def boot(fn, deadline_s=90.0):
+            # boot-time elections on a loaded rig can outlast one
+            # failover-client attempt budget; setup retries under its
+            # own deadline instead of failing the whole soak before the
+            # chaos even starts
+            t0 = time.monotonic()
+            while True:
+                try:
+                    return fn()
+                except (StorageError, OSError) as e:
+                    if getattr(e, "code", "") in (
+                            "BUCKET_ALREADY_EXISTS",
+                            "VOLUME_ALREADY_EXISTS") \
+                            or time.monotonic() - t0 > deadline_s:
+                        raise
+                    time.sleep(1.0)
+
+        def ensure_bucket(vol, name, replication):
+            # idempotent: a create whose RESPONSE is lost to boot-time
+            # churn (leader busy past the RPC timeout) may still have
+            # applied, and the failover client's retry then surfaces
+            # ALREADY_EXISTS for a bucket we own
+            try:
+                return boot(lambda: vol.create_bucket(
+                    name, replication=replication))
+            except StorageError as e:
+                if e.code != "BUCKET_ALREADY_EXISTS":
+                    raise
+                return vol.get_bucket(name)
+
+        try:
+            boot(lambda: oz.create_volume("v"))
+        except StorageError as e:
+            if e.code != "VOLUME_ALREADY_EXISTS":
+                raise
         vol = oz.get_volume("v")
-        ec_bucket = vol.create_bucket("ec", replication="rs-3-2-4096")
-        ratis_bucket = vol.create_bucket("r3", replication="RATIS/THREE")
+        ec_bucket = ensure_bucket(vol, "ec", "rs-3-2-4096")
+        ratis_bucket = ensure_bucket(vol, "r3", "RATIS/THREE")
+        # lifecycle sweeper enabled for the whole run: replicated keys
+        # written under an age-0 rule get tiered to EC by the
+        # term-fenced background sweeper WHILE the chaos runs; the
+        # end-state invariant (every acked write reads back byte-exact)
+        # must hold whether a key was transitioned, mid-transition when
+        # a leader died, or still replicated
+        tier_bucket = ensure_bucket(vol, "tier", "RATIS/THREE")
+        boot(lambda: oz.om.set_bucket_lifecycle("v", "tier", [{
+            "id": "t0", "prefix": "tier-", "age_days": 0.0,
+            "action": "TRANSITION_TO_EC", "target": "rs-3-2-4096",
+        }]))
         ec_payload = np.random.default_rng(seed).integers(
             0, 256, 50_000, dtype=np.uint8).tobytes()
         r_payload = np.random.default_rng(seed + 1).integers(
@@ -210,6 +273,25 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
                     return
                 n += 1
                 time.sleep(0.25)
+
+        # tier keys are written BEFORE the chaos (healthy cluster), so
+        # the sweeper races the chaos on a fixed population instead of
+        # an ever-growing one — continuous tier writes + sweeps + the
+        # historical load mix oversubscribe the two shared cores and
+        # starve the foreground writers the soak exists to measure
+        for n in range(12):
+            key = f"tier-{n}"
+            try:
+                tier_bucket.write_key(key, r_payload)
+                acked_tier.append(key)
+            except (StorageError, StripeWriteError, OSError):
+                pass  # un-acked: no durability claim
+
+        # NOTE: no dedicated sweep thread — the sweeper that runs during
+        # the chaos is the daemons' own background one (every ScmOmDaemon
+        # runs it on the leader, term-fenced, 4 s budget via the env knob
+        # above), exactly how production sweeps happen; the post-heal
+        # run-now pass below finishes whatever the chaos interrupted
 
         threads = [
             threading.Thread(target=writer,
@@ -382,6 +464,27 @@ def test_soak_all_instruments_under_load(tmp_path, seed):
             read_back("ec", key, ec_payload)
         for key in acked_ratis:
             read_back("r3", key, r_payload)
+        # 1a. tiered bucket: a final post-heal sweep finishes what the
+        # chaos interrupted, then every acked key reads back byte-exact
+        # no matter where the sweeper left it (replicated, transitioned,
+        # or abandoned mid-transition by a killed leader — the fence
+        # guarantees the live version is always a complete one)
+        for _ in range(5):
+            try:
+                if oz.om.run_lifecycle_once().get("complete"):
+                    break
+            except (StorageError, OSError):
+                pass
+            time.sleep(2.0)
+        for key in acked_tier:
+            read_back("tier", key, r_payload)
+        assert len(acked_tier) >= 5, \
+            f"tier setup starved: {len(acked_tier)}"
+        tiered = sum(
+            1 for key in acked_tier
+            if str(oz.om.lookup_key("v", "tier", key).get(
+                "replication", "")).startswith("rs-"))
+        assert tiered >= 1, "sweeper made no progress by end state"
 
         # 1b. acked S3 objects read back THROUGH the gateway (its own
         # OM client must have ridden the failovers), same retry budget
