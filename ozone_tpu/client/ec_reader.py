@@ -30,6 +30,7 @@ import numpy as np
 from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import BlockGroup, block_lengths
+from ozone_tpu.codec import service as codec_service
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.fused import FusedSpec, make_fused_decoder
 from ozone_tpu.codec.pipeline import (
@@ -79,6 +80,7 @@ class ECBlockGroupReader:
         bytes_per_checksum: int = 16 * 1024,
         mesh=None,
         use_ring: bool = False,
+        qos_class: str = "interactive",
     ):
         #: optional jax.sharding.Mesh: recovery decodes run stripe-
         #: parallel (DP) over it — or survivor-sharded around the
@@ -123,6 +125,10 @@ class ECBlockGroupReader:
         #: operation deadline captured at the public entry points and
         #: re-activated on reader-pool worker threads
         self._deadline: Optional[resilience.Deadline] = None
+        #: shared codec service (None = per-operation pipeline): decode
+        #: batches coalesce with other operations sharing the erasure
+        #: pattern (reconstruction storms, fleets of degraded readers)
+        self._qos = qos_class
 
     # ---------------------------------------------------------------- helpers
     @property
@@ -432,7 +438,17 @@ class ECBlockGroupReader:
         batch = np.zeros((1, self.k, self.cell), dtype=np.uint8)
         for vi, x in enumerate(valid):
             batch[0, vi] = self._peek_cell(x, stripe)
-        rec, _crcs = fn(batch)
+        svc = codec_service.maybe_service()
+        if svc is not None:
+            # lone-stripe decode rides the service at width 1: no linger
+            # added to the latency-critical hedge, but concurrent hedges
+            # on the same pattern still serialize through one dispatcher
+            # instead of contending for the chip
+            rec, _crcs = codec_service.wait_result(svc.submit(
+                codec_service.decode_key(self.spec, valid, (u,)), fn,
+                batch, width=1, qos=self._qos, deadline=self._deadline))
+        else:
+            rec, _crcs = fn(batch)
         return np.asarray(rec)[0, 0]
 
     def _fanout_survivors(self, pool, fill_unit, valid: list[int],
@@ -608,7 +624,17 @@ class ECBlockGroupReader:
         fn = (self._mesh_decode_fn(valid, list(targets))
               if self.mesh is not None
               else make_fused_decoder(self.spec, valid, list(targets)))
-        pipe = DeviceBatchPipeline(fn)
+        svc = codec_service.maybe_service() if self.mesh is None else None
+        if svc is not None:
+            # shared-service path: this read's decode batches share
+            # device dispatches with every other in-flight operation on
+            # the same erasure pattern (a dead datanode's reconstruction
+            # storm is MANY groups with one pattern)
+            pipe = codec_service.ServicePipeline(
+                svc, codec_service.decode_key(self.spec, valid, targets),
+                fn, width=self._decode_batch, qos=self._qos)
+        else:
+            pipe = DeviceBatchPipeline(fn)
         pool = self._ensure_pool()
         for sb in batched(stripes, self._decode_batch):
             batch = np.zeros((len(sb), self.k, self.cell), dtype=np.uint8)

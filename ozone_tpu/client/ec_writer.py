@@ -41,6 +41,7 @@ from ozone_tpu.client.dn_client import (
     DatanodeClientFactory,
     batch_unsupported,
 )
+from ozone_tpu.codec import service as codec_service
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.fused import FusedSpec, effective_bpc, make_fused_encoder
 from ozone_tpu.scm.pipeline import Pipeline
@@ -232,6 +233,7 @@ class ECKeyWriter:
         stripe_batch: int = 8,
         max_retries: int = 3,
         batched_rpc: Optional[bool] = None,
+        qos_class: str = "interactive",
     ):
         self.opts = options
         self.k, self.p, self.cell = (
@@ -249,8 +251,13 @@ class ECKeyWriter:
         self.bpc = effective_bpc(self.cell, bytes_per_checksum)
         self.stripe_batch = stripe_batch
         self.max_retries = max_retries
-        self._fused = make_fused_encoder(FusedSpec(options, checksum, self.bpc))
+        self._spec = FusedSpec(options, checksum, self.bpc)
+        self._fused = make_fused_encoder(self._spec)
         self._host_checksum = Checksum(checksum, self.bpc)
+        #: QoS class for the shared codec service, which is resolved
+        #: per flush (like the reader) so a writer never holds a stale
+        #: handle across a service restart
+        self._qos = qos_class
 
         self._groups: list[BlockGroup] = []
         self._group: Optional[BlockGroup] = None
@@ -341,23 +348,49 @@ class ECKeyWriter:
             return
         stripes, self._queue = self._queue, []
         batch = np.stack([s.data for s in stripes])  # [B, k, C]
-        parity_dev, crcs_dev = self._fused(batch)  # async dispatch
-        for a in (parity_dev, crcs_dev):
-            # start the D2H transfer eagerly where the backend supports
-            # it, so it runs under the previous batch's network writes
-            try:
-                a.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
-        prev, self._pending = self._pending, (stripes, parity_dev,
-                                              crcs_dev)
+        svc = codec_service.maybe_service()
+        if svc is not None:
+            # shared-service path: a partial batch (the tail of a small
+            # PUT) is marked tail so it rides the linger path — it waits
+            # up to OZONE_TPU_CODEC_LINGER_MS to share its dispatch with
+            # OTHER operations' stripes instead of paying a full batch
+            # slot alone (counted in codec.service tail_flushes)
+            fut = svc.submit(
+                codec_service.encode_key(self._spec), self._fused, batch,
+                width=self.stripe_batch, qos=self._qos,
+                tail=len(stripes) < self.stripe_batch,
+                deadline=self._deadline)
+            prev, self._pending = self._pending, (stripes, fut)
+        else:
+            parity_dev, crcs_dev = self._fused(batch)  # async dispatch
+            for a in (parity_dev, crcs_dev):
+                # start the D2H transfer eagerly where the backend
+                # supports it, so it runs under the previous batch's
+                # network writes
+                try:
+                    a.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass
+            prev, self._pending = self._pending, (stripes, parity_dev,
+                                                  crcs_dev)
         if prev is not None:
-            self._write_batch(*prev)
+            self._write_batch(*self._resolve_pending(prev))
+
+    @staticmethod
+    def _resolve_pending(prev: tuple) -> tuple:
+        """(stripes, parity, crcs) of an in-flight batch, whether it
+        rode the shared codec service (future) or a direct dispatch
+        (device arrays)."""
+        if len(prev) == 2:
+            stripes, fut = prev
+            parity, crcs = codec_service.wait_result(fut)
+            return stripes, parity, crcs
+        return prev
 
     def _drain_pending(self) -> None:
         prev, self._pending = self._pending, None
         if prev is not None:
-            self._write_batch(*prev)
+            self._write_batch(*self._resolve_pending(prev))
 
     def _write_batch(self, stripes, parity_dev, crcs_dev) -> None:
         """Write one encoded batch. The batched-RPC path writes each run

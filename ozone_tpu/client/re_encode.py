@@ -19,6 +19,7 @@ import numpy as np
 
 from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
+from ozone_tpu.codec import service as codec_service
 from ozone_tpu.client.ec_writer import ECKeyWriter
 from ozone_tpu.client.replicated import ReplicatedKeyReader
 from ozone_tpu.om.om import OzoneManager
@@ -81,6 +82,7 @@ def re_encode_key_to_ec(
         block_size=om.block_size,
         checksum=ChecksumType(info.get("checksum_type", "CRC32C")),
         bytes_per_checksum=info.get("bytes_per_checksum", 16 * 1024),
+        qos_class="bulk",  # background conversion must not starve reads
     )
     for g in old_groups:
         writer.write(ReplicatedKeyReader(g, clients).read_all())
@@ -271,8 +273,18 @@ def re_encode_xor_key_to_rs(
 
         # depth-1 pipeline over stripe windows: the ec_writer's
         # _flush_queue structure on the conversion path — target writes
-        # of window N overlap the device pass + D2H of window N+1
-        pipe = DeviceBatchPipeline(fn)
+        # of window N overlap the device pass + D2H of window N+1.
+        # Routed through the shared codec service (bulk class) when
+        # enabled so conversion windows coalesce with other operations'
+        # stripes and defer to interactive traffic.
+        svc = codec_service.maybe_service()
+        if svc is not None:
+            lane_key = (codec_service.reencode_key(spec, lost) if parity_ok
+                        else codec_service.encode_key(spec))
+            pipe = codec_service.ServicePipeline(
+                svc, lane_key, fn, width=window, qos="bulk")
+        else:
+            pipe = DeviceBatchPipeline(fn)
         health = getattr(clients, "health", None)
         for s0 in range(0, stripes, window):
             resilience.check_deadline("re_encode_window")

@@ -1,0 +1,626 @@
+"""Shared codec service: cross-request continuous batching for the chip.
+
+Every perf number so far was measured with ONE operation owning the whole
+`DeviceBatchPipeline`; at millions-of-users concurrency the real traffic
+shape is many small concurrent PUTs/GETs, each far too small to fill a
+stripe batch, all contending for the device. This module applies the
+continuous-batching idea from LLM serving (Orca, OSDI '22) to the
+GF(2^8) codec: a per-process, thread-safe `CodecService` owns the device
+and runs a dispatcher loop that drains a submission queue of stripe work
+(encode, decode/recover, re-encode) from ANY concurrent operation, packs
+same-shape stripes into constant-shape fused batches (zero-padded tail,
+so the plan caches in `codec/fused.py` keep serving ONE compiled program
+per shape — no new XLA compiles), double-buffers dispatches exactly like
+`DeviceBatchPipeline`, and completes per-submitter futures as results
+land. The same consolidation argument f4 (OSDI '14) makes for warm-blob
+IO, applied to device dispatches.
+
+Policy layer:
+
+- **Deadline-aware flush**: a submitter's ambient `resilience.Deadline`
+  nearing expiry forces a partial batch instead of waiting for fill, so
+  a tight budget gets a padded dispatch, never DEADLINE_EXCEEDED spent
+  queueing.
+- **Max linger** (``OZONE_TPU_CODEC_LINGER_MS``): bounds the added
+  latency for lone stripes — a submission that cannot fill its lane's
+  batch width dispatches (zero-padded) after at most the linger.
+- **Weighted fair scheduling** (``OZONE_TPU_CODEC_QOS``): per-class
+  service weights so a bulk lifecycle or reconstruction sweep cannot
+  starve interactive reads; a starvation guard preempts fairness when a
+  queue head has waited past ``OZONE_TPU_CODEC_STARVE_MS``.
+
+Lanes: submissions coalesce per (semantic key, batch width, QoS class)
+— the key carries the fused spec plus, for decode, the erasure pattern
+(different recovery matrices cannot share one dispatch), and classes
+stay in separate lanes so FIFO packing can never schedule interactive
+stripes at a bulk submission's weight. Lanes are ephemeral: a
+lane exists only while it has queued stripes, and binds the fused
+callable its first submitter resolved — so backend choice (device vs
+native twin) and test instrumentation stay with the submitting layer.
+
+``OZONE_TPU_CODEC_SERVICE=0`` disables the service; every refactored
+caller keeps its per-operation `DeviceBatchPipeline` as the degraded
+no-service fallback.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutTimeout
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ozone_tpu.codec.pipeline import _start_d2h
+from ozone_tpu.storage.ids import StorageError
+from ozone_tpu.utils.config import env_float
+from ozone_tpu.utils.metrics import MetricsRegistry, registry
+
+log = logging.getLogger(__name__)
+
+#: every service signal in ONE registry (prometheus: codec_service_*)
+METRICS: MetricsRegistry = registry("codec.service")
+
+#: default added-latency bound for a lone stripe waiting for co-batching
+DEFAULT_LINGER_MS = 2.0
+#: default starvation bound: a queue head older than this preempts the
+#: weighted fair pick outright (and counts starvation_guard_trips)
+DEFAULT_STARVE_MS = 250.0
+#: default per-class QoS weights (OZONE_TPU_CODEC_QOS overrides, e.g.
+#: "interactive=4,bulk=1"): interactive reads outweigh background sweeps
+DEFAULT_QOS = {"interactive": 4.0, "bulk": 1.0}
+#: seed for the dispatch-time EWMA before the first dispatch lands
+_DISPATCH_EWMA_SEED_S = 0.005
+
+
+def enabled() -> bool:
+    """The service disable switch (OZONE_TPU_CODEC_SERVICE=0)."""
+    return os.environ.get("OZONE_TPU_CODEC_SERVICE", "1") != "0"
+
+
+def qos_weights() -> dict[str, float]:
+    """Parse OZONE_TPU_CODEC_QOS ("cls=weight,cls=weight"); unknown
+    classes default to weight 1."""
+    out = dict(DEFAULT_QOS)
+    raw = os.environ.get("OZONE_TPU_CODEC_QOS", "")
+    for part in raw.split(","):
+        if "=" not in part:
+            continue
+        cls, _, w = part.partition("=")
+        try:
+            out[cls.strip()] = max(1e-6, float(w))
+        except ValueError:
+            continue
+    return out
+
+
+def _ambient_deadline():
+    """The submitter's operation deadline, if any (lazy import: codec
+    must stay importable without the client layer)."""
+    from ozone_tpu.client import resilience
+
+    return resilience.current()
+
+
+class _Sub:
+    """One submission: `n` same-shape stripes from one operation."""
+
+    __slots__ = ("stripes", "n", "future", "cls", "deadline", "t_enq",
+                 "tail", "taken", "pending_parts", "parts")
+
+    def __init__(self, stripes: np.ndarray, future: Future, cls: str,
+                 deadline, tail: bool):
+        self.stripes = stripes
+        self.n = int(stripes.shape[0])
+        self.future = future
+        self.cls = cls
+        self.deadline = deadline
+        self.t_enq = time.monotonic()
+        self.tail = tail
+        self.taken = 0          # stripes already packed into dispatches
+        self.pending_parts = 0  # dispatched parts not yet completed
+        self.parts: list[tuple] = []  # (offset, take, host outs tuple)
+
+    def deadline_t(self) -> float:
+        return self.deadline.t_end if self.deadline is not None else math.inf
+
+
+class _Lane:
+    """One coalescing lane: same semantic key, same stripe shape, same
+    batch width, same QoS class (classes get separate lanes so a bulk
+    submission queued ahead of an interactive one in FIFO order can
+    never drag it down to bulk scheduling weight). FIFO of submissions
+    with undispatched stripes."""
+
+    __slots__ = ("lane_key", "fn", "width", "cls", "subs", "queued",
+                 "min_deadline_t", "last_served")
+
+    def __init__(self, lane_key: tuple, fn: Callable, width: int,
+                 cls: str):
+        self.lane_key = lane_key
+        self.fn = fn
+        self.width = max(1, int(width))
+        self.cls = cls
+        self.subs: deque[_Sub] = deque()
+        self.queued = 0  # undispatched stripes across subs
+        self.min_deadline_t = math.inf
+        self.last_served = 0.0  # 0 = never dispatched from
+
+
+class CodecService:
+    """The per-process dispatcher owning fused device dispatches.
+
+    `submit(key, fn, stripes, ...)` enqueues `[n, ...]` stripe work and
+    returns a Future resolving to the tuple of host arrays `fn` produces
+    for exactly those `n` stripes (outputs are sliced out of the fused
+    batch along axis 0). Submissions sharing (key, width) coalesce into
+    one dispatch; the dispatcher zero-pads every batch to the lane width
+    so each lane runs ONE compiled program.
+    """
+
+    def __init__(self):
+        self.linger_s = env_float("OZONE_TPU_CODEC_LINGER_MS",
+                                  DEFAULT_LINGER_MS) / 1000.0
+        self.starve_s = env_float("OZONE_TPU_CODEC_STARVE_MS",
+                                  DEFAULT_STARVE_MS) / 1000.0
+        self.weights = qos_weights()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._lanes: dict[tuple, _Lane] = {}
+        self._vtime: dict[str, float] = {}
+        #: system virtual clock (SFQ-style): advances with the least
+        #: virtual time among backlogged classes; a class returning
+        #: from idle is floored to it on activation, so neither a
+        #: stale LOW vtime (idle bulk monopolizing on return) nor a
+        #: stale HIGH one (interactive penalized for past service)
+        #: survives an idle period
+        self._vclock = 0.0
+        self._queued_cls: dict[str, int] = {}  # class -> queued subs
+        self._inflight: deque[tuple] = deque()
+        self._dispatch_ewma_s = _DISPATCH_EWMA_SEED_S
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="codec-service")
+        self._thread.start()
+
+    # ----------------------------------------------------------- submit
+    def submit(self, key: tuple, fn: Callable, stripes: np.ndarray,
+               *, width: int, qos: str = "interactive",
+               tail: bool = False, deadline=None) -> Future:
+        """Enqueue `stripes` ([n, ...] with n >= 1) for the fused `fn`.
+
+        `key` is the hashable coalescing identity (kind + spec + pattern);
+        `width` the constant dispatch batch size this submitter's shape
+        family compiles at (a lane is keyed by both, so mismatched
+        widths never pad against each other). `fn` is bound to the lane
+        by its FIRST submitter and dropped when the lane drains.
+        `tail=True` marks a partial final flush: it rides the linger
+        path (waiting up to the linger to co-batch with other
+        operations) and is counted in the tail_flushes metric when it
+        dispatches, whether it ended up co-batched or padded.
+        The ambient resilience deadline is captured when none is given.
+        """
+        if stripes.shape[0] < 1:
+            raise ValueError("empty codec submission")
+        if deadline is None:
+            deadline = _ambient_deadline()
+        fut: Future = Future()
+        sub = _Sub(stripes, fut, qos, deadline, tail)
+        lane_key = (key, width, qos)
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("codec service is shut down")
+            lane = self._lanes.get(lane_key)
+            if lane is None:
+                lane = self._lanes[lane_key] = _Lane(lane_key, fn,
+                                                     width, qos)
+            if not self._queued_cls.get(qos):
+                # WFQ activation floor: a class becoming backlogged
+                # joins at the system virtual clock
+                self._vtime[qos] = max(self._vtime.get(qos, 0.0),
+                                       self._vclock)
+            self._queued_cls[qos] = self._queued_cls.get(qos, 0) + 1
+            lane.subs.append(sub)
+            lane.queued += sub.n
+            lane.min_deadline_t = min(lane.min_deadline_t,
+                                      sub.deadline_t())
+            METRICS.counter("submissions").inc()
+            METRICS.gauge("queue_depth").set(self._queue_depth_locked())
+            self._cond.notify()
+        return fut
+
+    # ------------------------------------------------------- scheduling
+    def _queue_depth_locked(self) -> int:
+        return sum(lane.queued for lane in self._lanes.values())
+
+    def _flush_margin_s(self) -> float:
+        """How far before a deadline a partial batch must flush: the
+        linger plus headroom for the in-flight depth's dispatch time."""
+        return self.linger_s + 4.0 * self._dispatch_ewma_s
+
+    def _ready_reason(self, lane: _Lane, now: float) -> Optional[str]:
+        if not lane.subs:
+            return None
+        if lane.queued >= lane.width:
+            return "full"
+        if lane.min_deadline_t - now <= self._flush_margin_s():
+            return "deadline"
+        if now - lane.subs[0].t_enq >= self.linger_s:
+            return "linger"
+        return None
+
+    def _pick_lane_locked(self, now: float):
+        """Choose the next lane to dispatch: the ready lane whose head
+        class has the least weighted service (classic weighted-fair
+        virtual time) — unless a starved lane preempts it. Among
+        starved lanes the LEAST-RECENTLY-SERVED wins, not the oldest
+        head: when a deep bulk backlog keeps its own head perpetually
+        over-aged, oldest-first would hand the guard straight back to
+        the backlog and starve everyone else anyway."""
+        ready: list[tuple[_Lane, str]] = []
+        for lane in self._lanes.values():
+            reason = self._ready_reason(lane, now)
+            if reason is not None:
+                ready.append((lane, reason))
+        if not ready:
+            return None
+        # advance the system virtual clock to the least backlogged
+        # class's virtual time (it never goes backwards)
+        self._vclock = max(self._vclock, min(
+            self._vtime.get(lane.subs[0].cls, 0.0) for lane, _ in ready))
+
+        def vkey(lr):
+            lane, _ = lr
+            cls = lane.subs[0].cls
+            return (self._vtime.get(cls, 0.0), lane.subs[0].t_enq)
+
+        fair = min(ready, key=vkey)
+        starved = [(lane, r) for lane, r in ready
+                   if now - lane.subs[0].t_enq >= self.starve_s]
+        if starved:
+            lane, reason = min(
+                starved,
+                key=lambda lr: (lr[0].last_served,
+                                lr[0].subs[0].t_enq))
+            if lane is not fair[0]:
+                # the guard overrode the weighted-fair choice
+                METRICS.counter("starvation_guard_trips").inc()
+            return lane, reason
+        return fair
+
+    def _next_wakeup_locked(self, now: float) -> Optional[float]:
+        """Seconds until the earliest linger/deadline trigger."""
+        t = math.inf
+        margin = self._flush_margin_s()
+        for lane in self._lanes.values():
+            if not lane.subs:
+                continue
+            t = min(t, lane.subs[0].t_enq + self.linger_s,
+                    lane.min_deadline_t - margin)
+        return None if math.isinf(t) else max(0.0, t - now)
+
+    def _pack_locked(self, lane: _Lane, reason: str):
+        """Take up to `width` stripes from the lane head, FIFO across
+        submissions (the cross-request coalescing step)."""
+        entries: list[tuple[_Sub, int, int, int]] = []
+        lane.last_served = time.monotonic()
+        row = 0
+        while lane.subs and row < lane.width:
+            sub = lane.subs[0]
+            take = min(sub.n - sub.taken, lane.width - row)
+            entries.append((sub, sub.taken, take, row))
+            sub.taken += take
+            sub.pending_parts += 1
+            if sub.taken == sub.n:
+                lane.subs.popleft()
+                left = self._queued_cls.get(sub.cls, 1) - 1
+                if left > 0:
+                    self._queued_cls[sub.cls] = left
+                else:
+                    self._queued_cls.pop(sub.cls, None)
+            row += take
+            lane.queued -= take
+        if not lane.subs:
+            # ephemeral lanes: drop the fn binding once drained
+            self._lanes.pop(lane.lane_key, None)
+            lane.min_deadline_t = math.inf
+        else:
+            lane.min_deadline_t = min(
+                s.deadline_t() for s in lane.subs)
+        return entries, row
+
+    # ------------------------------------------------------- dispatcher
+    def _loop(self) -> None:
+        try:
+            while True:
+                entries = None
+                with self._cond:
+                    now = time.monotonic()
+                    picked = self._pick_lane_locked(now)
+                    if picked is not None:
+                        lane, reason = picked
+                        entries, rows = self._pack_locked(lane, reason)
+                    elif not self._inflight:
+                        if not self._running:
+                            if not self._lanes:
+                                break
+                            # closing with queued-but-untriggered work:
+                            # flush it rather than strand the futures
+                            lane = next(iter(self._lanes.values()))
+                            reason = "linger"
+                            entries, rows = self._pack_locked(
+                                lane, reason)
+                        else:
+                            self._cond.wait(self._next_wakeup_locked(now))
+                            continue
+                if entries is not None:
+                    self._dispatch(lane, entries, rows, reason)
+                    # depth-1 double buffer: keep ONE older batch in
+                    # flight; complete it only once the next dispatch
+                    # is on the device (the _flush_queue overlap)
+                    if len(self._inflight) > 1:
+                        self._complete(self._inflight.popleft())
+                elif self._inflight:
+                    # nothing packable right now: never hold results
+                    # hostage waiting for more work
+                    self._complete(self._inflight.popleft())
+        except BaseException:  # noqa: BLE001 - dispatcher must not die silently
+            log.exception("codec service dispatcher crashed")
+            raise
+        finally:
+            # a dead dispatcher must read as NOT RUNNING: submit()
+            # rejects instead of queueing into a drain nobody runs, and
+            # get_service() hands out a fresh service
+            with self._lock:
+                self._running = False
+            self._fail_pending(RuntimeError("codec service stopped"))
+
+    def _dispatch(self, lane: _Lane, entries, rows: int,
+                  reason: str) -> None:
+        now = time.monotonic()
+        ops = len(entries)
+        with self._lock:
+            # fairness accounting under the lock: submit()'s SFQ
+            # activation floor does a read-modify-write of the same
+            # vtime entries from other threads
+            for sub, off, take, _row in entries:
+                w = self.weights.get(sub.cls, 1.0)
+                self._vtime[sub.cls] = \
+                    self._vtime.get(sub.cls, 0.0) + take / w
+        for sub, off, take, _row in entries:
+            if off == 0:
+                wait = now - sub.t_enq
+                METRICS.timer("queue_wait_seconds").update(wait)
+                METRICS.timer(f"queue_wait_{sub.cls}_seconds").update(wait)
+                if sub.tail:
+                    METRICS.counter("tail_flushes").inc()
+        head = entries[0]
+        if ops == 1 and head[2] == rows == lane.width:
+            # one submission covering the whole batch: dispatch its own
+            # (contiguous) rows without a staging copy — the bulk-sweep
+            # fast path, byte-identical to the pre-service pipeline
+            sub, off, take, _ = head
+            batch = sub.stripes[off:off + take]
+            if not batch.flags.c_contiguous:
+                batch = np.ascontiguousarray(batch)
+        else:
+            shape = (lane.width,) + tuple(head[0].stripes.shape[1:])
+            batch = np.zeros(shape, dtype=head[0].stripes.dtype)
+            for sub, off, take, row in entries:
+                batch[row:row + take] = sub.stripes[off:off + take]
+        t0 = time.monotonic()
+        try:
+            outs = lane.fn(batch)
+        except BaseException as e:  # noqa: BLE001 - per-dispatch fault
+            self._resolve_error(entries, e)
+            return
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        for a in outs:
+            # eager D2H under the next batch's host work
+            _start_d2h(a)
+        METRICS.counter("dispatches").inc()
+        METRICS.counter("stripes_dispatched").inc(rows)
+        METRICS.counter("slots_dispatched").inc(lane.width)
+        METRICS.counter("coalesced_operations").inc(ops)
+        if ops > 1:
+            METRICS.counter("multi_op_dispatches").inc()
+        if reason == "linger":
+            METRICS.counter("forced_flushes").inc()
+        elif reason == "deadline":
+            METRICS.counter("deadline_flushes").inc()
+        METRICS.gauge("batch_fill_pct").set(100.0 * rows / lane.width)
+        METRICS.gauge("last_coalesced_operations").set(ops)
+        with self._lock:
+            METRICS.gauge("queue_depth").set(self._queue_depth_locked())
+        self._inflight.append((entries, outs, t0))
+
+    def _complete(self, rec: tuple) -> None:
+        entries, outs, t0 = rec
+        try:
+            host = tuple(np.asarray(a) for a in outs)
+        except BaseException as e:  # noqa: BLE001 - D2H fault
+            self._resolve_error(entries, e)
+            return
+        self._dispatch_ewma_s += 0.2 * (
+            (time.monotonic() - t0) - self._dispatch_ewma_s)
+        METRICS.timer("dispatch_seconds").update(time.monotonic() - t0)
+        for sub, off, take, row in entries:
+            sub.parts.append(
+                (off, take, tuple(a[row:row + take] for a in host)))
+            sub.pending_parts -= 1
+            if sub.taken == sub.n and sub.pending_parts == 0:
+                self._resolve(sub)
+
+    @staticmethod
+    def _resolve(sub: _Sub) -> None:
+        if sub.future.done():
+            # an earlier part of this (split) submission already failed
+            # the future; later parts complete harmlessly
+            return
+        if len(sub.parts) == 1:
+            sub.future.set_result(sub.parts[0][2])
+            return
+        sub.parts.sort(key=lambda p: p[0])
+        outs = tuple(
+            np.concatenate([p[2][i] for p in sub.parts], axis=0)
+            for i in range(len(sub.parts[0][2])))
+        sub.future.set_result(outs)
+
+    @staticmethod
+    def _resolve_error(entries, e: BaseException) -> None:
+        done = set()
+        for sub, _off, _take, _row in entries:
+            if id(sub) not in done:
+                done.add(id(sub))
+                if not sub.future.done():
+                    sub.future.set_exception(e)
+
+    def _fail_pending(self, e: BaseException) -> None:
+        with self._lock:
+            subs = [s for lane in self._lanes.values() for s in lane.subs]
+            self._lanes.clear()
+            self._queued_cls.clear()
+            inflight, self._inflight = list(self._inflight), deque()
+        for rec in inflight:
+            for sub, _o, _t, _r in rec[0]:
+                subs.append(sub)
+        for s in subs:
+            if not s.future.done():
+                s.future.set_exception(e)
+
+    # ---------------------------------------------------------- control
+    def stats(self) -> dict:
+        """Operator snapshot (the Recon /api/codec payload)."""
+        snap = METRICS.snapshot()
+        slots = snap.get("slots_dispatched", 0)
+        disp = snap.get("dispatches", 0)
+        snap["fill_ratio"] = (snap.get("stripes_dispatched", 0) / slots
+                              if slots else 0.0)
+        snap["ops_per_dispatch"] = (
+            snap.get("coalesced_operations", 0) / disp if disp else 0.0)
+        with self._lock:
+            snap["queue_depth"] = self._queue_depth_locked()
+            snap["lanes"] = len(self._lanes)
+            snap["inflight"] = len(self._inflight)
+        snap["linger_ms"] = self.linger_s * 1000.0
+        snap["weights"] = dict(self.weights)
+        snap["enabled"] = enabled()
+        return snap
+
+    def close(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout=self._flush_margin_s() * 64)
+        self._fail_pending(RuntimeError("codec service shut down"))
+
+
+_service: Optional[CodecService] = None
+_service_lock = threading.Lock()
+
+
+def get_service() -> CodecService:
+    """The process-wide service (created on first use)."""
+    global _service
+    with _service_lock:
+        if _service is None or not _service._running:
+            _service = CodecService()
+        return _service
+
+
+def maybe_service() -> Optional[CodecService]:
+    """The service, or None when disabled — the ONE check every
+    refactored datapath makes before choosing its fallback pipeline."""
+    return get_service() if enabled() else None
+
+
+def reset_for_tests() -> None:
+    """Shut down and drop the singleton (fresh knobs per test)."""
+    global _service
+    with _service_lock:
+        svc, _service = _service, None
+    if svc is not None:
+        svc.close()
+
+
+# ------------------------------------------------------------- plan keys
+def encode_key(spec) -> tuple:
+    return ("encode", spec)
+
+
+def decode_key(spec, valid, erased) -> tuple:
+    return ("decode", spec, tuple(valid), tuple(erased))
+
+
+def reencode_key(spec, lost: int) -> tuple:
+    return ("reencode", spec, int(lost))
+
+
+def wait_result(fut: Future, grace_s: Optional[float] = None):
+    """Block on a codec future with deadline-aware patience: the wait
+    allows the remaining operation budget PLUS the service's flush
+    margin — a near-expiry submission is being force-flushed, so the
+    right behavior is to collect that partial-batch result, not to
+    declare DEADLINE_EXCEEDED while it is already on the device."""
+    from ozone_tpu.client import resilience
+
+    d = resilience.current()
+    if d is None:
+        return fut.result()
+    if grace_s is None:
+        svc = _service
+        grace_s = (svc._flush_margin_s() if svc is not None else 0.0) \
+            + 16.0 * _DISPATCH_EWMA_SEED_S
+    left = d.remaining()
+    try:
+        return fut.result(timeout=max(0.0, left) + grace_s)
+    except _FutTimeout:
+        METRICS.counter("wait_deadline_exceeded").inc()
+        raise StorageError(
+            "DEADLINE_EXCEEDED",
+            f"operation {d.op} deadline exceeded waiting for the codec "
+            f"service") from None
+
+
+class ServicePipeline:
+    """Drop-in twin of `codec.pipeline.DeviceBatchPipeline` backed by
+    the shared service: submit(batch, ctx) routes the batch through the
+    coalescing dispatcher and returns the PREVIOUS submission's host
+    results (ctx, outs) — so every depth-1 pipeline consumer (degraded
+    reads, re-encode, lifecycle tiering) keeps its overlap structure
+    and gains cross-request batching with a two-line change."""
+
+    def __init__(self, svc: CodecService, key: tuple, fn: Callable,
+                 width: int, qos: str = "interactive"):
+        self._svc = svc
+        self._key = key
+        self._fn = fn
+        self._width = max(1, int(width))
+        self._qos = qos
+        self._pending: Optional[tuple] = None
+
+    def submit(self, batch: np.ndarray, ctx: Any = None,
+               tail: bool = False) -> Optional[tuple]:
+        fut = self._svc.submit(self._key, self._fn, batch,
+                               width=self._width, qos=self._qos,
+                               tail=tail)
+        prev, self._pending = self._pending, (ctx, fut)
+        return self._to_host(prev)
+
+    def drain(self) -> Optional[tuple]:
+        prev, self._pending = self._pending, None
+        return self._to_host(prev)
+
+    @staticmethod
+    def _to_host(entry: Optional[tuple]) -> Optional[tuple]:
+        if entry is None:
+            return None
+        ctx, fut = entry
+        return ctx, wait_result(fut)

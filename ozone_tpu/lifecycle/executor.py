@@ -227,7 +227,7 @@ class TieringExecutor:
             spec = FusedSpec(conf.ec, ctype, bpc)
             packer = packers[key] = _SpecPacker(
                 self, make_fused_encoder(spec), conf.ec, ctype, bpc,
-                stats)
+                stats, spec=spec)
         return packer
 
     def _pack_key(self, packer: "_SpecPacker", volume: str, bucket: str,
@@ -332,7 +332,8 @@ class _SpecPacker:
     device batches over one depth-1 DeviceBatchPipeline."""
 
     def __init__(self, executor: TieringExecutor, fn, opts, ctype, bpc,
-                 stats: dict):
+                 stats: dict, spec=None):
+        from ozone_tpu.codec import service as codec_service
         from ozone_tpu.codec.pipeline import DeviceBatchPipeline
 
         self.executor = executor
@@ -341,7 +342,17 @@ class _SpecPacker:
         self.bpc = bpc
         self.stats = stats
         self.window = tier_batch_size()
-        self.pipe = DeviceBatchPipeline(fn)
+        # shared codec service (bulk class) when enabled: sweep windows
+        # coalesce with other operations' stripes and the weighted fair
+        # scheduler keeps the sweep from starving interactive traffic;
+        # per-sweep DeviceBatchPipeline is the no-service fallback
+        svc = codec_service.maybe_service() if spec is not None else None
+        if svc is not None:
+            self.pipe = codec_service.ServicePipeline(
+                svc, codec_service.encode_key(spec), fn,
+                width=self.window, qos="bulk")
+        else:
+            self.pipe = DeviceBatchPipeline(fn)
         self.host_checksum = Checksum(ctype, bpc)
         self.dispatches = 0
         self._reset_buffer()

@@ -725,6 +725,11 @@ class ReconServer:
                     # lifecycle sweeper panel: fencing term, cursor,
                     # last-sweep stats + live tiering counters
                     "/api/lifecycle": recon.lifecycle_view,
+                    # shared codec service: batch fill ratio, queue
+                    # depth, coalescing + QoS counters (the device's
+                    # continuous-batching health, next to lifecycle —
+                    # its main bulk consumer)
+                    "/api/codec": recon.codec_view,
                 }
                 fn = routes.get(path)
                 if fn is not None:
@@ -756,6 +761,22 @@ class ReconServer:
         with self._scan_lock:
             self._scan_cache[key] = (time.monotonic(), val)
         return val
+
+    def codec_view(self) -> dict:
+        """Shared codec service snapshot for the dashboard panel:
+        fill/coalescing ratios derived from the counters plus live
+        queue depth and knob echo (codec/service.stats). PEEKS at the
+        singleton — a monitoring GET must never be the thing that
+        spawns the device-owning dispatcher in a process that does no
+        codec work."""
+        from ozone_tpu.codec import service as codec_service
+
+        if not codec_service.enabled():
+            return {"enabled": False}
+        svc = codec_service._service
+        if svc is None or not svc._running:
+            return {"enabled": True, "started": False}
+        return svc.stats()
 
     def lifecycle_view(self) -> dict:
         """Lifecycle sweeper status + per-bucket rule census for the

@@ -201,6 +201,12 @@ RECON_INDEX_HTML = """<!doctype html>
     <tbody></tbody>
   </table>
 
+  <h2>Codec service</h2>
+  <div class="sub">cross-request continuous batching: stripes from
+    concurrent operations coalesced into shared fused device
+    dispatches &mdash; fill ratio, queue depth, QoS/linger flushes</div>
+  <div class="tiles" id="codec-tiles"></div>
+
   <h2>Container &rarr; keys</h2>
   <div class="sub">which keys reference a container (the reference's
     ContainerKeyMapper view) &mdash; enter a container id</div>
@@ -352,6 +358,22 @@ async function refresh() {
         `<td>${esc(r.prefix)}</td><td>${esc(r.age_days)}</td>` +
         `<td>${esc(r.action)}</td></tr>`)).join("") ||
       '<tr><td colspan="5">no lifecycle rules configured</td></tr>';
+    const cx = await (await fetch("/api/codec")).json();
+    document.getElementById("codec-tiles").innerHTML =
+      cx.enabled === false
+        ? tile("codec service", "disabled")
+        : [
+      tile("batch fill", `${Math.round((cx.fill_ratio ?? 0) * 100)}%`),
+      tile("queue depth", cx.queue_depth ?? 0),
+      tile("dispatches", cx.dispatches ?? 0),
+      tile("ops/dispatch",
+           (cx.ops_per_dispatch ?? 0).toFixed(2)),
+      tile("multi-op dispatches", cx.multi_op_dispatches ?? 0),
+      tile("linger flushes", cx.forced_flushes ?? 0),
+      tile("deadline flushes", cx.deadline_flushes ?? 0),
+      tile("tail flushes", cx.tail_flushes ?? 0),
+      tile("starvation trips", cx.starvation_guard_trips ?? 0),
+    ].join("");
     const uh = await (await fetch("/api/containers/unhealthy")).json();
     document.querySelector("#unhealthy tbody").innerHTML = uh
       .map(r => `<tr><td>${esc(r.container)}</td>` +

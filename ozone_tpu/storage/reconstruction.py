@@ -209,6 +209,7 @@ class ECReconstructionCoordinator:
             bytes_per_checksum=bpc,
             mesh=self.mesh,
             use_ring=self.use_ring,
+            qos_class="bulk",  # repair storms defer to interactive reads
         )
         target_units = [idx - 1 for idx in targets]  # 0-based unit indexes
         lengths = unit_true_lengths(group, opts)

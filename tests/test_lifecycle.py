@@ -431,8 +431,22 @@ def test_recon_lifecycle_endpoint(cluster):
             f"http://{recon.address}/api/lifecycle", timeout=10).read())
         assert out["buckets"][0]["rules"][0]["id"] == "warm"
         assert "metrics" in out
+        # the codec-service panel rides the same server (batch fill /
+        # queue depth for the device's continuous batching)
+        cx = json.loads(urllib.request.urlopen(
+            f"http://{recon.address}/api/codec", timeout=10).read())
+        if cx.get("enabled") is False:
+            assert set(cx) == {"enabled"}
+        elif cx.get("started") is False:
+            # monitoring GET must not spawn the dispatcher itself
+            assert set(cx) == {"enabled", "started"}
+        else:
+            for want in ("fill_ratio", "ops_per_dispatch",
+                         "queue_depth", "linger_ms", "weights"):
+                assert want in cx, want
         page = urllib.request.urlopen(
             f"http://{recon.address}/", timeout=10).read().decode()
         assert "Lifecycle tiering" in page and "/api/lifecycle" in page
+        assert "Codec service" in page and "/api/codec" in page
     finally:
         recon.stop()

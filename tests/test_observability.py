@@ -91,6 +91,20 @@ def test_prometheus_text_golden_every_registry_renders():
 
     RES.counter("deadline_exceeded").inc(0)
     RES.counter("hedges_fired").inc(0)
+    # the shared codec service's documented family (docs/OPERATIONS.md
+    # "Shared codec service"): dashboards key on these names
+    from ozone_tpu.codec.service import METRICS as CODEC
+
+    for name in ("submissions", "dispatches", "stripes_dispatched",
+                 "slots_dispatched", "coalesced_operations",
+                 "multi_op_dispatches", "forced_flushes",
+                 "deadline_flushes", "tail_flushes",
+                 "starvation_guard_trips"):
+        CODEC.counter(name).inc(0)
+    CODEC.gauge("queue_depth").set(0)
+    CODEC.gauge("batch_fill_pct").set(0.0)
+    CODEC.timer("queue_wait_seconds").update(0.0)
+    CODEC.timer("dispatch_seconds").update(0.0)
     text = m.prometheus_text()
     lines = text.splitlines()
     name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -114,16 +128,32 @@ def test_prometheus_text_golden_every_registry_renders():
         for k in reg._counters:
             want = f"{base}_{k.replace('.', '_').replace('-', '_')}"
             assert want in seen_metrics, f"{reg_name}: missing {want}"
-    # the documented lifecycle + resilience families specifically
+    # the documented lifecycle + resilience + codec-service families
     for want in ("lifecycle_keys_scanned", "lifecycle_transitions",
                  "lifecycle_bytes_tiered", "lifecycle_expirations",
                  "lifecycle_leader_fences", "lifecycle_sweep_seconds",
                  "client_resilience_deadline_exceeded",
-                 "client_resilience_hedges_fired"):
+                 "client_resilience_hedges_fired",
+                 "codec_service_submissions", "codec_service_dispatches",
+                 "codec_service_stripes_dispatched",
+                 "codec_service_slots_dispatched",
+                 "codec_service_coalesced_operations",
+                 "codec_service_multi_op_dispatches",
+                 "codec_service_forced_flushes",
+                 "codec_service_deadline_flushes",
+                 "codec_service_tail_flushes",
+                 "codec_service_starvation_guard_trips",
+                 "codec_service_queue_depth",
+                 "codec_service_batch_fill_pct",
+                 "codec_service_queue_wait_seconds",
+                 "codec_service_dispatch_seconds"):
         stem = want.removesuffix("_seconds")
         assert any(s.startswith(stem) for s in seen_metrics), want
     assert "# TYPE client_resilience_deadline_exceeded counter" in text
     assert "# HELP client_resilience_hedges_fired " in text
+    assert "# TYPE codec_service_dispatches counter" in text
+    assert "# HELP codec_service_tail_flushes " in text
+    assert "# TYPE codec_service_batch_fill_pct gauge" in text
 
 
 def test_tracing_spans_nest_and_propagate():

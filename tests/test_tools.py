@@ -480,12 +480,13 @@ def test_freon_fsg_and_sdg(cluster):
 def test_resilience_lint_no_hardcoded_timeouts_or_retry_sleeps():
     """Repo lint: straggler tolerance lives in client/resilience.py —
     a NEW hardcoded socket timeout (the old native_dn 120 s literal
-    class of bug) or a bare time.sleep retry loop in the client layer
-    OR the lifecycle subsystem (whose sweeps must ride
-    resilience.Deadline/RetryPolicy, never ad-hoc waits) bypasses
-    deadlines/jitter and fails this test. Deliberate exceptions
-    (injected chaos latency) carry a `# resilience-lint: allow`
-    marker."""
+    class of bug) or a bare time.sleep retry loop in the client layer,
+    the lifecycle subsystem, OR the shared codec service (whose
+    sweeps/waits must ride resilience.Deadline/RetryPolicy or the
+    linger/deadline-derived condition waits, never ad-hoc sleeps)
+    bypasses deadlines/jitter and fails this test. Deliberate
+    exceptions (injected chaos latency) carry a
+    `# resilience-lint: allow` marker."""
     import re
     from pathlib import Path
 
@@ -496,12 +497,24 @@ def test_resilience_lint_no_hardcoded_timeouts_or_retry_sleeps():
         r"(create_connection\(.*timeout\s*=\s*\d"
         r"|\.settimeout\(\s*\d)")
     pat_sleep = re.compile(r"\btime\.sleep\(")
+    # the codec service additionally bans NUMERIC-literal waits: every
+    # timeout in service.py must derive from the linger knob, the
+    # deadline margin, or the dispatch-time EWMA — a literal
+    # `.wait(0.1)` / `result(timeout=30)` would be a hidden latency
+    # policy outside the documented knob surface
+    pat_wait_literal = re.compile(
+        r"(\.wait\(\s*[\d.]"
+        r"|\bresult\(\s*timeout\s*=\s*[\d.]"
+        r"|\bjoin\(\s*timeout\s*=\s*[\d.])")
     offenders: list[str] = []
     for p in sorted(root.rglob("*.py")):
         if p.name == "resilience.py":
             continue
         rel = p.relative_to(root.parent)
-        no_sleep = p.parent.name in ("client", "lifecycle")
+        is_codec_service = (p.parent.name == "codec"
+                            and p.name == "service.py")
+        no_sleep = p.parent.name in ("client", "lifecycle") \
+            or is_codec_service
         for i, line in enumerate(p.read_text().splitlines(), 1):
             if "resilience-lint: allow" in line:
                 continue
@@ -514,6 +527,12 @@ def test_resilience_lint_no_hardcoded_timeouts_or_retry_sleeps():
                     f"{rel}:{i}: bare time.sleep in {p.parent.name}/ — "
                     f"retry/backoff sleeps must ride "
                     f"resilience.RetryPolicy")
+            if is_codec_service and pat_wait_literal.search(line):
+                offenders.append(
+                    f"{rel}:{i}: numeric-literal wait in the codec "
+                    f"service — timeouts there must derive from the "
+                    f"linger knob, the deadline margin, or the "
+                    f"dispatch EWMA")
     assert not offenders, "\n".join(offenders)
 
 
