@@ -32,7 +32,12 @@ __version__ = "0.1.0"
 # flow already, so the eager import costs nothing extra.)
 import os as _os
 
-if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+# OZONE_TPU_SKIP_JAX_PIN=1 keeps this package import jax-free for
+# tooling that never touches a device (ozlint's tier-1 gate shells out
+# to `python -m ozone_tpu.tools.lint` under a <5 s budget; a jax import
+# alone would blow it).
+if _os.environ.get("JAX_PLATFORMS", "").strip() == "cpu" and \
+        _os.environ.get("OZONE_TPU_SKIP_JAX_PIN", "") != "1":
     try:
         import jax as _jax
 

@@ -416,5 +416,5 @@ class DatanodeClientFactory:
         for c in clients:
             try:
                 c.close()
-            except Exception:  # noqa: BLE001 - best-effort teardown
+            except Exception:  # ozlint: allow[error-swallowing] -- best-effort pool teardown; a close failure has no recovery action
                 pass

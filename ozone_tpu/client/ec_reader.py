@@ -719,7 +719,7 @@ class ECBlockGroupReader:
                         e.unit, e.cause
                     )
                     self._failed.add(e.unit)
-                except _StragglerHedge:
+                except _StragglerHedge:  # ozlint: allow[error-swallowing] -- handled by design: units already excluded and counted by the recovery layer
                     # units already excluded + counted by the recovery
                     # layer: the retry reconstructs them (and anything
                     # already missing) in one batched decode pass

@@ -369,7 +369,7 @@ class ECKeyWriter:
                 # network writes
                 try:
                     a.copy_to_host_async()
-                except (AttributeError, RuntimeError):
+                except (AttributeError, RuntimeError):  # ozlint: allow[error-swallowing] -- optional eager-D2H hint; backends without it fall back to sync pull
                     pass
             prev, self._pending = self._pending, (stripes, parity_dev,
                                                   crcs_dev)

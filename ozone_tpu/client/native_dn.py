@@ -85,7 +85,7 @@ class _Conn:
         for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
             try:
                 self.sock.setsockopt(socket.SOL_SOCKET, opt, 8 * 1024 * 1024)
-            except OSError:
+            except OSError:  # ozlint: allow[error-swallowing] -- optional buffer tuning; kernel caps/refusals are fine
                 pass
 
     def arm(self, verb: str) -> None:
@@ -128,7 +128,7 @@ class _Conn:
     def close(self) -> None:
         try:
             self.sock.close()
-        except OSError:
+        except OSError:  # ozlint: allow[error-swallowing] -- best-effort socket teardown
             pass
 
 
@@ -199,7 +199,7 @@ class NativeDatanodeClient(GrpcDatanodeClient):
             import time
 
             # injected chaos latency, not a retry sleep
-            time.sleep(d)  # resilience-lint: allow
+            time.sleep(d)  # ozlint: allow[deadline-propagation] -- injected chaos latency must block like a real slow link (partition.py delay rule)
 
     def _status(self, conn: _Conn, body: bytes) -> None:
         m = json.loads(body) if body else {}

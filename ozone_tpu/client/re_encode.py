@@ -23,7 +23,6 @@ from ozone_tpu.codec import service as codec_service
 from ozone_tpu.client.ec_writer import ECKeyWriter
 from ozone_tpu.client.replicated import ReplicatedKeyReader
 from ozone_tpu.om.om import OzoneManager
-from ozone_tpu.om import requests as rq
 from ozone_tpu.scm.pipeline import ReplicationConfig, ReplicationType
 from ozone_tpu.storage.ids import (
     BlockData,
@@ -74,6 +73,13 @@ def re_encode_key_to_ec(
 
     ec_conf = ReplicationConfig.parse(ec)
     session = om.open_key(volume, bucket, key, replication=ec)
+    # rewrite fence on the SCANNED version (the lifecycle transition
+    # contract): a user overwrite racing the background conversion must
+    # win — an unfenced commit here would replace their fresh data with
+    # a stale re-encode. check_rewrite_fence rejects with KEY_MODIFIED
+    # and routes the conversion's blocks to the purge chain.
+    session.expect_object_id = info.get("object_id", "")
+    session.expect_generation = int(info.get("generation", -1))
     writer = ECKeyWriter(
         ec_conf.ec,
         lambda excluded, excluded_containers=():
@@ -87,11 +93,11 @@ def re_encode_key_to_ec(
     for g in old_groups:
         writer.write(ReplicatedKeyReader(g, clients).read_all())
     groups = writer.close()
-    # commit replaces the key's block list; the old key version moves to
-    # the deleted table so its blocks retire through the SCM chain
-    om.submit(
-        rq.DeleteKey(volume, bucket, key)
-    )
+    # the fenced commit replaces the key's block list atomically:
+    # finalize_commit routes the superseded replicated version into the
+    # purge chain (its blocks retire through scm/block_deletion), so no
+    # separate unfenced DeleteKey is needed — the old delete-then-commit
+    # pair could silently destroy a concurrent user overwrite
     om.commit_key(session, groups, writer.bytes_written)
 
     log.info(
@@ -195,6 +201,11 @@ def re_encode_xor_key_to_rs(
     p = dst.ec.parity_units
 
     session = om.open_key(volume, bucket, key, replication=ec)
+    # same rewrite fence as the replicated->EC path: the conversion
+    # loses deterministically (KEY_MODIFIED) to any commit that landed
+    # after the scan, instead of clobbering it
+    session.expect_object_id = info.get("object_id", "")
+    session.expect_generation = int(info.get("generation", -1))
     new_groups = []
     total = 0
     window = decode_batch_size()
@@ -307,7 +318,6 @@ def re_encode_xor_key_to_rs(
         new_groups.append(ng)
         total += g.length
 
-    om.submit(rq.DeleteKey(volume, bucket, key))
     om.commit_key(session, new_groups, total)
     log.info(
         "fused XOR->RS re-encode %s/%s/%s: %d bytes, %d groups",

@@ -199,12 +199,21 @@ class RetryPolicy:
     base_s: float = 0.25
     cap_s: float = 5.0
     max_attempts: int = 8
+    #: 0.0 = FULL jitter (default). Raise to guarantee a fraction of
+    #: the exponential ladder: 0.5 is AWS "equal jitter" — sleep =
+    #: hi/2 + uniform(0, hi/2). Leader-failover loops use it so the
+    #: retry window provably outlives an election (a full-jitter
+    #: ladder can draw near-zero sleeps across EVERY attempt and burn
+    #: the whole attempt budget mid-election), while retries still
+    #: decorrelate across clients.
+    floor_fraction: float = 0.0
 
     def backoff_s(self, attempt: int,
                   rng: Optional[random.Random] = None) -> float:
         hi = min(self.cap_s, self.base_s * (2.0 ** max(0, attempt)))
-        r = rng.uniform(0.0, hi) if rng is not None \
-            else random.uniform(0.0, hi)
+        lo = hi * min(1.0, max(0.0, self.floor_fraction))
+        r = rng.uniform(lo, hi) if rng is not None \
+            else random.uniform(lo, hi)
         return r
 
     def sleep(self, attempt: int,
@@ -227,6 +236,18 @@ class RetryPolicy:
         METRICS.counter("retries_slept").inc()
         time.sleep(d)
         return not (deadline is not None and deadline.expired())
+
+
+def failover_retry_policy(attempts: int) -> RetryPolicy:
+    """The ONE tuning for leader-failover loops (OM and SCM clients):
+    equal-jitter capped exponential — jitter decorrelates clients that
+    failed together, while the 0.5 floor keeps the summed window long
+    enough to provably outlive an election on a slow rig (full jitter
+    can draw near-zero sleeps across every attempt and burn the whole
+    attempt budget mid-election; soak seed 31337 reproduced exactly
+    that as total writer starvation)."""
+    return RetryPolicy(base_s=0.2, cap_s=0.6, max_attempts=attempts,
+                       floor_fraction=0.5)
 
 
 # ---------------------------------------------------------------- health

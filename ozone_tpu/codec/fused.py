@@ -456,6 +456,7 @@ def make_fused_decoder(spec: FusedSpec, valid: list[int], erased: list[int]):
 
 
 @lru_cache(maxsize=16)
+# ozlint: allow[dispatch-shape-stability] -- `lost` is bounded by data_units (<= a handful of programs, all cache-resident); folding it into the matrix as a traced arg would forfeit the single fused dispatch
 def _fused_reencode_cached(options: CoderOptions, checksum: ChecksumType,
                            bpc: int, lost: int):
     """XOR(1)-decode -> RS(k,p)-encode as ONE bit-linear matrix.

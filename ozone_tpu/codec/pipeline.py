@@ -46,7 +46,7 @@ def _start_d2h(out: Any) -> None:
     # ec_writer._flush_queue)
     try:
         out.copy_to_host_async()
-    except (AttributeError, RuntimeError):
+    except (AttributeError, RuntimeError):  # ozlint: allow[error-swallowing] -- optional eager-D2H hint; backends without it fall back to sync pull
         pass
 
 

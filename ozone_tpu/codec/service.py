@@ -95,7 +95,7 @@ def qos_weights() -> dict[str, float]:
         cls, _, w = part.partition("=")
         try:
             out[cls.strip()] = max(1e-6, float(w))
-        except ValueError:
+        except ValueError:  # ozlint: allow[error-swallowing] -- malformed OZONE_TPU_CODEC_QOS entry: skip it, defaults cover the class
             continue
     return out
 

@@ -170,6 +170,13 @@ class TieringExecutor:
                         METRICS.counter("bytes_tiered").inc(
                             int(info.get("size", 0)))
                     except (rq.OMError, StorageError) as e:
+                        if getattr(e, "code", "") == rq.KEY_MODIFIED:
+                            # the re-encode's rewrite fence lost to a
+                            # concurrent user overwrite: expected race,
+                            # same accounting as the packer path
+                            METRICS.counter("transition_conflicts").inc()
+                            stats["conflicts"] += 1
+                            continue
                         log.warning("lifecycle: xor re-encode of "
                                     "%s/%s/%s failed: %s",
                                     volume, bucket, key, e)

@@ -35,6 +35,7 @@ def build_shared(src: Path, so: Path, compiler: str = "g++",
     with _lock:
         try:
             if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+                # ozlint: allow[blocking-under-lock] -- one-shot build-on-demand: the lock exists precisely to serialize the compile, bounded by timeout=120
                 subprocess.run(
                     [compiler, "-O2", "-shared", "-fPIC", "-o", str(so),
                      str(src), *extra],

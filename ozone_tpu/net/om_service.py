@@ -561,7 +561,7 @@ class GrpcOmClient:
         return _ctx()
 
     def _call(self, method: str, **meta) -> dict:
-        import time as _time
+        from ozone_tpu.client import resilience
 
         ident = getattr(self._caller, "identity", None)
         if ident is not None and ident[0] is not None:
@@ -572,6 +572,10 @@ class GrpcOmClient:
         payload = wire.pack(meta)
         last: Exception | None = None
         attempts = max(4, 3 * len(self.addresses))
+        # failover backoff: see resilience.failover_retry_policy — the
+        # tuning (and its outlive-the-election rationale) lives there,
+        # shared with the SCM client
+        policy = resilience.failover_retry_policy(attempts)
         for attempt in range(attempts):
             addr, ch = self._pool.channel()
             try:
@@ -595,7 +599,11 @@ class GrpcOmClient:
                     self._pool.rotate()
                 else:
                     raise
-            _time.sleep(min(0.1 * (attempt + 1), 0.5))
+            if not policy.sleep(attempt):
+                # budget spent: surface fail-fast DEADLINE_EXCEEDED
+                # instead of the transport-shaped error below
+                resilience.check_deadline("om_failover")
+                break
         raise StorageError("IO_EXCEPTION",
                            f"no OM leader reachable: {last}")
 

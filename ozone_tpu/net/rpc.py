@@ -299,13 +299,13 @@ class RpcChannel:
             # caller's deadline: latency past the timeout behaves like a
             # real slow link — block until the deadline, then fail
             if timeout is not None and d >= timeout:
-                _time.sleep(timeout)
+                _time.sleep(timeout)  # ozlint: allow[deadline-propagation] -- injected chaos latency must block like a real slow link; bounded by the caller's timeout
                 raise StorageError(
                     "UNAVAILABLE",
                     f"rpc {key} to {self.address}: injected latency "
                     f"{d}s exceeded deadline {timeout}s",
                 )
-            _time.sleep(d)
+            _time.sleep(d)  # ozlint: allow[deadline-propagation] -- injected chaos latency, not a retry sleep (partition.py delay rule)
 
     def call_streaming(self, service: str, method: str, frames,
                        timeout: Optional[float] = 120.0) -> bytes:
@@ -445,7 +445,7 @@ class FailoverChannels:
             del self._chs[addr]
         try:
             ch.close()
-        except Exception:  # noqa: BLE001 - teardown best-effort
+        except Exception:  # ozlint: allow[error-swallowing] -- best-effort channel teardown
             pass
 
     def reconcile(self, ring: list) -> None:

@@ -438,16 +438,17 @@ class GrpcScmClient:
 
     def _call(self, method: str, meta: dict,
               timeout: Optional[float] = 30.0) -> dict:
-        import time as _time
+        from ozone_tpu.client import resilience
 
         payload = wire.pack(meta)
         last: Optional[Exception] = None
-        # backoff between failover attempts (same shape as the OM
-        # client): during an election every replica answers
-        # SCM_NOT_LEADER instantly, and a sleepless loop burns the
-        # whole retry budget in milliseconds instead of outliving the
-        # election
+        # backoff between failover attempts: during an election every
+        # replica answers SCM_NOT_LEADER instantly, and a sleepless
+        # loop burns the whole retry budget in milliseconds instead of
+        # outliving the election. Tuning shared with the OM client —
+        # see resilience.failover_retry_policy.
         attempts = max(4, 3 * len(self.addresses))
+        policy = resilience.failover_retry_policy(attempts)
         for attempt in range(attempts):
             addr, ch = self._pool.channel()
             try:
@@ -467,8 +468,9 @@ class GrpcScmClient:
                     self._pool.rotate()
                 else:
                     raise
-            if attempt < attempts - 1:  # no dead time before raising
-                _time.sleep(min(0.1 * (attempt + 1), 0.5))
+            if not policy.sleep(attempt):  # no dead time before raising
+                resilience.check_deadline("scm_failover")
+                break
         raise last
 
     def _broadcast(self, method: str, meta: dict,

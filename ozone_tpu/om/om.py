@@ -872,6 +872,7 @@ class OzoneManager:
         else:
             if self._is_legacy(binfo):
                 key = rq.normalize_fs_path(key)
+            # ozlint: allow[fence-carrying-commit] -- user-initiated delete: unfenced latest-version semantics IS the API contract
             self.submit(rq.DeleteKey(volume, bucket, key))
         self.metrics.counter("keys_deleted").inc()
 

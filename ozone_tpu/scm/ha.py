@@ -351,8 +351,8 @@ class RaftSCM:
                         self._committed_seq = rec["seq"]
                         self._ack_cv.notify_all()
                     break
-                except TimeoutError:
-                    continue  # keep retrying while still leader
+                except TimeoutError:  # ozlint: allow[error-swallowing] -- keep retrying the quorum commit while still leader
+                    continue
 
     def _maybe_resync(self) -> None:
         import queue as _queue
@@ -371,7 +371,7 @@ class RaftSCM:
                     self._committed_seq = max(self._committed_seq,
                                               rec["seq"])
                     self._ack_cv.notify_all()
-        except _queue.Empty:
+        except _queue.Empty:  # ozlint: allow[error-swallowing] -- Empty terminates the drain loop by design
             pass
         try:
             if self.node.fetch_state_from(hint):

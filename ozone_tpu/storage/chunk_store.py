@@ -105,7 +105,7 @@ class FilePerBlockStore:
         if ent.fd >= 0:
             try:
                 os.close(ent.fd)
-            except OSError:
+            except OSError:  # ozlint: allow[error-swallowing] -- best-effort fd-cache eviction
                 pass
             ent.fd = -1
 

@@ -218,6 +218,6 @@ def import_container(dn: Datanode, data: bytes,
         if created is not None:
             try:
                 dn.delete_container(created.id, force=True)
-            except Exception:  # noqa: BLE001 - best-effort cleanup
+            except Exception:  # ozlint: allow[error-swallowing] -- best-effort cleanup of the half-imported container; the original error re-raises below
                 pass
         raise

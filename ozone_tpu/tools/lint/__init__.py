@@ -1,0 +1,37 @@
+"""ozlint: AST-based invariant analyzer for the ozone_tpu tree.
+
+The repo encodes hard invariants in prose (docs/LINT.md) — deadline
+propagation, no blocking under a lock, fence-carrying ring commits,
+constant-shape device dispatch, no swallowed datapath errors — and each
+one has already cost a real bug (the native_dn 120 s connect literal,
+the dial-before-bind channel wedge, the plan-cache recompile
+bimodality). ozlint is the structural enforcement: `python -m
+ozone_tpu.tools.lint ozone_tpu/` walks every file's AST and reports any
+code that violates an invariant and does not carry an in-line
+justification (`# ozlint: allow[rule-id] -- reason`).
+
+This package must stay import-light: no jax, no ozone_tpu runtime
+modules — the tier-1 gate runs it as a sub-second subprocess.
+"""
+
+from ozone_tpu.tools.lint.core import (  # noqa: F401
+    Finding,
+    LintError,
+    RULES,
+    SourceFile,
+    format_findings,
+    lint_paths,
+    lint_source,
+    rewrite_legacy_suppressions,
+)
+
+__all__ = [
+    "Finding",
+    "LintError",
+    "RULES",
+    "SourceFile",
+    "format_findings",
+    "lint_paths",
+    "lint_source",
+    "rewrite_legacy_suppressions",
+]

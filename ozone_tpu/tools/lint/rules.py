@@ -1,0 +1,672 @@
+"""The five production ozlint rules.
+
+Each rule guards an invariant the repo states in prose and has already
+paid for in bugs (docs/LINT.md has the full origin stories):
+
+- ``deadline-propagation``  every timeout in the client/net/lifecycle
+  datapath and the codec service derives from ``resilience.Deadline``
+  (PR 2's hardcoded-120s-connect class of bug). Strictly subsumes the
+  old regex lint in tests/test_tools.py: constant folding + name
+  resolution catch keyword args and computed literals the regex missed.
+- ``blocking-under-lock``   no blocking call while holding a lock (the
+  codec-service dispatcher/double-buffer race-detector shape).
+- ``fence-carrying-commit`` ring mutations of term-fenced state carry
+  their fencing term / expected object id (PR 4's deposed-leader and
+  racing-overwrite class of bug).
+- ``dispatch-shape-stability`` jitted device programs must not be keyed
+  on known-varying values (PR 1/PR 6's plan-cache recompile
+  bimodality).
+- ``error-swallowing``      no silently dropped exceptions on datapath
+  or consensus modules.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ozone_tpu.tools.lint.core import Finding, Rule, SourceFile, register
+
+# --------------------------------------------------------- AST helpers
+
+
+def dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression: ``socket.create_connection``,
+    ``self._cond.wait`` -> empty string for non-name shapes."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    return ""
+
+
+def last_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def receiver_name(call_func: ast.AST) -> str:
+    """For ``a.b.wait(...)`` the receiver's final segment (``b``)."""
+    if isinstance(call_func, ast.Attribute):
+        return last_name(call_func.value)
+    return ""
+
+
+def _span(node: ast.AST) -> tuple[int, int]:
+    return (node.lineno, getattr(node, "end_lineno", node.lineno)
+            or node.lineno)
+
+
+class _ConstEnv:
+    """Single-assignment numeric-constant environment: module-level and
+    function-local ``NAME = 120.0`` style bindings, poisoned on
+    reassignment so only provably-constant names resolve."""
+
+    def __init__(self) -> None:
+        self._vals: dict[str, Optional[float]] = {}
+
+    def bind(self, name: str, value: Optional[float]) -> None:
+        if name in self._vals:
+            self._vals[name] = None  # reassigned: no longer provable
+        else:
+            self._vals[name] = value
+
+    def get(self, name: str) -> Optional[float]:
+        return self._vals.get(name)
+
+
+def _fold(node: ast.AST, env: _ConstEnv) -> Optional[float]:
+    """Resolve an expression to a numeric constant, through unary/binary
+    arithmetic and single-assignment name bindings. None = not provable."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bool) or not isinstance(
+                node.value, (int, float)):
+            return None
+        return float(node.value)
+    if isinstance(node, ast.UnaryOp) and isinstance(
+            node.op, (ast.USub, ast.UAdd)):
+        v = _fold(node.operand, env)
+        if v is None:
+            return None
+        return -v if isinstance(node.op, ast.USub) else v
+    if isinstance(node, ast.BinOp):
+        a, b = _fold(node.left, env), _fold(node.right, env)
+        if a is None or b is None:
+            return None
+        try:
+            if isinstance(node.op, ast.Add):
+                return a + b
+            if isinstance(node.op, ast.Sub):
+                return a - b
+            if isinstance(node.op, ast.Mult):
+                return a * b
+            if isinstance(node.op, ast.Div):
+                return a / b
+            if isinstance(node.op, ast.FloorDiv):
+                return a // b
+            if isinstance(node.op, ast.Pow):
+                return a ** b
+        except (ZeroDivisionError, OverflowError):
+            return None
+        return None
+    if isinstance(node, ast.Name):
+        return env.get(node.id)
+    return None
+
+
+def _scope_walk(body: Iterable[ast.stmt]):
+    """Every node in this scope — including except-handler bodies, loop
+    bodies, with-blocks — but NOT nested function/class scopes. Yields
+    in SOURCE order (pre-order DFS): constant folding relies on seeing
+    a name's first binding before its uses in later assignments."""
+    stack = list(reversed(list(body)))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue  # separate scope
+        stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def _collect_env(body: Iterable[ast.stmt], env: _ConstEnv,
+                 *, recurse: bool = True) -> None:
+    """Bind simple ``NAME = <expr>`` assignments (value folded eagerly;
+    a second binding — or any dynamic one: loop targets, ``with … as``,
+    except-handler rebinds, walrus — poisons the name, so partial
+    knowledge never produces a false constant)."""
+    nodes = _scope_walk(body) if recurse else list(body)
+    for stmt in nodes:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            env.bind(stmt.targets[0].id, _fold(stmt.value, env))
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None \
+                and isinstance(stmt.target, ast.Name):
+            env.bind(stmt.target.id, _fold(stmt.value, env))
+        elif isinstance(stmt, ast.AugAssign) and isinstance(
+                stmt.target, ast.Name):
+            env.bind(stmt.target.id, None)
+        elif isinstance(stmt, ast.Assign):
+            # tuple/starred/attribute targets: poison every plain name
+            for t in stmt.targets:
+                for nn in ast.walk(t):
+                    if isinstance(nn, ast.Name):
+                        env.bind(nn.id, None)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            for nn in ast.walk(stmt.target):
+                if isinstance(nn, ast.Name):
+                    env.bind(nn.id, None)
+        elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    for nn in ast.walk(item.optional_vars):
+                        if isinstance(nn, ast.Name):
+                            env.bind(nn.id, None)
+        elif isinstance(stmt, ast.ExceptHandler) and stmt.name:
+            env.bind(stmt.name, None)
+        elif isinstance(stmt, ast.NamedExpr) and \
+                isinstance(stmt.target, ast.Name):
+            env.bind(stmt.target.id, None)
+
+
+def _fn_env(module_env: _ConstEnv, fn) -> _ConstEnv:
+    env = _ConstEnv()
+    env._vals.update(module_env._vals)
+    if fn is not None:
+        # parameters are caller-supplied, never provably constant (and
+        # a later local assignment over the same name stays poisoned)
+        for a in list(fn.args.args) + list(fn.args.kwonlyargs) + \
+                list(fn.args.posonlyargs):
+            env.bind(a.arg, None)
+        _collect_env(fn.body, env)
+    return env
+
+
+# ------------------------------------------------- deadline-propagation
+@register
+class DeadlinePropagation(Rule):
+    id = "deadline-propagation"
+    summary = ("timeouts in client/, net/, lifecycle/ and the codec "
+               "service must derive from resilience.Deadline, never "
+               "from numeric literals; socket timeouts repo-wide")
+    rationale = (
+        "PR 2's root bug: native_dn hardcoded a 120 s connect timeout, "
+        "so a dead peer consumed the whole operation budget before the "
+        "first retry. Every hop's timeout must derive from the ambient "
+        "resilience.Deadline (op_timeout()/Deadline.timeout()) or an "
+        "EWMA/env-derived knob. Supersedes the regex lint in "
+        "tests/test_tools.py, which missed keyword args and computed "
+        "literals.")
+
+    SLEEPS = {"sleep"}
+    TIMEOUT_KWARGS = {"timeout", "timeout_s", "deadline_s"}
+    POSITIONAL_WAITS = {"wait", "join", "result", "wait_for"}
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if src.is_module("client", "resilience.py"):
+            return  # the implementation of the discipline itself
+        in_scope = (src.in_dirs("client", "net", "lifecycle")
+                    or src.is_module("codec", "service.py"))
+        module_env = _ConstEnv()
+        _collect_env(src.tree.body, module_env, recurse=False)
+        # per-function env memo, scoped to THIS check pass: fn nodes
+        # stay alive via src.tree, so id() keys cannot be recycled
+        # (a process-global id-keyed cache could alias freed nodes)
+        envs: dict[int, _ConstEnv] = {}
+        for call, fn in src.calls_with_fn:
+            key = id(fn)
+            env = envs.get(key)
+            if env is None:
+                env = envs[key] = _fn_env(module_env, fn)
+            name = last_name(call.func)
+            dot = dotted(call.func)
+
+            # socket timeouts: repo-wide (the 120 s connect class)
+            if name == "create_connection":
+                for kw in call.keywords:
+                    if kw.arg == "timeout" and \
+                            _fold(kw.value, env) is not None:
+                        yield self._f(src, kw.value,
+                                      "socket connect timeout is a "
+                                      "numeric literal")
+                if len(call.args) >= 2 and \
+                        _fold(call.args[1], env) is not None:
+                    yield self._f(src, call.args[1],
+                                  "socket connect timeout is a "
+                                  "numeric literal")
+                continue
+            if name == "settimeout" and call.args and \
+                    _fold(call.args[0], env) is not None:
+                yield self._f(src, call.args[0],
+                              "socket timeout is a numeric literal")
+                continue
+
+            if not in_scope:
+                continue
+
+            # bare sleeps: backoff belongs to resilience.RetryPolicy
+            if dot in ("time.sleep", "_time.sleep"):
+                yield self._f(
+                    src, call, "bare time.sleep on a deadline-scoped "
+                    "path — retries/backoff must ride "
+                    "resilience.RetryPolicy", what="call")
+                continue
+
+            # literal timeout keyword on any call
+            for kw in call.keywords:
+                if kw.arg in self.TIMEOUT_KWARGS and \
+                        _fold(kw.value, env) is not None:
+                    yield self._f(src, kw.value,
+                                  f"literal `{kw.arg}=` on `{dot or name}()`")
+            # literal positional timeout on the known blocking verbs
+            if name in self.POSITIONAL_WAITS and len(call.args) == 1 \
+                    and _fold(call.args[0], env) is not None:
+                yield self._f(src, call.args[0],
+                              f"literal timeout passed to `.{name}()`")
+
+    def _f(self, src: SourceFile, node: ast.AST, what_msg: str,
+           what: str = "timeout") -> Finding:
+        msg = what_msg if what == "call" else (
+            f"{what_msg} — derive it from resilience.op_timeout()/"
+            f"Deadline.timeout() or a documented env knob")
+        return Finding(self.id, src.display_path, node.lineno, msg,
+                       span=_span(node))
+
+
+# ------------------------------------------------- blocking-under-lock
+@register
+class BlockingUnderLock(Rule):
+    id = "blocking-under-lock"
+    summary = ("no blocking call (sleep, future/thread join, queue get, "
+               "socket or device I/O) lexically inside a held lock")
+    rationale = (
+        "The codec-service dispatcher packs under self._cond but "
+        "dispatches to the chip OUTSIDE it; holding any lock across a "
+        "blocking call is the lock-convoy/deadlock shape that "
+        "thread-sanitizer gates catch in mature storage systems. "
+        "Condition.wait() is exempt — it releases the lock.")
+
+    SOCKET_OPS = {"recv", "recv_into", "sendall", "accept", "connect",
+                  "create_connection"}
+    DEVICE_OPS = {"block_until_ready", "device_put", "wait_result",
+                  "drain"}
+    SUBPROC_OPS = {"communicate", "check_output", "check_call"}
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if src.in_dirs("testing", "tools"):
+            return
+        findings: list[Finding] = []
+
+        def lockish(expr: ast.AST) -> Optional[str]:
+            n = last_name(expr).lower()
+            if isinstance(expr, ast.Call):
+                n = last_name(expr.func).lower()
+            if any(t in n for t in ("lock", "mutex", "cond")) or \
+                    n in ("cv", "_cv"):
+                return n
+            return None
+
+        def condish(name: str) -> bool:
+            n = name.lower()
+            return "cond" in n or n in ("cv", "_cv")
+
+        def classify(call: ast.Call) -> Optional[str]:
+            name = last_name(call.func)
+            dot = dotted(call.func)
+            recv = receiver_name(call.func)
+            if dot in ("time.sleep", "_time.sleep"):
+                return "time.sleep"
+            if name in self.SOCKET_OPS and recv not in ("self",):
+                return f"socket .{name}()"
+            if name in self.DEVICE_OPS or "dispatch" in name.lower():
+                return f"device/pipeline `{name}()`"
+            if dot.startswith("subprocess.") and name in (
+                    self.SUBPROC_OPS | {"run", "call"}):
+                return f"subprocess.{name}()"
+            if name in self.SUBPROC_OPS:
+                return f".{name}()"
+            if name == "result":
+                return "future .result()"
+            if name == "join" and _join_is_thread_join(call):
+                return "thread .join()"
+            if name in ("wait", "wait_for") and not condish(recv):
+                return f"non-condition .{name}()"
+            if name == "get" and not call.args and (
+                    not call.keywords or all(
+                        k.arg in ("block", "timeout")
+                        for k in call.keywords)):
+                return "queue .get()"
+            return None
+
+        def _join_is_thread_join(call: ast.Call) -> bool:
+            """Distinguish Thread.join([timeout]) from str.join(iter):
+            zero args, a timeout kwarg, or a single numeric arg."""
+            if any(k.arg == "timeout" for k in call.keywords):
+                return True
+            if not call.args and not call.keywords:
+                return True
+            return (len(call.args) == 1
+                    and isinstance(call.args[0], ast.Constant)
+                    and isinstance(call.args[0].value, (int, float)))
+
+        def scan_expr(node: ast.AST, held: list[str]) -> None:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    kind = classify(sub)
+                    if kind:
+                        findings.append(Finding(
+                            self.id, src.display_path, sub.lineno,
+                            f"blocking {kind} while holding "
+                            f"`{held[-1]}` — move the blocking work "
+                            f"outside the lock or use a Condition",
+                            span=_span(sub)))
+
+        def scan_body(body: list[ast.stmt], held: list[str]) -> None:
+            # NB: mutates the caller's `held` in place so a release()
+            # inside a nested block (the acquire/try/finally:release
+            # idiom) unwinds the lock for the statements that follow;
+            # `with` blocks pass a fresh list since their lock scope
+            # ends with the block
+            for stmt in body:
+                # acquire()/release() bracketing in this statement list
+                if isinstance(stmt, ast.Expr) and isinstance(
+                        stmt.value, ast.Call):
+                    nm = last_name(stmt.value.func)
+                    tgt = dotted(stmt.value.func)
+                    recv = (stmt.value.func.value
+                            if isinstance(stmt.value.func, ast.Attribute)
+                            else stmt.value.func)
+                    if nm == "acquire" and lockish(recv) is not None:
+                        held.append(tgt.rsplit(".", 1)[0] or "lock")
+                        continue
+                    # only a LOCK-like receiver's release() unwinds —
+                    # a buffer/semaphore release inside the region must
+                    # not hide blocking calls that follow it
+                    if nm == "release" and held and \
+                            lockish(recv) is not None:
+                        held.pop()
+                        continue
+                if isinstance(stmt, ast.With):
+                    locks = [lockish(item.context_expr)
+                             for item in stmt.items]
+                    new_held = list(held) + [n for n in locks if n]
+                    if held:
+                        for item in stmt.items:
+                            scan_expr(item.context_expr, held)
+                    scan_body(stmt.body, new_held)
+                    continue
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    # nested def: body runs later, not under this lock
+                    scan_body(stmt.body, [])
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    scan_body(stmt.body, [])
+                    continue
+                if held:
+                    # flag blocking calls in this statement's own
+                    # expressions, then recurse into compound bodies
+                    for field_name, value in ast.iter_fields(stmt):
+                        if field_name in ("body", "orelse", "finalbody",
+                                          "handlers"):
+                            continue
+                        if isinstance(value, ast.AST):
+                            scan_expr(value, held)
+                        elif isinstance(value, list):
+                            for v in value:
+                                if isinstance(v, ast.AST):
+                                    scan_expr(v, held)
+                for field_name in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field_name, None)
+                    if sub:
+                        scan_body(sub, held)
+                for h in getattr(stmt, "handlers", []) or []:
+                    scan_body(h.body, held)
+
+        scan_body(src.tree.body, [])
+        yield from findings
+
+
+# ----------------------------------------------- fence-carrying-commit
+@register
+class FenceCarryingCommit(Rule):
+    id = "fence-carrying-commit"
+    summary = ("ring requests that mutate term-fenced state must pass "
+               "their fencing term / expected object id")
+    rationale = (
+        "PR 4's duplicate-allocation and lifecycle lessons: an unfenced "
+        "mutation from a deposed leader or a background job racing a "
+        "user overwrite silently loses data. LifecycleCheckpoint must "
+        "carry `term`; CommitKey/CommitFile/DeleteKey must carry "
+        "`expect_object_id` (\"\" only where unfenced semantics are the "
+        "documented API, with an ozlint suppression saying why).")
+
+    #: constructor -> (required kwarg, positional index or None)
+    FENCED = {
+        "LifecycleCheckpoint": ("term", 0),
+        "CommitKey": ("expect_object_id", None),
+        "CommitFile": ("expect_object_id", None),
+        "DeleteKey": ("expect_object_id", None),
+    }
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if src.is_module("om", "requests.py") or \
+                src.is_module("om", "fso.py") or \
+                src.in_dirs("testing", "tools"):
+            return
+        for call, _fn in src.calls_with_fn:
+            name = last_name(call.func)
+            spec = self.FENCED.get(name)
+            if spec is None:
+                continue
+            field_name, pos = spec
+            has_kw = any(k.arg == field_name for k in call.keywords)
+            has_pos = pos is not None and len(call.args) > pos
+            if not (has_kw or has_pos):
+                yield Finding(
+                    self.id, src.display_path, call.lineno,
+                    f"`{name}(...)` mutates term-fenced state but does "
+                    f"not pass `{field_name}` — an unfenced commit can "
+                    f"race a concurrent overwrite or a deposed leader",
+                    span=_span(call))
+
+
+# ------------------------------------------- dispatch-shape-stability
+@register
+class DispatchShapeStability(Rule):
+    id = "dispatch-shape-stability"
+    summary = ("jitted device programs must not be specialized on "
+               "known-varying values (erasure pattern, batch width)")
+    rationale = (
+        "PR 1 made the recovery matrix a traced argument after per-"
+        "erasure-pattern closures thrashed the jit cache; PR 6's bench "
+        "bimodality was first-touch plan compiles hiding in the timed "
+        "region. A `static_argnames` entry or an lru_cache key that "
+        "varies per request compiles one XLA program per value.")
+
+    VARYING = {"erased", "valid", "pattern", "erasure",
+               "erasure_pattern", "batch", "width", "batch_width",
+               "n_stripes", "stripes", "lost", "survivors", "recovery"}
+    ARRAY_CTORS = {"zeros", "ones", "empty", "full", "arange"}
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        for node in src.functions:
+            yield from self._check_def(src, node)
+
+    # -- helpers -------------------------------------------------------
+    def _jit_call(self, call: ast.Call) -> Optional[ast.Call]:
+        """The jax.jit(...) call inside `jax.jit(...)` or
+        `functools.partial(jax.jit, ...)`, else None."""
+        if last_name(call.func) == "jit":
+            return call
+        if last_name(call.func) == "partial" and call.args and \
+                last_name(call.args[0]) == "jit":
+            return call
+        return None
+
+    def _static_names(self, call: ast.Call,
+                      fn=None) -> list[tuple[str, ast.AST]]:
+        names: list[tuple[str, ast.AST]] = []
+        params = []
+        if fn is not None:
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for s in ast.walk(kw.value):
+                    if isinstance(s, ast.Constant) and \
+                            isinstance(s.value, str):
+                        names.append((s.value, kw.value))
+            elif kw.arg == "static_argnums" and params:
+                for s in ast.walk(kw.value):
+                    if isinstance(s, ast.Constant) and \
+                            isinstance(s.value, int) and \
+                            0 <= s.value < len(params):
+                        names.append((params[s.value], kw.value))
+        return names
+
+    def _is_lru_cached(self, fn) -> bool:
+        for dec in fn.decorator_list:
+            name = last_name(dec.func) if isinstance(dec, ast.Call) \
+                else last_name(dec)
+            if name in ("lru_cache", "cache"):
+                return True
+        return False
+
+    def _has_jit_marker(self, fn) -> bool:
+        for dec in fn.decorator_list:
+            if last_name(dec) == "jit" or (
+                    isinstance(dec, ast.Call)
+                    and self._jit_call(dec) is not None):
+                return True
+        return False
+
+    # -- checks --------------------------------------------------------
+    def _check_def(self, src: SourceFile, fn) -> Iterable[Finding]:
+        # (a) static_argnames/static_argnums naming a varying value —
+        # on the decorator or any jit() call inside the body
+        for dec in fn.decorator_list:
+            if isinstance(dec, ast.Call) and \
+                    self._jit_call(dec) is not None:
+                for nm, where in self._static_names(dec, fn):
+                    if nm in self.VARYING:
+                        yield Finding(
+                            self.id, src.display_path, where.lineno,
+                            f"jit static arg `{nm}` is a known-varying "
+                            f"value — every new value compiles a new "
+                            f"XLA program; pass it as a traced array "
+                            f"(the PR 1 decode-plan treatment)",
+                            span=_span(where))
+        decorator_calls = {id(d) for d in fn.decorator_list}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    id(node) not in decorator_calls:
+                jc = self._jit_call(node)
+                if jc is not None and jc is node:
+                    for nm, where in self._static_names(node):
+                        if nm in self.VARYING:
+                            yield Finding(
+                                self.id, src.display_path, where.lineno,
+                                f"jit static arg `{nm}` is a known-"
+                                f"varying value — every new value "
+                                f"compiles a new XLA program",
+                                span=_span(where))
+
+        # (b) an lru_cache'd factory keyed on a varying parameter that
+        # builds a jitted program per call = per-value compile
+        if self._is_lru_cached(fn):
+            varying = [a.arg for a in
+                       fn.args.posonlyargs + fn.args.args +
+                       fn.args.kwonlyargs if a.arg in self.VARYING]
+            if varying and self._contains_jit(fn):
+                yield Finding(
+                    self.id, src.display_path, fn.lineno,
+                    f"lru_cache'd jit-program factory keyed on varying "
+                    f"parameter(s) {', '.join(varying)} — each value "
+                    f"compiles a distinct XLA program; make it a "
+                    f"traced argument or bound the key space",
+                    span=(fn.lineno, fn.lineno))
+
+        # (c) array constructors inside a jitted def whose shape pulls a
+        # varying closure variable (not a parameter, not a local)
+        if self._has_jit_marker(fn):
+            params = {a.arg for a in fn.args.posonlyargs + fn.args.args
+                      + fn.args.kwonlyargs}
+            local = set(params)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        for nn in ast.walk(t):
+                            if isinstance(nn, ast.Name):
+                                local.add(nn.id)
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        last_name(node.func) in self.ARRAY_CTORS and \
+                        node.args:
+                    shape = node.args[0]
+                    for nn in ast.walk(shape):
+                        if isinstance(nn, ast.Name) and \
+                                nn.id not in local and \
+                                nn.id in self.VARYING:
+                            yield Finding(
+                                self.id, src.display_path, nn.lineno,
+                                f"array shape inside a jitted function "
+                                f"uses closure-captured varying value "
+                                f"`{nn.id}` — the program re-traces "
+                                f"per value; derive shapes from traced "
+                                f"operand `.shape`",
+                                span=_span(node))
+
+    def _contains_jit(self, fn) -> bool:
+        for node in ast.walk(fn):
+            if node is fn:
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._has_jit_marker(node):
+                    return True
+            if isinstance(node, ast.Call) and \
+                    last_name(node.func) == "jit":
+                return True
+        return False
+
+
+# ---------------------------------------------------- error-swallowing
+@register
+class ErrorSwallowing(Rule):
+    id = "error-swallowing"
+    summary = ("no bare `except:` and no `except ...: pass` on "
+               "datapath/consensus modules")
+    rationale = (
+        "A swallowed exception on the datapath converts a loud failure "
+        "into silent data loss or a wedged control loop (the class of "
+        "bug the round-4 soak post-mortems dug out of replay paths). "
+        "Handle it, log it, or suppress with a written reason.")
+
+    DIRS = ("client", "codec", "net", "storage", "consensus", "scm",
+            "om", "lifecycle", "parallel")
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dirs(*self.DIRS):
+            return
+        for node in src.nodes:
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield Finding(
+                    self.id, src.display_path, node.lineno,
+                    "bare `except:` catches SystemExit/KeyboardInterrupt "
+                    "and hides the real error — name the exception",
+                    span=(node.lineno, node.lineno))
+                continue
+            if all(isinstance(s, (ast.Pass, ast.Continue)) or
+                   (isinstance(s, ast.Expr) and isinstance(
+                       s.value, ast.Constant)) for s in node.body):
+                yield Finding(
+                    self.id, src.display_path, node.lineno,
+                    "exception swallowed without handling or logging — "
+                    "a datapath error must be handled, logged, or "
+                    "suppressed with a reason",
+                    span=(node.lineno, node.lineno))

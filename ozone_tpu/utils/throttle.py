@@ -40,14 +40,16 @@ class Throttle:
             self._t = now
             self._tokens -= n
             if self._tokens < 0:
-                wait = -self._tokens / self.rate
-                # sleep INSIDE the lock: the bucket models one shared
-                # link, so concurrent transfers must queue behind the
-                # deficit rather than all overdraw at once
-                time.sleep(wait)
-                slept = wait
-                self._t = time.monotonic()
-                self._tokens = 0.0
+                slept = -self._tokens / self.rate
+        if slept > 0:
+            # sleep OUTSIDE the lock (virtual-scheduling pacing): the
+            # deficit stays booked on the bucket, so a second taker
+            # arriving mid-sleep sees its request stacked behind this
+            # one's (an even deeper deficit = a longer sleep) — same
+            # one-shared-link queueing as sleeping under the lock, but
+            # other threads can book their demand and pace in parallel
+            # instead of serializing on a held mutex
+            time.sleep(slept)
         if self.metrics is not None and slept > 0:
             self.metrics.counter("replication_throttle_ms").inc(
                 int(slept * 1000))

@@ -1,0 +1,34 @@
+# ozlint: path ozone_tpu/codec/_fixture.py
+"""Known-bad corpus for `dispatch-shape-stability`: device programs
+specialized on known-varying values — one XLA compile per erasure
+pattern / batch width (the pre-PR-1 plan-cache thrash)."""
+import functools
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("erased",))
+def decode_static_pattern(units, a_bits, erased):
+    # every distinct erasure tuple compiles a fresh program
+    return units @ a_bits
+
+
+@lru_cache(maxsize=512)
+def decode_plan(options, pattern):
+    # per-value jitted closure factory keyed on the varying pattern
+    @jax.jit
+    def fn(units):
+        return units + 1
+
+    return fn
+
+
+def make_padder(batch):
+    @jax.jit
+    def pad(x):
+        # closure-captured varying width: re-traces per batch size
+        return x + jnp.zeros((batch, x.shape[1]), x.dtype)
+
+    return pad

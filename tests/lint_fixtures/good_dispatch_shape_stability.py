@@ -1,0 +1,24 @@
+# ozlint: path ozone_tpu/codec/_fixture.py
+"""Known-good corpus for `dispatch-shape-stability`: varying values ride
+as traced arrays; caches are keyed only on config-stable values."""
+import functools
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("zeros_crc",))
+def decode_apply(units, a_bits, zeros_crc):
+    # recovery matrix is a traced ARG: one program for every pattern
+    return units @ a_bits + zeros_crc
+
+
+@lru_cache(maxsize=16)
+def encode_plan(options, checksum, bpc):
+    # cache keyed on config-stable coder options only
+    @jax.jit
+    def fn(data):
+        return data + jnp.zeros((data.shape[0], 1), data.dtype)
+
+    return fn

@@ -48,6 +48,50 @@ def test_re_encode_replicated_key_to_ec(cluster):
         re_encode_key_to_ec(cluster.om, cluster.clients, "v", "b", "k")
 
 
+def test_re_encode_loses_to_concurrent_overwrite(cluster, monkeypatch):
+    """Rewrite-fence regression (found by ozlint's fence-carrying-commit
+    rule): a user overwrite landing WHILE a background conversion is
+    reading must win. The old delete-then-commit pair deleted whatever
+    was live (the fresh overwrite included) and committed stale
+    re-encoded bytes over it; the fenced commit now loses
+    deterministically with KEY_MODIFIED and the overwrite survives."""
+    from ozone_tpu.client import re_encode as re_enc_mod
+    from ozone_tpu.om.requests import KEY_MODIFIED, OMError
+
+    oz = cluster.client()
+    b = oz.create_volume("v").create_bucket("b",
+                                            replication="RATIS/THREE")
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, 60_000, dtype=np.uint8)
+    b.write_key("k", data)
+    fresh = rng.integers(0, 256, 50_000, dtype=np.uint8)
+
+    orig = re_enc_mod.ReplicatedKeyReader.read_all
+    fired = []
+
+    def hooked(self):
+        out = orig(self)
+        if not fired:  # overwrite lands mid-conversion, exactly once
+            fired.append(1)
+            b.write_key("k", fresh)
+        return out
+
+    monkeypatch.setattr(re_enc_mod.ReplicatedKeyReader, "read_all",
+                        hooked)
+    with pytest.raises(OMError) as ei:
+        re_encode_key_to_ec(cluster.om, cluster.clients, "v", "b", "k",
+                            ec="rs-3-2-4096")
+    assert ei.value.code == KEY_MODIFIED
+    assert fired
+    # the user's overwrite is intact, still on its original scheme
+    info = oz.om.lookup_key("v", "b", "k")
+    assert info["replication"].startswith("RATIS")
+    assert np.array_equal(b.read_key("k"), fresh)
+    # and the conversion's orphaned EC blocks went to the purge chain
+    # (check_rewrite_fence routes them) instead of leaking
+    assert cluster.om.run_key_deleting_service_once() >= 1
+
+
 def test_fused_xor_to_rs_reencode_with_lost_unit(cluster):
     """BASELINE config #4 as a product path: an XOR(1)-coded key with a
     data unit lost converts to RS(k,p) via ONE fused device dispatch per

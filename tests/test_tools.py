@@ -478,62 +478,29 @@ def test_freon_fsg_and_sdg(cluster):
 
 
 def test_resilience_lint_no_hardcoded_timeouts_or_retry_sleeps():
-    """Repo lint: straggler tolerance lives in client/resilience.py —
-    a NEW hardcoded socket timeout (the old native_dn 120 s literal
-    class of bug) or a bare time.sleep retry loop in the client layer,
-    the lifecycle subsystem, OR the shared codec service (whose
-    sweeps/waits must ride resilience.Deadline/RetryPolicy or the
-    linger/deadline-derived condition waits, never ad-hoc sleeps)
-    bypasses deadlines/jitter and fails this test. Deliberate
-    exceptions (injected chaos latency) carry a
-    `# resilience-lint: allow` marker."""
-    import re
+    """MIGRATED onto ozlint (ozone_tpu/tools/lint, docs/LINT.md): the
+    old regex lint lived here and missed keyword args, computed
+    literals, and everything structural. The AST `deadline-propagation`
+    rule strictly subsumes it — socket-timeout literals repo-wide plus
+    literal timeouts/bare sleeps in client/, net/, lifecycle/ and the
+    codec service. This thin wrapper keeps the historical test name as
+    the guard; tests/test_lint.py owns the full gate (all five rules
+    plus the fixture corpus). Deliberate exceptions carry
+    `# ozlint: allow[deadline-propagation] -- reason` markers."""
     from pathlib import Path
 
-    root = Path(__file__).resolve().parent.parent / "ozone_tpu"
-    # NB: `.*` (not `[^)]*`) so the pattern crosses the address tuple's
-    # closing paren in `create_connection((host, port), timeout=120.0)`
-    pat_timeout = re.compile(
-        r"(create_connection\(.*timeout\s*=\s*\d"
-        r"|\.settimeout\(\s*\d)")
-    pat_sleep = re.compile(r"\btime\.sleep\(")
-    # the codec service additionally bans NUMERIC-literal waits: every
-    # timeout in service.py must derive from the linger knob, the
-    # deadline margin, or the dispatch-time EWMA — a literal
-    # `.wait(0.1)` / `result(timeout=30)` would be a hidden latency
-    # policy outside the documented knob surface
-    pat_wait_literal = re.compile(
-        r"(\.wait\(\s*[\d.]"
-        r"|\bresult\(\s*timeout\s*=\s*[\d.]"
-        r"|\bjoin\(\s*timeout\s*=\s*[\d.])")
-    offenders: list[str] = []
-    for p in sorted(root.rglob("*.py")):
-        if p.name == "resilience.py":
-            continue
-        rel = p.relative_to(root.parent)
-        is_codec_service = (p.parent.name == "codec"
-                            and p.name == "service.py")
-        no_sleep = p.parent.name in ("client", "lifecycle") \
-            or is_codec_service
-        for i, line in enumerate(p.read_text().splitlines(), 1):
-            if "resilience-lint: allow" in line:
-                continue
-            if pat_timeout.search(line):
-                offenders.append(
-                    f"{rel}:{i}: hardcoded socket timeout — derive it "
-                    f"from resilience.op_timeout()")
-            if no_sleep and pat_sleep.search(line):
-                offenders.append(
-                    f"{rel}:{i}: bare time.sleep in {p.parent.name}/ — "
-                    f"retry/backoff sleeps must ride "
-                    f"resilience.RetryPolicy")
-            if is_codec_service and pat_wait_literal.search(line):
-                offenders.append(
-                    f"{rel}:{i}: numeric-literal wait in the codec "
-                    f"service — timeouts there must derive from the "
-                    f"linger knob, the deadline margin, or the "
-                    f"dispatch EWMA")
-    assert not offenders, "\n".join(offenders)
+    from ozone_tpu.tools.lint import format_findings, lint_paths
+
+    root = Path(__file__).resolve().parent.parent
+    # scan only the dirs the historical regex guarded — the full-tree
+    # all-rules pass already runs in test_lint.py; re-walking the whole
+    # package here would double the tier-1 lint cost for zero coverage
+    pkg = root / "ozone_tpu"
+    findings = lint_paths(
+        [str(pkg / "client"), str(pkg / "lifecycle"),
+         str(pkg / "codec" / "service.py")],
+        rules=["deadline-propagation"], root=str(root))
+    assert not findings, format_findings(findings)
 
 
 def test_cli_version_and_getconf(capsys):
