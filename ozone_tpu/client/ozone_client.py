@@ -159,6 +159,7 @@ class OzoneBucket:
                 block_size=om.block_size,
                 checksum=ChecksumType(session.checksum_type),
                 bytes_per_checksum=session.bytes_per_checksum,
+                qos_class=self.client.qos_class,
             )
         if (
             session.replication.type is ReplicationType.RATIS
@@ -288,6 +289,7 @@ class OzoneBucket:
                             info.get("checksum_type", "CRC32C")),
                         bytes_per_checksum=info.get(
                             "bytes_per_checksum", 16 * 1024),
+                        qos_class=self.client.qos_class,
                     )
                 else:
                     reader = ReplicatedKeyReader(g, self.client.clients)
@@ -392,13 +394,17 @@ class OzoneClient:
     """Entry point (ObjectStore analog)."""
 
     def __init__(self, om: OzoneManager, clients: DatanodeClientFactory,
-                 ratis_clients=None):
+                 ratis_clients=None, qos_class: str = "interactive"):
         self.om = om
         self.clients = clients
         #: optional net/ratis_service.RatisClientFactory: when present,
         #: RATIS/3 writes are ordered through the pipeline raft ring
         #: (XceiverClientRatis path) instead of plain client fan-out
         self.ratis_clients = ratis_clients
+        #: shared-codec-service QoS class for this client's EC device
+        #: dispatches; background replayers (geo replication) run at
+        #: "bulk" so they can never starve interactive traffic
+        self.qos_class = qos_class
 
     def create_volume(self, volume: str) -> OzoneVolume:
         self.om.create_volume(volume)

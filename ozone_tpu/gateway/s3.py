@@ -434,6 +434,11 @@ class S3Gateway:
                 "NO_SUCH_MULTIPART_UPLOAD": ("NoSuchUpload", 404),
                 "INVALID_PART": ("InvalidPart", 400),
                 "QUOTA_EXCEEDED": ("QuotaExceeded", 403),
+                # deterministic rule rejections (e.g. lifecycle or geo
+                # replication on an FSO bucket) are client errors: a
+                # 500 would make SDKs retry a request that can never
+                # succeed
+                "INVALID_REQUEST": ("InvalidRequest", 400),
             }.get(e.code, ("InternalError", 500))
             h._reply(*_err(code[0], str(e), code[1]))
         except Exception as e:  # noqa: BLE001
@@ -566,13 +571,20 @@ class S3Gateway:
             # Apache Ozone 1.5, which answers 501 here
             self._bucket_lifecycle_op(h, method, bucket)
             return
+        if "replication" in q:
+            # Put/Get/DeleteBucketReplication, backed by the OM's
+            # replicated bucket metadata + the geo-DR shipper
+            # (replication_geo/) — a deliberate extension beyond
+            # Apache Ozone 1.5, which answers 501 here
+            self._bucket_replication_op(h, method, bucket)
+            return
         # subresources the store does not implement answer the AWS way
         # (501 NotImplemented, like the reference's unsupported-feature
         # responses) instead of falling through to bucket create/list —
         # a silent 200 would make `aws s3api put-bucket-policy`
         # look like it took effect
         for sub in ("policy", "website", "cors",
-                    "replication", "encryption", "accelerate",
+                    "encryption", "accelerate",
                     "requestPayment", "logging", "notification",
                     "inventory", "analytics", "metrics", "intelligent-tiering",
                     "ownershipControls", "publicAccessBlock"):
@@ -607,6 +619,25 @@ class S3Gateway:
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))
 
+    def _default_ec_target(self) -> str:
+        """Warm storage classes map to this gateway's scheme when it IS
+        an RS scheme; a replicated-default gateway tiers to the
+        cluster-default EC layout. Shared by the ?lifecycle and
+        ?replication subresources so their StorageClass mapping cannot
+        drift."""
+        from ozone_tpu.scm.pipeline import (
+            ReplicationConfig,
+            ReplicationType,
+        )
+
+        try:
+            conf = ReplicationConfig.parse(self.replication)
+            return (self.replication
+                    if conf.type is ReplicationType.EC
+                    and conf.ec.codec == "rs" else "rs-6-3-1024k")
+        except ValueError:
+            return "rs-6-3-1024k"
+
     def _bucket_lifecycle_op(self, h, method: str, bucket: str) -> None:
         """?lifecycle subresource: PUT parses the AWS
         LifecycleConfiguration XML into the internal rule model (warm
@@ -619,21 +650,7 @@ class S3Gateway:
             rules_to_s3_xml,
         )
 
-        from ozone_tpu.scm.pipeline import (
-            ReplicationConfig,
-            ReplicationType,
-        )
-
-        # warm storage classes map to this gateway's scheme when it IS
-        # an RS scheme; a replicated-default gateway tiers to the
-        # cluster-default EC layout
-        try:
-            conf = ReplicationConfig.parse(self.replication)
-            default = (self.replication
-                       if conf.type is ReplicationType.EC
-                       and conf.ec.codec == "rs" else "rs-6-3-1024k")
-        except ValueError:
-            default = "rs-6-3-1024k"
+        default = self._default_ec_target()
         om = self.client.om
         if method in ("PUT", "POST", "DELETE"):
             body = h._body()  # drain before any raising call
@@ -657,6 +674,48 @@ class S3Gateway:
                      {"Content-Type": "application/xml"})
         elif method == "DELETE":
             om.delete_bucket_lifecycle(self._vol, bucket)
+            h._reply(204)
+        else:
+            h._reply(*_err("MethodNotAllowed", method, 405))
+
+    def _bucket_replication_op(self, h, method: str, bucket: str) -> None:
+        """?replication subresource: PUT parses the AWS
+        ReplicationConfiguration XML into the internal rule model (the
+        ARN's region slot — or an explicit <Endpoint> — names the
+        destination cluster; warm storage classes map to this gateway's
+        EC scheme), GET renders the stored rules back, DELETE clears
+        them. Rules persist in OM bucket metadata; the background
+        ReplicationShipper enforces them."""
+        from ozone_tpu.replication_geo.rules import (
+            GeoReplicationError,
+            rules_from_s3_xml,
+            rules_to_s3_xml,
+        )
+
+        default = self._default_ec_target()
+        om = self.client.om
+        if method in ("PUT", "POST", "DELETE"):
+            body = h._body()  # drain before any raising call
+        if method == "PUT":
+            try:
+                rules = rules_from_s3_xml(body, default_target=default)
+            except GeoReplicationError as e:
+                h._reply(*_err("MalformedXML", str(e), 400))
+                return
+            om.set_bucket_geo_replication(self._vol, bucket, rules)
+            h._reply(200)
+        elif method == "GET":
+            rules = om.get_bucket_geo_replication(self._vol, bucket)
+            if not rules:
+                om.bucket_info(self._vol, bucket)  # NoSuchBucket -> 404
+                h._reply(*_err(
+                    "ReplicationConfigurationNotFoundError",
+                    "The replication configuration was not found", 404))
+                return
+            h._reply(200, rules_to_s3_xml(rules),
+                     {"Content-Type": "application/xml"})
+        elif method == "DELETE":
+            om.delete_bucket_geo_replication(self._vol, bucket)
             h._reply(204)
         else:
             h._reply(*_err("MethodNotAllowed", method, 405))

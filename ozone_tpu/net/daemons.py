@@ -834,6 +834,33 @@ class ScmOmDaemon:
                                    if self.ha is not None else None),
         )
         self.om.lifecycle = self.lifecycle
+        # geo-replication shipper (replication_geo/shipper.py):
+        # leader-singleton on the metadata ring, term-fenced with the
+        # ring's raft term like the lifecycle sweeper; tails the OM
+        # WAL delta feed and replays key commits/deletes to remote
+        # clusters. OZONE_TPU_GEO_MBPS throttles source reads so
+        # shipping never starves foreground traffic.
+        from ozone_tpu.replication_geo.shipper import ReplicationShipper
+
+        geo_throttle = None
+        geo_mbps = env_float("OZONE_TPU_GEO_MBPS", 0.0)
+        if geo_mbps > 0:
+            from ozone_tpu.utils.throttle import Throttle
+
+            geo_throttle = Throttle(geo_mbps * 1024 * 1024,
+                                    metrics=self.om.metrics)
+        self.geo = ReplicationShipper(
+            self.om,
+            clients_fn=self._lifecycle_client_factory,
+            term_fn=lambda: (self.ha.node.storage.term
+                             if self.ha is not None else 0),
+            leader_fn=lambda: (self.ha.is_ready
+                               if self.ha is not None else True),
+            throttle=geo_throttle,
+            ship_deadline_s=env_float("OZONE_TPU_GEO_DEADLINE_S", 30.0),
+            tls=self.tls,
+        )
+        self.om.geo = self.geo
         # ---- metadata HA: one raft ring for OM + SCM state ----
         # (the reference's OM-HA + SCM-HA Ratis rings; co-located here,
         # so one ring and one leader for both roles)
@@ -1094,6 +1121,10 @@ class ScmOmDaemon:
         self._lc_period = env_float("OZONE_TPU_LIFECYCLE_PERIOD_S",
                                     60.0)
         self._lc_last = time.monotonic()
+        # geo-replication ship cadence (seconds between cycle starts);
+        # OZONE_TPU_GEO_PERIOD_S overrides
+        self._geo_period = env_float("OZONE_TPU_GEO_PERIOD_S", 30.0)
+        self._geo_last = time.monotonic()
 
         def _om_services():
             while not self._om_bg_stop.wait(self._bg_interval):
@@ -1136,6 +1167,14 @@ class ScmOmDaemon:
                     if now_m - self._lc_last >= self._lc_period:
                         self._lc_last = now_m
                         self.lifecycle.run_once()
+                    # geo-replication ship cycle: leader-gated +
+                    # term-fenced internally; no-rule clusters scan
+                    # nothing (same wall-time gating rationale as the
+                    # lifecycle sweep above)
+                    now_m = time.monotonic()
+                    if now_m - self._geo_last >= self._geo_period:
+                        self._geo_last = now_m
+                        self.geo.run_once()
                     now = time.monotonic()
                     if self.recon is not None and \
                             now - self._recon_last >= self._recon_interval:
@@ -1156,6 +1195,7 @@ class ScmOmDaemon:
             self._om_bg.join(timeout=30.0)  # ozlint: allow[deadline-propagation] -- bounded shutdown join, no ambient op deadline at stop()
         if self.ha is not None:
             self.ha.stop()
+        self.geo.close()
         if self.http is not None:
             self.http.stop()
         if self.recon is not None:

@@ -97,6 +97,10 @@ class OzoneManager:
         from ozone_tpu.om.snapshots import SnapshotDiffJobs
 
         self._diff_jobs = SnapshotDiffJobs(self)
+        # geo-replication shipper (replication_geo/shipper.py):
+        # installed by the daemon wiring under HA; created lazily with
+        # defaults by run_geo_once on standalone OMs
+        self.geo = None
         # lifecycle sweeper (lifecycle/service.py): installed by the
         # daemon under HA (term-fenced on the ring); lazily built with
         # defaults by run_lifecycle_once on standalone OMs
@@ -861,19 +865,30 @@ class OzoneManager:
             )
         ]
 
-    def delete_key(self, volume: str, bucket: str, key: str) -> None:
+    def delete_key(self, volume: str, bucket: str, key: str,
+                   expect_object_id: str = "") -> None:
+        """Delete a key. ``expect_object_id`` ("" = unfenced, the user
+        API's latest-version semantics) makes the delete conditional on
+        the live row still being that version — background replayers
+        (geo replication, lifecycle expiry) fence so a concurrent
+        overwrite always wins with KEY_MODIFIED."""
         from ozone_tpu.om import fso
 
         volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, key, "DELETE")
         binfo = self.bucket_info(volume, bucket)
         if self._is_fso(binfo):
+            if expect_object_id:
+                raise rq.OMError(
+                    rq.INVALID_REQUEST,
+                    "fenced deletes are not supported on "
+                    "FILE_SYSTEM_OPTIMIZED buckets")
             self.submit(fso.DeleteFile(volume, bucket, key))
         else:
             if self._is_legacy(binfo):
                 key = rq.normalize_fs_path(key)
-            # ozlint: allow[fence-carrying-commit] -- user-initiated delete: unfenced latest-version semantics IS the API contract
-            self.submit(rq.DeleteKey(volume, bucket, key))
+            self.submit(rq.DeleteKey(volume, bucket, key,
+                                     expect_object_id=expect_object_id))
         self.metrics.counter("keys_deleted").inc()
 
     def rename_key(self, volume: str, bucket: str, key: str, new_key: str) -> None:
@@ -1091,6 +1106,62 @@ class OzoneManager:
 
             self.lifecycle = LifecycleService(self, clients=self.clients)
         return self.lifecycle.run_once(max_keys=max_keys)
+
+    # ------------------------------------------------- geo replication (DR)
+    def set_bucket_geo_replication(self, volume: str, bucket: str,
+                                   rules: list[dict]) -> dict:
+        """Install per-bucket cross-cluster replication rules (S3
+        PutBucketReplication analog): prefix + destination cluster
+        endpoint + optional destination bucket/scheme, persisted in
+        bucket metadata through the replicated ring
+        (replication_geo/rules.py)."""
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "WRITE")
+        return self.submit(
+            rq.SetBucketGeoReplication(volume, bucket, rules))
+
+    def get_bucket_geo_replication(self, volume: str,
+                                   bucket: str) -> list[dict]:
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        return self.bucket_info(volume, bucket).get("geo_replication", [])
+
+    def delete_bucket_geo_replication(self, volume: str,
+                                      bucket: str) -> None:
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "WRITE")
+        self.submit(rq.DeleteBucketGeoReplication(volume, bucket))
+
+    def geo_status(self) -> dict:
+        """Shipper state (fencing term, WAL cursor, last stats) + live
+        counters and WAL-head lag — the `replication status` CLI /
+        Recon panel view."""
+        from ozone_tpu.utils.metrics import get_registry
+
+        row = self.store.get("system", "geo_state") or {}
+        reg = get_registry("replication")
+        out = {
+            "term": row.get("term"),
+            "cursor": row.get("cursor") or {},
+            "bootstrapped": row.get("bootstrapped") or [],
+            "stats": row.get("stats") or {},
+            "metrics": reg.snapshot() if reg is not None else {},
+        }
+        if getattr(self, "geo", None) is not None:
+            out["lag"] = self.geo.lag()
+        return out
+
+    def run_geo_once(self, max_entries: Optional[int] = None) -> dict:
+        """Trigger one replication ship cycle (the `replication
+        run-now` verb). Uses the daemon-installed shipper when present
+        (term-fenced on the HA ring); standalone OMs get a local
+        default shipper."""
+        if getattr(self, "geo", None) is None:
+            from ozone_tpu.replication_geo.shipper import (
+                ReplicationShipper,
+            )
+
+            self.geo = ReplicationShipper(self, clients=self.clients)
+        return self.geo.run_once(max_entries=max_entries)
 
     # ----------------------------------------------------- multipart upload
     def initiate_multipart_upload(

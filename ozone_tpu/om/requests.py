@@ -1553,6 +1553,116 @@ class LifecycleCheckpoint(OMRequest):
         return dict(row)
 
 
+# ------------------------------------------------- geo replication (DR)
+
+GEO_FENCED = "GEO_FENCED"
+
+
+@dataclass
+class SetBucketGeoReplication(OMRequest):
+    """Install a bucket's cross-cluster replication rules (the S3
+    PutBucketReplication analog; Apache Ozone 1.5 has no bucket-level
+    geo replication — PARITY row 47). Rules ride the bucket row, so
+    they replicate through the metadata ring and survive failover like
+    every other bucket property; the ReplicationShipper
+    (replication_geo/shipper.py) enforces them."""
+
+    volume: str
+    bucket: str
+    rules: list = field(default_factory=list)
+
+    def pre_execute(self, om) -> None:
+        from ozone_tpu.replication_geo.rules import (
+            GeoReplicationError,
+            validate_rules,
+        )
+
+        try:
+            self.rules = validate_rules(self.rules)
+        except GeoReplicationError as e:
+            raise OMError(INVALID_REQUEST, str(e))
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", k)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, k)
+        if b.get("layout") == "FILE_SYSTEM_OPTIMIZED":
+            # the shipper tails the flat `keys` table; FSO namespaces
+            # commit through the `files` table, so accepting rules here
+            # would configure a silent no-op (deterministic rejection
+            # instead, same contract as lifecycle)
+            raise OMError(
+                INVALID_REQUEST,
+                "geo replication rules are not supported on "
+                "FILE_SYSTEM_OPTIMIZED buckets (docs/OPERATIONS.md)")
+        b["geo_replication"] = list(self.rules)
+        store.put("buckets", k, b)
+        return b
+
+
+@dataclass
+class DeleteBucketGeoReplication(OMRequest):
+    volume: str
+    bucket: str
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", k)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, k)
+        b.pop("geo_replication", None)
+        store.put("buckets", k, b)
+        return b
+
+
+@dataclass
+class GeoCheckpoint(OMRequest):
+    """Replication shipper state: fencing term + the WAL-delta cursor
+    (last shipped journal txid) + the set of buckets whose initial
+    reconcile completed, committed through the ring so a restarted or
+    failed-over shipper resumes exactly at the last durable page.
+
+    Term fencing is the LifecycleCheckpoint treatment verbatim: a
+    `fence` checkpoint claims the shipper role for `term` and is
+    rejected if a HIGHER term already claimed it; a plain checkpoint is
+    rejected unless its term IS the fenced term. Every replica applies
+    the same deterministic rejection, so a deposed shipper's late
+    cursor commits can never regress the WAL position — kill -9 of the
+    shipper leader mid-page loses at most one un-checkpointed page,
+    which re-ships idempotently (the destination's geo-src-oid marker
+    makes the re-apply a no-op)."""
+
+    term: int
+    cursor: dict = field(default_factory=dict)
+    stats: dict = field(default_factory=dict)
+    #: None = leave the bootstrapped-bucket set unchanged
+    bootstrapped: Optional[list] = None
+    fence: bool = False
+
+    def apply(self, store):
+        row = store.get("system", "geo_state") or {"term": -1}
+        fenced = int(row.get("term", -1))
+        if self.fence:
+            if int(self.term) < fenced:
+                raise OMError(
+                    GEO_FENCED,
+                    f"fence term {self.term} < current {fenced}")
+            row["term"] = int(self.term)
+        else:
+            if int(self.term) != fenced:
+                raise OMError(
+                    GEO_FENCED,
+                    f"checkpoint term {self.term} != fenced {fenced}")
+            row["cursor"] = dict(self.cursor)
+            if self.bootstrapped is not None:
+                row["bootstrapped"] = list(self.bootstrapped)
+            if self.stats:
+                row["stats"] = dict(self.stats)
+        store.put("system", "geo_state", row)
+        return dict(row)
+
+
 @dataclass
 class PurgeDeletedKeys(OMRequest):
     """Remove processed entries from the deleted table (background

@@ -725,6 +725,10 @@ class ReconServer:
                     # lifecycle sweeper panel: fencing term, cursor,
                     # last-sweep stats + live tiering counters
                     "/api/lifecycle": recon.lifecycle_view,
+                    # geo-replication panel: shipper term/cursor,
+                    # per-bucket rules, and WAL-head lag gauges
+                    # (entries + seconds behind)
+                    "/api/replication": recon.replication_view,
                     # shared codec service: batch fill ratio, queue
                     # depth, coalescing + QoS counters (the device's
                     # continuous-batching health, next to lifecycle —
@@ -777,6 +781,31 @@ class ReconServer:
         if svc is None or not svc._running:
             return {"enabled": True, "started": False}
         return svc.stats()
+
+    def replication_view(self) -> dict:
+        """Geo-replication shipper status + per-bucket rule census for
+        the dashboard panel: fencing term, WAL cursor, live counters,
+        and the lag gauges (journal entries and seconds behind the WAL
+        head) operators alarm on."""
+        om = self.tasks.om
+        out = om.geo_status()
+        if "lag" not in out:
+            # no shipper installed on this process (e.g. a follower):
+            # derive the lag from a throwaway shipper over the same
+            # store — a monitoring GET must still report how far
+            # behind the cluster is
+            from ozone_tpu.replication_geo.shipper import (
+                ReplicationShipper,
+            )
+
+            out["lag"] = ReplicationShipper(om).lag()
+        buckets = []
+        for bk, brow in om.store.iterate("buckets"):
+            rules = brow.get("geo_replication") or []
+            if rules:
+                buckets.append({"bucket": bk, "rules": rules})
+        out["buckets"] = buckets
+        return out
 
     def lifecycle_view(self) -> dict:
         """Lifecycle sweeper status + per-bucket rule census for the

@@ -201,6 +201,17 @@ RECON_INDEX_HTML = """<!doctype html>
     <tbody></tbody>
   </table>
 
+  <h2>Geo replication</h2>
+  <div class="sub">cross-cluster async bucket replication (geo-DR):
+    term-fenced WAL shipper &mdash; lag behind the metadata WAL head,
+    shipped/conflict counters, per-bucket rules</div>
+  <div class="tiles" id="geo-tiles"></div>
+  <table id="geo-rules">
+    <thead><tr><th>bucket</th><th>rule</th><th>prefix</th>
+      <th>destination</th><th>scheme</th></tr></thead>
+    <tbody></tbody>
+  </table>
+
   <h2>Codec service</h2>
   <div class="sub">cross-request continuous batching: stripes from
     concurrent operations coalesced into shared fused device
@@ -358,6 +369,25 @@ async function refresh() {
         `<td>${esc(r.prefix)}</td><td>${esc(r.age_days)}</td>` +
         `<td>${esc(r.action)}</td></tr>`)).join("") ||
       '<tr><td colspan="5">no lifecycle rules configured</td></tr>';
+    const geo = await (await fetch("/api/replication")).json();
+    const gm = geo.metrics || {};
+    const glag = geo.lag || {};
+    document.getElementById("geo-tiles").innerHTML = [
+      tile("lag (entries)", glag.entries ?? 0),
+      tile("lag (seconds)", glag.seconds ?? 0),
+      tile("keys shipped", gm.keys_shipped ?? 0),
+      tile("bytes shipped", fmtBytes(gm.bytes_shipped ?? 0)),
+      tile("deletes shipped", gm.deletes_shipped ?? 0),
+      tile("conflicts (LWW)", gm.conflicts ?? 0),
+      tile("leader fences", gm.leader_fences ?? 0),
+    ].join("");
+    document.querySelector("#geo-rules tbody").innerHTML =
+      (geo.buckets || []).flatMap(b => (b.rules || []).map(r =>
+        `<tr><td>${esc(b.bucket)}</td><td>${esc(r.id)}</td>` +
+        `<td>${esc(r.prefix)}</td><td>${esc(r.endpoint)}` +
+        `${r.bucket ? "/" + esc(r.bucket) : ""}</td>` +
+        `<td>${esc(r.scheme || "source")}</td></tr>`)).join("") ||
+      '<tr><td colspan="5">no replication rules configured</td></tr>';
     const cx = await (await fetch("/api/codec")).json();
     document.getElementById("codec-tiles").innerHTML =
       cx.enabled === false

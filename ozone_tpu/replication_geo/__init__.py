@@ -1,0 +1,29 @@
+"""Geo-DR: cross-cluster asynchronous bucket replication.
+
+Per-bucket replication rules (rules.py: destination cluster endpoint,
+optional prefix filter, optional destination replication scheme)
+persisted in replicated bucket metadata, enforced by a leader-singleton,
+term-fenced ReplicationShipper (shipper.py) that tails the metadata
+ring's WAL delta feed — the same stream Recon consumes — and replays
+key commits/deletes to the remote cluster through the existing client
+datapath.
+
+Consistency shape (f4 OSDI '14 / Azure Storage ATC '12): strong inside
+a cluster, asynchronous + ordered across clusters, last-writer-wins on
+the rewrite fence so a destination-side overwrite beats a stale replay.
+Apache Ozone 1.5 has no bucket-level cross-cluster replication; this is
+a deliberate extension (docs/PARITY.md row 47).
+"""
+
+from ozone_tpu.replication_geo.rules import (  # noqa: F401
+    GeoReplicationError,
+    ReplicationRule,
+    rules_from_s3_xml,
+    rules_to_s3_xml,
+    validate_rules,
+)
+from ozone_tpu.replication_geo.shipper import (  # noqa: F401
+    ReplicationShipper,
+    register_inprocess,
+    unregister_inprocess,
+)

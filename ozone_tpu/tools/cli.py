@@ -777,6 +777,18 @@ def cmd_freon(args) -> int:
             replication=args.replication or "RATIS/THREE",
             target=args.target,
         ).summary())
+    elif args.generator == "geo":
+        if not args.dest:
+            print("error: freon geo needs --dest HOST:PORT (the "
+                  "destination cluster endpoint)", file=sys.stderr)
+            return 1
+        oz = _client(args)
+        _emit(freon.geo(
+            oz, args.dest, n_keys=args.num, size=args.size,
+            threads=args.threads,
+            replication=args.replication or "RATIS/THREE",
+            scheme=args.scheme,
+        ).summary())
     elif args.generator == "hsg":
         oz = _client(args)
         _emit(freon.hsg(
@@ -1314,6 +1326,59 @@ def cmd_lifecycle(args) -> int:
     return 0
 
 
+def cmd_replication(args) -> int:
+    """Geo replication admin (`replication set/get/clear/run-now/
+    status`): per-bucket cross-cluster async replication rules,
+    enforced by the leader-singleton WAL-tailing shipper. A deliberate
+    extension beyond Apache Ozone 1.5 (docs/PARITY.md row 47)."""
+    from ozone_tpu.net.om_service import GrpcOmClient
+
+    def usage(msg: str) -> int:
+        print(f"error: {msg}", file=sys.stderr)
+        return 2
+
+    om = GrpcOmClient(args.om, tls=_client_tls())
+    verb = args.verb
+    if verb in ("run-now", "status"):
+        if verb == "run-now":
+            _emit(om.run_geo_once(args.max_entries))
+        else:
+            _emit(om.geo_status())
+        return 0
+    if not args.path:
+        return usage(f"replication {verb} needs a /volume/bucket path")
+    parts = _parse_path(args.path)
+    if len(parts) != 2:
+        return usage(f"expected /volume/bucket, got {args.path!r}")
+    vol, bucket = parts
+    if verb == "get":
+        _emit(om.get_bucket_geo_replication(vol, bucket))
+    elif verb == "clear":
+        om.delete_bucket_geo_replication(vol, bucket)
+        print(f"replication cleared on /{vol}/{bucket}")
+    elif verb == "set":
+        if not args.dest:
+            return usage("replication set needs --dest HOST:PORT "
+                         "(the destination cluster endpoint)")
+        rules = (om.get_bucket_geo_replication(vol, bucket)
+                 if args.append else [])
+        rule = {
+            "id": args.id or f"rule-{len(rules)}",
+            "endpoint": args.dest,
+            "prefix": args.prefix,
+            "bucket": args.dest_bucket,
+            "volume": args.dest_volume,
+            "scheme": args.scheme,
+            "enabled": True,
+        }
+        rules = [*rules, rule]
+        _emit(om.set_bucket_geo_replication(
+            vol, bucket, rules).get("geo_replication", []))
+    else:
+        return usage(f"unknown replication verb {verb!r}")
+    return 0
+
+
 def cmd_version(args) -> int:
     """`ozone version` analog: framework + runtime stack versions.
     Must ALWAYS succeed — device discovery initializes the JAX backend,
@@ -1504,6 +1569,38 @@ def build_parser() -> argparse.ArgumentParser:
                     help="run-now: bound the sweep's scan")
     lc.set_defaults(fn=cmd_lifecycle)
 
+    geo = sub.add_parser("replication",
+                         help="cross-cluster async bucket replication "
+                              "(geo-DR)")
+    geo.add_argument("verb", choices=["set", "get", "clear", "run-now",
+                                      "status"])
+    geo.add_argument("path", nargs="?", default="",
+                     help="/volume/bucket (set/get/clear)")
+    geo.add_argument("--om", default="127.0.0.1:9860")
+    geo.add_argument("--dest", default="",
+                     help="set: destination cluster OM endpoint "
+                          "HOST:PORT (comma-separated for HA)")
+    geo.add_argument("--prefix", default="",
+                     help="set: key-name prefix filter")
+    geo.add_argument("--dest-bucket", default="",
+                     help="set: destination bucket (default: same "
+                          "name as the source bucket)")
+    geo.add_argument("--dest-volume", default="",
+                     help="set: destination volume (default: same "
+                          "name as the source volume)")
+    geo.add_argument("--scheme", default="",
+                     help="set: destination replication scheme "
+                          "(default: keep the source key's scheme; "
+                          "an EC scheme re-encodes on device)")
+    geo.add_argument("--id", default="",
+                     help="set: rule id (default rule-<n>)")
+    geo.add_argument("--append", action="store_true",
+                     help="set: append to existing rules instead of "
+                          "replacing them")
+    geo.add_argument("--max-entries", type=int, default=None,
+                     help="run-now: bound the WAL-delta scan")
+    geo.set_defaults(fn=cmd_replication)
+
     fr = sub.add_parser("freon", help="load generators")
     fr.add_argument("generator",
                     choices=["ockg", "ockr", "ockrr", "ockv", "ecrd",
@@ -1511,7 +1608,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
                              "dcb", "dcv", "dsg", "hsg", "dnbp", "ralg",
                              "fskg", "mpug", "s3kg", "fsg", "sdg",
-                             "dnsim", "lcg"])
+                             "dnsim", "lcg", "geo"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("--keys", type=int, default=1,
@@ -1532,6 +1629,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="ommg op mix (c/r/u/d/l per char)")
     fr.add_argument("--target", default="rs-3-2-4096",
                     help="lcg: EC scheme the lifecycle rule tiers to")
+    fr.add_argument("--dest", default="",
+                    help="geo: destination cluster OM endpoint")
+    fr.add_argument("--scheme", default="",
+                    help="geo: destination replication scheme "
+                         "(default: keep the source scheme)")
     fr.add_argument("--root", default="",
                     help="local path for cmdw/dbgen")
     fr.add_argument("--containers", type=int, default=5,

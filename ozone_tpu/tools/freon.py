@@ -265,6 +265,91 @@ def lcg(client, n_keys: int = 20, size: int = 10 * 1024,
     return rep
 
 
+def geo(client, dest_endpoint: str, n_keys: int = 20,
+        size: int = 10 * 1024, threads: int = 4,
+        volume: str = "freon-vol", bucket: str = "freon-geo",
+        replication: str = "RATIS/THREE", scheme: str = "",
+        prefix: str = "geo", dest_client=None) -> FreonReport:
+    """Geo-replication churn (write -> overwrite -> delete -> ship ->
+    verify): the soak/CI probe for the geo-DR subsystem. Writes
+    `n_keys` keys under a replication rule pointing at
+    `dest_endpoint`, overwrites a third, deletes a fifth, triggers a
+    ship cycle (`replication run-now`), then verifies convergence:
+    every surviving key reads back byte-exact FROM THE DESTINATION and
+    every deleted key is gone there. The timer covers the writes; the
+    ship/verify outcome rides the report extras (`shipped`,
+    `verify_failures`, `lag_entries`)."""
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket, replication)
+    except Exception:
+        pass
+    client.om.set_bucket_geo_replication(volume, bucket, [{
+        "id": "freon-geo", "endpoint": dest_endpoint, "prefix": prefix,
+        "scheme": scheme,
+    }])
+    b = client.get_volume(volume).get_bucket(bucket)
+
+    def op(i: int) -> int:
+        b.write_key(f"{prefix}-{i}", _det_payload(size, seed=i),
+                    replication)
+        return size
+
+    rep = BaseFreonGenerator("geo", n_keys, threads).run(op)
+    ship1 = client.om.run_geo_once()  # initial convergence
+    # churn AFTER the first ship so overwrites supersede shipped
+    # replicas and deletes retire them: every 3rd key overwritten,
+    # every 5th (of the rest) deleted
+    expect: dict[str, Optional[int]] = {
+        f"{prefix}-{i}": i for i in range(n_keys)
+    }
+    for i in range(0, n_keys, 3):
+        b.write_key(f"{prefix}-{i}", _det_payload(size, seed=i + 1000),
+                    replication)
+        expect[f"{prefix}-{i}"] = i + 1000
+    for i in range(1, n_keys, 5):
+        b.delete_key(f"{prefix}-{i}")
+        expect[f"{prefix}-{i}"] = None
+    ship = client.om.run_geo_once()
+    ship = {k: ship.get(k, 0) + (ship1.get(k, 0)
+                                 if isinstance(ship1.get(k), int)
+                                 else 0)
+            for k in ("keys_shipped", "deletes_shipped", "conflicts",
+                      "bytes")}
+    if dest_client is None:
+        from ozone_tpu.replication_geo.shipper import resolve_cluster
+
+        dest_client = resolve_cluster(dest_endpoint).oz
+    db = dest_client.get_volume(volume).get_bucket(bucket)
+    verify_failures = 0
+    for name, seed in expect.items():
+        try:
+            info = dest_client.om.lookup_key(volume, bucket, name)
+        except Exception:
+            if seed is not None:
+                verify_failures += 1  # should exist at the destination
+            continue
+        if seed is None:
+            verify_failures += 1  # deleted at source, still at dest
+            continue
+        got = db.read_key_info(info)
+        if not np.array_equal(got, _det_payload(size, seed=seed)):
+            verify_failures += 1
+    status = client.om.geo_status()
+    rep.extras.update({
+        "shipped": ship.get("keys_shipped", 0),
+        "deletes_shipped": ship.get("deletes_shipped", 0),
+        "conflicts": ship.get("conflicts", 0),
+        "ship_bytes": ship.get("bytes", 0),
+        "verify_failures": verify_failures,
+        "lag_entries": (status.get("lag") or {}).get("entries", 0),
+    })
+    return rep
+
+
 def ockr(client, n_keys: int, threads: int = 4, volume: str = "freon-vol",
          bucket: str = "freon-bucket", prefix: str = "key") -> FreonReport:
     """Key read generator (validation pass over ockg output)."""

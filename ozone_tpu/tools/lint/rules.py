@@ -190,9 +190,10 @@ def _fn_env(module_env: _ConstEnv, fn) -> _ConstEnv:
 @register
 class DeadlinePropagation(Rule):
     id = "deadline-propagation"
-    summary = ("timeouts in client/, net/, lifecycle/ and the codec "
-               "service must derive from resilience.Deadline, never "
-               "from numeric literals; socket timeouts repo-wide")
+    summary = ("timeouts in client/, net/, lifecycle/, "
+               "replication_geo/ and the codec service must derive "
+               "from resilience.Deadline, never from numeric "
+               "literals; socket timeouts repo-wide")
     rationale = (
         "PR 2's root bug: native_dn hardcoded a 120 s connect timeout, "
         "so a dead peer consumed the whole operation budget before the "
@@ -209,7 +210,8 @@ class DeadlinePropagation(Rule):
     def check(self, src: SourceFile) -> Iterable[Finding]:
         if src.is_module("client", "resilience.py"):
             return  # the implementation of the discipline itself
-        in_scope = (src.in_dirs("client", "net", "lifecycle")
+        in_scope = (src.in_dirs("client", "net", "lifecycle",
+                                "replication_geo")
                     or src.is_module("codec", "service.py"))
         module_env = _ConstEnv()
         _collect_env(src.tree.body, module_env, recurse=False)
@@ -440,14 +442,16 @@ class FenceCarryingCommit(Rule):
     rationale = (
         "PR 4's duplicate-allocation and lifecycle lessons: an unfenced "
         "mutation from a deposed leader or a background job racing a "
-        "user overwrite silently loses data. LifecycleCheckpoint must "
-        "carry `term`; CommitKey/CommitFile/DeleteKey must carry "
+        "user overwrite silently loses data. LifecycleCheckpoint and "
+        "GeoCheckpoint must carry `term`; "
+        "CommitKey/CommitFile/DeleteKey must carry "
         "`expect_object_id` (\"\" only where unfenced semantics are the "
         "documented API, with an ozlint suppression saying why).")
 
     #: constructor -> (required kwarg, positional index or None)
     FENCED = {
         "LifecycleCheckpoint": ("term", 0),
+        "GeoCheckpoint": ("term", 0),
         "CommitKey": ("expect_object_id", None),
         "CommitFile": ("expect_object_id", None),
         "DeleteKey": ("expect_object_id", None),
@@ -646,7 +650,7 @@ class ErrorSwallowing(Rule):
         "Handle it, log it, or suppress with a written reason.")
 
     DIRS = ("client", "codec", "net", "storage", "consensus", "scm",
-            "om", "lifecycle", "parallel")
+            "om", "lifecycle", "parallel", "replication_geo")
 
     def check(self, src: SourceFile) -> Iterable[Finding]:
         if not src.in_dirs(*self.DIRS):

@@ -25,10 +25,31 @@ from dataclasses import dataclass
 from pathlib import Path
 from typing import Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover - depends on the image
+    # `cryptography` is an optional dependency: insecure deployments
+    # (and minimal images) never need x509 material. Secure mode fails
+    # with an actionable error at CA/client construction instead of an
+    # opaque import error deep inside daemon bring-up; tests skip via
+    # pytest.importorskip("cryptography").
+    x509 = hashes = serialization = ec = None
+    ExtendedKeyUsageOID = NameOID = None
+    HAVE_CRYPTOGRAPHY = False
+
+
+def require_cryptography(what: str) -> None:
+    if not HAVE_CRYPTOGRAPHY:
+        raise RuntimeError(
+            f"{what} requires the optional `cryptography` module, "
+            "which is not installed in this image; install it or run "
+            "without secure mode (secure=False)")
+
 
 _ONE_DAY = datetime.timedelta(days=1)
 
@@ -77,6 +98,7 @@ class CertificateAuthority:
 
     def __init__(self, root_dir: Path, cluster_id: str = "ozone-tpu",
                  valid_days: int = 3650):
+        require_cryptography("CertificateAuthority (secure mode)")
         self.root_dir = Path(root_dir)
         self.root_dir.mkdir(parents=True, exist_ok=True)
         self.valid_days = valid_days
@@ -259,6 +281,7 @@ class CertificateClient:
     def __init__(self, role_dir: Path, role: str,
                  hostnames: Optional[list[str]] = None,
                  valid_days: int = 398):
+        require_cryptography("CertificateClient (secure mode)")
         self.role_dir = Path(role_dir)
         self.role_dir.mkdir(parents=True, exist_ok=True)
         self.role = role

@@ -87,6 +87,11 @@ ENROLL_SECRET = "drill-secret"
 
 @pytest.fixture(scope="module")
 def secure_cluster(tmp_path_factory):
+    # secure mode mints x509 material via utils/ca.py; images without
+    # the optional `cryptography` module skip the secure-cluster tests
+    # cleanly (the unit-level token tests above still run — HMAC block
+    # tokens themselves need only the stdlib)
+    pytest.importorskip("cryptography")
     tmp_path = tmp_path_factory.mktemp("secure")
     meta = ScmOmDaemon(
         tmp_path / "om.db",

@@ -431,6 +431,8 @@ def test_reconstruction_of_encrypted_key(cluster):
     repaired key decrypts byte-exactly. Placement is repointed from
     SCM container state like the sibling test — OM-served post-repair
     placement is NOT what is covered here."""
+    # client-side AES-CTR rides the optional `cryptography` module
+    pytest.importorskip("cryptography")
     meta, dns = cluster
     oz = _client(meta)
     meta.om.kms_create_key("reck")

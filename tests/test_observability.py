@@ -105,6 +105,18 @@ def test_prometheus_text_golden_every_registry_renders():
     CODEC.gauge("batch_fill_pct").set(0.0)
     CODEC.timer("queue_wait_seconds").update(0.0)
     CODEC.timer("dispatch_seconds").update(0.0)
+    # the geo-replication family (docs/OPERATIONS.md "Geo replication"):
+    # the lag gauges are the numbers operators alarm on
+    from ozone_tpu.replication_geo.shipper import METRICS as GEO
+
+    for name in ("keys_shipped", "bytes_shipped", "deletes_shipped",
+                 "conflicts", "ship_failures", "pages_shipped",
+                 "leader_fences", "bootstraps", "journal_gaps",
+                 "cycles"):
+        GEO.counter(name).inc(0)
+    GEO.gauge("lag_entries").set(0)
+    GEO.gauge("lag_seconds").set(0.0)
+    GEO.timer("ship_seconds").update(0.0)
     text = m.prometheus_text()
     lines = text.splitlines()
     name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -146,7 +158,14 @@ def test_prometheus_text_golden_every_registry_renders():
                  "codec_service_queue_depth",
                  "codec_service_batch_fill_pct",
                  "codec_service_queue_wait_seconds",
-                 "codec_service_dispatch_seconds"):
+                 "codec_service_dispatch_seconds",
+                 "replication_keys_shipped", "replication_bytes_shipped",
+                 "replication_deletes_shipped", "replication_conflicts",
+                 "replication_ship_failures", "replication_pages_shipped",
+                 "replication_leader_fences", "replication_bootstraps",
+                 "replication_journal_gaps", "replication_cycles",
+                 "replication_lag_entries", "replication_lag_seconds",
+                 "replication_ship_seconds"):
         stem = want.removesuffix("_seconds")
         assert any(s.startswith(stem) for s in seen_metrics), want
     assert "# TYPE client_resilience_deadline_exceeded counter" in text
@@ -154,6 +173,9 @@ def test_prometheus_text_golden_every_registry_renders():
     assert "# TYPE codec_service_dispatches counter" in text
     assert "# HELP codec_service_tail_flushes " in text
     assert "# TYPE codec_service_batch_fill_pct gauge" in text
+    assert "# TYPE replication_keys_shipped counter" in text
+    assert "# TYPE replication_lag_entries gauge" in text
+    assert "# HELP replication_lag_seconds " in text
 
 
 def test_tracing_spans_nest_and_propagate():

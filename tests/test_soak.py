@@ -63,6 +63,18 @@ CHAOS_S = float(os.environ.get("OZONE_TPU_SOAK_S", "40"))
 #: nightly sweeps override via OZONE_TPU_SOAK_SEEDS
 SEEDS = [int(s) for s in os.environ.get(
     "OZONE_TPU_SOAK_SEEDS", "1729,271828,31337").split(",")]
+#: tier-1 runs ONE representative seed (every instrument/invariant is
+#: exercised by any seed — the seed only varies the chaos schedule);
+#: the remaining seeds ride the slow tier so the tier-1 command stops
+#: truncating at its 870 s budget on the one-core rig. Seed lists set
+#: via OZONE_TPU_SOAK_SEEDS (nightly sweeps) run every seed in tier-1,
+#: preserving the historical override contract.
+_EXPLICIT = "OZONE_TPU_SOAK_SEEDS" in os.environ
+SEED_PARAMS = [
+    pytest.param(s, marks=() if (_EXPLICIT or i == 0)
+                 else pytest.mark.slow)
+    for i, s in enumerate(SEEDS)
+]
 
 
 def _starve_floor(base: int = 5) -> int:
@@ -105,7 +117,7 @@ def _start_injected_dn(tmp_path, dn_id, scm_addrs):
 @pytest.mark.serial  # forks an LD_PRELOAD datanode subprocess and is
 # timing-sensitive: concurrent jax-importing test batches on a one-core
 # rig starve the load threads below their acked floors (KNOWN_ISSUES)
-@pytest.mark.parametrize("seed", SEEDS)
+@pytest.mark.parametrize("seed", SEED_PARAMS)
 def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
     # the sweeper must coexist with the chaos on a couple of shared
     # cores: tight per-sweep budget + a source-read throttle (the same
