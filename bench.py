@@ -59,6 +59,33 @@ def remaining() -> float:
     return _DEADLINE - time.time()
 
 
+def tail_latencies_ms() -> dict:
+    """p50/p95/p99 (ms) from the datapath histograms — the end-to-end
+    benches (tiering PUT/GET, concurrent small-PUT) drive the real
+    client + codec-service paths, so the line records tail latency
+    alongside throughput (BENCH_r06+ tracks both)."""
+    out: dict = {}
+    try:
+        from ozone_tpu.client.ozone_client import METRICS as client_ops
+        from ozone_tpu.codec import service as codec_service
+    except Exception as e:  # watchdog may fire before any import
+        log(f"latency histograms unavailable: {e!r}")
+        return out
+    fams = {
+        "client_put": client_ops.histogram("put_seconds"),
+        "client_get": client_ops.histogram("get_seconds"),
+        "codec_queue_wait":
+            codec_service.METRICS.histogram("queue_wait_seconds"),
+        "codec_dispatch":
+            codec_service.METRICS.histogram("dispatch_seconds"),
+    }
+    for name, h in fams.items():
+        if h.count:
+            out[name] = {p: round(1e3 * v, 3)
+                         for p, v in h.percentiles().items()}
+    return out
+
+
 def emit_line(timed_out: bool = False, error: str = "") -> None:
     # exactly-one-JSON-line contract: the watchdog and the normal exit
     # path race near the deadline; whoever gets here first wins. The
@@ -104,6 +131,9 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
         if _STATE["small_put_speedup"] is not None:
             line["concurrent_small_put_speedup_x"] = round(
                 _STATE["small_put_speedup"], 2)
+        lat = tail_latencies_ms()
+        if lat:
+            line["latency_ms"] = lat
         if timed_out:
             line["timed_out"] = True
         if error:
@@ -982,6 +1012,9 @@ def main() -> None:
         except Exception as e:
             log(f"cpu reference bench failed: {e}")
 
+    for fam, p in tail_latencies_ms().items():
+        log(f"  {fam} latency: p50 {p['p50']} ms, p95 {p['p95']} ms, "
+            f"p99 {p['p99']} ms")
     emit_line()
 
 

@@ -40,6 +40,7 @@ from ozone_tpu.codec.pipeline import (
 )
 from ozone_tpu.storage.ids import BlockData, ChunkInfo, StorageError
 from ozone_tpu.utils.checksum import ChecksumType
+from ozone_tpu.utils.tracing import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -140,9 +141,11 @@ class ECBlockGroupReader:
         if u not in self._block_meta:
             dn_id = self.group.pipeline.nodes[u]
             try:
-                self._block_meta[u] = self._health.observe(
-                    dn_id, self.clients.get(dn_id).get_block,
-                    self.group.block_id)
+                with Tracer.instance().span("net:get_block", dn=dn_id,
+                                            unit=u):
+                    self._block_meta[u] = self._health.observe(
+                        dn_id, self.clients.get(dn_id).get_block,
+                        self.group.block_id)
             except (StorageError, KeyError, OSError) as e:
                 if isinstance(e, StorageError) \
                         and e.code == resilience.DEADLINE_EXCEEDED:
@@ -191,9 +194,11 @@ class ECBlockGroupReader:
         if info is None:
             return out  # cell has no data (short final stripe)
         dn_id = self.group.pipeline.nodes[u]
-        data = self._health.observe(
-            dn_id, self.clients.get(dn_id).read_chunk,
-            self.group.block_id, info, verify=self.verify)
+        with Tracer.instance().span("net:read_chunk", dn=dn_id,
+                                    unit=u, stripe=stripe):
+            data = self._health.observe(
+                dn_id, self.clients.get(dn_id).read_chunk,
+                self.group.block_id, info, verify=self.verify)
         out[: data.size] = data
         return out
 
@@ -224,9 +229,11 @@ class ECBlockGroupReader:
             fn = getattr(client, "read_chunks", None)
             if fn is None:
                 return
-            datas = self._health.observe(
-                dn_id, fn, self.group.block_id,
-                [i for _, i in wanted], verify=self.verify)
+            with Tracer.instance().span("net:read_chunks", dn=dn_id,
+                                        unit=u, cells=len(wanted)):
+                datas = self._health.observe(
+                    dn_id, fn, self.group.block_id,
+                    [i for _, i in wanted], verify=self.verify)
         except (StorageError, KeyError, OSError) as e:
             if isinstance(e, StorageError) \
                     and e.code == resilience.DEADLINE_EXCEEDED:
@@ -361,12 +368,14 @@ class ECBlockGroupReader:
         self._close_pool()
 
     def _submit_act(self, pool, fn, *args):
-        """Submit with the operation deadline re-activated on the worker
-        (contextvars don't cross executor threads)."""
+        """Submit with the operation deadline AND trace context
+        re-activated on the worker (neither contextvars nor the
+        thread-local span stack cross executor threads)."""
         d = self._deadline
+        ctx = Tracer.instance().inject()
 
         def run():
-            with resilience.activate(d):
+            with resilience.activate(d), Tracer.instance().activate(ctx):
                 return fn(*args)
 
         return pool.submit(run)
@@ -425,6 +434,11 @@ class ECBlockGroupReader:
         plan cache (one compiled program per erasure pattern). Peeks
         the prefetch cache and mutates no reader state, so a losing
         decode leaves no trace."""
+        with Tracer.instance().span("ec:decode_from_parity", unit=u,
+                                    stripe=stripe):
+            return self._decode_cell_traced(u, stripe)
+
+    def _decode_cell_traced(self, u: int, stripe: int) -> np.ndarray:
         others = [x for x in self.available_units() if x != u]
         nodes = self.group.pipeline.nodes
         order = {dn: i for i, dn in enumerate(
@@ -481,6 +495,9 @@ class ECBlockGroupReader:
             stragglers = sorted(futs[f] for f in pending)[: len(spares)]
             if stragglers:
                 resilience.METRICS.counter("hedges_fired").inc()
+                Tracer.instance().event("hedge_fired",
+                                        stragglers=stragglers,
+                                        spares=spares)
                 log.warning(
                     "survivor unit(s) %s straggling past %.3fs; hedging "
                     "into decode via spare unit(s) %s",
@@ -597,6 +614,8 @@ class ECBlockGroupReader:
                     # its primary (HedgeGroup), and the replanned decode
                     # hasn't succeeded yet at this point.
                     resilience.METRICS.counter("straggler_replans").inc()
+                    Tracer.instance().event("straggler_replan",
+                                            units=e.units)
                     self._failed.update(e.units)
                     if not exclude_stragglers:
                         # the CALLER replans (read() folds the straggler
@@ -703,6 +722,12 @@ class ECBlockGroupReader:
         # refresh per call (see recover_cells_iter): never re-activate a
         # previous operation's expired budget on a reused reader
         self._deadline = resilience.current()
+        with Tracer.instance().span("ec:read", offset=offset,
+                                    bytes=length):
+            return self._read_traced(out, offset, length)
+
+    def _read_traced(self, out: np.ndarray, offset: int,
+                     length: int) -> np.ndarray:
         try:
             # p hard failures plus straggler hedges both consume
             # attempts (hedges are detected within one hedge window,

@@ -53,6 +53,7 @@ from ozone_tpu.storage.ids import (
     StorageError,
 )
 from ozone_tpu.utils.checksum import Checksum, ChecksumData, ChecksumType
+from ozone_tpu.utils.tracing import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -362,15 +363,19 @@ class ECKeyWriter:
                 deadline=self._deadline)
             prev, self._pending = self._pending, (stripes, fut)
         else:
-            parity_dev, crcs_dev = self._fused(batch)  # async dispatch
-            for a in (parity_dev, crcs_dev):
-                # start the D2H transfer eagerly where the backend
-                # supports it, so it runs under the previous batch's
-                # network writes
-                try:
-                    a.copy_to_host_async()
-                except (AttributeError, RuntimeError):  # ozlint: allow[error-swallowing] -- optional eager-D2H hint; backends without it fall back to sync pull
-                    pass
+            with Tracer.instance().span("codec:device_dispatch",
+                                        rows=len(stripes),
+                                        width=self.stripe_batch,
+                                        direct=True):
+                parity_dev, crcs_dev = self._fused(batch)  # async dispatch
+                for a in (parity_dev, crcs_dev):
+                    # start the D2H transfer eagerly where the backend
+                    # supports it, so it runs under the previous batch's
+                    # network writes
+                    try:
+                        a.copy_to_host_async()
+                    except (AttributeError, RuntimeError):  # ozlint: allow[error-swallowing] -- optional eager-D2H hint; backends without it fall back to sync pull
+                        pass
             prev, self._pending = self._pending, (stripes, parity_dev,
                                                   crcs_dev)
         if prev is not None:
@@ -403,6 +408,10 @@ class ECKeyWriter:
         streaming mode. Falls back to the per-stripe path (commit order
         defines the ack watermark, as in flushStripeFromQueue:526) when
         a member lacks the verb."""
+        with Tracer.instance().span("ec:flush", stripes=len(stripes)):
+            self._write_batch_traced(stripes, parity_dev, crcs_dev)
+
+    def _write_batch_traced(self, stripes, parity_dev, crcs_dev) -> None:
         parity = np.asarray(parity_dev)
         crcs = np.asarray(crcs_dev)  # [B, k+p, S] uint32
 
@@ -741,14 +750,16 @@ class ECKeyWriter:
         return self._rpc_pool
 
     def _act(self, fn):
-        """Wrap a pool callable so the operation deadline is ambient on
-        the worker thread (RPC timeouts below derive from it)."""
+        """Wrap a pool callable so the operation deadline AND trace
+        context are ambient on the worker thread (RPC timeouts derive
+        from the deadline; per-hop spans join the operation's trace)."""
         d = self._deadline
-        if d is None:
+        ctx = Tracer.instance().inject()
+        if d is None and not ctx:
             return fn
 
         def wrapped(*a):
-            with resilience.activate(d):
+            with resilience.activate(d), Tracer.instance().activate(ctx):
                 return fn(*a)
 
         return wrapped
@@ -758,8 +769,12 @@ class ECKeyWriter:
         (resilience.is_transport_fault — which already exempts the
         batch-unsupported UNIMPLEMENTED downgrade and application
         outcomes like a closed container) so the writer can never move
-        a peer's breaker differently than the read paths do."""
-        return self._health.observe(dn_id, fn, *a, **kw)
+        a peer's breaker differently than the read paths do. Every hop
+        gets a span: the per-unit RPC is the "network" stage a slow
+        PUT's critical path attributes to."""
+        with Tracer.instance().span(
+                f"net:{getattr(fn, '__name__', 'rpc')}", dn=dn_id):
+            return self._health.observe(dn_id, fn, *a, **kw)
 
     # ------------------------------------------------------------------ groups
     def _ensure_group(self) -> BlockGroup:

@@ -9,6 +9,7 @@ the key's replication config.
 
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
@@ -21,6 +22,13 @@ from ozone_tpu.client.replicated import ReplicatedKeyReader, ReplicatedKeyWriter
 from ozone_tpu.om.om import OpenKeySession, OzoneManager
 from ozone_tpu.scm.pipeline import ReplicationType
 from ozone_tpu.utils.checksum import ChecksumType
+from ozone_tpu.utils.metrics import registry
+from ozone_tpu.utils.tracing import Tracer
+
+#: end-to-end client operation latency (PUT/GET histograms with trace
+#: exemplars: the scrape-side view of the same distribution the flight
+#: recorder retains outliers from)
+METRICS = registry("client.ops")
 
 
 class KeyWriteHandle:
@@ -55,17 +63,20 @@ class KeyWriteHandle:
         then commit the key at the synced length with the session kept
         alive. Not supported for EC keys (reference parity)."""
         groups = self._writer.hsync()
-        self._om.hsync_key(
-            self._session, groups, self._writer.bytes_written
-        )
+        with Tracer.instance().span("om:commit", hsync=True):
+            self._om.hsync_key(
+                self._session, groups, self._writer.bytes_written
+            )
 
     def close(self) -> None:
         if self._committed:
             return
         groups = self._writer.close()
-        self._om.commit_key(
-            self._session, groups, self._writer.bytes_written
-        )
+        with Tracer.instance().span("om:commit",
+                                    key=self._session.key):
+            self._om.commit_key(
+                self._session, groups, self._writer.bytes_written
+            )
         self._committed = True
 
     def __enter__(self):
@@ -210,8 +221,10 @@ class OzoneBucket:
         acls: Optional[list] = None,
     ) -> KeyWriteHandle:
         om = self.client.om
-        session = om.open_key(self.volume, self.name, key, replication,
-                              metadata=metadata, acls=acls)
+        with Tracer.instance().span("om:open_key", key=key):
+            session = om.open_key(self.volume, self.name, key,
+                                  replication, metadata=metadata,
+                                  acls=acls)
         return KeyWriteHandle(session, om, self._make_writer(session),
                               dek=self._data_key(session.encryption))
 
@@ -220,10 +233,17 @@ class OzoneBucket:
                   metadata: Optional[dict] = None) -> None:
         # key-write operation boundary: ONE deadline (operator opt-in,
         # OZONE_TPU_OP_DEADLINE_S) spans open, every stripe/chunk RPC
-        # and the commit — each hop times out on the remaining budget
-        with resilience.start("key_write"):
-            with self.open_key(key, replication, metadata=metadata) as h:
-                h.write(data)
+        # and the commit — each hop times out on the remaining budget.
+        # The root span is the flight recorder's SLO unit for a PUT.
+        t0 = time.perf_counter()
+        with Tracer.instance().span("client:put", volume=self.volume,
+                                    bucket=self.name, key=key) as sp:
+            with resilience.start("key_write"):
+                with self.open_key(key, replication,
+                                   metadata=metadata) as h:
+                    h.write(data)
+        METRICS.histogram("put_seconds").observe(
+            time.perf_counter() - t0, sp.trace_id)
 
     def lookup_key_info(self, key: str) -> dict:
         """Key info lookup with `.snapshot/<name>/<key>` routing (the
@@ -268,8 +288,16 @@ class OzoneBucket:
         if offset < 0 or length < 0 or offset + length > size:
             raise ValueError(f"range [{offset},{offset + length}) out of "
                              f"bounds for size {size}")
-        with resilience.start("key_read"):
-            return self._read_groups_range(om, info, offset, length)
+        t0 = time.perf_counter()
+        with Tracer.instance().span("client:get", volume=self.volume,
+                                    bucket=self.name,
+                                    key=info.get("key", ""),
+                                    bytes=length) as sp:
+            with resilience.start("key_read"):
+                out = self._read_groups_range(om, info, offset, length)
+        METRICS.histogram("get_seconds").observe(
+            time.perf_counter() - t0, sp.trace_id)
+        return out
 
     def _read_groups_range(self, om, info: dict, offset: int,
                            length: int) -> np.ndarray:

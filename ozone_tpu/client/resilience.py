@@ -59,6 +59,7 @@ from typing import Callable, Iterable, Optional, Sequence
 
 from ozone_tpu.storage.ids import StorageError
 from ozone_tpu.utils.metrics import MetricsRegistry, registry
+from ozone_tpu.utils.tracing import Tracer
 
 #: StorageError code for a spent operation budget; transport-shaped
 #: (like UNAVAILABLE) so failover/exclude machinery treats it as
@@ -100,6 +101,8 @@ class Deadline:
             METRICS.counter("deadline_exceeded").inc()
             if verb:
                 METRICS.counter(f"deadline_exceeded_{verb}").inc()
+            Tracer.instance().event("deadline_exceeded", op=self.op,
+                                    verb=verb)
             raise StorageError(
                 DEADLINE_EXCEEDED,
                 f"operation {self.op} deadline exceeded"
@@ -234,6 +237,8 @@ class RetryPolicy:
                 return False
             d = min(d, left)
         METRICS.counter("retries_slept").inc()
+        Tracer.instance().event("retry", attempt=attempt + 1,
+                                backoff_ms=round(d * 1e3, 1))
         time.sleep(d)
         return not (deadline is not None and deadline.expired())
 
@@ -323,6 +328,7 @@ class PeerHealth:
                 self._state = BreakerState.CLOSED
                 self._probe_claimed = False
                 METRICS.counter("breaker_closed").inc()
+                Tracer.instance().event("breaker_closed", peer=self.peer)
 
     def record_failure(self) -> None:
         with self._lock:
@@ -335,11 +341,14 @@ class PeerHealth:
                 self._opened_at = time.monotonic()
                 self._probe_claimed = False
                 METRICS.counter("breaker_reopened").inc()
+                Tracer.instance().event("breaker_reopened",
+                                        peer=self.peer)
             elif (self._state is BreakerState.CLOSED
                   and self.consecutive_failures >= self._open_after):
                 self._state = BreakerState.OPEN
                 self._opened_at = time.monotonic()
                 METRICS.counter("breaker_opened").inc()
+                Tracer.instance().event("breaker_opened", peer=self.peer)
 
     # ---------------------------------------------------------- decisions
     @property
@@ -451,7 +460,11 @@ class HealthRegistry:
         allow() this never consumes the half-open probe, so a peer can
         never be starved of its recovery probe by callers that were
         only comparing candidates."""
-        return self.get(peer).state is not BreakerState.OPEN
+        ok = self.get(peer).state is not BreakerState.OPEN
+        if not ok:
+            METRICS.counter("breaker_skips").inc()
+            Tracer.instance().event("breaker_skip", peer=peer)
+        return ok
 
     def is_open(self, peer: str) -> bool:
         with self._lock:
@@ -570,10 +583,13 @@ class HedgeGroup:
         fired = 0
         errors: list[BaseException] = []
 
+        ctx = Tracer.instance().inject()
+
         def fire(fn: Callable[[], object], idx: int) -> None:
             if idx > 0:
                 self.metrics.counter("hedges_fired").inc()
-            futs[ex.submit(self._wrap(fn, deadline))] = idx
+                Tracer.instance().event("hedge_fired", idx=idx)
+            futs[ex.submit(self._wrap(fn, deadline, ctx))] = idx
 
         fire(primary, 0)
         while True:
@@ -601,6 +617,7 @@ class HedgeGroup:
                     # on the daemon pool, their results discarded
                     if idx > 0:
                         self.metrics.counter("hedges_won").inc()
+                        Tracer.instance().event("hedge_won", idx=idx)
                     return HedgeWinner(f.result(), idx, fired > 0)
                 errors.append(err)
                 failed_this_round = True
@@ -611,9 +628,12 @@ class HedgeGroup:
                 fire(todo.pop(0), fired)
 
     @staticmethod
-    def _wrap(fn: Callable[[], object], deadline: Optional[Deadline]):
+    def _wrap(fn: Callable[[], object], deadline: Optional[Deadline],
+              trace_ctx: str = ""):
         def run():
-            with activate(deadline):
+            # hedge branches run on the shared daemon pool: both the
+            # deadline and the trace context must travel explicitly
+            with activate(deadline), Tracer.instance().activate(trace_ctx):
                 return fn()
 
         return run

@@ -138,7 +138,7 @@ def _measure_link(size: int = 4 * 2**20) -> tuple[float, float]:
     np.asarray(warm)
     t0 = time.perf_counter()
     on_dev = jax.device_put(host, dev)
-    on_dev.block_until_ready()
+    on_dev.block_until_ready()  # ozlint: allow[span-on-dispatch] -- offline link probe at import/benchmark time, not a request-path dispatch
     h2d = size / 2**20 / max(time.perf_counter() - t0, 1e-9)
     on_dev = bump(on_dev)
     on_dev.block_until_ready()

@@ -45,7 +45,7 @@ def _start_d2h(out: Any) -> None:
     # caller's host work on the previous batch (same trick as
     # ec_writer._flush_queue)
     try:
-        out.copy_to_host_async()
+        out.copy_to_host_async()  # ozlint: allow[span-on-dispatch] -- the D2H hint helper itself; every caller brackets it in its own dispatch span
     except (AttributeError, RuntimeError):  # ozlint: allow[error-swallowing] -- optional eager-D2H hint; backends without it fall back to sync pull
         pass
 
@@ -66,7 +66,7 @@ class DeviceBatchPipeline:
         if not isinstance(outs, tuple):
             outs = (outs,)
         for a in outs:
-            _start_d2h(a)
+            _start_d2h(a)  # ozlint: allow[span-on-dispatch] -- per-operation pipeline: the owning op (ec:flush / ec:read) brackets submit() in its span
         prev, self._pending = self._pending, (ctx, outs)
         return self._to_host(prev)
 

@@ -61,6 +61,7 @@ from ozone_tpu.codec.pipeline import _start_d2h
 from ozone_tpu.storage.ids import StorageError
 from ozone_tpu.utils.config import env_float
 from ozone_tpu.utils.metrics import MetricsRegistry, registry
+from ozone_tpu.utils.tracing import Tracer
 
 log = logging.getLogger(__name__)
 
@@ -112,7 +113,8 @@ class _Sub:
     """One submission: `n` same-shape stripes from one operation."""
 
     __slots__ = ("stripes", "n", "future", "cls", "deadline", "t_enq",
-                 "tail", "taken", "pending_parts", "parts")
+                 "t_enq_wall", "trace_ctx", "tail", "taken",
+                 "pending_parts", "parts")
 
     def __init__(self, stripes: np.ndarray, future: Future, cls: str,
                  deadline, tail: bool):
@@ -122,6 +124,11 @@ class _Sub:
         self.cls = cls
         self.deadline = deadline
         self.t_enq = time.monotonic()
+        self.t_enq_wall = time.time()
+        #: submitter's trace context: the dispatcher runs on its own
+        #: thread, so per-submission spans must join the operation's
+        #: trace explicitly, not via the thread-local span stack
+        self.trace_ctx = Tracer.instance().inject()
         self.tail = tail
         self.taken = 0          # stripes already packed into dispatches
         self.pending_parts = 0  # dispatched parts not yet completed
@@ -384,7 +391,15 @@ class CodecService:
     def _dispatch(self, lane: _Lane, entries, rows: int,
                   reason: str) -> None:
         now = time.monotonic()
+        now_wall = time.time()
         ops = len(entries)
+        tracer = Tracer.instance()
+        # one shared dispatch span id per device dispatch: every
+        # coalesced submission's span tags it, making cross-request
+        # batching visible from any participating trace
+        d_tid, d_sid = tracer._new_id(), tracer._new_id()
+        fill_pct = round(100.0 * rows / lane.width, 1)
+        lane_desc = str(lane.lane_key)[:120]
         with self._lock:
             # fairness accounting under the lock: submit()'s SFQ
             # activation floor does a read-modify-write of the same
@@ -396,8 +411,16 @@ class CodecService:
         for sub, off, take, _row in entries:
             if off == 0:
                 wait = now - sub.t_enq
-                METRICS.timer("queue_wait_seconds").update(wait)
-                METRICS.timer(f"queue_wait_{sub.cls}_seconds").update(wait)
+                tid = sub.trace_ctx.split(":", 1)[0]
+                METRICS.histogram("queue_wait_seconds").observe(wait, tid)
+                METRICS.histogram(
+                    f"queue_wait_{sub.cls}_seconds").observe(wait, tid)
+                if sub.trace_ctx:
+                    tracer.record_span(
+                        "codec:queue_wait", child_of=sub.trace_ctx,
+                        start=sub.t_enq_wall, duration=wait,
+                        lane=lane_desc, qos=sub.cls, fill_pct=fill_pct,
+                        dispatch_span=d_sid)
                 if sub.tail:
                     METRICS.counter("tail_flushes").inc()
         head = entries[0]
@@ -439,18 +462,39 @@ class CodecService:
         METRICS.gauge("last_coalesced_operations").set(ops)
         with self._lock:
             METRICS.gauge("queue_depth").set(self._queue_depth_locked())
-        self._inflight.append((entries, outs, t0))
+        self._inflight.append((entries, outs, t0, time.time(),
+                               (d_tid, d_sid, fill_pct, reason,
+                                lane_desc, ops, rows, lane.width)))
 
     def _complete(self, rec: tuple) -> None:
-        entries, outs, t0 = rec
+        entries, outs, t0, t0_wall, dctx = rec
+        d_tid, d_sid, fill_pct, reason, lane_desc, ops, rows, width = dctx
         try:
             host = tuple(np.asarray(a) for a in outs)
         except BaseException as e:  # noqa: BLE001 - D2H fault
             self._resolve_error(entries, e)
             return
-        self._dispatch_ewma_s += 0.2 * (
-            (time.monotonic() - t0) - self._dispatch_ewma_s)
-        METRICS.timer("dispatch_seconds").update(time.monotonic() - t0)
+        dt = time.monotonic() - t0
+        self._dispatch_ewma_s += 0.2 * (dt - self._dispatch_ewma_s)
+        METRICS.histogram("dispatch_seconds").observe(
+            dt, entries[0][0].trace_ctx.split(":", 1)[0])
+        tracer = Tracer.instance()
+        # the shared dispatch span (own trace, id known to every rider)
+        tracer.record_span(
+            "codec:device_dispatch", child_of=f"{d_tid}:",
+            span_id=d_sid, start=t0_wall, duration=dt,
+            lane=lane_desc, ops=ops, rows=rows, width=width,
+            fill_pct=fill_pct, reason=reason)
+        for sub, off, take, _row in entries:
+            # per-submission dispatch span in the *submitter's* trace,
+            # carrying the shared span id: two concurrent operations
+            # coalesced into one device batch both show dispatch_span=d_sid
+            if sub.trace_ctx:
+                tracer.record_span(
+                    "codec:dispatch", child_of=sub.trace_ctx,
+                    start=t0_wall, duration=dt, lane=lane_desc,
+                    qos=sub.cls, stripes=take, fill_pct=fill_pct,
+                    dispatch_span=d_sid, dispatch_trace=d_tid)
         for sub, off, take, row in entries:
             sub.parts.append(
                 (off, take, tuple(a[row:row + take] for a in host)))

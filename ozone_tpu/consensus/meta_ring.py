@@ -187,11 +187,16 @@ class MetaHARing(RaftSCM):
         # submit: only the leader admits, so a mixed-version ring stays
         # deterministic (followers apply whatever was admitted)
         self.om.check_layout_allowed(type(request).__name__)
-        request.pre_execute(self.om)
-        result = self.node.propose({"om": request.to_json()})
-        # block allocation in preExecute produced SCM decision records;
-        # the client ack covers them too
-        self._await_records()
+        from ozone_tpu.utils.tracing import Tracer
+
+        with Tracer.instance().span("om:submit",
+                                    request=type(request).__name__,
+                                    ha=True):
+            request.pre_execute(self.om)
+            result = self.node.propose({"om": request.to_json()})
+            # block allocation in preExecute produced SCM decision
+            # records; the client ack covers them too
+            self._await_records()
         if isinstance(result, Exception):
             raise result
         return result

@@ -812,26 +812,37 @@ class RaftNode:
                 raise NotRaftLeaderError(self.node_id, None)
             index = self._propose_locked(data, register_waiter=True)
         deadline = time.monotonic() + timeout
+        from ozone_tpu.utils.tracing import Tracer
+
+        t_wait = time.monotonic()
         try:
-            with self._commit_cv:
-                while self.last_applied < index:
-                    left = deadline - time.monotonic()
-                    if left <= 0 or self._stop.is_set():
-                        raise TimeoutError(
-                            f"entry {index} not committed within {timeout}s")
-                    if self.role != LEADER:
-                        raise NotRaftLeaderError(self.node_id,
-                                                 self.leader_hint)
-                    self._commit_cv.wait(timeout=min(left, 0.05))
-                    # single-threaded test mode: no timer thread to push
-                    # replication, so drive it from here
-                    if self.last_applied < index and self._timer_thread is None:
-                        self._commit_cv.release()
-                        try:
-                            self._broadcast_heartbeat()
-                        finally:
-                            self._commit_cv.acquire()
-                result = self._results.pop(index, None)
+            # the replicate-to-quorum-and-apply wait IS the consensus
+            # cost a slow write pays: span + histogram so a retained
+            # trace and the scrape agree on the commit stage
+            with Tracer.instance().span("raft:commit_wait", index=index):
+                with self._commit_cv:
+                    while self.last_applied < index:
+                        left = deadline - time.monotonic()
+                        if left <= 0 or self._stop.is_set():
+                            raise TimeoutError(
+                                f"entry {index} not committed within "
+                                f"{timeout}s")
+                        if self.role != LEADER:
+                            raise NotRaftLeaderError(self.node_id,
+                                                     self.leader_hint)
+                        self._commit_cv.wait(timeout=min(left, 0.05))
+                        # single-threaded test mode: no timer thread to
+                        # push replication, so drive it from here
+                        if self.last_applied < index \
+                                and self._timer_thread is None:
+                            self._commit_cv.release()
+                            try:
+                                self._broadcast_heartbeat()
+                            finally:
+                                self._commit_cv.acquire()
+                    result = self._results.pop(index, None)
+            self.metrics.histogram("commit_seconds").observe(
+                time.monotonic() - t_wait)
             return result
         finally:
             with self._lock:

@@ -943,6 +943,9 @@ class ScmOmDaemon:
                 self.om, self.scm, host=host, port=recon_port,
                 db_path=Path(om_db).parent / "recon.db",
             )
+            # slow-trace view serves the cluster collector's ring, not
+            # just this process's own recorder
+            self.recon.trace_collector = self.trace_collector
         # recon tasks do full-namespace scans + warehouse inserts: they
         # run on their own minute-scale cadence (reference
         # ReconTaskController schedules), never per background tick

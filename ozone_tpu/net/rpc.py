@@ -110,8 +110,16 @@ class _GenericHandler(grpc.GenericRpcHandler):
         yield loop, not just the call."""
         def wrapped(request, context: grpc.ServicerContext):
             self._check_revoked(context)
+            from ozone_tpu.utils.tracing import Tracer
+
+            remote_ctx = dict(context.invocation_metadata()).get(
+                "x-trace-id")
             try:
-                yield from fn(request)
+                with Tracer.instance().span(
+                    f"server:{method_name}",
+                    child_of=remote_ctx or None,
+                ):
+                    yield from fn(request)
             except StorageError as e:
                 context.abort(
                     grpc.StatusCode.ABORTED,
@@ -333,14 +341,21 @@ class RpcChannel:
                            timeout: Optional[float] = 300.0):
         """Server-streaming call: one request, an iterator of byte
         frames back (large downloads never buffer in one message)."""
+        from ozone_tpu.utils.tracing import Tracer
+
         key = f"/{service}/{method}"
         self._check_partition(key, timeout)
         fn = self._calls.get(key)
         if fn is None:
             fn = self._channel.unary_stream(key)
             self._calls[key] = fn
+        tracer = Tracer.instance()
         try:
-            yield from fn(request, timeout=timeout)
+            with tracer.span(f"client:{key}", address=self.address):
+                ctx = tracer.inject()
+                metadata = (("x-trace-id", ctx),) if ctx else None
+                yield from fn(request, timeout=timeout,
+                              metadata=metadata)
         except grpc.RpcError as e:
             raise self._map_rpc_error(key, e) from e
 

@@ -323,7 +323,11 @@ class OzoneManager:
                 "OM_PREPARED",
                 "OM is prepared for upgrade; writes are rejected until "
                 "cancelprepare")
-        with self.metrics.timer(request.audit_action).time():
+        from ozone_tpu.utils.tracing import Tracer
+
+        with self.metrics.timer(request.audit_action).time(), \
+                Tracer.instance().span("om:submit",
+                                       request=type(request).__name__):
             request.pre_execute(self)
             with self._lock:
                 if self.prepared:

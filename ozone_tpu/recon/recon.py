@@ -645,6 +645,10 @@ class ReconServer:
         self.warehouse = (
             ReconWarehouse(db_path) if db_path is not None else None
         )
+        #: optional cluster TraceCollector (daemons wire theirs in) —
+        #: the slow-traces view then merges its flight recorder with
+        #: the process-local one
+        self.trace_collector = None
         # full-namespace-scan task outputs are served from a short TTL
         # cache: the UI polls every 10s from any number of tabs, and a
         # scan must cost at most one pass per TTL window, not one per
@@ -734,6 +738,12 @@ class ReconServer:
                     # continuous-batching health, next to lifecycle —
                     # its main bulk consumer)
                     "/api/codec": recon.codec_view,
+                    # slow-request flight recorder: retained
+                    # over-SLO traces; ?id=<traceId> returns the full
+                    # span set + critical path for one trace
+                    "/api/traces/slow": lambda: recon.traces_slow_view(
+                        q.get("id", [None])[0],
+                        int(q.get("limit", ["50"])[0])),
                 }
                 fn = routes.get(path)
                 if fn is not None:
@@ -765,6 +775,33 @@ class ReconServer:
         with self._scan_lock:
             self._scan_cache[key] = (time.monotonic(), val)
         return val
+
+    def traces_slow_view(self, trace_id: Optional[str] = None,
+                         limit: int = 50) -> dict:
+        """Slow-request flight recorder surface: newest-first summaries
+        of traces retained past their per-op SLO, or — with ?id= — one
+        trace's full span set and critical path. PEEKS at the
+        process-local recorder (plus the daemon's TraceCollector ring
+        when one is wired in); a monitoring GET never starts tracing."""
+        from ozone_tpu.utils.tracing import Tracer
+
+        recorders = [Tracer.instance().recorder]
+        if self.trace_collector is not None:
+            recorders.append(self.trace_collector.recorder)
+        if trace_id:
+            for r in recorders:
+                entry = r.trace(trace_id)
+                if entry is not None:
+                    return entry
+            return {"error": f"trace {trace_id} not retained"}
+        out, seen = [], set()
+        for r in recorders:
+            for e in r.slow(limit):
+                if e["traceId"] not in seen:
+                    seen.add(e["traceId"])
+                    out.append(e)
+        out.sort(key=lambda e: e["start"], reverse=True)
+        return {"traces": out[:limit]}
 
     def codec_view(self) -> dict:
         """Shared codec service snapshot for the dashboard panel:

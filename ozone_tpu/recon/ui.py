@@ -218,6 +218,17 @@ RECON_INDEX_HTML = """<!doctype html>
     dispatches &mdash; fill ratio, queue depth, QoS/linger flushes</div>
   <div class="tiles" id="codec-tiles"></div>
 
+  <h2>Slow requests</h2>
+  <div class="sub">flight recorder: traces retained past their per-op
+    SLO &mdash; click a trace for its critical path (stage &rarr;
+    &micro;s latency attribution)</div>
+  <table id="slow-traces">
+    <thead><tr><th>trace</th><th>op</th><th>duration</th>
+      <th>SLO</th><th>spans</th></tr></thead>
+    <tbody></tbody>
+  </table>
+  <div id="slow-detail"></div>
+
   <h2>Container &rarr; keys</h2>
   <div class="sub">which keys reference a container (the reference's
     ContainerKeyMapper view) &mdash; enter a container id</div>
@@ -404,6 +415,16 @@ async function refresh() {
       tile("tail flushes", cx.tail_flushes ?? 0),
       tile("starvation trips", cx.starvation_guard_trips ?? 0),
     ].join("");
+    const sl = await (await fetch("/api/traces/slow")).json();
+    document.querySelector("#slow-traces tbody").innerHTML =
+      (sl.traces || []).map(t =>
+        `<tr><td><a href="#" onclick="showTrace('${esc(t.traceId)}');` +
+        `return false">${esc(t.traceId)}</a></td>` +
+        `<td>${esc(t.root)}</td>` +
+        `<td>${(t.durationMs ?? 0).toFixed(1)} ms</td>` +
+        `<td>${(t.sloMs ?? 0).toFixed(0)} ms</td>` +
+        `<td>${esc(t.spans)}</td></tr>`).join("") ||
+      '<tr><td colspan="5">no traces over SLO retained</td></tr>';
     const uh = await (await fetch("/api/containers/unhealthy")).json();
     document.querySelector("#unhealthy tbody").innerHTML = uh
       .map(r => `<tr><td>${esc(r.container)}</td>` +
@@ -452,6 +473,27 @@ async function lookupContainer() {
     '<tr><td colspan="2">no keys reference it</td></tr>';
 }
 document.getElementById("ck-go").onclick = lookupContainer;
+// slow-trace drill-down: the critical path is the answer to "where
+// did this request spend its time" — render it as a stage table
+async function showTrace(id) {
+  const res = await fetch("/api/traces/slow?id=" +
+      encodeURIComponent(id));
+  const t = res.ok ? await res.json() : {};
+  const cp = t.criticalPath || [];
+  const total = cp.reduce((a, s) => a + s.micros, 0) || 1;
+  document.getElementById("slow-detail").innerHTML =
+    `<div class="sub">trace ${esc(id)} &mdash; ` +
+    `${esc(t.root || "?")} ${(t.durationMs ?? 0).toFixed(1)} ms, ` +
+    `${(t.spans || []).length} spans</div>` +
+    '<table><thead><tr><th>stage</th><th>&micro;s</th><th>share</th>' +
+    "</tr></thead><tbody>" +
+    (cp.map(s =>
+      `<tr><td>${esc(s.stage)}</td><td>${esc(s.micros)}</td>` +
+      `<td>${(100 * s.micros / total).toFixed(1)}%</td></tr>`)
+      .join("") ||
+     '<tr><td colspan="3">trace no longer retained</td></tr>') +
+    "</tbody></table>";
+}
 // du drill-down: click rows to descend, the header crumb to reset
 let duPath = "/";
 async function refreshDu(p) {

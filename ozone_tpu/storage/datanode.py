@@ -177,12 +177,13 @@ class Datanode:
         self, block_id: BlockID, info: ChunkInfo, data, sync: bool = False,
         writer: Optional[str] = None,
     ) -> None:
-        c = self.containers.get(block_id.container_id)
-        c.require_writable()
-        self._fence(c, block_id, writer)
-        c.chunks.write_chunk(block_id, info, data, sync=sync)
-        self.mutation_count += 1
-        self.metrics.counter("bytes_written").inc(info.length)
+        with self.metrics.histogram("chunk_write_seconds").time():
+            c = self.containers.get(block_id.container_id)
+            c.require_writable()
+            self._fence(c, block_id, writer)
+            c.chunks.write_chunk(block_id, info, data, sync=sync)
+            self.mutation_count += 1
+            self.metrics.counter("bytes_written").inc(info.length)
 
     def _fence(self, container, block_id: BlockID,
                writer: Optional[str]) -> None:
@@ -212,17 +213,19 @@ class Datanode:
     def read_chunk(
         self, block_id: BlockID, info: ChunkInfo, verify: bool = False
     ) -> np.ndarray:
-        c = self.containers.get(block_id.container_id)
-        data = c.chunks.read_chunk(block_id, info)
-        if verify and info.checksum.checksums:
-            try:
-                Checksum().verify(data, info.checksum, offset_hint=str(block_id))
-            except ChecksumError as e:
-                self.metrics.counter("checksum_failures").inc()
-                self.on_read_error(c)
-                raise StorageError(CHECKSUM_MISMATCH, str(e)) from e
-        self.metrics.counter("bytes_read").inc(info.length)
-        return data
+        with self.metrics.histogram("chunk_read_seconds").time():
+            c = self.containers.get(block_id.container_id)
+            data = c.chunks.read_chunk(block_id, info)
+            if verify and info.checksum.checksums:
+                try:
+                    Checksum().verify(data, info.checksum,
+                                      offset_hint=str(block_id))
+                except ChecksumError as e:
+                    self.metrics.counter("checksum_failures").inc()
+                    self.on_read_error(c)
+                    raise StorageError(CHECKSUM_MISMATCH, str(e)) from e
+            self.metrics.counter("bytes_read").inc(info.length)
+            return data
 
     def put_block(self, block: BlockData, sync: bool = False,
                   writer: Optional[str] = None) -> None:

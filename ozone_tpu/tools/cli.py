@@ -1411,6 +1411,49 @@ def cmd_getconf(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """`ozone-tpu trace slow|show`: the slow-request flight recorder —
+    list traces retained past their per-op SLO, or print one trace's
+    critical path (ordered stage -> micros latency attribution) from
+    the cluster trace collector."""
+    from ozone_tpu.net import wire
+    from ozone_tpu.net.rpc import RpcChannel
+    from ozone_tpu.utils.tracing import TRACING_SERVICE
+
+    ch = RpcChannel(args.om.split(",")[0].strip(), tls=_client_tls())
+    try:
+        if args.verb == "slow":
+            m, _ = wire.unpack(ch.call(
+                TRACING_SERVICE, "Slow",
+                wire.pack({"limit": args.limit})))
+            _emit(m.get("traces", []))
+            return 0
+        if not args.trace_id:
+            print("error: trace show requires a trace id",
+                  file=sys.stderr)
+            return 2
+        m, _ = wire.unpack(ch.call(
+            TRACING_SERVICE, "Slow",
+            wire.pack({"trace_id": args.trace_id})))
+        entry = m.get("trace")
+        if not entry:
+            print(f"error: trace {args.trace_id!r} not retained "
+                  "(only over-SLO traces are pinned)", file=sys.stderr)
+            return 1
+        print(f"trace {entry['traceId']}  root={entry['root']}  "
+              f"{entry['durationMs']}ms (slo {entry['sloMs']}ms)  "
+              f"{len(entry['spans'])} spans")
+        print("critical path:")
+        total = sum(s["micros"] for s in entry["criticalPath"]) or 1
+        for st in entry["criticalPath"]:
+            share = 100.0 * st["micros"] / total
+            print(f"  {st['stage']:<28} {st['micros']:>12} us  "
+                  f"{share:5.1f}%")
+        return 0
+    finally:
+        ch.close()
+
+
 # -------------------------------------------------------------------- main
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(prog="ozone-tpu")
@@ -1823,6 +1866,18 @@ def build_parser() -> argparse.ArgumentParser:
                      help="export/import-container: local tarball path")
     dbg.set_defaults(fn=cmd_debug)
 
+    tr = sub.add_parser("trace", help="slow-request flight recorder: "
+                                      "retained over-SLO traces and "
+                                      "their critical paths")
+    tr.add_argument("verb", choices=["slow", "show"],
+                    help="slow = list retained slow traces; "
+                         "show <id> = one trace's critical path")
+    tr.add_argument("trace_id", nargs="?", default="")
+    tr.add_argument("--om", default="127.0.0.1:9860")
+    tr.add_argument("--limit", type=int, default=20,
+                    help="slow: max traces to list")
+    tr.set_defaults(fn=cmd_trace)
+
     fsck = sub.add_parser("fsck", help="namespace health walk "
                                        "(ozone fsck analog)")
     fsck.add_argument("--om", default="127.0.0.1:9860")
@@ -2178,6 +2233,33 @@ def cmd_debug(args) -> int:
     return 0
 
 
+def _ship_spans(args) -> None:
+    """One-shot span export for short-lived CLI invocations: daemons run
+    a periodic SpanExporter, but a `sh key put` exits before any 2 s
+    batch fires — without this flush the client:put root span (and the
+    slow-trace retention it drives) never reaches the collector."""
+    from ozone_tpu.utils.tracing import SpanExporter, Tracer
+
+    om = getattr(args, "om", "")
+    tracer = Tracer.instance()
+    if not om or not tracer.spans:
+        return
+    exp = SpanExporter(tracer, service="cli",
+                       address=om.split(",")[0].strip(),
+                       tls=_client_tls())
+    # the command's spans finished before the exporter existed, so they
+    # never entered its queue — hand them over wholesale
+    with tracer._lock:
+        exp._q.extend(tracer.spans)
+    while exp._q:
+        shipped = exp.exported
+        exp.flush()
+        if exp.exported == shipped:
+            break  # collector unreachable: lossy by design
+    if exp._ch is not None:
+        exp._ch.close()
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
@@ -2194,6 +2276,11 @@ def main(argv=None) -> int:
 
         os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         return 0
+    finally:
+        try:
+            _ship_spans(args)
+        except Exception:
+            pass  # ozlint: allow[error-swallowing] -- best-effort span export on exit; tracing never fails a CLI verb
 
 
 if __name__ == "__main__":

@@ -125,6 +125,25 @@ class BaseFreonGenerator:
         )
 
 
+def _client_hist_extras() -> dict:
+    """Scrape-side tail latency: p50/p95/p99 (ms) derived from the
+    client-ops histograms — the same numbers a Prometheus
+    histogram_quantile over `client_ops_{put,get}_seconds_bucket` would
+    yield. Reported alongside the raw-list percentiles so workload runs
+    record what the monitoring plane will actually see (bucket-quantile
+    estimates over every op since process start, warmups included)."""
+    from ozone_tpu.client.ozone_client import METRICS as client_ops
+
+    out: dict = {}
+    for verb in ("put", "get"):
+        h = client_ops.histogram(f"{verb}_seconds")
+        if h.count:
+            out[f"hist_{verb}_ms"] = {
+                p: round(1e3 * v, 3)
+                for p, v in h.percentiles().items()}
+    return out
+
+
 def _det_payload(size: int, seed: int = 0) -> np.ndarray:
     """The deterministic ockg payload; ockv re-derives it to validate,
     so both MUST use this one helper (a drifting expression would read
@@ -170,7 +189,9 @@ def ockg(
 
     for w in range(warmup):
         b.write_key(f"{prefix}-warmup-{w}", payload, replication)
-    return BaseFreonGenerator("ockg", n_keys, threads).run(op)
+    rep = BaseFreonGenerator("ockg", n_keys, threads).run(op)
+    rep.extras.update(_client_hist_extras())
+    return rep
 
 
 def hsg(
@@ -359,7 +380,9 @@ def ockr(client, n_keys: int, threads: int = 4, volume: str = "freon-vol",
         data = b.read_key(f"{prefix}-{i}")
         return int(data.size)
 
-    return BaseFreonGenerator("ockr", n_keys, threads).run(op)
+    rep = BaseFreonGenerator("ockr", n_keys, threads).run(op)
+    rep.extras.update(_client_hist_extras())
+    return rep
 
 
 def ockrr(client, n_reads: int, threads: int = 4, size: int = 65536,

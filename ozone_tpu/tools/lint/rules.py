@@ -1,4 +1,4 @@
-"""The five production ozlint rules.
+"""The six production ozlint rules.
 
 Each rule guards an invariant the repo states in prose and has already
 paid for in bugs (docs/LINT.md has the full origin stories):
@@ -18,6 +18,9 @@ paid for in bugs (docs/LINT.md has the full origin stories):
   bimodality).
 - ``error-swallowing``      no silently dropped exceptions on datapath
   or consensus modules.
+- ``span-on-dispatch``      codec device-dispatch edges run inside an
+  active trace span (the latency-attribution contract), and RPC
+  handlers register only through net/rpc.py's span guard.
 """
 
 from __future__ import annotations
@@ -674,3 +677,68 @@ class ErrorSwallowing(Rule):
                     "a datapath error must be handled, logged, or "
                     "suppressed with a reason",
                     span=(node.lineno, node.lineno))
+
+
+@register
+class SpanOnDispatch(Rule):
+    id = "span-on-dispatch"
+    summary = ("codec device-dispatch sites run inside an active trace "
+               "span; RPC handlers register only through net/rpc.py's "
+               "span guard")
+    rationale = (
+        "The latency-attribution contract: every device dispatch edge "
+        "(async compute launch, eager D2H, block_until_ready) must be "
+        "bracketed by a span — or fabricate one with record_span / "
+        "carry one with activate — or the slow-request flight recorder "
+        "attributes that time to the parent and critical paths lie. "
+        "Likewise add_generic_rpc_handlers outside net/rpc.py bypasses "
+        "the server interceptor that opens the server-side span and "
+        "extracts the wire trace context.")
+
+    #: calls that hand work to (or synchronize with) the device — the
+    #: edges the request-path critical path must be able to name
+    DISPATCH_EDGES = {"_start_d2h", "copy_to_host_async",
+                      "block_until_ready"}
+    #: any of these inside the same function satisfies the invariant
+    TRACE_CALLS = {"span", "record_span", "activate"}
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        # (b) handler registration anywhere but net/rpc.py dodges the
+        # guard that wraps every handler in a server:<method> span
+        if not src.is_module("net", "rpc.py"):
+            for call, _fn in src.calls_with_fn:
+                if last_name(call.func) == "add_generic_rpc_handlers":
+                    yield Finding(
+                        self.id, src.display_path, call.lineno,
+                        "RPC handlers registered outside net/rpc.py "
+                        "bypass the span guard (no server span, no "
+                        "trace-context extraction) — register through "
+                        "RpcServer.add_service",
+                        span=_span(call))
+        # (a) codec functions containing a dispatch edge must trace
+        if not src.in_dirs("codec"):
+            return
+        edges_by_fn: dict[int, list[ast.Call]] = {}
+        traced_fns: set[int] = set()
+        fns: dict[int, ast.AST] = {}
+        for call, fn in src.calls_with_fn:
+            if fn is None:
+                continue
+            name = last_name(call.func)
+            if name in self.DISPATCH_EDGES:
+                fns[id(fn)] = fn
+                edges_by_fn.setdefault(id(fn), []).append(call)
+            elif name in self.TRACE_CALLS:
+                traced_fns.add(id(fn))
+        for key, edges in edges_by_fn.items():
+            if key in traced_fns:
+                continue
+            first = min(edges, key=lambda c: c.lineno)
+            fn_name = getattr(fns[key], "name", "<fn>")
+            yield Finding(
+                self.id, src.display_path, first.lineno,
+                f"device dispatch in `{fn_name}` without an active "
+                "span — wrap it in Tracer.span()/record_span() (or "
+                "activate() a carried context) so the flight "
+                "recorder's critical path can name this stage",
+                span=(first.lineno, first.lineno))

@@ -1,21 +1,36 @@
-"""Metrics: counters/gauges/timers with Prometheus text export.
+"""Metrics: counters/gauges/timers/histograms with Prometheus export.
 
 Capability mirror of the reference's metrics2 registries +
 PrometheusMetricsSink (hadoop-hdds/framework hdds/server/http/
 PrometheusMetricsSink.java — on-by-default /prom endpoint,
 docs Observability.md:32). Every subsystem creates a MetricsRegistry and
 the HTTP layer exposes `prometheus_text()` of the global registry set.
+
+Histograms carry optional trace-id exemplars (OpenMetrics exemplar
+syntax) so a scraped tail bucket links back to a retained slow trace.
 """
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import defaultdict
-from typing import Optional
+from typing import Callable, Optional
 
 _all_registries: dict[str, "MetricsRegistry"] = {}
 _all_lock = threading.RLock()  # registry() constructs while holding it
+
+# Installed by utils/tracing at import; lets Histogram.observe stamp the
+# active trace id on outlier observations without a metrics->tracing
+# import edge (tracing already imports nothing from metrics, but the
+# provider keeps the layering one-directional either way).
+_trace_id_provider: Optional[Callable[[], str]] = None
+
+
+def set_trace_id_provider(fn: Callable[[], str]) -> None:
+    global _trace_id_provider
+    _trace_id_provider = fn
 
 
 class Counter:
@@ -35,13 +50,16 @@ class Counter:
 class Gauge:
     def __init__(self):
         self._v = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self._v = v
+        with self._lock:
+            self._v = v
 
     @property
     def value(self) -> float:
-        return self._v
+        with self._lock:
+            return self._v
 
 
 class Timer:
@@ -76,7 +94,139 @@ class Timer:
 
     @property
     def mean(self) -> float:
-        return self.total / self.count if self.count else 0.0
+        with self._lock:
+            return self.total / self.count if self.count else 0.0
+
+
+def log_buckets(lo: float = 1e-4, hi: float = 100.0,
+                per_decade: int = 3) -> tuple[float, ...]:
+    """Log-spaced bucket upper bounds covering [lo, hi]."""
+    n = int(round(per_decade * math.log10(hi / lo)))
+    return tuple(
+        round(lo * (hi / lo) ** (i / n), 10) for i in range(n + 1)
+    )
+
+
+DEFAULT_BUCKETS = log_buckets()  # 100us .. 100s, 3 per decade
+
+
+class Histogram:
+    """Bucketed latency distribution (Prometheus histogram semantics).
+
+    Cumulative `le` buckets over log-spaced bounds, plus sum/count and
+    min/max, so p50/p95/p99 are derivable both server-side (quantile())
+    and by a scraper. Observations above `exemplar_min` (or landing past
+    the median bucket) stamp the active trace id as an exemplar on their
+    bucket, linking the tail of the distribution to retained traces.
+    """
+
+    def __init__(self, bounds: Optional[tuple[float, ...]] = None):
+        self.bounds: tuple[float, ...] = tuple(bounds or DEFAULT_BUCKETS)
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        # bucket index -> (value, trace_id, unix_ts); bounded by bucket
+        # count, latest outlier wins
+        self._exemplars: dict[int, tuple[float, str, float]] = {}
+
+    def _bucket_index(self, v: float) -> int:
+        lo, hi = 0, len(self.bounds)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if v <= self.bounds[mid]:
+                hi = mid
+            else:
+                lo = mid + 1
+        return lo
+
+    def observe(self, seconds: float, trace_id: str = "") -> None:
+        idx = self._bucket_index(seconds)
+        if not trace_id and _trace_id_provider is not None:
+            try:
+                trace_id = _trace_id_provider() or ""
+            except Exception:
+                trace_id = ""
+        with self._lock:
+            self._counts[idx] += 1
+            self.count += 1
+            self.total += seconds
+            self.min = min(self.min, seconds)
+            self.max = max(self.max, seconds)
+            if trace_id and seconds * 2 >= (self.total / self.count):
+                # outlier-ish (at/above half the running mean covers the
+                # tail without a quantile pass per observation)
+                self._exemplars[idx] = (seconds, trace_id, time.time())
+
+    def time(self):
+        hist = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *a):
+                hist.observe(time.perf_counter() - self.t0)
+
+        return _Ctx()
+
+    @property
+    def mean(self) -> float:
+        with self._lock:
+            return self.total / self.count if self.count else 0.0
+
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile by linear interpolation within the
+        containing bucket (what a PromQL histogram_quantile would see)."""
+        with self._lock:
+            if not self.count:
+                return 0.0
+            target = q * self.count
+            cum = 0
+            for i, c in enumerate(self._counts):
+                if not c:
+                    continue
+                if cum + c >= target:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = (self.bounds[i] if i < len(self.bounds)
+                          else max(self.max, lo))
+                    frac = (target - cum) / c
+                    return lo + (hi - lo) * frac
+                cum += c
+            return self.max
+
+    def percentiles(self) -> dict:
+        return {"p50": self.quantile(0.50), "p95": self.quantile(0.95),
+                "p99": self.quantile(0.99)}
+
+    def render(self, metric: str, lines: list[str]) -> None:
+        """Append exposition lines for one histogram family."""
+        with self._lock:
+            counts = list(self._counts)
+            exemplars = dict(self._exemplars)
+            total, count = self.total, self.count
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            le = (_format_float(self.bounds[i]) if i < len(self.bounds)
+                  else "+Inf")
+            line = f'{metric}_bucket{{le="{le}"}} {cum}'
+            ex = exemplars.get(i)
+            if ex is not None:
+                val, tid, ts = ex
+                line += (f' # {{trace_id="{escape_label(tid)}"}} '
+                         f"{_format_float(val)} {round(ts, 3)}")
+            lines.append(line)
+        lines.append(f"{metric}_sum {total}")
+        lines.append(f"{metric}_count {count}")
+
+
+def _format_float(v: float) -> str:
+    s = f"{v:.10f}".rstrip("0").rstrip(".")
+    return s if s else "0"
 
 
 class MetricsRegistry:
@@ -85,6 +235,8 @@ class MetricsRegistry:
         self._counters: dict[str, Counter] = defaultdict(Counter)
         self._gauges: dict[str, Gauge] = defaultdict(Gauge)
         self._timers: dict[str, Timer] = defaultdict(Timer)
+        self._histograms: dict[str, Histogram] = {}
+        self._hist_lock = threading.Lock()
         with _all_lock:
             _all_registries[name] = self
 
@@ -97,12 +249,25 @@ class MetricsRegistry:
     def timer(self, name: str) -> Timer:
         return self._timers[name]
 
+    def histogram(self, name: str,
+                  bounds: Optional[tuple[float, ...]] = None) -> Histogram:
+        with self._hist_lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(bounds)
+            return h
+
     def snapshot(self) -> dict:
         return {
             **{k: c.value for k, c in self._counters.items()},
             **{k: g.value for k, g in self._gauges.items()},
             **{
                 f"{k}_mean_s": t.mean for k, t in self._timers.items() if t.count
+            },
+            **{
+                f"{k}_{p}_s": v
+                for k, h in self._histograms.items() if h.count
+                for p, v in h.percentiles().items()
             },
         }
 
@@ -124,32 +289,53 @@ def _sanitize(s: str) -> str:
     return s.replace(".", "_").replace("-", "_")
 
 
+def escape_label(v: str) -> str:
+    """Escape a label value per the exposition format: backslash,
+    double-quote and newline must be escaped inside `label="..."`."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def prometheus_text(registry: Optional[MetricsRegistry] = None) -> str:
     """Prometheus exposition text for one or all registries. Every
     metric renders a # HELP and # TYPE pair (the exposition-format
     contract scrapers and the golden test check) with a stable
-    `<registry>_<name>` identifier."""
-    regs = [registry] if registry else list(_all_registries.values())
+    `<registry>_<name>` identifier; registries and metrics emit in
+    sorted order so successive scrapes diff cleanly."""
+    with _all_lock:
+        regs = ([registry] if registry
+                else [_all_registries[k] for k in sorted(_all_registries)])
     lines: list[str] = []
     for r in regs:
         base = _sanitize(r.name)
-        for k, c in r._counters.items():
+        for k in sorted(r._counters):
+            c = r._counters[k]
             m = f"{base}_{_sanitize(k)}"
             lines.append(f"# HELP {m} counter {k} of registry {r.name}")
             lines.append(f"# TYPE {m} counter")
             lines.append(f"{m} {c.value}")
-        for k, g in r._gauges.items():
+        for k in sorted(r._gauges):
+            g = r._gauges[k]
             m = f"{base}_{_sanitize(k)}"
             lines.append(f"# HELP {m} gauge {k} of registry {r.name}")
             lines.append(f"# TYPE {m} gauge")
             lines.append(f"{m} {g.value}")
-        for k, t in r._timers.items():
+        for k in sorted(r._timers):
+            t = r._timers[k]
             m = f"{base}_{_sanitize(k)}"
             lines.append(f"# HELP {m}_seconds latency summary {k} of "
                          f"registry {r.name}")
             lines.append(f"# TYPE {m}_seconds summary")
             lines.append(f"{m}_seconds_count {t.count}")
             lines.append(f"{m}_seconds_sum {t.total}")
+        with r._hist_lock:
+            hists = sorted(r._histograms.items())
+        for k, h in hists:
+            m = f"{base}_{_sanitize(k)}"
+            lines.append(f"# HELP {m} latency histogram {k} of "
+                         f"registry {r.name}")
+            lines.append(f"# TYPE {m} histogram")
+            h.render(m, lines)
     return "\n".join(lines) + "\n"
 
 

@@ -13,6 +13,7 @@ automatically.
 from __future__ import annotations
 
 import random
+import re
 import threading
 import time
 from collections import deque
@@ -32,6 +33,9 @@ class Span:
     start: float
     duration: float = 0.0
     tags: dict = field(default_factory=dict)
+    #: point-in-time annotations ({"t", "name", ...attrs}); retry /
+    #: hedge / breaker decisions land here rather than as child spans
+    events: list = field(default_factory=list)
 
 
 class Tracer:
@@ -45,6 +49,8 @@ class Tracer:
         self._lock = threading.Lock()
         #: filled by an attached SpanExporter; None = local-only mode
         self._export_q: Optional[deque] = None
+        #: tail-based slow-trace retention (per-op SLO, env-tunable)
+        self.recorder = FlightRecorder()
 
     @classmethod
     def instance(cls) -> "Tracer":
@@ -79,17 +85,76 @@ class Tracer:
         finally:
             s.duration = time.time() - s.start
             _local.span = prev
-            if random.random() < self.sample_rate:
-                with self._lock:
-                    self.spans.append(s)
-                    if self._export_q is not None:
-                        self._export_q.append(s)
+            self._finish(s)
+
+    def _finish(self, s: Span) -> None:
+        if random.random() < self.sample_rate:
+            with self._lock:
+                self.spans.append(s)
+                if self._export_q is not None:
+                    self._export_q.append(s)
+            if not s.parent_id:
+                # root finished last: the whole local trace is in the
+                # buffer, so tail-based retention can decide now
+                self.recorder.offer(s, self.traces(s.trace_id))
+
+    def record_span(self, name: str, *, child_of: str = "",
+                    start: float, duration: float, span_id: str = "",
+                    **tags) -> Span:
+        """Record an already-measured interval as a finished span.
+
+        Needed where the measuring thread is not the owning thread —
+        e.g. the codec-service dispatcher closing out a submission's
+        queue-wait on behalf of the submitting request — so a
+        contextmanager span can't bracket the interval."""
+        if child_of:
+            trace_id, parent_id = (child_of.split(":") + [""])[:2]
+        else:
+            cur = self.current()
+            if cur is not None:
+                trace_id, parent_id = cur.trace_id, cur.span_id
+            else:
+                trace_id, parent_id = self._new_id(), ""
+        s = Span(trace_id, span_id or self._new_id(), parent_id, name,
+                 start, duration, tags=dict(tags))
+        self._finish(s)
+        return s
+
+    def event(self, name: str, **attrs) -> None:
+        """Annotate the current span (no-op outside any span). Retry,
+        breaker-skip, hedge and deadline decisions record as events so
+        a slow trace shows *why* the path was taken."""
+        s = self.current()
+        if s is not None:
+            s.events.append({"t": time.time(), "name": name, **attrs})
+
+    @contextmanager
+    def activate(self, ctx: str):
+        """Re-establish a trace context on a worker thread. The span
+        stack is thread-local, so pool workers (ec-writer, ec-read,
+        hedge) must carry the submitter's context explicitly — the
+        exact analog of resilience.activate for deadlines."""
+        if not ctx:
+            yield
+            return
+        tid, sid = (ctx.split(":") + [""])[:2]
+        prev = self.current()
+        # context holder only — never finished, never recorded
+        _local.span = Span(tid, sid, "", "<activated>", time.time())
+        try:
+            yield
+        finally:
+            _local.span = prev
 
     def inject(self) -> str:
         """Export the current context for the wire ("traceID" field analog);
         empty string when not tracing."""
         s = self.current()
         return f"{s.trace_id}:{s.span_id}" if s else ""
+
+    def current_trace_id(self) -> str:
+        s = self.current()
+        return s.trace_id if s else ""
 
     def traces(self, trace_id: Optional[str] = None) -> list[Span]:
         with self._lock:
@@ -122,8 +187,145 @@ def span_json(s: Span, service: str = "") -> dict:
         "start": s.start,
         "durationMs": round(s.duration * 1e3, 3),
         "tags": s.tags,
+        **({"events": list(s.events)} if s.events else {}),
         **({"service": service} if service else {}),
     }
+
+
+def critical_path(spans: list[dict]) -> list[dict]:
+    """Reduce a trace to ordered (stage, micros) wall-clock attribution.
+
+    Every instant of the root span's duration is attributed to exactly
+    one span: a parent keeps the time no child covers, overlapping
+    siblings are swept first-started-first so parallel hops (hedges,
+    fan-out) never double-count. Output is aggregated by span name,
+    ordered by first occurrence; the micros sum equals the root span's
+    duration by construction."""
+    spans = [s for s in spans if s.get("spanId")]
+    if not spans:
+        return []
+    ids = {s["spanId"] for s in spans}
+    children: dict[str, list[dict]] = {}
+    roots = []
+    for s in spans:
+        pid = s.get("parentId", "")
+        if pid and pid in ids:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    root = min(roots or spans, key=lambda s: s["start"])
+    stages: dict[str, list] = {}  # name -> [seconds, first_start]
+
+    def visit(s: dict, w0: float, w1: float) -> None:
+        kids = sorted(children.get(s["spanId"], []),
+                      key=lambda c: c["start"])
+        cur = w0
+        consumed = 0.0
+        for c in kids:
+            c0 = max(c["start"], cur)
+            c1 = min(c["start"] + c.get("durationMs", 0.0) / 1e3, w1)
+            if c1 <= c0:
+                continue
+            visit(c, c0, c1)
+            consumed += c1 - c0
+            cur = c1
+        st = stages.setdefault(s["name"], [0.0, w0])
+        st[0] += max(0.0, (w1 - w0) - consumed)
+        st[1] = min(st[1], w0)
+
+    visit(root, root["start"],
+          root["start"] + root.get("durationMs", 0.0) / 1e3)
+    return [
+        {"stage": name, "micros": int(round(sec * 1e6))}
+        for name, (sec, _first) in sorted(stages.items(),
+                                          key=lambda kv: kv[1][1])
+    ]
+
+
+class FlightRecorder:
+    """Tail-based slow-trace retention: any trace whose ROOT span
+    exceeds its per-op SLO is pinned — with its critical path — into a
+    bounded ring, surviving the span buffer / collector LRU. The
+    always-on flight recorder that answers "where did that P99 PUT
+    spend its time" after the fact (tail sampling, not head sampling)."""
+
+    def __init__(self, max_traces: int = 0):
+        from collections import OrderedDict
+
+        from ozone_tpu.utils.config import env_int
+
+        self.max_traces = max_traces or env_int(
+            "OZONE_TPU_TRACE_SLOW_RING", 64)
+        self._ring: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def slo_s(op: str) -> float:
+        """Per-op SLO threshold: OZONE_TPU_TRACE_SLO_<OP>_MS (op is the
+        root span name, uppercased, non-alnum -> _), falling back to
+        OZONE_TPU_TRACE_SLO_MS (default 1000 ms). Read live so
+        operators can retune a running daemon's env between restarts
+        and tests can tighten it per-case."""
+        from ozone_tpu.utils.config import env_float
+
+        default = env_float("OZONE_TPU_TRACE_SLO_MS", 1000.0)
+        key = re.sub(r"[^A-Za-z0-9]+", "_", op).strip("_").upper()
+        return env_float(f"OZONE_TPU_TRACE_SLO_{key}_MS", default) / 1e3
+
+    def offer(self, root, spans: list) -> bool:
+        """Retain the trace if its root exceeded the op's SLO. `root`
+        and `spans` may be Span objects or span_json dicts."""
+        rj = span_json(root) if isinstance(root, Span) else root
+        if rj.get("durationMs", 0.0) / 1e3 < self.slo_s(rj["name"]):
+            return False
+        sj = [span_json(s) if isinstance(s, Span) else s for s in spans]
+        entry = {
+            "traceId": rj["traceId"],
+            "root": rj["name"],
+            "start": rj["start"],
+            "durationMs": rj["durationMs"],
+            "sloMs": round(self.slo_s(rj["name"]) * 1e3, 3),
+            "spans": sj,
+            "criticalPath": critical_path(sj),
+        }
+        with self._lock:
+            self._ring[rj["traceId"]] = entry
+            while len(self._ring) > self.max_traces:
+                self._ring.popitem(last=False)
+        return True
+
+    def append(self, trace_id: str, spans: list[dict]) -> None:
+        """Late span arrivals for an already-pinned trace (collector
+        assembly is cross-service and out of order)."""
+        with self._lock:
+            e = self._ring.get(trace_id)
+            if e is None:
+                return
+            e["spans"].extend(spans)
+            e["criticalPath"] = critical_path(e["spans"])
+
+    def is_pinned(self, trace_id: str) -> bool:
+        with self._lock:
+            return trace_id in self._ring
+
+    def slow(self, limit: int = 50) -> list[dict]:
+        """Newest-first summaries of retained slow traces."""
+        with self._lock:
+            entries = list(self._ring.values())[-limit:]
+        return [
+            {k: e[k] for k in
+             ("traceId", "root", "start", "durationMs", "sloMs")}
+            | {"spans": len(e["spans"])}
+            for e in reversed(entries)
+        ]
+
+    def trace(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            e = self._ring.get(trace_id)
+            return None if e is None else {
+                **e, "spans": list(e["spans"]),
+                "criticalPath": list(e["criticalPath"]),
+            }
 
 
 TRACING_SERVICE = "ozone.tpu.Tracing"
@@ -223,15 +425,21 @@ class TraceCollector:
         self._traces: "OrderedDict[str, dict]" = OrderedDict()
         self.max_traces = max_traces
         self._lock = threading.Lock()
+        #: cluster-side flight recorder: roots reported over the wire
+        #: pin their whole assembled trace past the LRU
+        self.recorder = FlightRecorder()
         if server is not None:
             server.add_service(TRACING_SERVICE, {
                 "Report": self._report,
                 "Query": self._query,
                 "Recent": self._recent,
+                "Slow": self._slow,
             })
 
     # ------------------------------------------------------------ ingest
     def add(self, service: str, spans: list[dict]) -> None:
+        slow_roots = []
+        late: dict[str, list[dict]] = {}
         with self._lock:
             for sp in spans:
                 tid = sp.get("traceId", "")
@@ -252,6 +460,16 @@ class TraceCollector:
                 t["start"] = min(t["start"], sp["start"])
                 t["end"] = max(t["end"],
                                sp["start"] + sp["durationMs"] / 1e3)
+                if not sp.get("parentId"):
+                    slow_roots.append(sp)
+                elif self.recorder.is_pinned(tid):
+                    late.setdefault(tid, []).append(sp)
+        # tail retention outside the assembly lock: offer() re-reads the
+        # trace and evaluates the SLO, never blocking concurrent Reports
+        for root in slow_roots:
+            self.recorder.offer(root, self.trace(root["traceId"]))
+        for tid, sps in late.items():
+            self.recorder.append(tid, sps)
 
     def _report(self, req: bytes) -> bytes:
         from ozone_tpu.net import wire as _wire
@@ -264,8 +482,13 @@ class TraceCollector:
     def trace(self, trace_id: str) -> list[dict]:
         with self._lock:
             t = self._traces.get(trace_id)
-            return sorted((dict(s) for s in t["spans"]),
-                          key=lambda s: s["start"]) if t else []
+            if t is not None:
+                return sorted((dict(s) for s in t["spans"]),
+                              key=lambda s: s["start"])
+        # evicted from the LRU but pinned as slow: still answerable
+        pinned = self.recorder.trace(trace_id)
+        return (sorted(pinned["spans"], key=lambda s: s["start"])
+                if pinned else [])
 
     def recent(self, limit: int = 50) -> list[dict]:
         with self._lock:
@@ -302,3 +525,22 @@ class TraceCollector:
 
         m, _ = _wire.unpack(req)
         return _wire.pack({"traces": self.recent(m.get("limit", 50))})
+
+    def _slow(self, req: bytes) -> bytes:
+        from ozone_tpu.net import wire as _wire
+
+        m, _ = _wire.unpack(req)
+        tid = m.get("trace_id", "")
+        if tid:
+            return _wire.pack({"trace": self.recorder.trace(tid)})
+        return _wire.pack(
+            {"traces": self.recorder.slow(m.get("limit", 50))})
+
+
+# Histogram exemplars stamp the active trace id (outlier observations
+# link a scraped tail bucket to a retained slow trace); registered here
+# so metrics stays import-independent of tracing.
+from ozone_tpu.utils import metrics as _metrics  # noqa: E402
+
+_metrics.set_trace_id_provider(
+    lambda: Tracer.instance().current_trace_id())

@@ -1,0 +1,35 @@
+# ozlint: path ozone_tpu/codec/_fixture.py
+"""Known-bad corpus for `span-on-dispatch`: device dispatch edges with
+no active span (the flight recorder attributes their time to the
+parent and the critical path lies), plus an RPC handler registration
+that dodges net/rpc.py's server-span guard."""
+import numpy as np
+
+
+def submit_untraced(fn, batch):
+    # async dispatch + eager D2H with no span anywhere in the function
+    outs = fn(batch)
+    _start_d2h(outs)
+    return np.asarray(outs)
+
+
+def sync_pull(arr):
+    # a bare device sync: this wall time is invisible to attribution
+    arr.block_until_ready()
+    return np.asarray(arr)
+
+
+def eager_hint(out):
+    # raw D2H hint outside any span or carried context
+    out.copy_to_host_async()
+    return out
+
+
+def register_handlers(server, service):
+    # bypasses RpcServer.add_service, so no server:<method> span and no
+    # wire trace-context extraction
+    server.add_generic_rpc_handlers((service,))
+
+
+def _start_d2h(out):
+    return out

@@ -1,0 +1,37 @@
+# ozlint: path ozone_tpu/codec/_fixture.py
+"""Known-good corpus for `span-on-dispatch`: every dispatch edge runs
+under an open span, a fabricated record_span, or a carried context, and
+handlers register through RpcServer.add_service (the span guard)."""
+import numpy as np
+
+from ozone_tpu.utils.tracing import Tracer
+
+
+def submit_traced(fn, batch):
+    with Tracer.instance().span("codec:dispatch", rows=len(batch)):
+        outs = fn(batch)
+        _start_d2h(outs)
+    return np.asarray(outs)
+
+
+def sync_pull_fabricated(arr, t0, t1):
+    # completion thread: fabricate the finished span around the sync
+    arr.block_until_ready()
+    Tracer.instance().record_span("codec:device_dispatch", t0, t1)
+    return np.asarray(arr)
+
+
+def eager_hint_carried(out, ctx):
+    # worker thread carrying the submitter's trace context
+    with Tracer.instance().activate(ctx):
+        out.copy_to_host_async()
+    return out
+
+
+def register_handlers(server, service):
+    # the one sanctioned path: wraps every handler in server:<method>
+    server.add_service(service)
+
+
+def _start_d2h(out):
+    return out

@@ -5,7 +5,7 @@ Three contracts:
    Seeding any fixed violation back (a literal socket timeout in
    client/native_dn.py, an unfenced background DeleteKey, a jit keyed
    on an erasure pattern) fails this suite.
-2. Each of the five rules demonstrably trips on its known-bad fixture
+2. Each of the six rules demonstrably trips on its known-bad fixture
    and stays quiet on the known-good one (tests/lint_fixtures/).
 3. The CLI is fast and import-light: `python -m ozone_tpu.tools.lint
    --check` must run WITHOUT importing jax (OZONE_TPU_SKIP_JAX_PIN=1),
@@ -38,6 +38,7 @@ RULE_IDS = [
     "fence-carrying-commit",
     "dispatch-shape-stability",
     "error-swallowing",
+    "span-on-dispatch",
 ]
 
 
@@ -49,7 +50,7 @@ def test_zero_findings_on_tree():
     assert not findings, format_findings(findings)
 
 
-def test_all_five_rules_registered():
+def test_all_six_rules_registered():
     for rid in RULE_IDS:
         assert rid in RULES, f"rule {rid} not registered"
         assert RULES[rid].summary and RULES[rid].rationale

@@ -25,7 +25,7 @@ def cluster(tmp_path):
     c.close()
 
 
-def test_recon_endpoints(cluster):
+def test_recon_endpoints(cluster, monkeypatch):
     oz = cluster.client()
     b = oz.create_volume("v").create_bucket("b", replication=EC)
     rng = np.random.default_rng(0)
@@ -57,9 +57,23 @@ def test_recon_endpoints(cluster):
         assert heat["cells"] == [
             {"volume": "v", "bucket": "b", "keys": 3, "bytes": 65_100}
         ]
+        # slow-request flight recorder: any PUT beats a 0ms SLO, so the
+        # next write is retained and queryable with its critical path
+        monkeypatch.setenv("OZONE_TPU_TRACE_SLO_CLIENT_PUT_MS", "0")
+        b.write_key("k3", rng.integers(0, 256, 100, dtype=np.uint8))
+        sl = json.loads(
+            urllib.request.urlopen(base + "/api/traces/slow").read())
+        assert any(t["root"] == "client:put" for t in sl["traces"])
+        tid = next(t["traceId"] for t in sl["traces"]
+                   if t["root"] == "client:put")
+        detail = json.loads(urllib.request.urlopen(
+            base + "/api/traces/slow?id=" + tid).read())
+        assert detail["criticalPath"] and detail["spans"]
+        assert sum(s["micros"] for s in detail["criticalPath"]) > 0
         # the dashboard page renders the heat panel
         page = urllib.request.urlopen(base + "/").read().decode()
         assert "Namespace heat" in page and "/api/heatmap" in page
+        assert "Slow requests" in page and "/api/traces/slow" in page
         # base endpoints still work
         prom = urllib.request.urlopen(base + "/prom").read().decode()
         assert "om_" in prom
@@ -103,8 +117,16 @@ def test_prometheus_text_golden_every_registry_renders():
         CODEC.counter(name).inc(0)
     CODEC.gauge("queue_depth").set(0)
     CODEC.gauge("batch_fill_pct").set(0.0)
-    CODEC.timer("queue_wait_seconds").update(0.0)
-    CODEC.timer("dispatch_seconds").update(0.0)
+    # hot-path latency families are HISTOGRAMS (log-spaced buckets, so
+    # p50/p95/p99 are scrapeable); one observation carries a trace-id
+    # exemplar to pin the OpenMetrics exemplar syntax
+    CODEC.histogram("queue_wait_seconds").observe(0.0)
+    CODEC.histogram("dispatch_seconds").observe(
+        0.25, trace_id="deadbeefcafef00d")
+    from ozone_tpu.client.ozone_client import METRICS as OPS
+
+    OPS.histogram("put_seconds").observe(0.001)
+    OPS.histogram("get_seconds").observe(0.001)
     # the geo-replication family (docs/OPERATIONS.md "Geo replication"):
     # the lag gauges are the numbers operators alarm on
     from ozone_tpu.replication_geo.shipper import METRICS as GEO
@@ -125,7 +147,7 @@ def test_prometheus_text_golden_every_registry_renders():
         if not line.startswith("# TYPE "):
             continue
         _, _, metric, mtype = line.split(" ")
-        assert mtype in ("counter", "gauge", "summary"), line
+        assert mtype in ("counter", "gauge", "summary", "histogram"), line
         assert name_re.match(metric), f"unstable metric name {metric!r}"
         # the HELP line immediately precedes its TYPE line
         assert lines[i - 1].startswith(f"# HELP {metric} "), \
@@ -176,6 +198,28 @@ def test_prometheus_text_golden_every_registry_renders():
     assert "# TYPE replication_keys_shipped counter" in text
     assert "# TYPE replication_lag_entries gauge" in text
     assert "# HELP replication_lag_seconds " in text
+    # -- histogram exposition: the hot-path latency families render
+    # Prometheus histograms with cumulative buckets, _sum, and _count
+    for fam in ("codec_service_queue_wait_seconds",
+                "codec_service_dispatch_seconds",
+                "client_ops_put_seconds", "client_ops_get_seconds"):
+        assert f"# TYPE {fam} histogram" in text, fam
+        buckets = [s for s in lines
+                   if s.startswith(f'{fam}_bucket{{le="')]
+        assert buckets, f"no _bucket lines for {fam}"
+        assert any(s.startswith(f'{fam}_bucket{{le="+Inf"}}')
+                   for s in buckets), fam
+        assert any(s.startswith(f"{fam}_sum ") for s in lines), fam
+        assert any(s.startswith(f"{fam}_count ") for s in lines), fam
+    # the outlier observation carries an OpenMetrics exemplar with the
+    # trace id a scrape can pivot into /api/traces/slow
+    assert re.search(
+        r'codec_service_dispatch_seconds_bucket\{le="[^"]+"\} \d+ '
+        r'# \{trace_id="deadbeefcafef00d"\} 0\.25 \d+(\.\d+)?', text), \
+        "missing trace exemplar on dispatch_seconds bucket"
+    # rendering is deterministic (sorted registries + sorted names), so
+    # successive scrapes diff cleanly
+    assert m.prometheus_text() == text
 
 
 def test_tracing_spans_nest_and_propagate():

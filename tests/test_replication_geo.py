@@ -171,7 +171,7 @@ def test_two_cluster_convergence_puts_overwrites_deletes(pair):
     ve = sc.get_volume("v").get_bucket("ec")
     data = {f"k{i}": _payload(20_000 + i, seed=i) for i in range(6)}
     creg = get_registry("codec.service")
-    bulk_before = (creg.timer("queue_wait_bulk_seconds").count
+    bulk_before = (creg.histogram("queue_wait_bulk_seconds").count
                    if creg is not None else 0)
     for name, d in data.items():
         vb.write_key(name, d)
@@ -214,7 +214,7 @@ def test_two_cluster_convergence_puts_overwrites_deletes(pair):
 
     if codec_service.enabled():
         creg = get_registry("codec.service")
-        assert creg.timer("queue_wait_bulk_seconds").count > bulk_before
+        assert creg.histogram("queue_wait_bulk_seconds").count > bulk_before
     # shipped, nothing pending: the lag gauge is back to 0
     lag = src.om.geo_status()["lag"]
     assert lag["entries"] == 0 and lag["seconds"] == 0.0

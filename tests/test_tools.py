@@ -43,8 +43,13 @@ def test_freon_ockg_and_read(cluster):
     s = rep.summary()
     assert s["ops"] == 12 and s["failures"] == 0
     assert s["ops_per_s"] > 0
+    # tail latency from the client-ops histograms rides the summary
+    assert set(s["hist_put_ms"]) == {"p50", "p95", "p99"}
+    assert s["hist_put_ms"]["p50"] <= s["hist_put_ms"]["p99"]
     rep2 = freon.ockr(oz, 12, threads=3)
-    assert rep2.summary()["failures"] == 0
+    s2 = rep2.summary()
+    assert s2["failures"] == 0
+    assert s2["hist_get_ms"]["p99"] > 0
     # ranged-read generator over the same keys (positioned path)
     rep3 = freon.ockrr(oz, 20, threads=3, size=1500, n_keys=12)
     s3 = rep3.summary()
@@ -77,6 +82,39 @@ def test_cli_sh_roundtrip(cluster, tmp_path, capsys):
     assert cli_main(["sh", "key", "list", "/cliv/b1", "--om", om]) == 0
     out = json.loads(capsys.readouterr().out)
     assert [k["name"] for k in out] == ["k1"]
+
+
+def test_cli_trace_slow_and_show(cluster, capsys):
+    """`ozone-tpu trace slow|show` against the daemon's TRACING_SERVICE
+    Slow verb: a reported over-SLO trace lists with its summary and
+    prints an ordered critical path; an unknown id is a clean error."""
+    import time
+
+    meta, dns = cluster
+    om = meta.address
+    t0 = time.time() - 5.0
+
+    def span(sid, pid, name, start, dur_ms):
+        return {"traceId": "feedc0de00000001", "spanId": sid,
+                "parentId": pid, "name": name, "start": start,
+                "durationMs": dur_ms, "tags": {}}
+
+    # a 2s PUT (default SLO 1000ms) dominated by one chunk write
+    meta.trace_collector.add("om", [
+        span("s1", "", "client:put", t0, 2000.0),
+        span("s2", "s1", "net:write_chunk", t0 + 0.2, 1500.0),
+    ])
+    capsys.readouterr()
+    assert cli_main(["trace", "slow", "--om", om]) == 0
+    traces = json.loads(capsys.readouterr().out)
+    mine = next(t for t in traces if t["traceId"] == "feedc0de00000001")
+    assert mine["root"] == "client:put" and mine["durationMs"] == 2000.0
+    assert cli_main(["trace", "show", "feedc0de00000001",
+                     "--om", om]) == 0
+    text = capsys.readouterr().out
+    assert "critical path:" in text
+    assert "net:write_chunk" in text and "client:put" in text
+    assert cli_main(["trace", "show", "no-such-trace", "--om", om]) == 1
 
 
 def test_cli_lifecycle_and_freon_lcg(cluster, tmp_path, capsys):
