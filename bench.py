@@ -39,6 +39,10 @@ def log(*a):
 #: the headline benches measure the DEVICE kernel itself — pin the fused
 #: backend so the round-4 adaptive link probe (which steers degraded-link
 #: CLIENTS to the native twin) can never flip what this file measures
+#: ... except the --mesh section, which measures the PRODUCTION mesh
+#: executor policy (host twin on CPU backends) and must know whether
+#: the pin above came from the caller or from this file
+_FUSED_BACKEND_EXTERNAL = "OZONE_TPU_FUSED_BACKEND" in os.environ
 os.environ.setdefault("OZONE_TPU_FUSED_BACKEND", "jax")
 
 BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "540"))
@@ -50,7 +54,10 @@ _STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None,
                 "decode_sustained": None, "decode_churn": None,
                 "degraded_straggler": None, "tiering": None,
                 "small_put": None, "small_put_unbatched": None,
-                "small_put_speedup": None}
+                "small_put_speedup": None,
+                "mesh_encode": None, "mesh_reconstruct": None,
+                "mesh_dispatches": None, "mesh_inflight": None,
+                "mesh_scaling": None, "mesh_skipped": None}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
@@ -131,6 +138,20 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
         if _STATE["small_put_speedup"] is not None:
             line["concurrent_small_put_speedup_x"] = round(
                 _STATE["small_put_speedup"], 2)
+        if _STATE["mesh_encode"] is not None:
+            line["mesh_encode_mib_s_per_device"] = round(
+                _STATE["mesh_encode"], 2)
+        if _STATE["mesh_reconstruct"] is not None:
+            line["mesh_reconstruct_mib_s_per_device"] = round(
+                _STATE["mesh_reconstruct"], 2)
+        if _STATE["mesh_dispatches"] is not None:
+            line["mesh_dispatches"] = _STATE["mesh_dispatches"]
+        if _STATE["mesh_inflight"] is not None:
+            line["mesh_inflight_depth"] = _STATE["mesh_inflight"]
+        if _STATE["mesh_scaling"] is not None:
+            line["mesh_scaling_mib_s_per_device"] = _STATE["mesh_scaling"]
+        if _STATE["mesh_skipped"] is not None:
+            line["mesh_skipped"] = _STATE["mesh_skipped"]
         lat = tail_latencies_ms()
         if lat:
             line["latency_ms"] = lat
@@ -890,6 +911,97 @@ def bench_cpp_fused(cell: int = 1024 * 1024) -> float:
     return data.nbytes / 2**30 / full_dt
 
 
+def bench_mesh_executor(rounds: int = 5, inflight: int = 4,
+                        per_dev: int = 4, cell: int = 128 * 1024):
+    """The persistent mesh executor's steady-state datapath: per-device
+    encode and reconstruct throughput with depth-N batches in flight,
+    plus the per-device scaling curve across mesh sizes. Measures the
+    PRODUCTION backend policy (host twin on CPU, SPMD on accelerators),
+    so the headline jax pin is lifted unless the caller set it."""
+    import jax
+
+    from ozone_tpu.codec import service as codec_service
+    from ozone_tpu.codec.api import CoderOptions
+    from ozone_tpu.codec.fused import FusedSpec
+    from ozone_tpu.parallel import mesh_executor
+    from ozone_tpu.parallel.sharded import make_mesh
+    from ozone_tpu.utils.checksum import ChecksumType
+
+    n = jax.device_count()
+    if n < 2:
+        return None  # single device: there is no mesh to keep fed
+
+    spec = FusedSpec(CoderOptions(6, 3, "rs", cell_size=cell),
+                     ChecksumType.CRC32C, bytes_per_checksum=16 * 1024)
+    enc_key = codec_service.encode_key(spec)
+    dec_key = codec_service.decode_key(
+        spec, [0, 1, 2, 3, 4, 5], [6, 7])
+    rng = np.random.default_rng(11)
+
+    pinned = not _FUSED_BACKEND_EXTERNAL and \
+        os.environ.get("OZONE_TPU_FUSED_BACKEND") == "jax"
+    if pinned:
+        del os.environ["OZONE_TPU_FUSED_BACKEND"]
+
+    def run(nn: int, key: tuple, units: int) -> tuple[float, dict]:
+        """Steady-state MiB/s/device over a `nn`-device executor."""
+        ex = mesh_executor.MeshExecutor(mesh=make_mesh(nn))
+        try:
+            width = ex.dispatch_width(per_dev)
+            data = rng.integers(0, 256, (width, units, cell),
+                                dtype=np.uint8)
+            ex.submit(key, data, width=per_dev).result()  # warm
+            snap0 = mesh_executor.METRICS.snapshot()
+            t0 = time.time()
+            done = 0
+            futs = []
+            for _ in range(rounds):
+                futs.append(ex.submit(key, data, width=per_dev))
+                if len(futs) > inflight:
+                    futs.pop(0).result()
+                    done += 1
+                if remaining() < 20:
+                    break
+            for f in futs:
+                f.result()
+                done += 1
+            dt = time.time() - t0
+            ex.quiesce()
+            snap1 = mesh_executor.METRICS.snapshot()
+            mib = done * data.nbytes / 2**20
+            stats = {
+                "dispatches": int(snap1.get("dispatches", 0)
+                                  - snap0.get("dispatches", 0)),
+                "max_inflight": ex._max_inflight,
+                "compile_delta": ex.compile_counts(),
+            }
+            return mib / dt / nn, stats
+        finally:
+            ex.close()
+
+    try:
+        enc_rate, enc_stats = run(n, enc_key, 6)
+        dec_rate, _ = run(n, dec_key, 6)
+        curve = {}
+        for nn in (1, 2, 4, 8):
+            if nn > n:
+                break
+            if remaining() < 30:
+                break
+            r, _ = run(nn, enc_key, 6)
+            curve[str(nn)] = round(r, 2)
+    finally:
+        if pinned:
+            os.environ["OZONE_TPU_FUSED_BACKEND"] = "jax"
+    return {
+        "encode_mib_s_per_device": enc_rate,
+        "reconstruct_mib_s_per_device": dec_rate,
+        "dispatches": enc_stats["dispatches"],
+        "max_inflight": enc_stats["max_inflight"],
+        "scaling": curve,
+    }
+
+
 def main() -> None:
     start_watchdog()
     probe_devices()
@@ -916,6 +1028,29 @@ def main() -> None:
                 f"{sh['median']:.2f} GiB/s/chip — config #5 per-chip rate")
         except Exception as e:
             log(f"sharded bench failed: {e}")
+    if "--mesh" in sys.argv and budget_for("mesh executor bench", 60):
+        try:
+            m = bench_mesh_executor()
+            if m is None:
+                _STATE["mesh_skipped"] = "single-device"
+                log("mesh executor bench skipped: single device "
+                    "(the mesh datapath needs >= 2)")
+            else:
+                _STATE["mesh_encode"] = m["encode_mib_s_per_device"]
+                _STATE["mesh_reconstruct"] = (
+                    m["reconstruct_mib_s_per_device"])
+                _STATE["mesh_dispatches"] = m["dispatches"]
+                _STATE["mesh_inflight"] = m["max_inflight"]
+                _STATE["mesh_scaling"] = m["scaling"]
+                log(f"mesh executor steady-state: encode "
+                    f"{m['encode_mib_s_per_device']:.1f} MiB/s/device, "
+                    f"reconstruct "
+                    f"{m['reconstruct_mib_s_per_device']:.1f} "
+                    f"MiB/s/device, {m['dispatches']} dispatch(es), "
+                    f"in-flight depth {m['max_inflight']}, "
+                    f"scaling {m['scaling']}")
+        except Exception as e:
+            log(f"mesh executor bench failed: {e}")
     # decode family next (this PR's hot path): the burst decode median,
     # the pattern-churn cliff probe, and the sustained-60s decode number
     # all feed the driver's JSON trajectory from this round on

@@ -82,6 +82,7 @@ class ECBlockGroupReader:
         mesh=None,
         use_ring: bool = False,
         qos_class: str = "interactive",
+        executor=None,
     ):
         #: optional jax.sharding.Mesh: recovery decodes run stripe-
         #: parallel (DP) over it — or survivor-sharded around the
@@ -130,6 +131,12 @@ class ECBlockGroupReader:
         #: batches coalesce with other operations sharing the erasure
         #: pattern (reconstruction storms, fleets of degraded readers)
         self._qos = qos_class
+        #: optional parallel.mesh_executor.MeshExecutor: decode batches
+        #: route through its persistent submission queue instead of the
+        #: single-chip service — many concurrent readers (a
+        #: reconstruction storm) coalesce into full-width mesh
+        #: dispatches on long-lived SPMD programs
+        self._executor = executor
 
     # ---------------------------------------------------------------- helpers
     @property
@@ -640,20 +647,7 @@ class ECBlockGroupReader:
         stripes = list(
             stripes if stripes is not None else range(self.num_stripes))
         valid = self._choose_valid(list(targets))
-        fn = (self._mesh_decode_fn(valid, list(targets))
-              if self.mesh is not None
-              else make_fused_decoder(self.spec, valid, list(targets)))
-        svc = codec_service.maybe_service() if self.mesh is None else None
-        if svc is not None:
-            # shared-service path: this read's decode batches share
-            # device dispatches with every other in-flight operation on
-            # the same erasure pattern (a dead datanode's reconstruction
-            # storm is MANY groups with one pattern)
-            pipe = codec_service.ServicePipeline(
-                svc, codec_service.decode_key(self.spec, valid, targets),
-                fn, width=self._decode_batch, qos=self._qos)
-        else:
-            pipe = DeviceBatchPipeline(fn)
+        pipe = self._decode_pipe(valid, list(targets))
         pool = self._ensure_pool()
         for sb in batched(stripes, self._decode_batch):
             batch = np.zeros((len(sb), self.k, self.cell), dtype=np.uint8)
@@ -681,6 +675,34 @@ class ECBlockGroupReader:
         out = pipe.drain()
         if out is not None:
             yield out
+
+    def _decode_pipe(self, valid: list[int], targets: list[int]):
+        """The recovery dispatch pipeline, best path first: persistent
+        mesh executor (decode batches join its submission queue, where
+        every other reader repairing the same erasure pattern — a
+        reconstruction storm is MANY groups with ONE pattern —
+        coalesces into full-width mesh dispatches on long-lived
+        programs), then the caller-supplied mesh, then the shared
+        single-chip codec service, then a per-operation pipeline."""
+        if self._executor is not None and self.mesh is None:
+            try:
+                return self._executor.pipeline(
+                    codec_service.decode_key(self.spec, valid, targets),
+                    width=self._decode_batch, qos=self._qos)
+            except KeyError:  # ozlint: allow[error-swallowing] -- no mesh program for this spec: fall through to the single-chip paths below
+                pass
+        fn = (self._mesh_decode_fn(valid, targets)
+              if self.mesh is not None
+              else make_fused_decoder(self.spec, valid, targets))
+        svc = codec_service.maybe_service() if self.mesh is None else None
+        if svc is not None:
+            # shared-service path: this read's decode batches share
+            # device dispatches with every other in-flight operation on
+            # the same erasure pattern
+            return codec_service.ServicePipeline(
+                svc, codec_service.decode_key(self.spec, valid, targets),
+                fn, width=self._decode_batch, qos=self._qos)
+        return DeviceBatchPipeline(fn)
 
     def _mesh_decode_fn(self, valid: list[int], targets: list[int]):
         """Multi-chip decode (ECReconstructionCoordinator.java:146 run on

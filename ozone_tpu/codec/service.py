@@ -342,18 +342,94 @@ class CodecService:
                 s.deadline_t() for s in lane.subs)
         return entries, row
 
+    # ------------------------------------------------------------ spill
+    def _collect_spill_locked(self) -> list[tuple]:
+        """Whole-lane overflow redirection to the mesh executor: when
+        the single-chip queue depth crosses the spill watermark, pop
+        entire lanes whose submissions are all still untouched (no
+        stripe dispatched yet — a spilled future must be served wholly
+        by one executor) and hand them to the mesh. Pops deepest-first
+        and keeps the watermark's worth of work here: the single chip
+        stays fed while the overflow drains on the neighbors."""
+        from ozone_tpu.parallel import mesh_executor
+
+        if not mesh_executor.spill_enabled():
+            return []
+        depth = self._queue_depth_locked()
+        watermark = mesh_executor.spill_watermark()
+        if depth <= watermark:
+            return []
+        mex = mesh_executor.maybe_executor()
+        if mex is None:
+            return []
+        spilled: list[tuple] = []
+        for lane in sorted(self._lanes.values(),
+                           key=lambda ln: -ln.queued):
+            if depth <= watermark:
+                break
+            if not lane.subs or any(s.taken for s in lane.subs):
+                continue
+            key = lane.lane_key[0]
+            ok = mex.accepts_cached(key)
+            if ok is not True:
+                if ok is None:
+                    # unknown key: warm it outside the lock; next
+                    # iteration spills it (resolution may compile, and
+                    # submitters must not stall behind that)
+                    spilled.append((mex, key, None))
+                continue
+            self._lanes.pop(lane.lane_key, None)
+            for sub in lane.subs:
+                left = self._queued_cls.get(sub.cls, 1) - 1
+                if left > 0:
+                    self._queued_cls[sub.cls] = left
+                else:
+                    self._queued_cls.pop(sub.cls, None)
+            depth -= lane.queued
+            spilled.append((mex, key, lane))
+        real = [s for s in spilled if s[2] is not None]
+        if real:
+            METRICS.counter("mesh_spill_lanes").inc(len(real))
+            METRICS.counter("mesh_spill_stripes").inc(
+                sum(lane.queued for _, _, lane in real))
+            METRICS.gauge("queue_depth").set(depth)
+        return spilled
+
+    @staticmethod
+    def _spill(spilled: list[tuple]) -> None:
+        """Absorb popped lanes into the mesh executor (outside the
+        service lock: program resolution may compile). Entries with no
+        lane are resolution warm-ups for keys the peek didn't know."""
+        for mex, key, lane in spilled:
+            if lane is None:
+                try:
+                    mex.accepts(key)
+                except Exception:  # noqa: BLE001 - warm-up only; lane stayed queued here
+                    log.exception("mesh warm-up failed for %r", key)
+                continue
+            _, width, qos = lane.lane_key
+            try:
+                mex.absorb(key, width, qos, list(lane.subs))
+            except BaseException as e:  # noqa: BLE001 - spill must never strand futures
+                log.exception("mesh spill failed for %r", key)
+                for sub in lane.subs:
+                    if not sub.future.done():
+                        sub.future.set_exception(e)
+
     # ------------------------------------------------------- dispatcher
     def _loop(self) -> None:
         try:
             while True:
                 entries = None
+                spilled = None
                 with self._cond:
                     now = time.monotonic()
+                    spilled = self._collect_spill_locked()
                     picked = self._pick_lane_locked(now)
                     if picked is not None:
                         lane, reason = picked
                         entries, rows = self._pack_locked(lane, reason)
-                    elif not self._inflight:
+                    elif not self._inflight and not spilled:
                         if not self._running:
                             if not self._lanes:
                                 break
@@ -366,6 +442,10 @@ class CodecService:
                         else:
                             self._cond.wait(self._next_wakeup_locked(now))
                             continue
+                if spilled:
+                    # outside the lock: absorption resolves (and may
+                    # compile) mesh programs; submitters keep flowing
+                    self._spill(spilled)
                 if entries is not None:
                     self._dispatch(lane, entries, rows, reason)
                     # depth-1 double buffer: keep ONE older batch in

@@ -342,6 +342,7 @@ class _SpecPacker:
                  stats: dict, spec=None):
         from ozone_tpu.codec import service as codec_service
         from ozone_tpu.codec.pipeline import DeviceBatchPipeline
+        from ozone_tpu.parallel import mesh_executor
 
         self.executor = executor
         self.opts = opts
@@ -349,17 +350,33 @@ class _SpecPacker:
         self.bpc = bpc
         self.stats = stats
         self.window = tier_batch_size()
-        # shared codec service (bulk class) when enabled: sweep windows
-        # coalesce with other operations' stripes and the weighted fair
-        # scheduler keeps the sweep from starving interactive traffic;
-        # per-sweep DeviceBatchPipeline is the no-service fallback
-        svc = codec_service.maybe_service() if spec is not None else None
-        if svc is not None:
-            self.pipe = codec_service.ServicePipeline(
-                svc, codec_service.encode_key(spec), fn,
-                width=self.window, qos="bulk")
-        else:
-            self.pipe = DeviceBatchPipeline(fn)
+        # mesh lane first: on a multi-chip host a bulk tiering sweep is
+        # exactly the traffic the persistent mesh executor exists for —
+        # full-width windows coalescing with other sweeps into mesh-wide
+        # dispatches. Then the shared codec service (bulk class): sweep
+        # windows coalesce with other operations' stripes and the
+        # weighted fair scheduler keeps the sweep from starving
+        # interactive traffic; per-sweep DeviceBatchPipeline is the
+        # no-service fallback.
+        self.pipe = None
+        if spec is not None:
+            mex = mesh_executor.maybe_executor()
+            if mex is not None:
+                try:
+                    self.pipe = mex.pipeline(
+                        codec_service.encode_key(spec),
+                        width=self.window, qos="bulk")
+                except KeyError:
+                    self.pipe = None
+        if self.pipe is None:
+            svc = codec_service.maybe_service() if spec is not None \
+                else None
+            if svc is not None:
+                self.pipe = codec_service.ServicePipeline(
+                    svc, codec_service.encode_key(spec), fn,
+                    width=self.window, qos="bulk")
+            else:
+                self.pipe = DeviceBatchPipeline(fn)
         self.host_checksum = Checksum(ctype, bpc)
         self.dispatches = 0
         self._reset_buffer()

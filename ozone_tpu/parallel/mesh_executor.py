@@ -1,0 +1,772 @@
+"""Persistent mesh executor: the multi-chip datapath, kept fed.
+
+MULTICHIP_r05 proved the sharded codec correct (bit-exact DP encode,
+cross-process psum) but moved ~0.2 MiB/s/device, because every mesh call
+re-staged its batch, re-dispatched synchronously, and blocked for the
+result. This module gives the mesh the same treatment
+`DeviceBatchPipeline` + `CodecService` gave the single chip:
+
+- **Long-lived compiled SPMD programs**, one per (FusedSpec, erasure
+  pattern, batch width), resolved once per lane through
+  `parallel/sharded.py`'s plan caches — erasure-pattern churn swaps a
+  tiny replicated matrix, never the compiled program.
+- **Reused host staging buffers**: every dispatch packs into a pooled
+  buffer of the lane's constant shape instead of allocating; the pool
+  holds depth+1 buffers per shape, the steady-state working set of the
+  in-flight window.
+- **Depth-N in-flight batches** (``OZONE_TPU_MESH_DEPTH``, default 2):
+  dispatch N+1 launches while batches N..N-depth+1 are still on the
+  devices; results harvest without blocking the submission path.
+- **A submission-queue front end mirroring `codec/service.py` lanes**:
+  concurrent operations submit stripes keyed by the same semantic keys
+  (`encode_key` / `decode_key`); the dispatcher coalesces them into
+  full-width mesh dispatches (per-device batch x mesh size), so a
+  reconstruction storm over many containers becomes a few wide
+  dispatches instead of per-container dribbles.
+
+Backend policy mirrors `codec/fused.py`: on CPU-only hosts (where XLA's
+GF(2) bit-matmul runs orders of magnitude slower than the AVX2 nibble
+coder) a lane's program resolves to the **native host twin sharded
+across one worker thread per mesh device** — same contract, same
+coalescing, and trivially zero XLA compiles — while accelerator meshes
+run the jitted SPMD programs. `stats()["mode_*"]` reports which.
+
+Spill: when ``OZONE_TPU_MESH_SPILL=1`` (off by default) the shared
+codec service redirects whole overflowing lanes here once its queue
+depth crosses ``OZONE_TPU_MESH_SPILL_WATERMARK`` — see
+`codec/service.py:_collect_spill_locked`.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ozone_tpu.codec.pipeline import _start_d2h
+from ozone_tpu.parallel import sharded
+from ozone_tpu.utils.config import env_float, env_int
+from ozone_tpu.utils.metrics import MetricsRegistry, registry
+from ozone_tpu.utils.tracing import Tracer
+
+log = logging.getLogger(__name__)
+
+#: every mesh-executor signal in ONE registry (prometheus: mesh_*)
+METRICS: MetricsRegistry = registry("mesh")
+
+#: in-flight mesh batches per lane family (double buffering = 2; triple
+#: buffering = 3 hides longer D2H tails at the cost of one more staged
+#: batch of memory per shape)
+DEFAULT_DEPTH = 2
+#: a single mesh dispatch never packs more stripe slots than this, no
+#: matter the mesh size — bounds staged-buffer memory ([256, k, cell])
+MAX_DISPATCH_WIDTH = 256
+#: added-latency bound for a partial mesh batch waiting for co-batching
+#: (the codec service's linger, applied to the mesh front end)
+DEFAULT_LINGER_MS = 2.0
+
+
+def mesh_depth() -> int:
+    """The in-flight depth knob (OZONE_TPU_MESH_DEPTH, min 1)."""
+    return max(1, env_int("OZONE_TPU_MESH_DEPTH", DEFAULT_DEPTH))
+
+
+def enabled() -> bool:
+    """The executor disable switch (OZONE_TPU_MESH=0)."""
+    return os.environ.get("OZONE_TPU_MESH", "1") != "0"
+
+
+def spill_enabled() -> bool:
+    """Codec-service overflow spill onto the mesh
+    (OZONE_TPU_MESH_SPILL=1; OFF by default — spilling helps only when
+    neighbor chips are otherwise idle, and moves interactive work onto
+    a path tuned for throughput, not latency)."""
+    return os.environ.get("OZONE_TPU_MESH_SPILL", "0") in (
+        "1", "true", "yes", "on")
+
+
+def spill_watermark() -> int:
+    """Queue-depth (stripes) past which the codec service starts
+    redirecting whole lanes to the mesh (OZONE_TPU_MESH_SPILL_WATERMARK)."""
+    return max(1, env_int("OZONE_TPU_MESH_SPILL_WATERMARK", 64))
+
+
+def _ambient_deadline():
+    from ozone_tpu.client import resilience
+
+    return resilience.current()
+
+
+class _MeshProgram:
+    """One resolved, long-lived mesh program for a semantic key.
+
+    `fn(batch [W, ...]) -> tuple of outputs` where W is any multiple of
+    the mesh size up to the dispatch width; `jitted` lists the
+    underlying compiled callables for the zero-new-compile probe
+    (empty on the host-twin path, which has nothing to compile).
+    """
+
+    __slots__ = ("fn", "jitted", "host_twin")
+
+    def __init__(self, fn: Callable, jitted: tuple, host_twin: bool):
+        self.fn = fn
+        self.jitted = jitted
+        self.host_twin = host_twin
+
+    def compile_count(self) -> int:
+        """Compiled-executable census across this program's jitted
+        callables; steady-state dispatches must not move it."""
+        total = 0
+        for f in self.jitted:
+            try:
+                total += int(f._cache_size())
+            except Exception:  # ozlint: allow[error-swallowing] -- _cache_size is a private jax probe; absent on some versions, the census just under-counts
+                continue
+        return total
+
+
+class _Sub:
+    """One submission: `n` same-shape stripes from one operation."""
+
+    __slots__ = ("stripes", "n", "future", "cls", "deadline", "t_enq",
+                 "t_enq_wall", "trace_ctx", "tail", "taken",
+                 "pending_parts", "parts")
+
+    def __init__(self, stripes: np.ndarray, future: Future, cls: str,
+                 deadline, tail: bool):
+        self.stripes = stripes
+        self.n = int(stripes.shape[0])
+        self.future = future
+        self.cls = cls
+        self.deadline = deadline
+        self.t_enq = time.monotonic()
+        self.t_enq_wall = time.time()
+        self.trace_ctx = Tracer.instance().inject()
+        self.tail = tail
+        self.taken = 0
+        self.pending_parts = 0
+        self.parts: list[tuple] = []
+
+    def deadline_t(self) -> float:
+        return self.deadline.t_end if self.deadline is not None else math.inf
+
+
+class _Lane:
+    """One coalescing lane: same semantic key, same per-device batch
+    width, same QoS class. FIFO of submissions with undispatched
+    stripes; the bound program persists for the executor's lifetime
+    (unlike the codec service's ephemeral fn bindings, mesh programs
+    are the executor's to own — that persistence IS the point)."""
+
+    __slots__ = ("lane_key", "program", "width", "cls", "subs", "queued",
+                 "min_deadline_t")
+
+    def __init__(self, lane_key: tuple, program: _MeshProgram,
+                 width: int, cls: str):
+        self.lane_key = lane_key
+        self.program = program
+        self.width = max(1, int(width))
+        self.cls = cls
+        self.subs: deque[_Sub] = deque()
+        self.queued = 0
+        self.min_deadline_t = math.inf
+
+
+class MeshExecutor:
+    """Per-process owner of the multi-chip datapath.
+
+    `submit(key, stripes, width=...)` enqueues stripe work under a
+    codec-service semantic key and returns a Future of the host output
+    tuple for exactly those stripes. Submissions sharing (key, width,
+    qos) coalesce into full-width mesh dispatches; up to
+    ``mesh_depth()`` dispatches stay in flight.
+    """
+
+    def __init__(self, mesh=None, depth: Optional[int] = None,
+                 axis: str = "dn"):
+        if mesh is None:
+            mesh = sharded.default_codec_mesh(axis=axis)
+        if mesh is None:
+            raise ValueError(
+                "mesh executor needs a multi-device mesh "
+                "(jax.device_count() > 1)")
+        self.mesh = mesh
+        self.axis = axis
+        self.n_devices = int(mesh.devices.size)
+        self.depth = depth if depth is not None else mesh_depth()
+        self.linger_s = env_float("OZONE_TPU_MESH_LINGER_MS",
+                                  DEFAULT_LINGER_MS) / 1000.0
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._lanes: dict[tuple, _Lane] = {}
+        self._programs: dict[tuple, Optional[_MeshProgram]] = {}
+        self._inflight: deque[tuple] = deque()
+        #: host staging buffers: (shape, dtype str) -> free list; the
+        #: in-flight window recycles depth+1 buffers per lane shape
+        self._staging: dict[tuple, list[np.ndarray]] = {}
+        self._max_inflight = 0
+        #: one worker per mesh device for the host-twin programs (the
+        #: production mirror of fused._prefer_host_coder: on CPU-only
+        #: hosts the native AVX2 coder outruns XLA's bit-matmul by
+        #: orders of magnitude, and the "mesh" is the core count)
+        self._workers = ThreadPoolExecutor(
+            max_workers=self.n_devices, thread_name_prefix="mesh-dev")
+        self._dispatch_ewma_s = 0.005
+        self._running = True
+        METRICS.gauge("devices").set(self.n_devices)
+        METRICS.gauge("depth").set(self.depth)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="mesh-executor")
+        self._thread.start()
+
+    # ------------------------------------------------------ program cache
+    def dispatch_width(self, width: int) -> int:
+        """A lane's mesh dispatch width: the per-device batch times the
+        mesh size (every device gets the single-chip batch the
+        submitter tuned for), bounded, and always a mesh multiple."""
+        w = max(1, int(width)) * self.n_devices
+        w = min(w, MAX_DISPATCH_WIDTH)
+        return max(self.n_devices, -(-w // self.n_devices) * self.n_devices)
+
+    def accepts(self, key: tuple) -> bool:
+        """Whether `key` resolves to a mesh program (spill eligibility).
+        May build (and on device backends compile) the program."""
+        return self._resolve(key) is not None
+
+    def accepts_cached(self, key: tuple) -> Optional[bool]:
+        """Non-blocking spill-eligibility peek: True/False when `key`
+        has already been resolved, None when unknown — callers holding
+        their own dispatch locks use this and warm unknown keys via
+        `accepts()` outside them (resolution may compile)."""
+        with self._lock:
+            if key not in self._programs:
+                return None
+            return self._programs[key] is not None
+
+    def _resolve(self, key: tuple) -> Optional[_MeshProgram]:
+        with self._lock:
+            if key in self._programs:
+                return self._programs[key]
+        try:
+            prog = self._build_program(key)
+        except Exception:  # noqa: BLE001 - unresolvable key: caller keeps its single-chip path
+            log.exception("mesh program resolution failed for %r", key)
+            prog = None
+        with self._lock:
+            self._programs.setdefault(key, prog)
+            return self._programs[key]
+
+    def _build_program(self, key: tuple) -> Optional[_MeshProgram]:
+        from ozone_tpu.codec import fused
+
+        kind = key[0]
+        if kind == "encode":
+            spec = key[1]
+            if fused._prefer_host_coder(spec.options,
+                                        checksum=spec.checksum):
+                single = fused._native_fused_encoder(
+                    spec.options, spec.checksum, spec.bytes_per_checksum)
+                if single is not None:
+                    return _MeshProgram(self._host_shard(single), (), True)
+            jfn = sharded.make_sharded_fused_encoder(
+                spec, self.mesh, self.axis)
+            return _MeshProgram(jfn, (jfn,), False)
+        if kind == "decode":
+            spec, valid, erased = key[1], list(key[2]), list(key[3])
+            out_ratio = len(erased) / max(len(valid), 1)
+            if fused._prefer_host_coder(spec.options, out_ratio=out_ratio,
+                                        checksum=spec.checksum):
+                single = fused._native_fused_decoder(
+                    spec.options, spec.checksum, spec.bytes_per_checksum,
+                    tuple(valid), tuple(erased))
+                if single is not None:
+                    return _MeshProgram(self._host_shard(single), (), True)
+            jfn = sharded.make_sharded_decoder(
+                spec, valid, erased, self.mesh, self.axis)
+            k_dev, zeros_crc = fused.crc_plan_cached(
+                spec.checksum, spec.bytes_per_checksum)
+            apply_fn = sharded._sharded_decode_apply_cached(
+                self.mesh, self.axis, k_dev is not None, zeros_crc)
+            return _MeshProgram(jfn, (apply_fn,), False)
+        # reencode and custom fns have no sharded twin (the re-encode
+        # kernel's single fused dispatch doesn't decompose across the
+        # batch axis for free) — their lanes never spill here
+        return None
+
+    def _host_shard(self, single: Callable) -> Callable:
+        """Shard a batch across one worker thread per mesh device, each
+        running the native single-chip twin on its contiguous slice —
+        the host mirror of the DP sharding (batch axis over devices)."""
+        n = self.n_devices
+
+        def fn(batch: np.ndarray):
+            per = batch.shape[0] // n
+            if per == 0:
+                outs = [single(batch)]
+            else:
+                futs = [
+                    self._workers.submit(single, batch[i * per:(i + 1) * per])
+                    for i in range(n)
+                ]
+                outs = [f.result() for f in futs]
+            first = outs[0] if isinstance(outs[0], tuple) else (outs[0],)
+            width = len(first)
+            return tuple(
+                np.concatenate(
+                    [(o if isinstance(o, tuple) else (o,))[i]
+                     for o in outs], axis=0)
+                for i in range(width))
+
+        return fn
+
+    # ---------------------------------------------------------- staging
+    def _take_staging(self, shape: tuple, dtype) -> np.ndarray:
+        skey = (shape, np.dtype(dtype).str)
+        with self._lock:
+            free = self._staging.get(skey)
+            if free:
+                METRICS.counter("staging_reuses").inc()
+                return free.pop()
+        return np.empty(shape, dtype=dtype)
+
+    def _give_staging(self, buf: np.ndarray) -> None:
+        skey = (buf.shape, buf.dtype.str)
+        with self._lock:
+            free = self._staging.setdefault(skey, [])
+            if len(free) <= self.depth:
+                free.append(buf)
+
+    # ----------------------------------------------------------- submit
+    def submit(self, key: tuple, stripes: np.ndarray, *, width: int,
+               qos: str = "bulk", tail: bool = False,
+               deadline=None) -> Future:
+        """Enqueue `stripes` ([n, ...], n >= 1) under semantic `key`.
+
+        `width` is the submitter's per-device batch width (the lane
+        dispatches at ``dispatch_width(width)``). Raises KeyError when
+        the key has no mesh program — callers should have checked
+        `accepts()` or hold a pipeline from `pipeline()`.
+        """
+        if stripes.shape[0] < 1:
+            raise ValueError("empty mesh submission")
+        prog = self._resolve(key)
+        if prog is None:
+            raise KeyError(f"no mesh program for {key!r}")
+        if deadline is None:
+            deadline = _ambient_deadline()
+        fut: Future = Future()
+        sub = _Sub(stripes, fut, qos, deadline, tail)
+        self._enqueue(key, prog, width, qos, [sub])
+        return fut
+
+    def _enqueue(self, key: tuple, prog: _MeshProgram, width: int,
+                 qos: str, subs: list) -> None:
+        lane_key = (key, int(width), qos)
+        lane_width = self.dispatch_width(width)
+        with self._cond:
+            if not self._running:
+                raise RuntimeError("mesh executor is shut down")
+            lane = self._lanes.get(lane_key)
+            if lane is None:
+                lane = self._lanes[lane_key] = _Lane(
+                    lane_key, prog, lane_width, qos)
+            for sub in subs:
+                lane.subs.append(sub)
+                lane.queued += sub.n
+                lane.min_deadline_t = min(lane.min_deadline_t,
+                                          sub.deadline_t())
+                METRICS.counter("submissions").inc()
+            METRICS.gauge("queue_depth").set(self._queue_depth_locked())
+            self._cond.notify()
+
+    def absorb(self, key: tuple, width: int, qos: str,
+               subs: list) -> None:
+        """Take over queued submissions spilled from the codec service:
+        same future, same stripes, same deadline — only the dispatch
+        path changes. Caller guarantees no sub has partially-dispatched
+        stripes (the service only spills untouched lanes)."""
+        prog = self._resolve(key)
+        if prog is None:
+            raise KeyError(f"no mesh program for {key!r}")
+        METRICS.counter("spilled_lanes").inc()
+        METRICS.counter("spilled_stripes").inc(sum(s.n for s in subs))
+        self._enqueue(key, prog, width, qos, subs)
+
+    def pipeline(self, key: tuple, *, width: int,
+                 qos: str = "bulk") -> "MeshPipeline":
+        """A `ServicePipeline`-shaped front end over one mesh lane —
+        the two-line routing change for depth-1 pipeline consumers.
+        Raises KeyError when the key has no mesh program."""
+        if self._resolve(key) is None:
+            raise KeyError(f"no mesh program for {key!r}")
+        return MeshPipeline(self, key, width=width, qos=qos)
+
+    # ------------------------------------------------------- scheduling
+    def _queue_depth_locked(self) -> int:
+        return sum(lane.queued for lane in self._lanes.values())
+
+    def _flush_margin_s(self) -> float:
+        return self.linger_s + 4.0 * self._dispatch_ewma_s
+
+    def _ready_lane_locked(self, now: float) -> Optional[_Lane]:
+        """Earliest-deadline-then-oldest ready lane: full lanes first,
+        then deadline-pressed, then lingered-out. The heavy fairness
+        machinery (WFQ vtime, starvation guard) lives in the codec
+        service front end; by the time work reaches the mesh it is
+        bulk-classed or already fairness-filtered."""
+        best: Optional[_Lane] = None
+        best_rank: tuple = ()
+        margin = self._flush_margin_s()
+        for lane in self._lanes.values():
+            if not lane.subs:
+                continue
+            head_age = now - lane.subs[0].t_enq
+            if lane.queued >= lane.width:
+                rank = (0, -lane.queued, lane.subs[0].t_enq)
+            elif lane.min_deadline_t - now <= margin:
+                rank = (1, lane.min_deadline_t, lane.subs[0].t_enq)
+            elif head_age >= self.linger_s:
+                rank = (2, lane.subs[0].t_enq, 0.0)
+            else:
+                continue
+            if best is None or rank < best_rank:
+                best, best_rank = lane, rank
+        return best
+
+    def _next_wakeup_locked(self, now: float) -> Optional[float]:
+        t = math.inf
+        margin = self._flush_margin_s()
+        for lane in self._lanes.values():
+            if not lane.subs:
+                continue
+            t = min(t, lane.subs[0].t_enq + self.linger_s,
+                    lane.min_deadline_t - margin)
+        return None if math.isinf(t) else max(0.0, t - now)
+
+    def _pack_locked(self, lane: _Lane):
+        entries: list[tuple[_Sub, int, int, int]] = []
+        row = 0
+        while lane.subs and row < lane.width:
+            sub = lane.subs[0]
+            take = min(sub.n - sub.taken, lane.width - row)
+            entries.append((sub, sub.taken, take, row))
+            sub.taken += take
+            sub.pending_parts += 1
+            if sub.taken == sub.n:
+                lane.subs.popleft()
+            row += take
+            lane.queued -= take
+        if not lane.subs:
+            lane.min_deadline_t = math.inf
+        else:
+            lane.min_deadline_t = min(s.deadline_t() for s in lane.subs)
+        return entries, row
+
+    # ------------------------------------------------------- dispatcher
+    def _loop(self) -> None:
+        try:
+            while True:
+                entries = None
+                with self._cond:
+                    now = time.monotonic()
+                    lane = self._ready_lane_locked(now)
+                    if lane is not None:
+                        entries, rows = self._pack_locked(lane)
+                    elif not self._inflight:
+                        if not self._running:
+                            if not self._lanes or not any(
+                                    ln.subs for ln in self._lanes.values()):
+                                break
+                            lane = next(ln for ln in self._lanes.values()
+                                        if ln.subs)
+                            entries, rows = self._pack_locked(lane)
+                        else:
+                            self._cond.wait(self._next_wakeup_locked(now))
+                            continue
+                if entries is not None:
+                    self._dispatch(lane, entries, rows)
+                    # depth-N buffering: keep up to `depth` mesh batches
+                    # in flight; harvest the oldest only once the window
+                    # is over-full, so launches never wait on pulls
+                    while len(self._inflight) > self.depth:
+                        self._complete(self._inflight.popleft())
+                elif self._inflight:
+                    # nothing packable: never hold results hostage
+                    self._complete(self._inflight.popleft())
+        except BaseException:  # noqa: BLE001 - dispatcher must not die silently
+            log.exception("mesh executor dispatcher crashed")
+            raise
+        finally:
+            with self._lock:
+                self._running = False
+            self._fail_pending(RuntimeError("mesh executor stopped"))
+
+    def _dispatch(self, lane: _Lane, entries, rows: int) -> None:
+        now = time.monotonic()
+        ops = len(entries)
+        tracer = Tracer.instance()
+        lane_desc = str(lane.lane_key)[:120]
+        for sub, off, take, _row in entries:
+            if off == 0:
+                wait = now - sub.t_enq
+                tid = sub.trace_ctx.split(":", 1)[0]
+                METRICS.histogram("queue_wait_seconds").observe(wait, tid)
+                if sub.trace_ctx:
+                    tracer.record_span(
+                        "mesh:queue_wait", child_of=sub.trace_ctx,
+                        start=sub.t_enq_wall, duration=wait,
+                        lane=lane_desc, qos=sub.cls)
+        head = entries[0]
+        staged = None
+        if ops == 1 and head[2] == rows == lane.width and head[1] == 0 \
+                and head[0].n == lane.width \
+                and head[0].stripes.flags.c_contiguous:
+            # one submission covering the whole batch: dispatch its own
+            # rows without a staging copy
+            batch = head[0].stripes
+        else:
+            shape = (lane.width,) + tuple(head[0].stripes.shape[1:])
+            staged = batch = self._take_staging(
+                shape, head[0].stripes.dtype)
+            for sub, off, take, row in entries:
+                batch[row:row + take] = sub.stripes[off:off + take]
+            if rows < lane.width:
+                batch[rows:] = 0  # constant-shape zero-padded tail
+        t0 = time.monotonic()
+        with tracer.span("mesh:dispatch", lane=lane_desc, ops=ops,
+                         rows=rows, width=lane.width,
+                         devices=self.n_devices):
+            try:
+                outs = lane.program.fn(batch)
+            except BaseException as e:  # noqa: BLE001 - per-dispatch fault
+                if staged is not None:
+                    self._give_staging(staged)
+                self._resolve_error(entries, e)
+                return
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for a in outs:
+                # eager D2H: the pull overlaps the next batch's staging
+                _start_d2h(a)
+        METRICS.counter("dispatches").inc()
+        METRICS.counter("stripes_dispatched").inc(rows)
+        METRICS.counter("slots_dispatched").inc(lane.width)
+        METRICS.counter("coalesced_operations").inc(ops)
+        if ops > 1:
+            METRICS.counter("multi_op_dispatches").inc()
+        METRICS.gauge("batch_fill_pct").set(100.0 * rows / lane.width)
+        with self._lock:
+            METRICS.gauge("queue_depth").set(self._queue_depth_locked())
+        self._inflight.append(
+            (entries, outs, staged, t0, time.time(),
+             (lane_desc, ops, rows, lane.width)))
+        depth_now = len(self._inflight)
+        self._max_inflight = max(self._max_inflight, depth_now)
+        METRICS.gauge("inflight_depth").set(depth_now)
+        METRICS.gauge("inflight_per_device").set(depth_now)
+        METRICS.gauge("max_inflight_depth").set(self._max_inflight)
+
+    def _complete(self, rec: tuple) -> None:
+        entries, outs, staged, t0, t0_wall, dctx = rec
+        lane_desc, ops, rows, width = dctx
+        try:
+            host = tuple(np.asarray(a) for a in outs)
+        except BaseException as e:  # noqa: BLE001 - D2H fault
+            if staged is not None:
+                self._give_staging(staged)
+            self._resolve_error(entries, e)
+            return
+        if staged is not None:
+            self._give_staging(staged)
+        dt = time.monotonic() - t0
+        self._dispatch_ewma_s += 0.2 * (dt - self._dispatch_ewma_s)
+        METRICS.histogram("dispatch_seconds").observe(
+            dt, entries[0][0].trace_ctx.split(":", 1)[0])
+        METRICS.gauge("inflight_depth").set(len(self._inflight))
+        tracer = Tracer.instance()
+        for sub, off, take, _row in entries:
+            if sub.trace_ctx:
+                tracer.record_span(
+                    "mesh:device_dispatch", child_of=sub.trace_ctx,
+                    start=t0_wall, duration=dt, lane=lane_desc,
+                    qos=sub.cls, stripes=take, ops=ops, rows=rows,
+                    width=width)
+        for sub, off, take, row in entries:
+            sub.parts.append(
+                (off, take, tuple(a[row:row + take] for a in host)))
+            sub.pending_parts -= 1
+            if sub.taken == sub.n and sub.pending_parts == 0:
+                _resolve_sub(sub)
+
+    @staticmethod
+    def _resolve_error(entries, e: BaseException) -> None:
+        done = set()
+        for sub, _off, _take, _row in entries:
+            if id(sub) not in done:
+                done.add(id(sub))
+                if not sub.future.done():
+                    sub.future.set_exception(e)
+
+    def _fail_pending(self, e: BaseException) -> None:
+        with self._lock:
+            subs = [s for lane in self._lanes.values() for s in lane.subs]
+            self._lanes.clear()
+            inflight, self._inflight = list(self._inflight), deque()
+        for rec in inflight:
+            for sub, _o, _t, _r in rec[0]:
+                subs.append(sub)
+        for s in subs:
+            if not s.future.done():
+                s.future.set_exception(e)
+
+    # ---------------------------------------------------------- control
+    def compile_counts(self) -> int:
+        """Total compiled executables across every resolved mesh
+        program — the warm-program proof probes the delta of this
+        across steady-state rounds (must be zero)."""
+        with self._lock:
+            progs = [p for p in self._programs.values() if p is not None]
+        return sum(p.compile_count() for p in progs)
+
+    def stats(self) -> dict:
+        """Operator snapshot (the Recon /api/mesh payload)."""
+        snap = METRICS.snapshot()
+        slots = snap.get("slots_dispatched", 0)
+        disp = snap.get("dispatches", 0)
+        snap["fill_ratio"] = (snap.get("stripes_dispatched", 0) / slots
+                              if slots else 0.0)
+        snap["ops_per_dispatch"] = (
+            snap.get("coalesced_operations", 0) / disp if disp else 0.0)
+        with self._lock:
+            snap["queue_depth"] = self._queue_depth_locked()
+            snap["lanes"] = len(self._lanes)
+            snap["inflight"] = len(self._inflight)
+            progs = [p for p in self._programs.values() if p is not None]
+            snap["programs"] = len(progs)
+            snap["programs_host_twin"] = sum(
+                1 for p in progs if p.host_twin)
+        snap["max_inflight"] = self._max_inflight
+        snap["devices"] = self.n_devices
+        snap["mesh_depth"] = self.depth
+        snap["compile_counts"] = sum(p.compile_count() for p in progs)
+        snap["spill_enabled"] = spill_enabled()
+        snap["spill_watermark"] = spill_watermark()
+        snap["enabled"] = enabled()
+        return snap
+
+    def quiesce(self, timeout_s: float = 30.0) -> None:
+        """Wait until every queued submission has dispatched and
+        harvested (tests and drills; production never needs it)."""
+        t_end = time.monotonic() + timeout_s
+        while time.monotonic() < t_end:
+            with self._lock:
+                if not self._inflight and \
+                        self._queue_depth_locked() == 0:
+                    return
+            time.sleep(0.002)
+
+    def close(self) -> None:
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        self._thread.join(timeout=60.0)
+        self._fail_pending(RuntimeError("mesh executor shut down"))
+        self._workers.shutdown(wait=False)
+
+
+def _resolve_sub(sub: _Sub) -> None:
+    if sub.future.done():
+        return
+    if len(sub.parts) == 1:
+        sub.future.set_result(sub.parts[0][2])
+        return
+    sub.parts.sort(key=lambda p: p[0])
+    outs = tuple(
+        np.concatenate([p[2][i] for p in sub.parts], axis=0)
+        for i in range(len(sub.parts[0][2])))
+    sub.future.set_result(outs)
+
+
+class MeshPipeline:
+    """Drop-in twin of `DeviceBatchPipeline`/`ServicePipeline` backed by
+    one mesh lane: submit(batch, ctx) coalesces into full-width mesh
+    dispatches and returns the PREVIOUS submission's host results."""
+
+    def __init__(self, executor: MeshExecutor, key: tuple, *,
+                 width: int, qos: str = "bulk"):
+        self._ex = executor
+        self._key = key
+        self._width = max(1, int(width))
+        self._qos = qos
+        self._pending: Optional[tuple] = None
+
+    def submit(self, batch: np.ndarray, ctx: Any = None,
+               tail: bool = False) -> Optional[tuple]:
+        fut = self._ex.submit(self._key, batch, width=self._width,
+                              qos=self._qos, tail=tail)
+        prev, self._pending = self._pending, (ctx, fut)
+        return self._to_host(prev)
+
+    def drain(self) -> Optional[tuple]:
+        prev, self._pending = self._pending, None
+        return self._to_host(prev)
+
+    @staticmethod
+    def _to_host(entry: Optional[tuple]) -> Optional[tuple]:
+        if entry is None:
+            return None
+        ctx, fut = entry
+        from ozone_tpu.codec import service as codec_service
+
+        return ctx, codec_service.wait_result(fut)
+
+
+_executor: Optional[MeshExecutor] = None
+_executor_lock = threading.Lock()
+
+
+def get_executor() -> MeshExecutor:
+    """The process-wide executor (created on first use)."""
+    global _executor
+    with _executor_lock:
+        if _executor is None or not _executor._running:
+            _executor = MeshExecutor()
+        return _executor
+
+
+def maybe_executor() -> Optional[MeshExecutor]:
+    """The executor when it can exist here: enabled AND more than one
+    device attached — the ONE check routed datapaths (lifecycle mesh
+    lane, reconstruction storms, codec-service spill) make before
+    falling back to their single-chip pipelines."""
+    if not enabled():
+        return None
+    try:
+        import jax
+
+        if jax.device_count() < 2:
+            return None
+    except Exception:  # noqa: BLE001 - no backend: single-device path
+        return None
+    try:
+        return get_executor()
+    except Exception:  # noqa: BLE001 - mesh construction failed: fall back
+        log.exception("mesh executor unavailable")
+        return None
+
+
+def reset_for_tests() -> None:
+    """Shut down and drop the singleton (fresh knobs per test)."""
+    global _executor
+    with _executor_lock:
+        ex, _executor = _executor, None
+    if ex is not None:
+        ex.close()

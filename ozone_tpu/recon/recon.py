@@ -738,6 +738,10 @@ class ReconServer:
                     # continuous-batching health, next to lifecycle —
                     # its main bulk consumer)
                     "/api/codec": recon.codec_view,
+                    # persistent mesh executor: multi-chip dispatch,
+                    # coalescing and spill accounting (the fleet
+                    # reconstruction/bulk-tiering datapath's health)
+                    "/api/mesh": recon.mesh_view,
                     # slow-request flight recorder: retained
                     # over-SLO traces; ?id=<traceId> returns the full
                     # span set + critical path for one trace
@@ -818,6 +822,25 @@ class ReconServer:
         if svc is None or not svc._running:
             return {"enabled": True, "started": False}
         return svc.stats()
+
+    def mesh_view(self) -> dict:
+        """Persistent mesh executor snapshot for the dashboard panel:
+        dispatch/fill/coalescing accounting, in-flight depth, program
+        census (device vs host-twin) and spill knob echo
+        (parallel/mesh_executor.stats). PEEKS at the singleton exactly
+        like codec_view — a monitoring GET must never be the thing that
+        spawns the mesh-owning dispatcher (or builds a mesh) in a
+        process that does no mesh work."""
+        from ozone_tpu.parallel import mesh_executor
+
+        if not mesh_executor.enabled():
+            return {"enabled": False}
+        ex = mesh_executor._executor
+        if ex is None or not ex._running:
+            return {"enabled": True, "started": False,
+                    "spill_enabled": mesh_executor.spill_enabled(),
+                    "spill_watermark": mesh_executor.spill_watermark()}
+        return ex.stats()
 
     def replication_view(self) -> dict:
         """Geo-replication shipper status + per-bucket rule census for

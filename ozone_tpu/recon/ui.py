@@ -218,6 +218,13 @@ RECON_INDEX_HTML = """<!doctype html>
     dispatches &mdash; fill ratio, queue depth, QoS/linger flushes</div>
   <div class="tiles" id="codec-tiles"></div>
 
+  <h2>Mesh executor</h2>
+  <div class="sub">persistent multi-chip datapath: long-lived SPMD
+    programs fed depth-N in-flight batches &mdash; dispatch fill,
+    coalescing across operations, spill absorption from the codec
+    service</div>
+  <div class="tiles" id="mesh-tiles"></div>
+
   <h2>Slow requests</h2>
   <div class="sub">flight recorder: traces retained past their per-op
     SLO &mdash; click a trace for its critical path (stage &rarr;
@@ -414,6 +421,31 @@ async function refresh() {
       tile("deadline flushes", cx.deadline_flushes ?? 0),
       tile("tail flushes", cx.tail_flushes ?? 0),
       tile("starvation trips", cx.starvation_guard_trips ?? 0),
+    ].join("");
+    const mx = await (await fetch("/api/mesh")).json();
+    document.getElementById("mesh-tiles").innerHTML =
+      mx.enabled === false
+        ? tile("mesh executor", "disabled")
+        : mx.started === false
+        ? [
+      tile("mesh executor", "idle"),
+      tile("spill", mx.spill_enabled ? "on" : "off"),
+    ].join("")
+        : [
+      tile("devices", mx.devices ?? 0),
+      tile("mode", (mx.programs_host_twin ?? 0) > 0
+           && mx.programs_host_twin === mx.programs
+           ? "host twin" : "device"),
+      tile("batch fill", `${Math.round((mx.fill_ratio ?? 0) * 100)}%`),
+      tile("queue depth", mx.queue_depth ?? 0),
+      tile("dispatches", mx.dispatches ?? 0),
+      tile("ops/dispatch", (mx.ops_per_dispatch ?? 0).toFixed(2)),
+      tile("in-flight", `${mx.inflight ?? 0}/${mx.mesh_depth ?? 0}`),
+      tile("max in-flight", mx.max_inflight ?? 0),
+      tile("programs", mx.programs ?? 0),
+      tile("spilled lanes", mx.spilled_lanes ?? 0),
+      tile("spilled stripes", mx.spilled_stripes ?? 0),
+      tile("spill", mx.spill_enabled ? "on" : "off"),
     ].join("");
     const sl = await (await fetch("/api/traces/slow")).json();
     document.querySelector("#slow-traces tbody").innerHTML =

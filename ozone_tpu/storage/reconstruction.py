@@ -70,6 +70,7 @@ class ECReconstructionCoordinator:
         mesh=None,
         use_ring: bool = False,
         max_parallel_blocks: int = 2,
+        executor=None,
     ):
         self.clients = clients
         self.checksum = checksum
@@ -86,6 +87,11 @@ class ECReconstructionCoordinator:
         #: the one that owns the mesh
         self.mesh = mesh
         self.use_ring = use_ring
+        #: persistent mesh executor (parallel/mesh_executor.py): decode
+        #: batches from EVERY block and container this coordinator
+        #: repairs join one submission queue and coalesce into
+        #: full-width mesh dispatches — the fleet-storm datapath
+        self.executor = executor
         self.metrics = MetricsRegistry("ec.reconstruction")
         #: shared peer health: source selection skips breaker-open
         #: peers while alternatives exist, and the reader's survivor
@@ -210,6 +216,7 @@ class ECReconstructionCoordinator:
             mesh=self.mesh,
             use_ring=self.use_ring,
             qos_class="bulk",  # repair storms defer to interactive reads
+            executor=self.executor,
         )
         target_units = [idx - 1 for idx in targets]  # 0-based unit indexes
         lengths = unit_true_lengths(group, opts)

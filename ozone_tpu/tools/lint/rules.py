@@ -715,8 +715,10 @@ class SpanOnDispatch(Rule):
                         "trace-context extraction) — register through "
                         "RpcServer.add_service",
                         span=_span(call))
-        # (a) codec functions containing a dispatch edge must trace
-        if not src.in_dirs("codec"):
+        # (a) codec and mesh (parallel/) functions containing a
+        # dispatch edge must trace — the mesh executor's dispatch loop
+        # is a request-path stage like any codec dispatch
+        if not src.in_dirs("codec", "parallel"):
             return
         edges_by_fn: dict[int, list[ast.Call]] = {}
         traced_fns: set[int] = set()

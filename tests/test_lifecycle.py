@@ -444,9 +444,23 @@ def test_recon_lifecycle_endpoint(cluster):
             for want in ("fill_ratio", "ops_per_dispatch",
                          "queue_depth", "linger_ms", "weights"):
                 assert want in cx, want
+        # the mesh-executor panel rides the same server (multi-chip
+        # dispatch/coalescing/spill accounting); the GET must not
+        # spawn the executor either
+        mx = json.loads(urllib.request.urlopen(
+            f"http://{recon.address}/api/mesh", timeout=10).read())
+        if mx.get("enabled") is False:
+            assert set(mx) == {"enabled"}
+        elif mx.get("started") is False:
+            assert "spill_enabled" in mx and "spill_watermark" in mx
+        else:
+            for want in ("fill_ratio", "ops_per_dispatch", "devices",
+                         "mesh_depth", "programs", "max_inflight"):
+                assert want in mx, want
         page = urllib.request.urlopen(
             f"http://{recon.address}/", timeout=10).read().decode()
         assert "Lifecycle tiering" in page and "/api/lifecycle" in page
         assert "Codec service" in page and "/api/codec" in page
+        assert "Mesh executor" in page and "/api/mesh" in page
     finally:
         recon.stop()

@@ -127,6 +127,22 @@ def test_prometheus_text_golden_every_registry_renders():
 
     OPS.histogram("put_seconds").observe(0.001)
     OPS.histogram("get_seconds").observe(0.001)
+    # the mesh-executor family (docs/OPERATIONS.md "Mesh executor"):
+    # touching the module-level registry must NOT require (or create)
+    # a running executor — dashboards scrape single-chip hosts too
+    from ozone_tpu.parallel.mesh_executor import METRICS as MESH
+
+    for name in ("submissions", "dispatches", "stripes_dispatched",
+                 "slots_dispatched", "coalesced_operations",
+                 "multi_op_dispatches", "spilled_lanes",
+                 "spilled_stripes", "staging_reuses"):
+        MESH.counter(name).inc(0)
+    for name in ("devices", "depth", "queue_depth", "batch_fill_pct",
+                 "inflight_depth", "inflight_per_device",
+                 "max_inflight_depth"):
+        MESH.gauge(name).set(0)
+    MESH.histogram("queue_wait_seconds").observe(0.0)
+    MESH.histogram("dispatch_seconds").observe(0.0)
     # the geo-replication family (docs/OPERATIONS.md "Geo replication"):
     # the lag gauges are the numbers operators alarm on
     from ozone_tpu.replication_geo.shipper import METRICS as GEO
@@ -181,6 +197,15 @@ def test_prometheus_text_golden_every_registry_renders():
                  "codec_service_batch_fill_pct",
                  "codec_service_queue_wait_seconds",
                  "codec_service_dispatch_seconds",
+                 "mesh_submissions", "mesh_dispatches",
+                 "mesh_stripes_dispatched", "mesh_slots_dispatched",
+                 "mesh_coalesced_operations", "mesh_multi_op_dispatches",
+                 "mesh_spilled_lanes", "mesh_spilled_stripes",
+                 "mesh_staging_reuses", "mesh_devices", "mesh_depth",
+                 "mesh_queue_depth", "mesh_batch_fill_pct",
+                 "mesh_inflight_depth", "mesh_inflight_per_device",
+                 "mesh_max_inflight_depth", "mesh_queue_wait_seconds",
+                 "mesh_dispatch_seconds",
                  "replication_keys_shipped", "replication_bytes_shipped",
                  "replication_deletes_shipped", "replication_conflicts",
                  "replication_ship_failures", "replication_pages_shipped",
@@ -202,6 +227,7 @@ def test_prometheus_text_golden_every_registry_renders():
     # Prometheus histograms with cumulative buckets, _sum, and _count
     for fam in ("codec_service_queue_wait_seconds",
                 "codec_service_dispatch_seconds",
+                "mesh_queue_wait_seconds", "mesh_dispatch_seconds",
                 "client_ops_put_seconds", "client_ops_get_seconds"):
         assert f"# TYPE {fam} histogram" in text, fam
         buckets = [s for s in lines
