@@ -57,6 +57,18 @@ class MetaHARing(RaftSCM):
         # just SCM container state
         self.node.snapshot_fn = self._snapshot_all
         self.node.restore_fn = self._restore_all
+        # follower-read admission (om/sharding/leases.py): any replica
+        # holding a live read lease may answer read verbs locally
+        from ozone_tpu.om.sharding.leases import FollowerReadGate
+
+        self.read_gate = FollowerReadGate(self.node)
+        _renewals = self.read_gate.metrics.counter("lease_renewals")
+        self.node.on_lease_renewal = _renewals.inc
+        #: push the commit index to followers right after each write
+        #: commits (one extra heartbeat) so their read leases serve
+        #: fresh state instead of refusing on min_applied for a whole
+        #: heartbeat interval. Opt-in: the sharded plane sets it.
+        self.push_commit_on_write = False
 
     # ------------------------------------------------------------- apply
     def _apply(self, data: dict) -> Any:
@@ -197,6 +209,8 @@ class MetaHARing(RaftSCM):
             # block allocation in preExecute produced SCM decision
             # records; the client ack covers them too
             self._await_records()
+        if self.push_commit_on_write:
+            self.node.push_commit()
         if isinstance(result, Exception):
             raise result
         return result

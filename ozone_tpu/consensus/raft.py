@@ -96,6 +96,10 @@ class RaftStorage:
         # Persisted with term/vote: a node must never forget a config it
         # acted on (Raft §4.1 — configs take effect when APPENDED).
         self.config_history: list[list] = []
+        # long-lived append handle: the hot path fsyncs every entry and
+        # must not also pay an open() per append; dropped whenever the
+        # log file is rewritten wholesale (truncate/compact/snapshot)
+        self._append_f = None
         self._load()
 
     def _load(self) -> None:
@@ -260,17 +264,27 @@ class RaftStorage:
         return None
 
     def append(self, entries: list[dict]) -> None:
-        fresh = (not self.log_path.exists()
-                 or self.log_path.stat().st_size == 0)
-        with open(self.log_path, "a") as f:
+        f = self._append_f
+        if f is None:
+            fresh = (not self.log_path.exists()
+                     or self.log_path.stat().st_size == 0)
+            f = self._append_f = open(self.log_path, "a")
             if fresh:  # stamp which point the positions count from
                 f.write(json.dumps({"_logstart": self.snapshot_index})
                         + "\n")
-            for e in entries:
-                f.write(json.dumps(e, separators=(",", ":")) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        for e in entries:
+            f.write(json.dumps(e, separators=(",", ":")) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
         self.entries.extend(entries)
+
+    def _drop_append_handle(self) -> None:
+        if self._append_f is not None:
+            self._append_f.close()
+            self._append_f = None
+
+    def close(self) -> None:
+        self._drop_append_handle()
 
     def truncate_from(self, index: int) -> None:
         """Drop entries at raft index >= index (conflict repair)."""
@@ -279,6 +293,7 @@ class RaftStorage:
             return
         self.truncate_configs_from(index)
         self.entries = self.entries[:keep]
+        self._drop_append_handle()
         self._write_durable(self.log_path, self._log_payload())
 
     def install_snapshot(self, index: int, term: int, data: Any,
@@ -301,6 +316,7 @@ class RaftStorage:
         # reconciled by _load; a stale snapshot next to a newer meta
         # marker is not recoverable)
         self.persist_snapshot()
+        self._drop_append_handle()
         if self.log_path.exists():
             self.log_path.unlink()
         self.persist_meta()
@@ -331,6 +347,7 @@ class RaftStorage:
         # overrides a stale meta, and _load drops log entries the
         # snapshot already covers.
         self.persist_snapshot()
+        self._drop_append_handle()
         self._write_durable(self.log_path, self._log_payload())
         self.persist_meta()
 
@@ -426,6 +443,11 @@ class RaftNode:
         self._waiters: set[int] = set()
         self._results: dict[int, Any] = {}
         self.on_step_down = on_step_down
+        #: follower read-lease renewal hook (om/sharding/leases.py wires
+        #: a metrics counter): called on every accepted append_entries,
+        #: under the node lock — must only bump counters, never call
+        #: back into this node
+        self.on_lease_renewal: Optional[Callable[[], None]] = None
         #: leadership hand-off in flight (§3.10): propose() refuses
         self._transferring = False
         #: index of this term's no-op marker (set on winning an election)
@@ -465,6 +487,7 @@ class RaftNode:
         if self._timer_thread:
             self._timer_thread.join(timeout=1.0)
             self._timer_thread = None
+        self.storage.close()
 
     def _new_deadline(self) -> float:
         lo, hi = self.config.election_timeout_s
@@ -1056,6 +1079,11 @@ class RaftNode:
             self.role = FOLLOWER
             self.leader_hint = req["leader_id"]
             self._last_heartbeat = time.monotonic()
+            if self.on_lease_renewal is not None:
+                try:
+                    self.on_lease_renewal()
+                except Exception:
+                    log.exception("on_lease_renewal callback failed")
             if self._timer_thread:
                 self._election_deadline = self._new_deadline()
 
@@ -1202,6 +1230,25 @@ class RaftNode:
         failover (a freshly elected leader may lag the old commit line)."""
         return self.role == LEADER and \
             self.last_applied >= self._leader_ready_index
+
+    def follower_lease_valid(self, lease_s: float) -> bool:
+        """True while this FOLLOWER's read lease is live: it heard an
+        accepted append_entries within `lease_s`. Sound only for
+        lease_s < min election timeout — within that window no other
+        node can have won an election this follower never voted in, so
+        no commit line exists that this replica is sealed off from
+        (om/sharding/leases.py holds the staleness argument)."""
+        return self.role == FOLLOWER and \
+            time.monotonic() - self._last_heartbeat < lease_s
+
+    def push_commit(self) -> None:
+        """Leader-side commit push: one immediate heartbeat so
+        followers learn the current commit index NOW instead of a
+        heartbeat interval later. The follower-read freshness check
+        (`min_applied`) would otherwise refuse every read issued within
+        ~heartbeat_interval_s of the write that preceded it."""
+        if self.role == LEADER:
+            self._broadcast_heartbeat()
 
 
 class Transport:

@@ -24,6 +24,13 @@ from ozone_tpu.net.om_service import OmGrpcService
 from ozone_tpu.net.rpc import RpcServer
 from ozone_tpu.net.scm_service import GrpcScmClient, ScmGrpcService
 from ozone_tpu.om.om import OzoneManager
+
+# registration side effect (OMRequest.__init_subclass__): any process
+# that may APPLY replicated sharding entries — a shard ring follower
+# replaying its log — must import the sharding request classes before
+# the first replay, or from_json cannot resolve them
+import ozone_tpu.om.sharding  # noqa: F401,E402
+
 from ozone_tpu.scm.replication_manager import (
     DeleteReplicaCommand,
     ReplicateCommand,
@@ -604,6 +611,8 @@ class ScmOmDaemon:
         enrollment_secret: str | None = None,
         insecure_secrets: bool = False,
         ca_address: str | None = None,
+        shard_config: dict | None = None,
+        shard_map: dict | None = None,
     ):
         self.scm = StorageContainerManager(
             min_datanodes=min_datanodes,
@@ -868,6 +877,28 @@ class ScmOmDaemon:
         self._ha_peers = dict(ha_peers or {})
         if ha_id is not None:
             self._init_ha(ha_id, Path(om_db).parent / "meta-raft")
+        # ---- sharded metadata plane (om/sharding) ----
+        # shard_config: this daemon's InstallShardConfig payload (epoch,
+        # shard_id, slot_count, owned) — the replicated ownership row its
+        # OM enforces via check_shard. shard_map: the root map json this
+        # daemon serves from GetShardMap so clients can discover the
+        # shard rings through any replica.
+        self._shard_config = shard_config
+        self._shard_map = shard_map
+        self._shard_installed = shard_config is None and shard_map is None
+        if not self._shard_installed:
+            from ozone_tpu.om.sharding.leases import follower_reads_enabled
+
+            if self.ha is None:
+                self._install_sharding()
+            else:
+                # HA: install needs a ready leader — deferred to the
+                # background loop's leader section (epoch guards make
+                # the replay-after-restart re-install idempotent)
+                if follower_reads_enabled():
+                    # fresh commit index per write so follower leases
+                    # serve read-your-writes without a heartbeat lag
+                    self.ha.push_commit_on_write = True
         from ozone_tpu.utils.insight import InsightService
 
         self.insight = InsightService(self.server, "scm-om")
@@ -1023,6 +1054,10 @@ class ScmOmDaemon:
         self.om_service.gate = self._leader_gate
         self.om_service.scm_barrier = lambda: self._ha_call(
             self.ha._await_records, "OM_NOT_LEADER")
+        # stamped on responses so shard-routing clients can carry a
+        # read-your-writes floor into lease-based follower reads
+        self.om_service.applied_index_fn = \
+            lambda: self.ha.node.last_applied
 
         def _scm_gate():
             if not self.ha.is_ready:
@@ -1084,15 +1119,49 @@ class ScmOmDaemon:
             self._lifecycle_clients.update_remote(dn_id, addr)
         return self._lifecycle_clients
 
-    def _leader_gate(self) -> None:
+    def _install_sharding(self) -> None:
+        """Install this daemon's shard ownership + the root map copy.
+
+        Single-node: at construction. HA: from the background loop once
+        this replica is the ready leader (the install replicates to
+        followers through the ring like any other OM request)."""
+        from ozone_tpu.om.sharding.shardmap import (
+            InstallShardConfig,
+            InstallShardMap,
+        )
+
+        if self._shard_config is not None:
+            self.om.submit(InstallShardConfig(**self._shard_config))
+        if self._shard_map is not None:
+            self.om.submit(InstallShardMap(dict(self._shard_map)))
+        self._shard_installed = True
+
+    def _leader_gate(self, verb: str | None = None,
+                     req: bytes | None = None) -> None:
         # ready-leader, not just leader: a freshly elected leader must
         # apply the prior terms' committed entries (its no-op marker)
         # before serving reads, or a failover client could read stale
         # state it wrote through the previous leader
-        if self.ha is not None and not self.ha.is_ready:
-            raise StorageError(
-                "OM_NOT_LEADER",
-                self._leader_address(self.ha.leader_hint))
+        if self.ha is None or self.ha.is_ready:
+            return
+        # lease-based follower reads (om/sharding/leases.py): a replica
+        # holding a live read lease answers read verbs locally, provided
+        # its applied state has reached the caller's floor — leader-read
+        # fallback happens client-side on the OM_NOT_LEADER bounce below
+        if verb is not None and req is not None:
+            from ozone_tpu.net import wire
+            from ozone_tpu.om.sharding.leases import (
+                follower_reads_enabled,
+            )
+
+            if follower_reads_enabled():
+                m, _ = wire.unpack(req)
+                floor = int(m.get("_min_applied") or 0)
+                if self.ha.read_gate.try_serve(verb, floor):
+                    return
+        raise StorageError(
+            "OM_NOT_LEADER",
+            self._leader_address(self.ha.leader_hint))
 
     def start(self) -> None:
         if self.enroll_server is not None:
@@ -1149,6 +1218,10 @@ class ScmOmDaemon:
                 # not starve the slow-cadence sweeps below
                 self._om_bg_ticks += 1
                 try:
+                    if not self._shard_installed:
+                        # deferred HA shard install: this replica just
+                        # became the ready leader
+                        self._install_sharding()
                     if self.ha is not None:
                         self.scm.run_background_once()
                     self.om.run_dir_deleting_service_once()

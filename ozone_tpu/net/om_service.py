@@ -44,6 +44,11 @@ class OmGrpcService:
         #: records produced by a direct allocation are quorum-committed
         #: (the OM-request path gets this inside MetaHARing.submit_om)
         self.scm_barrier = None
+        #: set by the HA daemon: callable returning this replica's
+        #: applied log index, stamped on responses as `_applied` so
+        #: shard-routing clients can carry a read-your-writes floor
+        #: into lease-based follower reads
+        self.applied_index_fn = None
         methods = {
                 "CreateVolume": self._wrap(lambda m: self.om.create_volume(m["volume"])),
                 "DeleteVolume": self._wrap(lambda m: self.om.delete_volume(m["volume"])),
@@ -360,14 +365,26 @@ class OmGrpcService:
                     lambda m: self.om.renew_delegation_token(m["token"])),
                 "CancelDelegationToken": self._wrap(
                     lambda m: self.om.cancel_delegation_token(m["token"])),
+                # sharded metadata plane (om/sharding): the root map,
+                # served by ANY replica — it is how a fresh client finds
+                # the shard rings in the first place, so it cannot be
+                # leader-gated
+                "GetShardMap": self._wrap(
+                    lambda m: self.om.store.get("system", "shard_map")),
         }
         server.add_service(
-            SERVICE, {n: self._gated(fn) for n, fn in methods.items()})
+            SERVICE, {n: self._gated(n, fn) for n, fn in methods.items()})
 
-    def _gated(self, fn):
+    #: verbs exempt from the HA leader gate (see GetShardMap above)
+    UNGATED = frozenset({"GetShardMap"})
+
+    def _gated(self, name: str, fn):
         def method(req: bytes) -> bytes:
-            if self.gate is not None:
-                self.gate()
+            if self.gate is not None and name not in self.UNGATED:
+                # verb-aware: the HA gate admits read verbs on followers
+                # holding a live lease (om/sharding/leases.py) and
+                # bounces everything else to the leader
+                self.gate(name, req)
             return fn(req)
 
         return method
@@ -402,6 +419,8 @@ class OmGrpcService:
             except OMError as e:
                 raise StorageError(e.code, e.msg)
             resp = {"result": out}
+            if self.applied_index_fn is not None:
+                resp["_applied"] = self.applied_index_fn()
             if with_addresses:
                 # located reads: the reference's OmKeyLocationInfo
                 # carries DatanodeDetails for the key's pipelines only,
@@ -509,7 +528,11 @@ class OmGrpcService:
                                hsync=bool(m.get("hsync")))
         except OMError as e:
             raise StorageError(e.code, e.msg)
-        return wire.pack({})
+        resp = {}
+        if self.applied_index_fn is not None:
+            # the floor-advancing write on the freon put path
+            resp["_applied"] = self.applied_index_fn()
+        return wire.pack(resp)
 
     def _recover_lease(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
@@ -546,7 +569,8 @@ class GrpcOmClient:
     (OMFailoverProxyProvider analog): calls stick to the known leader,
     follow OM_NOT_LEADER hints, and rotate on connection failure."""
 
-    def __init__(self, address: str, clients=None, tls=None, token=None):
+    def __init__(self, address: str, clients=None, tls=None, token=None,
+                 shard_aware: Optional[bool] = None):
         from ozone_tpu.net.rpc import FailoverChannels
 
         self._pool = FailoverChannels(address, tls=tls)
@@ -559,6 +583,14 @@ class GrpcOmClient:
         #: delegation token attached to every call — the authenticated
         #: identity path (jobs present the token instead of _user)
         self._token = token
+        #: client-side shard routing (om/sharding/router.py): None =
+        #: auto-discover via GetShardMap on first use (a deployment
+        #: without a shard map costs one extra RPC, once), False =
+        #: never route, True = require a routable map
+        self._shard_aware = shard_aware
+        self._router = None
+        self._router_checked = shard_aware is False
+        self._router_lock = threading.Lock()
 
     def use_token(self, token) -> None:
         self._token = token
@@ -580,8 +612,38 @@ class GrpcOmClient:
 
         return _ctx()
 
-    def _call(self, method: str, **meta) -> dict:
+    def _ensure_router(self) -> None:
+        """One-shot shard-map discovery (see __init__)."""
+        with self._router_lock:
+            if self._router_checked:
+                return
+            self._router_checked = True
+            try:
+                mj = self._call("GetShardMap", _pool=self._pool)["result"]
+            except StorageError:
+                mj = None  # pre-sharding server or transient: stay flat
+            if mj and any(mj.get("addresses", {}).values()):
+                from ozone_tpu.om.sharding.router import ShardRouter
+
+                self._router = ShardRouter(mj, tls=self.tls)
+            elif self._shard_aware is True:
+                raise StorageError(
+                    "INVALID",
+                    "shard_aware=True but the server has no routable "
+                    "shard map")
+
+    def _refresh_shard_map(self) -> None:
+        """SHARD_MOVED invalidation: refetch the map, adopt it."""
+        from ozone_tpu.om.sharding.router import METRICS
+
+        METRICS.counter("moved_rejections").inc()
+        mj = self._call("GetShardMap", _pool=self._pool)["result"]
+        if mj and self._router is not None:
+            self._router.update_map(mj)
+
+    def _call(self, method: str, _pool=None, **meta) -> dict:
         from ozone_tpu.client import resilience
+        from ozone_tpu.om.sharding.shardmap import SHARD_MOVED
 
         ident = getattr(self._caller, "identity", None)
         if ident is not None and ident[0] is not None:
@@ -589,6 +651,22 @@ class GrpcOmClient:
             meta.setdefault("_groups", list(ident[1]))
         if self._token is not None:
             meta.setdefault("_dtoken", self._token)
+        if _pool is None and not self._router_checked:
+            self._ensure_router()
+        # shard routing: bucket-addressed verbs go to the owning ring
+        sid = None
+        pool = _pool
+        if pool is None and self._router is not None:
+            sid, pool = self._router.route(method, meta)
+        if pool is None:
+            pool = self._pool
+        # lease-based follower reads: spread read verbs over the shard's
+        # replicas; a follower without a live lease (or behind the
+        # caller's floor) bounces OM_NOT_LEADER and the retry below
+        # falls back to the leader
+        read_addr = None
+        if sid is not None and "_min_applied" in meta:
+            read_addr = self._router.read_address(sid)
         payload = wire.pack(meta)
         last: Exception | None = None
         attempts = max(4, 3 * len(self.addresses))
@@ -596,27 +674,44 @@ class GrpcOmClient:
         # tuning (and its outlive-the-election rationale) lives there,
         # shared with the SCM client
         policy = resilience.failover_retry_policy(attempts)
+        moved_retried = False
         for attempt in range(attempts):
-            addr, ch = self._pool.channel()
+            if read_addr is not None and attempt == 0:
+                addr, ch = pool.channel(read_addr)
+            else:
+                addr, ch = pool.channel()
             try:
                 m, _ = wire.unpack(ch.call(SERVICE, method, payload))
-                self.address = addr
+                if sid is None:
+                    self.address = addr
+                elif self._router is not None:
+                    self._router.observe(sid, m)
                 return m
             except StorageError as e:
                 last = e
                 if e.code == "OM_NOT_LEADER":
                     # msg carries the leader address when known
-                    self._pool.follow_hint(e.msg)
+                    pool.follow_hint(e.msg)
+                elif e.code == SHARD_MOVED and sid is not None \
+                        and not moved_retried:
+                    # stale shard map: the rejection is the cache
+                    # invalidation — refetch, re-route, retry once
+                    moved_retried = True
+                    self._refresh_shard_map()
+                    new_sid, new_pool = self._router.route(method, meta)
+                    if new_pool is not None:
+                        sid, pool = new_sid, new_pool
+                        payload = wire.pack(meta)  # _min_applied moved
                 elif e.code == "UNAVAILABLE":
                     # replica unreachable: drop its (possibly wedged)
                     # channel and rotate. Server-side errors
                     # (IO_EXCEPTION and application codes) surface —
                     # blind retry would re-execute non-idempotent writes
                     # and mask the real failure
-                    self._pool.invalidate(addr)
-                    if len(self.addresses) == 1:
+                    pool.invalidate(addr)
+                    if len(pool.addresses) == 1:
                         raise
-                    self._pool.rotate()
+                    pool.rotate()
                 else:
                     raise
             if not policy.sleep(attempt):
@@ -629,9 +724,30 @@ class GrpcOmClient:
 
     # namespace
     def create_volume(self, volume, owner="root"):
+        if not self._router_checked:
+            self._ensure_router()
+        if self._router is not None:
+            # volumes exist on EVERY shard (any shard may own buckets
+            # of any volume) — fan the create out
+            for pool in self._router.pools.values():
+                self._call("CreateVolume", _pool=pool, volume=volume)
+            return
         self._call("CreateVolume", volume=volume)
 
     def delete_volume(self, volume):
+        if not self._router_checked:
+            self._ensure_router()
+        if self._router is not None:
+            # check-all THEN delete-all: each shard's DeleteVolume only
+            # sees its own buckets, so a one-pass delete could remove
+            # the volume from empty shards and then fail
+            for pool in self._router.pools.values():
+                if self._call("ListBuckets", _pool=pool,
+                              volume=volume)["result"]:
+                    raise StorageError("VOLUME_NOT_EMPTY", volume)
+            for pool in self._router.pools.values():
+                self._call("DeleteVolume", _pool=pool, volume=volume)
+            return
         self._call("DeleteVolume", volume=volume)
 
     def set_volume_owner(self, volume, owner):
@@ -677,7 +793,19 @@ class GrpcOmClient:
         return self._call("BucketInfo", volume=volume, bucket=bucket)["result"]
 
     def list_buckets(self, volume):
+        if not self._router_checked:
+            self._ensure_router()
+        if self._router is not None:
+            out = []
+            for pool in self._router.pools.values():
+                out.extend(self._call("ListBuckets", _pool=pool,
+                                      volume=volume)["result"])
+            return sorted(out, key=lambda b: b["name"])
         return self._call("ListBuckets", volume=volume)["result"]
+
+    def get_shard_map(self):
+        """The root shard map row, or None on unsharded deployments."""
+        return self._call("GetShardMap")["result"]
 
     # keys
     def open_key(self, volume, bucket, key, replication=None,
@@ -1026,4 +1154,6 @@ class GrpcOmClient:
         return self._call("PrepareStatus")["result"]
 
     def close(self):
+        if self._router is not None:
+            self._router.close()
         self._pool.close()

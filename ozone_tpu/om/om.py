@@ -30,6 +30,7 @@ from ozone_tpu.om.metadata import (
     key_key,
     volume_key,
 )
+from ozone_tpu.om.sharding import shardmap as _shardmap
 from ozone_tpu.scm.pipeline import ReplicationConfig
 from ozone_tpu.scm.scm import StorageContainerManager
 from ozone_tpu.storage.ids import StorageError
@@ -395,18 +396,31 @@ class OzoneManager:
         gdpr: bool = False,
     ) -> None:
         self.check_access(volume, None, None, "CREATE")
+        self.check_shard(volume, bucket)
         self.submit(rq.CreateBucket(volume, bucket, replication, layout,
                                     encryption_key=encryption_key,
                                     gdpr=gdpr))
 
     def create_bucket_link(self, src_volume: str, src_bucket: str,
                            volume: str, bucket: str) -> None:
-        """Create a link bucket aliasing src (ozone sh bucket link)."""
+        """Create a link bucket aliasing src (ozone sh bucket link).
+        On a sharded plane, a link whose source hashes to ANOTHER shard
+        must instead go through the cross-shard 2PC
+        (sharding/txn.link_bucket_cross) — this single-ring path gates
+        on the link's own shard and validates the source locally."""
         self.check_access(volume, None, None, "CREATE")
+        self.check_shard(volume, bucket)
         self.submit(rq.CreateBucket(
             volume, bucket,
             source_volume=src_volume, source_bucket=src_bucket,
         ))
+
+    def check_shard(self, volume: str, bucket: str) -> None:
+        """Shard-ownership gate (sharding/shardmap.py): raises
+        SHARD_MOVED when this replica's replicated shard config does
+        not own the (volume, bucket) slot. A no-op (one cached `system`
+        row get) on unsharded deployments."""
+        _shardmap.check_shard(self.store, volume, bucket)
 
     def resolve_bucket(self, volume: str, bucket: str) -> tuple[str, str]:
         """Follow link-bucket chains to the real bucket (reference
@@ -414,6 +428,7 @@ class OzoneManager:
         link's source is missing or the chain loops."""
         seen = set()
         while True:
+            self.check_shard(volume, bucket)
             row = self.store.get("buckets", bucket_key(volume, bucket))
             if row is None:
                 if seen:  # we got here by following a link

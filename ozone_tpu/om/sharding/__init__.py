@@ -1,0 +1,36 @@
+"""Sharded metadata plane: hash-partitioned OM rings under a root map.
+
+Layout (ROADMAP open item 3; Azure Storage ATC '12 partition layer +
+f4 OSDI '14 off-leader reads, applied to the jax_graft OM):
+
+- `shardmap.py`  — slot hashing, the epoch-numbered root shard map,
+                   per-shard replicated ownership config, SHARD_MOVED.
+- `txn.py`       — two-phase cross-shard rename / bucket link with a
+                   root-ring coordinator journal and crash recovery.
+- `leases.py`    — lease-based follower reads (gate + knobs).
+- `plane.py`     — in-process sharded plane + ShardedOm facade
+                   (minicluster boot, bench, failure drills).
+- `router.py`    — client-side shard-map cache and routing.
+
+Importing this package registers the sharding OMRequest subclasses, so
+any process that may APPLY replicated sharding entries (daemons,
+followers) must import it before its first log replay — daemons.py does
+this at module import.
+"""
+
+from ozone_tpu.utils.metrics import registry
+
+#: the om.shard.* observability family (pinned in test_observability)
+METRICS = registry("om.shard")
+
+# request registration side effects (OMRequest.__init_subclass__)
+from ozone_tpu.om.sharding import leases, shardmap, txn  # noqa: E402,F401
+from ozone_tpu.om.sharding.shardmap import (  # noqa: E402
+    SHARD_MOVED,
+    SLOT_COUNT,
+    ShardMap,
+    slot_for,
+)
+
+__all__ = ["METRICS", "SHARD_MOVED", "SLOT_COUNT", "ShardMap",
+           "slot_for", "leases", "shardmap", "txn"]

@@ -742,6 +742,10 @@ class ReconServer:
                     # coalescing and spill accounting (the fleet
                     # reconstruction/bulk-tiering datapath's health)
                     "/api/mesh": recon.mesh_view,
+                    # sharded metadata plane: this OM's shard config,
+                    # the root shard map (when this OM hosts it), and
+                    # the routing / 2PC / follower-read counters
+                    "/api/shards": recon.shard_view,
                     # slow-request flight recorder: retained
                     # over-SLO traces; ?id=<traceId> returns the full
                     # span set + critical path for one trace
@@ -841,6 +845,37 @@ class ReconServer:
                     "spill_enabled": mesh_executor.spill_enabled(),
                     "spill_watermark": mesh_executor.spill_watermark()}
         return ex.stats()
+
+    def shard_view(self) -> dict:
+        """Sharded metadata plane snapshot for the dashboard panel: the
+        local OM's replicated `system/shard_config` row (which slots
+        this ring owns, at which epoch), the root shard map when this
+        OM hosts it, and the om.shard counter family (routes, moved
+        rejections, cross-shard 2PC outcomes, follower-read hit/miss,
+        lease renewals). PEEKS at store rows and the shared registry —
+        a monitoring GET never installs or mutates shard state."""
+        from ozone_tpu.utils.metrics import registry
+
+        store = self.tasks.om.store
+        cfg = store.get("system", "shard_config")
+        mj = store.get("system", "shard_map")
+        out: dict = {"sharded": cfg is not None or mj is not None,
+                     "counters": registry("om.shard").snapshot()}
+        if cfg is not None:
+            out["config"] = {"epoch": cfg["epoch"],
+                             "shard_id": cfg["shard_id"],
+                             "slot_count": cfg["slot_count"],
+                             "owned_slots": len(cfg["owned"])}
+        if mj is not None:
+            counts: dict[str, int] = {}
+            for idx in mj["slots"]:
+                sid = mj["shards"][idx]
+                counts[sid] = counts.get(sid, 0) + 1
+            out["map"] = {"epoch": mj["epoch"],
+                          "slot_count": len(mj["slots"]),
+                          "slots_per_shard": counts,
+                          "addresses": dict(mj.get("addresses") or {})}
+        return out
 
     def replication_view(self) -> dict:
         """Geo-replication shipper status + per-bucket rule census for

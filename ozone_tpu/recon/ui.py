@@ -225,6 +225,18 @@ RECON_INDEX_HTML = """<!doctype html>
     service</div>
   <div class="tiles" id="mesh-tiles"></div>
 
+  <h2>Shard map</h2>
+  <div class="sub">sharded metadata plane: hash-partitioned OM rings
+    behind an epoch-numbered root shard map &mdash; routing volume,
+    moved-slot rejections, cross-shard 2PC outcomes, follower-read
+    hit rate</div>
+  <div class="tiles" id="shard-tiles"></div>
+  <table id="shard-owners">
+    <thead><tr><th>shard</th><th>slots owned</th><th>addresses</th>
+      </tr></thead>
+    <tbody></tbody>
+  </table>
+
   <h2>Slow requests</h2>
   <div class="sub">flight recorder: traces retained past their per-op
     SLO &mdash; click a trace for its critical path (stage &rarr;
@@ -447,6 +459,34 @@ async function refresh() {
       tile("spilled stripes", mx.spilled_stripes ?? 0),
       tile("spill", mx.spill_enabled ? "on" : "off"),
     ].join("");
+    const sh = await (await fetch("/api/shards")).json();
+    const sc = sh.counters || {};
+    const frTotal = (sc.follower_read_hits ?? 0) +
+                    (sc.follower_read_misses ?? 0);
+    document.getElementById("shard-tiles").innerHTML =
+      sh.sharded === false
+        ? tile("shard plane", "unsharded")
+        : [
+      tile("map epoch", sh.map?.epoch ?? sh.config?.epoch ?? 0),
+      tile("slots", sh.map?.slot_count ?? sh.config?.slot_count ?? 0),
+      tile("owned here", sh.config?.owned_slots ?? 0),
+      tile("routes", sc.routes ?? 0),
+      tile("moved rejections", sc.moved_rejections ?? 0),
+      tile("2PC prepares", sc.cross_shard_prepares ?? 0),
+      tile("2PC commits", sc.cross_shard_commits ?? 0),
+      tile("2PC aborts", sc.cross_shard_aborts ?? 0),
+      tile("follower-read hit", frTotal
+           ? `${Math.round(100 * (sc.follower_read_hits ?? 0)
+                           / frTotal)}%` : "n/a"),
+      tile("lease renewals", sc.lease_renewals ?? 0),
+    ].join("");
+    document.querySelector("#shard-owners tbody").innerHTML =
+      Object.entries(sh.map?.slots_per_shard || {})
+        .map(([sid, n]) =>
+          `<tr><td>${esc(sid)}</td><td>${esc(n)}</td>` +
+          `<td>${esc((sh.map?.addresses || {})[sid] || "")}</td></tr>`)
+        .join("") ||
+      '<tr><td colspan="3">no root shard map on this OM</td></tr>';
     const sl = await (await fetch("/api/traces/slow")).json();
     document.querySelector("#slow-traces tbody").innerHTML =
       (sl.traces || []).map(t =>

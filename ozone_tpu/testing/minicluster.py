@@ -311,6 +311,104 @@ class MiniOzoneHACluster:
         self.datanodes = []
 
 
+class MiniShardedCluster:
+    """Sharded metadata plane over real gRPC: one single-replica
+    ScmOmDaemon per shard, each booted with its replicated
+    InstallShardConfig ownership row and a copy of the root shard map
+    (served ungated via GetShardMap), plus shard-aware GrpcOmClients
+    that route by the cached map and retry through SHARD_MOVED.
+
+    Metadata-only by design: each daemon embeds its own SCM, so block
+    allocation across shards would hand out colliding container ids —
+    data-path drills run on the in-process ShardedMetaPlane, which
+    shares one SCM (om/sharding/plane.py).
+    """
+
+    def __init__(self, root: Path, n_shards: int = 2,
+                 slot_count: int = 64, block_size: int = 256 * 1024):
+        from ozone_tpu.net.daemons import ScmOmDaemon
+        from ozone_tpu.om.sharding.shardmap import ShardMap
+
+        self.root = Path(root)
+        self.shard_ids = [f"s{i}" for i in range(n_shards)]
+        addresses = {
+            sid: f"127.0.0.1:{p}"
+            for sid, p in zip(self.shard_ids, free_ports(n_shards))
+        }
+        self.map = ShardMap.uniform(self.shard_ids, epoch=1,
+                                    addresses=addresses,
+                                    slot_count=slot_count)
+        self.daemons: dict[str, ScmOmDaemon] = {}
+        for sid in self.shard_ids:
+            d = ScmOmDaemon(
+                self.root / sid / "om.db",
+                port=int(addresses[sid].rsplit(":", 1)[1]),
+                block_size=block_size,
+                stale_after_s=1000.0,
+                dead_after_s=2000.0,
+                background_interval_s=0.2,
+                shard_config={
+                    "epoch": 1, "shard_id": sid,
+                    "slot_count": slot_count,
+                    "owned": self.map.owned_slots(sid),
+                },
+                shard_map=self.map.to_json(),
+            )
+            d.start()
+            self.daemons[sid] = d
+
+    def om_client(self):
+        """A shard-aware remote OM client (discovers the map itself)."""
+        from ozone_tpu.net.om_service import GrpcOmClient
+
+        return GrpcOmClient(",".join(self.map.addresses.values()),
+                            shard_aware=True)
+
+    def move_slot(self, slot: int, to_sid: str):
+        """Operator rebalance: fence the source, copy the slot's rows,
+        grant the target, publish the bumped map on every daemon.
+        Clients holding the old map get SHARD_MOVED and refetch."""
+        from ozone_tpu.om.sharding.shardmap import (
+            ImportRow,
+            InstallShardConfig,
+            InstallShardMap,
+            slot_for,
+        )
+
+        new_map = self.map.move_slot(slot, to_sid)
+        from_sid = self.map.shards[self.map.slots[slot]]
+        src, dst = self.daemons[from_sid].om, self.daemons[to_sid].om
+        src.submit(InstallShardConfig(
+            epoch=new_map.epoch, shard_id=from_sid,
+            slot_count=new_map.slot_count,
+            owned=new_map.owned_slots(from_sid)))
+        for vk, _ in list(src.store.iterate("volumes")):
+            for bk, brow in list(src.store.iterate("buckets", vk + "/")):
+                if slot_for(brow["volume"], brow["name"],
+                            new_map.slot_count) != slot:
+                    continue
+                dst.submit(ImportRow("buckets", bk, brow))
+                for table in ("keys", "open_keys", "deleted_keys",
+                              "multipart", "dirs", "files",
+                              "deleted_dirs"):
+                    for k, row in list(src.store.iterate(table,
+                                                         bk + "/")):
+                        dst.submit(ImportRow(table, k, row))
+        dst.submit(InstallShardConfig(
+            epoch=new_map.epoch, shard_id=to_sid,
+            slot_count=new_map.slot_count,
+            owned=new_map.owned_slots(to_sid)))
+        for d in self.daemons.values():
+            d.om.submit(InstallShardMap(new_map.to_json()))
+        self.map = new_map
+        return new_map
+
+    def shutdown(self) -> None:
+        for d in self.daemons.values():
+            d.stop()
+        self.daemons.clear()
+
+
 def make_meta_daemon(tmp_path, i: int, peers: dict, **overrides):
     """One metadata-ring replica (ScmOmDaemon) with test-friendly
     defaults; peers maps 'm<i>' -> host:port. Shared by the HA suites."""

@@ -639,6 +639,36 @@ def cmd_admin(args) -> int:
             return usage(f"unknown om verb {verb!r} "
                          "(expected prepare|cancelprepare|status|"
                          "list-open-files)")
+    elif subject == "shards":
+        # sharded metadata plane: show the root shard map (epoch,
+        # slot ownership, address book) as any routing client sees it
+        from ozone_tpu.net.om_service import GrpcOmClient
+        from ozone_tpu.om.sharding.shardmap import ShardMap
+
+        om = GrpcOmClient(args.om, tls=_client_tls(), shard_aware=False)
+        try:
+            if verb in (None, "map", "status"):
+                mj = om.get_shard_map()
+                if not mj:
+                    print("no shard map installed (unsharded deployment)")
+                    return 0
+                m = ShardMap.from_json(mj)
+                counts: dict[str, int] = {}
+                for idx in m.slots:
+                    sid = m.shards[idx]
+                    counts[sid] = counts.get(sid, 0) + 1
+                _emit({
+                    "epoch": m.epoch,
+                    "slot_count": len(m.slots),
+                    "shards": sorted(counts),
+                    "slots_per_shard": counts,
+                    "addresses": m.addresses,
+                })
+            else:
+                return usage(f"unknown shards verb {verb!r} "
+                             "(expected map|status)")
+        finally:
+            om.close()
     elif subject == "namespace":
         # `ozone admin namespace summary <path>` analog: per-directory
         # du / entity counts from Recon's NSSummary warehouse
@@ -1557,6 +1587,7 @@ def build_parser() -> argparse.ArgumentParser:
         "safemode", "datanode", "status", "pipeline", "container",
         "balancer", "replicationmanager", "om", "finalizeupgrade",
         "upgrade", "ring", "kms", "cert", "reconfig", "namespace",
+        "shards",
     ])
     ad.add_argument("verb", nargs="?", default=None,
                     help="safemode: enter|exit; datanode: decommission|"

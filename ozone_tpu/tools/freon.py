@@ -517,21 +517,36 @@ def dsg(
     return BaseFreonGenerator("dsg", n_blocks, threads).run(op)
 
 
-def omkg(client, n_keys: int = 1000, threads: int = 8,
-         volume: str = "freon-vol", bucket: str = "freon-meta") -> FreonReport:
-    """Pure OM metadata op generator: open+commit empty keys without any
-    datanode IO (OmKeyGenerator analog — measures namespace throughput)."""
+def _freon_buckets(client, volume: str, bucket: str,
+                   buckets: int) -> list[str]:
+    """Create the generator's bucket set. buckets > 1 spreads ops over
+    `bucket-<j>` names — on a sharded metadata plane the (volume,
+    bucket) hash then fans the load across shard rings instead of
+    serializing everything on one ring's slot."""
     try:
         client.om.create_volume(volume)
     except Exception:
         pass
-    try:
-        client.om.create_bucket(volume, bucket)
-    except Exception:
-        pass
+    names = ([bucket] if buckets <= 1
+             else [f"{bucket}-{j}" for j in range(buckets)])
+    for name in names:
+        try:
+            client.om.create_bucket(volume, name)
+        except Exception:
+            pass
+    return names
+
+
+def omkg(client, n_keys: int = 1000, threads: int = 8,
+         volume: str = "freon-vol", bucket: str = "freon-meta",
+         buckets: int = 1) -> FreonReport:
+    """Pure OM metadata op generator: open+commit empty keys without any
+    datanode IO (OmKeyGenerator analog — measures namespace throughput)."""
+    names = _freon_buckets(client, volume, bucket, buckets)
 
     def op(i: int) -> int:
-        s = client.om.open_key(volume, bucket, f"meta-{i}")
+        b = names[i % len(names)]
+        s = client.om.open_key(volume, b, f"meta-{i}")
         client.om.commit_key(s, [], 0)
         return 0
 
@@ -727,42 +742,38 @@ def dbgen(db_path, n_keys: int = 10_000, volume: str = "genvol",
 
 def ommg(client, n_ops: int = 1000, threads: int = 8,
          volume: str = "freon-vol", bucket: str = "freon-meta",
-         mix: str = "crudl") -> FreonReport:
+         mix: str = "crudl", buckets: int = 1) -> FreonReport:
     """Mixed OM metadata ops (OmMetadataGenerator analog): cycles
     create/read(lookup)/update(rename)/delete/list per the mix string."""
     bad = set(mix) - set("crudl")
     if not mix or bad:
         raise ValueError(f"mix must be chars from 'crudl', got {mix!r}")
-    try:
-        client.om.create_volume(volume)
-    except Exception:
-        pass
-    try:
-        client.om.create_bucket(volume, bucket)
-    except Exception:
-        pass
-    # seed keys the read/delete ops can hit
-    for i in range(min(64, n_ops)):
-        s = client.om.open_key(volume, bucket, f"mix-{i}")
-        client.om.commit_key(s, [], 0)
+    names = _freon_buckets(client, volume, bucket, buckets)
+    # seed keys the read/delete ops can hit (every bucket gets the full
+    # seed set: op i addresses bucket i % len(names))
+    for name in names:
+        for i in range(min(64, n_ops)):
+            s = client.om.open_key(volume, name, f"mix-{i}")
+            client.om.commit_key(s, [], 0)
 
     def op(i: int) -> int:
         kind = mix[i % len(mix)]
+        b = names[i % len(names)]
         name = f"mix-{i % 64}"
         if kind == "c":
-            s = client.om.open_key(volume, bucket, f"mix-new-{i}")
+            s = client.om.open_key(volume, b, f"mix-new-{i}")
             client.om.commit_key(s, [], 0)
         elif kind == "r":
-            client.om.lookup_key(volume, bucket, name)
+            client.om.lookup_key(volume, b, name)
         elif kind == "u":
-            client.om.rename_key(volume, bucket, name, name + ".r")
-            client.om.rename_key(volume, bucket, name + ".r", name)
+            client.om.rename_key(volume, b, name, name + ".r")
+            client.om.rename_key(volume, b, name + ".r", name)
         elif kind == "d":
-            s = client.om.open_key(volume, bucket, f"mix-del-{i}")
+            s = client.om.open_key(volume, b, f"mix-del-{i}")
             client.om.commit_key(s, [], 0)
-            client.om.delete_key(volume, bucket, f"mix-del-{i}")
+            client.om.delete_key(volume, b, f"mix-del-{i}")
         elif kind == "l":
-            client.om.list_keys(volume, bucket, "mix-")
+            client.om.list_keys(volume, b, "mix-")
         return 0
 
     return BaseFreonGenerator("ommg", n_ops, threads).run(op)

@@ -215,7 +215,10 @@ class DeadlinePropagation(Rule):
             return  # the implementation of the discipline itself
         in_scope = (src.in_dirs("client", "net", "lifecycle",
                                 "replication_geo")
-                    or src.is_module("codec", "service.py"))
+                    or src.is_module("codec", "service.py")
+                    # the sharded metadata plane retries through ring
+                    # failovers — its waits must be deadline-derived
+                    or src.module_parts[:2] == ("om", "sharding"))
         module_env = _ConstEnv()
         _collect_env(src.tree.body, module_env, recurse=False)
         # per-function env memo, scoped to THIS check pass: fn nodes
@@ -449,7 +452,9 @@ class FenceCarryingCommit(Rule):
         "GeoCheckpoint must carry `term`; "
         "CommitKey/CommitFile/DeleteKey must carry "
         "`expect_object_id` (\"\" only where unfenced semantics are the "
-        "documented API, with an ozlint suppression saying why).")
+        "documented API, with an ozlint suppression saying why); the "
+        "cross-shard 2PC verbs (ShardPrepare/ShardCommit/ShardAbort) "
+        "must carry the coordinator's shard-map `epoch`.")
 
     #: constructor -> (required kwarg, positional index or None)
     FENCED = {
@@ -458,6 +463,12 @@ class FenceCarryingCommit(Rule):
         "CommitKey": ("expect_object_id", None),
         "CommitFile": ("expect_object_id", None),
         "DeleteKey": ("expect_object_id", None),
+        # cross-shard 2PC verbs: every phase record must carry the
+        # coordinator's shard-map epoch (prepare fences on it; commit/
+        # abort record it for the audit trail)
+        "ShardPrepare": ("epoch", 3),
+        "ShardCommit": ("epoch", 1),
+        "ShardAbort": ("epoch", 1),
     }
 
     def check(self, src: SourceFile) -> Iterable[Finding]:

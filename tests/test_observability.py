@@ -155,6 +155,16 @@ def test_prometheus_text_golden_every_registry_renders():
     GEO.gauge("lag_entries").set(0)
     GEO.gauge("lag_seconds").set(0.0)
     GEO.timer("ship_seconds").update(0.0)
+    # the sharded-metadata-plane family (docs/OPERATIONS.md "Sharded
+    # metadata plane"): routing, 2PC, and follower-read counters the
+    # Recon shard panel keys on
+    from ozone_tpu.om.sharding.plane import METRICS as SHARD
+
+    for name in ("routes", "moved_rejections", "cross_shard_prepares",
+                 "cross_shard_commits", "cross_shard_aborts",
+                 "follower_read_hits", "follower_read_misses",
+                 "lease_renewals", "slots_migrated"):
+        SHARD.counter(name).inc(0)
     text = m.prometheus_text()
     lines = text.splitlines()
     name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -212,7 +222,14 @@ def test_prometheus_text_golden_every_registry_renders():
                  "replication_leader_fences", "replication_bootstraps",
                  "replication_journal_gaps", "replication_cycles",
                  "replication_lag_entries", "replication_lag_seconds",
-                 "replication_ship_seconds"):
+                 "replication_ship_seconds",
+                 "om_shard_routes", "om_shard_moved_rejections",
+                 "om_shard_cross_shard_prepares",
+                 "om_shard_cross_shard_commits",
+                 "om_shard_cross_shard_aborts",
+                 "om_shard_follower_read_hits",
+                 "om_shard_follower_read_misses",
+                 "om_shard_lease_renewals", "om_shard_slots_migrated"):
         stem = want.removesuffix("_seconds")
         assert any(s.startswith(stem) for s in seen_metrics), want
     assert "# TYPE client_resilience_deadline_exceeded counter" in text
@@ -223,6 +240,8 @@ def test_prometheus_text_golden_every_registry_renders():
     assert "# TYPE replication_keys_shipped counter" in text
     assert "# TYPE replication_lag_entries gauge" in text
     assert "# HELP replication_lag_seconds " in text
+    assert "# TYPE om_shard_routes counter" in text
+    assert "# HELP om_shard_follower_read_hits " in text
     # -- histogram exposition: the hot-path latency families render
     # Prometheus histograms with cumulative buckets, _sum, and _count
     for fam in ("codec_service_queue_wait_seconds",

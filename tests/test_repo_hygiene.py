@@ -1,0 +1,29 @@
+"""Repo hygiene: build artifacts must never be tracked.
+
+A `__pycache__` directory committed alongside source (PR 15 removed a
+batch of them) poisons review diffs and ships stale bytecode that
+shadows edited modules on some import paths; this pins the cleanup."""
+
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _tracked() -> list[str]:
+    out = subprocess.run(
+        ["git", "ls-files"], cwd=REPO, capture_output=True, text=True)
+    if out.returncode != 0:  # not a git checkout (sdist, vendored copy)
+        return []
+    return out.stdout.splitlines()
+
+
+def test_no_bytecode_tracked():
+    bad = [f for f in _tracked()
+           if "__pycache__" in f or f.endswith((".pyc", ".pyo"))]
+    assert not bad, f"bytecode artifacts tracked in git: {bad[:10]}"
+
+
+def test_gitignore_covers_bytecode():
+    text = (REPO / ".gitignore").read_text()
+    assert "__pycache__" in text and "*.pyc" in text
