@@ -60,7 +60,8 @@ _STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None,
                 "mesh_scaling": None, "mesh_skipped": None,
                 "meta_ops": None, "meta_scaling": None,
                 "meta_proc_ops": None, "meta_proc_scaling": None,
-                "meta_follower_hit": None}
+                "meta_follower_hit": None,
+                "e2e_put": None, "e2e_get": None, "e2e_copies": None}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
@@ -163,6 +164,10 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
             line["meta_proc_scaling_4x"] = _STATE["meta_proc_scaling"]
         if _STATE["meta_follower_hit"] is not None:
             line["meta_follower_hit_rate"] = _STATE["meta_follower_hit"]
+        if _STATE["e2e_put"] is not None:
+            line["e2e_put_gib_s"] = round(_STATE["e2e_put"], 3)
+            line["e2e_get_gib_s"] = round(_STATE["e2e_get"], 3)
+            line["host_copies_per_chunk"] = round(_STATE["e2e_copies"], 3)
         lat = tail_latencies_ms()
         if lat:
             line["latency_ms"] = lat
@@ -732,6 +737,93 @@ def bench_tiering(n_keys: int = 6, key_mib: int = 16,
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_e2e_datapath(chunk_mib: int = 4, n_chunks: int = 16,
+                       rounds: int = 5):
+    """In-process single-stream PUT/GET through the zero-copy native
+    datapath (pooled recv slabs, gathered sendmsg, server readv/mmap+
+    writev): one datanode + sidecar on loopback, one client streaming
+    `n_chunks` x `chunk_mib` MiB per op. Reports GiB/s medians plus
+    host_copies_per_chunk from the codec/hostmem.py copy-accounting
+    registry (the zero-copy contract: <= 1, steady state 0). None when
+    the native toolchain is unavailable."""
+    import shutil
+    import statistics
+    import tempfile
+    from pathlib import Path
+
+    from ozone_tpu.client.native_dn import NativeDatanodeClient
+    from ozone_tpu.codec import hostmem
+    from ozone_tpu.net.dn_service import DatanodeGrpcService
+    from ozone_tpu.net.rpc import RpcServer
+    from ozone_tpu.storage.datanode import Datanode
+    from ozone_tpu.storage.fast_datapath import DatapathSidecar, load_lib
+    from ozone_tpu.storage.ids import BlockData, BlockID, ChunkInfo
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    if load_lib() is None:
+        log("  e2e datapath bench skipped: no native toolchain")
+        return None
+    # page-cache-resident store: this bench measures the WIRE datapath
+    # (pooled slabs, gathered sendmsg, sendfile, CRC), not the disk
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+    tmp = Path(tempfile.mkdtemp(prefix="ozone-bench-dp-", dir=base))
+    dn = Datanode(tmp / "dn", dn_id="dn0")
+    dn.create_container(1)
+    server = RpcServer()
+    sidecar = DatapathSidecar(dn)
+    assert sidecar.start() is not None
+    DatanodeGrpcService(dn, server, datapath_port=sidecar.advertise)
+    server.start()
+    client = NativeDatanodeClient("dn0", server.address)
+    try:
+        size = chunk_mib << 20
+        data = np.random.default_rng(7).integers(0, 256, size,
+                                                 dtype=np.uint8)
+        cs = Checksum(ChecksumType.CRC32C, 16 * 1024).compute(data)
+        gib = n_chunks * size / 2**30
+        # steady state: rounds overwrite ONE block in place, the way a
+        # hot store runs — file pages, pool slabs and arena buffers are
+        # all recycled, so the numbers measure the datapath rather than
+        # first-touch page faults. Two untimed warmup rounds get every
+        # pool to its plateau.
+        bid = BlockID(1, 1)
+        infos = [ChunkInfo(f"c{j}", j * size, size, cs)
+                 for j in range(n_chunks)]
+        pairs = [(i, data) for i in infos]
+        put_rates, get_rates = [], []
+        for _ in range(2):
+            client.write_chunks_commit(bid, pairs,
+                                       commit=BlockData(bid, infos))
+            client.read_chunks(bid, infos, verify=True)
+        c0 = hostmem._COPIES.value
+        for r in range(rounds):
+            t0 = time.time()
+            client.write_chunks_commit(bid, pairs,
+                                       commit=BlockData(bid, infos))
+            put_rates.append(gib / (time.time() - t0))
+            t0 = time.time()
+            out = client.read_chunks(bid, infos, verify=True)
+            get_rates.append(gib / (time.time() - t0))
+            del out
+        copies = hostmem._COPIES.value - c0
+        res = {
+            "put_gib_s": statistics.median(put_rates),
+            "get_gib_s": statistics.median(get_rates),
+            "host_copies_per_chunk": copies / (2.0 * rounds * n_chunks),
+        }
+        log(f"  e2e native datapath ({n_chunks}x{chunk_mib} MiB/stream): "
+            f"PUT {res['put_gib_s']:.2f} GiB/s, GET(verify) "
+            f"{res['get_gib_s']:.2f} GiB/s, "
+            f"{res['host_copies_per_chunk']:.3f} host copies/chunk")
+        return res
+    finally:
+        client.close()
+        sidecar.stop()
+        server.stop()
+        dn.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_meta_ops(n_ops: int = 1500, threads: int = 8) -> dict:
     """Sharded metadata plane throughput: freon omkg (open+commit, no
     datanode IO) at 1 vs 2 vs 4 shards, in two harnesses.
@@ -1280,6 +1372,19 @@ def main() -> None:
                 f"{tier['dispatches']} dispatch(es)")
         except Exception as e:
             log(f"tiering bench failed: {e}")
+    if budget_for("e2e datapath bench", 45):
+        try:
+            dp = bench_e2e_datapath()
+            if dp is not None:
+                _STATE["e2e_put"] = dp["put_gib_s"]
+                _STATE["e2e_get"] = dp["get_gib_s"]
+                _STATE["e2e_copies"] = dp["host_copies_per_chunk"]
+                log(f"e2e native datapath: PUT {dp['put_gib_s']:.2f} "
+                    f"GiB/s, GET {dp['get_gib_s']:.2f} GiB/s, "
+                    f"{dp['host_copies_per_chunk']:.3f} host "
+                    f"copies/chunk")
+        except Exception as e:
+            log(f"e2e datapath bench failed: {e}")
     if budget_for("re-encode bench", 60):
         try:
             re = bench_xor_reencode()

@@ -30,6 +30,7 @@ import numpy as np
 from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import BlockGroup, block_lengths
+from ozone_tpu.codec import hostmem
 from ozone_tpu.codec import service as codec_service
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.fused import FusedSpec, make_fused_decoder
@@ -193,20 +194,31 @@ class ECBlockGroupReader:
 
     def _fetch_cell(self, u: int, stripe: int) -> np.ndarray:
         bd = self._unit_block(u)
-        out = np.zeros(self.cell, dtype=np.uint8)
         if bd is None:
-            return out
+            return np.zeros(self.cell, dtype=np.uint8)
         offset = stripe * self.cell
         info = next((c for c in bd.chunks if c.offset == offset), None)
         if info is None:
-            return out  # cell has no data (short final stripe)
+            # cell has no data (short final stripe)
+            return np.zeros(self.cell, dtype=np.uint8)
         dn_id = self.group.pipeline.nodes[u]
         with Tracer.instance().span("net:read_chunk", dn=dn_id,
                                     unit=u, stripe=stripe):
             data = self._health.observe(
                 dn_id, self.clients.get(dn_id).read_chunk,
                 self.group.block_id, info, verify=self.verify)
+        return self._cell_array(data)
+
+    def _cell_array(self, data: np.ndarray) -> np.ndarray:
+        """Full cells pass through as zero-copy views over the wire
+        buffer (cells are immutable once cached); short cells pad into
+        a fresh array — one counted copy, inherent to zero-fill."""
+        if data.size == self.cell:
+            return hostmem.as_array(data)
+        out = np.zeros(self.cell, dtype=np.uint8)
         out[: data.size] = data
+        hostmem.count_copy(int(data.size), site="ec_reader._cell_array",
+                           warn=False)
         return out
 
     def _prefetch_unit(self, u: int, stripes: Sequence[int]) -> None:
@@ -249,9 +261,7 @@ class ECBlockGroupReader:
                       "path will retry", u, e)
             return
         for (s, _info), data in zip(wanted, datas):
-            out = np.zeros(self.cell, dtype=np.uint8)
-            out[: data.size] = data
-            self._cell_cache[(u, s)] = out
+            self._cell_cache[(u, s)] = self._cell_array(data)
 
     # ---------------------------------------------------------------- normal
     def read_all(self) -> np.ndarray:

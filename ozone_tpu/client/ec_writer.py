@@ -41,6 +41,7 @@ from ozone_tpu.client.dn_client import (
     DatanodeClientFactory,
     batch_unsupported,
 )
+from ozone_tpu.codec import hostmem
 from ozone_tpu.codec import service as codec_service
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.fused import FusedSpec, effective_bpc, make_fused_encoder
@@ -311,12 +312,7 @@ class ECKeyWriter:
         d = resilience.current()
         if d is not None:
             self._deadline = d  # freshest ambient budget wins
-        arr = np.asarray(
-            np.frombuffer(data, dtype=np.uint8)
-            if isinstance(data, (bytes, bytearray))
-            else data,
-            dtype=np.uint8,
-        ).reshape(-1)
+        arr = hostmem.as_array(data)
         pos = 0
         while pos < arr.size:
             take = min(self.cell - self._cell_off, arr.size - pos)

@@ -26,12 +26,14 @@ import json
 import os
 import socket
 import struct
+import sys
 import threading
 from typing import Optional
 
 import numpy as np
 
 from ozone_tpu.client import resilience
+from ozone_tpu.codec import hostmem
 from ozone_tpu.net.dn_service import GrpcDatanodeClient
 from ozone_tpu.storage.ids import StorageError
 
@@ -42,6 +44,14 @@ _T_STATUS, _T_DATA = 0x81, 0x82
 _FRAME = struct.Struct("<IB")
 _CHUNK_HDR = struct.Struct("<QI")
 _RCHUNK_HDR = struct.Struct("<QIBII")
+
+_MAX_FRAME = 256 * 1024 * 1024  # must match datapath.cpp
+try:
+    _IOV_MAX = os.sysconf("SC_IOV_MAX")
+    if _IOV_MAX <= 0:
+        _IOV_MAX = 1024
+except (AttributeError, OSError, ValueError):
+    _IOV_MAX = 1024
 
 #: sockets kept per client; EC fan-out drives one unit stream per DN so
 #: per-DN concurrency is low
@@ -72,14 +82,48 @@ def _io_timeout_s() -> float:
         return 120.0
 
 
+def _sendmsg_all(sock: socket.socket, parts: list) -> None:
+    """One gathered ``sendmsg`` for a whole request, IOV_MAX-batched:
+    frame headers and payload views leave the process zero-copy in a
+    handful of syscalls instead of two writes per chunk. On shared-core
+    rigs the per-chunk wakeup this replaces — not bandwidth — dominated
+    PUT latency (docs/PERF.md round 6)."""
+    mv = [p if isinstance(p, memoryview) else memoryview(p) for p in parts]
+    i = 0
+    while i < len(mv):
+        batch = mv[i:i + _IOV_MAX]
+        sent = sock.sendmsg(batch)
+        j = 0
+        while j < len(batch) and sent >= len(batch[j]):
+            sent -= len(batch[j])
+            j += 1
+        i += j
+        if j < len(batch) and sent:
+            mv[i] = batch[j][sent:]
+
+
 class _Conn:
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int, uds: Optional[str] = None):
         # deadline-derived connect timeout: a spent budget raises
         # DEADLINE_EXCEEDED here instead of queueing a doomed connect
-        self.sock = socket.create_connection(
-            (host, port),
-            timeout=resilience.op_timeout(_connect_timeout_s(), "connect"))
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        timeout = resilience.op_timeout(_connect_timeout_s(), "connect")
+        self.sock = None
+        if uds:
+            # co-located lane: the abstract unix socket the sidecar
+            # advertised skips the loopback pseudo-NIC entirely
+            # (~1.5-2x single-stream on one core). A name minted on
+            # another host simply fails to connect -> TCP below.
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.settimeout(timeout)
+                s.connect("\0" + uds[1:] if uds.startswith("@") else uds)
+                self.sock = s
+            except OSError:
+                self.sock = None
+        if self.sock is None:
+            self.sock = socket.create_connection((host, port),
+                                                 timeout=timeout)
+            self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # deep buffers: on shared-core rigs every buffer-full forces a
         # client<->server context switch mid-chunk
         for opt in (socket.SO_SNDBUF, socket.SO_RCVBUF):
@@ -87,6 +131,8 @@ class _Conn:
                 self.sock.setsockopt(socket.SOL_SOCKET, opt, 8 * 1024 * 1024)
             except OSError:  # ozlint: allow[error-swallowing] -- optional buffer tuning; kernel caps/refusals are fine
                 pass
+        # reusable control-plane receive scratch (recv_exact/recv_frame)
+        self._scratch = bytearray(4096)
 
     def arm(self, verb: str) -> None:
         """Per-request IO timeout: pooled-connection REUSE re-derives it
@@ -95,35 +141,44 @@ class _Conn:
         self.sock.settimeout(resilience.op_timeout(_io_timeout_s(), verb))
 
     def send_frame(self, tag: int, body) -> None:
-        self.sock.sendall(_FRAME.pack(len(body), tag))
-        if len(body):
-            self.sock.sendall(body)
+        _sendmsg_all(self.sock, [_FRAME.pack(len(body), tag), body]
+                     if len(body) else [_FRAME.pack(0, tag)])
 
     def send_frames(self, frames: list[tuple[int, object]]) -> None:
-        """One sendall for the metadata-heavy prefix of a request —
-        headers and small frames coalesce; big payloads go raw."""
+        """One gathered sendmsg for a whole request — headers, small
+        frames and payload views leave zero-copy, never joined into a
+        coalescing bytes()."""
         parts: list[bytes | memoryview] = []
         for tag, body in frames:
             parts.append(_FRAME.pack(len(body), tag))
             if len(body):
                 parts.append(body)
-        self.sock.sendall(b"".join(
-            bytes(p) if isinstance(p, memoryview) else p for p in parts))
+        _sendmsg_all(self.sock, parts)
 
-    def recv_exact(self, n: int) -> bytes:
-        buf = bytearray(n)
-        view = memoryview(buf)
-        got = 0
+    def recv_exact_into(self, view: memoryview) -> None:
+        got, n = 0, len(view)
         while got < n:
             r = self.sock.recv_into(view[got:], n - got)
             if r == 0:
                 raise ConnectionError("native datapath peer closed")
             got += r
-        return bytes(buf)
 
-    def recv_frame(self) -> tuple[int, bytes]:
+    def recv_exact(self, n: int) -> memoryview:
+        """Control-plane receive into the connection's reusable scratch
+        (no per-frame bytes materialized). The returned view is valid
+        until the next recv_* call; payload frames never come through
+        here — read_chunks scatters them into pooled leases."""
+        if n > len(self._scratch):
+            self._scratch = bytearray(max(n, 4096))
+        view = memoryview(self._scratch)[:n]
+        self.recv_exact_into(view)
+        return view
+
+    def recv_frame(self) -> tuple[int, memoryview]:
         n, tag = _FRAME.unpack(self.recv_exact(5))
-        return tag, (self.recv_exact(n) if n else b"")
+        if n > _MAX_FRAME:
+            raise ConnectionError(f"oversized frame {n}")
+        return tag, (self.recv_exact(n) if n else memoryview(b""))
 
     def close(self) -> None:
         try:
@@ -141,6 +196,7 @@ class NativeDatanodeClient(GrpcDatanodeClient):
         # on the (authenticated) gRPC transport
         self._np_enabled = _enabled() and tls is None
         self._np_port: Optional[int] = None
+        self._np_uds: Optional[str] = None
         self._np_probed = False
         self._np_lock = threading.Lock()
         self._pool: list[_Conn] = []
@@ -157,10 +213,12 @@ class NativeDatanodeClient(GrpcDatanodeClient):
             try:
                 m, _ = self._call("GetDatapathInfo", {})
                 self._np_port = m.get("port")
+                self._np_uds = m.get("uds")
             except (StorageError, OSError):
                 # older server without the verb, or unreachable: the
                 # caller's normal gRPC path surfaces real errors
                 self._np_port = None
+                self._np_uds = None
             return self._np_port
 
     def _disable_native(self) -> None:
@@ -175,7 +233,8 @@ class NativeDatanodeClient(GrpcDatanodeClient):
         with self._np_lock:
             if self._pool:
                 return self._pool.pop()
-        return _Conn(self._host, port)
+            uds = self._np_uds
+        return _Conn(self._host, port, uds=uds)
 
     def _checkin(self, conn: _Conn) -> None:
         with self._np_lock:
@@ -201,8 +260,9 @@ class NativeDatanodeClient(GrpcDatanodeClient):
             # injected chaos latency, not a retry sleep
             time.sleep(d)  # ozlint: allow[deadline-propagation] -- injected chaos latency must block like a real slow link (partition.py delay rule)
 
-    def _status(self, conn: _Conn, body: bytes) -> None:
-        m = json.loads(body) if body else {}
+    def _status(self, conn: _Conn, body) -> None:
+        # json.loads needs bytes; STATUS is tiny control-plane framing
+        m = json.loads(bytes(body)) if len(body) else {}  # ozlint: allow[datapath-no-copy] -- control-plane STATUS JSON, not payload
         err = m.get("error")
         if err:
             raise StorageError(err.get("code", "IO_EXCEPTION"),
@@ -246,15 +306,23 @@ class NativeDatanodeClient(GrpcDatanodeClient):
         completed = False  # STATUS received: framing is in lockstep
         try:
             conn.arm("WriteChunksCommit")
-            conn.send_frame(_T_WHDR, hdr)
+            # the WHOLE request — WHDR, every chunk header, every
+            # payload view, END — leaves in one gathered sendmsg
+            # (IOV_MAX-batched): zero payload copies and a handful of
+            # syscalls per batch instead of two per chunk
+            parts: list[bytes | memoryview] = [
+                _FRAME.pack(len(hdr), _T_WHDR), hdr]
+            payload_bytes = 0
             for (info, _data), view in zip(chunks, views):
-                # one gathered syscall per chunk: frame prefix + binary
-                # chunk header + the payload zero-copy from its buffer
-                _send_iov(conn.sock,
-                          _FRAME.pack(12 + info.length, _T_CHUNK)
-                          + _CHUNK_HDR.pack(info.offset, info.length),
-                          view)
-            conn.send_frame(_T_END, bytes([1 if sync else 0]))
+                parts.append(_FRAME.pack(12 + info.length, _T_CHUNK)
+                             + _CHUNK_HDR.pack(info.offset, info.length))
+                if info.length:
+                    parts.append(view)
+                payload_bytes += info.length
+            parts.append(_FRAME.pack(1, _T_END)
+                         + (b"\x01" if sync else b"\x00"))
+            _sendmsg_all(conn.sock, parts)
+            hostmem.count_move(payload_bytes)
             tag, body = conn.recv_frame()
             if tag != _T_STATUS:
                 raise ConnectionError(f"unexpected frame tag {tag:#x}")
@@ -311,6 +379,38 @@ class NativeDatanodeClient(GrpcDatanodeClient):
         except OSError:
             self._disable_native()
             return super().read_chunks(block_id, infos, verify=verify)
+        # the whole response stream — DATA frames + trailing STATUS —
+        # lands in ONE pooled slab lease; chunk arrays are zero-copy
+        # views at their frame offsets (the lease is recycled when the
+        # last array dies). Mid-stream errors release it immediately.
+        payload_total = sum(int(i.length) for i in infos)
+        lease = hostmem.pool().lease(
+            payload_total + 5 * (len(infos) + 1) + 256)
+        slab = lease.view
+        state = {"filled": 0}
+
+        def _fill(upto: int) -> None:
+            filled = state["filled"]
+            while filled < upto:
+                r = conn.sock.recv_into(slab[filled:])
+                if r == 0:
+                    raise ConnectionError("native datapath peer closed")
+                filled += r
+            state["filled"] = filled
+
+        def _status_body(pos: int, n: int):
+            # STATUS bodies normally fit the slab margin; an outsized
+            # error message spills into a transient buffer
+            if pos + n <= len(slab):
+                _fill(pos + n)
+                return slab[pos:pos + n]
+            have = state["filled"] - pos
+            body = bytearray(n)
+            body[:have] = slab[pos:state["filled"]]
+            conn.recv_exact_into(memoryview(body)[have:])
+            return body
+
+        out = []
         try:
             conn.arm("ReadChunks")
             frames: list[tuple[int, object]] = [(_T_RHDR, hdr)]
@@ -318,21 +418,31 @@ class NativeDatanodeClient(GrpcDatanodeClient):
                 frames.append((_T_RCHUNK, _rchunk_body(info, verify)))
             frames.append((_T_END, b""))
             conn.send_frames(frames)
-            out = []
-            for _ in infos:
-                tag, body = conn.recv_frame()
+            pos = 0
+            for idx in range(len(infos) + 1):
+                _fill(pos + 5)
+                n, tag = _FRAME.unpack(slab[pos:pos + 5])
+                pos += 5
+                if n > _MAX_FRAME:
+                    raise ConnectionError(f"oversized frame {n}")
                 if tag == _T_STATUS:
-                    self._status(conn, body)  # raises
-                    raise ConnectionError("short native read stream")
-                if tag != _T_DATA:
+                    self._status(conn, _status_body(pos, n))  # raises on err
+                    if idx != len(infos):
+                        raise ConnectionError("short native read stream")
+                    break
+                if idx == len(infos) or tag != _T_DATA:
                     raise ConnectionError(f"unexpected frame tag {tag:#x}")
-                out.append(np.frombuffer(body, dtype=np.uint8))
-            tag, body = conn.recv_frame()
-            if tag != _T_STATUS:
-                raise ConnectionError(f"unexpected frame tag {tag:#x}")
-            self._status(conn, body)
+                if n != infos[idx].length:
+                    raise ConnectionError(
+                        f"DATA frame {n}B != requested {infos[idx].length}B")
+                _fill(pos + n)
+                out.append(lease.array(length=n, offset=pos) if n
+                           else np.empty(0, dtype=np.uint8))
+                pos += n
+            hostmem.count_move(payload_total)
         except (OSError, ConnectionError) as e:
             conn.close()
+            out.clear()  # the traceback pins this frame: drop the views
             raise StorageError(
                 "UNAVAILABLE",
                 f"native datapath to {self.address}: {e}") from e
@@ -340,9 +450,14 @@ class NativeDatanodeClient(GrpcDatanodeClient):
             # a mid-stream server error leaves this connection's framing
             # state unknown: don't pool it
             conn.close()
+            out.clear()  # the traceback pins this frame: drop the views
             raise
         else:
             self._checkin(conn)
+        finally:
+            # drop the owner reference: outstanding chunk arrays keep
+            # the buffer alive; on error it returns to the pool now
+            lease.release()
         return out
 
     def read_chunk(self, block_id, info, verify=False):
@@ -359,21 +474,18 @@ class NativeDatanodeClient(GrpcDatanodeClient):
         super().close()
 
 
-def _send_iov(sock: socket.socket, hdr: bytes, payload: memoryview) -> None:
-    sent = sock.sendmsg([hdr, payload])
-    total = len(hdr) + len(payload)
-    while sent < total:
-        if sent < len(hdr):
-            sent += sock.sendmsg([memoryview(hdr)[sent:], payload])
-        else:
-            sent += sock.send(payload[sent - len(hdr):])
-
-
 def _payload_view(data) -> memoryview:
     if isinstance(data, (bytes, bytearray, memoryview)):
         return memoryview(data).cast("B")
     arr = np.asarray(data)
     if arr.dtype != np.uint8 or not arr.flags.c_contiguous:
+        # hidden full copy (non-contiguous or non-uint8 payload): count
+        # it against the copy budget and warn once per call-site
+        caller = sys._getframe(1)
+        hostmem.count_copy(
+            int(arr.nbytes),
+            site=(f"{os.path.basename(caller.f_code.co_filename)}:"
+                  f"{caller.f_lineno}"))
         arr = np.ascontiguousarray(arr, dtype=np.uint8)
     return memoryview(arr.reshape(-1))
 

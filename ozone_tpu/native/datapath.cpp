@@ -45,16 +45,22 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <limits.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <stdint.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/mman.h>
+#include <sys/sendfile.h>
 #include <sys/socket.h>
+#include <sys/stat.h>
 #include <sys/uio.h>
+#include <sys/un.h>
 #include <unistd.h>
 
+#include <array>
 #include <atomic>
 #include <mutex>
 #include <set>
@@ -83,13 +89,117 @@ void crc32c_init() {
   }
 }
 
+#if defined(__SSE4_2__)
+// The crc32 instruction has a 3-cycle latency, so a single dependency
+// chain tops out near 4 GiB/s — a third of what the verify path needs.
+// Run three independent chains over adjacent blocks and splice them
+// with GF(2) "advance the CRC past N zero bytes" operators, the same
+// interleave zlib/ISA-L use.  The operators for the two fixed block
+// sizes are precomputed into 4x256 lookup tables at first use.
+constexpr size_t kCrcLongBlk = 4096;
+constexpr size_t kCrcShortBlk = 256;
+uint32_t crc_shift_long[4][256];
+uint32_t crc_shift_short[4][256];
+std::once_flag crc_shift_once;
+
+uint32_t gf2_times(const uint32_t* mat, uint32_t vec) {
+  uint32_t sum = 0;
+  while (vec) {
+    if (vec & 1) sum ^= *mat;
+    vec >>= 1;
+    mat++;
+  }
+  return sum;
+}
+
+void gf2_square(uint32_t* sq, const uint32_t* mat) {
+  for (int n = 0; n < 32; n++) sq[n] = gf2_times(mat, mat[n]);
+}
+
+// Build the 32x32 GF(2) matrix that advances a CRC-32C register past
+// `len` zero bytes, by repeated squaring of the one-bit shift operator.
+void crc_zeros_op(uint32_t* even, size_t len) {
+  uint32_t odd[32];
+  odd[0] = 0x82F63B78u;  // reflected Castagnoli polynomial
+  uint32_t row = 1;
+  for (int n = 1; n < 32; n++) {
+    odd[n] = row;
+    row <<= 1;
+  }
+  gf2_square(even, odd);  // two squarings: odd is now "shift 1 bit",
+  gf2_square(odd, even);  // even/odd alternate 2-bit, 4-bit, ...
+  do {
+    gf2_square(even, odd);
+    len >>= 1;
+    if (len == 0) return;
+    gf2_square(odd, even);
+    len >>= 1;
+  } while (len);
+  for (int n = 0; n < 32; n++) even[n] = odd[n];
+}
+
+void crc_zeros_table(uint32_t zeros[][256], size_t len) {
+  uint32_t op[32];
+  crc_zeros_op(op, len);
+  for (uint32_t n = 0; n < 256; n++) {
+    zeros[0][n] = gf2_times(op, n);
+    zeros[1][n] = gf2_times(op, n << 8);
+    zeros[2][n] = gf2_times(op, n << 16);
+    zeros[3][n] = gf2_times(op, n << 24);
+  }
+}
+
+void crc_shift_init() {
+  crc_zeros_table(crc_shift_long, kCrcLongBlk);
+  crc_zeros_table(crc_shift_short, kCrcShortBlk);
+}
+
+inline uint32_t crc_shift(const uint32_t zeros[][256], uint32_t crc) {
+  return zeros[0][crc & 0xFF] ^ zeros[1][(crc >> 8) & 0xFF] ^
+         zeros[2][(crc >> 16) & 0xFF] ^ zeros[3][crc >> 24];
+}
+
+uint64_t load_u64(const uint8_t* p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+#endif  // __SSE4_2__
+
 uint32_t crc32c(const uint8_t* p, size_t n) {
   uint32_t s = 0xFFFFFFFFu;
 #if defined(__SSE4_2__)
+  std::call_once(crc_shift_once, crc_shift_init);
+  while (n >= 3 * kCrcLongBlk) {
+    uint32_t c1 = 0, c2 = 0;
+    const uint8_t* end = p + kCrcLongBlk;
+    do {
+      s = (uint32_t)_mm_crc32_u64(s, load_u64(p));
+      c1 = (uint32_t)_mm_crc32_u64(c1, load_u64(p + kCrcLongBlk));
+      c2 = (uint32_t)_mm_crc32_u64(c2, load_u64(p + 2 * kCrcLongBlk));
+      p += 8;
+    } while (p < end);
+    s = crc_shift(crc_shift_long, s) ^ c1;
+    s = crc_shift(crc_shift_long, s) ^ c2;
+    p += 2 * kCrcLongBlk;
+    n -= 3 * kCrcLongBlk;
+  }
+  while (n >= 3 * kCrcShortBlk) {
+    uint32_t c1 = 0, c2 = 0;
+    const uint8_t* end = p + kCrcShortBlk;
+    do {
+      s = (uint32_t)_mm_crc32_u64(s, load_u64(p));
+      c1 = (uint32_t)_mm_crc32_u64(c1, load_u64(p + kCrcShortBlk));
+      c2 = (uint32_t)_mm_crc32_u64(c2, load_u64(p + 2 * kCrcShortBlk));
+      p += 8;
+    } while (p < end);
+    s = crc_shift(crc_shift_short, s) ^ c1;
+    s = crc_shift(crc_shift_short, s) ^ c2;
+    p += 2 * kCrcShortBlk;
+    n -= 3 * kCrcShortBlk;
+  }
   while (n >= 8) {
-    uint64_t v;
-    memcpy(&v, p, 8);
-    s = (uint32_t)_mm_crc32_u64(s, v);
+    s = (uint32_t)_mm_crc32_u64(s, load_u64(p));
     p += 8;
     n -= 8;
   }
@@ -149,9 +259,114 @@ struct Buf {
   uint8_t operator[](size_t i) const { return p[i]; }
 };
 
+// ---------------------------------------------------------- buffer arena
+// Page-aligned, size-classed, refcounted buffer pool. Payload bytes are
+// received (readv) straight into leased buffers and sent (writev)
+// straight out of them — the arena is the only payload-sized allocator
+// on the native hot path, and it is exported to Python through the
+// dp_buf_* capsule API so tests and the sidecar can observe (and, when
+// useful, share) the same pool. Netty PooledByteBufAllocator analog.
+struct PoolBuf {
+  uint8_t* p = nullptr;
+  size_t cap = 0;
+  std::atomic<int> refs{1};
+};
+
+class Arena {
+ public:
+  static constexpr size_t kMinClass = 4096;        // one page
+  static constexpr size_t kMaxClass = 64u << 20;   // retained classes
+  static constexpr int kNClass = 15;               // 4 KiB .. 64 MiB
+
+  PoolBuf* lease(size_t n) {
+    size_t cap = kMinClass;
+    while (cap < n) cap <<= 1;
+    int cls = class_of(cap);
+    PoolBuf* b = nullptr;
+    if (cls >= 0) {
+      std::lock_guard<std::mutex> g(mu_);
+      auto& lst = free_[cls];
+      if (!lst.empty()) {
+        b = lst.back();
+        lst.pop_back();
+        free_bytes_.fetch_sub(cap);
+      }
+    }
+    if (b) {
+      b->refs.store(1);
+    } else {
+      void* mem = nullptr;
+      if (posix_memalign(&mem, 4096, cap) != 0) return nullptr;
+      b = new PoolBuf();
+      b->p = (uint8_t*)mem;
+      b->cap = cap;
+    }
+    uint64_t now = leased_bytes_.fetch_add(cap) + cap;
+    uint64_t hw = high_water_.load();
+    while (now > hw && !high_water_.compare_exchange_weak(hw, now)) {
+    }
+    return b;
+  }
+
+  void retain(PoolBuf* b) { b->refs.fetch_add(1); }
+
+  void release(PoolBuf* b) {
+    if (b->refs.fetch_sub(1) != 1) return;
+    leased_bytes_.fetch_sub(b->cap);
+    int cls = class_of(b->cap);
+    if (cls >= 0 && free_bytes_.load() + b->cap <= max_retained()) {
+      std::lock_guard<std::mutex> g(mu_);
+      free_[cls].push_back(b);
+      free_bytes_.fetch_add(b->cap);
+      return;
+    }
+    free(b->p);
+    delete b;
+  }
+
+  uint64_t stat(int which) const {
+    switch (which) {
+      case 0: return leased_bytes_.load();
+      case 1: return free_bytes_.load();
+      case 2: return high_water_.load();
+      default: return 0;
+    }
+  }
+
+ private:
+  static int class_of(size_t cap) {
+    if (cap < kMinClass || cap > kMaxClass || (cap & (cap - 1))) return -1;
+    int i = 0;
+    for (size_t c = kMinClass; c < cap; c <<= 1) i++;
+    return i;
+  }
+
+  static uint64_t max_retained() {
+    static uint64_t v = [] {
+      const char* e = getenv("OZONE_TPU_POOL_MAX_MIB");
+      long mib = e ? atol(e) : 256;
+      if (mib < 16) mib = 16;
+      return (uint64_t)mib << 20;
+    }();
+    return v;
+  }
+
+  std::mutex mu_;
+  std::vector<PoolBuf*> free_[kNClass];
+  std::atomic<uint64_t> leased_bytes_{0}, free_bytes_{0}, high_water_{0};
+};
+
+Arena g_arena;
+
 struct Server {
   int listen_fd = -1;
   int port = 0;
+  // local lane: an abstract-namespace unix socket speaking the same
+  // frame protocol — ~1.5-2x the loopback-TCP throughput on one core
+  // (no pseudo-NIC segmentation, one less queue). Co-located clients
+  // learn the name over GetDatapathInfo and prefer it.
+  int uds_fd = -1;
+  std::string uds_name;
   dp_auth_cb auth = nullptr;
   dp_done_cb done = nullptr;
   dp_fail_cb fail = nullptr;
@@ -160,6 +375,7 @@ struct Server {
   std::mutex conn_mu;
   std::set<int> conns;
   std::thread acceptor;
+  std::thread uds_acceptor;
 };
 
 bool read_full(int fd, void* buf, size_t n) {
@@ -186,6 +402,64 @@ bool write_full(int fd, const void* buf, size_t n) {
     }
     p += r;
     n -= (size_t)r;
+  }
+  return true;
+}
+
+// scatter receive: fill every iovec completely (headers into stack
+// scratch, payload straight into a pooled buffer — one syscall for
+// both on the common path)
+bool readv_full(int fd, struct iovec* iov, int cnt) {
+  while (cnt) {
+    ssize_t r = readv(fd, iov, cnt);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    size_t adv = (size_t)r;
+    while (cnt && adv) {
+      size_t take = adv < iov->iov_len ? adv : iov->iov_len;
+      iov->iov_base = (uint8_t*)iov->iov_base + take;
+      iov->iov_len -= take;
+      adv -= take;
+      if (!iov->iov_len) {
+        iov++;
+        cnt--;
+      }
+    }
+    while (cnt && !iov->iov_len) {
+      iov++;
+      cnt--;
+    }
+  }
+  return true;
+}
+
+// gather send of a pre-built iovec array, IOV_MAX-batched
+bool writev_full(int fd, struct iovec* iov, size_t cnt) {
+#ifdef IOV_MAX
+  const size_t kMaxIov = IOV_MAX;
+#else
+  const size_t kMaxIov = 1024;
+#endif
+  size_t done = 0;
+  while (done < cnt) {
+    while (done < cnt && !iov[done].iov_len) done++;
+    if (done >= cnt) break;
+    size_t batch = cnt - done < kMaxIov ? cnt - done : kMaxIov;
+    ssize_t r = writev(fd, iov + done, (int)batch);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    size_t adv = (size_t)r;
+    while (done < cnt && adv) {
+      size_t take = adv < iov[done].iov_len ? adv : iov[done].iov_len;
+      iov[done].iov_base = (uint8_t*)iov[done].iov_base + take;
+      iov[done].iov_len -= take;
+      adv -= take;
+      if (!iov[done].iov_len) done++;
+    }
   }
   return true;
 }
@@ -296,30 +570,71 @@ bool handle_write(Server* s, int fd, const Buf& hdr,
   uint64_t total = 0;
   uint32_t chunks = 0;
   bool sync = false;
-  uint8_t tag;
   for (;;) {
-    if (!read_frame(fd, &tag, scratch)) {
+    // parse the frame header ourselves: CHUNK payloads are scattered
+    // (readv) straight into a pooled arena buffer, never staged
+    // through the grow-only scratch
+    uint8_t fh[5];
+    if (!read_full(fd, fh, 5)) {
+      if (file_fd >= 0) close(file_fd);
+      return false;
+    }
+    uint32_t n;
+    memcpy(&n, fh, 4);
+    uint8_t tag = fh[4];
+    if (n > MAX_FRAME) {
       if (file_fd >= 0) close(file_fd);
       return false;
     }
     if (tag == T_END) {
+      if (!scratch.resize(n) || (n && !read_full(fd, scratch.data(), n))) {
+        if (file_fd >= 0) close(file_fd);
+        return false;
+      }
       if (!scratch.empty()) sync = scratch[0] != 0;
       break;
     }
-    if (tag != T_CHUNK || scratch.size() < 12) {
+    if (tag != T_CHUNK || n < 12) {
       if (file_fd >= 0) close(file_fd);
       return false;  // protocol error: drop the connection
     }
-    if (!err.empty()) continue;  // already failed: drain remaining
+    uint32_t len = n - 12;
+    uint8_t chdr[12];
+    PoolBuf* pb = nullptr;
+    if (err.empty() && len) pb = g_arena.lease(len);
+    if (pb || !len) {
+      struct iovec iov[2] = {{chdr, 12}, {pb ? pb->p : nullptr, len}};
+      if (!readv_full(fd, iov, len ? 2 : 1)) {
+        if (pb) g_arena.release(pb);
+        if (file_fd >= 0) close(file_fd);
+        return false;
+      }
+    } else {
+      // no buffer (failed stream or OOM): drain hdr + payload via
+      // scratch to keep the connection framed
+      if (!read_full(fd, chdr, 12) || !scratch.resize(len) ||
+          (len && !read_full(fd, scratch.data(), len))) {
+        if (file_fd >= 0) close(file_fd);
+        return false;
+      }
+      if (err.empty())
+        err = err_json("IO_EXCEPTION", "write buffer allocation failed");
+      continue;
+    }
+    if (!err.empty()) {
+      if (pb) g_arena.release(pb);
+      continue;  // already failed: drain remaining
+    }
     uint64_t off;
-    uint32_t len;
-    memcpy(&off, scratch.data(), 8);
-    memcpy(&len, scratch.data() + 8, 4);
-    if (scratch.size() != 12 + (size_t)len) {
+    uint32_t hdr_len;
+    memcpy(&off, chdr, 8);
+    memcpy(&hdr_len, chdr + 8, 4);
+    if (hdr_len != len) {
+      if (pb) g_arena.release(pb);
       if (file_fd >= 0) close(file_fd);
       return false;
     }
-    const uint8_t* p = scratch.data() + 12;
+    const uint8_t* p = pb ? pb->p : nullptr;
     size_t left = len;
     uint64_t at = off;
     while (left) {
@@ -334,6 +649,7 @@ bool handle_write(Server* s, int fd, const Buf& hdr,
       at += (uint64_t)w;
       left -= (size_t)w;
     }
+    if (pb) g_arena.release(pb);
     if (err.empty()) {
       total += len;
       chunks++;
@@ -391,51 +707,194 @@ bool handle_read(Server* s, int fd, const Buf& hdr,
   if (file_fd < 0)
     return send_status(
         fd, err_json("IO_EXCEPTION", "open " + body + ": " + strerror(errno)));
-  Buf buf;
+  // map the block once: in-range chunks are CRC'd out of the page
+  // cache and leave via sendfile (zero server-side copies); only
+  // EOF-straddling tails fall back to a pooled pread+zero-fill buffer
+  struct stat st {};
+  size_t fsize = fstat(file_fd, &st) == 0 ? (size_t)st.st_size : 0;
+  uint8_t* map = nullptr;
+  if (fsize) {
+    // MAP_POPULATE wires the PTEs up front: one syscall instead of a
+    // minor fault per page while the CRC/writev loop walks the block
+    int mflags = MAP_SHARED;
+#ifdef MAP_POPULATE
+    mflags |= MAP_POPULATE;
+#endif
+    void* m = mmap(nullptr, fsize, PROT_READ, mflags, file_fd, 0);
+    if (m != MAP_FAILED) {
+      map = (uint8_t*)m;
+#ifdef POSIX_MADV_SEQUENTIAL
+      posix_madvise(map, fsize, POSIX_MADV_SEQUENTIAL);
+#endif
+    }
+  }
+  // DATA frames accumulate into a pending batch. Chunks that live in
+  // the mapping leave via sendfile(2) — the page-cache pages ride into
+  // the socket as references, so the server-side copy disappears and
+  // the only memcpy left on a GET is the client's recv into its pooled
+  // slab. Pooled tail buffers (EOF-straddles) still go out through one
+  // gathered writev. The 5-byte frame header before a sendfile payload
+  // is sent with MSG_MORE so it lands in the same segment.
+  struct PendingSend {
+    std::array<uint8_t, 5> hdr;
+    const uint8_t* payload;
+    uint32_t len;
+    PoolBuf* buf;  // null when the payload points into the mapping
+  };
+  std::vector<PendingSend> pending;
+  pending.reserve(reqs.size());
+  size_t pending_bytes = 0;
+  constexpr size_t kFlushBytes = 8u << 20;
+  bool use_sendfile = true;
+  auto cleanup = [&](bool ok_close) {
+    for (auto& ps : pending)
+      if (ps.buf) g_arena.release(ps.buf);
+    pending.clear();
+    if (map) munmap(map, fsize);
+    if (ok_close) close(file_fd);
+  };
+  auto send_hdr = [&](const std::array<uint8_t, 5>& h) -> bool {
+    size_t done = 0;
+    while (done < 5) {
+      ssize_t w = send(fd, h.data() + done, 5 - done,
+                       MSG_MORE | MSG_NOSIGNAL);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+      done += (size_t)w;
+    }
+    return true;
+  };
+  auto sendfile_full = [&](off_t off, uint32_t len, bool* fell_back)
+      -> bool {
+    size_t left = len;
+    while (left) {
+      ssize_t w = sendfile(fd, file_fd, &off, left);
+      if (w < 0) {
+        if (errno == EINTR) continue;
+        if (left == len && (errno == EINVAL || errno == ENOSYS)) {
+          // filesystem can't sendfile: nothing sent yet, let the
+          // caller writev this payload and stop trying
+          *fell_back = true;
+          return true;
+        }
+        return false;
+      }
+      if (w == 0) return false;
+      left -= (size_t)w;
+    }
+    return true;
+  };
+  auto flush = [&]() -> bool {
+    bool ok = true;
+    size_t i = 0;
+    auto mapped = [&](const PendingSend& ps) {
+      return use_sendfile && !ps.buf && ps.len && ps.payload >= map &&
+             ps.payload + ps.len <= map + fsize;
+    };
+    while (ok && i < pending.size()) {
+      if (mapped(pending[i])) {
+        bool fell_back = false;
+        ok = send_hdr(pending[i].hdr) &&
+             sendfile_full((off_t)(pending[i].payload - map),
+                           pending[i].len, &fell_back);
+        if (ok && fell_back) {
+          use_sendfile = false;
+          struct iovec iov = {(void*)pending[i].payload, pending[i].len};
+          ok = writev_full(fd, &iov, 1);
+        }
+        i++;
+        continue;
+      }
+      // gather the run of pooled/empty entries into one writev
+      std::vector<struct iovec> iov;
+      while (i < pending.size() && !mapped(pending[i])) {
+        iov.push_back({pending[i].hdr.data(), 5});
+        if (pending[i].len)
+          iov.push_back({(void*)pending[i].payload, pending[i].len});
+        i++;
+      }
+      ok = writev_full(fd, iov.data(), iov.size());
+    }
+    for (auto& ps : pending)
+      if (ps.buf) g_arena.release(ps.buf);
+    pending.clear();
+    pending_bytes = 0;
+    return ok;
+  };
   uint64_t total = 0;
   for (auto& r : reqs) {
-    if (!buf.resize(r.len)) {  // OOM: fail the stream, keep the process
-      close(file_fd);
-      return send_status(
-          fd, err_json("IO_EXCEPTION", "read buffer allocation failed"));
-    }
-    size_t got = 0;
-    while (got < r.len) {
-      ssize_t rd = pread(file_fd, buf.data() + got, r.len - got,
-                         (off_t)(r.off + got));
-      if (rd < 0) {
-        if (errno == EINTR) continue;
-        close(file_fd);
+    const uint8_t* src = nullptr;
+    PoolBuf* pb = nullptr;
+    if (map && r.off <= fsize && r.len <= fsize - r.off) {
+      src = map + r.off;  // fully in range: serve from the mapping
+    } else if (r.len) {
+      pb = g_arena.lease(r.len);
+      if (!pb) {  // OOM: fail the stream, keep the process
+        cleanup(true);
         return send_status(
-            fd, err_json("IO_EXCEPTION",
-                         "pread: " + std::string(strerror(errno))));
+            fd, err_json("IO_EXCEPTION", "read buffer allocation failed"));
       }
-      if (rd == 0) break;  // short: zero-fill tail (store semantics)
-      got += (size_t)rd;
+      size_t got = 0;
+      while (got < r.len) {
+        ssize_t rd = pread(file_fd, pb->p + got, r.len - got,
+                           (off_t)(r.off + got));
+        if (rd < 0) {
+          if (errno == EINTR) continue;
+          g_arena.release(pb);
+          cleanup(true);
+          return send_status(
+              fd, err_json("IO_EXCEPTION",
+                           "pread: " + std::string(strerror(errno))));
+        }
+        if (rd == 0) break;  // short: zero-fill tail (store semantics)
+        got += (size_t)rd;
+      }
+      if (got < r.len) memset(pb->p + got, 0, r.len - got);
+      src = pb->p;
     }
-    if (got < r.len) memset(buf.data() + got, 0, r.len - got);
     if (r.vtype == 1 && !r.crcs.empty()) {
       uint32_t bpc = r.bpc ? r.bpc : r.len;
       size_t slice = 0;
       for (uint32_t o = 0; o < r.len && slice < r.crcs.size();
            o += bpc, slice++) {
         uint32_t n = (r.len - o) < bpc ? (r.len - o) : bpc;
-        if (crc32c(buf.data() + o, n) != r.crcs[slice]) {
-          close(file_fd);
+        if (crc32c(src + o, n) != r.crcs[slice]) {
+          if (pb) g_arena.release(pb);
+          // deliver earlier verified chunks, then the error status
+          bool sent = flush();
           s->fail(hdr.data(), (uint32_t)hdr.size());
           char msg[96];
           snprintf(msg, sizeof msg, "checksum mismatch at slice %zu", slice);
-          return send_status(fd, err_json("CHECKSUM_MISMATCH", msg));
+          bool st_ok = sent && send_status(fd, err_json("CHECKSUM_MISMATCH",
+                                                        msg));
+          cleanup(true);
+          return st_ok;
         }
       }
     }
-    if (!send_frame(fd, T_DATA, buf.data(), r.len)) {
-      close(file_fd);
-      return false;
-    }
+    PendingSend ps;
+    memcpy(ps.hdr.data(), &r.len, 4);
+    ps.hdr[4] = T_DATA;
+    ps.payload = src;
+    ps.len = r.len;
+    ps.buf = pb;
+    pending.push_back(ps);
+    pending_bytes += r.len;
     total += r.len;
+    if (pending_bytes >= kFlushBytes || pending.size() >= 256) {
+      if (!flush()) {
+        cleanup(true);
+        return false;
+      }
+    }
   }
-  close(file_fd);
+  if (!flush()) {
+    cleanup(true);
+    return false;
+  }
+  cleanup(true);
   std::string done_body;
   int d = run_cb_done(s, hdr, 0, total, (uint32_t)reqs.size(), &done_body);
   if (d < 0)
@@ -477,9 +936,9 @@ void conn_loop(Server* s, int fd) {
   s->active--;
 }
 
-void accept_loop(Server* s) {
+void accept_loop(Server* s, int listen_fd) {
   for (;;) {
-    int fd = accept(s->listen_fd, nullptr, nullptr);
+    int fd = accept(listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (errno == EINTR) continue;
       break;  // listener closed: shutting down
@@ -526,11 +985,49 @@ void* dp_start(const char* host, int port, dp_auth_cb auth, dp_done_cb done,
   s->auth = auth;
   s->done = done;
   s->fail = fail;
-  s->acceptor = std::thread(accept_loop, s);
+  s->acceptor = std::thread(accept_loop, s, fd);
+  // local lane: abstract unix socket (kernel-scoped name, no file to
+  // clean up, dies with the process). The random suffix keeps a client
+  // that was handed another host's name from ever reaching a
+  // coincidentally-matching local sidecar.
+  int ufd = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (ufd >= 0) {
+    uint64_t nonce = 0;
+    int rfd = open("/dev/urandom", O_RDONLY | O_CLOEXEC);
+    if (rfd >= 0) {
+      if (read(rfd, &nonce, sizeof nonce) != sizeof nonce) nonce = 0;
+      close(rfd);
+    }
+    char name[96];
+    snprintf(name, sizeof name, "ozone-dp.%d.%d.%016llx", (int)getpid(),
+             s->port, (unsigned long long)nonce);
+    sockaddr_un ua{};
+    ua.sun_family = AF_UNIX;
+    size_t nlen = strlen(name);
+    memcpy(ua.sun_path + 1, name, nlen);  // sun_path[0]=0: abstract
+    socklen_t ulen = (socklen_t)(offsetof(sockaddr_un, sun_path) + 1 + nlen);
+    if (bind(ufd, (sockaddr*)&ua, ulen) == 0 && listen(ufd, 64) == 0) {
+      s->uds_fd = ufd;
+      s->uds_name = std::string("@") + name;
+      s->uds_acceptor = std::thread(accept_loop, s, ufd);
+    } else {
+      close(ufd);
+    }
+  }
   return s;
 }
 
 int dp_port(void* h) { return h ? ((Server*)h)->port : -1; }
+
+// Copies the local-lane abstract socket name ("@..."), returns its
+// length; 0 when the unix listener could not be set up.
+int dp_uds(void* h, char* out, int cap) {
+  if (!h) return 0;
+  Server* s = (Server*)h;
+  if (s->uds_name.empty() || (int)s->uds_name.size() > cap) return 0;
+  memcpy(out, s->uds_name.data(), s->uds_name.size());
+  return (int)s->uds_name.size();
+}
 
 // Stop accepting, sever live connections, and wait (bounded) for the
 // in-flight handlers — their Python callbacks must finish before the
@@ -541,11 +1038,16 @@ void dp_stop(void* h) {
   s->stop.store(true);
   shutdown(s->listen_fd, SHUT_RDWR);
   close(s->listen_fd);
+  if (s->uds_fd >= 0) {
+    shutdown(s->uds_fd, SHUT_RDWR);
+    close(s->uds_fd);
+  }
   {
     std::lock_guard<std::mutex> g(s->conn_mu);
     for (int fd : s->conns) shutdown(fd, SHUT_RDWR);
   }
   if (s->acceptor.joinable()) s->acceptor.join();
+  if (s->uds_acceptor.joinable()) s->uds_acceptor.join();
   for (int i = 0; i < 200 && s->active.load() > 0; i++)
     usleep(10 * 1000);
   // leak the Server if a handler is wedged: a use-after-free in a
@@ -556,5 +1058,26 @@ void dp_stop(void* h) {
 uint32_t dp_crc32c(const void* p, int64_t n) {
   return crc32c((const uint8_t*)p, (size_t)n);
 }
+
+// ------------------------------------------------- buffer-pool capsule
+// Lease/retain/release handles into the same arena the server's hot
+// path uses. Python (ctypes) wraps the returned handle + data pointer
+// in a memoryview for zero-copy staging, and releases when done.
+void* dp_buf_lease(uint64_t n) { return g_arena.lease((size_t)n); }
+
+void* dp_buf_data(void* b) { return b ? ((PoolBuf*)b)->p : nullptr; }
+
+uint64_t dp_buf_cap(void* b) { return b ? ((PoolBuf*)b)->cap : 0; }
+
+void dp_buf_retain(void* b) {
+  if (b) g_arena.retain((PoolBuf*)b);
+}
+
+void dp_buf_release(void* b) {
+  if (b) g_arena.release((PoolBuf*)b);
+}
+
+// which: 0 leased_bytes, 1 free_bytes, 2 high_water_bytes
+uint64_t dp_pool_stat(int which) { return g_arena.stat(which); }
 
 }  // extern "C"

@@ -145,7 +145,7 @@ class DatanodeDaemon:
         self.service = DatanodeGrpcService(
             self.dn, self.server, verifier=self.verifier,
             layout=self.layout,
-            datapath_port=lambda: (self.datapath.port
+            datapath_port=lambda: (self.datapath.advertise()
                                    if self.datapath else None))
         # per-DN replication bandwidth cap (ReplicationSupervisor limit
         # analog): paces BOTH the pull loop below and served export

@@ -12,6 +12,7 @@ from typing import Optional
 
 import numpy as np
 
+from ozone_tpu.codec import hostmem
 from ozone_tpu.net import wire
 from ozone_tpu.net.rpc import RpcChannel, RpcServer
 from ozone_tpu.storage.datanode import Datanode
@@ -150,29 +151,54 @@ class DatanodeGrpcService:
 
         chunks: list[ChunkInfo] = []
         offset = 0
-        buf = bytearray()
+        # zero-copy chunk cutting: incoming slabs are held as views and
+        # sliced at chunk boundaries — a chunk served by ONE slab never
+        # materializes (the common case: clients send chunk-aligned
+        # slabs); only a boundary-straddling chunk joins its pieces
+        # (one counted copy)
+        pending: list[memoryview] = []
+        pending_bytes = 0
+
+        def cut(n: int) -> np.ndarray:
+            nonlocal pending_bytes
+            take: list[memoryview] = []
+            need = n
+            while need:
+                v = pending[0]
+                if len(v) <= need:
+                    take.append(pending.pop(0))
+                    need -= len(v)
+                else:
+                    take.append(v[:need])
+                    pending[0] = v[need:]
+                    need = 0
+            pending_bytes -= n
+            if len(take) == 1:
+                return hostmem.as_array(take[0])
+            hostmem.count_copy(n, site="dn_service._stream_write_block",
+                               warn=False)
+            return hostmem.as_array(b"".join(take))
 
         def flush(final: bool) -> None:
             nonlocal offset
-            while len(buf) >= chunk_size or (final and buf):
-                part = bytes(buf[:chunk_size])
-                del buf[:chunk_size]
+            while pending_bytes >= chunk_size or (final and pending_bytes):
+                part = cut(min(chunk_size, pending_bytes))
                 info = ChunkInfo(
                     name=f"{block_id}_chunk_{len(chunks)}",
                     offset=offset,
-                    length=len(part),
-                    checksum=cksum.compute(
-                        np.frombuffer(part, dtype=np.uint8)),
+                    length=int(part.size),
+                    checksum=cksum.compute(part),
                 )
                 self.dn.write_chunk(
-                    block_id, info,
-                    np.frombuffer(part, dtype=np.uint8), sync=sync,
+                    block_id, info, part, sync=sync,
                     writer=header.get("writer"))
                 chunks.append(info)
-                offset += len(part)
+                offset += int(part.size)
 
         for frame in it:
-            buf.extend(frame)
+            if len(frame):
+                pending.append(memoryview(frame).cast("B"))
+                pending_bytes += len(frame)
             flush(final=False)
         flush(final=True)
         bd = BlockData(block_id, chunks)
@@ -222,8 +248,13 @@ class DatanodeGrpcService:
         return wire.pack({})
 
     def _datapath_info(self, req: bytes) -> bytes:
-        port = self.datapath_port() if self.datapath_port else None
-        return wire.pack({"port": port})
+        # providers may return a bare port (older wiring) or a dict
+        # carrying the co-located unix-socket lane as well
+        # (DatapathSidecar.advertise)
+        v = self.datapath_port() if self.datapath_port else None
+        if isinstance(v, dict):
+            return wire.pack(v)
+        return wire.pack({"port": v})
 
     def _create_container(self, req: bytes) -> bytes:
         m, _ = wire.unpack(req)
@@ -305,7 +336,9 @@ class DatanodeGrpcService:
         # tarball actually IS that container before any bytes land
         expect_id = m.get("container_id")
         self._require_container(m, expect_id if expect_id is not None else -1)
-        data = b"".join(bytes(f) for f in it)
+        # join accepts the frames (bytes) directly: one assembly copy,
+        # no per-frame bytes() materialization
+        data = b"".join(it)
         c = import_container(self.dn, data,
                              replica_index=m.get("replica_index"),
                              expect_id=expect_id)
@@ -442,12 +475,7 @@ class GrpcDatanodeClient:
 
     def write_chunk(self, block_id, info, data, sync=False,
                     writer=None):
-        arr = np.asarray(
-            np.frombuffer(data, dtype=np.uint8)
-            if isinstance(data, (bytes, bytearray))
-            else data,
-            dtype=np.uint8,
-        )
+        arr = hostmem.as_array(data)
         m = {
             "block_id": block_id.to_json(),
             "chunk": info.to_json(),
@@ -468,7 +496,9 @@ class GrpcDatanodeClient:
                 **self._btok(block_id),
             },
         )
-        return wire.payload_array(payload).copy()
+        # zero-copy view over the response buffer (read-only; every
+        # consumer copies into its own destination or only reads)
+        return wire.payload_array(payload)
 
     def read_chunks(self, block_id, infos, verify=False):
         """Batch read: one server-streamed RPC returns every chunk in
@@ -488,7 +518,7 @@ class GrpcDatanodeClient:
         out = []
         for f in frames:
             _, payload = wire.unpack(f)
-            out.append(wire.payload_array(payload).copy())
+            out.append(wire.payload_array(payload))
         if len(out) != len(infos):
             raise StorageError(
                 "IO_EXCEPTION",
@@ -534,7 +564,8 @@ class GrpcDatanodeClient:
         )
         head = next(iter_frames := iter(frames))
         wire.unpack(head)  # header: {container_id, size, compression}
-        return b"".join(bytes(f) for f in iter_frames)
+        # one assembly copy; frames join without per-frame bytes()
+        return b"".join(iter_frames)
 
     def import_container(self, data: bytes,
                          replica_index=None,
@@ -611,8 +642,22 @@ class GrpcDatanodeClient:
                 "bytes_per_checksum": bytes_per_checksum,
                 **self._btok(block_id),
             })
+            # grpc's cython layer only transports immutable bytes, and
+            # it copies each frame into a C slice BEFORE pulling the
+            # next one — so already-bytes slabs pass through untouched
+            # (the old unconditional bytes(f) re-copied every frame)
+            # and mutable slabs (bytearray/ndarray/memoryview) are
+            # materialized exactly once, counted against the budget.
+            # The pooled-lease variant of this relay lives on the
+            # native lane (client/native_dn.py read/write paths).
             for f in data_frames:
-                yield bytes(f)
+                if isinstance(f, bytes):
+                    yield f
+                    continue
+                hostmem.count_copy(len(memoryview(f).cast("B")),
+                                   site="dn_service.stream_write_block",
+                                   warn=False)
+                yield bytes(f)  # ozlint: allow[datapath-no-copy] -- the single counted materialization grpc requires
 
         resp = self._ch.call_streaming(
             SERVICE, "StreamWriteBlock", frames(),
@@ -638,13 +683,8 @@ class GrpcDatanodeClient:
         def frames():
             yield wire.pack(meta)
             for info, data in chunks:
-                arr = np.asarray(
-                    np.frombuffer(data, dtype=np.uint8)
-                    if isinstance(data, (bytes, bytearray))
-                    else data,
-                    dtype=np.uint8,
-                )
-                yield wire.pack({"chunk": info.to_json()}, arr)
+                yield wire.pack({"chunk": info.to_json()},
+                                hostmem.as_array(data))
 
         self._ch.call_streaming(
             SERVICE, "WriteChunksCommit", frames(),

@@ -78,14 +78,41 @@ def load_lib() -> Optional[ctypes.CDLL]:
                                      _AUTH_CB, _DONE_CB, _FAIL_CB]
             lib.dp_port.restype = ctypes.c_int
             lib.dp_port.argtypes = [ctypes.c_void_p]
+            lib.dp_uds.restype = ctypes.c_int
+            lib.dp_uds.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                   ctypes.c_int]
             lib.dp_stop.argtypes = [ctypes.c_void_p]
             lib.dp_crc32c.restype = ctypes.c_uint32
             lib.dp_crc32c.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+            # buffer-pool capsule API (native arena lease/release)
+            lib.dp_buf_lease.restype = ctypes.c_void_p
+            lib.dp_buf_lease.argtypes = [ctypes.c_uint64]
+            lib.dp_buf_data.restype = ctypes.c_void_p
+            lib.dp_buf_data.argtypes = [ctypes.c_void_p]
+            lib.dp_buf_cap.restype = ctypes.c_uint64
+            lib.dp_buf_cap.argtypes = [ctypes.c_void_p]
+            lib.dp_buf_retain.argtypes = [ctypes.c_void_p]
+            lib.dp_buf_release.argtypes = [ctypes.c_void_p]
+            lib.dp_pool_stat.restype = ctypes.c_uint64
+            lib.dp_pool_stat.argtypes = [ctypes.c_int]
             _lib = lib
         except OSError as e:
             log.warning("native datapath unavailable: %s", e)
             _lib = None
         return _lib
+
+
+def native_pool_stats() -> Optional[dict]:
+    """Arena counters from the C++ side of the pool (the Python half
+    lives in codec/hostmem.py). None without the native toolchain."""
+    lib = load_lib()
+    if lib is None:
+        return None
+    return {
+        "leased_bytes": int(lib.dp_pool_stat(0)),
+        "free_bytes": int(lib.dp_pool_stat(1)),
+        "high_water_bytes": int(lib.dp_pool_stat(2)),
+    }
 
 
 def _pack_out(out, cap: int, ok: bool, body: bytes) -> int:
@@ -113,6 +140,9 @@ class DatapathSidecar:
         self.host = host
         self._want_port = port
         self.port: Optional[int] = None
+        #: abstract unix socket name ("@...") for the co-located lane;
+        #: None when the native side could not set one up
+        self.uds: Optional[str] = None
         self._handle = None
         # CFUNCTYPE wrappers must outlive the listener (GC'd callbacks
         # are a segfault from a C++ thread)
@@ -236,12 +266,21 @@ class DatapathSidecar:
                         self.host, self._want_port)
             return None
         self.port = lib.dp_port(self._handle)
-        log.info("native datapath listening on %s:%d (dn=%s)",
-                 self.host, self.port, self.dn.id)
+        buf = ctypes.create_string_buffer(128)
+        n = lib.dp_uds(self._handle, buf, len(buf))
+        self.uds = buf.raw[:n].decode() if n > 0 else None
+        log.info("native datapath listening on %s:%d uds=%s (dn=%s)",
+                 self.host, self.port, self.uds, self.dn.id)
         return self.port
+
+    def advertise(self) -> dict:
+        """GetDatapathInfo payload: TCP port plus the abstract unix
+        socket a co-located client should prefer."""
+        return {"port": self.port, "uds": self.uds}
 
     def stop(self) -> None:
         if self._handle is not None:
             load_lib().dp_stop(self._handle)
             self._handle = None
             self.port = None
+            self.uds = None

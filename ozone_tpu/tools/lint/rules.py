@@ -1,4 +1,4 @@
-"""The six production ozlint rules.
+"""The seven production ozlint rules.
 
 Each rule guards an invariant the repo states in prose and has already
 paid for in bugs (docs/LINT.md has the full origin stories):
@@ -21,6 +21,10 @@ paid for in bugs (docs/LINT.md has the full origin stories):
 - ``span-on-dispatch``      codec device-dispatch edges run inside an
   active trace span (the latency-attribution contract), and RPC
   handlers register only through net/rpc.py's span guard.
+- ``datapath-no-copy``      the wire-facing datapath modules never
+  materialize payload bytes (``bytes(...)``, ``.tobytes()``,
+  view ``.copy()``) — payloads travel as views over pooled buffers;
+  control-plane copies carry a reasoned suppression.
 """
 
 from __future__ import annotations
@@ -649,6 +653,74 @@ class DispatchShapeStability(Rule):
                     last_name(node.func) == "jit":
                 return True
         return False
+
+
+# ---------------------------------------------------- datapath-no-copy
+@register
+class DatapathNoCopy(Rule):
+    id = "datapath-no-copy"
+    summary = ("the wire-facing datapath modules must not materialize "
+               "payload bytes: no `bytes(...)`, `.tobytes()`, or "
+               "`.copy()` of a fresh buffer view")
+    rationale = (
+        "The zero-copy datapath contract: payloads travel as "
+        "memoryviews/ndarray views over pooled buffers "
+        "(codec/hostmem.py) from socket to chip. One stray "
+        "`bytes(frame)` on a 4 MiB chunk silently doubles the memory "
+        "traffic of every request that crosses it — exactly the class "
+        "of regression the copies/moved registry exists to catch. "
+        "Control-plane materializations (STATUS/JSON headers, the one "
+        "copy a transport's type contract forces) carry a reasoned "
+        "`# ozlint: allow[datapath-no-copy] -- why`.")
+
+    #: the wire-facing modules under the zero-copy contract
+    MODULES = {
+        ("client", "native_dn.py"),
+        ("client", "ec_writer.py"),
+        ("client", "ec_reader.py"),
+        ("net", "dn_service.py"),
+    }
+    #: `.copy()` on the RESULT of one of these producers is a fresh
+    #: view being materialized (np.frombuffer(...).copy() & friends)
+    VIEW_PRODUCERS = {"frombuffer", "payload_array", "asarray",
+                      "ascontiguousarray", "as_array"}
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if tuple(src.module_parts) not in self.MODULES:
+            return
+        for call, _fn in src.calls_with_fn:
+            name = last_name(call.func)
+            if isinstance(call.func, ast.Name) and name == "bytes":
+                # bytes(8) preallocates, bytes() is empty — neither
+                # copies a payload; bytes(buf) does
+                if len(call.args) == 1 and not call.keywords and not (
+                        isinstance(call.args[0], ast.Constant)
+                        and isinstance(call.args[0].value, int)):
+                    yield Finding(
+                        self.id, src.display_path, call.lineno,
+                        "`bytes(...)` materializes a payload copy — "
+                        "keep the memoryview/ndarray view (pooled "
+                        "lease), or suppress with a reason if this is "
+                        "control-plane framing",
+                        span=_span(call))
+            elif name == "tobytes" and isinstance(call.func,
+                                                  ast.Attribute):
+                yield Finding(
+                    self.id, src.display_path, call.lineno,
+                    "`.tobytes()` copies the array — pass the array "
+                    "itself (wire.pack and the socket layer take "
+                    "buffer views)",
+                    span=_span(call))
+            elif name == "copy" and isinstance(call.func, ast.Attribute) \
+                    and isinstance(call.func.value, ast.Call) and \
+                    last_name(call.func.value.func) in self.VIEW_PRODUCERS:
+                yield Finding(
+                    self.id, src.display_path, call.lineno,
+                    f"`{last_name(call.func.value.func)}(...).copy()` "
+                    f"defeats the zero-copy view it just made — return "
+                    f"the view; consumers that need ownership copy at "
+                    f"their edge (counted)",
+                    span=_span(call))
 
 
 # ---------------------------------------------------- error-swallowing

@@ -5,7 +5,7 @@ Three contracts:
    Seeding any fixed violation back (a literal socket timeout in
    client/native_dn.py, an unfenced background DeleteKey, a jit keyed
    on an erasure pattern) fails this suite.
-2. Each of the six rules demonstrably trips on its known-bad fixture
+2. Each of the seven rules demonstrably trips on its known-bad fixture
    and stays quiet on the known-good one (tests/lint_fixtures/).
 3. The CLI is fast and import-light: `python -m ozone_tpu.tools.lint
    --check` must run WITHOUT importing jax (OZONE_TPU_SKIP_JAX_PIN=1),
@@ -39,6 +39,7 @@ RULE_IDS = [
     "dispatch-shape-stability",
     "error-swallowing",
     "span-on-dispatch",
+    "datapath-no-copy",
 ]
 
 
@@ -50,7 +51,7 @@ def test_zero_findings_on_tree():
     assert not findings, format_findings(findings)
 
 
-def test_all_six_rules_registered():
+def test_all_seven_rules_registered():
     for rid in RULE_IDS:
         assert rid in RULES, f"rule {rid} not registered"
         assert RULES[rid].summary and RULES[rid].rationale
@@ -177,10 +178,10 @@ def test_seeding_fixed_violation_back_fails(tmp_path):
     literal socket timeout in client/native_dn.py) and the analyzer
     must catch it — proving the committed baseline actually guards."""
     real = (ROOT / "ozone_tpu" / "client" / "native_dn.py").read_text()
-    fenced = "timeout=resilience.op_timeout(_connect_timeout_s(), " \
+    fenced = "timeout = resilience.op_timeout(_connect_timeout_s(), " \
              "\"connect\")"
     assert fenced in real, "native_dn connect no longer fenced?"
-    seeded = real.replace(fenced, "timeout=120.0")
+    seeded = real.replace(fenced, "timeout = 120.0")
     findings = lint_source(seeded, path="ozone_tpu/client/native_dn.py")
     assert any(f.rule == "deadline-propagation" for f in findings), \
         format_findings(findings)

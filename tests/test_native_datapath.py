@@ -42,7 +42,7 @@ def cluster(tmp_path):
     port = sidecar.start()
     assert port is not None
     DatanodeGrpcService(dn, server,
-                        datapath_port=lambda: sidecar.port)
+                        datapath_port=sidecar.advertise)
     server.start()
     client = NativeDatanodeClient("dn0", server.address)
     yield dn, client, sidecar
@@ -211,7 +211,7 @@ def test_native_block_tokens_enforced(tmp_path):
     sidecar = DatapathSidecar(dn, verifier=verifier)
     assert sidecar.start() is not None
     DatanodeGrpcService(dn, server, verifier=verifier,
-                        datapath_port=lambda: sidecar.port)
+                        datapath_port=sidecar.advertise)
     server.start()
     data = _payload(8, 4096)
     cs = Checksum(ChecksumType.CRC32C, 16 * 1024).compute(data)

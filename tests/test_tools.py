@@ -541,6 +541,43 @@ def test_resilience_lint_no_hardcoded_timeouts_or_retry_sleeps():
     assert not findings, format_findings(findings)
 
 
+def test_libdatapath_rebuild_staleness():
+    """The native datapath .so must never be served stale: after
+    load_lib() the cached libdatapath.so is at least as new as
+    datapath.cpp, and build_shared's mtime probe recompiles an aged
+    artifact instead of loading it."""
+    import os
+    import shutil
+
+    from ozone_tpu.native import build_shared
+    from ozone_tpu.storage.fast_datapath import _SO, _SRC, load_lib
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain: native datapath runs as gRPC "
+                    "fallback; staleness check needs a compiler")
+    assert load_lib() is not None
+    assert _SO.stat().st_mtime >= _SRC.stat().st_mtime, \
+        "libdatapath.so is older than datapath.cpp — load_lib served " \
+        "a stale build"
+
+    # rebuild mechanics on a tiny source (sub-second compile)
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory() as td:
+        src = Path(td) / "probe.cpp"
+        src.write_text('extern "C" int probe() { return 1; }\n')
+        so = Path(td) / "libprobe.so"
+        assert build_shared(src, so) is not None
+        built = so.stat().st_mtime_ns
+        # age the artifact behind its source: must recompile, not reuse
+        os.utime(so, ns=(built - 10**10, built - 10**10))
+        src.write_text('extern "C" int probe() { return 2; }\n')
+        assert build_shared(src, so) is not None
+        assert so.stat().st_mtime_ns > built - 10**10, \
+            "build_shared reused a stale .so"
+
+
 def test_cli_version_and_getconf(capsys):
     assert cli_main(["version"]) == 0
     out = json.loads(capsys.readouterr().out)
