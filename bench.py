@@ -61,7 +61,8 @@ _STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None,
                 "meta_ops": None, "meta_scaling": None,
                 "meta_proc_ops": None, "meta_proc_scaling": None,
                 "meta_follower_hit": None,
-                "e2e_put": None, "e2e_get": None, "e2e_copies": None}
+                "e2e_put": None, "e2e_get": None, "e2e_copies": None,
+                "repair_econ": None, "lrc_repair_reduction": None}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
@@ -168,6 +169,11 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
             line["e2e_put_gib_s"] = round(_STATE["e2e_put"], 3)
             line["e2e_get_gib_s"] = round(_STATE["e2e_get"], 3)
             line["host_copies_per_chunk"] = round(_STATE["e2e_copies"], 3)
+        if _STATE["repair_econ"] is not None:
+            line["repair_econ"] = _STATE["repair_econ"]
+        if _STATE["lrc_repair_reduction"] is not None:
+            line["lrc_repair_reduction_x"] = round(
+                _STATE["lrc_repair_reduction"], 2)
         lat = tail_latencies_ms()
         if lat:
             line["latency_ms"] = lat
@@ -735,6 +741,141 @@ def bench_tiering(n_keys: int = 6, key_mib: int = 16,
     finally:
         cluster.close()
         shutil.rmtree(tmp, ignore_errors=True)
+
+
+def bench_repair_economics(cell: int = 16 * 1024, n_keys: int = 4) -> dict:
+    """Repair economics across the scheme family: RS(6,3) vs LRC(12,2,2)
+    vs wide RS(20,4), each on its own minicluster holding identical
+    objects. Per scheme: (a) repair ONE lost data chunk through the
+    reconstruction coordinator with a byte-counting spy on the survivor
+    clients -> `repair_bytes_per_lost_gib`, bytes read from survivors
+    per GiB of user data in the damaged block group (RS always reads k
+    units; an LRC local repair reads only the damaged group, half the
+    stripe for 12-2-2); (b) kill a whole datanode and time the
+    coalescing ReconstructionStorm -> `storm_wall_clock_s`; (c) the
+    storage-overhead column n/k. Byte-exact recovery is asserted for
+    both the chunk repair and every post-storm key read."""
+    import shutil
+    import tempfile
+    import time as _time
+    from pathlib import Path
+
+    from ozone_tpu.client.reconstruction import ReconstructionStorm
+    from ozone_tpu.scm.pipeline import ReplicationType
+    from ozone_tpu.storage.reconstruction import ReconstructionCommand
+    from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+    # 60 cells of user data divides k = 6, 12 and 20 into whole stripes,
+    # so every scheme stores the SAME object — the comparison is pure
+    # repair geometry, not object-size artifacts
+    S = 60 * cell
+    schemes = {}
+    for scheme, n_dn in (("rs-6-3", 11), ("lrc-12-2-2", 18),
+                         ("rs-20-4", 26)):
+        tmp = Path(tempfile.mkdtemp(prefix="ozone-bench-repair-"))
+        cluster = MiniOzoneCluster(
+            tmp, num_datanodes=n_dn, block_size=2 * S,
+            container_size=S + 64 * 1024,
+            stale_after_s=1000.0, dead_after_s=2000.0)
+        try:
+            oz = cluster.client()
+            b = oz.create_volume("econ").create_bucket(
+                "b", replication=f"{scheme}-{cell}")
+            rng = np.random.default_rng(23)
+            payloads = {}
+            for i in range(n_keys):
+                p = rng.integers(0, 256, S, dtype=np.uint8)
+                b.write_key(f"k{i}", p)
+                payloads[f"k{i}"] = p
+            cluster.heartbeat_all()
+
+            # byte spy: count chunk payload bytes served by survivors.
+            # LocalDatanodeClient.read_chunks routes through read_chunk,
+            # so wrapping read_chunk alone covers both verbs exactly once.
+            counter = {"bytes": 0}
+
+            def wrap(fn):
+                def spy(block_id, info, verify=False):
+                    data = fn(block_id, info, verify)
+                    counter["bytes"] += int(
+                        getattr(data, "nbytes", 0) or len(data))
+                    return data
+                return spy
+
+            for cl in cluster.clients._local.values():
+                cl.read_chunk = wrap(cl.read_chunk)
+
+            ec_containers = sorted(
+                (c for c in cluster.scm.containers.containers()
+                 if c.replication.type is ReplicationType.EC),
+                key=lambda c: c.id)
+            c0 = ec_containers[0]
+            ec = c0.replication.ec
+            # lose one DATA unit (replica_index 1..k): the lowest index,
+            # which for LRC sits in local group 0 -> a local repair
+            victim_dn, victim_idx = min(
+                ((dn, r.replica_index) for dn, r in c0.replicas.items()
+                 if 1 <= r.replica_index <= ec.data_units),
+                key=lambda t: t[1])
+            spare = next(d.id for d in cluster.datanodes
+                         if d.id not in c0.replicas)
+            cmd = ReconstructionCommand(
+                container_id=c0.id, replication=ec,
+                sources={r.replica_index: dn
+                         for dn, r in c0.replicas.items()
+                         if dn != victim_dn},
+                targets={victim_idx: spare})
+            storm = ReconstructionStorm(cluster.scm, cluster.clients)
+            before = counter["bytes"]
+            storm.coordinator.reconstruct_container_group(cmd)
+            read = counter["bytes"] - before
+            # byte-exact: the rebuilt replica on the spare must match
+            # the still-live original on the victim
+            src = cluster.datanode(victim_dn)
+            dst = cluster.datanode(spare)
+            for blk in src.list_blocks(c0.id):
+                rebuilt = dst.get_block(blk.block_id)
+                assert len(rebuilt.chunks) == len(blk.chunks)
+                for want_i, got_i in zip(blk.chunks, rebuilt.chunks):
+                    want = src.read_chunk(blk.block_id, want_i)
+                    got = dst.read_chunk(blk.block_id, got_i, verify=True)
+                    assert np.array_equal(want, got), "repair corrupt"
+
+            # register the rebuilt replica, then lose a whole node and
+            # time the fleet storm over everything it held
+            cluster.heartbeat_all()
+            dead = max((d.id for d in cluster.datanodes),
+                       key=lambda dn_id: sum(
+                           1 for c in ec_containers
+                           if dn_id in c.replicas))
+            cluster.stop_datanode(dead)
+            t0 = _time.monotonic()
+            report = storm.repair_datanode(dead)
+            wall = _time.monotonic() - t0
+            assert report.containers_failed == 0, report.failures
+            for name, p in payloads.items():
+                got = b.read_key(name)
+                assert np.array_equal(got, p), \
+                    f"{scheme} {name} corrupt after storm"
+            per_gib = int(read * (2**30 / S))
+            schemes[scheme] = {
+                "repair_bytes_per_lost_gib": per_gib,
+                "storm_wall_clock_s": round(wall, 3),
+                "storm_containers": report.containers_repaired,
+                "storage_overhead": round(
+                    ec.all_units / ec.data_units, 3),
+            }
+            log(f"  {scheme}: single-chunk repair read {read / S:.2f} "
+                f"GiB/affected-GiB ({read >> 10} KiB for a {S >> 10} "
+                f"KiB group), storm {report.containers_repaired} "
+                f"container(s) in {wall:.2f}s, overhead "
+                f"{ec.all_units / ec.data_units:.2f}x")
+        finally:
+            cluster.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+    rs63 = schemes["rs-6-3"]["repair_bytes_per_lost_gib"]
+    lrc = schemes["lrc-12-2-2"]["repair_bytes_per_lost_gib"]
+    return {"schemes": schemes, "lrc_vs_rs63_x": rs63 / lrc}
 
 
 def bench_e2e_datapath(chunk_mib: int = 4, n_chunks: int = 16,
@@ -1372,6 +1513,16 @@ def main() -> None:
                 f"{tier['dispatches']} dispatch(es)")
         except Exception as e:
             log(f"tiering bench failed: {e}")
+    if budget_for("repair-economics bench", 120):
+        try:
+            econ = bench_repair_economics()
+            _STATE["repair_econ"] = econ["schemes"]
+            _STATE["lrc_repair_reduction"] = econ["lrc_vs_rs63_x"]
+            log(f"repair economics (RS(6,3)/LRC(12,2,2)/RS(20,4)): "
+                f"LRC reads {econ['lrc_vs_rs63_x']:.2f}x fewer survivor "
+                f"bytes per affected GiB than RS(6,3)")
+        except Exception as e:
+            log(f"repair-economics bench failed: {e}")
     if budget_for("e2e datapath bench", 45):
         try:
             dp = bench_e2e_datapath()

@@ -31,6 +31,7 @@ from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_writer import BlockGroup, block_lengths
 from ozone_tpu.codec import hostmem
+from ozone_tpu.codec import lrc_math
 from ozone_tpu.codec import service as codec_service
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.fused import FusedSpec, make_fused_decoder
@@ -456,17 +457,23 @@ class ECBlockGroupReader:
             return self._decode_cell_traced(u, stripe)
 
     def _decode_cell_traced(self, u: int, stripe: int) -> np.ndarray:
-        others = [x for x in self.available_units() if x != u]
-        nodes = self.group.pipeline.nodes
-        order = {dn: i for i, dn in enumerate(
-            self._health.preferred([nodes[x] for x in others]))}
-        valid = sorted(sorted(
-            others, key=lambda x: order.get(nodes[x], len(order)))[: self.k])
-        if len(valid) < self.k:
-            raise InsufficientLocationsError(
-                f"hedge decode needs {self.k} units, reachable: {valid}")
+        if self.spec.options.codec == "lrc":
+            # the repair planner picks the minimal read set (the local
+            # group's survivors when u is singly lost in its group)
+            valid = self._choose_valid([u])
+        else:
+            others = [x for x in self.available_units() if x != u]
+            nodes = self.group.pipeline.nodes
+            order = {dn: i for i, dn in enumerate(
+                self._health.preferred([nodes[x] for x in others]))}
+            valid = sorted(sorted(
+                others,
+                key=lambda x: order.get(nodes[x], len(order)))[: self.k])
+            if len(valid) < self.k:
+                raise InsufficientLocationsError(
+                    f"hedge decode needs {self.k} units, reachable: {valid}")
         fn = make_fused_decoder(self.spec, valid, [u])
-        batch = np.zeros((1, self.k, self.cell), dtype=np.uint8)
+        batch = np.zeros((1, len(valid), self.cell), dtype=np.uint8)
         for vi, x in enumerate(valid):
             batch[0, vi] = self._peek_cell(x, stripe)
         svc = codec_service.maybe_service()
@@ -531,6 +538,29 @@ class ECBlockGroupReader:
     # ------------------------------------------------------------- degraded
     def _choose_valid(self, erased: Sequence[int]) -> list[int]:
         avail = [u for u in self.available_units() if u not in erased]
+        nodes = self.group.pipeline.nodes
+        if self.spec.options.codec == "lrc":
+            # LRC: the repair planner classifies the pattern — single
+            # in-group losses read the group's survivors (group_size
+            # units instead of k), everything else grows a minimal
+            # global read set.  Health and topology shape only the
+            # PREFERENCE order fed to the global path; the local read
+            # set is forced by geometry.
+            pref = sorted(avail)
+            if getattr(self.clients, "nearest_first", None) is not None:
+                order = {dn: i for i, dn in
+                         enumerate(self.clients.nearest_first(
+                             [nodes[u] for u in pref]))}
+                pref.sort(key=lambda u: order.get(nodes[u], len(order)))
+            usable = {u for u in pref if self._health.usable(nodes[u])}
+            if usable:
+                pref.sort(key=lambda u: u not in usable)  # stable
+            try:
+                valid, _kind = lrc_math.plan_valid(
+                    self.spec.options, list(erased), avail, prefer=pref)
+            except ValueError as e:
+                raise InsufficientLocationsError(str(e)) from None
+            return valid
         if len(avail) < self.k:
             raise InsufficientLocationsError(
                 f"need {self.k} units, reachable: {avail}, erased: {list(erased)}"
@@ -660,7 +690,10 @@ class ECBlockGroupReader:
         pipe = self._decode_pipe(valid, list(targets))
         pool = self._ensure_pool()
         for sb in batched(stripes, self._decode_batch):
-            batch = np.zeros((len(sb), self.k, self.cell), dtype=np.uint8)
+            # width = len(valid), not k: an LRC local repair reads only
+            # the lost unit's group (group_size survivors)
+            batch = np.zeros((len(sb), len(valid), self.cell),
+                             dtype=np.uint8)
 
             def fill_unit(vi_u):
                 vi, u = vi_u

@@ -111,8 +111,14 @@ class ReconstructionStorm:
         surviving holder per index as source, placement-chosen targets
         excluding every present holder AND the dead node. Containers
         with too few survivors are skipped (and counted by the caller
-        as unrecoverable) — a storm must never wedge on a lost cause."""
-        cmds: list[ReconstructionCommand] = []
+        as unrecoverable) — a storm must never wedge on a lost cause.
+
+        Commands come back sorted by recoverability, fewest surviving
+        indexes first: the stripes closest to losing data permanently
+        repair earliest, so a second failure mid-storm costs the least
+        (carry-over fix: PR 12's planner ordered containers by SCM
+        enumeration order)."""
+        cmds: list[tuple[int, ReconstructionCommand]] = []
         for c in self.scm.containers.containers():
             if c.replication.type is not ReplicationType.EC:
                 continue
@@ -135,7 +141,22 @@ class ReconstructionStorm:
                 set(range(1, ec.all_units + 1)) - set(present))
             if not missing:
                 continue  # dead replica's index survives elsewhere
-            if len(present) < ec.data_units:
+            if ec.codec == "lrc":
+                # LRC recoverability is pattern-shaped, not a survivor
+                # count: ask the repair planner whether the missing set
+                # is reachable from the surviving indexes (0-based)
+                from ozone_tpu.codec import lrc_math
+
+                try:
+                    lrc_math.plan_valid(
+                        ec, [i - 1 for i in missing],
+                        [i - 1 for i in present])
+                    recoverable = True
+                except ValueError:
+                    recoverable = False
+            else:
+                recoverable = len(present) >= ec.data_units
+            if not recoverable:
                 METRICS.counter("unrecoverable").inc()
                 log.warning(
                     "storm: container %s unrecoverable (%d/%d indexes "
@@ -150,13 +171,16 @@ class ReconstructionStorm:
                 METRICS.counter("placement_failures").inc()
                 log.exception("storm: no targets for container %s", c.id)
                 continue
-            cmds.append(ReconstructionCommand(
+            cmds.append((len(present), ReconstructionCommand(
                 container_id=c.id,
                 replication=ec,
                 sources=sources,
                 targets={i: n.dn_id for i, n in zip(missing, chosen)},
-            ))
-        return cmds
+            )))
+        # most-at-risk first: ascending surviving-index count, container
+        # id as the deterministic tiebreak
+        cmds.sort(key=lambda sc: (sc[0], sc[1].container_id))
+        return [cmd for _survivors, cmd in cmds]
 
     # ------------------------------------------------------------ drive
     def repair_datanode(self, dead_dn_id: str) -> StormReport:

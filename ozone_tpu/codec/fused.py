@@ -64,11 +64,18 @@ class FusedSpec:
 def _parity_matrix(options: CoderOptions) -> np.ndarray:
     """p x k GF(2^8) parity generator for the option's codec: Cauchy for
     RS, the all-ones row for XOR single parity (XORRawEncoder semantics —
-    parity = XOR of the k data units, coefficient 1 each)."""
+    parity = XOR of the k data units, coefficient 1 each).  LRC stacks
+    its local XOR rows and global Cauchy rows into one generator
+    (lrc_math.parity_matrix) so all l+r parities still cost ONE fused
+    matmul dispatch."""
     if options.codec == "xor":
         if options.parity_units != 1:
             raise ValueError("xor codec has exactly one parity unit")
         return np.ones((1, options.data_units), dtype=np.uint8)
+    if options.codec == "lrc":
+        from ozone_tpu.codec import lrc_math
+
+        return lrc_math.parity_matrix(options)
     return rs_math.parity_matrix(options.data_units, options.parity_units)
 
 
@@ -76,7 +83,15 @@ def _decode_matrix(options: CoderOptions, valid: list[int],
                    erased: list[int]) -> np.ndarray:
     """e x len(valid) GF(2^8) recovery matrix. RS inverts the surviving
     k x k submatrix (RSRawDecoder.java:133-157); XOR recovers its single
-    erasable unit as the XOR of everything else (XORRawDecoder)."""
+    erasable unit as the XOR of everything else (XORRawDecoder).  LRC
+    solves over an ARBITRARY read set (len(valid) may be the local group
+    size instead of k — lrc_math.recovery_rows), which downstream is
+    just a different traced-matrix shape, not a new program per
+    pattern."""
+    if options.codec == "lrc":
+        from ozone_tpu.codec import lrc_math
+
+        return lrc_math.recovery_rows(options, list(valid), list(erased))
     if options.codec == "xor":
         if len(erased) != 1:
             raise ValueError("xor codec recovers at most one erasure")

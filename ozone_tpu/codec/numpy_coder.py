@@ -59,6 +59,61 @@ class NumpyRSDecoder(RawErasureDecoder):
         return _gf_apply(dm, valid_data)
 
 
+class NumpyLRCEncoder(RawErasureEncoder):
+    """Locally-repairable code encoder: one stacked (l+r) x k generator
+    (local XOR rows + global Cauchy rows, codec/lrc_math.py) applied in
+    a single pass — the CPU ground truth for the fused LRC matmul."""
+
+    def __init__(self, options: CoderOptions):
+        from ozone_tpu.codec import lrc_math
+
+        super().__init__(options)
+        self._pm = lrc_math.parity_matrix(options)
+
+    def do_encode(self, data: np.ndarray) -> np.ndarray:
+        return _gf_apply(self._pm, data)
+
+
+class NumpyLRCDecoder(RawErasureDecoder):
+    """LRC decoder with the local-repair planner in front: single
+    in-group erasures read group survivors (group_size units, not k);
+    multi-loss groups or lost globals fall back to a global solve over a
+    grown-and-pruned read set.  Overrides decode() because the base
+    contract's first-k read-set selection is an RS-ism — an LRC read set
+    may be smaller than k (local) and first-k may even be singular."""
+
+    def __init__(self, options: CoderOptions):
+        from ozone_tpu.codec import lrc_math
+
+        super().__init__(options)
+        self._lrc = lrc_math
+
+    def decode(self, inputs, erased_indexes):
+        n = self.options.all_units
+        if len(inputs) != n:
+            raise ValueError(f"inputs must have length {n}, got {len(inputs)}")
+        erased = [int(e) for e in erased_indexes]
+        if not erased:
+            raise ValueError("erased_indexes must not be empty")
+        for e in erased:
+            if not 0 <= e < n:
+                raise ValueError(f"erased index {e} out of range")
+            if inputs[e] is not None:
+                raise ValueError(f"erased index {e} has a non-null input")
+        avail = [i for i, b in enumerate(inputs) if b is not None]
+        valid, _kind = self._lrc.plan_valid(self.options, erased, avail)
+        dense = np.stack([np.asarray(inputs[i], dtype=np.uint8) for i in valid])
+        if dense.ndim == 2:
+            return self.do_decode(dense[None], valid, erased)[0]
+        elif dense.ndim == 3:
+            return self.do_decode(np.swapaxes(dense, 0, 1), valid, erased)
+        raise ValueError(f"bad input rank {dense.ndim}")
+
+    def do_decode(self, valid_data, valid, erased):
+        dm = self._lrc.recovery_rows(self.options, valid, erased)
+        return _gf_apply(dm, valid_data)
+
+
 class NumpyXOREncoder(RawErasureEncoder):
     """Single-parity XOR (reference XORRawEncoder.java)."""
 

@@ -20,6 +20,22 @@ log = logging.getLogger(__name__)
 EncoderFactory = Callable[[CoderOptions], RawErasureEncoder]
 DecoderFactory = Callable[[CoderOptions], RawErasureDecoder]
 
+#: Codec families _register_defaults always provides.  CoderOptions.parse
+#: validates against known_families() below, which must NOT instantiate
+#: the registry (that would eagerly import the jax backend inside every
+#: host-only tool that merely parses a replication string).
+_DEFAULT_FAMILIES = ("dummy", "lrc", "rs", "xor")
+
+
+def known_families() -> tuple[str, ...]:
+    """Codec family names a CoderOptions string may use, sorted.  Reads
+    the live registry when one exists (so test-registered codecs parse),
+    else the default family list — without triggering backend imports."""
+    reg = CodecRegistry._instance
+    if reg is None:
+        return _DEFAULT_FAMILIES
+    return tuple(sorted(set(_DEFAULT_FAMILIES) | set(reg._factories)))
+
 
 class _Factory:
     def __init__(self, name: str, priority: int, make_encoder, make_decoder):
@@ -76,6 +92,13 @@ class CodecRegistry:
         )
         self.register(
             "dummy", "numpy", 10, numpy_coder.DummyEncoder, numpy_coder.DummyDecoder
+        )
+        self.register(
+            "lrc",
+            "numpy",
+            10,
+            numpy_coder.NumpyLRCEncoder,
+            numpy_coder.NumpyLRCDecoder,
         )
         # C++ backend (ISA-L-class nibble-shuffle kernels): preferred over
         # numpy, below the TPU backend — mirrors the reference's

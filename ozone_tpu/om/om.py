@@ -397,6 +397,9 @@ class OzoneManager:
     ) -> None:
         self.check_access(volume, None, None, "CREATE")
         self.check_shard(volume, bucket)
+        # fail fast on a bad scheme string (unknown codec family, bad
+        # LRC geometry) instead of storing it and erroring at first put
+        ReplicationConfig.parse(replication)
         self.submit(rq.CreateBucket(volume, bucket, replication, layout,
                                     encryption_key=encryption_key,
                                     gdpr=gdpr))
@@ -1075,6 +1078,7 @@ class OzoneManager:
                                replication: str) -> dict:
         volume, bucket = self.resolve_bucket(volume, bucket)
         self.check_access(volume, bucket, None, "WRITE")
+        ReplicationConfig.parse(replication)  # same fail-fast as create
         return self.submit(
             rq.SetBucketReplication(volume, bucket, replication))
 

@@ -57,10 +57,16 @@ _CHECK_KW = ("check_vma"
              if "check_vma" in inspect.signature(shard_map).parameters
              else "check_rep")
 
-from ozone_tpu.codec import crc_device, rs_math
+from ozone_tpu.codec import crc_device
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.codec.bitlin import expand_coding_matrix
-from ozone_tpu.codec.fused import FusedSpec, _POLY, crc_plan_cached
+from ozone_tpu.codec.fused import (
+    FusedSpec,
+    _POLY,
+    _decode_matrix,
+    _parity_matrix,
+    crc_plan_cached,
+)
 from ozone_tpu.codec.jax_coder import (
     _gf_dot,
     bits_to_bytes,
@@ -114,9 +120,7 @@ def _sharded_fused_encoder_cached(
     axis: str,
 ):
     a = jnp.asarray(
-        expand_coding_matrix(
-            rs_math.parity_matrix(options.data_units, options.parity_units)
-        ),
+        expand_coding_matrix(_parity_matrix(options)),
         dtype=jnp.int8,
     )
     if checksum in _POLY:
@@ -201,9 +205,7 @@ def _sharded_decode_plan_cached(
     """Per-pattern decode matrix for the sharded path; cheap host work,
     shared executable above, CRC constants shared via
     fused.crc_plan_cached."""
-    dm = rs_math.decode_matrix(
-        options.data_units, options.parity_units, list(erased), list(valid)
-    )
+    dm = _decode_matrix(options, list(valid), list(erased))
     return jnp.asarray(expand_coding_matrix(dm), dtype=jnp.int8)
 
 
@@ -232,7 +234,7 @@ def _tp_encoder_cached(options: CoderOptions, mesh: Mesh, axis: str):
     n = mesh.devices.size
     if k % n:
         raise ValueError(f"TP encode requires k % mesh == 0, got {k} % {n}")
-    a_np = expand_coding_matrix(rs_math.parity_matrix(k, p))  # [k*8, p*8]
+    a_np = expand_coding_matrix(_parity_matrix(options))  # [k*8, p*8]
     a = jnp.asarray(a_np, dtype=jnp.int8)
 
     @partial(
@@ -274,9 +276,7 @@ def _ring_decode_plan_cached(
     k = len(valid)
     e = len(erased)
     upc = -(-k // n)  # units per chip, survivors zero-padded to upc * n
-    dm = rs_math.decode_matrix(
-        options.data_units, options.parity_units, list(erased), list(valid)
-    )  # GF(2^8) [e, k]
+    dm = _decode_matrix(options, list(valid), list(erased))  # GF [e, k]
     a_np = expand_coding_matrix(dm)  # [k*8, e*8]
     if upc * n != k:
         # zero matrix rows for the padded survivor slots: a zero unit

@@ -51,6 +51,36 @@ def test_zero_findings_on_tree():
     assert not findings, format_findings(findings)
 
 
+def test_dispatch_shape_stability_covers_lrc_math(tmp_path):
+    """The LRC repair planner is dispatch-adjacent code: its recovery
+    matrices feed the fused decode as TRACED arguments, so the shipped
+    codec/lrc_math.py must stay clean under dispatch-shape-stability —
+    and an lrc-flavored plan factory that jits per erasure pattern must
+    still trip the rule (the scope covers the new module, not just the
+    rs-era ones)."""
+    findings = lint_paths(
+        [str(ROOT / "ozone_tpu" / "codec" / "lrc_math.py")],
+        root=str(ROOT))
+    assert not [f for f in findings
+                if f.rule == "dispatch-shape-stability"], \
+        format_findings(findings)
+
+    bad = tmp_path / "bad_lrc_plan.py"
+    bad.write_text(
+        "# ozlint: path ozone_tpu/codec/lrc_plan.py\n"
+        "from functools import lru_cache\n"
+        "import jax\n\n\n"
+        "@lru_cache(maxsize=512)\n"
+        "def lrc_repair_plan(options, erased):\n"
+        "    @jax.jit\n"
+        "    def fn(units):\n"
+        "        return units\n\n"
+        "    return fn\n")
+    findings = lint_paths([str(bad)])
+    assert any(f.rule == "dispatch-shape-stability" for f in findings), \
+        "per-pattern jitted LRC plan factory must trip the rule"
+
+
 def test_all_seven_rules_registered():
     for rid in RULE_IDS:
         assert rid in RULES, f"rule {rid} not registered"

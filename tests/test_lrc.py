@@ -1,0 +1,475 @@
+"""Locally-repairable + wide code tests: byte-exact LRC encode/decode
+vs the numpy reference over every single- and double-erasure pattern,
+repair-planner read-set minimality (a local repair reads exactly
+group-size units, spied at the DN clients), zero-recompile pattern
+churn through the fused plan cache, a ReconstructionStorm drill over
+LRC containers proving coalesced mesh dispatches still hold, storm
+ordering by recoverability, lifecycle tiering to LRC targets, and wide
+RS(20,4) end-to-end."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from tests.test_ec_pipeline import MiniEC, _read_key, _write_key
+from ozone_tpu.codec import lrc_math, registry
+from ozone_tpu.codec.api import CoderOptions
+from ozone_tpu.codec.numpy_coder import _gf_apply
+
+CELL = 4096
+LRC = CoderOptions(12, 4, "lrc", cell_size=CELL, local_groups=2)
+
+
+# ------------------------------------------------------------------ parse
+def test_parse_roundtrip_and_geometry():
+    o = CoderOptions.parse("lrc-12-2-2")
+    assert o == CoderOptions(12, 4, "lrc", local_groups=2)
+    assert o.group_size == 6 and o.global_parities == 2
+    assert o.all_units == 16
+    assert str(o) == "lrc-12-2-2-1m"
+    assert CoderOptions.parse(str(o)) == o
+    o2 = CoderOptions.parse("lrc-12-2-2-4096")
+    assert o2.cell_size == 4096 and str(o2) == "lrc-12-2-2-4k"
+    # wide RS parses as plain rs with a 24-unit group
+    w = CoderOptions.parse("rs-20-4")
+    assert (w.data_units, w.parity_units, w.local_groups) == (20, 4, 0)
+
+
+def test_parse_rejects_unknown_codec_with_supported_list():
+    """Satellite: "foo-6-3" must fail AT PARSE with the family list,
+    not round-trip silently and explode at coder creation."""
+    with pytest.raises(ValueError, match="supported families.*rs"):
+        CoderOptions.parse("foo-6-3")
+    with pytest.raises(ValueError, match="unknown EC codec"):
+        CoderOptions.parse("foo-6-3-1024k")
+
+
+def test_parse_rejects_bad_lrc_geometry():
+    with pytest.raises(ValueError):
+        CoderOptions.parse("lrc-12-2")  # missing r
+    with pytest.raises(ValueError):
+        CoderOptions.parse("lrc-12-5-2")  # 12 % 5 != 0
+    with pytest.raises(ValueError):
+        CoderOptions(12, 2, "lrc", local_groups=2)  # no global parity
+    with pytest.raises(ValueError):
+        CoderOptions(6, 3, "rs", local_groups=2)  # groups on non-lrc
+
+
+# ------------------------------------------------------------- math/codec
+def test_generator_shape_and_local_rows():
+    pm = lrc_math.parity_matrix(LRC)
+    assert pm.shape == (4, 12)
+    # local rows are XOR indicators over their group
+    assert np.array_equal(pm[0], np.array([1] * 6 + [0] * 6, np.uint8))
+    assert np.array_equal(pm[1], np.array([0] * 6 + [1] * 6, np.uint8))
+    # global rows touch every data unit with nonzero coefficients
+    assert np.all(pm[2:] != 0)
+
+
+def test_lrc_all_single_and_double_erasures_byte_exact():
+    """Every 1- and 2-erasure pattern of LRC(12,2,2) decodes byte-exact
+    against the raw generator (numpy reference backend)."""
+    enc = registry.create_encoder(LRC, backend="numpy")
+    dec = registry.create_decoder(LRC, backend="numpy")
+    rng = np.random.default_rng(0)
+    C = 64
+    data = rng.integers(0, 256, (12, C), dtype=np.uint8)
+    units = np.concatenate([data, enc.encode(data)], axis=0)
+    n = LRC.all_units
+    pats = [list(p) for r in (1, 2)
+            for p in itertools.combinations(range(n), r)]
+    assert len(pats) == 16 + 120
+    for pat in pats:
+        inputs = [None if i in pat else units[i] for i in range(n)]
+        out = dec.decode(inputs, pat)
+        assert np.array_equal(out, units[pat]), pat
+
+
+def test_planner_classification_and_read_sets():
+    n = LRC.all_units
+    healthy = list(range(n))
+
+    def plan(erased):
+        return lrc_math.plan_valid(
+            LRC, erased, [u for u in healthy if u not in erased])
+
+    # single data loss: local, reads the 5 group siblings + local parity
+    valid, kind = plan([2])
+    assert kind == "local" and valid == [0, 1, 3, 4, 5, 12]
+    # single local-parity loss: local, reads its 6 data units
+    valid, kind = plan([13])
+    assert kind == "local" and valid == [6, 7, 8, 9, 10, 11]
+    # one loss in EACH group: still local, 6 reads per group
+    valid, kind = plan([0, 7])
+    assert kind == "local" and len(valid) == 12
+    assert set(valid) == ({1, 2, 3, 4, 5, 12} | {6, 8, 9, 10, 11, 13})
+    # two losses in ONE group: global decode
+    valid, kind = plan([0, 1])
+    assert kind == "global"
+    # a lost global parity needs a global re-encode read
+    valid, kind = plan([14])
+    assert kind == "global" and len(valid) == 12
+    # repair economics: any single data/local loss reads group_size
+    for e in range(14):
+        assert lrc_math.repair_read_units(LRC, [e]) == 6
+    # unrecoverable: a whole group + its local + a global beyond r+1
+    with pytest.raises(ValueError):
+        plan([0, 1, 2, 3, 12, 14])
+
+
+def test_recovery_rows_arbitrary_read_sets():
+    """The GF solver recovers from read sets of ANY width: smaller than
+    k (local), exactly k, and over-complete (redundant columns 0)."""
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (12, 32), dtype=np.uint8)
+    units = np.concatenate(
+        [data, _gf_apply(lrc_math.parity_matrix(LRC), data[None])[0]])
+    # local: 6-wide
+    rows = lrc_math.recovery_rows(LRC, [0, 1, 3, 4, 5, 12], [2])
+    assert rows.shape == (1, 6)
+    got = _gf_apply(rows, units[None, [0, 1, 3, 4, 5, 12]])[0]
+    assert np.array_equal(got, units[[2]])
+    # over-complete: 14 survivors for a 2-erasure, redundant cols solve 0
+    valid = [u for u in range(16) if u not in (0, 13)]
+    rows = lrc_math.recovery_rows(LRC, valid, [0, 13])
+    got = _gf_apply(rows, units[None, valid])[0]
+    assert np.array_equal(got, units[[0, 13]])
+
+
+# ------------------------------------------------------------- fused path
+def test_fused_lrc_encode_decode_matches_numpy(monkeypatch):
+    monkeypatch.setenv("OZONE_TPU_FUSED_BACKEND", "jax")
+    from ozone_tpu.codec import fused
+    from ozone_tpu.utils.checksum import Checksum, ChecksumType
+
+    opts = CoderOptions(12, 4, "lrc", cell_size=2048, local_groups=2)
+    spec = fused.FusedSpec(opts, ChecksumType.CRC32C, 512)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (3, 12, 2048), dtype=np.uint8)
+    parity, crcs = (np.asarray(x)
+                    for x in fused.make_fused_encoder(spec)(data))
+    assert np.array_equal(parity,
+                          _gf_apply(lrc_math.parity_matrix(opts), data))
+    units = np.concatenate([data, parity], axis=1)
+    host = Checksum(ChecksumType.CRC32C, 512)
+    for erased in ([3], [12], [14], [0, 1], [5, 15]):
+        valid, _ = lrc_math.plan_valid(
+            opts, erased, [u for u in range(16) if u not in erased])
+        fn = fused.make_fused_decoder(spec, valid, erased)
+        rec, rcrc = (np.asarray(x) for x in fn(units[:, valid]))
+        assert np.array_equal(rec, units[:, erased]), erased
+        got = tuple(int(v).to_bytes(4, "big") for v in rcrc[0, 0].tolist())
+        assert got == host.compute(units[0, erased[0]]).checksums, erased
+
+
+def test_lrc_pattern_churn_zero_recompiles(monkeypatch):
+    """Acceptance: a NEW LRC erasure pattern swaps a device matrix,
+    never compiles a new program — one executable per decode width
+    (group_size for local repairs, k for global) serves all patterns."""
+    monkeypatch.setenv("OZONE_TPU_FUSED_BACKEND", "jax")
+    from ozone_tpu.codec import fused
+    from ozone_tpu.utils.checksum import ChecksumType
+
+    opts = CoderOptions(12, 4, "lrc", cell_size=1024, local_groups=2)
+    spec = fused.FusedSpec(opts, ChecksumType.CRC32C, 512)
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, (2, 12, 1024), dtype=np.uint8)
+    parity, _ = (np.asarray(x)
+                 for x in fused.make_fused_encoder(spec)(data))
+    units = np.concatenate([data, parity], axis=1)
+
+    def run(erased):
+        valid, _ = lrc_math.plan_valid(
+            opts, erased, [u for u in range(16) if u not in erased])
+        rec, _ = fused.make_fused_decoder(spec, valid, erased)(
+            units[:, valid])
+        assert np.array_equal(np.asarray(rec), units[:, erased]), erased
+        return len(valid)
+
+    # warm one local-width and one global-width program
+    assert run([0]) == 6
+    run([0, 1])
+    before = fused.decode_jit_cache_size()
+    # churn: every remaining single erasure (locals) + assorted globals
+    for e in range(1, 14):
+        assert run([e]) == 6
+    for pat in ([2, 3], [8, 9], [14, 15], [0, 12]):
+        run(list(pat))
+    grew = fused.decode_jit_cache_size() - before
+    assert grew == 0, (
+        f"{grew} recompile(s) across LRC erasure-pattern churn — "
+        "patterns must reuse the per-shape executables")
+
+
+# ----------------------------------------------------------- reader/spy
+def _spy_reads(clients):
+    """Wrap every local DN client's chunk reads with a per-DN counter."""
+    counts: dict[str, int] = {}
+
+    def wrap(dn_id, fn):
+        def spy(*a, **kw):
+            counts[dn_id] = counts.get(dn_id, 0) + 1
+            return fn(*a, **kw)
+        return spy
+
+    for dn_id, c in clients._local.items():
+        c.read_chunk = wrap(dn_id, c.read_chunk)
+        c.read_chunks = wrap(dn_id, c.read_chunks)
+    return counts
+
+
+def test_local_repair_reads_exactly_group_size_units(tmp_path):
+    """Satellite: repairing one lost unit of LRC(12,2,2) touches exactly
+    group_size datanodes — the lost unit's group siblings and its local
+    parity — never the k=12 an RS repair would read."""
+    opts = CoderOptions(12, 4, "lrc", cell_size=CELL, local_groups=2)
+    cluster = MiniEC(tmp_path, n_dn=17, opts=opts)
+    try:
+        rng = np.random.default_rng(11)
+        data = rng.integers(0, 256, 12 * 2 * CELL, dtype=np.uint8)
+        groups = _write_key(cluster, data)
+        g = groups[0]
+        lost = 2  # data unit in group 0
+        counts = _spy_reads(cluster.clients)
+        rec = cluster.reader(g).recover_cells([lost])
+        expect_dns = {g.pipeline.nodes[u]
+                      for u in (0, 1, 3, 4, 5, 12)}
+        assert set(counts) == expect_dns, (
+            f"local repair read {sorted(counts)}, wanted exactly the "
+            f"group survivors {sorted(expect_dns)}")
+        assert len(counts) == opts.group_size
+        # byte-exact against the unit's real content
+        stripes = -(-g.length // (12 * CELL))
+        want = np.zeros((stripes, CELL), np.uint8)
+        flat = np.zeros(12 * stripes * CELL, np.uint8)
+        flat[:data.size] = data
+        cells = flat.reshape(stripes, 12, CELL)
+        want = cells[:, lost, :]
+        assert np.array_equal(rec[:, 0, :], want)
+    finally:
+        cluster.close()
+
+
+def test_lrc_degraded_read_byte_exact(tmp_path):
+    """Kill a data unit's node: the degraded read path must decode
+    through the planner and still return the key byte-exact."""
+    opts = CoderOptions(12, 4, "lrc", cell_size=CELL, local_groups=2)
+    cluster = MiniEC(tmp_path, n_dn=17, opts=opts)
+    try:
+        rng = np.random.default_rng(13)
+        data = rng.integers(0, 256, 12 * 3 * CELL + 777, dtype=np.uint8)
+        groups = _write_key(cluster, data)
+        from ozone_tpu.storage.ids import StorageError
+
+        for g in groups:
+            dn_id = g.pipeline.nodes[4]
+            dn = next(d for d in cluster.dns if d.id == dn_id)
+            try:
+                dn.delete_block(g.block_id)
+            except StorageError:
+                pass
+        got = _read_key(cluster, groups)
+        assert np.array_equal(got, data)
+    finally:
+        cluster.close()
+
+
+def test_wide_rs_write_read_and_repair(tmp_path):
+    """rs-20-4: the 24-unit wide group writes, reads, and repairs a
+    lost unit through the unchanged RS machinery."""
+    opts = CoderOptions(20, 4, "rs", cell_size=CELL)
+    cluster = MiniEC(tmp_path, n_dn=25, opts=opts)
+    try:
+        rng = np.random.default_rng(17)
+        data = rng.integers(0, 256, 20 * 2 * CELL + 99, dtype=np.uint8)
+        groups = _write_key(cluster, data)
+        assert np.array_equal(_read_key(cluster, groups), data)
+        g = groups[0]
+        counts = _spy_reads(cluster.clients)
+        cluster.reader(g).recover_cells([7])
+        # RS repair reads k=20 units — the baseline LRC undercuts
+        assert len(counts) == 20
+    finally:
+        cluster.close()
+
+
+# ------------------------------------------------------------ storm drill
+def test_lrc_storm_drill_coalesced_dispatches(tmp_path):
+    """ReconstructionStorm over LRC containers: every container a dead
+    node held repairs byte-exact AND the decode batches still coalesce
+    into multi-stripe mesh dispatches (the PR 12 accounting holds for
+    local-width LRC decodes)."""
+    from ozone_tpu.client.reconstruction import ReconstructionStorm
+    from ozone_tpu.scm.pipeline import ReplicationType
+    from ozone_tpu.storage.ids import StorageError
+    from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+    cluster = MiniOzoneCluster(
+        tmp_path, num_datanodes=10, container_size=100 * 1024,
+        stale_after_s=1000.0, dead_after_s=2000.0)
+    try:
+        oz = cluster.client()
+        bucket = oz.create_volume("storm").create_bucket(
+            "b", replication=f"lrc-4-2-2-{CELL}")
+        rng = np.random.default_rng(42)
+        key_bytes = 6 * 4 * CELL  # 6 full stripes, one group per container
+        for i in range(12):
+            bucket.write_key(
+                f"k{i}", rng.integers(0, 256, key_bytes, dtype=np.uint8))
+        cluster.heartbeat_all()
+
+        held: dict[str, list] = {}
+        for c in cluster.scm.containers.containers():
+            if c.replication.type is ReplicationType.EC:
+                for dn_id in c.replicas:
+                    held.setdefault(dn_id, []).append(c)
+        victim = max(held, key=lambda d: len(held[d]))
+        victim_containers = held[victim]
+        assert len(victim_containers) >= 4
+        victim_dn = cluster.datanode(victim)
+        truth = {}
+        for c in victim_containers:
+            blocks = []
+            for bd in victim_dn.list_blocks(c.id):
+                chunks = [victim_dn.read_chunk(bd.block_id, info)
+                          for info in bd.chunks]
+                blocks.append((bd.block_id, chunks))
+            truth[c.id] = (c.replicas[victim].replica_index, blocks)
+
+        cluster.stop_datanode(victim)
+        report = ReconstructionStorm(
+            cluster.scm, cluster.clients).repair_datanode(victim)
+        assert report.ok, f"storm failures: {report.failures}"
+        assert report.containers_unrecoverable == 0
+        # coalescing proof, same bar as the RS drill
+        assert report.mesh_dispatches > 0, "storm never reached the mesh"
+        assert report.mesh_stripes >= 2 * report.mesh_dispatches, (
+            f"no batching: {report.mesh_stripes} stripes over "
+            f"{report.mesh_dispatches} dispatches")
+
+        for c in victim_containers:
+            idx, blocks = truth[c.id]
+            home = None
+            for dn in cluster.datanodes:
+                if dn.id == victim:
+                    continue
+                try:
+                    rep = dn.get_container(c.id)
+                except StorageError:
+                    continue
+                if rep.replica_index == idx:
+                    home = dn
+                    break
+            assert home is not None, f"container {c.id} idx {idx} lost"
+            for block_id, chunks in blocks:
+                blk = home.get_block(block_id)
+                for info, want in zip(blk.chunks, chunks):
+                    got = home.read_chunk(block_id, info, verify=True)
+                    assert np.array_equal(got, want)
+    finally:
+        cluster.close()
+
+
+def test_storm_plan_orders_most_at_risk_first(tmp_path):
+    """Carry-over fix: the storm plans the containers with the fewest
+    surviving indexes first, so the stripes closest to data loss repair
+    earliest."""
+    from ozone_tpu.client.reconstruction import ReconstructionStorm
+    from ozone_tpu.scm.pipeline import ReplicationType
+    from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+    cluster = MiniOzoneCluster(
+        tmp_path, num_datanodes=8, container_size=100 * 1024,
+        stale_after_s=1000.0, dead_after_s=2000.0)
+    try:
+        oz = cluster.client()
+        bucket = oz.create_volume("v").create_bucket(
+            "b", replication=f"rs-3-2-{CELL}")
+        rng = np.random.default_rng(3)
+        for i in range(6):
+            bucket.write_key(
+                f"k{i}", rng.integers(0, 256, 8 * 3 * CELL, dtype=np.uint8))
+        cluster.heartbeat_all()
+
+        ec = [c for c in cluster.scm.containers.containers()
+              if c.replication.type is ReplicationType.EC]
+        held: dict[str, list] = {}
+        for c in ec:
+            for dn_id in c.replicas:
+                held.setdefault(dn_id, []).append(c)
+        victim = max(held, key=lambda d: len(held[d]))
+        victim_cs = held[victim]
+        assert len(victim_cs) >= 2
+        # knock one EXTRA sibling replica off one victim container: it
+        # now has fewer survivors than its peers and must plan FIRST
+        weakest = victim_cs[-1]
+        other = next(d for d in sorted(weakest.replicas) if d != victim)
+        cluster.datanode(other).delete_container(weakest.id, force=True)
+        del weakest.replicas[other]
+        cluster.stop_datanode(victim)
+
+        cmds = ReconstructionStorm(
+            cluster.scm, cluster.clients).plan(victim)
+        assert cmds, "nothing planned"
+        assert cmds[0].container_id == weakest.id, (
+            "most at-risk container (fewest survivors) must repair first")
+    finally:
+        cluster.close()
+
+
+# -------------------------------------------------------------- lifecycle
+def test_lifecycle_tiering_to_lrc_target(tmp_path):
+    """TRANSITION_TO_EC accepts an LRC scheme: replicated keys tier to
+    lrc-4-2-2 containers through the existing TieringExecutor and read
+    back byte-exact."""
+    from ozone_tpu.lifecycle.service import LifecycleService
+    from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+    cluster = MiniOzoneCluster(
+        tmp_path, num_datanodes=10, block_size=8 * CELL,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0, dead_after_s=2000.0)
+    try:
+        oz = cluster.client()
+        b = oz.create_volume("v").create_bucket(
+            "b", replication="RATIS/THREE")
+        rng = np.random.default_rng(23)
+        datas = {}
+        for i in range(2):
+            d = rng.integers(0, 256, 4 * 4 * CELL + 31, dtype=np.uint8)
+            b.write_key(f"cold-{i}", d)
+            datas[f"cold-{i}"] = d
+        cluster.om.set_bucket_lifecycle("v", "b", [
+            {"id": "warm", "prefix": "cold-", "age_days": 0,
+             "action": "TRANSITION_TO_EC",
+             "target": f"lrc-4-2-2-{CELL}"}])
+        svc = LifecycleService(cluster.om, clients=cluster.clients)
+        stats = svc.run_once()
+        assert stats["transitioned"] == 2, stats
+        for name, want in datas.items():
+            info = cluster.om.lookup_key("v", "b", name)
+            assert info["replication"] == f"lrc-4-2-2-{CELL}"
+            assert np.array_equal(b.read_key(name), want)
+    finally:
+        cluster.close()
+
+
+def test_bucket_create_rejects_bad_scheme_eagerly(tmp_path):
+    """The OM fails fast on a bad scheme string at bucket create and
+    set-replication time — an unknown family or broken LRC geometry
+    must not be stored and left to explode at first put."""
+    from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+    cluster = MiniOzoneCluster(tmp_path, num_datanodes=1)
+    try:
+        v = cluster.client().create_volume("v")
+        with pytest.raises(ValueError, match="supported families"):
+            v.create_bucket("bad", replication="zfec-6-3-4096")
+        with pytest.raises(ValueError, match="local groups"):
+            v.create_bucket("bad2", replication="lrc-5-2-2-4096")
+        v.create_bucket("ok", replication=f"lrc-4-2-2-{CELL}")
+        with pytest.raises(ValueError, match="supported families"):
+            cluster.om.set_bucket_replication("v", "ok", "zfec-6-3")
+    finally:
+        cluster.close()
