@@ -1,0 +1,54 @@
+"""End-to-end overload protection for the object store's request path.
+
+Admission control answers the question every hop otherwise answers
+implicitly (and badly, by queuing): *should this request be allowed to
+start work right now?* The package provides:
+
+- :mod:`bucket` — per-tenant ops/s + bytes/s token buckets;
+- :mod:`shed` — SLO-driven shedding off live latency/backlog signals;
+- :mod:`controller` — the per-hop front door combining both with an
+  explicit bounded in-flight queue, plus the tenant-identity context
+  that carries gateway auth into the codec QoS lanes.
+
+Every rejection is a ``StorageError(SERVER_BUSY)`` with a
+``retry_after_s=...`` hint: deterministic, observable (per-hop,
+per-reason counters in the ``admission`` registry), and mapped to
+S3 503 ``SlowDown`` + ``Retry-After`` at the gateway. Clients treat it
+as backoff-not-failure (see ``client.resilience``).
+"""
+
+from ozone_tpu.admission.bucket import TenantBuckets
+from ozone_tpu.admission.controller import (
+    METRICS,
+    SERVER_BUSY,
+    AdmissionController,
+    InflightGate,
+    ambient_qos,
+    busy_error,
+    controller,
+    controllers,
+    current_tenant,
+    qos_class_for,
+    reset_for_tests,
+    retry_after_hint,
+    tenant_context,
+)
+from ozone_tpu.admission.shed import SloShedder
+
+__all__ = [
+    "METRICS",
+    "SERVER_BUSY",
+    "AdmissionController",
+    "InflightGate",
+    "SloShedder",
+    "TenantBuckets",
+    "ambient_qos",
+    "busy_error",
+    "controller",
+    "controllers",
+    "current_tenant",
+    "qos_class_for",
+    "reset_for_tests",
+    "retry_after_hint",
+    "tenant_context",
+]

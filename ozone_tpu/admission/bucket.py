@@ -1,0 +1,88 @@
+"""Per-tenant token buckets: the rate dimension of admission control.
+
+Two buckets per tenant — ops/s and bytes/s — built on the same
+virtual-scheduling token bucket that paces replication transfers
+(utils/throttle.Throttle), but consulted through ``try_take``: an
+admission decision REFUSES deterministically and hands back a
+Retry-After hint instead of blocking the server thread. Blocking at
+the front door would be queuing by another name; the whole point of
+admission control is that excess offered load is answered cheaply
+(reject + hint) while accepted work keeps its latency budget.
+
+A refused request still charges one op token: the refusal itself cost
+front-door work, and a tenant hammering past its rate must not get
+that accounting for free (DAGOR's "the overload signal must be cheaper
+than the work it sheds" discipline).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ozone_tpu.utils.throttle import Throttle
+
+
+class TenantBuckets:
+    """tenant -> (ops bucket, bytes bucket), created lazily.
+
+    A rate of 0 disables that dimension (unlimited). ``burst_s`` sizes
+    the bucket: a tenant may burst ``rate * burst_s`` above its rate
+    before refusals start, which absorbs benign arrival jitter without
+    letting a flood through.
+    """
+
+    def __init__(self, ops_per_s: float = 0.0, bytes_per_s: float = 0.0,
+                 burst_s: float = 1.0):
+        self.ops_per_s = max(0.0, float(ops_per_s))
+        self.bytes_per_s = max(0.0, float(bytes_per_s))
+        self.burst_s = max(0.05, float(burst_s))
+        self._lock = threading.Lock()
+        self._buckets: dict[str, tuple[Optional[Throttle],
+                                       Optional[Throttle]]] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.ops_per_s > 0 or self.bytes_per_s > 0
+
+    def _get(self, tenant: str) -> tuple[Optional[Throttle],
+                                         Optional[Throttle]]:
+        with self._lock:
+            pair = self._buckets.get(tenant)
+            if pair is None:
+                ops = (Throttle(self.ops_per_s, burst_s=self.burst_s)
+                       if self.ops_per_s > 0 else None)
+                byt = (Throttle(self.bytes_per_s, burst_s=self.burst_s)
+                       if self.bytes_per_s > 0 else None)
+                pair = self._buckets[tenant] = (ops, byt)
+            return pair
+
+    def try_admit(self, tenant: str,
+                  nbytes: int = 0) -> tuple[Optional[str], float]:
+        """One admission decision for `tenant`.
+
+        Returns ``(None, 0.0)`` when admitted (both dimensions booked),
+        else ``(reason, retry_after_s)`` where reason is ``"ops"`` or
+        ``"bytes"`` — the dimension that refused — and retry_after_s is
+        when the refused demand would fit.
+        """
+        if not self.enabled:
+            return None, 0.0
+        ops, byt = self._get(tenant)
+        if ops is not None:
+            wait = ops.try_take(1.0)
+            if wait > 0.0:
+                return "ops", wait
+        if byt is not None and nbytes > 0:
+            # cap the charge at one burst window so a single giant
+            # request can neither be permanently un-admittable nor
+            # book a deficit that starves the tenant for minutes
+            charge = min(float(nbytes), self.bytes_per_s * self.burst_s)
+            wait = byt.try_take(charge)
+            if wait > 0.0:
+                return "bytes", wait
+        return None, 0.0
+
+    def tenants(self) -> list[str]:
+        with self._lock:
+            return sorted(self._buckets)

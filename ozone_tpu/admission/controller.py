@@ -1,0 +1,305 @@
+"""Per-hop admission controllers: bounded queues + buckets + shedding.
+
+One :class:`AdmissionController` per service hop (``gateway``, ``om``,
+``scm``, ``dn``), get-or-created through :func:`controller` so every
+entry point of a process shares the same accounting. Three cooperating
+gates, each with its own per-hop, per-reason rejection counter in the
+``admission`` registry (no silent drops — every shed op is observable):
+
+- :class:`InflightGate`: a bounded request queue. gRPC's thread-pool
+  server queues excess calls invisibly and without limit; the gate
+  makes that queue explicit and finite — past ``queue_limit``
+  concurrently admitted requests, new arrivals are answered
+  ``SERVER_BUSY`` immediately instead of waiting in a line that grows
+  faster than it drains.
+- per-tenant token buckets (:mod:`ozone_tpu.admission.bucket`): ops/s
+  and bytes/s rate enforcement at identity-aware hops.
+- the SLO shedder (:mod:`ozone_tpu.admission.shed`): bulk-class work
+  is refused while live latency/backlog signals are over budget.
+
+A rejection raises ``StorageError(SERVER_BUSY, ...)`` carrying a
+machine-readable ``retry_after_s=<float>`` hint. The code is
+deliberately NOT transport-shaped (see resilience.TRANSPORT_FAULT_CODES):
+it is a healthy peer's deliberate answer, so it must never trip circuit
+breakers or failover rotation — clients back off (honoring the hint as
+their floor) and retry the same peer.
+
+Knobs (all ``OZONE_TPU_ADMIT_*``; defaults keep buckets and shedding
+off and the queue bound generous, so an untuned deployment behaves as
+before while still refusing a runaway backlog):
+
+=============================== ======= ===================================
+knob                            default meaning
+=============================== ======= ===================================
+OZONE_TPU_ADMIT_OPS             0       per-tenant ops/s (0 = unlimited)
+OZONE_TPU_ADMIT_BYTES           0       per-tenant bytes/s (0 = unlimited)
+OZONE_TPU_ADMIT_BURST_S         1.0     bucket burst window, seconds
+OZONE_TPU_ADMIT_QUEUE           256     per-hop in-flight bound (0 = off)
+OZONE_TPU_ADMIT_SLO_P99_MS      0       shed bulk past this client P99
+OZONE_TPU_ADMIT_SLO_CODEC_DEPTH 0       shed bulk past this codec backlog
+OZONE_TPU_ADMIT_SLO_MESH_DEPTH  0       shed bulk past this mesh in-flight
+OZONE_TPU_ADMIT_RETRY_AFTER_S   0.25    hint for queue/SLO rejections
+OZONE_TPU_ADMIT_CLASS           ""      tenant QoS map, "t1=bulk,t2=..."
+=============================== ======= ===================================
+
+Per-hop overrides append the upper-cased hop name:
+``OZONE_TPU_ADMIT_QUEUE_GATEWAY``, ``OZONE_TPU_ADMIT_OPS_OM``, ...
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import re
+import threading
+from typing import Iterable, Optional
+
+from ozone_tpu.admission.bucket import TenantBuckets
+from ozone_tpu.admission.shed import SloShedder
+from ozone_tpu.storage.ids import StorageError
+from ozone_tpu.utils.config import env_float, env_int
+from ozone_tpu.utils.metrics import MetricsRegistry, registry
+
+#: StorageError code for every admission rejection. Application-shaped
+#: on purpose: a pushback from a healthy peer, never a transport fault.
+SERVER_BUSY = "SERVER_BUSY"
+
+#: every admission signal lands in ONE registry so prometheus_text()
+#: exposes the whole overload story side by side
+METRICS: MetricsRegistry = registry("admission")
+
+_RETRY_AFTER_RE = re.compile(r"retry_after_s=([0-9][0-9.]*)")
+
+
+def retry_after_hint(msg: object) -> Optional[float]:
+    """Parse the ``retry_after_s=<float>`` hint out of a SERVER_BUSY
+    message (or an S3 SlowDown body); None when absent/garbled."""
+    m = _RETRY_AFTER_RE.search(str(msg))
+    if not m:
+        return None
+    try:
+        # cap: a deranged hint must not park a client for minutes
+        return min(30.0, float(m.group(1)))
+    except ValueError:
+        return None
+
+
+def busy_error(hop: str, reason: str, retry_after_s: float) -> StorageError:
+    return StorageError(
+        SERVER_BUSY,
+        f"{hop} overloaded ({reason}); retry_after_s={retry_after_s:.3f}")
+
+
+class InflightGate:
+    """Explicit bounded request queue: admits up to `limit` concurrent
+    requests, refuses the rest instantly. limit <= 0 disables."""
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def try_enter(self) -> bool:
+        if self.limit <= 0:
+            return True
+        with self._lock:
+            if self._n >= self.limit:
+                return False
+            self._n += 1
+            return True
+
+    def exit(self) -> None:
+        if self.limit <= 0:
+            return
+        with self._lock:
+            self._n -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._n
+
+
+class AdmissionController:
+    """One hop's front door. ``admit(verb)`` is the bounded-queue gate
+    (wrap the request's whole execution in it); ``charge(tenant, ...)``
+    is the identity-aware gate (buckets + SLO shed) for hops that know
+    who is asking."""
+
+    def __init__(self, hop: str, *, ops_per_s: float = 0.0,
+                 bytes_per_s: float = 0.0, burst_s: float = 1.0,
+                 queue_limit: int = 0,
+                 shedder: Optional[SloShedder] = None,
+                 retry_after_s: float = 0.25,
+                 exempt: Iterable[str] = ()):
+        self.hop = hop
+        self.buckets = TenantBuckets(ops_per_s, bytes_per_s, burst_s)
+        self.gate = InflightGate(queue_limit)
+        self.shedder = shedder or SloShedder()
+        self.retry_after_s = retry_after_s
+        #: verbs never refused (control-plane traffic: heartbeats,
+        #: registrations — refusing those converts overload into a
+        #: dead-node storm, the opposite of graceful degradation)
+        self.exempt = frozenset(exempt)
+
+    @property
+    def enabled(self) -> bool:
+        return (self.gate.limit > 0 or self.buckets.enabled
+                or self.shedder.enabled)
+
+    # ------------------------------------------------------- queue gate
+    def _reject(self, reason: str, retry_after_s: float) -> StorageError:
+        METRICS.counter(f"{self.hop}_rejected_total").inc()
+        METRICS.counter(f"{self.hop}_rejected_{reason}").inc()
+        return busy_error(self.hop, reason, retry_after_s)
+
+    @contextlib.contextmanager
+    def admit(self, verb: str = ""):
+        """Bounded-queue admission for one request. Raises
+        ``StorageError(SERVER_BUSY)`` when the hop's in-flight bound is
+        hit; otherwise tracks the request until it completes."""
+        if verb in self.exempt or not self.gate.try_enter():
+            if verb in self.exempt:
+                yield
+                return
+            raise self._reject("queue", self.retry_after_s)
+        METRICS.counter(f"{self.hop}_admitted").inc()
+        METRICS.gauge(f"{self.hop}_inflight").set(self.gate.inflight)
+        try:
+            yield
+        finally:
+            self.gate.exit()
+            METRICS.gauge(f"{self.hop}_inflight").set(self.gate.inflight)
+
+    # ---------------------------------------------------- identity gate
+    def charge(self, tenant: str, nbytes: int = 0,
+               priority: str = "interactive") -> None:
+        """Identity-aware admission: tenant buckets, then SLO shed.
+        Raises ``StorageError(SERVER_BUSY)`` with a Retry-After hint on
+        refusal; returns silently when admitted."""
+        reason, wait = self.buckets.try_admit(tenant, nbytes)
+        if reason is not None:
+            METRICS.counter(f"{self.hop}_tenant_rejections").inc()
+            raise self._reject(reason, max(wait, 0.001))
+        shed = self.shedder.should_shed(priority)
+        if shed is not None:
+            raise self._reject(shed, self.retry_after_s)
+
+    def snapshot(self) -> dict:
+        return {
+            "hop": self.hop,
+            "enabled": self.enabled,
+            "queue_limit": self.gate.limit,
+            "inflight": self.gate.inflight,
+            "ops_per_s": self.buckets.ops_per_s,
+            "bytes_per_s": self.buckets.bytes_per_s,
+            "burst_s": self.buckets.burst_s,
+            "tenants": self.buckets.tenants(),
+            "shed": self.shedder.snapshot(),
+        }
+
+
+# ------------------------------------------------------ hop controllers
+_controllers: dict[str, AdmissionController] = {}
+_controllers_lock = threading.Lock()
+
+
+def _hop_knob_f(hop: str, suffix: str, default: float) -> float:
+    base = env_float(f"OZONE_TPU_ADMIT_{suffix}", default)
+    return env_float(f"OZONE_TPU_ADMIT_{suffix}_{hop.upper()}", base)
+
+
+def _hop_knob_i(hop: str, suffix: str, default: int) -> int:
+    base = env_int(f"OZONE_TPU_ADMIT_{suffix}", default)
+    return env_int(f"OZONE_TPU_ADMIT_{suffix}_{hop.upper()}", base)
+
+
+def controller(hop: str,
+               exempt: Iterable[str] = ()) -> AdmissionController:
+    """Get-or-create the hop's controller, knobs read from the
+    environment at creation (``reset_for_tests`` drops the cache so
+    tests re-read). ``exempt`` applies only on first creation."""
+    with _controllers_lock:
+        ctl = _controllers.get(hop)
+        if ctl is None:
+            ctl = _controllers[hop] = AdmissionController(
+                hop,
+                ops_per_s=_hop_knob_f(hop, "OPS", 0.0),
+                bytes_per_s=_hop_knob_f(hop, "BYTES", 0.0),
+                burst_s=_hop_knob_f(hop, "BURST_S", 1.0),
+                queue_limit=_hop_knob_i(hop, "QUEUE", 256),
+                shedder=SloShedder(
+                    p99_ms=_hop_knob_f(hop, "SLO_P99_MS", 0.0),
+                    codec_depth=_hop_knob_i(hop, "SLO_CODEC_DEPTH", 0),
+                    mesh_depth=_hop_knob_i(hop, "SLO_MESH_DEPTH", 0),
+                ),
+                retry_after_s=_hop_knob_f(hop, "RETRY_AFTER_S", 0.25),
+                exempt=exempt,
+            )
+        return ctl
+
+
+def controllers() -> dict[str, AdmissionController]:
+    """Installed controllers (for Recon's /api/admission view)."""
+    with _controllers_lock:
+        return dict(_controllers)
+
+
+def reset_for_tests() -> None:
+    """Drop all controllers and the tenant-class cache so the next
+    lookup re-reads the OZONE_TPU_ADMIT_* environment."""
+    global _class_map
+    with _controllers_lock:
+        _controllers.clear()
+        _class_map = None
+
+
+# ------------------------------------------- tenant identity / QoS class
+#: (tenant, qos) of the request being served on this thread — set by
+#: the gateway after auth so every layer below (OzoneClient -> EC
+#: writer/reader -> codec service) inherits the tenant's QoS class
+_tenant_ctx: contextvars.ContextVar[Optional[tuple]] = \
+    contextvars.ContextVar("ozone_tpu_admit_tenant", default=None)
+
+_class_map: Optional[dict[str, str]] = None
+
+
+def qos_class_for(tenant: str) -> str:
+    """The tenant's QoS class from OZONE_TPU_ADMIT_CLASS
+    ("tenantA=bulk,tenantB=interactive"); interactive by default."""
+    global _class_map
+    m = _class_map
+    if m is None:
+        m = {}
+        raw = os.environ.get("OZONE_TPU_ADMIT_CLASS", "")
+        for part in raw.split(","):
+            name, _, cls = part.partition("=")
+            if name.strip() and cls.strip() in ("interactive", "bulk"):
+                m[name.strip()] = cls.strip()
+        _class_map = m
+    return m.get(tenant, "interactive")
+
+
+@contextlib.contextmanager
+def tenant_context(tenant: str, qos: Optional[str] = None):
+    """Bind the request's tenant identity (and its QoS class) to this
+    thread for the duration of one operation."""
+    tok = _tenant_ctx.set((tenant, qos or qos_class_for(tenant)))
+    try:
+        yield
+    finally:
+        _tenant_ctx.reset(tok)
+
+
+def current_tenant() -> Optional[str]:
+    ctx = _tenant_ctx.get()
+    return ctx[0] if ctx is not None else None
+
+
+def ambient_qos(default: str = "interactive") -> str:
+    """The ambient tenant's QoS class, or `default` outside any tenant
+    context — the ONE hook OzoneClient uses to carry gateway-derived
+    identity into codec/service.py's weighted-fair lanes."""
+    ctx = _tenant_ctx.get()
+    return ctx[1] if ctx is not None else default

@@ -1,0 +1,94 @@
+"""SLO-driven shedding: reject lowest-priority work when live signals
+say the latency budget is crossed.
+
+The signals are ones the process already exports — nothing new is
+measured here, the shedder just closes the loop on the PR 11
+observability surface:
+
+- client put/get P99 (``client.ops`` histograms): the end-to-end tail
+  the SLO is actually written against;
+- ``codec.service`` queue-depth gauge: the device dispatcher's backlog,
+  the leading indicator that bulk work is piling up;
+- mesh executor in-flight depth (``mesh`` registry): the multi-chip
+  datapath's congestion.
+
+Evaluation is cached for a short window so the hot path pays a dict
+lookup, not three registry walks per request. Shedding is by PRIORITY:
+only ``bulk``-class work is refused while over budget — interactive
+traffic rides through, which is exactly the DAGOR-style discipline of
+degrading the cheapest-to-retry work first instead of collapsing
+everyone's tail together.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ozone_tpu.utils.metrics import registry
+
+
+class SloShedder:
+    """Threshold watcher over live metrics; thresholds of 0 disable the
+    corresponding signal."""
+
+    def __init__(self, p99_ms: float = 0.0, codec_depth: int = 0,
+                 mesh_depth: int = 0, cache_s: float = 0.1):
+        self.p99_ms = max(0.0, float(p99_ms))
+        self.codec_depth = max(0, int(codec_depth))
+        self.mesh_depth = max(0, int(mesh_depth))
+        self.cache_s = cache_s
+        self._lock = threading.Lock()
+        self._cached: Optional[str] = None
+        self._cached_at = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.p99_ms or self.codec_depth or self.mesh_depth)
+
+    def _evaluate(self) -> Optional[str]:
+        if self.p99_ms:
+            hist = registry("client.ops")
+            for verb in ("put", "get"):
+                p99_s = hist.histogram(f"{verb}_seconds").quantile(0.99)
+                if p99_s * 1000.0 > self.p99_ms:
+                    return "slo_p99"
+        if self.codec_depth:
+            depth = registry("codec.service").gauge("queue_depth").value
+            if depth > self.codec_depth:
+                return "slo_codec_depth"
+        if self.mesh_depth:
+            depth = registry("mesh").gauge("inflight_depth").value
+            if depth > self.mesh_depth:
+                return "slo_mesh_depth"
+        return None
+
+    def over_budget(self) -> Optional[str]:
+        """The first crossed signal (a rejection-reason suffix), or
+        None while within budget. Cached for ``cache_s``."""
+        if not self.enabled:
+            return None
+        now = time.monotonic()
+        with self._lock:
+            if now - self._cached_at < self.cache_s:
+                return self._cached
+            self._cached = self._evaluate()
+            self._cached_at = now
+            return self._cached
+
+    def should_shed(self, priority: str) -> Optional[str]:
+        """Shed decision for one request: bulk-class work is refused
+        while over budget; interactive work always passes (the shedder
+        degrades, the queue gate is what finally protects collapse)."""
+        if priority == "interactive":
+            return None
+        return self.over_budget()
+
+    def snapshot(self) -> dict:
+        return {
+            "p99_ms": self.p99_ms,
+            "codec_depth": self.codec_depth,
+            "mesh_depth": self.mesh_depth,
+            "over_budget": self.over_budget(),
+        }

@@ -14,6 +14,7 @@ from typing import Optional
 
 import numpy as np
 
+from ozone_tpu import admission
 from ozone_tpu.client import resilience
 from ozone_tpu.client.dn_client import DatanodeClientFactory
 from ozone_tpu.client.ec_reader import ECBlockGroupReader
@@ -170,7 +171,10 @@ class OzoneBucket:
                 block_size=om.block_size,
                 checksum=ChecksumType(session.checksum_type),
                 bytes_per_checksum=session.bytes_per_checksum,
-                qos_class=self.client.qos_class,
+                # ambient tenant identity (set by the gateway's
+                # admission context) overrides the client-wide class,
+                # carrying per-tenant QoS into the codec's fair lanes
+                qos_class=admission.ambient_qos(self.client.qos_class),
             )
         if (
             session.replication.type is ReplicationType.RATIS
@@ -317,7 +321,10 @@ class OzoneBucket:
                             info.get("checksum_type", "CRC32C")),
                         bytes_per_checksum=info.get(
                             "bytes_per_checksum", 16 * 1024),
-                        qos_class=self.client.qos_class,
+                        # gateway-set tenant context wins over the
+                        # client-wide class (see _make_writer)
+                        qos_class=admission.ambient_qos(
+                            self.client.qos_class),
                     )
                 else:
                     reader = ReplicatedKeyReader(g, self.client.clients)

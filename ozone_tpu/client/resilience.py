@@ -66,9 +66,34 @@ from ozone_tpu.utils.tracing import Tracer
 #: "stop waiting", never as a data error
 DEADLINE_EXCEEDED = "DEADLINE_EXCEEDED"
 
+#: StorageError code for server admission pushback (bounded queue full,
+#: tenant bucket drained, SLO shed — see ozone_tpu/admission). A
+#: DELIBERATE answer from a healthy peer: retryable-with-server-hint,
+#: never a transport fault (must not trip breakers or failover), and
+#: counted apart from deadline_exceeded below.
+SERVER_BUSY = "SERVER_BUSY"
+
 #: every resilience signal lands in ONE registry so prometheus_text()
 #: exposes the whole straggler story side by side
 METRICS: MetricsRegistry = registry("client.resilience")
+
+
+def server_pushback_floor(e: BaseException,
+                          verb: str = "") -> Optional[float]:
+    """Classify + account one server pushback. For a SERVER_BUSY
+    StorageError: increments the ``server_busy`` counters (separate
+    from ``deadline_exceeded`` — pushback is load, not a spent budget)
+    and returns the server's Retry-After hint in seconds (0.0 when the
+    message carries none) to use as the backoff FLOOR. Returns None for
+    anything that is not server pushback."""
+    if not (isinstance(e, StorageError) and e.code == SERVER_BUSY):
+        return None
+    from ozone_tpu.admission import retry_after_hint
+
+    METRICS.counter("server_busy").inc()
+    if verb:
+        METRICS.counter(f"server_busy_{verb}").inc()
+    return retry_after_hint(getattr(e, "msg", str(e))) or 0.0
 
 
 def _env_f(name: str, default: float) -> float:
@@ -221,14 +246,22 @@ class RetryPolicy:
 
     def sleep(self, attempt: int,
               deadline: Optional[Deadline] = None,
-              rng: Optional[random.Random] = None) -> bool:
+              rng: Optional[random.Random] = None,
+              floor_s: Optional[float] = None) -> bool:
         """Sleep the jittered backoff, clipped to the deadline. Returns
         False (without sleeping the full interval) when the policy's
         attempt cap is reached or the budget cannot cover another
-        attempt — either way the caller stops retrying."""
+        attempt — either way the caller stops retrying.
+
+        ``floor_s`` is a server-supplied backoff floor (the Retry-After
+        hint on a SERVER_BUSY pushback): the jittered draw is raised to
+        at least the hint, because the server KNOWS when capacity will
+        exist and retrying sooner is guaranteed wasted work."""
         if attempt >= self.max_attempts - 1:
             return False
         d = self.backoff_s(attempt, rng)
+        if floor_s is not None and floor_s > 0:
+            d = max(d, floor_s)
         if deadline is None:
             deadline = _current.get()
         if deadline is not None:
@@ -260,7 +293,10 @@ def failover_retry_policy(attempts: int) -> RetryPolicy:
 #: unwell" — only these feed the circuit breaker. Application-level
 #: outcomes (NO_SUCH_BLOCK on a degraded group, CONTAINER_NOT_FOUND,
 #: quota/token refusals, checksum mismatches) are answers from a
-#: healthy peer and must never trip it.
+#: healthy peer and must never trip it. SERVER_BUSY is deliberately
+#: absent too: admission pushback comes from a peer healthy enough to
+#: refuse — tripping breakers (or rotating failover) on it would turn
+#: graceful shedding into a cascading brownout.
 TRANSPORT_FAULT_CODES = frozenset({"UNAVAILABLE", "TIMEOUT",
                                    "IO_EXCEPTION"})
 

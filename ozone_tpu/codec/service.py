@@ -154,7 +154,7 @@ class _Lane:
         self.fn = fn
         self.width = max(1, int(width))
         self.cls = cls
-        self.subs: deque[_Sub] = deque()
+        self.subs: deque[_Sub] = deque()  # ozlint: allow[bounded-queue] -- lane depth is governed by the weighted-fair scheduler's queue_depth gauge, which the admission SLO shedder watches; bounding here would drop accepted work
         self.queued = 0  # undispatched stripes across subs
         self.min_deadline_t = math.inf
         self.last_served = 0.0  # 0 = never dispatched from
@@ -189,7 +189,7 @@ class CodecService:
         #: survives an idle period
         self._vclock = 0.0
         self._queued_cls: dict[str, int] = {}  # class -> queued subs
-        self._inflight: deque[tuple] = deque()
+        self._inflight: deque[tuple] = deque()  # ozlint: allow[bounded-queue] -- holds only dispatched-to-device batches; depth is bounded by the double-buffer dispatch loop (at most prefetch_depth entries)
         self._dispatch_ewma_s = _DISPATCH_EWMA_SEED_S
         self._running = True
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -611,7 +611,7 @@ class CodecService:
             subs = [s for lane in self._lanes.values() for s in lane.subs]
             self._lanes.clear()
             self._queued_cls.clear()
-            inflight, self._inflight = list(self._inflight), deque()
+            inflight, self._inflight = list(self._inflight), deque()  # ozlint: allow[bounded-queue] -- drain/reset of the bounded in-flight deque above, not a new queue
         for rec in inflight:
             for sub, _o, _t, _r in rec[0]:
                 subs.append(sub)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import hashlib
 import json
 import logging
+import math
 import threading
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -33,6 +34,7 @@ from urllib.parse import parse_qs, quote as _url_quote, unquote, urlparse
 
 import numpy as np
 
+from ozone_tpu import admission
 from ozone_tpu.client.ozone_client import OzoneClient
 from ozone_tpu.gateway.s3_auth import (
     STREAMING,
@@ -410,14 +412,29 @@ class S3Gateway:
                 if not (parts and self._anonymous_allowed(method, parts[0])):
                     h._reply(*_err("AccessDenied", "anonymous access", 403))
                     return
-            if not parts:
-                self._list_buckets(h)
-                return
-            bucket, key = parts[0], "/".join(parts[1:])
-            if not key:
-                self._bucket_op(h, method, bucket, q)
-            else:
-                self._object_op(h, method, bucket, key, q)
+            # admission: the tenant key is the RESOLVED volume, so every
+            # access id of one tenant shares the same buckets (and the
+            # untenanted world shares "s3v"). Looked up per request, not
+            # cached on self, so reset_for_tests() re-reads knobs live.
+            tenant = self._vol
+            ctl = admission.controller("gateway")
+            with admission.tenant_context(tenant):
+                # charge BEFORE reading the body: rejecting by the
+                # declared Content-Length is what makes a rejection
+                # cheaper than the work it sheds
+                nbytes = (int(h.headers.get("Content-Length") or 0)
+                          if method in ("PUT", "POST") else 0)
+                ctl.charge(tenant, nbytes,
+                           priority=admission.ambient_qos())
+                with ctl.admit(method):
+                    if not parts:
+                        self._list_buckets(h)
+                        return
+                    bucket, key = parts[0], "/".join(parts[1:])
+                    if not key:
+                        self._bucket_op(h, method, bucket, q)
+                    else:
+                        self._object_op(h, method, bucket, key, q)
         except AuthError as e:
             status = (400 if "Malformed" in e.code or e.code in
                       ("InvalidRequest", "InvalidArgument",
@@ -439,8 +456,20 @@ class S3Gateway:
                 # 500 would make SDKs retry a request that can never
                 # succeed
                 "INVALID_REQUEST": ("InvalidRequest", 400),
+                # admission pushback (queue bound, tenant bucket, SLO
+                # shed) maps to the S3 throttling vocabulary — 503
+                # SlowDown — so stock SDK retry policies back off
+                # instead of treating overload as a hard failure
+                "SERVER_BUSY": ("SlowDown", 503),
             }.get(e.code, ("InternalError", 500))
-            h._reply(*_err(code[0], str(e), code[1]))
+            headers = None
+            if e.code == "SERVER_BUSY":
+                # Retry-After is integer seconds (RFC 9110); round UP so
+                # the client never comes back before the hinted instant
+                hint = admission.retry_after_hint(str(e)) or 1.0
+                headers = {"Retry-After": str(max(1, math.ceil(hint)))}
+            status, body = _err(code[0], str(e), code[1])
+            h._reply(status, body, headers)
         except Exception as e:  # noqa: BLE001
             log.exception("s3 %s %s failed", method, h.path)
             h._reply(*_err("InternalError", str(e), 500))

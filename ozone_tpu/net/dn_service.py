@@ -12,6 +12,7 @@ from typing import Optional
 
 import numpy as np
 
+from ozone_tpu import admission
 from ozone_tpu.codec import hostmem
 from ozone_tpu.net import wire
 from ozone_tpu.net.rpc import RpcChannel, RpcServer
@@ -79,6 +80,12 @@ class DatanodeGrpcService:
                 "ExportContainer": self._export_container,
                 "ReadChunks": self._read_chunks,
             },
+            # bounded request queue across ALL datapath verbs (unary,
+            # streaming writes, streaming reads share one in-flight
+            # bound — overload is overload regardless of verb shape).
+            # Echo (liveness probes) and datapath discovery stay exempt.
+            admission=admission.controller(
+                "dn", exempt=frozenset({"Echo", "GetDatapathInfo"})),
         )
 
     # ------------------------------------------------------------ token gate

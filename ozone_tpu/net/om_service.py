@@ -12,6 +12,7 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+from ozone_tpu import admission
 from ozone_tpu.client.ec_writer import BlockGroup
 from ozone_tpu.net import wire
 from ozone_tpu.net.rpc import RpcChannel, RpcServer
@@ -373,7 +374,12 @@ class OmGrpcService:
                     lambda m: self.om.store.get("system", "shard_map")),
         }
         server.add_service(
-            SERVICE, {n: self._gated(n, fn) for n, fn in methods.items()})
+            SERVICE, {n: self._gated(n, fn) for n, fn in methods.items()},
+            # bounded request queue (overload protection): past the
+            # in-flight bound, calls are answered SERVER_BUSY instead of
+            # piling up in the executor. GetShardMap stays exempt — it
+            # is how a rejected client finds somewhere else to go.
+            admission=admission.controller("om", exempt=self.UNGATED))
 
     #: verbs exempt from the HA leader gate (see GetShardMap above)
     UNGATED = frozenset({"GetShardMap"})
@@ -403,8 +409,22 @@ class OmGrpcService:
         groups = m.pop("_groups", ())
         if tok is not None:
             row = self.om.verify_delegation_token(tok)  # raises OMError
+            self._charge(row["owner"])
             return row["owner"], (), True
+        self._charge(user)
         return user, groups, False
+
+    def _charge(self, user) -> None:
+        """Per-tenant admission at the OM front door: every
+        identity-carrying verb books one op against the caller's bucket
+        (OM work is metadata-shaped, so the ops dimension is the one
+        that matters here). Raises StorageError(SERVER_BUSY) — carried
+        to the client as a deterministic, hinted rejection."""
+        ctl = admission.controller("om", exempt=self.UNGATED)
+        if not (ctl.buckets.enabled or ctl.shedder.enabled):
+            return
+        tenant = user or "anonymous"
+        ctl.charge(tenant, priority=admission.qos_class_for(tenant))
 
     def _wrap(self, fn, with_addresses: bool = False):
         def method(req: bytes) -> bytes:
@@ -676,6 +696,7 @@ class GrpcOmClient:
         policy = resilience.failover_retry_policy(attempts)
         moved_retried = False
         for attempt in range(attempts):
+            floor_s = None
             if read_addr is not None and attempt == 0:
                 addr, ch = pool.channel(read_addr)
             else:
@@ -712,13 +733,27 @@ class GrpcOmClient:
                     if len(pool.addresses) == 1:
                         raise
                     pool.rotate()
+                elif e.code == resilience.SERVER_BUSY:
+                    # admission pushback from a HEALTHY peer: no
+                    # invalidate, no rotation — back off (honoring the
+                    # server's Retry-After hint as the floor) and retry
+                    # the same replica. Rotating here would stampede the
+                    # overload onto the next replica.
+                    floor_s = resilience.server_pushback_floor(e, "om")
                 else:
                     raise
-            if not policy.sleep(attempt):
+            if not policy.sleep(attempt, floor_s=floor_s):
                 # budget spent: surface fail-fast DEADLINE_EXCEEDED
                 # instead of the transport-shaped error below
                 resilience.check_deadline("om_failover")
                 break
+        if isinstance(last, StorageError) \
+                and last.code == resilience.SERVER_BUSY:
+            # retry budget spent while the server kept pushing back:
+            # surface the pushback itself (the gateway maps it to 503
+            # SlowDown), not a transport-shaped error that would trip
+            # breakers on a healthy-but-loaded cluster
+            raise last
         raise StorageError("IO_EXCEPTION",
                            f"no OM leader reachable: {last}")
 

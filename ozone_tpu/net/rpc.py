@@ -10,6 +10,7 @@ and are re-raised as StorageError on the client.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import threading
@@ -37,7 +38,8 @@ class _GenericHandler(grpc.GenericRpcHandler):
     def __init__(self, methods: dict[str, Method],
                  stream_methods: Optional[dict[str, StreamMethod]] = None,
                  server_stream_methods: Optional[dict[str, Method]] = None,
-                 server: Optional["RpcServer"] = None):
+                 server: Optional["RpcServer"] = None,
+                 admission=None):
         self._methods = methods
         self._stream_methods = stream_methods or {}
         #: unary request -> iterator of byte frames (the replication
@@ -45,6 +47,19 @@ class _GenericHandler(grpc.GenericRpcHandler):
         self._server_stream_methods = server_stream_methods or {}
         #: owning server: read at call time for its live crl_provider
         self._server = server
+        #: AdmissionController bounding this service's in-flight work:
+        #: past the bound, new calls are answered SERVER_BUSY instead
+        #: of queuing invisibly in the executor's backlog
+        self._admission = admission
+
+    @contextlib.contextmanager
+    def _admit(self, method_name: str):
+        ctl = self._admission
+        if ctl is None:
+            yield
+            return
+        with ctl.admit(method_name.rpartition("/")[2]):
+            yield
 
     def _check_revoked(self, context) -> None:
         """Certificate revocation (the CRL the reference distributes
@@ -85,7 +100,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
 
             remote_ctx = dict(context.invocation_metadata()).get("x-trace-id")
             try:
-                with Tracer.instance().span(
+                with self._admit(method_name), Tracer.instance().span(
                     f"server:{method_name}",
                     child_of=remote_ctx or None,
                 ):
@@ -115,7 +130,7 @@ class _GenericHandler(grpc.GenericRpcHandler):
             remote_ctx = dict(context.invocation_metadata()).get(
                 "x-trace-id")
             try:
-                with Tracer.instance().span(
+                with self._admit(method_name), Tracer.instance().span(
                     f"server:{method_name}",
                     child_of=remote_ctx or None,
                 ):
@@ -190,7 +205,7 @@ class RpcServer:
     def add_service(self, service_name: str, methods: dict[str, Method],
                     stream_methods: Optional[dict[str, StreamMethod]] = None,
                     server_stream_methods: Optional[dict] = None,
-                    ) -> None:
+                    admission=None) -> None:
         full = {
             f"/{service_name}/{name}": fn for name, fn in methods.items()
         }
@@ -203,7 +218,8 @@ class RpcServer:
             for name, fn in (server_stream_methods or {}).items()
         }
         self._server.add_generic_rpc_handlers(
-            (_GenericHandler(full, sfull, ssfull, server=self),))
+            (_GenericHandler(full, sfull, ssfull, server=self,
+                             admission=admission),))
 
     def start(self) -> None:
         self._server.start()

@@ -12,6 +12,7 @@ from __future__ import annotations
 from dataclasses import asdict
 from typing import Optional
 
+from ozone_tpu import admission
 from ozone_tpu.codec.api import CoderOptions
 from ozone_tpu.net import wire
 from ozone_tpu.net.rpc import RpcChannel, RpcServer
@@ -139,6 +140,15 @@ class ScmGrpcService:
                 "ListContainers": self._list_containers,
                 "AdminOp": self._admin_op,
             },
+            # bounded request queue: client-facing verbs are refused
+            # past the in-flight bound; node liveness traffic is exempt
+            # — shedding heartbeats under load would convert overload
+            # into a dead-node storm (re-replication on top of the
+            # flood), the opposite of graceful degradation
+            admission=admission.controller(
+                "scm",
+                exempt=frozenset({"Register", "Heartbeat",
+                                  "NodeAddresses", "Status"})),
         )
 
     def _register(self, req: bytes) -> bytes:
@@ -450,6 +460,7 @@ class GrpcScmClient:
         attempts = max(4, 3 * len(self.addresses))
         policy = resilience.failover_retry_policy(attempts)
         for attempt in range(attempts):
+            floor_s = None
             addr, ch = self._pool.channel()
             try:
                 m, _ = wire.unpack(ch.call(
@@ -466,9 +477,13 @@ class GrpcScmClient:
                     if len(self.addresses) == 1:
                         raise
                     self._pool.rotate()
+                elif e.code == resilience.SERVER_BUSY:
+                    # healthy-peer pushback: back off to the server's
+                    # Retry-After hint, same replica — see the OM client
+                    floor_s = resilience.server_pushback_floor(e, "scm")
                 else:
                     raise
-            if not policy.sleep(attempt):  # no dead time before raising
+            if not policy.sleep(attempt, floor_s=floor_s):
                 resilience.check_deadline("scm_failover")
                 break
         raise last

@@ -742,6 +742,9 @@ class ReconServer:
                     # coalescing and spill accounting (the fleet
                     # reconstruction/bulk-tiering datapath's health)
                     "/api/mesh": recon.mesh_view,
+                    # admission-control panel: per-hop controller
+                    # knobs/in-flight plus every rejection counter
+                    "/api/admission": recon.admission_view,
                     # sharded metadata plane: this OM's shard config,
                     # the root shard map (when this OM hosts it), and
                     # the routing / 2PC / follower-read counters
@@ -845,6 +848,26 @@ class ReconServer:
                     "spill_enabled": mesh_executor.spill_enabled(),
                     "spill_watermark": mesh_executor.spill_watermark()}
         return ex.stats()
+
+    def admission_view(self) -> dict:
+        """Overload-protection snapshot for the dashboard panel: every
+        installed hop controller (knob echo, live in-flight depth,
+        tenants seen, SLO shed state) plus the full ``admission``
+        counter family — per-hop, per-reason rejection counts, so an
+        operator can tell SHED (rejections climbing, goodput flat)
+        from COLLAPSE (everything falling together). PEEKS at the
+        controller cache — a monitoring GET must never be the thing
+        that installs an admission controller."""
+        from ozone_tpu import admission
+        from ozone_tpu.utils.metrics import registry
+
+        hops = {hop: ctl.snapshot()
+                for hop, ctl in admission.controllers().items()}
+        return {
+            "enabled": any(s["enabled"] for s in hops.values()),
+            "hops": hops,
+            "counters": registry("admission").snapshot(),
+        }
 
     def shard_view(self) -> dict:
         """Sharded metadata plane snapshot for the dashboard panel: the

@@ -225,6 +225,14 @@ RECON_INDEX_HTML = """<!doctype html>
     service</div>
   <div class="tiles" id="mesh-tiles"></div>
 
+  <h2>Admission control</h2>
+  <div class="sub">end-to-end overload protection: per-tenant token
+    buckets, bounded request queues, SLO-driven shedding &mdash;
+    per-hop, per-reason rejection counters (rejections climbing while
+    goodput holds = healthy shed; everything falling together =
+    collapse)</div>
+  <div class="tiles" id="admission-tiles"></div>
+
   <h2>Shard map</h2>
   <div class="sub">sharded metadata plane: hash-partitioned OM rings
     behind an epoch-numbered root shard map &mdash; routing volume,
@@ -458,6 +466,26 @@ async function refresh() {
       tile("spilled lanes", mx.spilled_lanes ?? 0),
       tile("spilled stripes", mx.spilled_stripes ?? 0),
       tile("spill", mx.spill_enabled ? "on" : "off"),
+    ].join("");
+    const ad = await (await fetch("/api/admission")).json();
+    const ac = ad.counters || {};
+    const hops = Object.values(ad.hops || {});
+    document.getElementById("admission-tiles").innerHTML =
+      hops.length === 0
+        ? tile("admission", "no controllers installed")
+        : [
+      tile("enabled hops",
+           hops.filter((h) => h.enabled).map((h) => h.hop)
+               .join(" ") || "none"),
+      tile("in-flight", hops.map(
+           (h) => `${h.hop}:${h.inflight}/${h.queue_limit}`)
+           .join(" ")),
+      ...Object.entries(ac)
+        .filter(([k]) => k.endsWith("_rejected_total")
+                         || k.endsWith("_tenant_rejections"))
+        .map(([k, v]) => tile(k.replace(/_/g, " "), v)),
+      tile("tenants seen",
+           hops.reduce((n, h) => n + (h.tenants?.length ?? 0), 0)),
     ].join("");
     const sh = await (await fetch("/api/shards")).json();
     const sc = sh.counters || {};

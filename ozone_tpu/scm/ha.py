@@ -240,7 +240,7 @@ class RaftSCM:
         self.scm = scm
         self.scm_id = scm_id
         self.ack_timeout_s = ack_timeout_s
-        self._queue: "_queue.Queue" = _queue.Queue()
+        self._queue: "_queue.Queue" = _queue.Queue()  # ozlint: allow[bounded-queue] -- callers block on _ack_cv until their record commits (ack_timeout_s bounded), so depth is capped by the ack window, not open-ended
         self._inflight: set[str] = set()
         self._seq = 0
         self._committed_seq = 0

@@ -800,6 +800,16 @@ def cmd_freon(args) -> int:
             args.endpoint, n_keys=args.num, size=args.size,
             threads=args.threads, validate=args.validate,
         ).summary())
+    elif args.generator == "swarm":
+        # closed-loop multi-tenant overload swarm against the S3
+        # gateway; anonymous tenants from the CLI (signed tenants need
+        # OM-provisioned credentials — the bench wires those)
+        tenants = [{"name": f"tenant-{i}", "rate": 0.0}
+                   for i in range(max(1, args.threads))]
+        _emit(freon.swarm(
+            args.endpoint, tenants, duration_s=args.duration,
+            n_keys=args.num,
+        ).summary())
     elif args.generator == "lcg":
         oz = _client(args)
         _emit(freon.lcg(
@@ -1682,7 +1692,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
                              "dcb", "dcv", "dsg", "hsg", "dnbp", "ralg",
                              "fskg", "mpug", "s3kg", "fsg", "sdg",
-                             "dnsim", "lcg", "geo"])
+                             "dnsim", "lcg", "geo", "swarm"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("--keys", type=int, default=1,
@@ -1714,7 +1724,8 @@ def build_parser() -> argparse.ArgumentParser:
                     help="dnsim: fabricated containers per simulated "
                          "datanode")
     fr.add_argument("--duration", type=float, default=5.0,
-                    help="dnsim: seconds to heartbeat")
+                    help="dnsim: seconds to heartbeat; "
+                         "swarm: seconds to drive load")
     fr.add_argument("--interval", type=float, default=0.5,
                     help="dnsim: per-datanode heartbeat interval")
     fr.set_defaults(fn=cmd_freon)

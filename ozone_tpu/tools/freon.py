@@ -1165,3 +1165,169 @@ def ecrd(
         "times_s": [round(dt, 3) for _, dt in results],
     }
     return out
+
+
+def swarm(endpoint: str, tenants: list, duration_s: float = 4.0,
+          threads_per_tenant: int = 2, n_keys: int = 64,
+          sizes: tuple = (4 * 1024, 64 * 1024), zipf_a: float = 1.2,
+          seed: int = 1234, bucket: str = "swarm") -> FreonReport:
+    """freon swarm: the standing multi-tenant overload workload.
+
+    N simulated tenants drive the S3 gateway closed-loop through
+    SigV4-signed HTTP — Zipfian key popularity over a bounded working
+    set, mixed op sizes (mostly small, some bulk), mixed PUT/GET. Each
+    tenant dict carries {"name", "access_id", "secret", "rate"}: rate
+    is its offered ops/s (0 = unpaced, as fast as the loop turns), so
+    the caller ramps offered load — 1x capacity, then 2x with an
+    aggressor unpaced — without changing the workload shape.
+
+    503 SlowDown responses are counted as SHED, not failures: a shed op
+    is the admission system doing its job, and the report separates the
+    three outcomes (ok / shed / errors) per tenant so shed-not-collapse
+    is checkable — goodput and accepted-op latency per tenant, shed
+    fraction overall.
+    """
+    import bisect
+    import datetime
+    import random as _random
+    import urllib.error
+    import urllib.request
+
+    from ozone_tpu.gateway.s3_auth import sign_request
+
+    base = f"http://{endpoint}"
+
+    def _amz_now() -> str:
+        return datetime.datetime.now(datetime.timezone.utc).strftime(
+            "%Y%m%dT%H%M%SZ")
+
+    def _request(t: dict, method: str, path: str,
+                 body: bytes = b"") -> None:
+        url = f"{base}{path}"
+        headers = {"host": endpoint, "x-amz-date": _amz_now()}
+        if t.get("access_id"):
+            headers = sign_request(t["access_id"], t["secret"], method,
+                                   url, headers, body)
+        req = urllib.request.Request(
+            url, data=body if method in ("PUT", "POST") else None,
+            method=method, headers=headers)
+        with urllib.request.urlopen(req) as r:
+            r.read()
+
+    # Zipfian popularity: cumulative weights over key ranks, sampled by
+    # bisect — rank 0 is the hot key, the tail cools as 1/rank^a
+    cum: list[float] = []
+    acc = 0.0
+    for r in range(max(1, n_keys)):
+        acc += 1.0 / (r + 1) ** zipf_a
+        cum.append(acc)
+    payloads = {sz: bytes(np.random.default_rng(11).integers(
+        0, 256, sz, dtype=np.uint8)) for sz in sizes}
+
+    for t in tenants:
+        try:
+            _request(t, "PUT", f"/{bucket}")
+        except Exception:
+            pass  # BucketAlreadyExists across phases
+
+    lock = threading.Lock()
+    stats = {t["name"]: {"offered": 0, "ok": 0, "shed": 0, "errors": 0,
+                         "bytes": 0, "lat": []} for t in tenants}
+    written: dict[str, set] = {t["name"]: set() for t in tenants}
+    start = time.monotonic()
+    end = start + duration_s
+
+    def worker(t: dict, wid: int) -> None:
+        st = stats[t["name"]]
+        seen = written[t["name"]]
+        rng = _random.Random(f"{seed}:{t['name']}:{wid}")
+        rate = float(t.get("rate") or 0.0)
+        interval = threads_per_tenant / rate if rate > 0 else 0.0
+        next_t = time.monotonic() + rng.uniform(0, interval or 0.001)
+        while True:
+            now = time.monotonic()
+            if now >= end:
+                return
+            if interval:
+                # paced offered load: ops fire on a schedule, late ops
+                # do NOT bunch up (the schedule advances regardless)
+                if next_t >= end:
+                    return
+                if next_t > now:
+                    time.sleep(next_t - now)
+                next_t += interval
+            rank = bisect.bisect_left(cum, rng.uniform(0.0, cum[-1]))
+            key = f"{t['name']}-k{rank}"
+            size = sizes[0] if rng.random() < 0.8 else sizes[-1]
+            do_put = rank not in seen or rng.random() < 0.5
+            s0 = time.perf_counter()
+            try:
+                if do_put:
+                    _request(t, "PUT", f"/{bucket}/{key}",
+                             payloads[size])
+                else:
+                    _request(t, "GET", f"/{bucket}/{key}")
+                dt = time.perf_counter() - s0
+                with lock:
+                    st["offered"] += 1
+                    st["ok"] += 1
+                    st["bytes"] += size
+                    st["lat"].append(dt)
+                if do_put:
+                    seen.add(rank)
+            except urllib.error.HTTPError as e:
+                e.close()
+                with lock:
+                    st["offered"] += 1
+                    if e.code == 503:
+                        st["shed"] += 1
+                    else:
+                        st["errors"] += 1
+            except Exception:
+                with lock:
+                    st["offered"] += 1
+                    st["errors"] += 1
+
+    threads = [threading.Thread(target=worker, args=(t, w), daemon=True)
+               for t in tenants for w in range(threads_per_tenant)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    elapsed = time.monotonic() - start
+
+    def _p99(lat: list) -> float:
+        if not lat:
+            return 0.0
+        ls = sorted(lat)
+        return ls[min(len(ls) - 1, int(0.99 * len(ls)))]
+
+    all_lat: list[float] = []
+    per_tenant = {}
+    offered = ok = shed = errors = nbytes = 0
+    for name, st in stats.items():
+        all_lat.extend(st["lat"])
+        offered += st["offered"]
+        ok += st["ok"]
+        shed += st["shed"]
+        errors += st["errors"]
+        nbytes += st["bytes"]
+        per_tenant[name] = {
+            "offered": st["offered"],
+            "ok": st["ok"],
+            "shed": st["shed"],
+            "errors": st["errors"],
+            "goodput_ops_s": round(st["ok"] / elapsed, 2)
+            if elapsed else 0.0,
+            "p99_ms": round(1e3 * _p99(st["lat"]), 3),
+        }
+    return FreonReport(
+        "swarm", ops=ok, failures=errors, elapsed_s=elapsed,
+        latencies_s=all_lat, bytes_processed=nbytes,
+        extras={
+            "per_tenant": per_tenant,
+            "offered": offered,
+            "shed": shed,
+            "shed_fraction": round(shed / offered, 4) if offered else 0.0,
+            "goodput_ops_s": round(ok / elapsed, 2) if elapsed else 0.0,
+        })

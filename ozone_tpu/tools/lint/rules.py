@@ -1,4 +1,4 @@
-"""The seven production ozlint rules.
+"""The eight production ozlint rules.
 
 Each rule guards an invariant the repo states in prose and has already
 paid for in bugs (docs/LINT.md has the full origin stories):
@@ -25,6 +25,11 @@ paid for in bugs (docs/LINT.md has the full origin stories):
   materialize payload bytes (``bytes(...)``, ``.tobytes()``,
   view ``.copy()``) — payloads travel as views over pooled buffers;
   control-plane copies carry a reasoned suppression.
+- ``bounded-queue``         server-side packages construct no unbounded
+  ``queue.Queue()``/``deque()`` — an unbounded queue at a service hop
+  is admission control's blind spot (work piles up invisibly until the
+  process collapses); bound it or suppress with the reason the depth
+  is bounded elsewhere.
 """
 
 from __future__ import annotations
@@ -760,6 +765,89 @@ class ErrorSwallowing(Rule):
                     "a datapath error must be handled, logged, or "
                     "suppressed with a reason",
                     span=(node.lineno, node.lineno))
+
+
+# ------------------------------------------------------- bounded-queue
+@register
+class BoundedQueue(Rule):
+    id = "bounded-queue"
+    summary = ("server-side packages (net/, om/, scm/, gateway/, "
+               "codec/) must not construct unbounded queue.Queue / "
+               "deque instances")
+    rationale = (
+        "The overload-protection contract (ozone_tpu/admission): every "
+        "queue a service hop feeds must have an explicit bound, because "
+        "an unbounded queue accepts work faster than it drains and "
+        "converts overload into memory growth + unbounded latency — the "
+        "collapse mode admission control exists to prevent. DAGOR-style "
+        "shedding only works if there is nowhere for excess work to "
+        "hide. A queue whose depth is provably bounded by other "
+        "machinery (an ack window, an admission gate upstream) carries "
+        "a reasoned `# ozlint: allow[bounded-queue] -- why`.")
+
+    DIRS = ("net", "om", "scm", "gateway", "codec")
+    #: queue-class constructors taking maxsize as kwarg or first arg
+    QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
+
+    def check(self, src: SourceFile) -> Iterable[Finding]:
+        if not src.in_dirs(*self.DIRS):
+            return
+        module_env = _ConstEnv()
+        _collect_env(src.tree.body, module_env, recurse=False)
+        envs: dict[int, _ConstEnv] = {}
+        for call, fn in src.calls_with_fn:
+            name = last_name(call.func)
+            if name not in self.QUEUE_CTORS and name not in (
+                    "SimpleQueue", "deque"):
+                continue
+            key = id(fn)
+            env = envs.get(key)
+            if env is None:
+                env = envs[key] = _fn_env(module_env, fn)
+            if name == "SimpleQueue":
+                yield Finding(
+                    self.id, src.display_path, call.lineno,
+                    "`SimpleQueue()` cannot be bounded — use "
+                    "`queue.Queue(maxsize=...)` so excess work is "
+                    "refused, not accumulated",
+                    span=_span(call))
+            elif name in self.QUEUE_CTORS:
+                bound = None
+                if call.args:
+                    bound = call.args[0]
+                for kw in call.keywords:
+                    if kw.arg == "maxsize":
+                        bound = kw.value
+                if bound is None:
+                    yield self._unbounded(src, call, name,
+                                          "no `maxsize`")
+                else:
+                    v = _fold(bound, env)
+                    if v is not None and v <= 0:
+                        yield self._unbounded(
+                            src, call, name,
+                            f"`maxsize={int(v)}` (non-positive = "
+                            f"unlimited)")
+            else:  # deque
+                bound = call.args[1] if len(call.args) >= 2 else None
+                for kw in call.keywords:
+                    if kw.arg == "maxlen":
+                        bound = kw.value
+                if bound is None or (
+                        isinstance(bound, ast.Constant)
+                        and bound.value is None):
+                    yield self._unbounded(src, call, "deque",
+                                          "no `maxlen`")
+
+    def _unbounded(self, src: SourceFile, call: ast.Call, ctor: str,
+                   why: str) -> Finding:
+        return Finding(
+            self.id, src.display_path, call.lineno,
+            f"unbounded `{ctor}(...)` on a server-side module ({why}) "
+            f"— give it an explicit bound so overload is refused at "
+            f"admission instead of accumulating, or suppress with the "
+            f"reason the depth is bounded elsewhere",
+            span=_span(call))
 
 
 @register

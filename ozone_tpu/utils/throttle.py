@@ -56,3 +56,20 @@ class Throttle:
         if self.metrics is not None:
             self.metrics.counter("replication_throttled_bytes").inc(n)
         return slept
+
+    def try_take(self, n: float) -> float:
+        """Admission-control variant of :meth:`take`: consume `n` units
+        of budget only if available RIGHT NOW. Returns 0.0 when the
+        request was admitted (budget booked), otherwise the seconds
+        until `n` units will have accumulated — a Retry-After hint —
+        WITHOUT booking anything, so a refused caller leaves the bucket
+        untouched for better-behaved traffic."""
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return 0.0
+            return (n - self._tokens) / self.rate

@@ -1,0 +1,405 @@
+"""End-to-end overload protection (docs/OPERATIONS.md "Admission
+control").
+
+Three layers of proof:
+
+1. Unit contracts — the non-booking ``Throttle.try_take`` hint, tenant
+   bucket isolation, the bounded in-flight gate, SLO shedding by
+   priority, and the ``retry_after_s=`` hint round-trip.
+2. The wire contract — a bucket-refused S3 request maps to a
+   DETERMINISTIC 503 SlowDown with a Retry-After header, and an
+   OM-side refusal is honored by the client as backoff-not-failure
+   (same peer, floor from the hint, op still succeeds).
+3. Isolation on a live cluster — a flooding tenant is shed while an
+   interactive victim keeps its tail latency budget, with every
+   rejection visible in the ``admission`` registry.
+"""
+
+import contextlib
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ozone_tpu import admission
+from ozone_tpu.admission import (
+    AdmissionController,
+    InflightGate,
+    SloShedder,
+    TenantBuckets,
+    busy_error,
+    retry_after_hint,
+)
+from ozone_tpu.client import resilience
+from ozone_tpu.gateway.s3 import S3Gateway
+from ozone_tpu.gateway.s3_auth import sign_request
+from ozone_tpu.storage.ids import StorageError
+from ozone_tpu.testing.minicluster import (
+    MiniOzoneCluster,
+    MiniOzoneHACluster,
+)
+from ozone_tpu.utils.metrics import registry
+from ozone_tpu.utils.throttle import Throttle
+
+EC = "rs-3-2-4096"
+
+
+@contextlib.contextmanager
+def _admit_env(**knobs):
+    """Set OZONE_TPU_ADMIT_<K>=v knobs, drop the controller cache so
+    they take effect, and restore + reset on the way out."""
+    saved = {}
+    try:
+        for k, v in knobs.items():
+            key = f"OZONE_TPU_ADMIT_{k}"
+            saved[key] = os.environ.get(key)
+            os.environ[key] = str(v)
+        admission.reset_for_tests()
+        yield
+    finally:
+        for key, v in saved.items():
+            if v is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = v
+        admission.reset_for_tests()
+
+
+def _scale() -> float:
+    """Load-aware latency-budget multiplier (same discipline as
+    test_soak._starve_floor): an oversubscribed rig runs every thread
+    in slow motion, so tail budgets scale with load instead of flaking."""
+    try:
+        load = os.getloadavg()[0]
+    except OSError:
+        return 1.0
+    return min(4.0, max(1.0, load / max(1, os.cpu_count() or 1)))
+
+
+# ------------------------------------------------------- unit contracts
+def test_throttle_try_take_admits_now_or_hints_without_booking():
+    th = Throttle(10.0, burst_s=1.0)  # 10 tokens of burst
+    assert th.try_take(10) == 0.0  # whole burst admitted instantly
+    hint1 = th.try_take(5)
+    assert hint1 > 0.0  # refused: bucket empty
+    hint2 = th.try_take(5)
+    # the refusal did NOT book the 5 tokens: the second hint is not a
+    # doubled wait, it is the same ~0.5 s until 5 tokens accumulate
+    assert hint2 == pytest.approx(hint1, abs=0.2)
+
+
+def test_tenant_buckets_isolate_tenants():
+    b = TenantBuckets(ops_per_s=2.0, burst_s=1.0)
+    assert b.enabled
+    assert b.try_admit("noisy") == (None, 0.0)
+    assert b.try_admit("noisy") == (None, 0.0)
+    reason, wait = b.try_admit("noisy")
+    assert reason == "ops" and wait > 0.0
+    # a different tenant's bucket is untouched by the noisy one
+    assert b.try_admit("quiet") == (None, 0.0)
+
+
+def test_tenant_buckets_bytes_dimension_caps_single_charge():
+    b = TenantBuckets(bytes_per_s=1000.0, burst_s=1.0)
+    # a single request larger than the whole burst is admitted once
+    # (charge capped at the bucket size) rather than being unservable
+    assert b.try_admit("t", nbytes=50_000) == (None, 0.0)
+    reason, wait = b.try_admit("t", nbytes=100)
+    assert reason == "bytes" and wait > 0.0
+
+
+def test_inflight_gate_bounds_and_zero_disables():
+    g = InflightGate(2)
+    assert g.try_enter() and g.try_enter()
+    assert not g.try_enter()
+    g.exit()
+    assert g.try_enter()
+    off = InflightGate(0)
+    assert all(off.try_enter() for _ in range(100))
+
+
+def test_slo_shedder_sheds_bulk_spares_interactive():
+    depth = registry("codec.service").gauge("queue_depth")
+    prev = depth.value
+    try:
+        s = SloShedder(codec_depth=4, cache_s=0.0)
+        assert s.enabled
+        depth.set(10)
+        assert s.over_budget() == "slo_codec_depth"
+        assert s.should_shed("bulk") == "slo_codec_depth"
+        assert s.should_shed("interactive") is None
+        depth.set(0)
+        assert s.over_budget() is None
+        assert not SloShedder().enabled  # all thresholds 0 = off
+    finally:
+        depth.set(prev)
+
+
+def test_retry_after_hint_roundtrip_and_cap():
+    e = busy_error("om", "ops", 0.5)
+    assert e.code == admission.SERVER_BUSY
+    assert "om overloaded (ops)" in str(e)
+    assert retry_after_hint(str(e)) == pytest.approx(0.5)
+    # a deranged hint is capped so a client never parks for minutes
+    assert retry_after_hint("retry_after_s=999") == 30.0
+    assert retry_after_hint("no hint here") is None
+
+
+def test_controller_queue_gate_rejects_and_counts():
+    m = registry("admission")
+    ctl = AdmissionController("testhop", queue_limit=1,
+                              exempt=("Heartbeat",))
+    before = m.counter("testhop_rejected_queue").value
+    with ctl.admit("PutKey"):
+        with pytest.raises(StorageError) as ei:
+            with ctl.admit("PutKey"):
+                pass
+        assert ei.value.code == admission.SERVER_BUSY
+        assert retry_after_hint(str(ei.value)) is not None
+        # exempt control-plane verbs ride through a full queue
+        with ctl.admit("Heartbeat"):
+            pass
+    assert m.counter("testhop_rejected_queue").value == before + 1
+    assert m.counter("testhop_rejected_total").value >= before + 1
+    assert ctl.gate.inflight == 0
+
+
+def test_controller_charge_rejects_per_tenant():
+    m = registry("admission")
+    ctl = AdmissionController("testhop2", ops_per_s=1.0, burst_s=1.0)
+    before = m.counter("testhop2_tenant_rejections").value
+    ctl.charge("tenant-a")
+    with pytest.raises(StorageError) as ei:
+        ctl.charge("tenant-a")
+    assert ei.value.code == admission.SERVER_BUSY
+    assert retry_after_hint(str(ei.value)) > 0.0
+    ctl.charge("tenant-b")  # other tenants unaffected
+    assert m.counter("testhop2_tenant_rejections").value == before + 1
+    assert m.counter("testhop2_rejected_ops").value >= 1
+
+
+def test_server_busy_is_not_a_transport_fault():
+    """The load-bearing classification: pushback comes from a healthy
+    peer, so it must never trip circuit breakers or failover rotation —
+    that would turn graceful shedding into a cascading brownout."""
+    assert resilience.SERVER_BUSY not in resilience.TRANSPORT_FAULT_CODES
+
+
+def test_server_pushback_floor_classifies_and_counts():
+    before = resilience.METRICS.counter("server_busy").value
+    floor = resilience.server_pushback_floor(
+        busy_error("om", "ops", 0.4), "om")
+    assert floor == pytest.approx(0.4)
+    assert resilience.METRICS.counter("server_busy").value == before + 1
+    assert resilience.METRICS.counter("server_busy_om").value >= 1
+    # anything that is not SERVER_BUSY is not a pushback
+    assert resilience.server_pushback_floor(
+        StorageError("TIMEOUT", "deadline"), "om") is None
+    assert resilience.server_pushback_floor(ValueError("x"), "om") is None
+    assert resilience.METRICS.counter("server_busy").value == before + 1
+
+
+def test_retry_policy_sleep_honors_pushback_floor():
+    p = resilience.RetryPolicy(base_s=0.001, cap_s=0.002, max_attempts=4)
+    t0 = time.monotonic()
+    assert p.sleep(0, floor_s=0.08)
+    took = time.monotonic() - t0
+    assert took >= 0.08  # hint is a FLOOR under the jittered draw
+    t0 = time.monotonic()
+    assert p.sleep(0)  # no floor: the tiny backoff stays tiny
+    assert time.monotonic() - t0 < 0.05
+
+
+def test_qos_class_map_and_ambient_context():
+    with _admit_env(CLASS="batchco=bulk, liveco = interactive"):
+        assert admission.qos_class_for("batchco") == "bulk"
+        assert admission.qos_class_for("liveco") == "interactive"
+        assert admission.qos_class_for("unknown") == "interactive"
+        assert admission.current_tenant() is None
+        assert admission.ambient_qos("bulk") == "bulk"  # default passes
+        with admission.tenant_context("batchco"):
+            assert admission.current_tenant() == "batchco"
+            assert admission.ambient_qos() == "bulk"
+        assert admission.current_tenant() is None
+
+
+def test_per_hop_knob_override():
+    with _admit_env(OPS="0", OPS_GATEWAY="7"):
+        gw = admission.controller("gateway")
+        om = admission.controller("om")
+        assert gw.buckets.ops_per_s == 7.0
+        assert om.buckets.ops_per_s == 0.0
+
+
+# --------------------------------------------------------- live cluster
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = MiniOzoneCluster(
+        tmp_path_factory.mktemp("admission"),
+        num_datanodes=5,
+        block_size=8 * 4096,
+        container_size=4 * 1024 * 1024,
+        stale_after_s=1000.0,
+        dead_after_s=2000.0,
+    )
+    yield c
+    c.close()
+
+
+@pytest.fixture(scope="module")
+def gw(cluster):
+    g = S3Gateway(cluster.client(), replication=EC, require_auth=True)
+    g.start()
+    yield g
+    g.stop()
+
+
+def _signed(gw, creds, method, path, body=b""):
+    access, secret = creds
+    url = f"http://{gw.address}{path}"
+    headers = {
+        "host": gw.address,
+        "x-amz-date": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+    }
+    headers = sign_request(access, secret, method, url, headers, body)
+    req = urllib.request.Request(url, data=body or None, method=method,
+                                 headers=headers)
+    return urllib.request.urlopen(req)
+
+
+def test_gateway_maps_server_busy_to_503_slowdown(gw, cluster):
+    """Satellite 1: the S3 wire contract. With a 1 op/s tenant budget
+    the second back-to-back request is DETERMINISTICALLY refused: 503,
+    S3 ``SlowDown`` error code, and a Retry-After header the SDKs'
+    retry middlewares already honor."""
+    secret = cluster.client().om.get_s3_secret("admituser")
+    creds = ("admituser", secret)
+    with _admit_env(OPS_GATEWAY="1", BURST_S="1"):
+        assert _signed(gw, creds, "PUT", "/admitbkt").status == 200
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _signed(gw, creds, "PUT", "/admitbkt")
+        e = ei.value
+        body = e.read().decode()
+        assert e.code == 503
+        assert "<Code>SlowDown</Code>" in body
+        assert "retry_after_s=" in body  # machine-readable hint survives
+        ra = e.headers.get("Retry-After")
+        assert ra is not None and int(ra) >= 1
+        e.close()
+
+
+@pytest.fixture(scope="module")
+def grpc_cluster(tmp_path_factory):
+    """gRPC-served OM (MiniOzoneCluster wires the OM in-process without
+    the network layer, so OM-hop admission never runs there)."""
+    c = MiniOzoneHACluster(tmp_path_factory.mktemp("admissionha"),
+                           num_meta=1, num_datanodes=1)
+    yield c
+    c.shutdown()
+
+
+def test_om_pushback_is_backoff_not_failure(grpc_cluster):
+    """Satellite 2: a SERVER_BUSY refusal from the OM is absorbed by
+    the client retry loop — backoff to the hinted floor, SAME peer (no
+    failover rotation, no breaker trip) — so a paced-down caller still
+    succeeds on every op."""
+    oz = grpc_cluster.client()
+    m = registry("admission")
+    rej_before = m.counter("om_rejected_ops").value
+    busy_before = resilience.METRICS.counter("server_busy").value
+    with _admit_env(OPS_OM="4", BURST_S="0.5"):
+        for _ in range(8):  # unpaced: ~2 tokens of burst, 4/s refill
+            oz.om.list_volumes()  # must never raise
+    assert m.counter("om_rejected_ops").value > rej_before, \
+        "flood never tripped the OM bucket — test proved nothing"
+    assert resilience.METRICS.counter("server_busy").value > busy_before
+
+
+def test_per_tenant_isolation_under_flood(gw, cluster):
+    """The tentpole acceptance: an aggressor tenant flooding the
+    gateway is shed (visibly, in admission.*) while an interactive
+    victim tenant keeps its unloaded tail budget — isolation, not
+    fate-sharing."""
+    om = cluster.client().om
+    om.create_tenant("victimco")
+    victim = ("victimco-creds", "")
+    grant = om.tenant_assign_user("victimco", "vuser")
+    victim = (grant["access_id"], grant["secret"])
+    om.create_tenant("floodco")
+    grant = om.tenant_assign_user("floodco", "fuser")
+    flood = (grant["access_id"], grant["secret"])
+
+    m = registry("admission")
+    with _admit_env(OPS_GATEWAY="10", BURST_S="1",
+                    CLASS="floodco=bulk"):
+        _signed(gw, victim, "PUT", "/vb")
+        _signed(gw, victim, "PUT", "/vb/obj", b"v" * 1024)
+        _signed(gw, flood, "PUT", "/fb")
+        time.sleep(0.4)  # refill what setup spent
+
+        def victim_pass(n=10):
+            lat = []
+            for _ in range(n):
+                t0 = time.perf_counter()
+                _signed(gw, victim, "GET", "/vb/obj").read()
+                lat.append(time.perf_counter() - t0)
+                time.sleep(0.12)  # ~8 ops/s: inside the 10/s budget
+            return max(lat)
+
+        p99_unloaded = victim_pass()
+
+        rej_before = m.counter("gateway_rejected_total").value
+        shed = {"n": 0, "errors": 0}
+        stop = threading.Event()
+
+        def aggressor():
+            body = b"f" * 2048
+            i = 0
+            while not stop.is_set():
+                try:
+                    _signed(gw, flood, "PUT", f"/fb/k{i % 8}", body)
+                except urllib.error.HTTPError as e:
+                    if e.code == 503:
+                        shed["n"] += 1
+                    else:
+                        shed["errors"] += 1
+                    e.close()
+                i += 1
+
+        th = threading.Thread(target=aggressor, daemon=True)
+        th.start()
+        try:
+            p99_loaded = victim_pass()
+        finally:
+            stop.set()
+            th.join(timeout=10)
+
+    # the aggressor was shed, deterministically and observably
+    assert shed["n"] > 0, "flood was never refused"
+    assert shed["errors"] == 0, f"flood hit non-503 errors: {shed}"
+    assert m.counter("gateway_rejected_total").value > rej_before
+    assert m.counter("gateway_tenant_rejections").value > 0
+    # the victim's tail stayed inside its unloaded budget (load-aware)
+    budget = 2.0 * max(p99_unloaded, 0.05) * _scale()
+    assert p99_loaded <= budget, (
+        f"victim p99 {p99_loaded * 1e3:.1f} ms > budget "
+        f"{budget * 1e3:.1f} ms (unloaded {p99_unloaded * 1e3:.1f} ms)")
+
+
+def test_admission_snapshot_shape():
+    """/api/admission contract: every installed controller reports its
+    knobs, live in-flight depth, tenants seen, and shed state."""
+    with _admit_env(OPS_OM="4"):
+        admission.controller("om").charge("tenant-x")
+        snaps = {hop: c.snapshot()
+                 for hop, c in admission.controllers().items()}
+        assert "om" in snaps
+        s = snaps["om"]
+        assert s["enabled"] and s["ops_per_s"] == 4.0
+        assert s["queue_limit"] == 256
+        assert isinstance(s["tenants"], list) and s["tenants"]
+        assert set(s["shed"]) == {"p99_ms", "codec_depth", "mesh_depth",
+                                  "over_budget"}

@@ -5,7 +5,7 @@ Three contracts:
    Seeding any fixed violation back (a literal socket timeout in
    client/native_dn.py, an unfenced background DeleteKey, a jit keyed
    on an erasure pattern) fails this suite.
-2. Each of the seven rules demonstrably trips on its known-bad fixture
+2. Each of the eight rules demonstrably trips on its known-bad fixture
    and stays quiet on the known-good one (tests/lint_fixtures/).
 3. The CLI is fast and import-light: `python -m ozone_tpu.tools.lint
    --check` must run WITHOUT importing jax (OZONE_TPU_SKIP_JAX_PIN=1),
@@ -40,6 +40,7 @@ RULE_IDS = [
     "error-swallowing",
     "span-on-dispatch",
     "datapath-no-copy",
+    "bounded-queue",
 ]
 
 
@@ -81,7 +82,7 @@ def test_dispatch_shape_stability_covers_lrc_math(tmp_path):
         "per-pattern jitted LRC plan factory must trip the rule"
 
 
-def test_all_seven_rules_registered():
+def test_all_eight_rules_registered():
     for rid in RULE_IDS:
         assert rid in RULES, f"rule {rid} not registered"
         assert RULES[rid].summary and RULES[rid].rationale

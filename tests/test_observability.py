@@ -70,10 +70,31 @@ def test_recon_endpoints(cluster, monkeypatch):
             base + "/api/traces/slow?id=" + tid).read())
         assert detail["criticalPath"] and detail["spans"]
         assert sum(s["micros"] for s in detail["criticalPath"]) > 0
+        # admission panel: the view peeks at the controller cache (it
+        # must never install one), so a fresh process reports empty
+        ad = json.loads(
+            urllib.request.urlopen(base + "/api/admission").read())
+        assert set(ad) == {"enabled", "hops", "counters"}
+        # now install a controller the way a serving hop would and
+        # confirm the view surfaces its snapshot + rejection counters
+        from ozone_tpu import admission
+
+        admission.reset_for_tests()
+        try:
+            ctl = admission.controller("gateway")
+            with ctl.admit("GET"):
+                ad = json.loads(
+                    urllib.request.urlopen(base + "/api/admission").read())
+            assert "gateway" in ad["hops"]
+            assert ad["hops"]["gateway"]["inflight"] == 1
+            assert ad["counters"]["gateway_admitted"] >= 1
+        finally:
+            admission.reset_for_tests()
         # the dashboard page renders the heat panel
         page = urllib.request.urlopen(base + "/").read().decode()
         assert "Namespace heat" in page and "/api/heatmap" in page
         assert "Slow requests" in page and "/api/traces/slow" in page
+        assert "Admission control" in page and "/api/admission" in page
         # base endpoints still work
         prom = urllib.request.urlopen(base + "/prom").read().decode()
         assert "om_" in prom
@@ -165,6 +186,22 @@ def test_prometheus_text_golden_every_registry_renders():
                  "follower_read_hits", "follower_read_misses",
                  "lease_renewals", "slots_migrated"):
         SHARD.counter(name).inc(0)
+    # the admission-control family (docs/OPERATIONS.md "Admission
+    # control"): per-hop, per-reason rejection counters — the numbers
+    # that separate healthy shed from collapse on the Recon panel —
+    # plus the client-side server_busy pushback counter (deliberately
+    # distinct from deadline_exceeded: pushback is not a fault)
+    from ozone_tpu.admission import METRICS as ADMIT
+
+    for name in ("gateway_admitted", "gateway_rejected_total",
+                 "gateway_rejected_queue", "gateway_rejected_ops",
+                 "gateway_rejected_bytes", "gateway_rejected_slo_p99",
+                 "gateway_tenant_rejections", "om_admitted",
+                 "om_rejected_total", "om_rejected_ops",
+                 "om_tenant_rejections"):
+        ADMIT.counter(name).inc(0)
+    ADMIT.gauge("gateway_inflight").set(0)
+    RES.counter("server_busy").inc(0)
     text = m.prometheus_text()
     lines = text.splitlines()
     name_re = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -229,7 +266,19 @@ def test_prometheus_text_golden_every_registry_renders():
                  "om_shard_cross_shard_aborts",
                  "om_shard_follower_read_hits",
                  "om_shard_follower_read_misses",
-                 "om_shard_lease_renewals", "om_shard_slots_migrated"):
+                 "om_shard_lease_renewals", "om_shard_slots_migrated",
+                 "admission_gateway_admitted",
+                 "admission_gateway_rejected_total",
+                 "admission_gateway_rejected_queue",
+                 "admission_gateway_rejected_ops",
+                 "admission_gateway_rejected_bytes",
+                 "admission_gateway_rejected_slo_p99",
+                 "admission_gateway_tenant_rejections",
+                 "admission_gateway_inflight",
+                 "admission_om_admitted", "admission_om_rejected_total",
+                 "admission_om_rejected_ops",
+                 "admission_om_tenant_rejections",
+                 "client_resilience_server_busy"):
         stem = want.removesuffix("_seconds")
         assert any(s.startswith(stem) for s in seen_metrics), want
     assert "# TYPE client_resilience_deadline_exceeded counter" in text
@@ -238,6 +287,9 @@ def test_prometheus_text_golden_every_registry_renders():
     assert "# HELP codec_service_tail_flushes " in text
     assert "# TYPE codec_service_batch_fill_pct gauge" in text
     assert "# TYPE replication_keys_shipped counter" in text
+    assert "# TYPE admission_gateway_rejected_total counter" in text
+    assert "# TYPE admission_gateway_inflight gauge" in text
+    assert "# TYPE client_resilience_server_busy counter" in text
     assert "# TYPE replication_lag_entries gauge" in text
     assert "# HELP replication_lag_seconds " in text
     assert "# TYPE om_shard_routes counter" in text

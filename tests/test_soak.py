@@ -34,6 +34,17 @@ post-heal run-now pass finishes what the chaos interrupted, and
 invariant 1 extends to the tiered bucket (acked keys byte-exact
 whether replicated, transitioned, or abandoned mid-transition, with
 at least one transition landed by end state).
+PR 18 adds an overload-burst overlay: a per-tenant gateway ops budget
+is armed for the whole run (the paced load mix fits comfortably under
+it), and mid-chaos a seeded burst offers several times that budget
+through unpaced closed-loop S3 PUTs. Excess must be SHED — 503 SlowDown
+with a Retry-After header on every refusal — never queued into
+collapse; acked burst keys join invariant 5 (byte-exact through the
+gateway), and a post-heal paced probe proves steady-state goodput is
+restored (shedding is a transient of offered load, not a latched
+state). Like the slow-peer overlay it rides an INDEPENDENT rng stream
+(seed + 88_888) so historical chaos schedules stay byte-identical.
+
 CI runs the default seed list below; a long nightly sweep is
 `OZONE_TPU_SOAK_SEEDS=1,2,3,... OZONE_TPU_SOAK_S=120 pytest
 tests/test_soak.py` (any seed count, longer chaos window).
@@ -125,6 +136,12 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
     monkeypatch.setenv("OZONE_TPU_LIFECYCLE_DEADLINE_S", "4")
     monkeypatch.setenv("OZONE_TPU_LIFECYCLE_MBPS", "8")
     monkeypatch.setenv("OZONE_TPU_LIFECYCLE_PERIOD_S", "20")
+    # overload overlay: a modest per-tenant gateway ops budget for the
+    # whole run — the paced gateway load (~5 ops/s) fits under it, the
+    # seeded mid-chaos burst below deliberately does not
+    monkeypatch.setenv("OZONE_TPU_ADMIT_OPS_GATEWAY", "10")
+    from ozone_tpu import admission
+    admission.reset_for_tests()
     rng = random.Random(seed)
     ports = _free_ports(N_META)
     peers = {f"m{i}": f"127.0.0.1:{ports[i]}" for i in range(N_META)}
@@ -281,6 +298,48 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
                 n += 1
                 time.sleep(0.2)
 
+        # -------------------------------------------- overload overlay
+        burst_stats = {"acked": 0, "shed": 0, "retry_after": 0}
+        burst_lock = threading.Lock()
+
+        def overload_burst(wid: int) -> None:
+            # INDEPENDENT rng stream (like the slow-peer overlay): the
+            # burst schedule must not reshuffle the historical chaos
+            # draws of the CI seeds
+            import urllib.error
+
+            brng = random.Random(seed + 88_888 + wid)
+            t_start = time.time() + CHAOS_S * brng.uniform(0.25, 0.4)
+            while time.time() < t_start:
+                if stop.is_set():
+                    return
+                time.sleep(0.1)
+            # unpaced closed loop, two workers: offered load runs well
+            # past the 10 ops/s tenant budget — a 3x-plus overload ramp
+            t_stop = time.time() + min(6.0, CHAOS_S * 0.2)
+            n = 0
+            while time.time() < t_stop and not stop.is_set():
+                key = f"s3burst-{wid}-{n}"
+                try:
+                    _http("PUT", f"http://{s3gw.address}/soak/{key}",
+                          data=s3_payload)
+                    acked_s3.append(key)  # invariant 5 covers it
+                    with burst_lock:
+                        burst_stats["acked"] += 1
+                except urllib.error.HTTPError as e:
+                    if e.code == 503:
+                        with burst_lock:
+                            burst_stats["shed"] += 1
+                            if e.headers.get("Retry-After"):
+                                burst_stats["retry_after"] += 1
+                    e.close()  # non-503 (mid-failover 5xx): no claim
+                except OSError:
+                    pass  # mid-failover: no durability claim
+                except Exception as e:  # noqa: BLE001
+                    hard_errors.append(e)
+                    return
+                n += 1
+
         def metadata_load():
             n = 0
             while not stop.is_set():
@@ -337,6 +396,10 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
                              daemon=True),
             threading.Thread(target=metadata_load, daemon=True),
             threading.Thread(target=gateway_load, daemon=True),
+            threading.Thread(target=overload_burst, args=(0,),
+                             daemon=True),
+            threading.Thread(target=overload_burst, args=(1,),
+                             daemon=True),
         ]
         for t in threads:
             t.start()
@@ -433,6 +496,11 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
             t.join(timeout=60)
         assert not any(t.is_alive() for t in threads), "load wedged"
         assert not hard_errors, hard_errors
+        # every burst refusal was a deterministic, hinted 503: the
+        # wire contract holds under full chaos, not just in isolation
+        if burst_stats["shed"]:
+            assert burst_stats["retry_after"] == burst_stats["shed"], \
+                f"shed 503s missing Retry-After: {burst_stats}"
         floor = _starve_floor()
         assert len(acked_ec) >= floor, \
             f"EC writer starved: {len(acked_ec)} < {floor}"
@@ -442,6 +510,26 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
             f"S3 writer starved: {len(acked_s3)} < {floor}"
         _await_leader(metas, timeout=30)
         time.sleep(2.0)  # let heartbeats re-register restarted nodes
+
+        # steady-state goodput restored after the overload burst: a
+        # paced probe inside the tenant budget is ADMITTED again —
+        # shedding is a transient of offered load, not a latched state
+        restored, i = 0, 0
+        probe_deadline = time.monotonic() + 30.0
+        while restored < 3 and time.monotonic() < probe_deadline:
+            key = f"s3-post-burst-{i}"
+            try:
+                _http("PUT", f"http://{s3gw.address}/soak/{key}",
+                      data=s3_payload)
+                acked_s3.append(key)  # byte-exact checked below
+                restored += 1
+            except OSError:
+                pass  # still healing: retried until the deadline
+            i += 1
+            time.sleep(0.2)
+        assert restored >= 3, (
+            f"steady-state goodput not restored after overload burst "
+            f"({restored} admitted, stats {burst_stats})")
 
         # 0. replica-state convergence: once every replica reaches the
         # same applied position, their keys-table digests must be equal
@@ -568,6 +656,9 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
         partition.clear()
         for rid in slow_rules:
             partition.remove_rule(rid)
+        # drop the admission controllers armed for this run so later
+        # tests re-read a clean environment
+        admission.reset_for_tests()
         for gw in (s3gw, httpfs):
             if gw is not None:
                 try:
