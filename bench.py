@@ -64,7 +64,10 @@ _STATE: dict = {"value": 0.0, "spread_pct": 0.0, "sustained": None,
                 "e2e_put": None, "e2e_get": None, "e2e_copies": None,
                 "repair_econ": None, "lrc_repair_reduction": None,
                 "swarm_goodput": None, "swarm_retention": None,
-                "swarm_victim_p99": None, "swarm_shed": None}
+                "swarm_victim_p99": None, "swarm_shed": None,
+                "small_obj_ops": None, "small_obj_speedup": None,
+                "small_obj_overhead": None, "small_obj_stripes": None,
+                "small_obj_list_ms": None}
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
@@ -180,6 +183,12 @@ def emit_line(timed_out: bool = False, error: str = "") -> None:
             line["swarm_victim_p99_ms"] = round(
                 _STATE["swarm_victim_p99"], 2)
             line["swarm_shed_fraction"] = round(_STATE["swarm_shed"], 3)
+        if _STATE["small_obj_ops"] is not None:
+            line["small_put_ops_s"] = _STATE["small_obj_ops"]
+            line["small_put_speedup_x"] = _STATE["small_obj_speedup"]
+            line["effective_overhead_tiny"] = _STATE["small_obj_overhead"]
+            line["small_obj_stripes"] = _STATE["small_obj_stripes"]
+            line["list_after_ingest_ms"] = _STATE["small_obj_list_ms"]
         if _STATE["lrc_repair_reduction"] is not None:
             line["lrc_repair_reduction_x"] = round(
                 _STATE["lrc_repair_reduction"], 2)
@@ -1355,6 +1364,192 @@ def bench_concurrent_small_put(writers: int = 256, key_mib: int = 4,
         codec_service.reset_for_tests()
 
 
+def bench_small_objects(n_keys: int = 600, size: int = 4096,
+                        threads: int = 8,
+                        overhead_keys: int = 10_000) -> dict:
+    """Tiny-object fast-path acceptance bench, three sections.
+
+    `small_put_ops_s`: 4 KiB PUT throughput at 1/2/4 OM shards
+    (plain-mode sharded plane over one shared data plane), packer on vs
+    off. On: the key routes inline/needle through the small-object
+    path. Off: the same population forced down the classic per-key
+    open/allocate/commit EC stripe path. Every acked key is read back
+    byte-exact in both modes (freon tinyg validate). The fast path must
+    clear 5x the per-key baseline.
+
+    `effective_overhead_tiny`: 10k x 4 KiB keys ingested as needles
+    (inline threshold pinned below the key size) into slab stripes.
+    DN-visible bytes over user bytes must land within 10% of the EC
+    scheme's n/k, and the codec dispatch counters must show <=
+    overhead_keys/64 encoded stripes — the proof tiny keys coalesce
+    into shared stripes instead of one padded stripe each.
+
+    `list_after_ingest_ms`: a full bucket listing right after the 10k
+    ingest — needle keys are ordinary key rows, so LIST stays a pure
+    metadata scan."""
+    import shutil
+    import tempfile
+    import time as _time
+    from pathlib import Path
+
+    from ozone_tpu.client.dn_client import DatanodeClientFactory
+    from ozone_tpu.codec import service as codec_service
+    from ozone_tpu.om.sharding.plane import ShardedMetaPlane
+    from ozone_tpu.scm.scm import StorageContainerManager
+    from ozone_tpu.storage.datanode import Datanode
+    from ozone_tpu.tools import freon
+
+    def data_plane(tmp: Path, n_dns: int):
+        scm = StorageContainerManager(
+            min_datanodes=1, container_size=256 * 1024 * 1024,
+            placement_seed=42, stale_after_s=1e6, dead_after_s=2e6)
+        clients = DatanodeClientFactory()
+        dns = []
+        for i in range(n_dns):
+            dn = Datanode(tmp / f"dn{i}", dn_id=f"dn{i}")
+            dns.append(dn)
+            clients.register_local(dn)
+            scm.register_datanode(dn.id, rack="/default-rack",
+                                  capacity_bytes=16 * 2**30)
+        return scm, clients, dns
+
+    # -- section 1: sharded PUT throughput, packer on vs off ----------
+    on_ops: dict[str, float] = {}
+    off_ops: dict[str, float] = {}
+    off_keys = max(100, n_keys // 4)
+    tmp = Path(tempfile.mkdtemp(prefix="ozone-bench-smallobj-"))
+    scm, clients, dns = data_plane(tmp / "data", 6)
+    try:
+        for n in (1, 2, 4):
+            plane = ShardedMetaPlane(tmp / f"meta{n}", n_shards=n,
+                                     mode="plain", scm=scm,
+                                     clients=clients)
+            try:
+                oz = plane.client(clients)
+                rep = freon.tinyg(
+                    oz, n_keys=n_keys, size=size, threads=threads,
+                    bucket=f"tiny-on-{n}", replication="rs-3-2-4096",
+                    packer=True, validate=True)
+                assert rep.failures == 0 and \
+                    rep.extras["verify_failures"] == 0, \
+                    f"packer-on readback failed at {n} shard(s)"
+                on_ops[str(n)] = rep.ops / rep.elapsed_s
+                rep = freon.tinyg(
+                    oz, n_keys=off_keys, size=size, threads=threads,
+                    bucket=f"tiny-off-{n}", replication="rs-3-2-4096",
+                    packer=False, validate=True)
+                assert rep.failures == 0 and \
+                    rep.extras["verify_failures"] == 0, \
+                    f"packer-off readback failed at {n} shard(s)"
+                off_ops[str(n)] = rep.ops / rep.elapsed_s
+            finally:
+                plane.close()
+        speedup = {k: on_ops[k] / off_ops[k] for k in on_ops}
+        best = max(speedup.values())
+        assert best >= 5.0, (
+            f"small-object fast path below 5x the per-key EC baseline: "
+            f"{speedup}")
+
+        # -- section 2 + 3: needle packing economics + LIST ------------
+        # pin the inline threshold below the key size so every key
+        # becomes a needle, and stretch the packer linger so concurrent
+        # writers fill slabs (the coalescing under test)
+        # slab target = 1.5 MiB = exactly 4 rs-3-2-131072 stripes,
+        # more writer threads than needles-per-slab (448 > 384) so the
+        # queue crosses the size trigger, and a linger far above the
+        # per-slab flush time so slabs close stripe-aligned on size —
+        # parity is written per stripe at full cell size, so a
+        # linger-cut partial slab would pay disproportionate padding
+        env_keys = ("OZONE_TPU_INLINE_MAX", "OZONE_TPU_SLAB_LINGER_MS",
+                    "OZONE_TPU_SLAB_TARGET_MIB")
+        prev_env = {k: os.environ.get(k) for k in env_keys}
+        os.environ["OZONE_TPU_INLINE_MAX"] = "256"
+        os.environ["OZONE_TPU_SLAB_LINGER_MS"] = "2000"
+        os.environ["OZONE_TPU_SLAB_TARGET_MIB"] = "1.5"
+        ov_tmp = tmp / "overhead"
+        ov_scm, ov_clients, ov_dns = data_plane(ov_tmp / "data", 6)
+        try:
+            plane = ShardedMetaPlane(ov_tmp / "meta", n_shards=1,
+                                     mode="plain", scm=ov_scm,
+                                     clients=ov_clients)
+            try:
+                oz = plane.client(ov_clients)
+                s0 = codec_service.METRICS.counter(
+                    "stripes_dispatched").value
+                rep = freon.tinyg(
+                    oz, n_keys=overhead_keys, size=size, threads=448,
+                    bucket="tiny-econ",
+                    replication="rs-3-2-131072",
+                    packer=True, validate=True)
+                assert rep.failures == 0 and \
+                    rep.extras["verify_failures"] == 0, \
+                    "overhead-ingest readback failed"
+                assert rep.extras["inline_keys"] == 0, \
+                    "inline threshold override did not take"
+                stripes = int(codec_service.METRICS.counter(
+                    "stripes_dispatched").value - s0)
+                max_stripes = overhead_keys // 64
+                assert stripes <= max_stripes, (
+                    f"{overhead_keys} tiny keys needed {stripes} "
+                    f"stripes (> {max_stripes}): needle packing is "
+                    f"not coalescing")
+                # stored object bytes = chunk payload files (the DN's
+                # bounded rocksdb-analog metadata is not object data)
+                user_bytes = overhead_keys * size
+                dn_bytes = sum(
+                    f.stat().st_size
+                    for f in (ov_tmp / "data").rglob("*.block"))
+                overhead = dn_bytes / user_bytes
+                lens = sorted(
+                    s["length"] for s in oz.om.list_slabs(
+                        "freon-vol", "tiny-econ"))
+                log(f"  tiny ingest: {len(lens)} slab(s), fill "
+                    f"min/median/max {lens[0]}/"
+                    f"{lens[len(lens) // 2]}/{lens[-1]} B, "
+                    f"{stripes} stripe(s), overhead {overhead:.3f}")
+                target = 5.0 / 3.0  # rs-3-2 n/k
+                assert overhead <= 1.1 * target, (
+                    f"effective overhead {overhead:.3f} exceeds "
+                    f"{target:.3f} (n/k) by more than 10%")
+                t0 = _time.perf_counter()
+                listed = oz.get_volume("freon-vol") \
+                    .get_bucket("tiny-econ").list_keys()
+                list_ms = 1e3 * (_time.perf_counter() - t0)
+                assert len(listed) >= overhead_keys, \
+                    f"LIST returned {len(listed)} < {overhead_keys}"
+            finally:
+                plane.close()
+        finally:
+            for k, v in prev_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            for dn in ov_dns:
+                try:
+                    dn.close()
+                except Exception:  # noqa: BLE001 - teardown
+                    pass
+        return {
+            "ops_s": {k: round(v, 1) for k, v in on_ops.items()},
+            "baseline_ops_s": {k: round(v, 1)
+                               for k, v in off_ops.items()},
+            "speedup_x": round(best, 2),
+            "effective_overhead_tiny": round(overhead, 3),
+            "overhead_target": round(target, 3),
+            "slab_stripes": stripes,
+            "slabs": rep.extras["slabs"],
+            "list_after_ingest_ms": round(list_ms, 1),
+        }
+    finally:
+        for dn in dns:
+            try:
+                dn.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_cpu_reference(cell: int = 1024 * 1024) -> float:
     """Config #1: in-process numpy RawErasureEncoder.encode() RS(3,2)."""
     from ozone_tpu.codec import create_encoder
@@ -1635,6 +1830,24 @@ def main() -> None:
                 f"{100 * mo['follower_hit_rate']:.0f}%")
         except Exception as e:
             log(f"meta-ops bench failed: {e}")
+    if budget_for("small-objects bench", 180):
+        try:
+            so = bench_small_objects()
+            _STATE["small_obj_ops"] = so["ops_s"]
+            _STATE["small_obj_speedup"] = so["speedup_x"]
+            _STATE["small_obj_overhead"] = so["effective_overhead_tiny"]
+            _STATE["small_obj_stripes"] = so["slab_stripes"]
+            _STATE["small_obj_list_ms"] = so["list_after_ingest_ms"]
+            log(f"tiny-object fast path: {so['ops_s']} PUT ops/s "
+                f"(packer on, 1/2/4 shards) vs {so['baseline_ops_s']} "
+                f"per-key EC ({so['speedup_x']:.1f}x), effective "
+                f"overhead {so['effective_overhead_tiny']:.3f} vs "
+                f"{so['overhead_target']:.3f} n/k, "
+                f"{so['slab_stripes']} stripe(s) for 10k keys in "
+                f"{so['slabs']} slab(s), LIST after ingest "
+                f"{so['list_after_ingest_ms']:.0f} ms")
+        except Exception as e:
+            log(f"small-objects bench failed: {e}")
     if budget_for("freon swarm bench", 60):
         try:
             sw = bench_freon_swarm()

@@ -10,7 +10,7 @@ the key's replication config.
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import Any, Optional
 
 import numpy as np
 
@@ -156,6 +156,9 @@ class OzoneBucket:
         self.client = client
         self.volume = volume
         self.name = name
+        # small-object conf cache: False = not fetched yet, None =
+        # fetched, bucket not opted in (see _smallobj_conf)
+        self._smallobj: Any = False
 
     def _make_writer(self, session: OpenKeySession):
         om = self.client.om
@@ -232,6 +235,18 @@ class OzoneBucket:
         return KeyWriteHandle(session, om, self._make_writer(session),
                               dek=self._data_key(session.encryption))
 
+    def _smallobj_conf(self) -> Optional[dict]:
+        """The bucket's small-object thresholds, fetched once per handle
+        (None = bucket never opted in, the overwhelmingly common case —
+        a single cached miss keeps the regular PUT path at zero extra
+        OM round-trips)."""
+        if self._smallobj is False:
+            from ozone_tpu.client.slab import smallobj_conf
+
+            self._smallobj = smallobj_conf(
+                self.client.om.bucket_info(self.volume, self.name))
+        return self._smallobj
+
     def write_key(self, key: str, data,
                   replication: Optional[str] = None,
                   metadata: Optional[dict] = None) -> None:
@@ -243,6 +258,28 @@ class OzoneBucket:
         with Tracer.instance().span("client:put", volume=self.volume,
                                     bucket=self.name, key=key) as sp:
             with resilience.start("key_write"):
+                # tiny-object routing: only for scheme-default writes on
+                # an opted-in bucket (an explicit per-key replication
+                # always takes the regular stripe path)
+                conf = None if replication else self._smallobj_conf()
+                if conf is not None:
+                    raw = (data.tobytes()
+                           if isinstance(data, np.ndarray)
+                           else bytes(data))
+                    if len(raw) <= conf["inline_max"]:
+                        self.client.om.put_inline_key(
+                            self.volume, self.name, key, raw,
+                            metadata=metadata)
+                        raw = None
+                    elif len(raw) <= conf["needle_max"]:
+                        self.client.packer.put(
+                            self.volume, self.name, key, raw,
+                            metadata=metadata)
+                        raw = None
+                    if raw is None:
+                        METRICS.histogram("put_seconds").observe(
+                            time.perf_counter() - t0, sp.trace_id)
+                        return
                 with self.open_key(key, replication,
                                    metadata=metadata) as h:
                     h.write(data)
@@ -298,10 +335,52 @@ class OzoneBucket:
                                     key=info.get("key", ""),
                                     bytes=length) as sp:
             with resilience.start("key_read"):
-                out = self._read_groups_range(om, info, offset, length)
+                if info.get("inline") is not None:
+                    out = self._read_inline(info, offset, length)
+                elif info.get("needle"):
+                    out = self._read_needle(om, info, offset, length)
+                else:
+                    out = self._read_groups_range(om, info, offset,
+                                                  length)
         METRICS.histogram("get_seconds").observe(
             time.perf_counter() - t0, sp.trace_id)
         return out
+
+    def _read_inline(self, info: dict, offset: int,
+                     length: int) -> np.ndarray:
+        """Inline value GET: the bytes rode the OM key row (possibly a
+        follower's lease read) — zero datapath hops."""
+        import base64
+
+        from ozone_tpu.client.slab import METRICS as SMALLOBJ
+
+        raw = base64.b64decode(info["inline"])
+        SMALLOBJ.counter("inline_gets").inc()
+        return np.frombuffer(raw, np.uint8)[offset:offset + length].copy()
+
+    def _read_needle(self, om, info: dict, offset: int,
+                     length: int) -> np.ndarray:
+        """Needle GET: slice this key's bytes out of its shared slab via
+        ordinary ranged group reads. The WHOLE needle is always fetched
+        (they're small by construction) so its commit-time CRC can gate
+        the reply — a torn or mis-pointed needle is an error, never
+        bytes."""
+        from ozone_tpu.client.slab import (METRICS as SMALLOBJ,
+                                           NEEDLE_CRC_MISMATCH)
+        from ozone_tpu.om.requests import OMError
+        from ozone_tpu.utils.checksum import crc32c
+
+        nd = info["needle"]
+        whole = self._read_groups_range(om, info, int(nd["offset"]),
+                                        int(nd["length"]))
+        if int(crc32c(whole)) != int(nd["crc"]):
+            SMALLOBJ.counter("needle_crc_errors").inc()
+            raise OMError(
+                NEEDLE_CRC_MISMATCH,
+                f"needle {info.get('key', '')} in slab {nd['slab']} "
+                f"failed its CRC gate")
+        SMALLOBJ.counter("needle_gets").inc()
+        return whole[offset:offset + length].copy()
 
     def _read_groups_range(self, om, info: dict, offset: int,
                            length: int) -> np.ndarray:
@@ -440,6 +519,19 @@ class OzoneClient:
         #: dispatches; background replayers (geo replication) run at
         #: "bulk" so they can never starve interactive traffic
         self.qos_class = qos_class
+        self._packer = None
+
+    @property
+    def packer(self):
+        """Process-wide needle packer, started on first small PUT. Slab
+        flushes ride bulk QoS so a mass-ingest burst defers to
+        interactive traffic in the codec's fair lanes."""
+        if self._packer is None:
+            from ozone_tpu.client.slab import SlabPacker
+
+            self._packer = SlabPacker(self.om, self.clients,
+                                      qos_class="bulk")
+        return self._packer
 
     def create_volume(self, volume: str) -> OzoneVolume:
         self.om.create_volume(volume)

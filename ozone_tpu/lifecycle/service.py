@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+import numpy as np
+
 from ozone_tpu.client import resilience
 from ozone_tpu.lifecycle.policy import (
     ACTION_EXPIRE,
@@ -323,6 +325,150 @@ class LifecycleService:
         if repl.type is ReplicationType.EC and repl.ec.codec != "xor":
             return
         work.append((volume, bucket, key, rule.target))
+
+    # -------------------------------------------------- needle compaction
+    def compact_slabs_once(self, max_slabs: Optional[int] = None) -> dict:
+        """One needle-compaction sweep (the f4 volume-compaction analog):
+        scan slab rows for dead-needle ratio past the knob
+        (OZONE_TPU_SLAB_DEAD_RATIO, default 0.5), rewrite the survivors
+        into a fresh slab through the codec service at bulk QoS with
+        per-key rewrite fences, then retire the old slab and hand its
+        blocks to scm/block_deletion — only AFTER the new commit acked,
+        the same release ordering as tiering. Snapshotted buckets are
+        skipped: their slab blocks may be referenced by snapshot rows."""
+        if not self.leader_fn():
+            return {"skipped": "not_leader"}
+        from ozone_tpu.utils.config import env_float
+        from ozone_tpu.client.slab import METRICS as SMALLOBJ
+
+        dead_ratio = env_float("OZONE_TPU_SLAB_DEAD_RATIO", 0.5)
+        stats = {"slabs_scanned": 0, "compacted": 0, "skipped": 0,
+                 "conflicts": 0, "needles_rewritten": 0,
+                 "bytes_rewritten": 0, "blocks_released": 0}
+        candidates = []
+        for sk, srow in list(self.om.store.iterate("slabs")):
+            stats["slabs_scanned"] += 1
+            length = max(1, int(srow.get("length", 0)))
+            dead = int(srow.get("dead_bytes", 0))
+            n_dead = int(srow.get("dead_count", 0))
+            if (dead / length >= dead_ratio
+                    or n_dead >= len(srow.get("needles", {}))):
+                candidates.append(srow)
+        with resilience.start("slab_compaction",
+                              seconds=self.sweep_deadline_s):
+            for srow in candidates[:max_slabs]:
+                resilience.check_deadline("slab_compaction")
+                vol, bkt = srow["volume"], srow["bucket"]
+                if rq.bucket_snapshots(self.om.store, vol, bkt):
+                    stats["skipped"] += 1
+                    continue
+                try:
+                    self._compact_slab(srow, stats)
+                except (rq.OMError, StorageError, OSError, KeyError) as e:
+                    log.warning("lifecycle: compaction of slab %s "
+                                "failed: %s", srow["slab_id"], e)
+                    stats["skipped"] += 1
+        SMALLOBJ.counter("compaction_slabs").inc(stats["compacted"])
+        SMALLOBJ.counter("compaction_bytes").inc(stats["bytes_rewritten"])
+        SMALLOBJ.counter("compaction_conflicts").inc(stats["conflicts"])
+        return stats
+
+    def _compact_slab(self, srow: dict, stats: dict) -> None:
+        from ozone_tpu.client.slab import SlabPacker
+        from ozone_tpu.om.metadata import key_key
+        from ozone_tpu.utils.checksum import crc32c
+
+        vol, bkt, sid = srow["volume"], srow["bucket"], srow["slab_id"]
+        # survivors: needles whose LIVE key row still points at this
+        # slab with the recorded object id (anything else — deleted,
+        # overwritten, already re-homed — is dead weight)
+        survivors = []
+        for key, nd in sorted(srow.get("needles", {}).items()):
+            row = self.om.store.get("keys", key_key(vol, bkt, key))
+            if (row is not None and row.get("needle")
+                    and row["needle"].get("slab") == sid
+                    and row.get("object_id") == nd.get("oid")):
+                survivors.append((key, row))
+        if survivors:
+            data = {}
+            for key, row in survivors:
+                nd = row["needle"]
+                raw = self._read_slab_range(srow, int(nd["offset"]),
+                                            int(nd["length"]))
+                if int(crc32c(raw)) != int(nd["crc"]):
+                    raise StorageError(
+                        "CHECKSUM_MISMATCH",
+                        f"survivor {key} of slab {sid} fails its CRC")
+                data[key] = raw
+            # pack the survivors into a fresh slab via the packer's
+            # write path (bulk QoS, shared codec service), fenced on the
+            # exact versions we read — a racing user overwrite wins and
+            # its needle simply counts dead in the NEW slab
+            packer = SlabPacker(self.om, self.clients(),
+                                qos_class="bulk")
+            from ozone_tpu.client.slab import _BucketQueue, _Pending
+
+            q = _BucketQueue(vol, bkt, srow["replication"])
+            for key, row in survivors:
+                p = _Pending(key, bytes(data[key].tobytes()), None)
+                q.items.append(p)
+                q.nbytes += len(p.data)
+            out = packer._write_and_commit_fenced(
+                q, [(row.get("object_id", ""),
+                     int(row.get("generation", -1)))
+                    for _, row in survivors])
+            stats["conflicts"] += len(out.get("skipped", ()))
+            stats["needles_rewritten"] += len(out.get("committed", ()))
+            stats["bytes_rewritten"] += sum(
+                len(v) for k, v in data.items()
+                if k in set(out.get("committed", ())))
+        # retire the old slab row, THEN release its blocks: the blocks
+        # outlive every committed pointer at them, never the reverse
+        old = self.om.submit(rq.RetireSlab(vol, bkt, sid))
+        from ozone_tpu.client.slab import METRICS as SMALLOBJ
+        from ozone_tpu.storage.ids import BlockID
+
+        txs = []
+        for gj in old.get("block_groups", []):
+            txs.append((BlockID(gj["container_id"], gj["local_id"]),
+                        list(gj["nodes"])))
+        if txs:
+            self.om.scm.delete_blocks(txs)
+            stats["blocks_released"] += len(txs)
+        stats["compacted"] += 1
+        SMALLOBJ.counter("slabs_retired").inc()
+        log.info("lifecycle: compacted slab %s/%s/%s (%d survivors, "
+                 "%d blocks released)", vol, bkt, sid, len(survivors),
+                 len(txs))
+
+    def _read_slab_range(self, srow: dict, offset: int,
+                         length: int) -> np.ndarray:
+        """Ranged read out of a slab's EC groups (bulk QoS): the same
+        group-walk the client read path does, against the slab row's
+        own block directory."""
+        from ozone_tpu.client.ec_reader import ECBlockGroupReader
+        from ozone_tpu.client.ec_writer import BlockGroup
+
+        info = self.om.mint_read_tokens(
+            {"block_groups": list(srow["block_groups"])})
+        parts = []
+        pos = 0
+        for gj in info["block_groups"]:
+            g = BlockGroup.from_json(gj)
+            a, b = max(offset, pos), min(offset + length, pos + g.length)
+            if a < b:
+                reader = ECBlockGroupReader(
+                    g, g.pipeline.replication.ec, self.clients(),
+                    qos_class="bulk")
+                parts.append(reader.read(a - pos, b - a))
+            pos += g.length
+        out = (np.concatenate(parts) if parts
+               else np.zeros(0, np.uint8))
+        if out.size != length:
+            raise StorageError(
+                "IO_EXCEPTION",
+                f"slab range [{offset},{offset + length}) short read")
+        return out
 
     @staticmethod
     def _stats_row(stats: dict, now: float) -> dict:

@@ -1243,6 +1243,10 @@ class ScmOmDaemon:
                     if now_m - self._lc_last >= self._lc_period:
                         self._lc_last = now_m
                         self.lifecycle.run_once()
+                        # needle compaction rides the same cadence:
+                        # leader-gated internally, scans nothing when
+                        # no slab crosses the dead-ratio threshold
+                        self.lifecycle.compact_slabs_once()
                     # geo-replication ship cycle: leader-gated +
                     # term-fenced internally; no-rule clusters scan
                     # nothing (same wall-time gating rationale as the

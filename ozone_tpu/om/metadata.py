@@ -46,6 +46,10 @@ _TABLES = (
     # process-level markers (e.g. the raft applied-index floor) that must
     # flush atomically with the data they describe
     "system",
+    # small-object slabs (Haystack/f4 needle volumes): one row per sealed
+    # slab — its EC block groups plus the needle directory, keyed
+    # /volume/bucket/slab_id so a slab rides its bucket's shard slot
+    "slabs",
 )
 
 #: tables with a maintained rolling state digest (the replica-divergence
@@ -454,3 +458,10 @@ def bucket_key(volume: str, bucket: str) -> str:
 
 def key_key(volume: str, bucket: str, key: str) -> str:
     return f"/{volume}/{bucket}/{key}"
+
+
+def slab_key(volume: str, bucket: str, slab_id: str) -> str:
+    """Slabs are bucket-scoped rows: the whole needle directory of a
+    slab lives on the shard that owns its bucket's slot, so a batched
+    multi-key commit touches exactly one shard ring."""
+    return f"/{volume}/{bucket}/{slab_id}"

@@ -28,6 +28,7 @@ from ozone_tpu.om.metadata import (
     OMMetadataStore,
     bucket_key,
     key_key,
+    slab_key,
     volume_key,
 )
 from ozone_tpu.om.sharding import shardmap as _shardmap
@@ -536,6 +537,20 @@ class OzoneManager:
         self.check_access(volume, bucket, None, "CREATE")
         binfo = self.bucket_info(volume, bucket)
         repl = replication or binfo["replication"]
+        if replication:
+            # per-key override audit (same fail-fast as create_bucket /
+            # set_bucket_replication): a bad scheme string must refuse
+            # the PUT with a typed error BEFORE the open lands a ring
+            # entry — not explode in the session constructor and leave
+            # an orphaned open_keys row behind
+            try:
+                ReplicationConfig.parse(replication)
+            except rq.OMError:
+                raise
+            except Exception as e:
+                raise rq.OMError(
+                    rq.INVALID_REQUEST,
+                    f"bad per-key replication {replication!r}: {e}")
         client_id = uuid.uuid4().hex[:16]
         enc = self._mint_encryption(binfo)
         if self._is_fso(binfo):
@@ -849,7 +864,28 @@ class OzoneManager:
         if info is None:
             raise rq.OMError(rq.KEY_NOT_FOUND, f"{volume}/{bucket}/{key}")
         self.metrics.counter("key_lookups").inc()
+        info = self._join_needle(volume, bucket, info)
         return self.mint_read_tokens(info)
+
+    def _join_needle(self, volume: str, bucket: str, info: dict) -> dict:
+        """Attach the slab's block groups to a needle key's lookup
+        result: needle rows store only (slab, offset, length, crc) —
+        the tiny-object metadata economy — and the one extra store get
+        here is what buys it. The read path then slices the needle out
+        of the slab with ordinary ranged group reads."""
+        nd = info.get("needle")
+        if not nd:
+            return info
+        srow = self.store.get(
+            "slabs", slab_key(volume, bucket, nd["slab"]))
+        if srow is None:
+            raise rq.OMError(
+                "SLAB_NOT_FOUND",
+                f"slab {nd['slab']} missing for "
+                f"{volume}/{bucket}/{info.get('name')}")
+        info = dict(info)
+        info["block_groups"] = srow["block_groups"]
+        return info
 
     def key_block_groups(self, info: dict) -> list[BlockGroup]:
         """Materialize BlockGroup objects (with pipelines) from key info."""
@@ -1082,6 +1118,118 @@ class OzoneManager:
         return self.submit(
             rq.SetBucketReplication(volume, bucket, replication))
 
+    # ----------------------------------------------------- small objects
+    def set_bucket_smallobj(self, volume: str, bucket: str,
+                            enabled: bool = True, inline_max: int = 0,
+                            needle_max: int = 0) -> dict:
+        """Opt a bucket into (or out of) the small-object path.
+        Eligibility (flat layout, no encryption) is validated in the
+        replicated apply — config time, the parse-time analog — so an
+        ineligible combination fails with a typed error up front."""
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "WRITE")
+        return self.submit(rq.SetBucketSmallObj(
+            volume, bucket, enabled=enabled,
+            inline_max=int(inline_max), needle_max=int(needle_max)))
+
+    def smallobj_conf(self, binfo: dict) -> Optional[dict]:
+        """Effective inline/needle thresholds for a bucket, or None when
+        the bucket never opted in. Stored zeros defer to the env knobs
+        (OZONE_TPU_INLINE_MAX / OZONE_TPU_NEEDLE_MAX) at read time, so
+        an operator can retune a fleet without touching bucket rows."""
+        so = binfo.get("smallobj")
+        if not so:
+            return None
+        from ozone_tpu.utils.config import env_int
+
+        inline_max = int(so.get("inline_max", 0)) or env_int(
+            "OZONE_TPU_INLINE_MAX", 4096)
+        needle_max = int(so.get("needle_max", 0)) or env_int(
+            "OZONE_TPU_NEEDLE_MAX", 256 * 1024)
+        return {"inline_max": inline_max,
+                "needle_max": max(needle_max, inline_max)}
+
+    def put_inline_key(self, volume: str, bucket: str, key: str,
+                       data: bytes, metadata: Optional[dict] = None
+                       ) -> dict:
+        """Tiny-object PUT in ONE ring entry (no open session, no
+        blocks): the value rides the replicated key row. Size is gated
+        against the bucket's inline threshold here, on the leader, so a
+        raft entry can never be bloated past the configured bound."""
+        import base64
+
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "CREATE")
+        binfo = self.bucket_info(volume, bucket)
+        conf = self.smallobj_conf(binfo)
+        if conf is None:
+            raise rq.OMError(
+                rq.SMALLOBJ_NOT_SUPPORTED,
+                f"{volume}/{bucket} has no small-object config")
+        raw = bytes(data)
+        if len(raw) > conf["inline_max"]:
+            raise rq.OMError(
+                rq.INVALID_REQUEST,
+                f"{len(raw)} bytes exceeds inline_max "
+                f"{conf['inline_max']}")
+        info = self.submit(rq.PutInlineKey(
+            volume, bucket, key, base64.b64encode(raw).decode("ascii"),
+            len(raw), metadata or {}))
+        from ozone_tpu.client.slab import METRICS as SMALLOBJ
+
+        SMALLOBJ.counter("inline_puts").inc()
+        SMALLOBJ.counter("inline_bytes").inc(len(raw))
+        return info
+
+    def commit_keys(self, volume: str, bucket: str, slab: dict,
+                    entries: list[dict]) -> dict:
+        """Batched needle commit: N keys + the sealed slab directory in
+        ONE ring entry (the raft-amortization half of the tiny-object
+        fast path; the packer flush and freon mass ingestion both land
+        here). Per-entry rewrite fences are honored individually —
+        see rq.CommitKeys."""
+        volume, bucket = self.resolve_bucket(volume, bucket)
+        self.check_access(volume, bucket, None, "CREATE")
+        out = self.submit(rq.CommitKeys(volume, bucket, slab=slab,
+                                        entries=list(entries)))
+        from ozone_tpu.client.slab import METRICS as SMALLOBJ
+
+        SMALLOBJ.counter("commit_batches").inc()
+        SMALLOBJ.counter("needles_committed").inc(
+            len(out.get("committed", ())))
+        return out
+
+    def allocate_slab_group(self, replication: str,
+                            excluded: Optional[list[str]] = None,
+                            excluded_containers: Optional[list[int]]
+                            = None) -> BlockGroup:
+        """SCM allocation for a slab block (no open-key session: slabs
+        are not keys). Same token grant as allocate_block."""
+        return self.grant_write_tokens(self.scm.allocate_block(
+            ReplicationConfig.parse(replication), self.block_size,
+            excluded, excluded_containers))
+
+    def slab_info(self, volume: str, bucket: str, slab_id: str) -> dict:
+        row = self.store.get("slabs", slab_key(volume, bucket, slab_id))
+        if row is None:
+            raise rq.OMError(rq.KEY_NOT_FOUND, f"slab {slab_id}")
+        return row
+
+    def list_slabs(self, volume: str, bucket: str) -> list[dict]:
+        return [v for _, v in self.store.iterate(
+            "slabs", bucket_key(volume, bucket) + "/")]
+
+    def run_slab_compaction_once(self, max_slabs: Optional[int] = None
+                                 ) -> dict:
+        """Trigger one needle-compaction sweep (dead-ratio scan +
+        survivor rewrite + old-slab release). Rides the lifecycle
+        service so the daemon deployment gets the same term fencing."""
+        if getattr(self, "lifecycle", None) is None:
+            from ozone_tpu.lifecycle.service import LifecycleService
+
+            self.lifecycle = LifecycleService(self, clients=self.clients)
+        return self.lifecycle.compact_slabs_once(max_slabs=max_slabs)
+
     def get_bucket_acl(self, volume: str, bucket: str) -> list[dict]:
         return self.bucket_info(volume, bucket).get("acl", [])
 
@@ -1199,6 +1347,17 @@ class OzoneManager:
         legacy = self._is_legacy(binfo)
         if legacy:
             key = rq.normalize_fs_path(key)
+        if replication:
+            # same per-key override audit as open_key: typed refusal
+            # before any ring entry, never mid-upload
+            try:
+                ReplicationConfig.parse(replication)
+            except rq.OMError:
+                raise
+            except Exception as e:
+                raise rq.OMError(
+                    rq.INVALID_REQUEST,
+                    f"bad per-key replication {replication!r}: {e}")
         return self.submit(
             mpu.InitiateMultipartUpload(
                 volume, bucket, key, replication=replication or "",
@@ -1372,6 +1531,7 @@ class OzoneManager:
 
         purged: list[str] = []
         txs: list[tuple] = []
+        dead_needles: dict[tuple, list[int]] = {}
         for dk, info in entries:
             # defer-delete for snapshotted buckets: block data may still be
             # referenced by a snapshot (reference: snapshot deferred
@@ -1383,6 +1543,17 @@ class OzoneManager:
                 None,
             ):
                 continue
+            nd = info.get("needle")
+            if nd:
+                # a needle's blocks are the SHARED slab's — never handed
+                # to SCM here; its death is accounted on the slab row so
+                # the compaction sweep can see the dead ratio grow
+                acc = dead_needles.setdefault(
+                    (vol, bkt, nd["slab"]), [0, 0])
+                acc[0] += 1
+                acc[1] += int(nd.get("length", info.get("size", 0)))
+                purged.append(dk)
+                continue
             for g in info.get("block_groups", []):
                 txs.append(
                     (BlockID(g["container_id"], g["local_id"]),
@@ -1391,6 +1562,9 @@ class OzoneManager:
             purged.append(dk)
         if txs:
             self.scm.delete_blocks(txs)
+        for (vol, bkt, sid), (count, nbytes) in dead_needles.items():
+            self.submit(rq.AccountDeadNeedles(vol, bkt, sid,
+                                              count, nbytes))
         self.submit(rq.PurgeDeletedKeys(purged))
         return len(purged)
 

@@ -24,6 +24,7 @@ from ozone_tpu.om.metadata import (
     OMMetadataStore,
     bucket_key,
     key_key,
+    slab_key,
     volume_key,
 )
 
@@ -311,7 +312,7 @@ def finalize_commit(store, table: str, ek: str, info: dict, old,
         store.delete("open_keys", f"{ek}/{client_id}")
     if (
         old is not None
-        and old.get("block_groups")
+        and (old.get("block_groups") or old.get("needle"))
         and old.get("hsync_client_id") != client_id
     ):
         stale_writer = old.get("hsync_client_id")
@@ -420,6 +421,310 @@ def check_rewrite_fence(store, expect_object_id: str, old, open_k: str,
     store.put("deleted_keys", f"{row_key}:{modified}", info)
     raise OMError(KEY_MODIFIED,
                   f"{row_key} changed during rewrite; new data discarded")
+
+
+# ----------------------------------------------------- small objects
+
+SMALLOBJ_NOT_SUPPORTED = "SMALLOBJ_NOT_SUPPORTED"
+
+
+def check_smallobj_bucket(b: dict) -> None:
+    """Small-object eligibility gate, shared by the config verb and the
+    replicated applies: inline values and needle-in-slab packing are an
+    OBS/LEGACY flat-table feature. FSO buckets keep their namespace in
+    the parent-id-keyed file tree (a needle commit bypassing OpenFile
+    would skip parent materialization), and encrypted/GDPR buckets need
+    a per-key DEK minted at open — neither fits a batched commit that
+    never opens a session. Refused with a TYPED error at the
+    deterministic boundary (config time / PUT time), never mid-flush."""
+    if b.get("layout") == "FILE_SYSTEM_OPTIMIZED":
+        raise OMError(
+            SMALLOBJ_NOT_SUPPORTED,
+            f"/{b.get('volume')}/{b.get('name')} is FILE_SYSTEM_OPTIMIZED"
+            " — inline/needle packing needs a flat key table")
+    if b.get("encryption_key") or b.get("gdpr"):
+        raise OMError(
+            SMALLOBJ_NOT_SUPPORTED,
+            f"/{b.get('volume')}/{b.get('name')} is encrypted — small-"
+            "object commits mint no per-key DEK")
+    if b.get("source"):
+        raise OMError(
+            SMALLOBJ_NOT_SUPPORTED,
+            "configure small objects on the link SOURCE bucket")
+
+
+@dataclass
+class SetBucketSmallObj(OMRequest):
+    """Opt a bucket into the small-object path (the f4 'warm volume'
+    designation): keys at or under `inline_max` bytes are stored inline
+    in OM metadata, keys at or under `needle_max` ride the slab packer.
+    Eligibility is validated here — config time — so an ineligible
+    combination (FSO + packing) fails deterministically up front."""
+
+    volume: str
+    bucket: str
+    enabled: bool = True
+    inline_max: int = 0
+    needle_max: int = 0
+
+    def apply(self, store):
+        k = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", k)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, k)
+        if not self.enabled:
+            b.pop("smallobj", None)
+        else:
+            check_smallobj_bucket(b)
+            # zeros defer to the env-knob defaults at read time
+            if self.inline_max and self.needle_max and \
+                    self.inline_max > self.needle_max:
+                raise OMError(
+                    INVALID_REQUEST,
+                    f"inline_max {self.inline_max} > needle_max "
+                    f"{self.needle_max}")
+            b["smallobj"] = {"inline_max": int(self.inline_max),
+                             "needle_max": int(self.needle_max)}
+        store.put("buckets", k, b)
+        return b
+
+
+@dataclass
+class PutInlineKey(OMRequest):
+    """Tiny-object PUT as ONE ring entry: open + data + commit fused,
+    the value riding the key row itself (base64). Zero datapath hops,
+    zero blocks — a GET is served straight from OM metadata, including
+    lease-gated follower reads. The Haystack insight at its limit: when
+    the value is smaller than the per-key fixed costs, the metadata
+    write IS the data write."""
+
+    volume: str
+    bucket: str
+    key: str
+    data: str = ""  # base64; bounded by the bucket's inline_max
+    size: int = 0
+    metadata: dict = field(default_factory=dict)
+    modified: float = 0.0
+    key_id: str = ""
+    #: rewrite fence, same contract as CommitKey (compaction/rewrite
+    #: callers): "" = plain overwrite semantics
+    expect_object_id: str = ""
+    expect_generation: int = -1
+
+    def pre_execute(self, om) -> None:
+        import uuid
+
+        self.modified = time.time()
+        self.key_id = uuid.uuid4().hex[:16]
+
+    def apply(self, store):
+        b = store.get("buckets", bucket_key(self.volume, self.bucket))
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND,
+                          f"{self.volume}/{self.bucket}")
+        check_smallobj_bucket(b)  # replica-deterministic: bucket row
+        kk = key_key(self.volume, self.bucket, self.key)
+        old = store.get("keys", kk)
+        if self.expect_object_id and not (
+                old is not None
+                and old.get("object_id") == self.expect_object_id
+                and (self.expect_generation < 0
+                     or int(old.get("generation", 0))
+                     == self.expect_generation)):
+            raise OMError(KEY_MODIFIED,
+                          f"{kk} changed during inline rewrite")
+        from ozone_tpu.om.acl import inherit_defaults
+
+        info = {
+            "volume": self.volume,
+            "bucket": self.bucket,
+            "name": self.key,
+            "object_id": self.key_id,
+            "replication": "inline",
+            "checksum_type": "CRC32C",
+            "size": int(self.size),
+            "block_groups": [],
+            "inline": self.data,
+            "created": self.modified,
+            "modified": self.modified,
+            "acls": inherit_defaults(b.get("acls", [])),
+        }
+        if self.metadata:
+            info["metadata"] = dict(self.metadata)
+        finalize_commit(store, "keys", kk, info, old, "", False,
+                        self.modified)
+        return info
+
+
+@dataclass
+class CommitKeys(OMRequest):
+    """Batched multi-key needle commit: N tiny keys land in ONE ring
+    entry, each recorded as a needle (slab_id, offset, length, crc)
+    into a freshly sealed slab whose EC block groups ride the same
+    apply. Per-key rewrite fencing is preserved — a fenced entry whose
+    live row moved is SKIPPED (its needle bytes turn dead in this slab
+    immediately) rather than aborting the batch. The batch itself is
+    all-or-nothing: every precondition (bucket, slab uniqueness,
+    aggregate quota) is validated before the first mutation, because
+    the store's atomic() defers flushes but does not roll back."""
+
+    volume: str
+    bucket: str
+    slab: dict = field(default_factory=dict)
+    entries: list = field(default_factory=list)
+    modified: float = 0.0
+    key_ids: list = field(default_factory=list)
+
+    def pre_execute(self, om) -> None:
+        import uuid
+
+        self.modified = time.time()
+        self.key_ids = [uuid.uuid4().hex[:16] for _ in self.entries]
+
+    def apply(self, store):  # noqa: C901 - one validate+mutate pass
+        bk = bucket_key(self.volume, self.bucket)
+        b = store.get("buckets", bk)
+        if b is None:
+            raise OMError(BUCKET_NOT_FOUND, bk)
+        check_smallobj_bucket(b)
+        sid = self.slab.get("slab_id", "")
+        if not sid or not self.slab.get("block_groups"):
+            raise OMError(INVALID_REQUEST, "slab id/groups missing")
+        sk = slab_key(self.volume, self.bucket, sid)
+        if store.exists("slabs", sk):
+            raise OMError(INVALID_REQUEST,
+                          f"slab {sid} already sealed")
+        # -- validation pass: fences + aggregate quota, NO mutation --
+        last: dict = {}  # key -> entry index (duplicate puts: last wins)
+        for i, e in enumerate(self.entries):
+            last[e["key"]] = i
+        live, skipped = [], []
+        dead_bytes, bytes_delta, keys_delta = 0, 0, 0
+        for i, e in enumerate(self.entries):
+            key = e["key"]
+            if last[key] != i:
+                skipped.append(key)  # superseded within the batch
+                dead_bytes += int(e["length"])
+                continue
+            old = store.get("keys",
+                            key_key(self.volume, self.bucket, key))
+            fence = e.get("expect_object_id", "")
+            gen = int(e.get("expect_generation", -1))
+            if fence and not (
+                    old is not None
+                    and old.get("object_id") == fence
+                    and (gen < 0
+                         or int(old.get("generation", 0)) == gen)):
+                skipped.append(key)  # fenced out: needle bytes go dead
+                dead_bytes += int(e["length"])
+                continue
+            bytes_delta += int(e["length"]) - (
+                int(old.get("size", 0)) if old is not None else 0)
+            keys_delta += 0 if old is not None else 1
+            live.append((i, e, old))
+        check_and_charge_quota(store, self.volume, self.bucket,
+                               bytes_delta, keys_delta)
+        # -- mutation pass: cannot fail past this point ---------------
+        from ozone_tpu.om.acl import inherit_defaults
+
+        default_acls = inherit_defaults(b.get("acls", []))
+        needles: dict = {}
+        committed = []
+        for i, e, old in live:
+            key = e["key"]
+            kk = key_key(self.volume, self.bucket, key)
+            info = {
+                "volume": self.volume,
+                "bucket": self.bucket,
+                "name": key,
+                "object_id": self.key_ids[i],
+                "replication": self.slab.get("replication", ""),
+                "checksum_type": "CRC32C",
+                "size": int(e["length"]),
+                "block_groups": [],
+                "needle": {"slab": sid, "offset": int(e["offset"]),
+                           "length": int(e["length"]),
+                           "crc": int(e["crc"])},
+                "created": self.modified,
+                "modified": self.modified,
+                "acls": e.get("acls") or default_acls,
+            }
+            if e.get("metadata"):
+                info["metadata"] = dict(e["metadata"])
+            preserve_preimage(store, self.volume, self.bucket, kk)
+            info["generation"] = (int(old.get("generation", 0)) + 1
+                                  if old is not None else 1)
+            if old is not None and (old.get("block_groups")
+                                    or old.get("needle")):
+                stale_writer = old.get("hsync_client_id")
+                if stale_writer:
+                    store.delete("open_keys", f"{kk}/{stale_writer}")
+                erase_gdpr_secret(old)
+                store.put("deleted_keys", f"{kk}:{self.modified}", old)
+            store.put("keys", kk, info)
+            needles[key] = {"off": int(e["offset"]),
+                            "len": int(e["length"]),
+                            "oid": self.key_ids[i]}
+            committed.append(key)
+        store.put("slabs", sk, {
+            "slab_id": sid,
+            "volume": self.volume,
+            "bucket": self.bucket,
+            "replication": self.slab.get("replication", ""),
+            "length": int(self.slab.get("length", 0)),
+            "block_groups": list(self.slab.get("block_groups", [])),
+            "needles": needles,
+            "dead_bytes": dead_bytes,
+            "dead_count": len(skipped),
+            "created": self.modified,
+        })
+        return {"slab_id": sid, "committed": committed,
+                "skipped": skipped}
+
+
+@dataclass
+class AccountDeadNeedles(OMRequest):
+    """Dead-needle bookkeeping: a purged key version that lived as a
+    needle hands its bytes back to its slab's dead counters (the purge
+    chain must NOT hand the shared slab blocks to SCM — other needles
+    still live there). Idempotent against a retired slab: accounting
+    against a missing row is a no-op."""
+
+    volume: str
+    bucket: str
+    slab_id: str
+    count: int = 0
+    nbytes: int = 0
+
+    def apply(self, store):
+        sk = slab_key(self.volume, self.bucket, self.slab_id)
+        row = store.get("slabs", sk)
+        if row is None:
+            return None  # slab already compacted away
+        row["dead_count"] = int(row.get("dead_count", 0)) + self.count
+        row["dead_bytes"] = int(row.get("dead_bytes", 0)) + self.nbytes
+        store.put("slabs", sk, row)
+        return row
+
+
+@dataclass
+class RetireSlab(OMRequest):
+    """Drop a fully-compacted slab's directory row. The caller releases
+    the slab's blocks to scm/block_deletion AFTER this commit acks —
+    blocks outliving metadata is safe (the scrubber reaps), metadata
+    outliving blocks is data loss."""
+
+    volume: str
+    bucket: str
+    slab_id: str
+
+    def apply(self, store):
+        sk = slab_key(self.volume, self.bucket, self.slab_id)
+        row = store.get("slabs", sk)
+        if row is None:
+            raise OMError(KEY_NOT_FOUND, f"slab {sk}")
+        store.delete("slabs", sk)
+        return row
 
 
 def snap_prefix(volume: str, bucket: str, snap_id: str) -> str:

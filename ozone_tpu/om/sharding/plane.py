@@ -58,7 +58,8 @@ METRICS = registry("om.shard")
 #: tables are prefix-scanned per bucket; FSO tables ride the same
 #: bucket_key prefix scheme)
 _MIGRATE_TABLES = ("buckets", "keys", "open_keys", "deleted_keys",
-                   "multipart", "dirs", "files", "deleted_dirs")
+                   "multipart", "dirs", "files", "deleted_dirs",
+                   "slabs")
 
 
 def _meta_scm() -> StorageContainerManager:
@@ -455,6 +456,44 @@ class ShardedOm:
         rv, rb = self.resolve_bucket(volume, bucket)
         return self._routed("LookupKey", rv, rb,
                             lambda om: om.lookup_key(rv, rb, key))
+
+    # small-object verbs: slabs are bucket-scoped rows, so a batched
+    # CommitKeys — N needles + the slab directory — lands on exactly
+    # ONE shard ring as one entry (the whole point of the batching)
+    def set_bucket_smallobj(self, volume: str, bucket: str, *a, **kw):
+        rv, rb = self.resolve_bucket(volume, bucket)
+        return self._routed(
+            "SetBucketSmallObj", rv, rb,
+            lambda om: om.set_bucket_smallobj(rv, rb, *a, **kw),
+            write=True)
+
+    def put_inline_key(self, volume: str, bucket: str, key: str,
+                       data, **kw):
+        rv, rb = self.resolve_bucket(volume, bucket)
+        return self._routed(
+            "PutInlineKey", rv, rb,
+            lambda om: om.put_inline_key(rv, rb, key, data, **kw),
+            write=True)
+
+    def commit_keys(self, volume: str, bucket: str, slab: dict,
+                    entries: list):
+        rv, rb = self.resolve_bucket(volume, bucket)
+        return self._routed(
+            "CommitKeys", rv, rb,
+            lambda om: om.commit_keys(rv, rb, slab, entries),
+            write=True)
+
+    def slab_info(self, volume: str, bucket: str, slab_id: str) -> dict:
+        rv, rb = self.resolve_bucket(volume, bucket)
+        return self._routed(
+            "SlabInfo", rv, rb,
+            lambda om: om.slab_info(rv, rb, slab_id))
+
+    def list_slabs(self, volume: str, bucket: str) -> list:
+        rv, rb = self.resolve_bucket(volume, bucket)
+        return self._routed(
+            "ListSlabs", rv, rb,
+            lambda om: om.list_slabs(rv, rb))
 
     def list_keys(self, volume: str, bucket: str, *a, **kw):
         rv, rb = self.resolve_bucket(volume, bucket)

@@ -745,6 +745,10 @@ class ReconServer:
                     # admission-control panel: per-hop controller
                     # knobs/in-flight plus every rejection counter
                     "/api/admission": recon.admission_view,
+                    # small-object fast path: inline/needle counters,
+                    # live slab census (count, dead-byte ratio) and
+                    # threshold knob echo
+                    "/api/smallobj": recon.smallobj_view,
                     # sharded metadata plane: this OM's shard config,
                     # the root shard map (when this OM hosts it), and
                     # the routing / 2PC / follower-read counters
@@ -867,6 +871,45 @@ class ReconServer:
             "enabled": any(s["enabled"] for s in hops.values()),
             "hops": hops,
             "counters": registry("admission").snapshot(),
+        }
+
+    def smallobj_view(self) -> dict:
+        """Small-object fast-path snapshot for the dashboard panel: the
+        ``smallobj`` counter family (inline hits, needles packed, slabs
+        flushed, compaction bytes), a live slab census aggregated from
+        the OM's slab rows (count, live/dead bytes, worst dead ratio —
+        the compaction sweeper's backlog signal) and the threshold/knob
+        echo. PEEKS at store rows and the shared registry only."""
+        from ozone_tpu.utils.config import env_float, env_int
+        from ozone_tpu.utils.metrics import registry
+
+        store = self.tasks.om.store
+        slabs = live = dead = 0
+        worst = 0.0
+        for _, srow in store.iterate("slabs"):
+            slabs += 1
+            n = int(srow.get("length", 0))
+            d = int(srow.get("dead_bytes", 0))
+            live += n - d
+            dead += d
+            if n:
+                worst = max(worst, d / n)
+        return {
+            "counters": registry("smallobj").snapshot(),
+            "slabs": {"count": slabs, "live_bytes": live,
+                      "dead_bytes": dead,
+                      "worst_dead_ratio": round(worst, 3)},
+            "knobs": {
+                "inline_max": env_int("OZONE_TPU_INLINE_MAX", 4096),
+                "needle_max": env_int("OZONE_TPU_NEEDLE_MAX",
+                                      256 * 1024),
+                "slab_target_mib": env_float(
+                    "OZONE_TPU_SLAB_TARGET_MIB", 4.0),
+                "slab_linger_ms": env_float(
+                    "OZONE_TPU_SLAB_LINGER_MS", 8.0),
+                "dead_ratio": env_float(
+                    "OZONE_TPU_SLAB_DEAD_RATIO", 0.5),
+            },
         }
 
     def shard_view(self) -> dict:

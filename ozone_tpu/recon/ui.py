@@ -233,6 +233,13 @@ RECON_INDEX_HTML = """<!doctype html>
     collapse)</div>
   <div class="tiles" id="admission-tiles"></div>
 
+  <h2>Small objects</h2>
+  <div class="sub">tiny-object fast path: values inlined in OM
+    metadata, needles packed into shared EC slabs, batched multi-key
+    commits &mdash; slab census with dead-byte ratio (the compaction
+    sweeper's backlog signal)</div>
+  <div class="tiles" id="smallobj-tiles"></div>
+
   <h2>Shard map</h2>
   <div class="sub">sharded metadata plane: hash-partitioned OM rings
     behind an epoch-numbered root shard map &mdash; routing volume,
@@ -486,6 +493,25 @@ async function refresh() {
         .map(([k, v]) => tile(k.replace(/_/g, " "), v)),
       tile("tenants seen",
            hops.reduce((n, h) => n + (h.tenants?.length ?? 0), 0)),
+    ].join("");
+    const so = await (await fetch("/api/smallobj")).json();
+    const soc = so.counters || {};
+    const sos = so.slabs || {};
+    document.getElementById("smallobj-tiles").innerHTML = [
+      tile("inline puts", soc.inline_puts ?? 0),
+      tile("inline gets", soc.inline_gets ?? 0),
+      tile("needles packed", soc.needles_packed ?? 0),
+      tile("needle gets", soc.needle_gets ?? 0),
+      tile("slabs flushed", soc.slabs_flushed ?? 0),
+      tile("commit batches", soc.commit_batches ?? 0),
+      tile("live slabs", sos.count ?? 0),
+      tile("dead bytes", sos.dead_bytes ?? 0),
+      tile("worst dead ratio",
+           `${Math.round((sos.worst_dead_ratio ?? 0) * 100)}%`),
+      tile("compacted slabs", soc.compaction_slabs ?? 0),
+      tile("compaction bytes", soc.compaction_bytes ?? 0),
+      tile("inline max", so.knobs?.inline_max ?? 0),
+      tile("needle max", so.knobs?.needle_max ?? 0),
     ].join("");
     const sh = await (await fetch("/api/shards")).json();
     const sc = sh.counters || {};

@@ -129,7 +129,7 @@ def _quota_arg(v: str):
 _SH_VERBS = {
     "volume": {"create", "delete", "info", "list", "setquota", "update"},
     "bucket": {"create", "delete", "info", "list", "setquota", "link",
-               "set-replication"},
+               "set-replication", "set-smallobj"},
     "key": {"put", "get", "delete", "info", "list", "rename", "checksum",
             "cat", "cp", "rewrite"},
     "snapshot": {"create", "list", "info", "delete", "diff", "rename"},
@@ -253,6 +253,8 @@ def cmd_sh(args) -> int:
                 _emit(oz.om.set_quota(
                     vol, bucket, quota_bytes=_quota_arg(args.quota),
                     quota_namespace=args.namespace_quota))
+            elif verb == "set-smallobj":
+                _emit(oz.om.set_bucket_smallobj(vol, bucket))
             elif verb == "link":
                 if not args.to:
                     print("error: bucket link requires --to "
@@ -808,7 +810,15 @@ def cmd_freon(args) -> int:
                    for i in range(max(1, args.threads))]
         _emit(freon.swarm(
             args.endpoint, tenants, duration_s=args.duration,
-            n_keys=args.num,
+            n_keys=args.num, tiny=args.tiny,
+        ).summary())
+    elif args.generator == "tinyg":
+        oz = _client(args)
+        _emit(freon.tinyg(
+            oz, n_keys=args.num, size=args.size, threads=args.threads,
+            replication=args.replication or "rs-3-2-4096",
+            packer=not args.no_packer, mix=args.tiny,
+            validate=args.validate,
         ).summary())
     elif args.generator == "lcg":
         oz = _client(args)
@@ -1324,9 +1334,11 @@ def cmd_lifecycle(args) -> int:
 
     om = GrpcOmClient(args.om, tls=_client_tls())
     verb = args.verb
-    if verb in ("run-now", "status"):
+    if verb in ("run-now", "status", "compact-slabs"):
         if verb == "run-now":
             _emit(om.run_lifecycle_once(args.max_keys))
+        elif verb == "compact-slabs":
+            _emit(om.run_slab_compaction_once())
         else:
             _emit(om.lifecycle_status())
         return 0
@@ -1514,7 +1526,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "get", "rename", "checksum", "setquota",
                              "diff", "link", "renew", "cancel", "print",
                              "cat", "cp", "rewrite",
-                             "set-replication", "update"])
+                             "set-replication", "set-smallobj",
+                             "update"])
     sh.add_argument("path", nargs="?", default="",
                     help="/volume[/bucket[/key]] (token verbs take none)")
     sh.add_argument("file", nargs="?", help="local file for key put/get")
@@ -1632,7 +1645,7 @@ def build_parser() -> argparse.ArgumentParser:
                         help="bucket lifecycle: age-based tiering "
                              "(replicated->EC) + TTL expiry")
     lc.add_argument("verb", choices=["set", "get", "clear", "run-now",
-                                     "status"])
+                                     "status", "compact-slabs"])
     lc.add_argument("path", nargs="?", default="",
                     help="/volume/bucket (set/get/clear)")
     lc.add_argument("--om", default="127.0.0.1:9860")
@@ -1692,7 +1705,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "ommg", "scmtb", "cmdw", "dbgen", "dcg",
                              "dcb", "dcv", "dsg", "hsg", "dnbp", "ralg",
                              "fskg", "mpug", "s3kg", "fsg", "sdg",
-                             "dnsim", "lcg", "geo", "swarm"])
+                             "dnsim", "lcg", "geo", "swarm", "tinyg"])
     fr.add_argument("-n", "--num", type=int, default=100)
     fr.add_argument("-s", "--size", type=int, default=10240)
     fr.add_argument("--keys", type=int, default=1,
@@ -1713,6 +1726,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="ommg op mix (c/r/u/d/l per char)")
     fr.add_argument("--target", default="rs-3-2-4096",
                     help="lcg: EC scheme the lifecycle rule tiers to")
+    fr.add_argument("--no-packer", action="store_true",
+                    help="tinyg: force the classic per-key stripe path "
+                         "(the small-object before/after baseline)")
+    fr.add_argument("--tiny", action="store_true",
+                    help="tinyg/swarm: draw sizes from the tiny-key "
+                         "mix instead of a fixed --size")
     fr.add_argument("--dest", default="",
                     help="geo: destination cluster OM endpoint")
     fr.add_argument("--scheme", default="",

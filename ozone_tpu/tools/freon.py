@@ -286,6 +286,100 @@ def lcg(client, n_keys: int = 20, size: int = 10 * 1024,
     return rep
 
 
+#: the tiny-key size mix: 80/15/5 inline / needle / needle-ish — the
+#: metadata-bound object population the small-object path exists for
+TINY_SIZES = (512, 4 * 1024, 48 * 1024)
+
+
+def _tiny_size(i: int, size: int, mix: bool) -> int:
+    if not mix:
+        return size
+    r = i % 20
+    if r < 16:
+        return TINY_SIZES[0]
+    if r < 19:
+        return TINY_SIZES[1]
+    return TINY_SIZES[2]
+
+
+def tinyg(client, n_keys: int = 200, size: int = 4 * 1024,
+          threads: int = 8, volume: str = "freon-vol",
+          bucket: str = "freon-tiny",
+          replication: str = "rs-3-2-4096", prefix: str = "tiny",
+          packer: bool = True, mix: bool = False,
+          validate: bool = True) -> FreonReport:
+    """Tiny-key generator (freon tinyg): the small-object-path
+    workload. Writes `n_keys` tiny keys into a smallobj-enabled EC
+    bucket so PUTs route through the inline/needle fast path — inline
+    values live in OM metadata, needles coalesce through the client
+    SlabPacker into shared EC stripes committed via CommitKeys.
+
+    `packer=False` keeps the same key population but passes an explicit
+    per-key replication, forcing every key down the classic
+    open/allocate/commit stripe path — the before/after pair the bench
+    compares. `mix=True` draws sizes from TINY_SIZES (mostly inline,
+    some needles) instead of the fixed `size`; the swarm overload
+    workload reuses the same mix via its `tiny` flag.
+
+    Extras report how the population landed (inline/needle/regular key
+    counts, distinct slabs) plus byte-exact `verify_failures`."""
+    try:
+        client.om.create_volume(volume)
+    except Exception:
+        pass
+    try:
+        client.om.create_bucket(volume, bucket, replication)
+    except Exception:
+        pass
+    if packer:
+        client.om.set_bucket_smallobj(volume, bucket)
+    b = client.get_volume(volume).get_bucket(bucket)
+    # packer off => explicit replication pins the per-key stripe path
+    # (write_key only consults the smallobj config when the caller
+    # leaves replication unset)
+    per_key_repl = None if packer else replication
+
+    def op(i: int) -> int:
+        sz = _tiny_size(i, size, mix)
+        b.write_key(f"{prefix}-{i}", _det_payload(sz, seed=i),
+                    per_key_repl)
+        return sz
+
+    rep = BaseFreonGenerator("tinyg", n_keys, threads).run(op)
+    if packer:
+        client.packer.flush()
+    inline = needle = regular = verify_failures = 0
+    slabs: set = set()
+    for i in range(n_keys):
+        try:
+            info = client.om.lookup_key(volume, bucket,
+                                        f"{prefix}-{i}")
+            if info.get("inline") is not None:
+                inline += 1
+            elif info.get("needle"):
+                needle += 1
+                slabs.add(info["needle"]["slab"])
+            else:
+                regular += 1
+            if validate:
+                got = b.read_key_info(info)
+                want = _det_payload(_tiny_size(i, size, mix), seed=i)
+                if not np.array_equal(got, want):
+                    verify_failures += 1
+        except Exception:
+            verify_failures += 1
+    rep.extras.update({
+        "packer": packer,
+        "inline_keys": inline,
+        "needle_keys": needle,
+        "regular_keys": regular,
+        "slabs": len(slabs),
+        "verify_failures": verify_failures,
+    })
+    rep.extras.update(_client_hist_extras())
+    return rep
+
+
 def geo(client, dest_endpoint: str, n_keys: int = 20,
         size: int = 10 * 1024, threads: int = 4,
         volume: str = "freon-vol", bucket: str = "freon-geo",
@@ -1170,7 +1264,8 @@ def ecrd(
 def swarm(endpoint: str, tenants: list, duration_s: float = 4.0,
           threads_per_tenant: int = 2, n_keys: int = 64,
           sizes: tuple = (4 * 1024, 64 * 1024), zipf_a: float = 1.2,
-          seed: int = 1234, bucket: str = "swarm") -> FreonReport:
+          seed: int = 1234, bucket: str = "swarm",
+          tiny: bool = False) -> FreonReport:
     """freon swarm: the standing multi-tenant overload workload.
 
     N simulated tenants drive the S3 gateway closed-loop through
@@ -1195,6 +1290,11 @@ def swarm(endpoint: str, tenants: list, duration_s: float = 4.0,
 
     from ozone_tpu.gateway.s3_auth import sign_request
 
+    if tiny:
+        # tiny-key churn mode: the tinyg size mix drives the swarm, so
+        # the overload drills exercise the inline/needle path too (the
+        # gateway-side bucket must be smallobj-enabled by the caller)
+        sizes = TINY_SIZES
     base = f"http://{endpoint}"
 
     def _amz_now() -> str:

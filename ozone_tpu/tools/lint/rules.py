@@ -786,11 +786,15 @@ class BoundedQueue(Rule):
         "a reasoned `# ozlint: allow[bounded-queue] -- why`.")
 
     DIRS = ("net", "om", "scm", "gateway", "codec")
+    #: client-side modules that batch work for server hops — the slab
+    #: packer's pending set is a server-feeding queue in client clothing
+    MODULES = (("client", "slab.py"),)
     #: queue-class constructors taking maxsize as kwarg or first arg
     QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue"}
 
     def check(self, src: SourceFile) -> Iterable[Finding]:
-        if not src.in_dirs(*self.DIRS):
+        if not src.in_dirs(*self.DIRS) and not any(
+                src.is_module(*m) for m in self.MODULES):
             return
         module_env = _ConstEnv()
         _collect_env(src.tree.body, module_env, recurse=False)

@@ -403,3 +403,67 @@ def test_admission_snapshot_shape():
         assert isinstance(s["tenants"], list) and s["tenants"]
         assert set(s["shed"]) == {"p99_ms", "codec_depth", "mesh_depth",
                                   "over_budget"}
+
+
+# -------------------------------------------------- small-object packer
+def test_packer_flush_charges_bulk_and_isolates_tenants(tmp_path):
+    """Satellite: slab-flush traffic is admission-visible. Packer PUTs
+    charge the OWNING tenant's gateway byte bucket at ``bulk`` QoS, so
+    (a) a mass-ingest tenant is shed at the gateway with the typed
+    SERVER_BUSY + Retry-After contract while a light tenant on the same
+    cluster keeps writing, and (b) the moment the SLO shedder crosses a
+    budget, packer traffic is dropped FIRST (bulk class) while an
+    interactive charge on the very same controller still admits."""
+    import numpy as np
+
+    c = MiniOzoneCluster(tmp_path, num_datanodes=5,
+                         stale_after_s=1000.0, dead_after_s=2000.0)
+    try:
+        oz = c.client()
+        for vol in ("floodco", "liveco"):
+            oz.create_volume(vol)
+            oz.get_volume(vol).create_bucket("b", replication=EC)
+            c.om.set_bucket_smallobj(vol, "b")
+        payload = np.random.default_rng(0).integers(
+            0, 256, 9_000, dtype=np.uint8)
+        m = registry("admission")
+
+        # (a) per-tenant byte buckets: 30 KB/s of gateway budget admits
+        # ~3 needles then refuses; the other tenant's bucket is full
+        with _admit_env(BYTES_GATEWAY="30000", BURST_S="1"):
+            rej0 = m.counter("gateway_tenant_rejections").value
+            flood = oz.get_volume("floodco").get_bucket("b")
+            shed = None
+            for i in range(16):
+                try:
+                    flood.write_key(f"f-{i}", payload)
+                except StorageError as e:
+                    shed = e
+                    break
+            assert shed is not None, "flood tenant was never shed"
+            assert shed.code == resilience.SERVER_BUSY
+            assert retry_after_hint(shed) > 0.0
+            assert m.counter("gateway_tenant_rejections").value > rej0
+            # isolation: the victim's OWN bucket is untouched by the
+            # flood tenant's exhaustion
+            live = oz.get_volume("liveco").get_bucket("b")
+            for i in range(3):
+                live.write_key(f"l-{i}", payload)
+            np.testing.assert_array_equal(live.read_key("l-0"), payload)
+
+        # (b) bulk-class shed: cross the codec backlog budget and the
+        # packer's charge (bulk) is refused while interactive admits
+        with _admit_env(SLO_CODEC_DEPTH_GATEWAY="2", BYTES_GATEWAY="0"):
+            registry("codec.service").gauge("queue_depth").set(10)
+            try:
+                with pytest.raises(StorageError) as ei:
+                    oz.get_volume("floodco").get_bucket("b").write_key(
+                        "bulk-shed", payload)
+                assert ei.value.code == resilience.SERVER_BUSY
+                # same controller, interactive priority: still admitted
+                admission.controller("gateway").charge(
+                    "liveco", 9_000, priority="interactive")
+            finally:
+                registry("codec.service").gauge("queue_depth").set(0)
+    finally:
+        c.close()

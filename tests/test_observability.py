@@ -186,6 +186,22 @@ def test_prometheus_text_golden_every_registry_renders():
                  "follower_read_hits", "follower_read_misses",
                  "lease_renewals", "slots_migrated"):
         SHARD.counter(name).inc(0)
+    # the small-object family (docs/OPERATIONS.md "Small-object
+    # path"): inline hits, needles packed, slabs flushed, fill pct,
+    # compaction accounting — the Recon smallobj panel keys on these
+    from ozone_tpu.client.slab import METRICS as SMALLOBJ
+
+    for name in ("inline_puts", "inline_bytes", "inline_gets",
+                 "needle_gets", "needles_packed", "needles_committed",
+                 "commit_batches", "slabs_flushed", "slab_bytes",
+                 "compaction_slabs", "compaction_bytes",
+                 "compaction_conflicts", "slabs_retired",
+                 "put_rejected_queue", "flush_failures",
+                 "needle_crc_errors"):
+        SMALLOBJ.counter(name).inc(0)
+    SMALLOBJ.gauge("queue_depth").set(0)
+    SMALLOBJ.gauge("slab_fill_pct").set(0.0)
+    SMALLOBJ.histogram("flush_seconds").observe(0.0)
     # the admission-control family (docs/OPERATIONS.md "Admission
     # control"): per-hop, per-reason rejection counters — the numbers
     # that separate healthy shed from collapse on the Recon panel —
@@ -278,7 +294,15 @@ def test_prometheus_text_golden_every_registry_renders():
                  "admission_om_admitted", "admission_om_rejected_total",
                  "admission_om_rejected_ops",
                  "admission_om_tenant_rejections",
-                 "client_resilience_server_busy"):
+                 "client_resilience_server_busy",
+                 "smallobj_inline_puts", "smallobj_inline_gets",
+                 "smallobj_needles_packed", "smallobj_needle_gets",
+                 "smallobj_needles_committed", "smallobj_commit_batches",
+                 "smallobj_slabs_flushed", "smallobj_slab_bytes",
+                 "smallobj_compaction_slabs", "smallobj_compaction_bytes",
+                 "smallobj_compaction_conflicts",
+                 "smallobj_slabs_retired", "smallobj_queue_depth",
+                 "smallobj_slab_fill_pct", "smallobj_flush_seconds"):
         stem = want.removesuffix("_seconds")
         assert any(s.startswith(stem) for s in seen_metrics), want
     assert "# TYPE client_resilience_deadline_exceeded counter" in text

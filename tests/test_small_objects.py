@@ -1,0 +1,403 @@
+"""Tiny-object fast path: inline values, needle-in-slab packing,
+batched CommitKeys, and needle compaction (ISSUE 20).
+
+Coverage map against the acceptance claims:
+
+- threshold routing: a smallobj bucket sends <= inline_max PUTs into
+  the key row itself (one ring entry, zero datapath hops),
+  <= needle_max PUTs through the slab packer, and everything larger
+  down the classic per-key stripe path — with byte-exact readback on
+  all three;
+- coalescing: concurrent tiny PUTs share slabs (and therefore EC
+  stripes + raft entries) instead of writing one stripe each;
+- crash drills: acked keys survive a packer "kill -9" (abandoned
+  in-process packer) byte-exact; a commit failure mid-flush leaves the
+  un-acked keys cleanly absent; a torn needle is refused by the
+  per-needle CRC gate rather than served;
+- CommitKeys semantics: aggregate quota is all-or-nothing, duplicate
+  keys in one batch are last-wins, per-entry rewrite fences skip (not
+  abort), and on a sharded plane the batch lands on the bucket's
+  owning shard;
+- follower reads serve inline GETs without touching a datanode;
+- compaction rewrites survivors byte-exact into a fresh slab and
+  releases the retired slab's blocks through the SCM deletion chain.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from ozone_tpu.om import requests as rq
+from ozone_tpu.om.metadata import key_key
+from ozone_tpu.testing.minicluster import MiniOzoneCluster
+
+EC = "rs-3-2-4096"
+
+
+def _payload(size: int, seed: int = 0) -> np.ndarray:
+    return np.random.default_rng(seed).integers(0, 256, size,
+                                                dtype=np.uint8)
+
+
+@pytest.fixture()
+def cluster(tmp_path):
+    c = MiniOzoneCluster(tmp_path, num_datanodes=5,
+                         stale_after_s=1000.0, dead_after_s=2000.0)
+    yield c
+    c.close()
+
+
+@pytest.fixture()
+def bucket(cluster):
+    oz = cluster.client()
+    oz.create_volume("v")
+    b = oz.get_volume("v").create_bucket("b", replication=EC)
+    cluster.om.set_bucket_smallobj("v", "b")
+    return b
+
+
+def _parallel_put(bucket, items):
+    """Concurrent write_key calls (the packer only coalesces what is
+    in flight together); returns {key: exception} for failures."""
+    errs: dict = {}
+
+    def one(k, v):
+        try:
+            bucket.write_key(k, v)
+        except Exception as e:  # noqa: BLE001 - collected for asserts
+            errs[k] = e
+
+    ts = [threading.Thread(target=one, args=(k, v)) for k, v in items]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    return errs
+
+
+# ------------------------------------------------------ threshold routing
+def test_threshold_routing_three_paths_byte_exact(cluster, bucket):
+    om = cluster.om
+    cases = {
+        "tiny": _payload(2_000, 1),       # <= inline_max (4096)
+        "small": _payload(20_000, 2),     # <= needle_max (256 KiB)
+        "big": _payload(500_000, 3),      # classic stripe path
+    }
+    for k, v in cases.items():
+        bucket.write_key(k, v)
+
+    tiny = om.lookup_key("v", "b", "tiny")
+    assert tiny.get("inline") is not None
+    assert not tiny.get("block_groups") and not tiny.get("needle")
+    small = om.lookup_key("v", "b", "small")
+    assert small.get("needle") and small["needle"]["slab"]
+    assert small.get("inline") is None
+    big = om.lookup_key("v", "b", "big")
+    assert big.get("block_groups") and not big.get("needle")
+    assert big.get("inline") is None
+
+    for k, v in cases.items():
+        np.testing.assert_array_equal(bucket.read_key(k), v)
+    # an explicit per-key replication opts OUT of the fast path
+    bucket.write_key("forced", cases["tiny"], EC)
+    forced = om.lookup_key("v", "b", "forced")
+    assert forced.get("inline") is None and not forced.get("needle")
+
+
+def test_inline_size_served_from_om_and_size_gate(cluster, bucket):
+    om = cluster.om
+    data = _payload(1_000, 7)
+    bucket.write_key("k", data)
+    info = om.lookup_key("v", "b", "k")
+    assert int(info["size"]) == 1_000
+    # the leader gates inline bloat: an oversized inline PUT is a
+    # typed refusal, not a bloated raft entry
+    with pytest.raises(rq.OMError):
+        om.put_inline_key("v", "b", "huge",
+                          _payload(64 * 1024, 8).tobytes())
+
+
+# ----------------------------------------------------------- coalescing
+def test_concurrent_puts_coalesce_into_shared_slabs(
+        cluster, bucket, monkeypatch):
+    # a generous linger so one wave of writers lands in one flush
+    monkeypatch.setenv("OZONE_TPU_SLAB_LINGER_MS", "100")
+    from ozone_tpu.client.slab import METRICS as SMALLOBJ
+
+    batches0 = SMALLOBJ.counter("commit_batches").value
+    n = 16
+    items = [(f"n-{i}", _payload(12_000, 10 + i)) for i in range(n)]
+    assert _parallel_put(bucket, items) == {}
+    slabs = {cluster.om.lookup_key("v", "b", k)["needle"]["slab"]
+             for k, _ in items}
+    assert len(slabs) <= n // 4, \
+        f"{n} concurrent tiny PUTs used {len(slabs)} slabs"
+    # raft amortization: one CommitKeys ring entry per slab, not per key
+    batches = SMALLOBJ.counter("commit_batches").value - batches0
+    assert batches == len(slabs)
+    for k, v in items:
+        np.testing.assert_array_equal(bucket.read_key(k), v)
+
+
+# ---------------------------------------------------------- crash drills
+def test_acked_keys_survive_packer_crash(cluster, bucket):
+    items = [(f"a-{i}", _payload(9_000, 40 + i)) for i in range(8)]
+    assert _parallel_put(bucket, items) == {}
+    # "kill -9": abandon the whole client (and its packer thread) with
+    # no flush/close; a fresh client must read every ACKED key
+    fresh = cluster.client().get_volume("v").get_bucket("b")
+    for k, v in items:
+        np.testing.assert_array_equal(fresh.read_key(k), v)
+
+
+def test_commit_crash_mid_flush_leaves_unacked_keys_absent(
+        cluster, bucket, monkeypatch):
+    om = cluster.om
+    real = om.commit_keys
+
+    def boom(*a, **kw):
+        raise RuntimeError("simulated crash between EC write and commit")
+
+    monkeypatch.setattr(om, "commit_keys", boom)
+    items = [(f"u-{i}", _payload(9_000, 60 + i)) for i in range(4)]
+    errs = _parallel_put(bucket, items)
+    assert set(errs) == {k for k, _ in items}  # nothing falsely acked
+    for k, _ in items:
+        with pytest.raises(rq.OMError):
+            om.lookup_key("v", "b", k)  # cleanly absent, no torn row
+    # recovery: the same keys succeed once the "crashed" leader is back
+    monkeypatch.setattr(om, "commit_keys", real)
+    assert _parallel_put(bucket, items) == {}
+    for k, v in items:
+        np.testing.assert_array_equal(bucket.read_key(k), v)
+
+
+def test_needle_crc_gate_refuses_torn_needle(cluster, bucket):
+    from ozone_tpu.client.slab import NEEDLE_CRC_MISMATCH
+
+    data = _payload(10_000, 77)
+    bucket.write_key("torn", data)
+    om = cluster.om
+    kk = key_key("v", "b", "torn")
+    row = om.store.get("keys", kk)
+    # simulate a torn needle: the committed directory entry no longer
+    # matches the slab bytes (the exact shape a partial flush replayed
+    # over a reused region would take)
+    row["needle"]["crc"] = int(row["needle"]["crc"]) ^ 0xDEADBEEF
+    om.store.put("keys", kk, row)
+    with pytest.raises(rq.OMError) as ei:
+        bucket.read_key("torn")
+    assert ei.value.code == NEEDLE_CRC_MISMATCH
+
+
+# ---------------------------------------------------- CommitKeys semantics
+def _slab(sid: str, length: int) -> dict:
+    # a metadata-only slab directory: these tests assert ring-entry
+    # semantics, not the datapath (covered above)
+    return {"slab_id": sid, "replication": EC, "length": length,
+            "block_groups": [{"container_id": 1, "local_id": 1,
+                              "nodes": ["dn0", "dn1", "dn2", "dn3",
+                                        "dn4"]}]}
+
+
+def _entry(key: str, offset: int, length: int, **kw) -> dict:
+    return {"key": key, "offset": offset, "length": length,
+            "crc": 0, **kw}
+
+
+def test_commit_keys_quota_is_all_or_nothing(cluster, bucket):
+    om = cluster.om
+    om.set_quota("v", "b", quota_bytes=10_000)
+    with pytest.raises(rq.OMError) as ei:
+        om.commit_keys("v", "b", _slab("s" * 16, 18_000),
+                       [_entry("q-0", 0, 9_000),
+                        _entry("q-1", 9_000, 9_000)])
+    assert ei.value.code == rq.QUOTA_EXCEEDED
+    # atomic refusal: NO key from the batch exists, the slab row was
+    # never sealed, and the quota charge did not leak
+    for k in ("q-0", "q-1"):
+        with pytest.raises(rq.OMError):
+            om.lookup_key("v", "b", k)
+    with pytest.raises(rq.OMError):
+        om.slab_info("v", "b", "s" * 16)
+    assert int(om.bucket_info("v", "b").get("used_bytes", 0)) == 0
+    om.set_quota("v", "b", quota_bytes=-1)
+
+
+def test_commit_keys_duplicate_key_last_wins(cluster, bucket):
+    om = cluster.om
+    out = om.commit_keys("v", "b", _slab("d" * 16, 8_000),
+                         [_entry("dup", 0, 3_000),
+                          _entry("dup", 3_000, 5_000)])
+    assert out["committed"] == ["dup"]
+    assert out["skipped"] == ["dup"]
+    info = om.lookup_key("v", "b", "dup")
+    assert int(info["needle"]["offset"]) == 3_000
+    assert int(info["size"]) == 5_000
+    # the superseded needle's bytes are born dead in the slab
+    srow = om.slab_info("v", "b", "d" * 16)
+    assert srow["dead_bytes"] == 3_000 and srow["dead_count"] == 1
+
+
+def test_commit_keys_fence_skips_entry_not_batch(cluster, bucket):
+    om = cluster.om
+    out = om.commit_keys(
+        "v", "b", _slab("f" * 16, 8_000),
+        [_entry("fenced", 0, 4_000, expect_object_id="gone"),
+         _entry("clean", 4_000, 4_000)])
+    assert out["committed"] == ["clean"]
+    assert out["skipped"] == ["fenced"]
+    with pytest.raises(rq.OMError):
+        om.lookup_key("v", "b", "fenced")
+    assert om.lookup_key("v", "b", "clean")["needle"]["slab"] == "f" * 16
+
+
+def test_commit_keys_routes_to_owning_shard(tmp_path):
+    from ozone_tpu.om.sharding.plane import ShardedMetaPlane
+
+    plane = ShardedMetaPlane(tmp_path, n_shards=2, mode="plain")
+    try:
+        f = plane.facade
+        m = plane.current_map()
+        f.create_volume("v")
+        for i in range(10_000):
+            name = f"b{i}"
+            if m.shard_for("v", name) == "s1":
+                b1 = name
+                break
+        f.create_bucket("v", b1, replication=EC)
+        f.set_bucket_smallobj("v", b1)
+        out = f.commit_keys("v", b1, _slab("r" * 16, 2_000),
+                            [_entry("k", 0, 2_000)])
+        assert out["committed"] == ["k"]
+        # the slab row and key row live on the owning shard, not s0
+        from ozone_tpu.om.metadata import slab_key
+
+        sk = slab_key("v", b1, "r" * 16)
+        assert plane.shards["s1"].om.store.get("slabs", sk) is not None
+        assert plane.shards["s0"].om.store.get("slabs", sk) is None
+        assert f.lookup_key("v", b1, "k")["needle"]["slab"] == "r" * 16
+    finally:
+        plane.close()
+
+
+# --------------------------------------------------------- follower reads
+def test_follower_reads_serve_inline_gets(tmp_path, monkeypatch):
+    monkeypatch.setenv("OZONE_TPU_OM_FOLLOWER_READS", "1")
+    import base64
+
+    from ozone_tpu.om.sharding.plane import ShardedMetaPlane
+    from ozone_tpu.utils.metrics import registry
+
+    m = registry("om.shard")
+    plane = ShardedMetaPlane(tmp_path, n_shards=1, mode="ring",
+                             replicas=3, follower_reads=True,
+                             timers=False)
+    try:
+        f = plane.facade
+        f.create_volume("v")
+        f.create_bucket("v", "b", replication=EC)
+        f.set_bucket_smallobj("v", "b")
+        data = _payload(1_500, 5).tobytes()
+        f.put_inline_key("v", "b", "k", data)
+        hits0 = m.counter("follower_read_hits").value
+        for _ in range(10):
+            info = f.lookup_key("v", "b", "k")
+            # the GET is complete from metadata alone: the value rides
+            # the key row, no datanode (this plane has none) involved
+            assert base64.b64decode(info["inline"]) == data
+        hits = m.counter("follower_read_hits").value - hits0
+        assert hits >= 8, f"only {hits}/10 inline GETs follower-served"
+    finally:
+        plane.close()
+
+
+# ------------------------------------------------------------ compaction
+def test_compaction_rewrites_survivors_and_releases_blocks(
+        cluster, bucket, monkeypatch):
+    monkeypatch.setenv("OZONE_TPU_SLAB_LINGER_MS", "100")
+    om = cluster.om
+    items = [(f"c-{i}", _payload(11_000, 90 + i)) for i in range(10)]
+    assert _parallel_put(bucket, items) == {}
+    slabs0 = {om.lookup_key("v", "b", k)["needle"]["slab"]
+              for k, _ in items}
+    for k, _ in items[:6]:
+        bucket.delete_key(k)
+    # purge pass: dead needles hand their BYTES back to the slab row,
+    # never the shared blocks to SCM
+    om.run_key_deleting_service_once()
+    assert sum(s["dead_count"]
+               for s in om.list_slabs("v", "b")) == 6
+    monkeypatch.setenv("OZONE_TPU_SLAB_DEAD_RATIO", "0.5")
+    stats = om.run_slab_compaction_once()
+    assert stats["compacted"] >= 1
+    assert stats["needles_rewritten"] == 4
+    assert stats["blocks_released"] >= 1
+    # survivors byte-exact from their NEW slab; old slabs retired
+    for k, v in items[6:]:
+        info = om.lookup_key("v", "b", k)
+        assert info["needle"]["slab"] not in slabs0
+        np.testing.assert_array_equal(bucket.read_key(k), v)
+    for sid in slabs0:
+        with pytest.raises(rq.OMError):
+            om.slab_info("v", "b", sid)
+    # deleted keys stay deleted
+    for k, _ in items[:6]:
+        with pytest.raises(rq.OMError):
+            om.lookup_key("v", "b", k)
+
+
+# ------------------------------------- per-key replication PUT validation
+def test_bad_per_key_replication_is_typed_and_leaves_no_orphan(
+        cluster, bucket):
+    om = cluster.om
+    open0 = len(list(om.store.iterate("open_keys")))
+    with pytest.raises(rq.OMError) as ei:
+        bucket.write_key("bad", _payload(10_000, 3),
+                         "rs-zeppelin-9000")
+    assert ei.value.code == rq.INVALID_REQUEST
+    assert "rs-zeppelin-9000" in str(ei.value)
+    # validation fired BEFORE the open landed a ring entry
+    assert len(list(om.store.iterate("open_keys"))) == open0
+    with pytest.raises(rq.OMError):
+        om.lookup_key("v", "b", "bad")
+
+
+def test_fso_bucket_refuses_smallobj(cluster):
+    om = cluster.om
+    oz = cluster.client()
+    oz.create_volume("v")
+    om.create_bucket("v", "fso", replication=EC,
+                     layout="FILE_SYSTEM_OPTIMIZED")
+    with pytest.raises(rq.OMError):
+        om.set_bucket_smallobj("v", "fso")
+
+
+# ------------------------------------------------------------ soak-ish churn
+def test_tiny_key_churn_mixed_sizes(cluster, bucket):
+    """A seeded churn mix (the soak overlay's shape, time-boxed):
+    interleaved inline/needle writes, overwrites and deletes, then
+    every surviving key byte-exact and every deleted key absent."""
+    rng = np.random.default_rng(1729)
+    om = cluster.om
+    live: dict = {}
+    for n in range(60):
+        i = int(rng.integers(0, 20))
+        key = f"churn-{i}"
+        if key in live and rng.random() < 0.3:
+            bucket.delete_key(key)
+            del live[key]
+            continue
+        size = int(rng.choice([800, 3_000, 9_000, 40_000]))
+        data = _payload(size, 1000 + n)
+        bucket.write_key(key, data)
+        live[key] = data
+    om.run_key_deleting_service_once()
+    for key, want in live.items():
+        np.testing.assert_array_equal(bucket.read_key(key), want)
+    for i in range(20):
+        if f"churn-{i}" not in live:
+            with pytest.raises(rq.OMError):
+                om.lookup_key("v", "b", f"churn-{i}")

@@ -45,6 +45,13 @@ restored (shedding is a transient of offered load, not a latched
 state). Like the slow-peer overlay it rides an INDEPENDENT rng stream
 (seed + 88_888) so historical chaos schedules stay byte-identical.
 
+PR 20 adds a tiny-key churn overlay (independent stream, seed +
+99_999): mixed-size writes, overwrites and deletes against a
+small-object bucket, so inline rows and packed needles ride the same
+chaos as stripes. End state: acked survivors byte-exact, acked
+deletes cleanly absent — the per-needle CRC gate means a torn needle
+can only fail hard, never serve wrong bytes.
+
 CI runs the default seed list below; a long nightly sweep is
 `OZONE_TPU_SOAK_SEEDS=1,2,3,... OZONE_TPU_SOAK_S=120 pytest
 tests/test_soak.py` (any seed count, longer chaos window).
@@ -224,6 +231,10 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
             "id": "t0", "prefix": "tier-", "age_days": 0.0,
             "action": "TRANSITION_TO_EC", "target": "rs-3-2-4096",
         }]))
+        # small-object fast path in the load mix: inline rows and
+        # packed needles must survive the same chaos as stripes
+        tiny_bucket = ensure_bucket(vol, "tiny", "rs-3-2-4096")
+        boot(lambda: oz.om.set_bucket_smallobj("v", "tiny"))
         ec_payload = np.random.default_rng(seed).integers(
             0, 256, 50_000, dtype=np.uint8).tobytes()
         r_payload = np.random.default_rng(seed + 1).integers(
@@ -340,6 +351,49 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
                     return
                 n += 1
 
+        # ------------------------------------------ tiny-key churn overlay
+        # the small-object fast path under the same chaos: inline
+        # writes, packed needles, overwrites and deletes. Rides an
+        # INDEPENDENT rng stream (seed + 99_999, same discipline as the
+        # slow-peer and burst overlays) so the historical chaos
+        # schedules of the CI seeds stay byte-identical. Claim
+        # discipline mirrors rename_intents: any claim is dropped
+        # BEFORE the ambiguous op fires, re-recorded only on ack.
+        tiny_acked: dict = {}      # key -> last ACKED payload bytes
+        tiny_deleted: set = set()  # acked DELETEs with no later write
+        tiny_ops = [0]
+
+        def tiny_churn():
+            trng = random.Random(seed + 99_999)
+            n = 0
+            while not stop.is_set():
+                key = f"tiny-{trng.randrange(24)}"
+                delete = key in tiny_acked and trng.random() < 0.25
+                size = trng.choice((800, 3_000, 9_000, 40_000))
+                try:
+                    if delete:
+                        tiny_acked.pop(key, None)
+                        tiny_bucket.delete_key(key)
+                        tiny_deleted.add(key)
+                    else:
+                        data = np.random.default_rng(
+                            seed * 1_000_003 + n).integers(
+                                0, 256, size, dtype=np.uint8)
+                        # a write response lost mid-failover leaves
+                        # old-or-new bytes: no claim either way
+                        tiny_acked.pop(key, None)
+                        tiny_deleted.discard(key)
+                        tiny_bucket.write_key(key, data)
+                        tiny_acked[key] = data.tobytes()
+                    tiny_ops[0] += 1
+                except (StorageError, StripeWriteError, OSError):
+                    pass  # un-acked (incl. gateway shed): no claim
+                except Exception as e:  # noqa: BLE001
+                    hard_errors.append(e)
+                    return
+                n += 1
+                time.sleep(0.1)
+
         def metadata_load():
             n = 0
             while not stop.is_set():
@@ -395,6 +449,7 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
                                    "r"),
                              daemon=True),
             threading.Thread(target=metadata_load, daemon=True),
+            threading.Thread(target=tiny_churn, daemon=True),
             threading.Thread(target=gateway_load, daemon=True),
             threading.Thread(target=overload_burst, args=(0,),
                              daemon=True),
@@ -610,6 +665,30 @@ def test_soak_all_instruments_under_load(tmp_path, seed, monkeypatch):
             if str(oz.om.lookup_key("v", "tier", key).get(
                 "replication", "")).startswith("rs-"))
         assert tiered >= 1, "sweeper made no progress by end state"
+
+        # 1c. tiny-key churn: every surviving acked key reads back
+        # byte-exact through whichever path its size routed it (inline
+        # row or packed needle — a torn needle would surface here as a
+        # hard CRC error, never as wrong bytes), and every acked delete
+        # is cleanly absent after the heal
+        assert tiny_ops[0] >= _starve_floor(), \
+            f"tiny churn starved: {tiny_ops[0]} < {_starve_floor()}"
+        for key, want in sorted(tiny_acked.items()):
+            read_back("tiny", key, want)
+        for key in sorted(tiny_deleted):
+            t_end = time.monotonic() + 30.0
+            while True:
+                try:
+                    oz.om.lookup_key("v", "tiny", key)
+                    raise AssertionError(
+                        f"acked delete resurfaced: tiny/{key}")
+                except (StorageError, OSError) as e:
+                    code = getattr(e, "code", None)
+                    if code == "KEY_NOT_FOUND":
+                        break  # cleanly absent, the claim holds
+                    if time.monotonic() > t_end:  # still healing?
+                        raise
+                    time.sleep(1.0)
 
         # 1b. acked S3 objects read back THROUGH the gateway (its own
         # OM client must have ridden the failovers), same retry budget
